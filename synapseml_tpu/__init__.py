@@ -1,0 +1,41 @@
+"""synapseml_tpu — a TPU-native distributed ML framework with the capability
+surface of SynapseML (reference surveyed in SURVEY.md), built on JAX/Flax/
+Pallas/pjit with a C++ native runtime for host-side hot paths.
+
+Subpackages mirror the reference's module layout:
+  core/        data plane (DataFrame), params, pipeline API, logging, utils
+  parallel/    the one communication backend: mesh, collectives, checkpoint
+  ops/         Pallas/XLA kernels (histogram, ring attention, quantize)
+  models/      Flax model zoo + DeepText/DeepVision/CausalLM estimators
+  lightgbm/    GBDT estimators on a Pallas histogram engine
+  vw/          hashed-feature linear/bandit learners + policy evaluation
+  image/       ImageTransformer-equivalent preprocessing
+  onnx/        ONNX protobuf import -> JAX inference path
+  io/          HTTP-on-Spark-equivalent client stack + serving
+  services/    AI service transformers (OpenAI et al.)
+  stages/      generic transformers (minibatch, lambda, repartition, ...)
+  featurize/   auto-featurization, text featurization
+  explainers/  LIME/SHAP/ICE
+  causal/      DoubleML, diff-in-diff, synthetic control
+  recommendation/ SAR, ranking evaluation
+  nn/          KNN (TPU brute-force matmul + ball tree)
+  automl/      hyperparameter search, FindBestModel
+  train/       TrainClassifier/TrainRegressor/ComputeModelStatistics
+  exploratory/ data balance measures
+  cyber/       access-anomaly detection
+  isolationforest/ isolation forest
+"""
+
+__version__ = "0.1.0"
+
+from .core import (  # noqa: F401
+    DataFrame,
+    Estimator,
+    GlobalParams,
+    Model,
+    Pipeline,
+    PipelineModel,
+    PipelineStage,
+    Transformer,
+    load_stage,
+)

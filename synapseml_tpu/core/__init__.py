@@ -1,0 +1,11 @@
+from .dataframe import DataFrame, Partition, concat_partitions, schema_of
+from .params import ComplexParam, GlobalParams, Param, Params, ServiceParam, TypeConverters
+from .pipeline import Estimator, Model, Pipeline, PipelineModel, PipelineStage, Transformer, load_stage
+from .utils import ClusterInfo, StopWatch, cluster_info, retry_with_timeout, using
+
+__all__ = [
+    "DataFrame", "Partition", "concat_partitions", "schema_of",
+    "Param", "ComplexParam", "ServiceParam", "Params", "GlobalParams", "TypeConverters",
+    "PipelineStage", "Transformer", "Estimator", "Model", "Pipeline", "PipelineModel", "load_stage",
+    "StopWatch", "retry_with_timeout", "using", "ClusterInfo", "cluster_info",
+]

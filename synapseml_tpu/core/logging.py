@@ -1,0 +1,63 @@
+"""Uniform stage telemetry — the SynapseMLLogging equivalent.
+
+Reference: ``core/.../logging/SynapseMLLogging.scala:94-172`` — every stage wraps
+fit/transform in ``logFit``/``logTransform`` emitting structured JSON (uid, class,
+method, duration, schema size) with secrets scrubbed
+(``logging/common/Scrubber.scala``). Here the same contract is a decorator pair
+used by :class:`synapseml_tpu.core.pipeline.Transformer`/``Estimator``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import time
+from typing import Any
+
+logger = logging.getLogger("synapseml_tpu")
+
+_SECRET_PAT = re.compile(
+    r"(?i)(sig|key|token|secret|password|authorization|api[-_]?key)=([^&\s\"]+)")
+_BEARER_PAT = re.compile(r"(?i)bearer\s+[a-z0-9\-_\.=]+")
+
+
+def scrub(text: str) -> str:
+    """Strip secrets out of log payloads (reference ``SASScrubber``)."""
+    text = _SECRET_PAT.sub(lambda m: f"{m.group(1)}=####", text)
+    return _BEARER_PAT.sub("Bearer ####", text)
+
+
+def log_stage_event(payload: dict) -> None:
+    logger.info(scrub(json.dumps(payload, default=str)))
+
+
+class StageTelemetry:
+    """Mixin providing log_fit / log_transform / log_verb wrappers."""
+
+    feature_name: str = "core"
+
+    def _emit(self, method: str, duration_ms: float, extra: dict[str, Any] | None = None,
+              error: BaseException | None = None) -> None:
+        payload = {
+            "uid": getattr(self, "uid", "?"),
+            "className": type(self).__name__,
+            "featureName": self.feature_name,
+            "method": method,
+            "durationMs": round(duration_ms, 3),
+        }
+        if extra:
+            payload.update(extra)
+        if error is not None:
+            payload["error"] = f"{type(error).__name__}: {error}"
+        log_stage_event(payload)
+
+    def log_verb(self, method: str, fn, *args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            out = fn(*args, **kwargs)
+        except BaseException as e:
+            self._emit(method, (time.perf_counter() - t0) * 1e3, error=e)
+            raise
+        self._emit(method, (time.perf_counter() - t0) * 1e3)
+        return out

@@ -1,0 +1,183 @@
+"""Stage persistence: metadata.json + out-of-band complex params.
+
+Reference: ``org/apache/spark/ml/{Serializer,ComplexParamsSerializer}.scala`` —
+JSON for simple params, object serialization for complex ones (models,
+DataFrames, UDFs). Here: JSON metadata + npz for numpy/pytree leaves + pickle
+fallback for callables/objects, per complex param.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import pickle
+import shutil
+from typing import Any
+
+import numpy as np
+
+__all__ = ["save_stage", "load_stage", "prepare_dir", "save_pytree", "load_pytree"]
+
+
+def prepare_dir(path: str, overwrite: bool = True) -> None:
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(path)
+        shutil.rmtree(path)
+    os.makedirs(path, exist_ok=True)
+
+
+def _flatten_pytree(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_pytree(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten_pytree(v, f"{prefix}{i}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def save_pytree(tree: Any, path: str) -> None:
+    """Save a (possibly nested dict) pytree of arrays as one npz + structure JSON."""
+    flat = _flatten_pytree(tree)
+    np.savez(path + ".npz", **flat)
+    structure = _tree_structure(tree)
+    with open(path + ".tree.json", "w") as f:
+        json.dump(structure, f)
+
+
+def _tree_structure(tree: Any) -> Any:
+    if isinstance(tree, dict):
+        return {"__kind__": "dict", "items": {k: _tree_structure(v) for k, v in tree.items()}}
+    if isinstance(tree, (list, tuple)):
+        kind = "list" if isinstance(tree, list) else "tuple"
+        return {"__kind__": kind, "items": [_tree_structure(v) for v in tree]}
+    return {"__kind__": "leaf"}
+
+
+def load_pytree(path: str) -> Any:
+    data = np.load(path + ".npz", allow_pickle=False)
+    with open(path + ".tree.json") as f:
+        structure = json.load(f)
+
+    def rebuild(node, prefix=""):
+        kind = node["__kind__"]
+        if kind == "dict":
+            return {k: rebuild(v, f"{prefix}{k}/") for k, v in node["items"].items()}
+        if kind in ("list", "tuple"):
+            seq = [rebuild(v, f"{prefix}{i}/") for i, v in enumerate(node["items"])]
+            return seq if kind == "list" else tuple(seq)
+        return data[prefix.rstrip("/")]
+
+    return rebuild(structure)
+
+
+def _is_array_pytree(v: Any) -> bool:
+    if isinstance(v, np.ndarray) or np.isscalar(v):
+        return True
+    if hasattr(v, "__array__") and hasattr(v, "dtype"):  # jax arrays
+        return True
+    if isinstance(v, dict):
+        return bool(v) and all(_is_array_pytree(x) for x in v.values())
+    if isinstance(v, (list, tuple)):
+        return bool(v) and all(_is_array_pytree(x) for x in v)
+    return False
+
+
+def save_stage(stage, path: str, overwrite: bool = True) -> None:
+    from .pipeline import PipelineStage  # local import to avoid cycle
+
+    prepare_dir(path, overwrite)
+    complex_vals = stage.complex_param_values()
+    meta = {
+        "class": f"{type(stage).__module__}.{type(stage).__qualname__}",
+        "uid": stage.uid,
+        "params": _jsonify(stage.simple_param_values()),
+        "complexParams": {},
+    }
+    for name, value in complex_vals.items():
+        entry: dict[str, Any] = {}
+        target = os.path.join(path, f"complex_{name}")
+        if isinstance(value, PipelineStage):
+            entry["kind"] = "stage"
+            save_stage(value, target, overwrite=overwrite)
+        elif isinstance(value, list) and value and all(isinstance(v, PipelineStage) for v in value):
+            entry["kind"] = "stage_list"
+            entry["n"] = len(value)
+            for i, v in enumerate(value):
+                save_stage(v, f"{target}_{i:03d}", overwrite=overwrite)
+        elif _is_array_pytree(value):
+            entry["kind"] = "pytree"
+            save_pytree(_to_numpy_tree(value), target)
+        else:
+            entry["kind"] = "pickle"
+            with open(target + ".pkl", "wb") as f:
+                pickle.dump(value, f)
+        meta["complexParams"][name] = entry
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+
+
+def _to_numpy_tree(v: Any) -> Any:
+    if isinstance(v, dict):
+        return {k: _to_numpy_tree(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        t = [_to_numpy_tree(x) for x in v]
+        return t if isinstance(v, list) else tuple(t)
+    return np.asarray(v)
+
+
+def _jsonify(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, np.ndarray):
+            out[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+        elif isinstance(v, (np.integer,)):
+            out[k] = int(v)
+        elif isinstance(v, (np.floating,)):
+            out[k] = float(v)
+        else:
+            out[k] = v
+    return out
+
+
+def _unjsonify(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, dict) and "__ndarray__" in v:
+            out[k] = np.asarray(v["__ndarray__"], dtype=v["dtype"])
+        else:
+            out[k] = v
+    return out
+
+
+def load_stage(path: str):
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    mod_name, _, cls_name = meta["class"].rpartition(".")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    stage = cls.__new__(cls)
+    # re-run Params.__init__ machinery without subclass ctor side effects
+    from .params import Params
+
+    Params.__init__(stage, uid=meta["uid"])
+    stage.set(**_unjsonify(meta["params"]))
+    for name, entry in meta.get("complexParams", {}).items():
+        target = os.path.join(path, f"complex_{name}")
+        if entry["kind"] == "stage":
+            value = load_stage(target)
+        elif entry["kind"] == "stage_list":
+            value = [load_stage(f"{target}_{i:03d}") for i in range(entry["n"])]
+        elif entry["kind"] == "pytree":
+            value = load_pytree(target)
+        else:
+            with open(target + ".pkl", "rb") as f:
+                value = pickle.load(f)
+        stage.set(**{name: value})
+    if hasattr(stage, "_post_load"):
+        stage._post_load()
+    return stage

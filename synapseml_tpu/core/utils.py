@@ -1,0 +1,98 @@
+"""Core utilities: timing, fault tolerance, cluster/device introspection.
+
+Reference analogs: ``core/utils/ClusterUtil.scala:14-191`` (executor/task-slot
+discovery), ``core/utils/FaultToleranceUtils`` (retryWithTimeout),
+``StopWatch``, ``StreamUtilities.using``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import contextlib
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = ["StopWatch", "retry_with_timeout", "using", "ClusterInfo", "cluster_info"]
+
+
+class StopWatch:
+    def __init__(self):
+        self._start = None
+        self.elapsed_ms = 0.0
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed_ms += (time.perf_counter() - self._start) * 1e3
+        self._start = None
+        return False
+
+    def measure(self, fn: Callable, *a, **kw):
+        with self:
+            return fn(*a, **kw)
+
+
+def retry_with_timeout(fn: Callable[[], Any], timeout_s: float = 60.0,
+                       retries: int = 3, backoff_s: float = 0.5) -> Any:
+    """Run fn with a per-attempt timeout and exponential backoff between retries.
+
+    Reference: ``FaultToleranceUtils.retryWithTimeout`` used by NetworkManager
+    (``NetworkManager.scala:114``) and VW ``trainIteration``.
+    """
+    last: BaseException | None = None
+    for attempt in range(retries):
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+            fut = pool.submit(fn)
+            try:
+                return fut.result(timeout=timeout_s)
+            except BaseException as e:  # noqa: BLE001 - rethrown after retries
+                last = e
+                fut.cancel()
+        if attempt < retries - 1:
+            time.sleep(backoff_s * (2 ** attempt))
+    raise last  # type: ignore[misc]
+
+
+@contextlib.contextmanager
+def using(*resources):
+    """Close resources on exit (reference StreamUtilities.using)."""
+    try:
+        yield resources if len(resources) > 1 else resources[0]
+    finally:
+        for r in reversed(resources):
+            close = getattr(r, "close", None)
+            if close:
+                with contextlib.suppress(Exception):
+                    close()
+
+
+@dataclass
+class ClusterInfo:
+    """Host/device topology snapshot (ClusterUtil analog, TPU edition)."""
+
+    num_hosts: int
+    host_index: int
+    devices_per_host: int
+    total_devices: int
+    platform: str
+    coordinator_address: str | None = None
+
+    @property
+    def tasks_per_executor(self) -> int:
+        # one task slot per local device: the 1:1 executor<->TPU-host pinning
+        return self.devices_per_host
+
+
+def cluster_info() -> ClusterInfo:
+    import jax
+
+    return ClusterInfo(
+        num_hosts=jax.process_count(),
+        host_index=jax.process_index(),
+        devices_per_host=jax.local_device_count(),
+        total_devices=jax.device_count(),
+        platform=jax.devices()[0].platform,
+    )

@@ -1,0 +1,28 @@
+from .backend import (
+    DistributedBackend,
+    DriverRendezvous,
+    initialize_backend,
+    reset_backend,
+    worker_rendezvous,
+)
+from .batching import (
+    DoubleBufferedFeeder,
+    PaddedBatch,
+    batches,
+    bucket_size,
+    pad_batch,
+    pad_sequences,
+    round_up_to_multiple,
+    unpad,
+)
+from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from .mesh import MeshConfig, MeshContext, P, create_mesh, logical_axis_rules, shard_params
+
+__all__ = [
+    "DistributedBackend", "DriverRendezvous", "initialize_backend", "reset_backend",
+    "worker_rendezvous",
+    "DoubleBufferedFeeder", "PaddedBatch", "batches", "bucket_size", "pad_batch",
+    "pad_sequences", "round_up_to_multiple", "unpad",
+    "latest_step", "restore_checkpoint", "save_checkpoint",
+    "MeshConfig", "MeshContext", "P", "create_mesh", "logical_axis_rules", "shard_params",
+]

@@ -1,0 +1,156 @@
+"""Distributed bootstrap: driver-rendezvous -> jax.distributed.
+
+The reference bootstraps topology three different ways (SURVEY.md §2.7 item 7):
+LightGBM's driver ServerSocket rendezvous (``NetworkManager.scala:59-125``), VW's
+spanning-tree coordinator (``VowpalWabbitClusterUtil.scala:15-42``) and horovod's
+SparkBackend (``dl/utils.py:31-46``). All reduce to the same shape: a driver
+collects worker endpoints, computes a deterministic ordering, broadcasts the
+peer list, then a native collective ring forms.
+
+TPU-native: the only thing workers need is the coordinator address + their
+process index; `jax.distributed.initialize` then wires ICI/DCN. This module
+implements that rendezvous over a plain TCP socket so a Spark-like driver (or
+any launcher) can hand each executor its (coordinator, rank, world) triple —
+and a single-process fallback that skips rendezvous entirely.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass
+
+__all__ = ["DriverRendezvous", "worker_rendezvous", "DistributedBackend", "initialize_backend"]
+
+
+@dataclass
+class WorkerInfo:
+    host: str
+    executor_id: str
+    partition_id: int
+
+
+class DriverRendezvous:
+    """Driver side: collect `world_size` worker registrations, assign ranks by
+    (min partition id, executor id) — the reference's deterministic ordering
+    (``NetworkManager.waitForAllTasksToReport:354-425``) — and reply with
+    {coordinator, rank, world}."""
+
+    def __init__(self, world_size: int, coordinator_port: int = 9377, bind: str = "0.0.0.0"):
+        self.world_size = world_size
+        self.coordinator_port = coordinator_port
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((bind, 0))
+        self._srv.listen(world_size * 2)
+        self.port = self._srv.getsockname()[1]
+        self._thread: threading.Thread | None = None
+        self.error: BaseException | None = None
+
+    @property
+    def address(self) -> str:
+        return f"{socket.gethostname()}:{self.port}"
+
+    def start(self) -> "DriverRendezvous":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        try:
+            conns, infos = [], []
+            while len(conns) < self.world_size:
+                conn, _ = self._srv.accept()
+                data = json.loads(conn.makefile("r").readline())
+                infos.append(WorkerInfo(**data))
+                conns.append(conn)
+            order = sorted(range(len(infos)),
+                           key=lambda i: (infos[i].partition_id, infos[i].executor_id))
+            coord_host = infos[order[0]].host
+            coordinator = f"{coord_host}:{self.coordinator_port}"
+            for rank, i in enumerate(order):
+                reply = {"coordinator": coordinator, "rank": rank, "world": self.world_size}
+                conns[i].sendall((json.dumps(reply) + "\n").encode())
+            for c in conns:
+                c.close()
+        except BaseException as e:  # surfaced via .error for the driver loop
+            self.error = e
+        finally:
+            self._srv.close()
+
+    def join(self, timeout_s: float = 120.0) -> None:
+        assert self._thread is not None
+        self._thread.join(timeout_s)
+        if self.error:
+            raise self.error
+
+
+def worker_rendezvous(driver_address: str, executor_id: str, partition_id: int,
+                      timeout_s: float = 120.0, retry_interval_s: float = 0.25) -> dict:
+    """Worker side: register with the driver, receive (coordinator, rank, world).
+    Retries with backoff like ``NetworkManager.initLightGBMNetwork:195-218``."""
+    host, port = driver_address.rsplit(":", 1)
+    deadline = time.monotonic() + timeout_s
+    last: BaseException | None = None
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, int(port)), timeout=timeout_s) as s:
+                payload = {"host": socket.gethostname(), "executor_id": executor_id,
+                           "partition_id": partition_id}
+                s.sendall((json.dumps(payload) + "\n").encode())
+                return json.loads(s.makefile("r").readline())
+        except OSError as e:
+            last = e
+            time.sleep(retry_interval_s)
+            retry_interval_s = min(retry_interval_s * 2, 5.0)
+    raise TimeoutError(f"rendezvous with {driver_address} failed: {last}")
+
+
+@dataclass
+class DistributedBackend:
+    """The one comm backend handle estimators receive."""
+
+    rank: int
+    world: int
+    coordinator: str | None
+    initialized: bool
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.world > 1
+
+
+_BACKEND: DistributedBackend | None = None
+
+
+def initialize_backend(driver_address: str | None = None, executor_id: str | None = None,
+                       partition_id: int = 0) -> DistributedBackend:
+    """Initialize jax.distributed from rendezvous (multi-host) or env/defaults.
+
+    Single-process (tests, 1 TPU VM, CPU mesh): no-op beyond recording a
+    world-of-1 backend. Multi-host: rendezvous -> jax.distributed.initialize.
+    """
+    global _BACKEND
+    if _BACKEND is not None:
+        return _BACKEND
+    import jax
+
+    if driver_address is None:
+        _BACKEND = DistributedBackend(rank=jax.process_index(), world=jax.process_count(),
+                                      coordinator=os.environ.get("JAX_COORDINATOR_ADDRESS"),
+                                      initialized=False)
+        return _BACKEND
+    info = worker_rendezvous(driver_address, executor_id or socket.gethostname(), partition_id)
+    jax.distributed.initialize(coordinator_address=info["coordinator"],
+                               num_processes=info["world"], process_id=info["rank"])
+    _BACKEND = DistributedBackend(rank=info["rank"], world=info["world"],
+                                  coordinator=info["coordinator"], initialized=True)
+    return _BACKEND
+
+
+def reset_backend() -> None:
+    global _BACKEND
+    _BACKEND = None

@@ -1,0 +1,74 @@
+"""Sharded checkpoint/resume keyed by mesh (orbax-backed, npz fallback).
+
+Reference checkpointing is model-level: LightGBM ``modelString`` carry-over
+(``LightGBMBase.scala:48-60``), VW ``initialModel`` bytes, pytorch-lightning
+ModelCheckpoint (SURVEY.md §5). TPU equivalent: orbax sharded checkpoints that
+restore onto a different mesh topology (host-side numpy round-trip when orbax
+is unavailable or the target is single-process).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..core import serialization
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step"]
+
+
+def _step_dir(path: str, step: int) -> str:
+    return os.path.join(path, f"step_{step:010d}")
+
+
+def save_checkpoint(path: str, tree: Any, step: int = 0, use_orbax: bool | None = None) -> str:
+    """Save a pytree (params/opt state). Device arrays are fetched host-side
+    first so the artifact is topology-independent."""
+    target = _step_dir(path, step)
+    os.makedirs(target, exist_ok=True)
+    host_tree = jax.tree.map(lambda x: np.asarray(x), tree)
+    if use_orbax is None:
+        use_orbax = False  # npz path is deterministic + dependency-light; orbax opt-in
+    if use_orbax:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(target, "orbax"), host_tree, force=True)
+    else:
+        serialization.save_pytree(host_tree, os.path.join(target, "state"))
+    with open(os.path.join(target, "DONE"), "w") as f:
+        f.write(str(step))
+    return target
+
+
+def latest_step(path: str) -> int | None:
+    if not os.path.isdir(path):
+        return None
+    steps = []
+    for d in os.listdir(path):
+        if d.startswith("step_") and os.path.exists(os.path.join(path, d, "DONE")):
+            steps.append(int(d.split("_")[1]))
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(path: str, step: int | None = None, sharding_fn=None) -> Any:
+    """Restore; `sharding_fn(leaf_path) -> Sharding` re-places leaves on the
+    current mesh (None = host numpy)."""
+    if step is None:
+        step = latest_step(path)
+        if step is None:
+            raise FileNotFoundError(f"no completed checkpoint under {path}")
+    target = _step_dir(path, step)
+    orbax_dir = os.path.join(target, "orbax")
+    if os.path.isdir(orbax_dir):
+        import orbax.checkpoint as ocp
+
+        tree = ocp.PyTreeCheckpointer().restore(orbax_dir)
+    else:
+        tree = serialization.load_pytree(os.path.join(target, "state"))
+    if sharding_fn is not None:
+        tree = jax.tree.map(lambda x: jax.device_put(x, sharding_fn(x)), tree)
+    return tree

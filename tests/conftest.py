@@ -1,0 +1,70 @@
+"""Test harness: host-count-faked JAX CPU mesh (SURVEY.md §4 rebuild implication c).
+
+Must set XLA flags BEFORE jax initializes a backend: 8 virtual CPU devices so
+every sharding/collective path is exercised without TPU hardware — the analog
+of the reference running NetworkManager on local[*] Spark.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env points at real TPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The container's sitecustomize imports jax at interpreter boot (axon PJRT
+# registration), capturing JAX_PLATFORMS=axon before this file runs — override
+# through the config API, which wins as long as no backend is live yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from synapseml_tpu.parallel import MeshConfig, create_mesh
+
+    return create_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+
+
+@pytest.fixture(scope="session")
+def mesh_dp8():
+    from synapseml_tpu.parallel import MeshConfig, create_mesh
+
+    return create_mesh(MeshConfig(data=-1))
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
+
+
+def make_tabular_df(n=200, d=8, classes=2, seed=0, num_partitions=2):
+    """Shared synthetic dataset builder (TestBase makeBasicDF analog)."""
+    from synapseml_tpu.core import DataFrame
+
+    rs = np.random.default_rng(seed)
+    X = rs.normal(size=(n, d)).astype(np.float32)
+    w = rs.normal(size=(d,)).astype(np.float32)
+    logits = X @ w
+    if classes == 0:
+        y = (logits + 0.1 * rs.normal(size=n)).astype(np.float32)  # regression
+    else:
+        y = (np.digitize(logits, np.quantile(logits, np.linspace(0, 1, classes + 1)[1:-1]))
+             ).astype(np.int32)
+    return DataFrame.from_dict({"features": X, "label": y}, num_partitions=num_partitions)
+
+
+@pytest.fixture()
+def tabular_df():
+    return make_tabular_df()
+
+
+@pytest.fixture()
+def regression_df():
+    return make_tabular_df(classes=0)

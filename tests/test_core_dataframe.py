@@ -1,0 +1,78 @@
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+
+
+def test_from_dict_and_schema():
+    df = DataFrame.from_dict({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    assert df.count() == 3
+    assert df.columns == ["a", "b"]
+    assert df.schema["a"][0].startswith("int")
+    assert df.schema["b"][0] == "object"
+
+
+def test_repartition_roundtrip():
+    df = DataFrame.from_dict({"a": np.arange(10)}, num_partitions=3)
+    assert df.num_partitions == 3
+    assert df.count() == 10
+    np.testing.assert_array_equal(df.collect_column("a"), np.arange(10))
+    df2 = df.repartition(4).coalesce(2)
+    assert df2.num_partitions == 2
+    np.testing.assert_array_equal(df2.collect_column("a"), np.arange(10))
+
+
+def test_select_drop_rename():
+    df = DataFrame.from_dict({"a": [1], "b": [2], "c": [3]})
+    assert df.select("a", "c").columns == ["a", "c"]
+    assert df.drop("b").columns == ["a", "c"]
+    assert df.with_column_renamed("a", "z").columns == ["z", "b", "c"]
+    with pytest.raises(KeyError):
+        df.select("nope")
+
+
+def test_with_column_fn_and_array():
+    df = DataFrame.from_dict({"a": np.arange(6, dtype=np.float32)}, num_partitions=2)
+    df2 = df.with_column("double", lambda p: p["a"] * 2)
+    np.testing.assert_allclose(df2.collect_column("double"), np.arange(6) * 2)
+    df3 = df.with_column("idx", np.arange(6))
+    np.testing.assert_array_equal(df3.collect_column("idx"), np.arange(6))
+
+
+def test_filter_limit_sort():
+    df = DataFrame.from_dict({"a": np.array([5, 3, 1, 4, 2])}, num_partitions=2)
+    assert df.filter(lambda p: p["a"] > 2).count() == 3
+    assert df.limit(2).count() == 2
+    np.testing.assert_array_equal(df.sort("a").collect_column("a"), [1, 2, 3, 4, 5])
+
+
+def test_map_partitions_and_rows():
+    df = DataFrame.from_dict({"a": np.arange(4)}, num_partitions=2)
+    df2 = df.map_partitions(lambda p: {"a": p["a"], "sq": p["a"] ** 2})
+    np.testing.assert_array_equal(df2.collect_column("sq"), [0, 1, 4, 9])
+    df3 = df.map_rows(lambda r: {"s": str(r["a"])})
+    assert list(df3.collect_column("s")) == ["0", "1", "2", "3"]
+
+
+def test_random_split_union():
+    df = DataFrame.from_dict({"a": np.arange(100)})
+    tr, te = df.random_split([0.8, 0.2], seed=7)
+    assert tr.count() + te.count() == 100
+    assert 70 <= tr.count() <= 90
+    merged = tr.union(te)
+    assert merged.count() == 100
+    assert set(merged.collect_column("a")) == set(range(100))
+
+
+def test_tensor_columns():
+    X = np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32)
+    df = DataFrame.from_dict({"features": X}, num_partitions=3)
+    assert df.schema["features"] == ("float32", (4,))
+    np.testing.assert_allclose(df.collect_column("features"), X)
+
+
+def test_to_pandas_roundtrip():
+    df = DataFrame.from_dict({"a": [1, 2], "b": ["x", "y"]})
+    pdf = df.to_pandas()
+    df2 = DataFrame.from_pandas(pdf)
+    assert list(df2.collect_column("b")) == ["x", "y"]

@@ -1,0 +1,120 @@
+import os
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import (
+    ComplexParam,
+    DataFrame,
+    Estimator,
+    GlobalParams,
+    Model,
+    Param,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+    load_stage,
+)
+from synapseml_tpu.core.params import ServiceParam, TypeConverters
+
+
+class AddConst(Transformer):
+    input_col = Param("input_col", "input column", default="a")
+    output_col = Param("output_col", "output column", default="out")
+    value = Param("value", "constant to add", default=1.0, converter=TypeConverters.to_float)
+
+    def _transform(self, df):
+        return df.with_column(self.get("output_col"),
+                              lambda p: p[self.get("input_col")] + self.get("value"))
+
+
+class MeanModel(Model):
+    input_col = Param("input_col", "input column", default="a")
+    mean = ComplexParam("mean", "fitted mean")
+
+    def _transform(self, df):
+        return df.with_column("centered", lambda p: p[self.get("input_col")] - self.get("mean"))
+
+
+class MeanCenter(Estimator):
+    input_col = Param("input_col", "input column", default="a")
+
+    def _fit(self, df):
+        m = float(np.mean(df.collect_column(self.get("input_col"))))
+        return MeanModel(input_col=self.get("input_col"), mean=np.float32(m))
+
+
+def test_param_accessors():
+    t = AddConst(value=2)
+    assert t.get_value() == 2.0
+    t.set_value(3)
+    assert t.get("value") == 3.0
+    with pytest.raises(KeyError):
+        t.set(nope=1)
+    assert "value: constant to add" in t.explain_params()
+
+
+def test_global_params():
+    GlobalParams.reset()
+    t = AddConst()
+    assert t.get("value") == 1.0
+    GlobalParams.set_default(AddConst, "value", 9.0)
+    assert t.get("value") == 9.0
+    t.set_value(2)
+    assert t.get("value") == 2.0  # explicit set wins
+    GlobalParams.reset()
+
+
+def test_service_param_resolution():
+    class S(Transformer):
+        temp = ServiceParam("temp", "temperature")
+
+        def _transform(self, df):
+            return df
+
+    s = S(temp=("col", "t"))
+    part = {"t": np.array([0.1, 0.2])}
+    assert s.resolve_row_param("temp", part, 2) == [0.1, 0.2]
+    s.set(temp=0.5)
+    assert s.resolve_row_param("temp", part, 2) == [0.5, 0.5]
+
+
+def test_fit_transform_and_pipeline(tmp_path):
+    df = DataFrame.from_dict({"a": np.arange(10, dtype=np.float32)}, num_partitions=2)
+    pipe = Pipeline(stages=[AddConst(value=5, output_col="a5"), MeanCenter()])
+    model = pipe.fit(df)
+    assert isinstance(model, PipelineModel)
+    out = model.transform(df)
+    np.testing.assert_allclose(out.collect_column("centered"), np.arange(10) - 4.5)
+
+
+def test_stage_save_load(tmp_path):
+    path = os.path.join(tmp_path, "stage")
+    t = AddConst(value=7, output_col="z")
+    t.save(path)
+    t2 = load_stage(path)
+    assert isinstance(t2, AddConst)
+    assert t2.get("value") == 7.0 and t2.get("output_col") == "z"
+    assert t2.uid == t.uid
+
+
+def test_model_save_load_complex(tmp_path):
+    df = DataFrame.from_dict({"a": np.arange(4, dtype=np.float32)})
+    model = MeanCenter().fit(df)
+    path = os.path.join(tmp_path, "model")
+    model.save(path)
+    m2 = load_stage(path)
+    np.testing.assert_allclose(np.asarray(m2.get("mean")), 1.5)
+    out = m2.transform(df)
+    np.testing.assert_allclose(out.collect_column("centered"), np.arange(4) - 1.5)
+
+
+def test_pipeline_save_load(tmp_path):
+    df = DataFrame.from_dict({"a": np.arange(6, dtype=np.float32)})
+    model = Pipeline(stages=[AddConst(value=1), MeanCenter()]).fit(df)
+    path = os.path.join(tmp_path, "pm")
+    model.save(path)
+    m2 = PipelineModel.load(path)
+    a = model.transform(df).collect_column("centered")
+    b = m2.transform(df).collect_column("centered")
+    np.testing.assert_allclose(a, b)
