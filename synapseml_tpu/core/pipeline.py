@@ -43,6 +43,14 @@ class PipelineStage(Params, StageTelemetry):
         """Best-effort schema propagation (SparkML transformSchema analog)."""
         return schema
 
+    def require_columns(self, df: DataFrame, *cols: str) -> None:
+        """Fail fast with a readable message when input columns are missing
+        (SparkML validateSchema analog)."""
+        missing = [c for c in cols if c not in df.columns]
+        if missing:
+            raise ValueError(f"{type(self).__name__} ({self.uid}): input column(s) "
+                             f"{missing} not found; DataFrame has {df.columns}")
+
 
 class Transformer(PipelineStage):
     def _transform(self, df: DataFrame) -> DataFrame:  # pragma: no cover - abstract

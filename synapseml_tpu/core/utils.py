@@ -44,13 +44,18 @@ def retry_with_timeout(fn: Callable[[], Any], timeout_s: float = 60.0,
     """
     last: BaseException | None = None
     for attempt in range(retries):
-        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
-            fut = pool.submit(fn)
-            try:
-                return fut.result(timeout=timeout_s)
-            except BaseException as e:  # noqa: BLE001 - rethrown after retries
-                last = e
-                fut.cancel()
+        # no `with`: shutdown(wait=True) would join a hung fn and defeat the
+        # timeout; abandon the worker thread instead (daemon threads don't
+        # block process exit)
+        pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        fut = pool.submit(fn)
+        try:
+            return fut.result(timeout=timeout_s)
+        except BaseException as e:  # noqa: BLE001 - rethrown after retries
+            last = e
+            fut.cancel()
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
         if attempt < retries - 1:
             time.sleep(backoff_s * (2 ** attempt))
     raise last  # type: ignore[misc]

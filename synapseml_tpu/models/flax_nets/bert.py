@@ -1,0 +1,84 @@
+"""BERT-style text encoder + classification head (Flax).
+
+Reference analog: the HF ``AutoModelForSequenceClassification`` wrapped by
+``dl/LitDeepTextModel.py:29-176``; here a native Flax module with GSPMD axis
+names so `DeepTextClassifier` shards it over the mesh instead of horovod DP.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import Encoder, TransformerConfig
+
+__all__ = ["BertConfig", "BertClassifier", "bert_base", "bert_tiny"]
+
+
+def BertConfig(**kw) -> TransformerConfig:
+    defaults = dict(vocab_size=30522, hidden=768, n_layers=12, n_heads=12,
+                    mlp_dim=3072, max_len=512, norm="layernorm", act="gelu")
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+def bert_base(**kw) -> TransformerConfig:
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw) -> TransformerConfig:
+    defaults = dict(vocab_size=1024, hidden=64, n_layers=2, n_heads=2, mlp_dim=128, max_len=128)
+    defaults.update(kw)
+    return BertConfig(**defaults)
+
+
+class BertEmbeddings(nn.Module):
+    cfg: TransformerConfig
+    n_segments: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        cfg = self.cfg
+        embed = lambda name, num: nn.Embed(  # noqa: E731
+            num, cfg.hidden, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")),
+            name=name)
+        x = embed("word", cfg.vocab_size)(input_ids)
+        pos = jnp.arange(input_ids.shape[1])[None, :]
+        x = x + embed("position", cfg.max_len)(pos)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + embed("segment", self.n_segments)(token_type_ids)
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        if cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout, deterministic=not self.has_rng("dropout"))(x)
+        return x
+
+
+class BertClassifier(nn.Module):
+    """[B,T] token ids -> [B,num_classes] logits (CLS pooling)."""
+
+    cfg: TransformerConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        cfg = self.cfg
+        x = BertEmbeddings(cfg, name="embeddings")(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,T]
+        x = Encoder(cfg, name="encoder")(x, mask)
+        cls = x[:, 0]
+        pooled = nn.tanh(nn.Dense(
+            cfg.hidden, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(nn.initializers.xavier_uniform(),
+                                                     ("embed", "mlp")),
+            name="pooler")(cls))
+        logits = nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(nn.initializers.xavier_uniform(),
+                                                     ("embed", None)),
+            name="classifier")(pooled)
+        return logits

@@ -1,0 +1,125 @@
+"""Llama-family causal LM (Flax) — backbone for sharded batch inference.
+
+Reference analog: ``hf/HuggingFaceCausalLMTransform.py:103-331`` loads torch
+models per-partition; here a native Flax decoder (RMSNorm + SwiGLU + RoPE +
+GQA) whose weights shard over the tensor/fsdp mesh axes — the Llama-2-7B
+sharded-inference target of BASELINE.md rides this module.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .transformer import Encoder, TransformerConfig
+
+__all__ = ["llama2_7b", "llama_tiny", "LlamaLM", "greedy_generate"]
+
+
+def llama2_7b(**kw) -> TransformerConfig:
+    defaults = dict(vocab_size=32000, hidden=4096, n_layers=32, n_heads=32,
+                    n_kv_heads=32, mlp_dim=11008, max_len=4096, norm="rmsnorm",
+                    act="silu", gated_mlp=True, causal=True, use_rope=True)
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+def llama_tiny(**kw) -> TransformerConfig:
+    defaults = dict(vocab_size=256, hidden=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                    mlp_dim=128, max_len=128, norm="rmsnorm", act="silu",
+                    gated_mlp=True, causal=True, use_rope=True)
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+class LlamaLM(nn.Module):
+    """[B,T] ids -> [B,T,V] logits; decode=True enables the KV cache."""
+
+    cfg: TransformerConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, attention_mask=None):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     embedding_init=nn.with_logical_partitioning(
+                         nn.initializers.normal(0.02), ("vocab", "embed")),
+                     name="embed")(input_ids)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        x = Encoder(cfg, decode=self.decode, name="decoder")(x, mask, positions)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                          param_dtype=cfg.param_dtype,
+                          kernel_init=nn.with_logical_partitioning(
+                              nn.initializers.normal(0.02), ("embed", "vocab")),
+                          name="lm_head")(x)
+        return logits
+
+
+def greedy_generate(model: LlamaLM, params, prompt_ids: jax.Array, max_new_tokens: int,
+                    eos_id: int | None = None,
+                    prompt_mask: jax.Array | None = None) -> jax.Array:
+    """Prefill + lax.while_loop decode with KV cache — all static shapes.
+
+    prompt_ids: [B, P] padded to a fixed prompt bucket; ``prompt_mask`` [B, P]
+    marks real tokens (1) vs right-padding (0). Padded positions are masked out
+    of attention and the first generated token reads the logits of the LAST
+    REAL prompt token, not the pad tail. Generated tokens land at P, P+1, …
+    regardless of per-row prompt length (uniform layout for unpadding).
+    Returns [B, P + max_new_tokens].
+    """
+    B, P = prompt_ids.shape
+    cfg = model.cfg
+    if prompt_mask is None:
+        prompt_mask = jnp.ones((B, P), jnp.int32)
+    prompt_mask = prompt_mask.astype(jnp.int32)
+    lengths = jnp.sum(prompt_mask, axis=-1)  # [B]
+
+    vars0 = model.init(jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32),
+                       positions=jnp.zeros((B, 1), jnp.int32))
+    cache0 = vars0["cache"]
+
+    # kv-cache-wide validity: prompt pads stay masked for the whole decode
+    kv_mask = jnp.zeros((B, cfg.max_len), jnp.int32)
+    kv_mask = jax.lax.dynamic_update_slice(kv_mask, prompt_mask, (0, 0))
+    kv_mask = kv_mask.at[:, P:].set(1)  # generated positions are always real
+
+    prefill_pos = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
+    logits, state = model.apply({"params": params, "cache": cache0}, prompt_ids,
+                                positions=prefill_pos, mutable=["cache"],
+                                attention_mask=kv_mask)
+    last_real = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    next_tok = jnp.argmax(last_real, axis=-1).astype(jnp.int32)
+
+    total = P + max_new_tokens
+    out = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt_ids)
+    out = out.at[:, P].set(next_tok)
+
+    def cond(carry):
+        i, _, _, done = carry
+        return jnp.logical_and(i < max_new_tokens - 1, ~jnp.all(done))
+
+    def body(carry):
+        i, out, cache, done = carry
+        tok = jax.lax.dynamic_slice(out, (0, P + i), (B, 1))
+        # cache slot is P+i (static layout); RoPE position is the per-row true
+        # token index so padded prompts keep correct relative distances
+        pos = (lengths + i)[:, None].astype(jnp.int32)
+        logits, st = model.apply({"params": params, "cache": cache}, tok,
+                                 positions=pos, mutable=["cache"],
+                                 attention_mask=kv_mask)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        if eos_id is not None:
+            done = jnp.logical_or(done, nxt == eos_id)
+            nxt = jnp.where(done, eos_id, nxt)
+        out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, P + i + 1))
+        return i + 1, out, st["cache"], done
+
+    done0 = jnp.zeros((B,), bool)
+    if eos_id is not None:
+        done0 = next_tok == eos_id
+    _, out, _, _ = jax.lax.while_loop(cond, body, (jnp.zeros((), jnp.int32), out,
+                                                   state["cache"], done0))
+    return out

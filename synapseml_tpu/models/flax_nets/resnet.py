@@ -1,0 +1,101 @@
+"""ResNet (Flax) — the ImageFeaturizer/ONNX ResNet-50 analog, XLA-native.
+
+Reference analog: the ONNX ResNet-50 scored through ONNX Runtime in
+``onnx/ImageFeaturizer.scala`` and the torchvision resnet backbones of
+``dl/DeepVisionClassifier.py``. Convs stay NHWC (TPU-native layout).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ResNet", "resnet50", "resnet18", "resnet_tiny"]
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = lambda f, k, s, name: nn.Conv(  # noqa: E731
+            f, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2, use_bias=False,
+            dtype=self.dtype, name=name)
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, dtype=self.dtype, name=name)
+        residual = x
+        y = nn.relu(bn("bn1")(conv(self.features, 1, 1, "conv1")(x)))
+        y = nn.relu(bn("bn2")(conv(self.features, 3, self.strides, "conv2")(y)))
+        y = bn("bn3")(conv(self.features * 4, 1, 1, "conv3")(y))
+        if residual.shape != y.shape:
+            residual = bn("bn_proj")(conv(self.features * 4, 1, self.strides, "proj")(x))
+        return nn.relu(y + residual)
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = lambda f, k, s, name: nn.Conv(  # noqa: E731
+            f, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2, use_bias=False,
+            dtype=self.dtype, name=name)
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, dtype=self.dtype, name=name)
+        residual = x
+        y = nn.relu(bn("bn1")(conv(self.features, 3, self.strides, "conv1")(x)))
+        y = bn("bn2")(conv(self.features, 3, 1, "conv2")(y))
+        if residual.shape != y.shape:
+            residual = bn("bn_proj")(conv(self.features, 1, self.strides, "proj")(x))
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """[B,H,W,3] -> logits [B,num_classes]; call with method=feature for the
+    headless featurizer path (ImageFeaturizer analog)."""
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    block: str = "bottleneck"
+    num_classes: int = 1000
+    width: int = 64
+    stem_stride: int = 2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        block_cls = Bottleneck if self.block == "bottleneck" else BasicBlock
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(self.stem_stride, self.stem_stride),
+                    padding=[(3, 3), (3, 3)], use_bias=False, dtype=self.dtype, name="stem")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=self.dtype, name="stem_bn")(x))
+        if self.stem_stride > 1:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if j == 0 and i > 0 else 1
+                x = block_cls(self.width * (2 ** i), strides, self.dtype,
+                              name=f"stage{i}_block{j}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        if features_only:
+            return x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block="bottleneck", num_classes=num_classes, **kw)
+
+
+def resnet18(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block="basic", num_classes=num_classes, **kw)
+
+
+def resnet_tiny(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(stage_sizes=(1, 1), block="basic", num_classes=num_classes, width=8,
+                  stem_stride=1, **kw)

@@ -1,0 +1,232 @@
+"""Shared transformer building blocks, TPU-first.
+
+Design (not a torch port — reference models arrive via torchvision/HF in
+``dl/LitDeepVisionModel.py`` / ``dl/LitDeepTextModel.py``; here they are Flax
+modules built for GSPMD):
+  * every weight carries logical axis names (``nn.with_logical_partitioning``)
+    mapped to mesh axes by ``parallel.mesh.logical_axis_rules`` — tensor
+    parallelism is a rule change, not a code change;
+  * compute dtype bf16 by default (MXU native), params fp32;
+  * attention is einsum-based with optional GQA + rotary embeddings and a
+    decode-time KV cache; the sequence axis is ready for ring attention
+    (``ops.ring_attention``) when seq-parallel is on;
+  * optional ``nn.remat`` on blocks trades FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TransformerConfig", "Attention", "MlpBlock", "Block", "Encoder", "RMSNorm",
+           "apply_rope", "make_causal_mask"]
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    n_kv_heads: int | None = None  # None -> MHA; < n_heads -> GQA
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dropout: float = 0.0
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    causal: bool = False
+    use_rope: bool = False
+    rope_theta: float = 10000.0
+    norm: str = "layernorm"  # or "rmsnorm"
+    gated_mlp: bool = False  # SwiGLU when True
+    act: str = "gelu"
+    remat: bool = False
+    norm_eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+
+def _act_fn(name: str) -> Callable:
+    return {"gelu": nn.gelu, "relu": nn.relu, "silu": nn.silu}[name]
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+                           (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(self.dtype)
+
+
+def _norm(cfg: TransformerConfig):
+    if cfg.norm == "rmsnorm":
+        return RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype)
+    return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+                        bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)))
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float) -> tuple[np.ndarray, np.ndarray]:
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    t = np.arange(max_len, dtype=np.float32)
+    freqs = np.outer(t, inv)
+    return np.cos(freqs), np.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
+    """x: [B, T, H, D]; positions: [B, T] absolute positions (decode-time offset aware)."""
+    c = cos[positions][:, :, None, :]  # [B,T,1,D/2]
+    s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def make_causal_mask(q_len: int, kv_len: int, offset: int = 0) -> jax.Array:
+    q_pos = jnp.arange(q_len)[:, None] + offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return (kv_pos <= q_pos)[None, None, :, :]  # [1,1,Q,KV]
+
+
+class Attention(nn.Module):
+    """Multi-head / grouped-query attention with optional rotary embeddings and
+    a linen cache collection for autoregressive decode."""
+
+    cfg: TransformerConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None, positions=None):
+        cfg = self.cfg
+        B, T, _ = x.shape
+        H, KV, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda name, heads: nn.DenseGeneral(  # noqa: E731
+            features=(heads, D), axis=-1, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(nn.initializers.xavier_uniform(),
+                                                     ("embed", "heads", "kv")),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("heads", "kv")),
+            name=name)
+        q = dense("q", H)(x)
+        k = dense("k", KV)(x)
+        v = dense("v", KV)(x)
+
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        if cfg.use_rope:
+            cos_np, sin_np = rope_frequencies(D, cfg.max_len, cfg.rope_theta)
+            cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+
+        if self.decode:
+            # linen cache: append at cache_index; the update is skipped on the
+            # very first (init) call so a fresh cache starts at index 0
+            cache_ready = self.has_variable("cache", "cached_k")
+            ck = self.variable("cache", "cached_k", jnp.zeros, (B, cfg.max_len, KV, D), cfg.dtype)
+            cv = self.variable("cache", "cached_v", jnp.zeros, (B, cfg.max_len, KV, D), cfg.dtype)
+            idx = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+            start = idx.value
+            if cache_ready:
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
+                idx.value = start + T
+            k, v = ck.value, cv.value
+            kv_len = cfg.max_len
+            causal = make_causal_mask(T, kv_len, offset=start)
+            mask = causal if mask is None else jnp.logical_and(mask, causal)
+        elif cfg.causal:
+            causal = make_causal_mask(T, T)
+            mask = causal if mask is None else jnp.logical_and(mask, causal)
+
+        if KV != H:
+            k = jnp.repeat(k, H // KV, axis=2)
+            v = jnp.repeat(v, H // KV, axis=2)
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(D).astype(cfg.dtype)
+        if mask is not None:
+            scores = jnp.where(mask, scores, jnp.finfo(cfg.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        if cfg.dropout > 0:
+            probs = nn.Dropout(cfg.dropout, deterministic=not self.has_rng("dropout"))(probs)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            features=cfg.hidden, axis=(-2, -1), dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(nn.initializers.xavier_uniform(),
+                                                     ("heads", "kv", "embed")),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            name="o")(out)
+
+
+class MlpBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda name, feat, in_axis, out_axis: nn.Dense(  # noqa: E731
+            feat, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(nn.initializers.xavier_uniform(),
+                                                     (in_axis, out_axis)),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, (out_axis,)),
+            name=name)
+        act = _act_fn(cfg.act)
+        if cfg.gated_mlp:
+            g = dense("gate", cfg.mlp_dim, "embed", "mlp")(x)
+            u = dense("up", cfg.mlp_dim, "embed", "mlp")(x)
+            h = act(g) * u
+        else:
+            h = act(dense("up", cfg.mlp_dim, "embed", "mlp")(x))
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout, deterministic=not self.has_rng("dropout"))(h)
+        return dense("down", cfg.hidden, "mlp", "embed")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None, positions=None):
+        cfg = self.cfg
+        h = _norm(cfg)(x)
+        h = Attention(cfg, decode=self.decode, name="attn")(h, mask, positions)
+        x = x + h
+        h = _norm(cfg)(x)
+        h = MlpBlock(cfg, name="mlp")(h)
+        x = x + h
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class Encoder(nn.Module):
+    """Stack of blocks (used by BERT/ViT encoders and, with causal=True +
+    decode, by the Llama decoder)."""
+
+    cfg: TransformerConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None, positions=None):
+        cfg = self.cfg
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block_cls(cfg, decode=self.decode, name=f"layer_{i}")(x, mask, positions)
+        return _norm(cfg)(x)

@@ -1,0 +1,62 @@
+"""Vision Transformer (Flax) — backbone for DeepVisionClassifier.
+
+Reference analog: torchvision backbones consumed by
+``dl/LitDeepVisionModel.py``; rebuilt as a native Flax ViT so vision transfer
+learning runs on the MXU with GSPMD sharding.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import Encoder, TransformerConfig
+
+__all__ = ["vit_b16", "vit_tiny", "ViTClassifier"]
+
+
+def vit_b16(**kw) -> TransformerConfig:
+    defaults = dict(vocab_size=1, hidden=768, n_layers=12, n_heads=12, mlp_dim=3072,
+                    max_len=1 + (224 // 16) ** 2, norm="layernorm", act="gelu")
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+def vit_tiny(**kw) -> TransformerConfig:
+    defaults = dict(vocab_size=1, hidden=64, n_layers=2, n_heads=2, mlp_dim=128,
+                    max_len=1 + (32 // 8) ** 2)
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+class ViTClassifier(nn.Module):
+    """[B,H,W,C] images -> [B,num_classes] logits."""
+
+    cfg: TransformerConfig
+    num_classes: int = 1000
+    patch: int = 16
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.cfg
+        x = nn.Conv(cfg.hidden, kernel_size=(self.patch, self.patch),
+                    strides=(self.patch, self.patch), dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype,
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.xavier_uniform(), (None, None, None, "embed")),
+                    name="patch_embed")(images.astype(cfg.dtype))
+        B, h, w, _ = x.shape
+        x = x.reshape(B, h * w, cfg.hidden)
+        cls = self.param("cls", nn.with_logical_partitioning(
+            nn.initializers.zeros, (None, None, "embed")), (1, 1, cfg.hidden), cfg.param_dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, cfg.hidden)).astype(cfg.dtype), x], axis=1)
+        pos = self.param("pos_embed", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), (None, "seq", "embed")),
+            (1, cfg.max_len, cfg.hidden), cfg.param_dtype)
+        x = x + pos[:, : x.shape[1]].astype(cfg.dtype)
+        x = Encoder(cfg, name="encoder")(x)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                          kernel_init=nn.with_logical_partitioning(
+                              nn.initializers.xavier_uniform(), ("embed", None)),
+                          name="head")(x[:, 0])
+        return logits

@@ -1,0 +1,160 @@
+"""DeepVisionClassifier / DeepVisionModel — vision transfer learning on the mesh.
+
+Reference: ``dl/DeepVisionClassifier.py:31-268`` (horovod TorchEstimator with
+torchvision backbones) + ``dl/DeepVisionModel.py`` predict wrapper. Rebuilt:
+Flax ViT/ResNet backbones trained by the GSPMD Trainer; images arrive as an
+image column ([H,W,C] arrays) produced by image.ImageTransformer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..core import DataFrame, Estimator, Model
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..parallel.batching import batches
+from ..parallel.mesh import MeshConfig, create_mesh
+from .flax_nets.resnet import resnet18, resnet50, resnet_tiny
+from .flax_nets.vit import ViTClassifier, vit_b16, vit_tiny
+from .trainer import Trainer, TrainerConfig
+
+__all__ = ["DeepVisionClassifier", "DeepVisionModel"]
+
+
+def _build_module(backbone: str, num_classes: int):
+    if backbone == "vit_b16":
+        return ViTClassifier(vit_b16(), num_classes=num_classes, patch=16), False
+    if backbone == "vit_tiny":
+        return ViTClassifier(vit_tiny(), num_classes=num_classes, patch=8), False
+    if backbone == "resnet50":
+        return resnet50(num_classes=num_classes), True
+    if backbone == "resnet18":
+        return resnet18(num_classes=num_classes), True
+    if backbone == "resnet_tiny":
+        return resnet_tiny(num_classes=num_classes), True
+    raise ValueError(f"unknown backbone {backbone!r}; "
+                     "have vit_b16|vit_tiny|resnet50|resnet18|resnet_tiny")
+
+
+class _VisionParams:
+    image_col = Param("image_col", "input image column ([H,W,C] float arrays)",
+                      default="image")
+    label_col = Param("label_col", "label column", default="label")
+    prediction_col = Param("prediction_col", "argmax output column", default="prediction")
+    scores_col = Param("scores_col", "softmax scores column", default="scores")
+    backbone = Param("backbone", "vit_b16|vit_tiny|resnet50|resnet18|resnet_tiny",
+                     default="resnet_tiny")
+    num_classes = Param("num_classes", "number of classes", default=2,
+                        converter=TypeConverters.to_int)
+    batch_size = Param("batch_size", "global batch size", default=32,
+                       converter=TypeConverters.to_int)
+
+
+class DeepVisionClassifier(Estimator, _VisionParams):
+    feature_name = "deep_learning"
+
+    learning_rate = Param("learning_rate", "peak lr", default=1e-3,
+                          converter=TypeConverters.to_float)
+    num_train_epochs = Param("num_train_epochs", "epochs", default=2,
+                             converter=TypeConverters.to_int)
+    max_steps = Param("max_steps", "hard step cap (-1 = epochs)", default=-1,
+                      converter=TypeConverters.to_int)
+    seed = Param("seed", "init seed", default=0, converter=TypeConverters.to_int)
+    mesh_config = ComplexParam("mesh_config", "MeshConfig override", default=None)
+
+    def _fit(self, df: DataFrame) -> "DeepVisionModel":
+        module, has_bn = _build_module(self.get("backbone"), self.get("num_classes"))
+        mesh = create_mesh(self.get("mesh_config") or MeshConfig())
+
+        images = np.stack(list(df.collect_column(self.get("image_col")))).astype(np.float32)
+        labels = df.collect_column(self.get("label_col")).astype(np.int32)
+        n = len(labels)
+        bs = min(self.get("batch_size"), max(n, 1))
+        steps_per_epoch = max(n // bs, 1)
+        max_steps = self.get("max_steps")
+        total = max_steps if max_steps > 0 else steps_per_epoch * self.get("num_train_epochs")
+
+        trainer = Trainer(module, mesh,
+                          TrainerConfig(learning_rate=self.get("learning_rate"),
+                                        total_steps=total, lr_schedule="cosine",
+                                        warmup_steps=max(total // 10, 1)),
+                          has_batch_stats=has_bn)
+        rng = np.random.default_rng(self.get("seed"))
+        data = {"x": images, "labels": labels}
+
+        def batch_iter():
+            while True:
+                perm = rng.permutation(n)
+                shuf = {k: v[perm] for k, v in data.items()}
+                for b in batches(shuf, bs, drop_remainder=n >= bs):
+                    yield {**b.data, "_valid": b.mask.astype(np.float32)}
+
+        example = next(batch_iter())
+        state = trainer.init_state(example, jax.random.PRNGKey(self.get("seed")))
+        state = trainer.fit(state, batch_iter(), max_steps=total)
+
+        return DeepVisionModel(
+            params=jax.tree.map(np.asarray, state.params),
+            batch_stats=(jax.tree.map(np.asarray, state.batch_stats)
+                         if state.batch_stats is not None else None),
+            backbone=self.get("backbone"), num_classes=self.get("num_classes"),
+            image_col=self.get("image_col"), prediction_col=self.get("prediction_col"),
+            scores_col=self.get("scores_col"), batch_size=self.get("batch_size"),
+            train_metrics=trainer.metrics,
+        )
+
+
+class DeepVisionModel(Model, _VisionParams):
+    feature_name = "deep_learning"
+
+    params = ComplexParam("params", "trained parameter pytree")
+    batch_stats = ComplexParam("batch_stats", "BN running stats", default=None)
+    train_metrics = ComplexParam("train_metrics", "loss/throughput trace", default=None)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._apply_fn = None
+
+    def _post_load(self):
+        self._apply_fn = None
+
+    def _get_apply(self):
+        if self._apply_fn is None:
+            module, has_bn = _build_module(self.get("backbone"), self.get("num_classes"))
+
+            @jax.jit
+            def apply(variables, x):
+                logits = module.apply(variables, x)
+                return jax.nn.softmax(logits, axis=-1)
+
+            self._module_has_bn = has_bn
+            self._apply_fn = apply
+        return self._apply_fn
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("image_col"))
+        apply = self._get_apply()
+        variables = {"params": self.get("params")}
+        if self.get("batch_stats") is not None:
+            variables["batch_stats"] = self.get("batch_stats")
+        bs = self.get("batch_size")
+
+        def per_part(part):
+            imgs = part[self.get("image_col")]
+            if len(imgs) == 0:
+                return dict(part)
+            x = np.stack(list(imgs)).astype(np.float32)
+            chunks = []
+            for b in batches({"x": x}, bs):
+                p = apply(variables, b.data["x"])
+                chunks.append(np.asarray(p)[: b.n_valid])
+            probs = np.concatenate(chunks, axis=0)
+            out = dict(part)
+            out[self.get("scores_col")] = probs
+            out[self.get("prediction_col")] = np.argmax(probs, axis=-1).astype(np.int32)
+            return out
+
+        return df.map_partitions(per_part)

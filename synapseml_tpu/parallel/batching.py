@@ -28,7 +28,7 @@ def bucket_size(n: int, buckets: Sequence[int] | None = None, min_bucket: int = 
         for b in sorted(buckets):
             if b >= n:
                 return b
-        return sorted(buckets)[-1]
+        raise ValueError(f"batch of {n} rows exceeds largest bucket {max(buckets)}")
     b = min_bucket
     while b < n:
         b *= 2

@@ -94,6 +94,12 @@ class MeshContext:
         sh = self.batch_sharding()
         return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
 
+    def shard_stacked_batch(self, batch: Any) -> Any:
+        """Place [K, batch, ...] step-stacked arrays: K replicated (scan axis),
+        batch dim sharded over the data axes."""
+        sh = self.sharding(None, ("data", "fsdp"))
+        return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
     def __enter__(self):
         self._ctx = self.mesh.__enter__()
         return self

@@ -1,0 +1,89 @@
+import numpy as np
+import pytest
+
+import jax
+
+from synapseml_tpu.models.flax_nets.bert import BertClassifier, bert_tiny
+from synapseml_tpu.models.flax_nets.llama import LlamaLM, greedy_generate, llama_tiny
+from synapseml_tpu.models.trainer import Trainer, TrainerConfig
+from synapseml_tpu.parallel import MeshConfig, create_mesh, restore_checkpoint, save_checkpoint
+from synapseml_tpu.parallel.batching import bucket_size
+
+
+def _batch(B=8, T=16, vocab=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, (B, T)).astype(np.int32),
+            "attention_mask": np.ones((B, T), np.int32),
+            "labels": rng.integers(0, 2, (B,)).astype(np.int32)}
+
+
+def test_scan_matches_stepwise(mesh_dp8):
+    cfg = bert_tiny()
+    model = BertClassifier(cfg, num_classes=2)
+    batch = _batch(vocab=cfg.vocab_size)
+
+    tr1 = Trainer(model, mesh_dp8, TrainerConfig(total_steps=10))
+    s1 = tr1.init_state(batch, jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(4):
+        s1, m = tr1.train_step(s1, batch)
+        losses.append(float(m["loss"]))
+
+    tr2 = Trainer(model, mesh_dp8, TrainerConfig(total_steps=10))
+    s2 = tr2.init_state(batch, jax.random.PRNGKey(0))
+    stacked = jax.tree.map(lambda x: np.broadcast_to(x, (4,) + x.shape).copy(), batch)
+    s2, metrics = tr2.train_steps_scan(s2, stacked)
+    np.testing.assert_allclose(np.asarray(metrics["loss"]), losses, rtol=1e-4, atol=1e-5)
+    assert int(s2.step) == 4
+
+
+def test_resume_after_checkpoint(tmp_path, mesh_dp8):
+    cfg = bert_tiny()
+    model = BertClassifier(cfg, num_classes=2)
+    batch = _batch(vocab=cfg.vocab_size)
+    tr = Trainer(model, mesh_dp8, TrainerConfig(total_steps=10))
+    state = tr.init_state(batch)
+    state, _ = tr.train_step(state, batch)
+    save_checkpoint(str(tmp_path), {"params": state.params}, step=1)
+
+    # fresh trainer, restore params, resume WITHOUT init_state
+    tr2 = Trainer(model, mesh_dp8, TrainerConfig(total_steps=10))
+    restored = restore_checkpoint(str(tmp_path))
+    s2 = tr2.resume_state(restored["params"], step=1)
+    s2, m = tr2.train_step(s2, batch)
+    assert np.isfinite(float(m["loss"]))
+    assert int(s2.step) == 2
+
+
+def test_train_step_without_init_raises(mesh_dp8):
+    tr = Trainer(BertClassifier(bert_tiny(), num_classes=2), mesh_dp8,
+                 TrainerConfig())
+    with pytest.raises(RuntimeError, match="optimizer not built"):
+        tr.train_step(object(), _batch())  # state never inspected before the guard
+
+
+def test_bucket_overflow_raises():
+    with pytest.raises(ValueError, match="exceeds largest bucket"):
+        bucket_size(20, buckets=[8, 16])
+
+
+def test_generate_with_padded_prompt():
+    """Rows padded to the prompt bucket must generate as if unpadded."""
+    cfg = llama_tiny()
+    m = LlamaLM(cfg)
+    ids_short = np.array([[5, 7]], np.int32)                     # true length 2
+    variables = m.init(jax.random.PRNGKey(0), ids_short)
+    params = variables["params"]
+    dm = LlamaLM(cfg, decode=True)
+
+    # unpadded reference: bucket exactly fits the prompt
+    out_ref = np.asarray(greedy_generate(dm, params, ids_short, max_new_tokens=5))
+    # padded to P=8 with a mask
+    P = 8
+    ids_pad = np.zeros((1, P), np.int32)
+    ids_pad[0, :2] = ids_short[0]
+    mask = np.zeros((1, P), np.int32)
+    mask[0, :2] = 1
+    out_pad = np.asarray(greedy_generate(dm, params, ids_pad, max_new_tokens=5,
+                                         prompt_mask=mask))
+    np.testing.assert_array_equal(out_ref[0, 2:], out_pad[0, P:])
