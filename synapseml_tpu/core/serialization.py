@@ -77,6 +77,9 @@ def load_pytree(path: str) -> Any:
 
 
 def _is_array_pytree(v: Any) -> bool:
+    if isinstance(v, (bytes, bytearray, str)):
+        return False  # np.isscalar says True, but npz round-trips these as
+        # 0-d S/U arrays that break len()/indexing consumers — pickle instead
     if isinstance(v, np.ndarray) or np.isscalar(v):
         return True
     if hasattr(v, "__array__") and hasattr(v, "dtype"):  # jax arrays

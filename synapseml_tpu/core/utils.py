@@ -7,8 +7,8 @@ discovery), ``core/utils/FaultToleranceUtils`` (retryWithTimeout),
 
 from __future__ import annotations
 
-import concurrent.futures
 import contextlib
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -44,18 +44,25 @@ def retry_with_timeout(fn: Callable[[], Any], timeout_s: float = 60.0,
     """
     last: BaseException | None = None
     for attempt in range(retries):
-        # no `with`: shutdown(wait=True) would join a hung fn and defeat the
-        # timeout; abandon the worker thread instead (daemon threads don't
-        # block process exit)
-        pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-        fut = pool.submit(fn)
-        try:
-            return fut.result(timeout=timeout_s)
-        except BaseException as e:  # noqa: BLE001 - rethrown after retries
-            last = e
-            fut.cancel()
-        finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+        # plain daemon thread, not a ThreadPoolExecutor: executor workers are
+        # non-daemon and concurrent.futures' atexit hook joins them, so an
+        # abandoned hung fn would block process exit
+        result: list[Any] = []
+        error: list[BaseException] = []
+
+        def run():
+            try:
+                result.append(fn())
+            except BaseException as e:  # noqa: BLE001 - reported to caller
+                error.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout=timeout_s)
+        if result:
+            return result[0]
+        last = error[0] if error else TimeoutError(
+            f"call did not finish within {timeout_s}s (attempt {attempt + 1}/{retries})")
         if attempt < retries - 1:
             time.sleep(backoff_s * (2 ** attempt))
     raise last  # type: ignore[misc]

@@ -72,6 +72,11 @@ def greedy_generate(model: LlamaLM, params, prompt_ids: jax.Array, max_new_token
     """
     B, P = prompt_ids.shape
     cfg = model.cfg
+    if P + max_new_tokens > cfg.max_len:
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds the KV "
+            f"cache capacity max_len={cfg.max_len}; dynamic_update_slice would "
+            f"silently clamp and corrupt the cache")
     if prompt_mask is None:
         prompt_mask = jnp.ones((B, P), jnp.int32)
     prompt_mask = prompt_mask.astype(jnp.int32)

@@ -21,11 +21,21 @@ from ..parallel.batching import batches
 from ..parallel.mesh import MeshConfig, MeshContext, create_mesh
 from .flax_nets.bert import BertClassifier, bert_base, bert_tiny
 from .tokenizer import resolve_tokenizer
-from .trainer import Trainer, TrainerConfig, TrainState
+from .trainer import Trainer, TrainerConfig, TrainState, fit_arrays, plan_fit
 
 __all__ = ["DeepTextClassifier", "DeepTextModel"]
 
 _ARCHS = {"bert-base": bert_base, "bert-tiny": bert_tiny}
+
+
+def _resolve_arch(name: str):
+    """Known preset or fail fast — a typo must not silently train a
+    randomly-initialized bert-base."""
+    try:
+        return _ARCHS[name]
+    except KeyError:
+        raise ValueError(f"unknown checkpoint {name!r}; available presets: "
+                         f"{sorted(_ARCHS)}") from None
 
 
 class _TextParams:
@@ -66,9 +76,7 @@ class DeepTextClassifier(Estimator, _TextParams):
                          converter=TypeConverters.to_float)
 
     def _make_config(self, vocab_size: int):
-        arch = self.get("checkpoint")
-        factory = _ARCHS.get(arch, bert_base)
-        return factory(vocab_size=vocab_size)
+        return _resolve_arch(self.get("checkpoint"))(vocab_size=vocab_size)
 
     def _freeze_predicate(self, n_layers_total: int):
         n = self.get("unfreeze_layers")
@@ -95,12 +103,8 @@ class DeepTextClassifier(Estimator, _TextParams):
         encoded = tok(list(texts), max_len=self.get("max_token_len"))
         data = {**encoded, "labels": labels}
 
-        n = len(labels)
-        bs = min(self.get("batch_size"), max(n, 1))
-        steps_per_epoch = max(n // bs, 1)
-        max_steps = self.get("max_steps")
-        total = max_steps if max_steps > 0 else steps_per_epoch * self.get("num_train_epochs")
-
+        bs, total = plan_fit(len(labels), self.get("batch_size"),
+                             self.get("num_train_epochs"), self.get("max_steps"))
         tcfg = TrainerConfig(
             learning_rate=self.get("learning_rate"),
             weight_decay=self.get("weight_decay"),
@@ -109,19 +113,8 @@ class DeepTextClassifier(Estimator, _TextParams):
             freeze_predicate=self._freeze_predicate(cfg.n_layers),
         )
         trainer = Trainer(module, mesh, tcfg)
-
-        rng = np.random.default_rng(self.get("seed"))
-
-        def batch_iter():
-            while True:
-                perm = rng.permutation(n)
-                shuf = {k: v[perm] for k, v in data.items()}
-                for b in batches(shuf, bs, drop_remainder=n >= bs):
-                    yield {**b.data, "_valid": b.mask.astype(np.float32)}
-
-        example = next(batch_iter())
-        state = trainer.init_state(example, jax.random.PRNGKey(self.get("seed")))
-        state = trainer.fit(state, batch_iter(), max_steps=total)
+        state = fit_arrays(trainer, data, batch_size=bs, total_steps=total,
+                           seed=self.get("seed"))
 
         host_params = jax.tree.map(np.asarray, state.params)
         return DeepTextModel(
@@ -155,8 +148,7 @@ class DeepTextModel(Model, _TextParams):
     def _get_apply(self):
         if self._apply_fn is None:
             tok = resolve_tokenizer(self.get("tokenizer_config"))
-            cfg_factory = _ARCHS.get(self.get("checkpoint"), bert_base)
-            cfg = cfg_factory(vocab_size=tok.vocab_size)
+            cfg = _resolve_arch(self.get("checkpoint"))(vocab_size=tok.vocab_size)
             module = BertClassifier(cfg, num_classes=self.get("num_classes"))
 
             @jax.jit
@@ -177,7 +169,11 @@ class DeepTextModel(Model, _TextParams):
         def per_part(part):
             texts = list(part[self.get("text_col")])
             if not texts:
-                return {**part}
+                # keep the output schema rectangular across partitions
+                out = dict(part)
+                out[self.get("scores_col")] = np.zeros((0, self.get("num_classes")), np.float32)
+                out[self.get("prediction_col")] = np.zeros(0, np.int32)
+                return out
             enc = self._tok(texts, max_len=self.get("max_token_len"))
             probs_chunks = []
             for b in batches(enc, bs):

@@ -258,3 +258,39 @@ class Trainer:
     @property
     def metrics(self) -> list[dict]:
         return self._metrics
+
+
+def plan_fit(n: int, batch_size: int, epochs: int, max_steps: int) -> tuple[int, int]:
+    """(effective batch size, total optimizer steps) for an n-row fit.
+    Raises on empty input — shared by the DeepText/DeepVision estimators."""
+    if n == 0:
+        raise ValueError("cannot fit on an empty DataFrame (0 rows)")
+    bs = min(batch_size, n)
+    steps_per_epoch = max(n // bs, 1)
+    total = max_steps if max_steps > 0 else steps_per_epoch * epochs
+    return bs, total
+
+
+def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: int,
+               seed: int) -> "TrainState":
+    """Shared estimator fit loop: shuffling epochs over host arrays with
+    mesh-aligned padded batches (one place for batch alignment, so any
+    (batch_size, n, #devices) combination shards — batches are padded to a
+    multiple of the mesh data-parallel size and carry a ``_valid`` mask)."""
+    from ..parallel.batching import batches
+
+    n = next(iter(data.values())).shape[0]
+    dp = trainer.mesh.data_parallel_size()
+    rng = np.random.default_rng(seed)
+
+    def batch_iter():
+        while True:
+            perm = rng.permutation(n)
+            shuf = {k: v[perm] for k, v in data.items()}
+            for b in batches(shuf, batch_size, multiple_of=dp,
+                             drop_remainder=n >= batch_size):
+                yield {**b.data, "_valid": b.mask.astype(np.float32)}
+
+    it = batch_iter()
+    state = trainer.init_state(next(it), jax.random.PRNGKey(seed))
+    return trainer.fit(state, it, max_steps=total_steps)

@@ -19,7 +19,7 @@ from ..parallel.batching import batches
 from ..parallel.mesh import MeshConfig, create_mesh
 from .flax_nets.resnet import resnet18, resnet50, resnet_tiny
 from .flax_nets.vit import ViTClassifier, vit_b16, vit_tiny
-from .trainer import Trainer, TrainerConfig
+from .trainer import Trainer, TrainerConfig, fit_arrays, plan_fit
 
 __all__ = ["DeepVisionClassifier", "DeepVisionModel"]
 
@@ -69,32 +69,18 @@ class DeepVisionClassifier(Estimator, _VisionParams):
         module, has_bn = _build_module(self.get("backbone"), self.get("num_classes"))
         mesh = create_mesh(self.get("mesh_config") or MeshConfig())
 
-        images = np.stack(list(df.collect_column(self.get("image_col")))).astype(np.float32)
         labels = df.collect_column(self.get("label_col")).astype(np.int32)
-        n = len(labels)
-        bs = min(self.get("batch_size"), max(n, 1))
-        steps_per_epoch = max(n // bs, 1)
-        max_steps = self.get("max_steps")
-        total = max_steps if max_steps > 0 else steps_per_epoch * self.get("num_train_epochs")
+        bs, total = plan_fit(len(labels), self.get("batch_size"),
+                             self.get("num_train_epochs"), self.get("max_steps"))
+        images = np.stack(list(df.collect_column(self.get("image_col")))).astype(np.float32)
 
         trainer = Trainer(module, mesh,
                           TrainerConfig(learning_rate=self.get("learning_rate"),
                                         total_steps=total, lr_schedule="cosine",
                                         warmup_steps=max(total // 10, 1)),
                           has_batch_stats=has_bn)
-        rng = np.random.default_rng(self.get("seed"))
-        data = {"x": images, "labels": labels}
-
-        def batch_iter():
-            while True:
-                perm = rng.permutation(n)
-                shuf = {k: v[perm] for k, v in data.items()}
-                for b in batches(shuf, bs, drop_remainder=n >= bs):
-                    yield {**b.data, "_valid": b.mask.astype(np.float32)}
-
-        example = next(batch_iter())
-        state = trainer.init_state(example, jax.random.PRNGKey(self.get("seed")))
-        state = trainer.fit(state, batch_iter(), max_steps=total)
+        state = fit_arrays(trainer, {"x": images, "labels": labels},
+                           batch_size=bs, total_steps=total, seed=self.get("seed"))
 
         return DeepVisionModel(
             params=jax.tree.map(np.asarray, state.params),
@@ -145,7 +131,11 @@ class DeepVisionModel(Model, _VisionParams):
         def per_part(part):
             imgs = part[self.get("image_col")]
             if len(imgs) == 0:
-                return dict(part)
+                # keep the output schema rectangular across partitions
+                out = dict(part)
+                out[self.get("scores_col")] = np.zeros((0, self.get("num_classes")), np.float32)
+                out[self.get("prediction_col")] = np.zeros(0, np.int32)
+                return out
             x = np.stack(list(imgs)).astype(np.float32)
             chunks = []
             for b in batches({"x": x}, bs):
