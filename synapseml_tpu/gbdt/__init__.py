@@ -1,0 +1,30 @@
+"""TPU-native gradient-boosted decision trees (the LightGBM-equivalent engine).
+
+Replaces the reference's LightGBM C++/SWIG stack (``lightgbm/`` module,
+SURVEY.md §2.1): the native histogram builder + socket-ring allreduce
+(``NetworkManager.scala`` → ``LGBM_NetworkInit``) become a batched XLA
+histogram (``segment_sum`` per depth level) with an ICI ``psum`` over the
+``data`` mesh axis; tree growth is vectorized split evaluation on device.
+"""
+
+from .binning import BinMapper
+from .booster import TpuBooster
+from .estimators import (
+    LightGBMClassificationModel,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRankerModel,
+    LightGBMRegressionModel,
+    LightGBMRegressor,
+)
+
+__all__ = [
+    "BinMapper",
+    "TpuBooster",
+    "LightGBMClassifier",
+    "LightGBMClassificationModel",
+    "LightGBMRegressor",
+    "LightGBMRegressionModel",
+    "LightGBMRanker",
+    "LightGBMRankerModel",
+]

@@ -1,0 +1,325 @@
+"""LightGBMClassifier / LightGBMRegressor / LightGBMRanker estimators.
+
+Reference: ``lightgbm/.../LightGBMClassifier.scala:212`` area,
+``LightGBMRegressor.scala``, ``LightGBMRanker.scala`` and the shared param
+surface of ``params/LightGBMParams.scala`` (~100 params flattened into a
+native param string). Here the estimator params map 1:1 onto
+:func:`synapseml_tpu.gbdt.booster.train_booster` keywords; the native engine
+is the XLA histogram forest of :mod:`synapseml_tpu.gbdt.trees`.
+
+Training data flows the streaming-mode way (``StreamingPartitionTask.scala``):
+partitions are concatenated host-side into one binned matrix that is placed
+(optionally sharded over the mesh ``data`` axis) into HBM once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import DataFrame, Estimator, Model
+from ..core.params import ComplexParam, Param, TypeConverters
+
+__all__ = [
+    "LightGBMClassifier", "LightGBMClassificationModel",
+    "LightGBMRegressor", "LightGBMRegressionModel",
+    "LightGBMRanker", "LightGBMRankerModel",
+]
+
+
+class _LightGBMParams:
+    """Shared train params (reference ``params/LightGBMParams.scala``)."""
+
+    features_col = Param("features_col", "features column: one (N,F) array column, "
+                         "or set feature_cols for separate numeric columns",
+                         default="features")
+    feature_cols = Param("feature_cols", "explicit list of numeric feature columns "
+                         "(alternative to an assembled features_col)", default=None)
+    label_col = Param("label_col", "label column", default="label")
+    weight_col = Param("weight_col", "sample weight column", default=None)
+    prediction_col = Param("prediction_col", "prediction output column", default="prediction")
+    validation_indicator_col = Param(
+        "validation_indicator_col", "boolean column marking validation rows "
+        "(reference validationIndicatorCol)", default=None)
+
+    num_iterations = Param("num_iterations", "boosting rounds", default=100,
+                           converter=TypeConverters.to_int)
+    learning_rate = Param("learning_rate", "shrinkage", default=0.1,
+                          converter=TypeConverters.to_float)
+    num_leaves = Param("num_leaves", "max leaves per tree", default=31,
+                       converter=TypeConverters.to_int)
+    max_depth = Param("max_depth", "max depth (-1 = derive from num_leaves)",
+                      default=-1, converter=TypeConverters.to_int)
+    max_bin = Param("max_bin", "histogram bins per feature", default=255,
+                    converter=TypeConverters.to_int)
+    lambda_l1 = Param("lambda_l1", "L1 regularization", default=0.0,
+                      converter=TypeConverters.to_float)
+    lambda_l2 = Param("lambda_l2", "L2 regularization", default=0.0,
+                      converter=TypeConverters.to_float)
+    min_data_in_leaf = Param("min_data_in_leaf", "min rows per leaf", default=20,
+                             converter=TypeConverters.to_int)
+    min_sum_hessian_in_leaf = Param("min_sum_hessian_in_leaf", "min hessian per leaf",
+                                    default=1e-3, converter=TypeConverters.to_float)
+    min_gain_to_split = Param("min_gain_to_split", "min split gain", default=0.0,
+                              converter=TypeConverters.to_float)
+    feature_fraction = Param("feature_fraction", "per-tree feature subsample",
+                             default=1.0, converter=TypeConverters.to_float)
+    bagging_fraction = Param("bagging_fraction", "row subsample fraction", default=1.0,
+                             converter=TypeConverters.to_float)
+    bagging_freq = Param("bagging_freq", "bagging every k iterations (0=off)",
+                         default=0, converter=TypeConverters.to_int)
+    early_stopping_round = Param("early_stopping_round", "stop after k rounds without "
+                                 "validation improvement (0=off)", default=0,
+                                 converter=TypeConverters.to_int)
+    seed = Param("seed", "random seed", default=0, converter=TypeConverters.to_int)
+    verbosity = Param("verbosity", "print eval metrics when > 0", default=-1,
+                      converter=TypeConverters.to_int)
+    mesh_config = ComplexParam("mesh_config", "MeshConfig to shard rows over the "
+                               "mesh data axis (multi-host training)", default=None)
+
+    # ---- shared helpers ----
+    def _features(self, df: DataFrame) -> np.ndarray:
+        cols = self.get("feature_cols")
+        if cols:
+            self.require_columns(df, *cols)
+            return np.stack([np.asarray(df.collect_column(c), np.float64) for c in cols], axis=1)
+        fc = self.get("features_col")
+        self.require_columns(df, fc)
+        col = df.collect_column(fc)
+        if col.dtype == object:
+            col = np.stack([np.asarray(v, np.float64) for v in col])
+        return np.asarray(col, np.float64)
+
+    def _split_validation(self, df: DataFrame):
+        vic = self.get("validation_indicator_col")
+        if not vic:
+            return df, None
+        self.require_columns(df, vic)
+        mask = np.asarray(df.collect_column(vic), bool)
+        whole = df.collect()
+        train = DataFrame([{k: v[~mask] for k, v in whole.items()}])
+        valid = DataFrame([{k: v[mask] for k, v in whole.items()}])
+        return train, valid
+
+    def _mesh(self):
+        cfg = self.get("mesh_config")
+        if cfg is None:
+            return None
+        from ..parallel.mesh import create_mesh
+
+        return create_mesh(cfg).mesh
+
+    def _train_kwargs(self) -> dict:
+        return dict(
+            num_iterations=self.get("num_iterations"),
+            learning_rate=self.get("learning_rate"),
+            num_leaves=self.get("num_leaves"),
+            max_depth=self.get("max_depth"),
+            max_bin=self.get("max_bin"),
+            lambda_l1=self.get("lambda_l1"),
+            lambda_l2=self.get("lambda_l2"),
+            min_data_in_leaf=self.get("min_data_in_leaf"),
+            min_sum_hessian=self.get("min_sum_hessian_in_leaf"),
+            min_gain_to_split=self.get("min_gain_to_split"),
+            feature_fraction=self.get("feature_fraction"),
+            bagging_fraction=self.get("bagging_fraction"),
+            bagging_freq=self.get("bagging_freq"),
+            early_stopping_round=self.get("early_stopping_round"),
+            seed=self.get("seed"),
+            verbose=self.get("verbosity") > 0,
+            mesh=self._mesh(),
+        )
+
+
+class _LightGBMModelBase(Model, _LightGBMParams):
+    booster = ComplexParam("booster", "trained TpuBooster")
+
+    def get_booster(self):
+        return self.get("booster")
+
+    def get_feature_importances(self, importance_type: str = "split") -> np.ndarray:
+        return self.get_booster().feature_importance(importance_type)
+
+    def save_native_model(self, path: str) -> None:
+        """Reference ``saveNativeModel`` — writes the standalone booster dir
+        (npz + json + text dump)."""
+        b = self.get_booster()
+        b.save(path)
+        import os
+
+        with open(os.path.join(path, "model.txt"), "w") as f:
+            f.write(b.dump_text())
+
+
+# ---------------- classification ----------------
+
+class LightGBMClassifier(Estimator, _LightGBMParams):
+    feature_name = "lightgbm"
+
+    objective = Param("objective", "binary | multiclass (auto-detected from labels "
+                      "when left at default)", default="auto")
+    probability_col = Param("probability_col", "class probabilities output column",
+                            default="probability")
+    raw_prediction_col = Param("raw_prediction_col", "raw margin output column",
+                               default="rawPrediction")
+
+    def _fit(self, df: DataFrame) -> "LightGBMClassificationModel":
+        train, valid = self._split_validation(df)
+        x = self._features(train)
+        self.require_columns(train, self.get("label_col"))
+        y_raw = np.asarray(train.collect_column(self.get("label_col")))
+        classes, y = np.unique(y_raw, return_inverse=True)
+        num_class = len(classes)
+        objective = self.get("objective")
+        if objective == "auto":
+            objective = "binary" if num_class <= 2 else "multiclass"
+        w = (np.asarray(train.collect_column(self.get("weight_col")), np.float32)
+             if self.get("weight_col") else None)
+        vx = vy = None
+        if valid is not None and valid.count() > 0:
+            vx = self._features(valid)
+            vy = np.searchsorted(classes, np.asarray(valid.collect_column(self.get("label_col"))))
+
+        from .booster import train_booster
+
+        booster = train_booster(
+            x, y.astype(np.float32), objective=objective, num_class=num_class,
+            weights=w, valid_features=vx, valid_labels=vy, **self._train_kwargs())
+        model = LightGBMClassificationModel(booster=booster, classes=classes)
+        model.set(**{k: v for k, v in self._param_values.items()
+                     if model.has_param(k)})
+        return model
+
+
+class LightGBMClassificationModel(_LightGBMModelBase):
+    feature_name = "lightgbm"
+
+    classes = ComplexParam("classes", "original class labels (argmax index -> label)")
+    probability_col = Param("probability_col", "class probabilities output column",
+                            default="probability")
+    raw_prediction_col = Param("raw_prediction_col", "raw margin output column",
+                               default="rawPrediction")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        b = self.get_booster()
+        classes = np.asarray(self.get("classes"))
+
+        def per_part(part):
+            sub = DataFrame([part])
+            x = self._features(sub)
+            raw = b.raw_score(x)
+            prob = b.predict(x)
+            if b.objective == "binary":
+                prob2 = np.stack([1 - prob, prob], axis=1)
+                pred_idx = (prob >= 0.5).astype(int)
+            else:
+                prob2 = prob
+                pred_idx = np.argmax(prob, axis=1)
+            out = dict(part)
+            out[self.get("raw_prediction_col")] = raw
+            out[self.get("probability_col")] = prob2
+            out[self.get("prediction_col")] = classes[pred_idx]
+            return out
+
+        return df.map_partitions(per_part)
+
+
+# ---------------- regression ----------------
+
+class LightGBMRegressor(Estimator, _LightGBMParams):
+    feature_name = "lightgbm"
+
+    objective = Param("objective", "regression | regression_l1 | huber | poisson | quantile",
+                      default="regression")
+    alpha = Param("alpha", "huber delta / quantile level", default=0.9,
+                  converter=TypeConverters.to_float)
+
+    def _fit(self, df: DataFrame) -> "LightGBMRegressionModel":
+        train, valid = self._split_validation(df)
+        x = self._features(train)
+        self.require_columns(train, self.get("label_col"))
+        y = np.asarray(train.collect_column(self.get("label_col")), np.float32)
+        w = (np.asarray(train.collect_column(self.get("weight_col")), np.float32)
+             if self.get("weight_col") else None)
+        vx = vy = None
+        if valid is not None and valid.count() > 0:
+            vx = self._features(valid)
+            vy = np.asarray(valid.collect_column(self.get("label_col")), np.float32)
+
+        from .booster import train_booster
+
+        booster = train_booster(
+            x, y, objective=self.get("objective"), weights=w,
+            objective_alpha=self.get("alpha"),
+            valid_features=vx, valid_labels=vy, **self._train_kwargs())
+        model = LightGBMRegressionModel(booster=booster)
+        model.set(**{k: v for k, v in self._param_values.items()
+                     if model.has_param(k)})
+        return model
+
+
+class LightGBMRegressionModel(_LightGBMModelBase):
+    feature_name = "lightgbm"
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        b = self.get_booster()
+
+        def per_part(part):
+            sub = DataFrame([part])
+            out = dict(part)
+            out[self.get("prediction_col")] = b.predict(self._features(sub))
+            return out
+
+        return df.map_partitions(per_part)
+
+
+# ---------------- ranking ----------------
+
+class LightGBMRanker(Estimator, _LightGBMParams):
+    feature_name = "lightgbm"
+
+    group_col = Param("group_col", "query/group id column", default="group")
+    eval_at = Param("eval_at", "NDCG@k cutoffs", default=(5,),
+                    converter=TypeConverters.to_list)
+
+    def _fit(self, df: DataFrame) -> "LightGBMRankerModel":
+        train, valid = self._split_validation(df)
+        self.require_columns(train, self.get("label_col"), self.get("group_col"))
+        # group-contiguous ordering (the reference requires pre-grouped partitions)
+        train = train.sort(self.get("group_col"))
+        x = self._features(train)
+        y = np.asarray(train.collect_column(self.get("label_col")), np.float32)
+        gid = np.asarray(train.collect_column(self.get("group_col")))
+        _, sizes = np.unique(gid, return_counts=True)
+        vx = vy = vsizes = None
+        if valid is not None and valid.count() > 0:
+            valid = valid.sort(self.get("group_col"))
+            vx = self._features(valid)
+            vy = np.asarray(valid.collect_column(self.get("label_col")), np.float32)
+            _, vsizes = np.unique(np.asarray(valid.collect_column(self.get("group_col"))),
+                                  return_counts=True)
+
+        from .booster import train_booster
+
+        booster = train_booster(
+            x, y, objective="lambdarank", group_sizes=sizes,
+            valid_features=vx, valid_labels=vy, valid_group_sizes=vsizes,
+            **self._train_kwargs())
+        model = LightGBMRankerModel(booster=booster)
+        model.set(**{k: v for k, v in self._param_values.items()
+                     if model.has_param(k)})
+        return model
+
+
+class LightGBMRankerModel(_LightGBMModelBase):
+    feature_name = "lightgbm"
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        b = self.get_booster()
+
+        def per_part(part):
+            sub = DataFrame([part])
+            out = dict(part)
+            out[self.get("prediction_col")] = b.predict(self._features(sub))
+            return out
+
+        return df.map_partitions(per_part)

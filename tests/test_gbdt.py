@@ -1,0 +1,225 @@
+"""GBDT engine tests — accuracy gates in the reference's benchmark-CSV spirit
+(``lightgbm/src/test/resources/benchmarks/*.csv``: name,value,precision)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.gbdt import (
+    BinMapper,
+    LightGBMClassificationModel,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRegressor,
+    TpuBooster,
+)
+from synapseml_tpu.gbdt.booster import train_booster
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(7)
+
+
+@pytest.fixture(scope="module")
+def binary_data(rng):
+    n, f = 3000, 10
+    x = rng.normal(size=(n, f))
+    logit = 2 * x[:, 0] - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    return x, y
+
+
+class TestBinMapper:
+    def test_roundtrip_monotone(self, rng):
+        x = rng.normal(size=(500, 3))
+        m = BinMapper(max_bin=31)
+        codes = m.fit_transform(x)
+        assert codes.shape == (500, 3)
+        assert codes.max() < m.num_bins
+        # binning preserves order within a feature
+        order = np.argsort(x[:, 0])
+        assert (np.diff(codes[order, 0].astype(int)) >= 0).all()
+
+    def test_nan_bin(self, rng):
+        x = rng.normal(size=(100, 2))
+        x[::7, 0] = np.nan
+        m = BinMapper(max_bin=15)
+        codes = m.fit_transform(x)
+        assert (codes[::7, 0] == m.nan_bin).all()
+
+    def test_low_cardinality_gets_exact_bins(self):
+        x = np.tile(np.array([[0.0], [1.0], [2.0]]), (50, 1))
+        m = BinMapper(max_bin=255).fit(x)
+        codes = m.transform(np.array([[0.0], [1.0], [2.0]]))
+        assert len(np.unique(codes)) == 3
+
+    def test_serialization(self, rng):
+        m = BinMapper(max_bin=31).fit(rng.normal(size=(200, 2)))
+        m2 = BinMapper.from_dict(m.to_dict())
+        x = rng.normal(size=(50, 2))
+        np.testing.assert_array_equal(m.transform(x), m2.transform(x))
+
+
+class TestBoosterTraining:
+    def test_binary_accuracy_gate(self, binary_data):
+        x, y = binary_data
+        b = train_booster(x, y, objective="binary", num_iterations=30,
+                          num_leaves=15, learning_rate=0.2)
+        acc = ((b.predict(x) > 0.5) == y).mean()
+        assert acc > 0.92  # tolerance gate
+
+    def test_regression_gate(self, rng):
+        n, f = 3000, 6
+        x = rng.normal(size=(n, f))
+        y = (3 * x[:, 0] + np.sin(2 * x[:, 1]) + rng.normal(scale=0.1, size=n)).astype(np.float32)
+        b = train_booster(x, y, objective="regression", num_iterations=50, learning_rate=0.2)
+        rmse = float(np.sqrt(np.mean((b.predict(x) - y) ** 2)))
+        assert rmse < 0.25 * float(y.std())
+
+    def test_multiclass_gate(self, rng):
+        x = rng.normal(size=(2000, 5))
+        y = np.digitize(x[:, 0] + 0.3 * x[:, 1], [-0.5, 0.5]).astype(np.float32)
+        b = train_booster(x, y, objective="multiclass", num_class=3,
+                          num_iterations=20, learning_rate=0.3)
+        assert (np.argmax(b.predict(x), 1) == y).mean() > 0.9
+
+    def test_l1_and_quantile_objectives(self, rng):
+        x = rng.normal(size=(1000, 4))
+        y = (x[:, 0] + rng.normal(scale=0.2, size=1000)).astype(np.float32)
+        for objective in ("regression_l1", "quantile", "huber"):
+            b = train_booster(x, y, objective=objective, num_iterations=20,
+                              learning_rate=0.3, objective_alpha=0.5)
+            mae = np.mean(np.abs(b.predict(x) - y))
+            assert mae < 0.8 * np.mean(np.abs(y - np.median(y))), objective
+
+    def test_nan_features_route(self, rng):
+        x = rng.normal(size=(1500, 4))
+        x[rng.random(x.shape) < 0.1] = np.nan
+        y = (np.nan_to_num(x[:, 0]) > 0).astype(np.float32)
+        b = train_booster(x, y, objective="binary", num_iterations=20, learning_rate=0.3)
+        acc = ((b.predict(x) > 0.5) == y).mean()
+        assert acc > 0.85
+
+    def test_bagging_and_feature_fraction(self, binary_data):
+        x, y = binary_data
+        b = train_booster(x, y, objective="binary", num_iterations=20,
+                          learning_rate=0.2, bagging_fraction=0.7, bagging_freq=1,
+                          feature_fraction=0.8)
+        assert ((b.predict(x) > 0.5) == y).mean() > 0.9
+
+    def test_early_stopping(self, binary_data):
+        x, y = binary_data
+        b = train_booster(x[:2000], y[:2000], objective="binary",
+                          num_iterations=200, learning_rate=0.5,
+                          valid_features=x[2000:], valid_labels=y[2000:],
+                          early_stopping_round=3)
+        assert b.best_iteration is not None
+        assert b.num_iterations < 200
+
+    def test_min_data_in_leaf_limits_growth(self, binary_data):
+        x, y = binary_data
+        b = train_booster(x, y, objective="binary", num_iterations=3,
+                          min_data_in_leaf=1000, learning_rate=0.1)
+        # huge min_data -> few splits per tree
+        assert (b.feature >= 0).sum() <= 3 * 3
+
+
+class TestBoosterApi:
+    def test_save_load_identical(self, binary_data, tmp_path):
+        x, y = binary_data
+        b = train_booster(x, y, objective="binary", num_iterations=10, learning_rate=0.2)
+        b.save(str(tmp_path / "b"))
+        b2 = TpuBooster.load(str(tmp_path / "b"))
+        np.testing.assert_allclose(b.predict(x[:100]), b2.predict(x[:100]), rtol=1e-6)
+
+    def test_predict_leaf_shape(self, binary_data):
+        x, y = binary_data
+        b = train_booster(x, y, objective="binary", num_iterations=5, learning_rate=0.2)
+        leaves = b.predict_leaf(x[:50])
+        assert leaves.shape == (50, 5)
+        assert (leaves >= 0).all()
+
+    def test_feature_importance(self, binary_data):
+        x, y = binary_data
+        b = train_booster(x, y, objective="binary", num_iterations=10, learning_rate=0.2)
+        for kind in ("split", "gain"):
+            imp = b.feature_importance(kind)
+            assert imp.shape == (x.shape[1],)
+            # features 0/1 drive the label; they should dominate noise features
+            assert imp[0] + imp[1] > imp[4:].sum()
+
+    def test_dump_text(self, binary_data):
+        x, y = binary_data
+        b = train_booster(x, y, objective="binary", num_iterations=2, learning_rate=0.2)
+        txt = b.dump_text()
+        assert "tpu_booster" in txt and "tree 0.0" in txt
+
+
+class TestEstimators:
+    def test_classifier_pipeline(self, binary_data, tmp_path):
+        x, y = binary_data
+        df = DataFrame.from_dict({"features": x, "label": y.astype(int)}, num_partitions=3)
+        model = LightGBMClassifier(num_iterations=15, learning_rate=0.2).fit(df)
+        out = model.transform(df)
+        assert {"prediction", "probability", "rawPrediction"} <= set(out.columns)
+        assert (out.collect_column("prediction") == y).mean() > 0.9
+        model.save(str(tmp_path / "m"))
+        m2 = LightGBMClassificationModel.load(str(tmp_path / "m"))
+        np.testing.assert_allclose(m2.transform(df).collect_column("probability"),
+                                   out.collect_column("probability"), rtol=1e-6)
+
+    def test_classifier_string_labels(self, rng):
+        x = rng.normal(size=(600, 4))
+        y = np.where(x[:, 0] > 0, "pos", "neg")
+        df = DataFrame.from_dict({"features": x, "label": y})
+        out = LightGBMClassifier(num_iterations=10, learning_rate=0.3).fit(df).transform(df)
+        assert set(np.unique(out.collect_column("prediction"))) <= {"pos", "neg"}
+        assert (out.collect_column("prediction") == y).mean() > 0.95
+
+    def test_feature_cols_mode(self, rng):
+        x = rng.normal(size=(500, 3))
+        df = DataFrame.from_dict({"a": x[:, 0], "b": x[:, 1], "c": x[:, 2],
+                                  "label": (x[:, 0] > 0).astype(int)})
+        est = LightGBMRegressor(feature_cols=["a", "b", "c"], num_iterations=10,
+                                learning_rate=0.3)
+        out = est.fit(df).transform(df)
+        assert "prediction" in out.columns
+
+    def test_regressor_weights(self, rng):
+        x = rng.normal(size=(800, 3))
+        y = x[:, 0].astype(np.float32)
+        w = np.ones(800); w[:400] = 0.0  # zero-weight half the data
+        df = DataFrame.from_dict({"features": x, "label": y, "w": w})
+        model = LightGBMRegressor(weight_col="w", num_iterations=15, learning_rate=0.3).fit(df)
+        pred = model.transform(df).collect_column("prediction")
+        assert np.corrcoef(pred, y)[0, 1] > 0.8
+
+    def test_ranker_ndcg(self, rng):
+        n = 1000
+        x = rng.normal(size=(n, 5))
+        groups = np.repeat(np.arange(50), 20)
+        rel = np.clip((x[:, 0]) * 2 + 2, 0, 4).round()
+        df = DataFrame.from_dict({"features": x, "label": rel, "group": groups})
+        model = LightGBMRanker(num_iterations=10, num_leaves=7, learning_rate=0.3).fit(df)
+        pred = model.transform(df).collect_column("prediction")
+        assert np.corrcoef(pred, rel)[0, 1] > 0.6
+
+
+class TestSharded:
+    def test_sharded_matches_single_device(self, binary_data, mesh_dp8):
+        x, y = binary_data
+        kw = dict(objective="binary", num_iterations=8, learning_rate=0.2, num_leaves=15)
+        b1 = train_booster(x, y, **kw)
+        b8 = train_booster(x, y, mesh=mesh_dp8.mesh, **kw)
+        # identical split decisions -> near-identical predictions
+        np.testing.assert_allclose(b1.predict(x[:200]), b8.predict(x[:200]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sharded_uneven_rows(self, mesh_dp8, rng):
+        # n not divisible by 8 exercises the padded-row path
+        x = rng.normal(size=(1001, 4))
+        y = (x[:, 0] > 0).astype(np.float32)
+        b = train_booster(x, y, objective="binary", num_iterations=5,
+                          learning_rate=0.3, mesh=mesh_dp8.mesh)
+        assert ((b.predict(x) > 0.5) == y).mean() > 0.9
