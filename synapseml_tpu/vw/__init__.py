@@ -1,0 +1,49 @@
+"""TPU-native Vowpal-Wabbit-equivalent module.
+
+Replaces the reference's VW C++/JNI stack (``vw/`` module, SURVEY.md §2.2):
+hashed-namespace featurization (``VowpalWabbitFeaturizer.scala``), online
+linear learners synced by spanning-tree AllReduce
+(``VowpalWabbitClusterUtil.scala:15-42``), contextual bandits
+(``VowpalWabbitContextualBandit.scala``), and counterfactual policy
+evaluation (``policyeval/``). TPU redesign: features hash into a fixed
+2^bits weight vector; training is a jitted minibatch-SGD scan with the
+cross-shard gradient reduction expressed through GSPMD sharding (every
+minibatch syncs — strictly tighter than VW's pass-boundary AllReduce).
+"""
+
+from .featurizer import VowpalWabbitFeaturizer
+from .estimators import (
+    VowpalWabbitClassificationModel,
+    VowpalWabbitClassifier,
+    VowpalWabbitGeneric,
+    VowpalWabbitGenericModel,
+    VowpalWabbitRegressionModel,
+    VowpalWabbitRegressor,
+)
+from .contextual_bandit import VowpalWabbitContextualBandit, VowpalWabbitContextualBanditModel
+from .policyeval import (
+    VowpalWabbitCSETransformer,
+    cressie_read,
+    cressie_read_interval,
+    ips,
+    snips,
+)
+from .dsjson import VowpalWabbitDSJsonTransformer
+
+__all__ = [
+    "VowpalWabbitFeaturizer",
+    "VowpalWabbitClassifier",
+    "VowpalWabbitClassificationModel",
+    "VowpalWabbitRegressor",
+    "VowpalWabbitRegressionModel",
+    "VowpalWabbitGeneric",
+    "VowpalWabbitGenericModel",
+    "VowpalWabbitContextualBandit",
+    "VowpalWabbitContextualBanditModel",
+    "VowpalWabbitCSETransformer",
+    "VowpalWabbitDSJsonTransformer",
+    "ips",
+    "snips",
+    "cressie_read",
+    "cressie_read_interval",
+]

@@ -1,0 +1,46 @@
+"""Decision-service JSON → DataFrame (reference
+``VowpalWabbitDSJsonTransformer.scala``: parses VW's dsjson logged-interaction
+format into rows usable by the CB trainer and policy evaluators)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..core import DataFrame, Transformer
+from ..core.params import Param
+
+__all__ = ["VowpalWabbitDSJsonTransformer"]
+
+
+class VowpalWabbitDSJsonTransformer(Transformer):
+    feature_name = "vw"
+
+    dsjson_col = Param("dsjson_col", "column of dsjson lines", default="value")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("dsjson_col"))
+        rows = []
+        for line in df.collect_column(self.get("dsjson_col")):
+            try:
+                d = json.loads(line)
+            except (json.JSONDecodeError, TypeError):
+                continue
+            labels = d.get("_labelIndex", d.get("_label_Action", 1) - 1)
+            probs = d.get("p", [])
+            chosen = int(labels) if not isinstance(labels, list) else int(labels[0])
+            rows.append({
+                "eventId": d.get("EventId", ""),
+                "timestamp": d.get("Timestamp", ""),
+                "cost": float(d.get("_label_cost", 0.0)),
+                "probability": float(d.get("_label_probability",
+                                           probs[0] if probs else 1.0)),
+                "chosenAction": chosen + 1,  # 1-based like the reference
+                "actionCount": len(d.get("a", [])) or len(probs) or 1,
+                "probabilities": np.asarray(probs, np.float64),
+                "context": json.dumps(d.get("c", {})),
+            })
+        if not rows:
+            return DataFrame([{}])
+        return DataFrame.from_rows(rows)

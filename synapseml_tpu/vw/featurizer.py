@@ -1,0 +1,133 @@
+"""VowpalWabbitFeaturizer — DataFrame columns → hashed sparse features.
+
+Reference: ``vw/.../VowpalWabbitFeaturizer.scala:25-230`` + the per-type
+featurizers in ``featurizer/*.scala`` (Numeric, String, StringSplit, Map,
+Seq/Vector, Boolean) and namespace-prefixed murmur hashing
+(``VowpalWabbitMurmurWithPrefix.scala``).
+
+Output is the TPU-friendly padded-sparse layout: per row a fixed-width
+``(indices int32[max_nnz], values float32[max_nnz])`` pair (padding has
+value 0, which is a no-op for linear scores) — static shapes for jit.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..core import DataFrame, Transformer
+from ..core.params import Param, TypeConverters
+from .hashing import hash_feature
+
+__all__ = ["VowpalWabbitFeaturizer", "pack_sparse"]
+
+
+def pack_sparse(rows: list[list[tuple[int, float]]], max_nnz: int | None = None):
+    """Ragged (index, value) rows → padded (N, max_nnz) int32/float32 pair."""
+    width = max_nnz or max((len(r) for r in rows), default=1)
+    width = max(width, 1)
+    idx = np.zeros((len(rows), width), np.int32)
+    val = np.zeros((len(rows), width), np.float32)
+    for i, r in enumerate(rows):
+        r = r[:width]
+        for j, (k, v) in enumerate(r):
+            idx[i, j] = k
+            val[i, j] = v
+    return idx, val
+
+
+class VowpalWabbitFeaturizer(Transformer):
+    """Hash input columns into one padded-sparse feature column.
+
+    Column handling mirrors the reference featurizer dispatch:
+      * numeric → feature ``hash(colname)`` with the numeric value
+      * bool → hash(colname) with 1.0 when true
+      * str → categorical one-hot: ``hash(colname + '=' + value) -> 1.0``
+        (``StringFeaturizer.scala``)
+      * str with ``string_split_cols`` → one feature per whitespace token
+      * dict → ``hash(colname + '.' + key)`` numeric, or categorical for str values
+      * list/tuple/ndarray of numbers → ``hash(colname + '_' + i)`` per slot
+    """
+
+    feature_name = "vw"
+
+    input_cols = Param("input_cols", "columns to hash", default=None,
+                       converter=TypeConverters.to_list)
+    output_col = Param("output_col", "output struct column prefix; emits "
+                       "<out>_indices and <out>_values", default="features")
+    num_bits = Param("num_bits", "hash space = 2^num_bits (VW -b)", default=18,
+                     converter=TypeConverters.to_int)
+    string_split_cols = Param("string_split_cols", "string columns tokenized on "
+                              "whitespace (StringSplitFeaturizer)", default=(),
+                              converter=TypeConverters.to_list)
+    max_nnz = Param("max_nnz", "pad/truncate row features to this width "
+                    "(None = widest row)", default=None)
+    sum_collisions = Param("sum_collisions", "sum colliding feature values "
+                           "(reference sumCollisions)", default=True,
+                           converter=TypeConverters.to_bool)
+
+    def _featurize_value(self, col: str, v, bits: int, split: bool) -> list[tuple[int, float]]:
+        if v is None:
+            return []
+        if isinstance(v, (bool, np.bool_)):
+            return [(hash_feature(col, "", bits), 1.0)] if v else []
+        if isinstance(v, numbers.Number):
+            fv = float(v)
+            return [(hash_feature(col, "", bits), fv)] if fv != 0.0 else []
+        if isinstance(v, (str, bytes)):
+            s = v.decode() if isinstance(v, bytes) else v
+            if split:
+                return [(hash_feature(f"{col}_{tok}", "", bits), 1.0) for tok in s.split()]
+            return [(hash_feature(f"{col}={s}", "", bits), 1.0)]
+        if isinstance(v, dict):
+            out = []
+            for k, mv in v.items():
+                if isinstance(mv, numbers.Number):
+                    out.append((hash_feature(f"{col}.{k}", "", bits), float(mv)))
+                else:
+                    out.append((hash_feature(f"{col}.{k}={mv}", "", bits), 1.0))
+            return out
+        if isinstance(v, (list, tuple, np.ndarray)):
+            return [(hash_feature(f"{col}_{i}", "", bits), float(x))
+                    for i, x in enumerate(np.asarray(v, dtype=np.float64).ravel()) if x != 0.0]
+        raise TypeError(f"cannot featurize {type(v).__name__} in column {col!r}")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        cols = self.get("input_cols")
+        if not cols:
+            raise ValueError("input_cols must be set")
+        self.require_columns(df, *cols)
+        bits = self.get("num_bits")
+        split_cols = set(self.get("string_split_cols") or ())
+        out = self.get("output_col")
+        sum_col = self.get("sum_collisions")
+
+        # two passes: hash every partition first so the pad width is global
+        # (keeps the output schema rectangular across partitions)
+        all_rows: list[list[list[tuple[int, float]]]] = []
+        for part in df.partitions:
+            n = len(next(iter(part.values()))) if part else 0
+            rows = []
+            for i in range(n):
+                feats: list[tuple[int, float]] = []
+                for c in cols:
+                    feats.extend(self._featurize_value(c, part[c][i], bits, c in split_cols))
+                if sum_col and feats:
+                    agg: dict[int, float] = {}
+                    for k, v in feats:
+                        agg[k] = agg.get(k, 0.0) + v
+                    feats = list(agg.items())
+                rows.append(feats)
+            all_rows.append(rows)
+        width = self.get("max_nnz") or max(
+            (len(r) for rows in all_rows for r in rows), default=1)
+
+        new_parts = []
+        for part, rows in zip(df.partitions, all_rows):
+            idx, val = pack_sparse(rows, width)
+            res = dict(part)
+            res[f"{out}_indices"] = idx
+            res[f"{out}_values"] = val
+            new_parts.append(res)
+        return DataFrame(new_parts)

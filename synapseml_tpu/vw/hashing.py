@@ -1,0 +1,68 @@
+"""MurmurHash3 (x86 32-bit) — VW's feature hash, pure Python with caching.
+
+Reference: the JNI binding ``VowpalWabbitMurmur`` used by
+``VowpalWabbitMurmurWithPrefix.scala`` (namespace-prefixed feature hashing).
+This matches VW's uniform hash (murmur3_32 of the UTF-8 name, seeded by the
+namespace hash). Feature names repeat heavily across rows, so an LRU cache
+makes the pure-Python path fast; a C implementation lands via
+:mod:`synapseml_tpu.native` when built.
+"""
+
+from __future__ import annotations
+
+import functools
+
+__all__ = ["murmur3_32", "hash_feature", "namespace_seed"]
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_MASK = 0xFFFFFFFF
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _MASK
+
+
+@functools.lru_cache(maxsize=1 << 20)
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    h = seed & _MASK
+    n = len(data)
+    nblocks = n // 4
+    for i in range(nblocks):
+        k = int.from_bytes(data[4 * i : 4 * i + 4], "little")
+        k = (k * _C1) & _MASK
+        k = _rotl(k, 15)
+        k = (k * _C2) & _MASK
+        h ^= k
+        h = _rotl(h, 13)
+        h = (h * 5 + 0xE6546B64) & _MASK
+    tail = data[nblocks * 4 :]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * _C1) & _MASK
+        k = _rotl(k, 15)
+        k = (k * _C2) & _MASK
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _MASK
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _MASK
+    h ^= h >> 16
+    return h
+
+
+@functools.lru_cache(maxsize=1 << 10)
+def namespace_seed(namespace: str) -> int:
+    """VW hashes the namespace name to seed its features' hashes."""
+    return murmur3_32(namespace.encode("utf-8"), 0)
+
+
+@functools.lru_cache(maxsize=1 << 20)
+def hash_feature(name: str, namespace: str = "", num_bits: int = 18) -> int:
+    return murmur3_32(name.encode("utf-8"), namespace_seed(namespace)) & ((1 << num_bits) - 1)

@@ -1,0 +1,240 @@
+"""VW-equivalent module tests (featurizer, learners, bandits, policy eval)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.vw import (
+    VowpalWabbitClassificationModel,
+    VowpalWabbitClassifier,
+    VowpalWabbitContextualBandit,
+    VowpalWabbitCSETransformer,
+    VowpalWabbitDSJsonTransformer,
+    VowpalWabbitFeaturizer,
+    VowpalWabbitGeneric,
+    VowpalWabbitRegressor,
+    cressie_read,
+    ips,
+    snips,
+)
+from synapseml_tpu.vw.hashing import hash_feature, murmur3_32
+from synapseml_tpu.vw.learner import LinearConfig, train_linear
+from synapseml_tpu.vw.policyeval import KahanSum, cressie_read_interval
+
+
+class TestHashing:
+    def test_murmur3_reference_vectors(self):
+        # public murmur3_32 test vectors
+        assert murmur3_32(b"", 0) == 0
+        assert murmur3_32(b"hello", 0) == 0x248BFA47
+        assert murmur3_32(b"hello, world", 0) == 0x149BBB7F
+        assert murmur3_32(b"", 1) == 0x514E28B7
+
+    def test_hash_feature_bits(self):
+        for bits in (10, 18, 24):
+            assert 0 <= hash_feature("foo", "ns", bits) < (1 << bits)
+
+    def test_namespace_changes_hash(self):
+        assert hash_feature("f", "a") != hash_feature("f", "b")
+
+
+class TestFeaturizer:
+    def test_mixed_types(self):
+        df = DataFrame.from_dict({
+            "num": [1.5, 0.0, -2.0],
+            "cat": ["a", "b", "a"],
+            "flag": [True, False, True],
+        })
+        out = VowpalWabbitFeaturizer(input_cols=["num", "cat", "flag"]).transform(df)
+        idx = out.collect_column("features_indices")
+        val = out.collect_column("features_values")
+        assert idx.shape == val.shape
+        # row 0: num + cat + flag = 3 features; row 1: num==0 dropped, flag False dropped
+        assert (val[0] != 0).sum() == 3
+        assert (val[1] != 0).sum() == 1
+
+    def test_string_split(self):
+        df = DataFrame.from_dict({"text": ["good great", "bad"]})
+        out = VowpalWabbitFeaturizer(input_cols=["text"],
+                                     string_split_cols=["text"]).transform(df)
+        assert (out.collect_column("features_values")[0] != 0).sum() == 2
+
+    def test_array_and_dict_columns(self):
+        df = DataFrame.from_rows([
+            {"vec": [1.0, 0.0, 2.0], "m": {"k1": 3.0, "k2": "x"}},
+            {"vec": [0.0, 1.0, 0.0], "m": {"k1": 1.0}},
+        ])
+        out = VowpalWabbitFeaturizer(input_cols=["vec", "m"]).transform(df)
+        assert (out.collect_column("features_values")[0] != 0).sum() == 4  # 2 vec + 2 map
+
+    def test_global_padding_consistent_across_partitions(self):
+        df = DataFrame.from_dict({"t": ["a b c d", "a"]}, num_partitions=2)
+        out = VowpalWabbitFeaturizer(input_cols=["t"], string_split_cols=["t"]).transform(df)
+        assert out.collect_column("features_indices").shape[1] == 4
+
+
+@pytest.fixture(scope="module")
+def vw_binary():
+    rng = np.random.default_rng(3)
+    n = 1500
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = ((2 * x1 - x2) > 0).astype(int)
+    df = DataFrame.from_dict({"x1": x1, "x2": x2, "label": y}, num_partitions=2)
+    fdf = VowpalWabbitFeaturizer(input_cols=["x1", "x2"]).transform(df)
+    return fdf, y
+
+
+class TestLearners:
+    def test_classifier_gate(self, vw_binary):
+        fdf, y = vw_binary
+        model = VowpalWabbitClassifier(num_passes=4).fit(fdf)
+        out = model.transform(fdf)
+        assert (out.collect_column("prediction") == y).mean() > 0.9
+        assert {"probability", "rawPrediction"} <= set(out.columns)
+
+    def test_classifier_save_load(self, vw_binary, tmp_path):
+        fdf, y = vw_binary
+        model = VowpalWabbitClassifier(num_passes=2).fit(fdf)
+        model.save(str(tmp_path / "vw"))
+        m2 = VowpalWabbitClassificationModel.load(str(tmp_path / "vw"))
+        np.testing.assert_allclose(m2.transform(fdf).collect_column("probability"),
+                                   model.transform(fdf).collect_column("probability"))
+
+    def test_regressor_gate(self):
+        rng = np.random.default_rng(4)
+        n = 1200
+        x1, x2 = rng.normal(size=n), rng.normal(size=n)
+        y = 3 * x1 - 2 * x2 + rng.normal(scale=0.05, size=n)
+        df = DataFrame.from_dict({"x1": x1, "x2": x2, "label": y})
+        fdf = VowpalWabbitFeaturizer(input_cols=["x1", "x2"]).transform(df)
+        pred = VowpalWabbitRegressor(num_passes=5).fit(fdf).transform(fdf)
+        assert np.corrcoef(pred.collect_column("prediction"), y)[0, 1] > 0.95
+
+    def test_warm_start_initial_model(self, vw_binary):
+        fdf, y = vw_binary
+        m1 = VowpalWabbitClassifier(num_passes=1).fit(fdf)
+        warm = VowpalWabbitClassifier(num_passes=1,
+                                      initial_model=m1.get("model_weights")).fit(fdf)
+        # warm-started model should beat or match the 1-pass model
+        a1 = (m1.transform(fdf).collect_column("prediction") == y).mean()
+        a2 = (warm.transform(fdf).collect_column("prediction") == y).mean()
+        assert a2 >= a1 - 0.02
+
+    def test_quantile_loss(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=800)
+        y = x + rng.exponential(1.0, size=800)
+        df = DataFrame.from_dict({"x": x, "label": y})
+        fdf = VowpalWabbitFeaturizer(input_cols=["x"]).transform(df)
+        cfg = LinearConfig(loss="quantile", quantile_tau=0.9, num_passes=8,
+                           learning_rate=0.3)
+        idx = np.asarray(fdf.collect_column("features_indices"))
+        val = np.asarray(fdf.collect_column("features_values"))
+        w = train_linear(idx, val, y.astype(np.float32), cfg)
+        # the q90 fit should sit above the mean fit
+        assert (w != 0).sum() > 0
+
+    def test_generic_vw_text(self):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=600)
+        y = (x > 0).astype(int)
+        lines = [f"{1 if yi else -1} | x:{xi:.4f}" for yi, xi in zip(y, x)]
+        df = DataFrame.from_dict({"input": lines})
+        model = VowpalWabbitGeneric(loss_function="logistic", num_passes=4).fit(df)
+        pred = model.transform(df).collect_column("prediction")
+        assert (((pred > 0.5).astype(int)) == y).mean() > 0.9
+
+
+class TestContextualBandit:
+    def test_cb_learns_best_action(self):
+        rng = np.random.default_rng(7)
+        n, A, D = 1500, 3, 4
+        sh_idx = np.tile(np.arange(5, dtype=np.int32), (n, 1))
+        sh_val = rng.normal(size=(n, 5)).astype(np.float32)
+        # action features identify the action
+        a_idx = np.tile((np.arange(A * D, dtype=np.int32) + 100).reshape(A, D), (n, 1, 1))
+        a_val = np.ones((n, A, D), np.float32)
+        best = (sh_val[:, 0] > 0).astype(int)  # context decides best action (0 or 1)
+        chosen = rng.integers(0, A, size=n)
+        cost = np.where(chosen == best, 0.0, 1.0)
+        df = DataFrame.from_dict({
+            "shared_indices": sh_idx, "shared_values": sh_val,
+            "features_indices": a_idx, "features_values": a_val,
+            "chosenAction": chosen + 1, "cost": cost.astype(np.float64),
+            "probability": np.full(n, 1.0 / A)})
+        model = VowpalWabbitContextualBandit(num_passes=4).fit(df)
+        out = model.transform(df)
+        scores = out.collect_column("prediction")
+        assert scores.shape == (n, A)
+        # greedy action should match the context-dependent best often
+        match = (out.collect_column("predictedAction") - 1 == best).mean()
+        assert match > 0.6
+
+    def test_parallel_fit(self):
+        rng = np.random.default_rng(8)
+        n, A, D = 200, 2, 3
+        df = DataFrame.from_dict({
+            "shared_indices": np.tile(np.arange(4, dtype=np.int32), (n, 1)),
+            "shared_values": rng.normal(size=(n, 4)).astype(np.float32),
+            "features_indices": np.tile(np.arange(A * D, dtype=np.int32).reshape(A, D), (n, 1, 1)),
+            "features_values": np.ones((n, A, D), np.float32),
+            "chosenAction": rng.integers(1, A + 1, size=n),
+            "cost": rng.random(n), "probability": np.full(n, 0.5)})
+        models = VowpalWabbitContextualBandit().parallel_fit(
+            df, [{"learning_rate": 0.1}, {"learning_rate": 0.9}])
+        assert len(models) == 2
+
+
+class TestPolicyEval:
+    def test_kahan(self):
+        s = KahanSum()
+        for _ in range(1000):
+            s.add(0.1)
+        assert abs(s.value - 100.0) < 1e-9
+
+    def test_ips_snips_identity_policy(self):
+        r = np.random.default_rng(9).random(1000)
+        w = np.ones(1000)
+        assert abs(ips(w, r) - r.mean()) < 1e-12
+        assert abs(snips(w, r) - r.mean()) < 1e-12
+        assert abs(cressie_read(w, r) - r.mean()) < 1e-6
+
+    def test_cressie_read_shrinks_extremes(self):
+        rng = np.random.default_rng(10)
+        w = np.concatenate([np.ones(990), np.full(10, 50.0)])
+        r = np.concatenate([np.full(990, 0.1), np.ones(10)])
+        cr = cressie_read(w, r)
+        # CR should land below the unstable IPS estimate
+        assert cr < ips(w, r)
+
+    def test_interval_contains_point(self):
+        rng = np.random.default_rng(11)
+        w = np.exp(rng.normal(scale=0.2, size=500))
+        r = rng.random(500)
+        lo, hi = cressie_read_interval(w, r)
+        assert lo <= cressie_read(w, r) <= hi
+
+    def test_cse_transformer(self):
+        rng = np.random.default_rng(12)
+        df = DataFrame.from_dict({
+            "probLog": np.full(300, 0.5),
+            "probPred": np.clip(rng.random(300), 0.05, 1.0),
+            "reward": rng.random(300)})
+        out = VowpalWabbitCSETransformer().transform(df)
+        row = out.first()
+        assert row["count"] == 300
+        assert row["cressieReadLower"] <= row["cressieRead"] <= row["cressieReadUpper"]
+
+
+class TestDSJson:
+    def test_parse(self):
+        lines = [
+            '{"EventId":"a","_label_cost":-1,"_label_probability":0.8,"_labelIndex":1,'
+            '"a":[2,1],"p":[0.8,0.2],"c":{"f":1}}',
+            "not json",
+        ]
+        out = VowpalWabbitDSJsonTransformer().transform(
+            DataFrame.from_dict({"value": lines}))
+        assert out.count() == 1
+        row = out.first()
+        assert row["chosenAction"] == 2 and row["cost"] == -1.0 and row["actionCount"] == 2
