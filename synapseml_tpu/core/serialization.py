@@ -85,7 +85,9 @@ def _is_array_pytree(v: Any) -> bool:
     if hasattr(v, "__array__") and hasattr(v, "dtype"):  # jax arrays
         return True
     if isinstance(v, dict):
-        return bool(v) and all(_is_array_pytree(x) for x in v.values())
+        # non-str keys would be stringified by the npz flatten and not restored
+        return (bool(v) and all(isinstance(k, str) for k in v)
+                and all(_is_array_pytree(x) for x in v.values()))
     if isinstance(v, (list, tuple)):
         return bool(v) and all(_is_array_pytree(x) for x in v)
     return False

@@ -1,0 +1,34 @@
+"""Generic reusable transformers (reference ``core/.../stages/``, SURVEY.md §2.5).
+
+Minibatching lives in :mod:`.minibatch` — on TPU it is the seam between dynamic
+row streams and static-shape XLA executables, so the batched representation is
+columnar (object arrays of per-batch ndarrays) and feeds straight into the
+padding buckets of :mod:`synapseml_tpu.parallel.batching`.
+"""
+
+from .minibatch import (  # noqa: F401
+    DynamicMiniBatchTransformer,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    TimeIntervalMiniBatchTransformer,
+)
+from .basic import (  # noqa: F401
+    Cacher,
+    ClassBalancer,
+    ClassBalancerModel,
+    DropColumns,
+    EnsembleByKey,
+    Explode,
+    Lambda,
+    MultiColumnAdapter,
+    PartitionConsolidator,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    Timer,
+    TimerModel,
+    UDFTransformer,
+)
+from .text import TextPreprocessor, UnicodeNormalize  # noqa: F401
+from .summarize import SummarizeData  # noqa: F401

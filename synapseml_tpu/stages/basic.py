@@ -1,0 +1,334 @@
+"""Basic reusable transformers (reference ``core/.../stages/*.scala``)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, Partition, _as_column, concat_partitions
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model, Transformer
+
+__all__ = [
+    "Lambda", "UDFTransformer", "DropColumns", "SelectColumns", "RenameColumn",
+    "Repartition", "Cacher", "Explode", "EnsembleByKey", "StratifiedRepartition",
+    "PartitionConsolidator", "Timer", "TimerModel", "ClassBalancer",
+    "ClassBalancerModel", "MultiColumnAdapter",
+]
+
+
+class Lambda(Transformer):
+    """Arbitrary DataFrame->DataFrame function as a stage
+    (ref ``stages/Lambda.scala:24``)."""
+
+    transform_fn = ComplexParam("transform_fn", "DataFrame -> DataFrame callable")
+    transform_schema_fn = ComplexParam("transform_schema_fn", "schema -> schema callable")
+
+    def __init__(self, transform_fn: Callable[[DataFrame], DataFrame] | None = None, **kw):
+        super().__init__(**kw)
+        if transform_fn is not None:
+            self.set(transform_fn=transform_fn)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return self.get("transform_fn")(df)
+
+    def transform_schema(self, schema: dict) -> dict:
+        fn = self.get("transform_schema_fn")
+        return fn(schema) if fn else schema
+
+
+class UDFTransformer(Transformer):
+    """Apply a user function to input column(s) producing an output column
+    (ref ``stages/UDFTransformer.scala:27``). The udf receives per-partition
+    column arrays (vectorized — the TPU-friendly contract) unless
+    ``vectorized=False``, in which case it is applied per element."""
+
+    input_col = Param("input_col", "single input column")
+    input_cols = Param("input_cols", "multiple input columns", converter=TypeConverters.to_list)
+    output_col = Param("output_col", "output column", default="output")
+    udf = ComplexParam("udf", "the function")
+    vectorized = Param("vectorized", "call once per partition with arrays", default=True,
+                       converter=TypeConverters.to_bool)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        cols = self.get("input_cols") or ([self.get("input_col")] if self.get("input_col") else [])
+        if not cols:
+            raise ValueError("UDFTransformer: set input_col or input_cols")
+        self.require_columns(df, *cols)
+        fn = self.get("udf")
+
+        def per_part(p: Partition) -> np.ndarray:
+            args = [p[c] for c in cols]
+            if self.get("vectorized"):
+                return _as_column(fn(*args), len(args[0]))
+            return _as_column([fn(*vals) for vals in zip(*args)], len(args[0]))
+
+        return df.with_column(self.get("output_col"), per_part)
+
+
+class DropColumns(Transformer):
+    cols = Param("cols", "columns to drop", converter=TypeConverters.to_list, default=[])
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return df.drop([c for c in self.get("cols") if c in df.columns])
+
+
+class SelectColumns(Transformer):
+    cols = Param("cols", "columns to keep", converter=TypeConverters.to_list, default=[])
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return df.select(self.get("cols"))
+
+
+class RenameColumn(Transformer):
+    input_col = Param("input_col", "existing name")
+    output_col = Param("output_col", "new name")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        return df.with_column_renamed(self.get("input_col"), self.get("output_col"))
+
+
+class Repartition(Transformer):
+    """(ref ``stages/Repartition.scala``) — partitions map 1:1 to host feeding
+    units on the mesh, so this is also the executor-count control."""
+
+    n = Param("n", "target partition count", converter=TypeConverters.to_int,
+              validator=lambda v: v > 0)
+    disable = Param("disable", "pass through unchanged", default=False,
+                    converter=TypeConverters.to_bool)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return df if self.get("disable") else df.repartition(self.get("n"))
+
+
+class Cacher(Transformer):
+    """(ref ``stages/Cacher.scala``) — the eager data plane is always
+    materialized; kept for pipeline parity."""
+
+    disable = Param("disable", "skip caching", default=False, converter=TypeConverters.to_bool)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return df if self.get("disable") else df.cache()
+
+
+class Explode(Transformer):
+    """Explode an array column into rows (ref ``stages/Explode.scala``)."""
+
+    input_col = Param("input_col", "array column to explode")
+    output_col = Param("output_col", "exploded column name")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        in_col = self.get("input_col")
+        out_col = self.get("output_col") or in_col
+        self.require_columns(df, in_col)
+
+        def per_part(p: Partition) -> Partition:
+            n = len(p[in_col])
+            reps = np.asarray([len(p[in_col][i]) for i in range(n)], dtype=np.int64)
+            out: dict[str, np.ndarray] = {}
+            for k, col in p.items():
+                if k == in_col:
+                    continue
+                out[k] = np.repeat(col, reps, axis=0)
+            flat: list = []
+            for i in range(n):
+                flat.extend(list(p[in_col][i]))
+            out[out_col] = _as_column(flat)
+            return out
+
+        return df.map_partitions(per_part)
+
+
+class EnsembleByKey(Transformer):
+    """Group rows by key column(s) and aggregate value column(s)
+    (ref ``stages/EnsembleByKey.scala:22``). Strategy: mean (vectors average
+    elementwise, the reference's behavior for DenseVector cols)."""
+
+    keys = Param("keys", "grouping key columns", converter=TypeConverters.to_list)
+    cols = Param("cols", "value columns to aggregate", converter=TypeConverters.to_list)
+    col_names = Param("col_names", "output names (default '<strategy>(<col>)')",
+                      converter=TypeConverters.to_list)
+    strategy = Param("strategy", "aggregation strategy", default="mean",
+                     validator=lambda v: v in ("mean",))
+    collapse_group = Param("collapse_group", "one row per key (else broadcast back)",
+                           default=True, converter=TypeConverters.to_bool)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        keys, cols = self.get("keys"), self.get("cols")
+        self.require_columns(df, *keys, *cols)
+        names = self.get("col_names") or [f"{self.get('strategy')}({c})" for c in cols]
+        whole = df.collect()
+        n = len(next(iter(whole.values())))
+        key_rows = list(zip(*[whole[k] for k in keys]))
+        index: dict[tuple, list[int]] = {}
+        for i, kr in enumerate(key_rows):
+            index.setdefault(kr, []).append(i)
+        group_keys = list(index.keys())
+        agg = {name: [np.mean(np.stack([np.asarray(whole[c][i], dtype=np.float64)
+                                        for i in idx]), axis=0)
+                      for idx in index.values()]
+               for name, c in zip(names, cols)}
+        if self.get("collapse_group"):
+            out: Partition = {k: _as_column([gk[j] for gk in group_keys])
+                              for j, k in enumerate(keys)}
+            for name in names:
+                out[name] = _as_column(agg[name])
+            return DataFrame([out])
+        pos = {kr: gi for gi, kr in enumerate(group_keys)}
+        out = dict(whole)
+        for name in names:
+            out[name] = _as_column([agg[name][pos[key_rows[i]]] for i in range(n)])
+        return DataFrame([out])
+
+
+class StratifiedRepartition(Transformer):
+    """Repartition so every partition sees every label value
+    (ref ``stages/StratifiedRepartition.scala:31``): round-robin within each
+    stratum across partitions. Modes: 'equal' (equalize class counts by
+    resampling), 'original' (keep counts), 'mixed' (cap imbalance at 3x min)."""
+
+    label_col = Param("label_col", "stratification column", default="label")
+    mode = Param("mode", "equal | original | mixed", default="original",
+                 validator=lambda v: v in ("equal", "original", "mixed"))
+    seed = Param("seed", "resampling seed", default=0, converter=TypeConverters.to_int)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        lab = self.get("label_col")
+        self.require_columns(df, lab)
+        nparts = df.num_partitions
+        whole = df.collect()
+        labels = whole[lab]
+        values, counts = np.unique(labels, return_counts=True)
+        rng = np.random.default_rng(self.get("seed"))
+        mode = self.get("mode")
+        if mode == "equal":
+            target = {v: int(counts.max()) for v in values}
+        elif mode == "mixed":
+            cap = int(min(counts) * 3)
+            target = {v: min(int(c), cap) for v, c in zip(values, counts)}
+        else:
+            target = {v: int(c) for v, c in zip(values, counts)}
+        chosen: list[np.ndarray] = []
+        for v in values:
+            idx = np.nonzero(labels == v)[0]
+            t = target[v]
+            if t <= len(idx):
+                chosen.append(idx[:t])
+            else:  # upsample with replacement to equalize
+                extra = rng.choice(idx, size=t - len(idx), replace=True)
+                chosen.append(np.concatenate([idx, extra]))
+        parts: list[list[int]] = [[] for _ in range(nparts)]
+        for idx in chosen:  # round-robin each stratum across partitions
+            for j, i in enumerate(idx):
+                parts[j % nparts].append(int(i))
+        return DataFrame([{k: v[np.asarray(p_idx, dtype=np.int64)] for k, v in whole.items()}
+                          for p_idx in parts if p_idx])
+
+
+class PartitionConsolidator(Transformer):
+    """Funnel data to one partition per host (ref
+    ``stages/PartitionConsolidator.scala:22`` — one-per-executor for
+    rate-limited resources like HTTP clients; here: one per mesh host)."""
+
+    num_hosts = Param("num_hosts", "target host count (default: jax process count)",
+                      converter=TypeConverters.to_int)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        n = self.get("num_hosts")
+        if n is None:
+            import jax
+
+            n = max(jax.process_count(), 1)
+        return df.coalesce(min(n, df.num_partitions))
+
+
+class TimerModel(Model):
+    stage = ComplexParam("stage", "wrapped fitted stage")
+    log_to_scala = Param("log_to_scala", "print timing lines", default=True,
+                         converter=TypeConverters.to_bool)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        inner = self.get("stage")
+        t0 = time.perf_counter()
+        out = inner.transform(df)
+        self.last_elapsed = time.perf_counter() - t0
+        if self.get("log_to_scala"):
+            print(f"[Timer] {type(inner).__name__}.transform took {self.last_elapsed:.4f}s")
+        return out
+
+
+class Timer(Estimator):
+    """Time a wrapped stage's fit/transform (ref ``stages/Timer.scala:56``)."""
+
+    stage = ComplexParam("stage", "stage to time")
+    log_to_scala = Param("log_to_scala", "print timing lines", default=True,
+                         converter=TypeConverters.to_bool)
+
+    def _fit(self, df: DataFrame) -> TimerModel:
+        inner = self.get("stage")
+        t0 = time.perf_counter()
+        fitted = inner.fit(df) if isinstance(inner, Estimator) else inner
+        self.last_elapsed = time.perf_counter() - t0
+        if self.get("log_to_scala") and isinstance(inner, Estimator):
+            print(f"[Timer] {type(inner).__name__}.fit took {self.last_elapsed:.4f}s")
+        return TimerModel(stage=fitted, log_to_scala=self.get("log_to_scala"))
+
+
+class ClassBalancerModel(Model):
+    input_col = Param("input_col", "label column")
+    output_col = Param("output_col", "weight column", default="weight")
+    weights = ComplexParam("weights", "label value -> weight mapping (dict)")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        w = self.get("weights")
+        col = self.get("input_col")
+        self.require_columns(df, col)
+        return df.with_column(
+            self.get("output_col"),
+            lambda p: np.asarray([w.get(_key(v), 1.0) for v in p[col]], dtype=np.float64))
+
+
+def _key(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+class ClassBalancer(Estimator):
+    """Weight column = max_class_count / class_count
+    (ref ``stages/ClassBalancer.scala``)."""
+
+    input_col = Param("input_col", "label column", default="label")
+    output_col = Param("output_col", "weight column", default="weight")
+
+    def _fit(self, df: DataFrame) -> ClassBalancerModel:
+        col = self.get("input_col")
+        self.require_columns(df, col)
+        labels = df.collect_column(col)
+        values, counts = np.unique(labels, return_counts=True)
+        mx = counts.max()
+        weights = {_key(v): float(mx) / float(c) for v, c in zip(values, counts)}
+        return ClassBalancerModel(input_col=col, output_col=self.get("output_col"),
+                                  weights=weights)
+
+
+class MultiColumnAdapter(Estimator):
+    """Apply a 1-col stage independently to many columns
+    (ref ``stages/MultiColumnAdapter.scala``)."""
+
+    base_stage = ComplexParam("base_stage", "stage with input_col/output_col params")
+    input_cols = Param("input_cols", "input columns", converter=TypeConverters.to_list)
+    output_cols = Param("output_cols", "output columns", converter=TypeConverters.to_list)
+
+    def _make_stages(self):
+        base = self.get("base_stage")
+        ins, outs = self.get("input_cols"), self.get("output_cols")
+        if len(ins) != len(outs):
+            raise ValueError("input_cols and output_cols must align")
+        return [base.copy({"input_col": i, "output_col": o}) for i, o in zip(ins, outs)]
+
+    def _fit(self, df: DataFrame):
+        from ..core.pipeline import Pipeline
+
+        return Pipeline(stages=self._make_stages()).fit(df)

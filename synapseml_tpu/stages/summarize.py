@@ -1,0 +1,86 @@
+"""SummarizeData — per-column summary statistics as a DataFrame
+(reference ``core/.../stages/SummarizeData.scala:101``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, _as_column
+from ..core.params import Param, TypeConverters
+from ..core.pipeline import Transformer
+
+__all__ = ["SummarizeData"]
+
+
+class SummarizeData(Transformer):
+    counts = Param("counts", "include count/unique/missing", default=True,
+                   converter=TypeConverters.to_bool)
+    basic = Param("basic", "include mean/std/min/max", default=True,
+                  converter=TypeConverters.to_bool)
+    sample = Param("sample", "include skew/kurtosis/variance", default=True,
+                   converter=TypeConverters.to_bool)
+    percentiles = Param("percentiles", "include p0.5/p1/p5/p25/p50/p75/p95/p99/p99.5",
+                        default=True, converter=TypeConverters.to_bool)
+    error_threshold = Param("error_threshold", "approx-quantile tolerance (parity; exact here)",
+                            default=0.0, converter=TypeConverters.to_float)
+
+    _PCTS = [0.5, 1, 5, 25, 50, 75, 95, 99, 99.5]
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        whole = df.collect()
+        rows: dict[str, list] = {"feature": []}
+        stats_order: list[str] = []
+
+        def put(name: str, value):
+            if name not in rows:
+                rows[name] = []
+                stats_order.append(name)
+            rows[name].append(value)
+
+        for name, col in whole.items():
+            numeric = col.dtype != object and np.issubdtype(col.dtype, np.number) and col.ndim == 1
+            rows["feature"].append(name)
+            if self.get("counts"):
+                put("count", int(len(col)))
+                try:
+                    put("unique_value_count", int(len(np.unique(col[~_isnan(col)])
+                                                      if numeric else set(map(str, col)))))
+                except TypeError:
+                    put("unique_value_count", float("nan"))
+                put("missing_value_count",
+                    int(np.count_nonzero(_isnan(col))) if numeric
+                    else sum(1 for v in col if v is None))
+            vals = col[~_isnan(col)].astype(np.float64) if numeric else None
+            if self.get("basic"):
+                put("mean", float(np.mean(vals)) if numeric and len(vals) else float("nan"))
+                put("stddev", float(np.std(vals, ddof=1)) if numeric and len(vals) > 1 else float("nan"))
+                put("min", float(np.min(vals)) if numeric and len(vals) else float("nan"))
+                put("max", float(np.max(vals)) if numeric and len(vals) else float("nan"))
+            if self.get("sample"):
+                put("variance", float(np.var(vals, ddof=1)) if numeric and len(vals) > 1 else float("nan"))
+                put("skewness", _skew(vals) if numeric and len(vals) > 2 else float("nan"))
+                put("kurtosis", _kurt(vals) if numeric and len(vals) > 3 else float("nan"))
+            if self.get("percentiles"):
+                for q in self._PCTS:
+                    put(f"p{q:g}", float(np.percentile(vals, q)) if numeric and len(vals)
+                        else float("nan"))
+        out = {"feature": _as_column(rows["feature"])}
+        for s in stats_order:
+            out[s] = _as_column(rows[s])
+        return DataFrame([out])
+
+
+def _isnan(col: np.ndarray) -> np.ndarray:
+    if col.dtype != object and np.issubdtype(col.dtype, np.floating):
+        return np.isnan(col)
+    return np.zeros(len(col), dtype=bool)
+
+
+def _skew(v: np.ndarray) -> float:
+    m, s = np.mean(v), np.std(v)
+    return float(np.mean(((v - m) / s) ** 3)) if s > 0 else 0.0
+
+
+def _kurt(v: np.ndarray) -> float:
+    m, s = np.mean(v), np.std(v)
+    return float(np.mean(((v - m) / s) ** 4) - 3.0) if s > 0 else 0.0

@@ -1,0 +1,70 @@
+"""Text normalization stages (reference ``core/.../stages/TextPreprocessor.scala``
+and ``UnicodeNormalize.scala``)."""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, TypeConverters
+from ..core.pipeline import Transformer
+
+__all__ = ["TextPreprocessor", "UnicodeNormalize"]
+
+
+class TextPreprocessor(Transformer):
+    """Longest-match substring replacement over a map (the reference builds a
+    char trie for the same longest-match semantics), then optional lowercase."""
+
+    input_col = Param("input_col", "text column", default="text")
+    output_col = Param("output_col", "output column", default="processed")
+    map = Param("map", "substring -> replacement mapping", default={})
+    normalize_case = Param("normalize_case", "lowercase after replacement", default=True,
+                           converter=TypeConverters.to_bool)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        mapping = dict(self.get("map") or {})
+        # longest-first alternation == trie longest-match
+        pattern = (re.compile("|".join(re.escape(k) for k in
+                                       sorted(mapping, key=len, reverse=True)))
+                   if mapping else None)
+        lower = self.get("normalize_case")
+
+        def clean(text: str) -> str:
+            s = str(text)
+            if pattern is not None:
+                s = pattern.sub(lambda m: mapping[m.group(0)], s)
+            return s.lower() if lower else s
+
+        def per_part(p):
+            col = p[self.get("input_col")]
+            out = np.empty(len(col), dtype=object)
+            out[:] = [clean(t) for t in col]
+            return out
+
+        return df.with_column(self.get("output_col"), per_part)
+
+
+class UnicodeNormalize(Transformer):
+    form = Param("form", "unicode normal form NFC|NFD|NFKC|NFKD", default="NFKD",
+                 validator=lambda v: v in ("NFC", "NFD", "NFKC", "NFKD"))
+    input_col = Param("input_col", "text column", default="text")
+    output_col = Param("output_col", "output column", default="normalized")
+    lower = Param("lower", "lowercase output", default=True, converter=TypeConverters.to_bool)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        form, lower = self.get("form"), self.get("lower")
+
+        def per_part(p):
+            col = p[self.get("input_col")]
+            out = np.empty(len(col), dtype=object)
+            out[:] = [unicodedata.normalize(form, str(t)).lower() if lower
+                      else unicodedata.normalize(form, str(t)) for t in col]
+            return out
+
+        return df.with_column(self.get("output_col"), per_part)

@@ -16,7 +16,6 @@ import numpy as np
 
 from ..core import DataFrame, Estimator, Model
 from ..core.params import ComplexParam, Param, TypeConverters
-from .featurizer import pack_sparse
 from .learner import LinearConfig, linear_predict, train_linear
 
 __all__ = ["VowpalWabbitContextualBandit", "VowpalWabbitContextualBanditModel"]

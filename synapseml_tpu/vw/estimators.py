@@ -100,7 +100,11 @@ class VowpalWabbitClassifier(Estimator, _VWBaseParams):
 
     feature_name = "vw"
 
-    loss_function = Param("loss_function", "logistic | hinge", default="logistic")
+    # the reference forces --loss_function logistic for the classifier
+    # (VowpalWabbitClassifier.scala:25); the probability column is sigmoid(margin),
+    # which is only calibrated for logistic loss, so other losses are rejected
+    loss_function = Param("loss_function", "logistic", default="logistic",
+                          validator=lambda v: v == "logistic")
     probability_col = Param("probability_col", "probability output column",
                             default="probability")
     raw_prediction_col = Param("raw_prediction_col", "margin output column",
@@ -179,27 +183,27 @@ def parse_vw_line(line: str, num_bits: int):
     weight = float(head[1]) if len(head) > 1 else 1.0
     feats: list[tuple[int, float]] = []
     for section in rest.split("|"):
-        section = section.strip()
-        if not section:
+        if not section.strip():
             continue
+        # VW: a namespace is flush against the bar ("|ns f"); a space after the
+        # bar ("| f") means default namespace. split('|') preserves the leading
+        # space, so inspect it before tokenizing.
+        has_ns = not section[0].isspace()
         toks = section.split()
-        if toks[0].endswith(":") or ":" not in toks[0] and section[0] != " " and not _is_feature_first(section):
-            ns, toks = toks[0], toks[1:]
-        else:
-            ns = ""
+        ns, ns_scale = "", 1.0
+        if has_ns:
+            ns_tok, toks = toks[0], toks[1:]
+            ns, _, scale_s = ns_tok.partition(":")
+            if scale_s:
+                ns_scale = float(scale_s)
         for tok in toks:
             m = _FEAT_RE.fullmatch(tok)
             if not m:
                 continue
             name, v = m.group(1), m.group(2)
-            feats.append((hash_feature(name, ns, num_bits), float(v) if v else 1.0))
+            feats.append((hash_feature(name, ns, num_bits),
+                          (float(v) if v else 1.0) * ns_scale))
     return label, weight, feats
-
-
-def _is_feature_first(section: str) -> bool:
-    # "| f1:1 f2" (no namespace) vs "|ns f1:1": VW puts the namespace flush
-    # after the bar; our caller splits on '|' so a leading space means no ns
-    return False
 
 
 class VowpalWabbitGeneric(Estimator, _VWBaseParams):

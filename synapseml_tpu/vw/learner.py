@@ -53,8 +53,9 @@ def _loss_grad(loss: str, pred: jax.Array, y: jax.Array, tau: float) -> jax.Arra
     if loss == "hinge":
         return jnp.where(y * pred < 1.0, -y, 0.0)
     if loss == "quantile":
+        # pinball loss: L = (1-tau)*(pred-y) if pred>y else tau*(y-pred)
         e = pred - y
-        return jnp.where(e >= 0, tau, tau - 1.0)
+        return jnp.where(e >= 0, 1.0 - tau, -tau)
     raise ValueError(f"unknown loss {loss!r}; pick from {LOSSES}")
 
 
@@ -96,6 +97,9 @@ def train_linear(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
     """Train and return the weight vector (2^bits,) as numpy."""
     n = indices.shape[0]
     dim = 1 << cfg.num_bits
+    if initial_weights is not None and np.shape(initial_weights) != (dim,):
+        raise ValueError(f"initial_weights shape {np.shape(initial_weights)} != "
+                         f"({dim},) implied by num_bits={cfg.num_bits}")
     w = (jnp.asarray(initial_weights, jnp.float32) if initial_weights is not None
          else jnp.zeros(dim, jnp.float32))
     acc = jnp.full(dim, 1e-8, jnp.float32)

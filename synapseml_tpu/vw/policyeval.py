@@ -87,15 +87,17 @@ def cressie_read(weights: np.ndarray, rewards: np.ndarray,
         # boundary matching d0's sign; if no crossing, the EL solution is at
         # the boundary (e.g. all w >= 1 -> mass concentrates on w == min)
         lo, hi = (0.0, hi_bound) if d0 > 0 else (lo_bound, 0.0)
-        if _el_dual(w, lo) * _el_dual(w, hi) > 0:
+        f_lo = _el_dual(w, lo)
+        if f_lo * _el_dual(w, hi) > 0:
             lam = hi if d0 > 0 else lo
         else:
             for _ in range(100):
                 mid = 0.5 * (lo + hi)
-                if _el_dual(w, lo) * _el_dual(w, mid) <= 0:
+                f_mid = _el_dual(w, mid)
+                if f_lo * f_mid <= 0:
                     hi = mid
                 else:
-                    lo = mid
+                    lo, f_lo = mid, f_mid
             lam = 0.5 * (lo + hi)
     p = 1.0 / (1.0 + lam * (w - 1.0))
     p = p / p.sum()

@@ -1,0 +1,184 @@
+"""Stage transformer tests (reference suites: MiniBatchTransformerSuite etc.)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame, Pipeline
+from synapseml_tpu.stages import (
+    Cacher,
+    ClassBalancer,
+    DropColumns,
+    DynamicMiniBatchTransformer,
+    EnsembleByKey,
+    Explode,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    Lambda,
+    MultiColumnAdapter,
+    PartitionConsolidator,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    SummarizeData,
+    TextPreprocessor,
+    TimeIntervalMiniBatchTransformer,
+    Timer,
+    UDFTransformer,
+    UnicodeNormalize,
+)
+
+
+@pytest.fixture()
+def df():
+    return DataFrame.from_dict(
+        {"a": np.arange(10, dtype=np.float32), "b": np.arange(10, 20, dtype=np.int32)},
+        num_partitions=2,
+    )
+
+
+def test_fixed_minibatch_roundtrip(df):
+    batched = FixedMiniBatchTransformer(batch_size=3).transform(df)
+    # 2 partitions of 5 rows -> [3,2] batches each
+    assert batched.count() == 4
+    sizes = [len(x) for x in batched.collect_column("a")]
+    assert sizes == [3, 2, 3, 2]
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_array_equal(flat.collect_column("a"), df.collect_column("a"))
+    np.testing.assert_array_equal(flat.collect_column("b"), df.collect_column("b"))
+
+
+def test_dynamic_and_interval_minibatch(df):
+    d = DynamicMiniBatchTransformer().transform(df)
+    assert d.count() == 2  # one batch per partition
+    capped = DynamicMiniBatchTransformer(max_batch_size=4).transform(df)
+    assert [len(x) for x in capped.collect_column("a")] == [4, 1, 4, 1]
+    t = TimeIntervalMiniBatchTransformer(max_batch_size=5).transform(df)
+    assert t.count() == 2
+
+
+def test_interval_batch_stream():
+    t = TimeIntervalMiniBatchTransformer(millis_to_wait=10_000, max_batch_size=2)
+    rows = [{"x": i} for i in range(5)]
+    out = list(t.batch_stream(iter(rows)))
+    assert [len(b["x"]) for b in out] == [2, 2, 1]
+
+
+def test_lambda_and_udf(df):
+    lam = Lambda(lambda d: d.with_column("c", lambda p: p["a"] * 2))
+    out = lam.transform(df)
+    np.testing.assert_array_equal(out.collect_column("c"), df.collect_column("a") * 2)
+
+    udf = UDFTransformer(input_col="a", output_col="sq", udf=lambda a: a**2)
+    np.testing.assert_array_equal(udf.transform(df).collect_column("sq"),
+                                  df.collect_column("a") ** 2)
+    udf2 = UDFTransformer(input_cols=["a", "b"], output_col="s", vectorized=False,
+                          udf=lambda a, b: float(a + b))
+    np.testing.assert_allclose(udf2.transform(df).collect_column("s"),
+                               df.collect_column("a") + df.collect_column("b"))
+
+
+def test_column_stages(df):
+    assert SelectColumns(cols=["a"]).transform(df).columns == ["a"]
+    assert DropColumns(cols=["a"]).transform(df).columns == ["b"]
+    assert "z" in RenameColumn(input_col="a", output_col="z").transform(df).columns
+    assert Repartition(n=5).transform(df).num_partitions == 5
+    assert Cacher().transform(df) is df
+    assert PartitionConsolidator(num_hosts=1).transform(df).num_partitions == 1
+
+
+def test_explode():
+    df = DataFrame.from_dict({"k": np.array([1, 2]),
+                              "v": [[1, 2, 3], [4]]})
+    out = Explode(input_col="v", output_col="e").transform(df)
+    np.testing.assert_array_equal(out.collect_column("k"), [1, 1, 1, 2])
+    np.testing.assert_array_equal(out.collect_column("e"), [1, 2, 3, 4])
+
+
+def test_ensemble_by_key():
+    df = DataFrame.from_dict({"k": np.array([0, 0, 1, 1]),
+                              "score": np.array([1.0, 3.0, 5.0, 7.0])})
+    out = EnsembleByKey(keys=["k"], cols=["score"]).transform(df)
+    got = dict(zip(out.collect_column("k"), out.collect_column("mean(score)")))
+    assert got[0] == 2.0 and got[1] == 6.0
+    broad = EnsembleByKey(keys=["k"], cols=["score"], collapse_group=False).transform(df)
+    assert broad.count() == 4
+    np.testing.assert_allclose(broad.collect_column("mean(score)"), [2, 2, 6, 6])
+
+
+def test_stratified_repartition():
+    labels = np.array([0] * 8 + [1] * 2)
+    df = DataFrame.from_dict({"label": labels, "x": np.arange(10)}, num_partitions=2)
+    out = StratifiedRepartition(label_col="label").transform(df)
+    for p in out.partitions:
+        assert set(np.unique(p["label"])) == {0, 1}
+    eq = StratifiedRepartition(label_col="label", mode="equal").transform(df)
+    _, counts = np.unique(eq.collect_column("label"), return_counts=True)
+    assert counts[0] == counts[1] == 8
+
+
+def test_timer(df, capsys):
+    t = Timer(stage=ClassBalancer(input_col="b"))
+    model = t.fit(df)
+    out = model.transform(df)
+    assert "weight" in out.columns
+    assert "[Timer]" in capsys.readouterr().out
+
+
+def test_class_balancer():
+    df = DataFrame.from_dict({"label": np.array([0, 0, 0, 1])})
+    model = ClassBalancer(input_col="label").fit(df)
+    np.testing.assert_allclose(model.transform(df).collect_column("weight"),
+                               [1.0, 1.0, 1.0, 3.0])
+
+
+def test_text_stages():
+    df = DataFrame.from_dict({"text": ["Hello WORLD", "café Bad"]})
+    out = TextPreprocessor(map={"Bad": "good"}, input_col="text",
+                           output_col="clean").transform(df)
+    assert list(out.collect_column("clean")) == ["hello world", "café good"]
+    norm = UnicodeNormalize(form="NFC", input_col="text", output_col="n").transform(df)
+    assert list(norm.collect_column("n"))[1].startswith("café")
+
+
+def test_multi_column_adapter(df):
+    from synapseml_tpu.stages.basic import UDFTransformer
+
+    base = UDFTransformer(udf=lambda a: a * 10)
+    adapter = MultiColumnAdapter(base_stage=base, input_cols=["a", "b"],
+                                 output_cols=["a10", "b10"])
+    out = adapter.fit(df).transform(df)
+    np.testing.assert_allclose(out.collect_column("a10"), df.collect_column("a") * 10)
+    np.testing.assert_allclose(out.collect_column("b10"), df.collect_column("b") * 10)
+
+
+def test_summarize_data():
+    df = DataFrame.from_dict({"x": np.array([1.0, 2.0, 3.0, np.nan]),
+                              "s": ["a", "b", "b", "c"]})
+    out = SummarizeData().transform(df).to_pandas().set_index("feature")
+    assert out.loc["x", "count"] == 4
+    assert out.loc["x", "missing_value_count"] == 1
+    np.testing.assert_allclose(out.loc["x", "mean"], 2.0)
+    np.testing.assert_allclose(out.loc["x", "p50"], 2.0)
+    assert out.loc["s", "unique_value_count"] == 3
+    counts_only = SummarizeData(basic=False, sample=False, percentiles=False).transform(df)
+    assert set(counts_only.columns) == {"feature", "count", "unique_value_count",
+                                        "missing_value_count"}
+
+
+def test_stage_serialization_roundtrip(df, tmp_path):
+    stage = FixedMiniBatchTransformer(batch_size=4)
+    stage.save(str(tmp_path / "fmb"))
+    from synapseml_tpu.core import load_stage
+
+    loaded = load_stage(str(tmp_path / "fmb"))
+    assert loaded.get("batch_size") == 4
+    pipe = Pipeline(stages=[SelectColumns(cols=["a"]),
+                            FixedMiniBatchTransformer(batch_size=2), FlattenBatch()])
+    model = pipe.fit(df)
+    model.save(str(tmp_path / "pipe"))
+    from synapseml_tpu.core import PipelineModel
+
+    reloaded = PipelineModel.load(str(tmp_path / "pipe"))
+    np.testing.assert_array_equal(reloaded.transform(df).collect_column("a"),
+                                  df.collect_column("a"))

@@ -121,18 +121,18 @@ class TestLearners:
         assert a2 >= a1 - 0.02
 
     def test_quantile_loss(self):
+        # constant-feature fit converges to the tau quantile of the labels
         rng = np.random.default_rng(5)
-        x = rng.normal(size=800)
-        y = x + rng.exponential(1.0, size=800)
-        df = DataFrame.from_dict({"x": x, "label": y})
-        fdf = VowpalWabbitFeaturizer(input_cols=["x"]).transform(df)
-        cfg = LinearConfig(loss="quantile", quantile_tau=0.9, num_passes=8,
-                           learning_rate=0.3)
-        idx = np.asarray(fdf.collect_column("features_indices"))
-        val = np.asarray(fdf.collect_column("features_values"))
-        w = train_linear(idx, val, y.astype(np.float32), cfg)
-        # the q90 fit should sit above the mean fit
-        assert (w != 0).sum() > 0
+        y = rng.normal(size=1200).astype(np.float32)
+        n = len(y)
+        idx = np.zeros((n, 1), np.int32)
+        val = np.ones((n, 1), np.float32)
+        for tau in (0.1, 0.9):
+            cfg = LinearConfig(loss="quantile", quantile_tau=tau, num_passes=40,
+                               learning_rate=0.5, adaptive=False, seed=1)
+            w = train_linear(idx, val, y, cfg)
+            target = np.quantile(y, tau)
+            assert abs(float(w[0]) - target) < 0.25, (tau, float(w[0]), target)
 
     def test_generic_vw_text(self):
         rng = np.random.default_rng(6)
