@@ -1,0 +1,11 @@
+"""AutoML (reference ``core/.../automl/``, SURVEY.md §2.5): parallel
+hyperparameter search and best-model selection."""
+
+from .hyperparams import (  # noqa: F401
+    DiscreteHyperParam,
+    GridSpace,
+    HyperparamBuilder,
+    RandomSpace,
+    RangeHyperParam,
+)
+from .tune import BestModel, FindBestModel, FindBestModelResult, TuneHyperparameters  # noqa: F401

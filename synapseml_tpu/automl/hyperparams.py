@@ -1,0 +1,78 @@
+"""Hyperparameter spaces (reference ``automl/HyperparamBuilder.scala`` +
+``DefaultHyperparams.scala``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DiscreteHyperParam", "RangeHyperParam", "HyperparamBuilder",
+           "GridSpace", "RandomSpace"]
+
+
+class DiscreteHyperParam:
+    def __init__(self, values):
+        self.values = list(values)
+
+    def sample(self, rng: np.random.Generator):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+    def grid(self):
+        return list(self.values)
+
+
+class RangeHyperParam:
+    def __init__(self, low, high, log: bool = False, integer: bool | None = None):
+        self.low, self.high, self.log = low, high, log
+        self.integer = (isinstance(low, int) and isinstance(high, int)
+                        if integer is None else integer)
+
+    def sample(self, rng: np.random.Generator):
+        if self.log:
+            v = float(np.exp(rng.uniform(np.log(self.low), np.log(self.high))))
+        else:
+            v = float(rng.uniform(self.low, self.high))
+        return int(round(v)) if self.integer else v
+
+    def grid(self, n: int = 5):
+        if self.log:
+            vals = np.exp(np.linspace(np.log(self.low), np.log(self.high), n))
+        else:
+            vals = np.linspace(self.low, self.high, n)
+        return [int(round(v)) for v in vals] if self.integer else [float(v) for v in vals]
+
+
+class HyperparamBuilder:
+    """Collects param-name -> space mappings (ref ``HyperparamBuilder.scala``)."""
+
+    def __init__(self):
+        self._space: dict[str, object] = {}
+
+    def add_hyperparam(self, name: str, space) -> "HyperparamBuilder":
+        self._space[name] = space
+        return self
+
+    def build(self) -> dict:
+        return dict(self._space)
+
+
+class GridSpace:
+    """Cartesian product of every space's grid()."""
+
+    def __init__(self, space: dict):
+        self.space = space
+
+    def configs(self) -> list[dict]:
+        import itertools
+
+        names = list(self.space)
+        grids = [self.space[n].grid() for n in names]
+        return [dict(zip(names, combo)) for combo in itertools.product(*grids)]
+
+
+class RandomSpace:
+    def __init__(self, space: dict, seed: int = 0):
+        self.space = space
+        self.rng = np.random.default_rng(seed)
+
+    def configs(self, n: int) -> list[dict]:
+        return [{k: v.sample(self.rng) for k, v in self.space.items()} for _ in range(n)]

@@ -1,0 +1,145 @@
+"""TuneHyperparameters / FindBestModel
+(reference ``automl/TuneHyperparameters.scala:38``, ``FindBestModel.scala:53``).
+
+Parallelism note: candidate fits run on a thread pool — each fit dispatches its
+own XLA programs, and the TPU runtime serializes device work while the host
+side (binning, featurization, data prep) overlaps, mirroring the reference's
+parallel fits across a Spark cluster."""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..train.statistics import ComputeModelStatistics
+
+__all__ = ["TuneHyperparameters", "BestModel", "FindBestModel", "FindBestModelResult"]
+
+_METRIC_DIRECTION = {"accuracy": 1, "precision": 1, "recall": 1, "AUC": 1, "R^2": 1,
+                     "mean_squared_error": -1, "root_mean_squared_error": -1,
+                     "mean_absolute_error": -1}
+
+
+def _evaluate(model, df: DataFrame, metric: str, label_col: str) -> float:
+    scored = model.transform(df)
+    pred_col = "prediction" if "prediction" in scored.columns else scored.columns[-1]
+    kind = ("regression" if metric in ("mean_squared_error", "root_mean_squared_error",
+                                       "mean_absolute_error", "R^2") else "classification")
+    stats = ComputeModelStatistics(
+        label_col=label_col, scores_col=pred_col, evaluation_metric=kind,
+        scored_probabilities_col="probability" if "probability" in scored.columns else None,
+    ).transform(scored)
+    return float(stats.collect_column(metric)[0])
+
+
+class BestModel(Model):
+    best_model = ComplexParam("best_model", "winning fitted model")
+    best_params = ComplexParam("best_params", "winning hyperparameter dict")
+    best_metric = Param("best_metric", "winning validation metric value",
+                        converter=TypeConverters.to_float)
+    all_results = ComplexParam("all_results", "list of (params, metric) tuples")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return self.get("best_model").transform(df)
+
+
+class TuneHyperparameters(Estimator):
+    """Random/grid search over (possibly several) learners
+    (ref ``TuneHyperparameters.scala:38``)."""
+
+    feature_name = "automl"
+
+    models = ComplexParam("models", "list of candidate Estimators")
+    hyperparam_space = ComplexParam("hyperparam_space",
+                                    "dict name->space, or list aligned with models")
+    search_mode = Param("search_mode", "random | grid", default="random",
+                        validator=lambda v: v in ("random", "grid"))
+    num_runs = Param("num_runs", "samples for random search", default=8,
+                     converter=TypeConverters.to_int)
+    parallelism = Param("parallelism", "concurrent fits", default=4,
+                        converter=TypeConverters.to_int)
+    evaluation_metric = Param("evaluation_metric", "metric name", default="accuracy")
+    label_col = Param("label_col", "label column", default="label")
+    validation_fraction = Param("validation_fraction", "holdout fraction", default=0.25,
+                                converter=TypeConverters.to_float)
+    seed = Param("seed", "search seed", default=0, converter=TypeConverters.to_int)
+
+    def _fit(self, df: DataFrame) -> BestModel:
+        from .hyperparams import GridSpace, RandomSpace
+
+        models = self.get("models")
+        if not isinstance(models, (list, tuple)):
+            models = [models]
+        spaces = self.get("hyperparam_space")
+        if isinstance(spaces, dict):
+            spaces = [spaces] * len(models)
+        train, valid = df.random_split(
+            [1 - self.get("validation_fraction"), self.get("validation_fraction")],
+            seed=self.get("seed"))
+        metric = self.get("evaluation_metric")
+        direction = _METRIC_DIRECTION.get(metric, 1)
+
+        candidates: list[tuple[Estimator, dict]] = []
+        for mi, (m, space) in enumerate(zip(models, spaces)):
+            if self.get("search_mode") == "grid":
+                configs = GridSpace(space).configs()
+            else:
+                configs = RandomSpace(space, seed=self.get("seed") + mi).configs(
+                    self.get("num_runs"))
+            candidates.extend((m, c) for c in configs)
+
+        def run(pair):
+            est, cfg = pair
+            try:
+                model = est.copy(cfg).fit(train)
+                return model, cfg, _evaluate(model, valid, metric, self.get("label_col"))
+            except Exception as e:  # a bad config must not sink the sweep
+                return None, dict(cfg, __error__=f"{type(e).__name__}: {e}"), float("nan")
+
+        with ThreadPoolExecutor(max_workers=self.get("parallelism")) as pool:
+            results = list(pool.map(run, candidates))
+        scored = [(m, c, v) for m, c, v in results if m is not None and np.isfinite(v)]
+        if not scored:
+            errors = {c["__error__"] for _, c, _ in results if "__error__" in c}
+            raise RuntimeError("TuneHyperparameters: every candidate failed; "
+                               f"causes: {sorted(errors)}")
+        best = max(scored, key=lambda t: direction * t[2])
+        return BestModel(best_model=best[0], best_params=best[1], best_metric=best[2],
+                         all_results=[(c, v) for _, c, v in results])
+
+
+class FindBestModelResult(Model):
+    best_model = ComplexParam("best_model", "winning fitted model")
+    all_model_metrics = ComplexParam("all_model_metrics", "list of (name, metric)")
+    best_metric = Param("best_metric", "winning metric", converter=TypeConverters.to_float)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return self.get("best_model").transform(df)
+
+
+class FindBestModel(Estimator):
+    """Pick the best among already-specified models by eval metric
+    (ref ``FindBestModel.scala:53``). Models may be fitted Transformers
+    (evaluated directly) or Estimators (fitted first)."""
+
+    feature_name = "automl"
+
+    models = ComplexParam("models", "candidate models")
+    evaluation_metric = Param("evaluation_metric", "metric name", default="accuracy")
+    label_col = Param("label_col", "label column", default="label")
+
+    def _fit(self, df: DataFrame) -> FindBestModelResult:
+        metric = self.get("evaluation_metric")
+        direction = _METRIC_DIRECTION.get(metric, 1)
+        results = []
+        for m in self.get("models"):
+            fitted = m.fit(df) if isinstance(m, Estimator) else m
+            results.append((fitted, _evaluate(fitted, df, metric, self.get("label_col"))))
+        best = max(results, key=lambda t: direction * t[1])
+        return FindBestModelResult(
+            best_model=best[0], best_metric=best[1],
+            all_model_metrics=[(type(m).__name__, v) for m, v in results])

@@ -24,9 +24,14 @@ from typing import Any, Callable, Iterable, Mapping, Sequence
 
 import numpy as np
 
-__all__ = ["DataFrame", "Partition", "schema_of", "concat_partitions"]
+__all__ = ["DataFrame", "Partition", "schema_of", "concat_partitions", "scalar_of"]
 
 Partition = dict  # name -> np.ndarray, all the same length
+
+
+def scalar_of(v: Any) -> Any:
+    """Unwrap numpy scalars to python scalars (stable dict keys / comparisons)."""
+    return v.item() if isinstance(v, np.generic) else v
 
 
 def _as_column(values: Any, n: int | None = None) -> np.ndarray:

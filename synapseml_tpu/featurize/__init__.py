@@ -1,0 +1,17 @@
+"""Auto-featurization (reference ``core/.../featurize/``, SURVEY.md §2.5).
+
+Turns heterogeneous DataFrame columns into the dense float32 matrix columns the
+TPU estimators consume (``features`` ndarray column), replacing SparkML's
+VectorAssembler-based sparse pipeline with direct columnar assembly.
+"""
+
+from .clean import CleanMissingData, CleanMissingDataModel, DataConversion  # noqa: F401
+from .indexers import (  # noqa: F401
+    CountSelector,
+    CountSelectorModel,
+    IndexToValue,
+    ValueIndexer,
+    ValueIndexerModel,
+)
+from .featurize import Featurize, FeaturizeModel  # noqa: F401
+from .text import MultiNGram, PageSplitter, TextFeaturizer, TextFeaturizerModel  # noqa: F401

@@ -1,0 +1,107 @@
+"""Missing-data cleaning and type conversion
+(reference ``featurize/CleanMissingData.scala:51``, ``DataConversion.scala``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, _as_column
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model, Transformer
+
+__all__ = ["CleanMissingData", "CleanMissingDataModel", "DataConversion"]
+
+
+class CleanMissingDataModel(Model):
+    input_cols = Param("input_cols", "columns to clean", converter=TypeConverters.to_list)
+    output_cols = Param("output_cols", "cleaned output columns", converter=TypeConverters.to_list)
+    fill_values = ComplexParam("fill_values", "column -> replacement value")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        fills = self.get("fill_values")
+        out = df
+        for src, dst in zip(self.get("input_cols"), self.get("output_cols")):
+            self.require_columns(df, src)
+            fill = fills[src]
+
+            def repl(p, _src=src, _fill=fill):
+                col = np.asarray(p[_src], dtype=np.float64)
+                return np.where(np.isnan(col), _fill, col)
+
+            out = out.with_column(dst, repl)
+        return out
+
+
+class CleanMissingData(Estimator):
+    """Impute NaNs with mean/median/custom (ref ``CleanMissingData.scala:51``)."""
+
+    input_cols = Param("input_cols", "columns to clean", converter=TypeConverters.to_list)
+    output_cols = Param("output_cols", "cleaned output columns (default: in place)",
+                        converter=TypeConverters.to_list)
+    cleaning_mode = Param("cleaning_mode", "Mean | Median | Custom", default="Mean",
+                          validator=lambda v: v in ("Mean", "Median", "Custom"))
+    custom_value = Param("custom_value", "replacement for Custom mode",
+                         converter=TypeConverters.to_float)
+
+    def _fit(self, df: DataFrame) -> CleanMissingDataModel:
+        ins = self.get("input_cols")
+        outs = self.get("output_cols") or ins
+        self.require_columns(df, *ins)
+        mode = self.get("cleaning_mode")
+        fills: dict[str, float] = {}
+        for c in ins:
+            if mode == "Custom":
+                fills[c] = float(self.get("custom_value"))
+                continue
+            col = np.asarray(df.collect_column(c), dtype=np.float64)
+            valid = col[~np.isnan(col)]
+            if len(valid) == 0:
+                fills[c] = 0.0
+            elif mode == "Mean":
+                fills[c] = float(np.mean(valid))
+            else:
+                fills[c] = float(np.median(valid))
+        return CleanMissingDataModel(input_cols=ins, output_cols=outs, fill_values=fills)
+
+
+_CONVERTERS = {
+    "boolean": lambda c: np.asarray(c).astype(bool),
+    "byte": lambda c: np.asarray(c).astype(np.int8),
+    "short": lambda c: np.asarray(c).astype(np.int16),
+    "integer": lambda c: np.asarray(c).astype(np.int32),
+    "long": lambda c: np.asarray(c).astype(np.int64),
+    "float": lambda c: np.asarray(c).astype(np.float32),
+    "double": lambda c: np.asarray(c).astype(np.float64),
+    "string": lambda c: _as_column([str(v) for v in c]),
+    "toCategorical": None,  # handled via ValueIndexer
+    "clearCategorical": None,
+}
+
+
+class DataConversion(Transformer):
+    """Cast columns to a named type (ref ``featurize/DataConversion.scala``);
+    date handling reduced to numeric epoch casts."""
+
+    cols = Param("cols", "columns to convert", converter=TypeConverters.to_list)
+    convert_to = Param("convert_to", "target type: " + "|".join(k for k in _CONVERTERS),
+                       default="double")
+    date_time_format = Param("date_time_format", "accepted for parity", default=None)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        target = self.get("convert_to")
+        if target in ("toCategorical", "clearCategorical"):
+            from .indexers import ValueIndexer
+
+            out = df
+            if target == "toCategorical":
+                for c in self.get("cols"):
+                    out = ValueIndexer(input_col=c, output_col=c).fit(out).transform(out)
+            return out
+        conv = _CONVERTERS.get(target)
+        if conv is None:
+            raise ValueError(f"unknown convert_to {target!r}")
+        out = df
+        for c in self.get("cols"):
+            self.require_columns(df, c)
+            out = out.with_column(c, lambda p, _c=c: conv(p[_c]))
+        return out

@@ -1,0 +1,124 @@
+"""Featurize — auto-assembly of mixed columns into one dense float32 matrix.
+
+Reference ``featurize/Featurize.scala:35``: imputation + one-hot (low-cardinality
+strings/categoricals) + hashing (high-cardinality strings) + vector assembly.
+TPU-native difference: output is a dense ``(n, d)`` float32 ndarray column
+(``features``) that maps straight into HBM, not a SparkML sparse vector.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, scalar_of as _scalar
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..vw.hashing import hash_feature
+
+__all__ = ["Featurize", "FeaturizeModel"]
+
+
+class FeaturizeModel(Model):
+    input_cols = Param("input_cols", "source columns", converter=TypeConverters.to_list)
+    output_col = Param("output_col", "assembled matrix column", default="features")
+    plan = ComplexParam("plan", "per-column featurization plan")
+    num_features = Param("num_features", "hash bucket count (power of two)",
+                         validator=lambda v: v > 0 and (v & (v - 1)) == 0,
+                         default=262144, converter=TypeConverters.to_int)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        plan = self.get("plan")
+        self.require_columns(df, *self.get("input_cols"))
+        nbits = int(np.log2(self.get("num_features")))
+
+        def per_part(p):
+            n = len(next(iter(p.values())))
+            blocks: list[np.ndarray] = []
+            for c in self.get("input_cols"):
+                spec = plan[c]
+                col = p[c]
+                kind = spec["kind"]
+                if kind == "numeric":
+                    v = np.asarray(col, np.float64)
+                    v = np.where(np.isnan(v), spec["fill"], v)
+                    blocks.append(v[:, None].astype(np.float32))
+                elif kind == "matrix":
+                    mat = np.asarray(np.stack(list(col)), np.float32)
+                    mat = np.where(np.isnan(mat), 0.0, mat)
+                    blocks.append(mat.reshape(n, -1))
+                elif kind == "onehot":
+                    levels = {v: i for i, v in enumerate(spec["levels"])}
+                    out = np.zeros((n, len(levels)), np.float32)
+                    for i, v in enumerate(col):
+                        j = levels.get(_scalar(v))
+                        if j is not None:
+                            out[i, j] = 1.0
+                    blocks.append(out)
+                elif kind == "hash":
+                    out = np.zeros((n, self.get("num_features")), np.float32)
+                    for i, v in enumerate(col):
+                        for tok in str(v).split():
+                            out[i, hash_feature(tok, c, nbits)] += 1.0
+                    blocks.append(out)
+                else:  # pragma: no cover
+                    raise ValueError(f"unknown plan kind {kind}")
+            return np.concatenate(blocks, axis=1) if blocks else np.zeros((n, 0), np.float32)
+
+        return df.with_column(self.get("output_col"), per_part)
+
+    @property
+    def feature_dim(self) -> int:
+        plan = self.get("plan")
+        d = 0
+        for c in self.get("input_cols"):
+            spec = plan[c]
+            d += {"numeric": 1, "matrix": spec.get("dim", 0),
+                  "onehot": len(spec.get("levels", [])),
+                  "hash": self.get("num_features")}[spec["kind"]]
+        return d
+
+
+class Featurize(Estimator):
+    """Auto-featurization estimator (ref ``Featurize.scala:35``)."""
+
+    input_cols = Param("input_cols", "source columns", converter=TypeConverters.to_list)
+    output_col = Param("output_col", "assembled matrix column", default="features")
+    one_hot_encode_categoricals = Param("one_hot_encode_categoricals",
+                                        "one-hot low-cardinality strings", default=True,
+                                        converter=TypeConverters.to_bool)
+    num_features = Param("num_features", "hash buckets for high-cardinality strings "
+                         "(power of two)", default=256, converter=TypeConverters.to_int,
+                         validator=lambda v: v > 0 and (v & (v - 1)) == 0)
+    impute_missing = Param("impute_missing", "impute numeric NaNs with the mean",
+                           default=True, converter=TypeConverters.to_bool)
+    max_one_hot_cardinality = Param("max_one_hot_cardinality",
+                                    "string cardinality cutoff for one-hot vs hashing",
+                                    default=64, converter=TypeConverters.to_int)
+
+    def _fit(self, df: DataFrame) -> FeaturizeModel:
+        cols = self.get("input_cols")
+        self.require_columns(df, *cols)
+        plan: dict[str, dict] = {}
+        for c in cols:
+            sample = df.collect_column(c)
+            if sample.dtype != object and sample.ndim > 1:
+                plan[c] = {"kind": "matrix", "dim": int(np.prod(sample.shape[1:]))}
+            elif sample.dtype != object and np.issubdtype(sample.dtype, np.number):
+                vals = sample.astype(np.float64)
+                valid = vals[~np.isnan(vals)]
+                fill = float(np.mean(valid)) if self.get("impute_missing") and len(valid) else 0.0
+                plan[c] = {"kind": "numeric", "fill": fill}
+            else:
+                first = next((v for v in sample if v is not None), None)
+                if isinstance(first, (list, tuple, np.ndarray)):
+                    plan[c] = {"kind": "matrix", "dim": len(first)}
+                else:
+                    levels = sorted({_scalar(v) for v in sample}, key=str)
+                    if (self.get("one_hot_encode_categoricals")
+                            and len(levels) <= self.get("max_one_hot_cardinality")):
+                        plan[c] = {"kind": "onehot", "levels": levels}
+                    else:
+                        plan[c] = {"kind": "hash"}
+        return FeaturizeModel(input_cols=cols, output_col=self.get("output_col"),
+                              plan=plan, num_features=self.get("num_features"))
+

@@ -1,0 +1,112 @@
+"""Categorical indexing (reference ``featurize/ValueIndexer.scala:57``,
+``IndexToValue.scala``, ``CountSelector.scala``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, _as_column, scalar_of as _scalar
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+
+__all__ = ["ValueIndexer", "ValueIndexerModel", "IndexToValue",
+           "CountSelector", "CountSelectorModel"]
+
+
+class ValueIndexerModel(Model):
+    input_col = Param("input_col", "column to index")
+    output_col = Param("output_col", "indexed output column")
+    levels = ComplexParam("levels", "ordered distinct values; index = position")
+    unknown_index = Param("unknown_index", "index for unseen values (-1 errors)",
+                          default=-1, converter=TypeConverters.to_int)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        levels = list(self.get("levels"))
+        table = {_scalar(v): i for i, v in enumerate(levels)}
+        unk = self.get("unknown_index")
+
+        def per_part(p):
+            col = p[self.get("input_col")]
+            out = np.empty(len(col), dtype=np.int32)
+            for i, v in enumerate(col):
+                hit = table.get(_scalar(v), unk)
+                if hit < 0:
+                    raise ValueError(f"unseen level {v!r} in {self.get('input_col')} "
+                                     f"(set unknown_index to tolerate)")
+                out[i] = hit
+            return out
+
+        return df.with_column(self.get("output_col"), per_part)
+
+
+class ValueIndexer(Estimator):
+    """Learn distinct levels -> contiguous indices (ref ``ValueIndexer.scala:57``).
+    Levels sort ascending (numeric) / lexicographic (string) for determinism."""
+
+    input_col = Param("input_col", "column to index")
+    output_col = Param("output_col", "indexed output column")
+    unknown_index = Param("unknown_index", "index for unseen values at transform",
+                          default=-1, converter=TypeConverters.to_int)
+
+    def _fit(self, df: DataFrame) -> ValueIndexerModel:
+        col = self.get("input_col")
+        self.require_columns(df, col)
+        values = df.collect_column(col)
+        levels = sorted({_scalar(v) for v in values}, key=lambda v: (str(type(v)), v))
+        return ValueIndexerModel(input_col=col,
+                                 output_col=self.get("output_col") or f"{col}_indexed",
+                                 levels=levels, unknown_index=self.get("unknown_index"))
+
+
+class IndexToValue(Model):
+    """Inverse of ValueIndexerModel (ref ``featurize/IndexToValue.scala``):
+    reads levels from a fitted model or explicit list."""
+
+    input_col = Param("input_col", "index column")
+    output_col = Param("output_col", "value output column")
+    levels = ComplexParam("levels", "ordered distinct values")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        levels = list(self.get("levels"))
+
+        def per_part(p):
+            idx = np.asarray(p[self.get("input_col")], dtype=np.int64)
+            return _as_column([levels[i] for i in idx])
+
+        return df.with_column(self.get("output_col"), per_part)
+
+
+class CountSelectorModel(Model):
+    input_col = Param("input_col", "feature matrix column")
+    output_col = Param("output_col", "selected output column")
+    indices = ComplexParam("indices", "kept feature slot indices")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        keep = np.asarray(self.get("indices"), dtype=np.int64)
+        return df.with_column(
+            self.get("output_col"),
+            lambda p: np.asarray(np.stack(list(p[self.get("input_col")])), np.float32)[:, keep])
+
+
+class CountSelector(Estimator):
+    """Drop always-zero feature slots (ref ``featurize/CountSelector.scala`` —
+    CountBasedFeatureSelector on sparse vectors; here on dense matrix columns)."""
+
+    input_col = Param("input_col", "feature matrix column", default="features")
+    output_col = Param("output_col", "selected output column", default="features")
+
+    def _fit(self, df: DataFrame) -> CountSelectorModel:
+        col = self.get("input_col")
+        self.require_columns(df, col)
+        nonzero = None
+        for p in df.partitions:
+            mat = np.asarray(np.stack(list(p[col])), np.float64)
+            counts = (mat != 0).sum(axis=0)
+            nonzero = counts if nonzero is None else nonzero + counts
+        keep = np.nonzero(nonzero > 0)[0]
+        return CountSelectorModel(input_col=col, output_col=self.get("output_col"),
+                                  indices=keep)
+

@@ -1,0 +1,164 @@
+"""Text featurization (reference ``featurize/text/TextFeaturizer.scala:193``,
+``PageSplitter.scala``, ``MultiNGram.scala``).
+
+TextFeaturizer = tokenize -> ngram -> hashing-TF -> IDF, emitting a dense
+float32 matrix column sized ``num_features`` (TPU-friendly; the reference emits
+SparkML sparse vectors)."""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, _as_column
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model, Transformer
+from ..vw.hashing import hash_feature
+
+__all__ = ["TextFeaturizer", "TextFeaturizerModel", "PageSplitter", "MultiNGram"]
+
+_TOKEN_RE = re.compile(r"[A-Za-z0-9_]+")
+
+
+def _tokenize(text: str, lower: bool) -> list[str]:
+    s = str(text)
+    return _TOKEN_RE.findall(s.lower() if lower else s)
+
+
+def _ngrams(tokens: list[str], n: int) -> list[str]:
+    if n <= 1:
+        return tokens
+    return [" ".join(tokens[i : i + n]) for i in range(len(tokens) - n + 1)]
+
+
+class TextFeaturizerModel(Model):
+    input_col = Param("input_col", "text column")
+    output_col = Param("output_col", "feature matrix column")
+    num_features = Param("num_features", "hash buckets", default=4096,
+                         converter=TypeConverters.to_int)
+    n_gram_length = Param("n_gram_length", "ngram size", default=1,
+                          converter=TypeConverters.to_int)
+    to_lower_case = Param("to_lower_case", "lowercase", default=True,
+                          converter=TypeConverters.to_bool)
+    binary = Param("binary", "binary TF", default=False, converter=TypeConverters.to_bool)
+    idf = ComplexParam("idf", "per-bucket inverse document frequency (None = TF only)")
+
+    def _tf(self, texts) -> np.ndarray:
+        d = self.get("num_features")
+        nbits = int(np.log2(d))
+        out = np.zeros((len(texts), d), np.float32)
+        n = self.get("n_gram_length")
+        lower = self.get("to_lower_case")
+        for i, t in enumerate(texts):
+            for g in _ngrams(_tokenize(t, lower), n):
+                out[i, hash_feature(g, "", nbits)] += 1.0
+        if self.get("binary"):
+            out = (out > 0).astype(np.float32)
+        return out
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+
+        def per_part(p):
+            tf = self._tf(list(p[self.get("input_col")]))
+            idf = self.get("idf")
+            return tf * np.asarray(idf, np.float32) if idf is not None else tf
+
+        return df.with_column(self.get("output_col"), per_part)
+
+
+class TextFeaturizer(Estimator):
+    """(ref ``TextFeaturizer.scala:193``)"""
+
+    input_col = Param("input_col", "text column", default="text")
+    output_col = Param("output_col", "feature matrix column", default="features")
+    num_features = Param("num_features", "hash buckets (power of two)", default=4096,
+                         converter=TypeConverters.to_int,
+                         validator=lambda v: v > 0 and (v & (v - 1)) == 0)
+    n_gram_length = Param("n_gram_length", "ngram size", default=1,
+                          converter=TypeConverters.to_int)
+    to_lower_case = Param("to_lower_case", "lowercase", default=True,
+                          converter=TypeConverters.to_bool)
+    use_idf = Param("use_idf", "apply IDF weighting", default=True,
+                    converter=TypeConverters.to_bool)
+    min_doc_freq = Param("min_doc_freq", "zero buckets seen in fewer docs", default=1,
+                         converter=TypeConverters.to_int)
+    binary = Param("binary", "binary TF", default=False, converter=TypeConverters.to_bool)
+
+    def _fit(self, df: DataFrame) -> TextFeaturizerModel:
+        self.require_columns(df, self.get("input_col"))
+        model = TextFeaturizerModel(
+            input_col=self.get("input_col"), output_col=self.get("output_col"),
+            num_features=self.get("num_features"), n_gram_length=self.get("n_gram_length"),
+            to_lower_case=self.get("to_lower_case"), binary=self.get("binary"), idf=None)
+        if self.get("use_idf"):
+            texts = list(df.collect_column(self.get("input_col")))
+            tf = model._tf(texts)
+            docfreq = (tf > 0).sum(axis=0).astype(np.float64)
+            n_docs = max(len(texts), 1)
+            idf = np.log((n_docs + 1.0) / (docfreq + 1.0))  # SparkML IDF formula
+            idf[docfreq < self.get("min_doc_freq")] = 0.0
+            model.set(idf=idf.astype(np.float32))
+        return model
+
+
+class PageSplitter(Transformer):
+    """Split text into page strings within [min,max] length, preferring word
+    boundaries (ref ``featurize/text/PageSplitter.scala``)."""
+
+    input_col = Param("input_col", "text column", default="text")
+    output_col = Param("output_col", "pages (list) column", default="pages")
+    maximum_page_length = Param("maximum_page_length", "max chars per page", default=5000,
+                                converter=TypeConverters.to_int)
+    minimum_page_length = Param("minimum_page_length", "min chars before a boundary split",
+                                default=4500, converter=TypeConverters.to_int)
+    boundary_regex = Param("boundary_regex", "preferred split points", default=r"\s")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        mx, mn = self.get("maximum_page_length"), self.get("minimum_page_length")
+        brx = re.compile(self.get("boundary_regex"))
+
+        def split(text: str) -> list[str]:
+            s, pages = str(text), []
+            while len(s) > mx:
+                cut = None
+                for m in brx.finditer(s, max(mn, 1), mx):
+                    cut = m.start()
+                cut = cut if cut and cut > 0 else mx  # cut=0 would never shrink s
+                pages.append(s[:cut])
+                s = s[cut:]
+            pages.append(s)
+            return pages
+
+        def per_part(p):
+            return _as_column([split(t) for t in p[self.get("input_col")]])
+
+        return df.with_column(self.get("output_col"), per_part)
+
+
+class MultiNGram(Transformer):
+    """Token lists -> concatenated ngrams of several lengths
+    (ref ``featurize/text/MultiNGram.scala``)."""
+
+    input_col = Param("input_col", "token-list column", default="tokens")
+    output_col = Param("output_col", "ngram-list column", default="ngrams")
+    lengths = Param("lengths", "ngram sizes to include", default=[1, 2, 3],
+                    converter=TypeConverters.to_list)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        lengths = [int(x) for x in self.get("lengths")]
+
+        def per_part(p):
+            out = []
+            for toks in p[self.get("input_col")]:
+                toks = list(toks)
+                grams: list[str] = []
+                for n in lengths:
+                    grams.extend(_ngrams(toks, n))
+                out.append(grams)
+            return _as_column(out)
+
+        return df.with_column(self.get("output_col"), per_part)

@@ -7,7 +7,7 @@ from typing import Callable
 
 import numpy as np
 
-from ..core.dataframe import DataFrame, Partition, _as_column, concat_partitions
+from ..core.dataframe import DataFrame, Partition, _as_column, scalar_of as _key
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Estimator, Model, Transformer
 
@@ -130,8 +130,8 @@ class Explode(Transformer):
             reps = np.asarray([len(p[in_col][i]) for i in range(n)], dtype=np.int64)
             out: dict[str, np.ndarray] = {}
             for k, col in p.items():
-                if k == in_col:
-                    continue
+                if k == in_col and out_col == in_col:
+                    continue  # replaced by the exploded values below
                 out[k] = np.repeat(col, reps, axis=0)
             flat: list = []
             for i in range(n):
@@ -216,7 +216,7 @@ class StratifiedRepartition(Transformer):
             idx = np.nonzero(labels == v)[0]
             t = target[v]
             if t <= len(idx):
-                chosen.append(idx[:t])
+                chosen.append(rng.choice(idx, size=t, replace=False) if t < len(idx) else idx)
             else:  # upsample with replacement to equalize
                 extra = rng.choice(idx, size=t - len(idx), replace=True)
                 chosen.append(np.concatenate([idx, extra]))
@@ -290,9 +290,6 @@ class ClassBalancerModel(Model):
             self.get("output_col"),
             lambda p: np.asarray([w.get(_key(v), 1.0) for v in p[col]], dtype=np.float64))
 
-
-def _key(v):
-    return v.item() if isinstance(v, np.generic) else v
 
 
 class ClassBalancer(Estimator):

@@ -85,20 +85,15 @@ class DynamicMiniBatchTransformer(_MiniBatchBase):
         return [(lo, min(lo + b, n)) for lo in range(0, n, b)]
 
 
-class TimeIntervalMiniBatchTransformer(_MiniBatchBase):
+class TimeIntervalMiniBatchTransformer(DynamicMiniBatchTransformer):
     """Batch by wall-clock interval (ref ``MiniBatchTransformer.scala:79``).
-    Against a materialized partition all rows are already present, so this
-    degenerates to one (capped) batch — matching the reference's behavior when
-    the upstream iterator never blocks."""
+    Against a materialized partition all rows are already present, so the
+    eager path degenerates to :class:`DynamicMiniBatchTransformer`'s capped
+    batching — matching the reference when the upstream iterator never blocks.
+    ``batch_stream`` is the true streaming path used by serving."""
 
     millis_to_wait = Param("millis_to_wait", "interval to collect a batch", default=1000,
                            converter=TypeConverters.to_int)
-    max_batch_size = Param("max_batch_size", "cap on rows per batch", default=2147483647,
-                           converter=TypeConverters.to_int, validator=lambda v: v > 0)
-
-    def _spans(self, n: int) -> list[tuple[int, int]]:
-        b = min(self.get("max_batch_size"), max(n, 1))
-        return [(lo, min(lo + b, n)) for lo in range(0, n, b)]
 
     def batch_stream(self, rows: Iterable[dict]) -> Iterable[dict]:
         """Streaming path (serving): drain `rows` into interval batches."""
@@ -130,7 +125,9 @@ class FlattenBatch(Transformer):
             out: dict[str, list] = {k: [] for k in p}
             n = _n_rows(p)
             for i in range(n):
-                lens = {len(p[k][i]) for k in p if p[k][i] is not None and hasattr(p[k][i], "__len__")}
+                lens = {len(p[k][i]) for k in p
+                        if p[k][i] is not None and hasattr(p[k][i], "__len__")
+                        and not isinstance(p[k][i], (str, bytes))}
                 if len(lens) > 1:
                     raise ValueError(f"FlattenBatch: unequal batch lengths {lens} in row {i}")
                 m = lens.pop() if lens else 1

@@ -1,0 +1,5 @@
+"""Training convenience layer (reference ``core/.../train/``, SURVEY.md §2.5):
+auto-featurize + fit any learner, plus model-quality metrics."""
+
+from .train import TrainClassifier, TrainRegressor, TrainedClassifierModel, TrainedRegressorModel  # noqa: F401
+from .statistics import ComputeModelStatistics, ComputePerInstanceStatistics  # noqa: F401

@@ -1,0 +1,139 @@
+"""Model-quality metrics (reference ``train/ComputeModelStatistics.scala:58``,
+``ComputePerInstanceStatistics.scala``).
+
+Classification: accuracy, precision, recall, AUC, confusion matrix.
+Regression: MSE, RMSE, MAE, R^2. Metric math runs in numpy on the driver —
+these are reductions over a column, not MXU work."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame, _as_column
+from ..core.params import Param, TypeConverters
+from ..core.pipeline import Transformer
+
+__all__ = ["ComputeModelStatistics", "ComputePerInstanceStatistics",
+           "confusion_matrix", "roc_auc"]
+
+
+def confusion_matrix(y_true: np.ndarray, y_pred: np.ndarray) -> np.ndarray:
+    classes = np.unique(np.concatenate([y_true, y_pred]))
+    k = len(classes)
+    lut = {c: i for i, c in enumerate(classes)}
+    cm = np.zeros((k, k), dtype=np.int64)
+    for t, p in zip(y_true, y_pred):
+        cm[lut[t], lut[p]] += 1
+    return cm
+
+
+def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
+    """AUC via the rank statistic (ties get average rank)."""
+    y = np.asarray(y_true) > 0
+    n_pos, n_neg = int(y.sum()), int((~y).sum())
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(scores, kind="stable")
+    ranks = np.empty(len(scores), dtype=np.float64)
+    ranks[order] = np.arange(1, len(scores) + 1)
+    s = np.asarray(scores, dtype=np.float64)
+    for v in np.unique(s):  # average ranks over ties
+        m = s == v
+        if m.sum() > 1:
+            ranks[m] = ranks[m].mean()
+    return float((ranks[y].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+class ComputeModelStatistics(Transformer):
+    """(ref ``ComputeModelStatistics.scala:58``) — returns a one-row metrics
+    DataFrame; evaluation_metric: classification | regression | auto."""
+
+    label_col = Param("label_col", "ground-truth column", default="label")
+    scores_col = Param("scores_col", "prediction column", default="prediction")
+    scored_probabilities_col = Param("scored_probabilities_col",
+                                     "probability column (binary AUC)", default=None)
+    evaluation_metric = Param("evaluation_metric", "classification | regression | auto",
+                              default="auto")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("label_col"), self.get("scores_col"))
+        y = np.asarray(df.collect_column(self.get("label_col")))
+        pred = np.asarray(df.collect_column(self.get("scores_col")))
+        kind = self.get("evaluation_metric")
+        if kind == "auto":
+            few_levels = len(np.unique(y)) <= max(20, int(np.sqrt(len(y))))
+            stringy = y.dtype == object or y.dtype.kind in ("U", "S")
+            integral = (stringy or np.issubdtype(y.dtype, np.integer)
+                        or bool(np.all(np.asarray(y, np.float64) % 1 == 0)))
+            kind = "classification" if few_levels and integral else "regression"
+        if kind == "classification":
+            cm = confusion_matrix(y, pred)
+            acc = float(np.trace(cm)) / max(cm.sum(), 1)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                prec = np.diag(cm) / np.maximum(cm.sum(axis=0), 1)
+                rec = np.diag(cm) / np.maximum(cm.sum(axis=1), 1)
+            out = {"evaluation_type": _as_column(["Classification"]),
+                   "accuracy": np.array([acc]),
+                   "precision": np.array([float(np.mean(prec))]),
+                   "recall": np.array([float(np.mean(rec))]),
+                   "confusion_matrix": _as_column([cm])}
+            pc = self.get("scored_probabilities_col")
+            if pc and pc in df.columns and len(np.unique(y)) == 2:
+                probs = np.asarray(df.collect_column(pc), np.float64)
+                if probs.ndim == 2:
+                    probs = probs[:, -1]
+                pos = np.unique(y)[1]
+                out["AUC"] = np.array([roc_auc(y == pos, probs)])
+            return DataFrame([out])
+        err = np.asarray(pred, np.float64) - np.asarray(y, np.float64)
+        mse = float(np.mean(err**2))
+        var = float(np.var(np.asarray(y, np.float64)))
+        return DataFrame([{
+            "evaluation_type": _as_column(["Regression"]),
+            "mean_squared_error": np.array([mse]),
+            "root_mean_squared_error": np.array([np.sqrt(mse)]),
+            "mean_absolute_error": np.array([float(np.mean(np.abs(err)))]),
+            "R^2": np.array([1.0 - mse / var if var > 0 else float("nan")]),
+        }])
+
+
+class ComputePerInstanceStatistics(Transformer):
+    """Per-row loss/correctness (ref ``ComputePerInstanceStatistics.scala``)."""
+
+    label_col = Param("label_col", "ground-truth column", default="label")
+    scores_col = Param("scores_col", "prediction column", default="prediction")
+    scored_probabilities_col = Param("scored_probabilities_col",
+                                     "probability column for log-loss", default=None)
+    evaluation_metric = Param("evaluation_metric", "classification | regression",
+                              default="classification")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("label_col"), self.get("scores_col"))
+        if self.get("evaluation_metric") == "regression":
+            def add(p):
+                e = np.asarray(p[self.get("scores_col")], np.float64) - \
+                    np.asarray(p[self.get("label_col")], np.float64)
+                return e * e
+
+            return (df.with_column("squared_error", add)
+                      .with_column("absolute_error",
+                                   lambda p: np.abs(np.asarray(p[self.get("scores_col")], np.float64)
+                                                    - np.asarray(p[self.get("label_col")], np.float64))))
+        out = df.with_column("correct",
+                             lambda p: (np.asarray(p[self.get("scores_col")])
+                                        == np.asarray(p[self.get("label_col")])).astype(np.float64))
+        pc = self.get("scored_probabilities_col")
+        if pc and pc in df.columns:
+            def logloss(p):
+                probs = np.asarray(np.stack([np.atleast_1d(np.asarray(v, np.float64))
+                                             for v in p[pc]]))
+                y = np.asarray(p[self.get("label_col")])
+                if probs.shape[1] == 1:  # binary prob of positive class
+                    pr = np.clip(probs[:, 0], 1e-12, 1 - 1e-12)
+                    return -(y * np.log(pr) + (1 - y) * np.log(1 - pr))
+                idx = y.astype(np.int64)
+                pr = np.clip(probs[np.arange(len(y)), idx], 1e-12, None)
+                return -np.log(pr)
+
+            out = out.with_column("log_loss", logloss)
+        return out

@@ -1,0 +1,117 @@
+"""TrainClassifier / TrainRegressor — auto-featurize + fit any learner.
+
+Reference: ``train/TrainClassifier.scala:52`` / ``TrainRegressor.scala`` —
+wraps any SparkML learner: featurizes non-numeric columns, indexes string
+labels, fits, and returns a model that runs the same featurization at
+transform time."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..featurize import Featurize, ValueIndexer
+
+__all__ = ["TrainClassifier", "TrainedClassifierModel",
+           "TrainRegressor", "TrainedRegressorModel"]
+
+
+class _TrainBase:
+    model = ComplexParam("model", "the learner to fit (an Estimator)")
+    label_col = Param("label_col", "label column", default="label")
+    features_col = Param("features_col", "assembled features column", default="features")
+    num_features = Param("num_features", "hash buckets for high-cardinality strings",
+                         default=256, converter=TypeConverters.to_int)
+
+    def _feature_cols(self, df: DataFrame) -> list[str]:
+        skip = {self.get("label_col"), self.get("features_col")}
+        return [c for c in df.columns if c not in skip]
+
+    def _assemble(self, df: DataFrame):
+        if self.get("features_col") in df.columns:
+            return None, df  # pre-featurized
+        feat = Featurize(input_cols=self._feature_cols(df),
+                         output_col=self.get("features_col"),
+                         num_features=self.get("num_features")).fit(df)
+        return feat, feat.transform(df)
+
+
+class TrainClassifier(Estimator, _TrainBase):
+    """(ref ``TrainClassifier.scala:52``)"""
+
+    feature_name = "train"
+
+    def _fit(self, df: DataFrame) -> "TrainedClassifierModel":
+        self.require_columns(df, self.get("label_col"))
+        label_col = self.get("label_col")
+        labels = df.collect_column(label_col)
+        indexer_model = None
+        if labels.dtype == object or labels.dtype.kind in ("U", "S"):  # string labels
+            indexer_model = ValueIndexer(input_col=label_col, output_col=label_col).fit(df)
+            df = indexer_model.transform(df)
+        feat, fdf = self._assemble(df)
+        learner = self.get("model")
+        if learner is None:
+            raise ValueError("TrainClassifier: set model=<an Estimator>")
+        inner = learner.copy({"label_col": label_col,
+                              "features_col": self.get("features_col")}).fit(fdf)
+        return TrainedClassifierModel(featurizer=feat, label_indexer=indexer_model,
+                                      inner_model=inner,
+                                      features_col=self.get("features_col"),
+                                      label_col=label_col)
+
+
+class TrainedClassifierModel(Model):
+    feature_name = "train"
+
+    featurizer = ComplexParam("featurizer", "fitted FeaturizeModel (None if pre-featurized)")
+    label_indexer = ComplexParam("label_indexer", "fitted label ValueIndexerModel or None")
+    inner_model = ComplexParam("inner_model", "fitted learner model")
+    features_col = Param("features_col", "assembled features column", default="features")
+    label_col = Param("label_col", "label column", default="label")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        feat = self.get("featurizer")
+        cur = feat.transform(df) if feat is not None and self.get("features_col") not in df.columns else df
+        out = self.get("inner_model").transform(cur)
+        idx = self.get("label_indexer")
+        if idx is not None and "prediction" in out.columns:
+            from ..featurize import IndexToValue
+
+            out = IndexToValue(input_col="prediction", output_col="predicted_label",
+                               levels=idx.get("levels")).transform(out)
+        return out
+
+
+class TrainRegressor(Estimator, _TrainBase):
+    """(ref ``train/TrainRegressor.scala``)"""
+
+    feature_name = "train"
+
+    def _fit(self, df: DataFrame) -> "TrainedRegressorModel":
+        self.require_columns(df, self.get("label_col"))
+        feat, fdf = self._assemble(df)
+        learner = self.get("model")
+        if learner is None:
+            raise ValueError("TrainRegressor: set model=<an Estimator>")
+        inner = learner.copy({"label_col": self.get("label_col"),
+                              "features_col": self.get("features_col")}).fit(fdf)
+        return TrainedRegressorModel(featurizer=feat, inner_model=inner,
+                                     features_col=self.get("features_col"),
+                                     label_col=self.get("label_col"))
+
+
+class TrainedRegressorModel(Model):
+    feature_name = "train"
+
+    featurizer = ComplexParam("featurizer", "fitted FeaturizeModel (None if pre-featurized)")
+    inner_model = ComplexParam("inner_model", "fitted learner model")
+    features_col = Param("features_col", "assembled features column", default="features")
+    label_col = Param("label_col", "label column", default="label")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        feat = self.get("featurizer")
+        cur = feat.transform(df) if feat is not None and self.get("features_col") not in df.columns else df
+        return self.get("inner_model").transform(cur)

@@ -1,0 +1,135 @@
+"""Featurize module tests (reference featurize suites)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame, Pipeline, load_stage
+from synapseml_tpu.featurize import (
+    CleanMissingData,
+    CountSelector,
+    DataConversion,
+    Featurize,
+    IndexToValue,
+    MultiNGram,
+    PageSplitter,
+    TextFeaturizer,
+    ValueIndexer,
+)
+
+
+def test_clean_missing_data():
+    df = DataFrame.from_dict({"x": np.array([1.0, np.nan, 3.0]),
+                              "y": np.array([np.nan, 2.0, 4.0])})
+    m = CleanMissingData(input_cols=["x", "y"], cleaning_mode="Mean").fit(df)
+    out = m.transform(df)
+    np.testing.assert_allclose(out.collect_column("x"), [1, 2, 3])
+    np.testing.assert_allclose(out.collect_column("y"), [3, 2, 4])
+    med = CleanMissingData(input_cols=["x"], cleaning_mode="Median").fit(df).transform(df)
+    np.testing.assert_allclose(med.collect_column("x"), [1, 2, 3])
+    cust = (CleanMissingData(input_cols=["x"], cleaning_mode="Custom", custom_value=-1)
+            .fit(df).transform(df))
+    np.testing.assert_allclose(cust.collect_column("x"), [1, -1, 3])
+
+
+def test_data_conversion():
+    df = DataFrame.from_dict({"x": np.array([1.7, 2.2]), "s": ["3", "4"]})
+    out = DataConversion(cols=["x"], convert_to="integer").transform(df)
+    assert out.collect_column("x").dtype == np.int32
+    out2 = DataConversion(cols=["s"], convert_to="double").transform(df)
+    np.testing.assert_allclose(out2.collect_column("s"), [3.0, 4.0])
+    cat = DataConversion(cols=["s"], convert_to="toCategorical").transform(df)
+    np.testing.assert_array_equal(cat.collect_column("s"), [0, 1])
+
+
+def test_value_indexer_roundtrip(tmp_path):
+    df = DataFrame.from_dict({"c": ["b", "a", "b", "c"]})
+    model = ValueIndexer(input_col="c", output_col="i").fit(df)
+    out = model.transform(df)
+    np.testing.assert_array_equal(out.collect_column("i"), [1, 0, 1, 2])
+    inv = IndexToValue(input_col="i", output_col="back", levels=model.get("levels"))
+    assert list(inv.transform(out).collect_column("back")) == ["b", "a", "b", "c"]
+    # unseen value errors by default, tolerated with unknown_index
+    df2 = DataFrame.from_dict({"c": ["z"]})
+    with pytest.raises(ValueError):
+        model.transform(df2)
+    model.set(unknown_index=0)
+    assert model.transform(df2).collect_column("i")[0] == 0
+    model.save(str(tmp_path / "vi"))
+    np.testing.assert_array_equal(
+        load_stage(str(tmp_path / "vi")).transform(df).collect_column("i"), [1, 0, 1, 2])
+
+
+def test_count_selector():
+    X = np.array([[1.0, 0.0, 2.0], [3.0, 0.0, 0.0]], np.float32)
+    df = DataFrame.from_dict({"features": X})
+    m = CountSelector().fit(df)
+    out = np.stack(list(m.transform(df).collect_column("features")))
+    assert out.shape == (2, 2)
+    np.testing.assert_allclose(out, [[1, 2], [3, 0]])
+
+
+def test_featurize_mixed():
+    df = DataFrame.from_dict({
+        "num": np.array([1.0, np.nan, 3.0, 4.0]),
+        "cat": ["red", "blue", "red", "green"],
+        "vec": np.ones((4, 2), np.float32),
+    })
+    model = Featurize(input_cols=["num", "cat", "vec"]).fit(df)
+    out = model.transform(df)
+    X = np.stack(list(out.collect_column("features")))
+    # 1 numeric + 3 onehot + 2 vec = 6
+    assert X.shape == (4, 6)
+    assert X[1, 0] == pytest.approx((1 + 3 + 4) / 3)  # imputed mean
+    assert X[:, 1:4].sum() == 4  # one-hot rows sum to 1
+    assert model.feature_dim == 6
+
+
+def test_featurize_high_cardinality_hashing():
+    vals = [f"user_{i}" for i in range(100)]
+    df = DataFrame.from_dict({"id": vals})
+    model = Featurize(input_cols=["id"], max_one_hot_cardinality=10,
+                      num_features=64).fit(df)
+    X = np.stack(list(model.transform(df).collect_column("features")))
+    assert X.shape == (100, 64)
+    assert (X.sum(axis=1) == 1).all()
+
+
+def test_text_featurizer_idf():
+    df = DataFrame.from_dict({"text": ["the cat sat", "the dog ran", "cat and dog"]})
+    model = TextFeaturizer(input_col="text", num_features=256).fit(df)
+    X = np.stack(list(model.transform(df).collect_column("features")))
+    assert X.shape == (3, 256)
+    # 'the' (df=2) weighs less than 'sat' (df=1)
+    no_idf = TextFeaturizer(input_col="text", num_features=256, use_idf=False).fit(df)
+    X0 = np.stack(list(no_idf.transform(df).collect_column("features")))
+    assert (X0 >= 0).all() and X0.max() == 1.0
+
+
+def test_text_featurizer_in_pipeline_with_vw():
+    from synapseml_tpu.stages import UDFTransformer
+
+    texts = (["good great excellent"] * 30) + (["bad awful terrible"] * 30)
+    labels = np.array([1] * 30 + [0] * 30)
+    df = DataFrame.from_dict({"text": texts, "label": labels})
+    tf = TextFeaturizer(input_col="text", output_col="features", num_features=128)
+    model = tf.fit(df)
+    out = model.transform(df)
+    X = np.stack(list(out.collect_column("features")))
+    from sklearn.linear_model import LogisticRegression
+
+    assert LogisticRegression().fit(X, labels).score(X, labels) == 1.0
+
+
+def test_page_splitter():
+    text = "word " * 100  # 500 chars
+    df = DataFrame.from_dict({"text": [text.strip()]})
+    out = PageSplitter(maximum_page_length=120, minimum_page_length=80).transform(df)
+    pages = out.collect_column("pages")[0]
+    assert all(len(p) <= 120 for p in pages)
+    assert "".join(pages) == text.strip()
+
+
+def test_multi_ngram():
+    df = DataFrame.from_dict({"tokens": [["a", "b", "c"]]})
+    out = MultiNGram(lengths=[1, 2]).transform(df)
+    assert list(out.collect_column("ngrams")[0]) == ["a", "b", "c", "a b", "b c"]

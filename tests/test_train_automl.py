@@ -1,0 +1,118 @@
+"""train/ and automl/ module tests."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.train import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    TrainClassifier,
+    TrainRegressor,
+)
+from synapseml_tpu.train.statistics import confusion_matrix, roc_auc
+from synapseml_tpu.automl import (
+    DiscreteHyperParam,
+    FindBestModel,
+    HyperparamBuilder,
+    RangeHyperParam,
+    TuneHyperparameters,
+)
+from synapseml_tpu.gbdt import LightGBMClassifier, LightGBMRegressor
+
+
+def make_mixed_df(n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    num = rng.normal(size=n)
+    cat = np.array(["a", "b"])[(rng.random(n) > 0.5).astype(int)]
+    label = ((num > 0) ^ (cat == "b")).astype(np.int32)
+    return DataFrame.from_dict({"num": num, "cat": cat, "label": label},
+                               num_partitions=2)
+
+
+def test_train_classifier_mixed_columns():
+    df = make_mixed_df()
+    model = TrainClassifier(model=LightGBMClassifier(num_iterations=20)).fit(df)
+    out = model.transform(df)
+    acc = (out.collect_column("prediction") == df.collect_column("label")).mean()
+    assert acc > 0.9
+
+
+def test_train_classifier_string_labels():
+    df = make_mixed_df()
+    df = df.with_column("label", np.where(df.collect_column("label") == 1, "yes", "no"))
+    model = TrainClassifier(model=LightGBMClassifier(num_iterations=15)).fit(df)
+    out = model.transform(df)
+    assert set(np.unique(out.collect_column("predicted_label"))) <= {"yes", "no"}
+    acc = (out.collect_column("predicted_label") == df.collect_column("label")).mean()
+    assert acc > 0.9
+
+
+def test_train_regressor():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(200, 3))
+    y = x @ np.array([1.0, -2.0, 0.5]) + 0.01 * rng.normal(size=200)
+    df = DataFrame.from_dict({"f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "label": y})
+    model = TrainRegressor(model=LightGBMRegressor(num_iterations=50)).fit(df)
+    pred = model.transform(df).collect_column("prediction")
+    assert np.corrcoef(pred, y)[0, 1] > 0.95
+
+
+def test_compute_model_statistics_classification():
+    df = DataFrame.from_dict({"label": np.array([0, 0, 1, 1]),
+                              "prediction": np.array([0, 1, 1, 1]),
+                              "probability": np.array([0.1, 0.6, 0.8, 0.9])})
+    stats = ComputeModelStatistics(scored_probabilities_col="probability").transform(df)
+    row = stats.collect_rows()[0]
+    assert row["accuracy"] == 0.75
+    assert row["AUC"] == 1.0
+    np.testing.assert_array_equal(row["confusion_matrix"], [[1, 1], [0, 2]])
+
+
+def test_compute_model_statistics_regression():
+    y = np.array([1.0, 2.0, 3.0])
+    df = DataFrame.from_dict({"label": y, "prediction": y + 0.1})
+    row = ComputeModelStatistics(evaluation_metric="regression").transform(df).collect_rows()[0]
+    np.testing.assert_allclose(row["mean_squared_error"], 0.01, atol=1e-9)
+    assert row["R^2"] > 0.98
+
+
+def test_roc_auc_and_confusion():
+    assert roc_auc(np.array([0, 0, 1, 1]), np.array([0.1, 0.4, 0.35, 0.8])) == 0.75
+    cm = confusion_matrix(np.array(["x", "y"]), np.array(["x", "x"]))
+    np.testing.assert_array_equal(cm, [[1, 0], [1, 0]])
+
+
+def test_per_instance_statistics():
+    df = DataFrame.from_dict({"label": np.array([0, 1]),
+                              "prediction": np.array([0, 0]),
+                              "probability": np.array([0.2, 0.3])})
+    out = ComputePerInstanceStatistics(scored_probabilities_col="probability").transform(df)
+    np.testing.assert_array_equal(out.collect_column("correct"), [1.0, 0.0])
+    np.testing.assert_allclose(out.collect_column("log_loss"),
+                               [-np.log(0.8), -np.log(0.3)])
+    reg = ComputePerInstanceStatistics(evaluation_metric="regression").transform(
+        DataFrame.from_dict({"label": np.array([1.0]), "prediction": np.array([1.5])}))
+    np.testing.assert_allclose(reg.collect_column("squared_error"), [0.25])
+
+
+def test_tune_hyperparameters(tabular_df):
+    space = (HyperparamBuilder()
+             .add_hyperparam("num_leaves", DiscreteHyperParam([4, 15]))
+             .add_hyperparam("num_iterations", RangeHyperParam(5, 15))
+             .build())
+    best = TuneHyperparameters(models=[LightGBMClassifier()], hyperparam_space=space,
+                               num_runs=3, parallelism=2,
+                               evaluation_metric="accuracy", seed=7).fit(tabular_df)
+    assert best.get("best_metric") > 0.7
+    assert "num_leaves" in best.get("best_params")
+    out = best.transform(tabular_df)
+    assert "prediction" in out.columns
+
+
+def test_find_best_model(tabular_df):
+    models = [LightGBMClassifier(num_iterations=3),
+              LightGBMClassifier(num_iterations=25)]
+    res = FindBestModel(models=models).fit(tabular_df)
+    assert res.get("best_metric") >= 0.8
+    assert len(res.get("all_model_metrics")) == 2
