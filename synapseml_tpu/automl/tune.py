@@ -33,6 +33,11 @@ def _evaluate(model, df: DataFrame, metric: str, label_col: str) -> float:
         label_col=label_col, scores_col=pred_col, evaluation_metric=kind,
         scored_probabilities_col="probability" if "probability" in scored.columns else None,
     ).transform(scored)
+    if metric not in stats.columns:
+        raise ValueError(
+            f"metric {metric!r} unavailable for this model/dataset "
+            f"(computed: {stats.columns}). 'AUC' needs a binary label and a "
+            f"'probability' column on the scored output.")
     return float(stats.collect_column(metric)[0])
 
 

@@ -44,15 +44,17 @@ class TextFeaturizerModel(Model):
     binary = Param("binary", "binary TF", default=False, converter=TypeConverters.to_bool)
     idf = ComplexParam("idf", "per-bucket inverse document frequency (None = TF only)")
 
+    def _doc_buckets(self, text) -> list[int]:
+        nbits = int(np.log2(self.get("num_features")))
+        toks = _ngrams(_tokenize(text, self.get("to_lower_case")), self.get("n_gram_length"))
+        return [hash_feature(g, "", nbits) for g in toks]
+
     def _tf(self, texts) -> np.ndarray:
         d = self.get("num_features")
-        nbits = int(np.log2(d))
         out = np.zeros((len(texts), d), np.float32)
-        n = self.get("n_gram_length")
-        lower = self.get("to_lower_case")
         for i, t in enumerate(texts):
-            for g in _ngrams(_tokenize(t, lower), n):
-                out[i, hash_feature(g, "", nbits)] += 1.0
+            for b in self._doc_buckets(t):
+                out[i, b] += 1.0
         if self.get("binary"):
             out = (out > 0).astype(np.float32)
         return out
@@ -94,8 +96,12 @@ class TextFeaturizer(Estimator):
             to_lower_case=self.get("to_lower_case"), binary=self.get("binary"), idf=None)
         if self.get("use_idf"):
             texts = list(df.collect_column(self.get("input_col")))
-            tf = model._tf(texts)
-            docfreq = (tf > 0).sum(axis=0).astype(np.float64)
+            # streamed per-doc bucket sets: O(num_features) memory, never the
+            # dense (n_docs x num_features) TF matrix
+            docfreq = np.zeros(self.get("num_features"), np.float64)
+            for t in texts:
+                for b in set(model._doc_buckets(t)):
+                    docfreq[b] += 1.0
             n_docs = max(len(texts), 1)
             idf = np.log((n_docs + 1.0) / (docfreq + 1.0))  # SparkML IDF formula
             idf[docfreq < self.get("min_doc_freq")] = 0.0

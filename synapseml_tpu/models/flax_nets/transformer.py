@@ -49,6 +49,12 @@ class TransformerConfig:
     act: str = "gelu"
     remat: bool = False
     norm_eps: float = 1e-6
+    # attention backend: 'einsum' (XLA, always available), 'flash' (Pallas
+    # blockwise kernel, ops.flash_attention), 'ring' (sequence-parallel ring
+    # over `seq_axis`, ops.ring_attention — requires a live mesh whose
+    # seq axis size > 1; falls back to flash/einsum otherwise)
+    attn_impl: str = "einsum"
+    seq_axis: str = "seq"
 
     @property
     def head_dim(self) -> int:
@@ -105,12 +111,90 @@ def make_causal_mask(q_len: int, kv_len: int, offset: int = 0) -> jax.Array:
     return (kv_pos <= q_pos)[None, None, :, :]  # [1,1,Q,KV]
 
 
+def _current_mesh():
+    """The mesh in scope (``with mesh:`` context or jit sharding env), if any."""
+    try:
+        m = jax.sharding.get_abstract_mesh()
+        if m is not None and m.axis_names:
+            return m
+    except Exception:
+        pass
+    try:
+        import warnings
+
+        from jax.interpreters import pxla
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            m = pxla.thread_resources.env.physical_mesh
+        if m is not None and not m.empty:
+            return m
+    except Exception:
+        pass
+    return None
+
+
 class Attention(nn.Module):
     """Multi-head / grouped-query attention with optional rotary embeddings and
-    a linen cache collection for autoregressive decode."""
+    a linen cache collection for autoregressive decode.
+
+    The score/softmax/value core dispatches on ``cfg.attn_impl``:
+    'einsum' (XLA), 'flash' (Pallas blockwise kernel), or 'ring'
+    (sequence-parallel over ``cfg.seq_axis`` — the long-context path the
+    reference lacks, SURVEY.md §5)."""
 
     cfg: TransformerConfig
     decode: bool = False
+
+    def _attend(self, q, k, v, mask):
+        cfg = self.cfg
+        D = cfg.head_dim
+        # flash/ring support padding (kv-position) masks; arbitrary [.., Q, K]
+        # masks (decode-time cache masks) use the einsum path
+        kv_mask = None
+        mask_is_kv_shaped = (mask is not None and mask.ndim == 4
+                             and mask.shape[1] == 1 and mask.shape[2] == 1)
+        if mask_is_kv_shaped:
+            kv_mask = mask[:, 0, 0, :]
+        impl = cfg.attn_impl
+        # NOTE: flash/ring never materialize attention probabilities, so
+        # attention-probability dropout does not apply on those paths (standard
+        # for fused kernels); residual/MLP dropout is unaffected. Falling back
+        # to einsum here would silently reintroduce the O(T^2) score matrix.
+        eligible = not self.decode and (mask is None or mask_is_kv_shaped)
+
+        if impl == "ring" and eligible:
+            mesh = _current_mesh()
+            if mesh is not None and dict(zip(mesh.axis_names, mesh.axis_sizes)
+                                         ).get(cfg.seq_axis, 1) > 1:
+                from ...ops import ring_attention_sharded
+
+                return ring_attention_sharded(mesh, q, k, v, kv_mask=kv_mask,
+                                              causal=cfg.causal,
+                                              seq_axis=cfg.seq_axis)
+            import warnings
+
+            warnings.warn(
+                f"attn_impl='ring' requested but no mesh with a "
+                f"'{cfg.seq_axis}' axis (size>1) is in scope; using the local "
+                f"flash kernel instead", stacklevel=2)
+            impl = "flash"
+
+        if impl == "flash" and eligible:
+            from ...ops import flash_attention
+
+            return flash_attention(q, k, v, kv_mask=kv_mask, causal=cfg.causal)
+
+        if cfg.causal and not self.decode:
+            causal = make_causal_mask(q.shape[1], k.shape[1])
+            mask = causal if mask is None else jnp.logical_and(mask, causal)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(D).astype(cfg.dtype)
+        if mask is not None:
+            scores = jnp.where(mask, scores, jnp.finfo(cfg.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        if cfg.dropout > 0:
+            probs = nn.Dropout(cfg.dropout, deterministic=not self.has_rng("dropout"))(probs)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
     @nn.compact
     def __call__(self, x, mask=None, positions=None):
@@ -151,21 +235,11 @@ class Attention(nn.Module):
             kv_len = cfg.max_len
             causal = make_causal_mask(T, kv_len, offset=start)
             mask = causal if mask is None else jnp.logical_and(mask, causal)
-        elif cfg.causal:
-            causal = make_causal_mask(T, T)
-            mask = causal if mask is None else jnp.logical_and(mask, causal)
-
         if KV != H:
             k = jnp.repeat(k, H // KV, axis=2)
             v = jnp.repeat(v, H // KV, axis=2)
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(D).astype(cfg.dtype)
-        if mask is not None:
-            scores = jnp.where(mask, scores, jnp.finfo(cfg.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        if cfg.dropout > 0:
-            probs = nn.Dropout(cfg.dropout, deterministic=not self.has_rng("dropout"))(probs)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = self._attend(q, k, v, mask)
         return nn.DenseGeneral(
             features=cfg.hidden, axis=(-2, -1), dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(nn.initializers.xavier_uniform(),

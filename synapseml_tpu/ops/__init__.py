@@ -1,0 +1,22 @@
+"""TPU kernel ops: the compute hot paths of the framework.
+
+The reference delegates its hot loops to prebuilt native engines (LightGBM
+C++ histograms, VW C++ SGD, ONNX Runtime CUDA kernels — SURVEY.md §1 L0).
+Here the hot ops are first-class TPU kernels:
+
+  * :mod:`attention` — blockwise flash attention (Pallas TPU kernel with an
+    XLA blockwise fallback) for the on-chip attention hot path;
+  * :mod:`ring_attention` — cross-chip sequence parallelism over a named
+    mesh axis via ``ppermute`` (net-new capability, SURVEY.md §5
+    "long-context"; the reference has none).
+"""
+
+from .attention import flash_attention, reference_attention
+from .ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "flash_attention",
+    "reference_attention",
+    "ring_attention",
+    "ring_attention_sharded",
+]

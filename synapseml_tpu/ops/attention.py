@@ -1,0 +1,281 @@
+"""Blockwise (flash) attention — Pallas TPU kernel + XLA fallback.
+
+The reference's attention hot loop lives inside torch CUDA kernels reached via
+``dl/LitDeepTextModel.py`` / ONNX Runtime (SURVEY.md §2.3); the TPU-native
+equivalent is a fused Pallas kernel: Q/K/V stream HBM→VMEM in blocks, the
+running-softmax (max/sum) accumulators stay in VMEM scratch, and only the
+normalized output is written back — O(T) memory instead of materializing the
+[T, T] score matrix.
+
+Layout contract: ``q, k, v: [B, T, H, D]`` (same as :mod:`models.flax_nets`),
+``kv_mask: [B, T]`` boolean (True = attend). Fully-masked query rows output
+exactly zero (same contract as :func:`reference_attention` and ring
+attention) — padding rows carry no gradient and are sliced away downstream.
+
+Backward pass: a custom VJP recomputes attention blockwise in XLA from the
+saved log-sum-exp — no [T, T] materialization, no second Pallas kernel needed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = -1e30
+
+
+def _pick_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def reference_attention(q, k, v, kv_mask=None, causal: bool = False,
+                        q_offset=0, kv_offset=0):
+    """Plain XLA attention (the correctness oracle). [B,T,H,D] layout.
+
+    ``q_offset``/``kv_offset`` are global position offsets so sequence-parallel
+    shards can build the right causal mask (used by ring attention).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / np.sqrt(D)
+    if causal:
+        q_pos = q_offset + jnp.arange(Tq)[:, None]
+        kv_pos = kv_offset + jnp.arange(Tk)[None, :]
+        scores = jnp.where((kv_pos <= q_pos)[None, None], scores, _NEG_INF)
+    if kv_mask is not None:
+        scores = jnp.where(kv_mask[:, None, None, :], scores, _NEG_INF)
+    any_valid = jnp.any(scores > _NEG_INF * 0.5, axis=-1)        # [B,H,Tq]
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(any_valid[..., None], probs, 0.0)          # zero masked rows
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
+                      block_k: int, kv_len: int, scale: float, causal: bool,
+                      block_q: int):
+    """One (batch*head, q-block) program: stream all K/V blocks through VMEM."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale            # [block_q, D]
+    q_blk = pl.program_id(1)
+
+    m = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+
+    n_kblocks = kv_len // block_k
+    if causal:
+        # skip blocks fully above the diagonal: kv block i is visible to this
+        # q block iff i * block_k <= q_blk * block_q + block_q - 1
+        n_kblocks = jnp.minimum(
+            n_kblocks, ((q_blk + 1) * block_q + block_k - 1) // block_k)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq, bk]
+        valid = mask_ref[0, 0, pl.dslice(i * block_k, block_k)] != 0  # [bk]
+        s = jnp.where(valid[None, :], s, _NEG_INF)
+        if causal:
+            q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kv_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kv_pos <= q_pos, s, _NEG_INF)
+        new_m = jnp.maximum(m, jnp.max(s, axis=1))
+        alpha = jnp.exp(m - new_m)
+        # gate, not just subtract: for fully-masked rows s == new_m == -1e30
+        # and exp(0) would count masked entries (f32 absorbs log(l) into -1e30)
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0, jnp.exp(s - new_m[:, None]))
+        new_l = l * alpha + jnp.sum(p, axis=1)
+        new_acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return new_m, new_l, new_acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m, l, acc))
+    safe_l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :, 0] = m + jnp.log(safe_l)
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(q, k, v, kv_mask, causal, block_q, block_k):
+    out, _ = _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k)
+    return out
+
+
+def _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k):
+    """q,k,v: [BH, T, Dp]; kv_mask: [BH, Tk] bool. Returns (out, lse)."""
+    from jax.experimental import pallas as pl
+
+    BH, Tq, Dp = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k, kv_len=Tk,
+                               scale=scale, causal=causal, block_q=block_q)
+    grid = (BH, Tq // block_q)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, Tk), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, Dp), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+        ],
+        interpret=_pick_interpret(),
+    )(q, k, v, kv_mask.astype(jnp.int32)[:, None, :])
+    return out, lse[:, :, 0]
+
+
+def _flash_core_fwd(q, k, v, kv_mask, causal, block_q, block_k):
+    out, lse = _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k)
+    return out, (q, k, v, kv_mask, out, lse)
+
+
+def _flash_core_bwd(causal, block_q, block_k, res, g):
+    """Blockwise XLA backward from saved LSE — O(T·block) memory via lax.scan
+    over kv blocks (dq) / q blocks (dk, dv)."""
+    q, k, v, kv_mask, out, lse = res
+    BH, Tq, Dp = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / np.sqrt(Dp)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    # delta_i = sum_d out_i * g_i  (rowwise), standard flash bwd identity
+    delta = jnp.sum(out.astype(jnp.float32) * gf, axis=-1)  # [BH, Tq]
+
+    q_pos = jnp.arange(Tq)
+    kv_pos = jnp.arange(Tk)
+
+    def p_block(q_blk, lse_blk, kb_idx, k_all, qi0):
+        """probs for one (q block, kv block): [BH, bq, bk]."""
+        kb = jax.lax.dynamic_slice_in_dim(k_all, kb_idx * block_k, block_k, axis=1)
+        s = jnp.einsum("bqd,bkd->bqk", q_blk, kb,
+                       preferred_element_type=jnp.float32) * scale
+        mb = jax.lax.dynamic_slice_in_dim(kv_mask, kb_idx * block_k, block_k, axis=1)
+        s = jnp.where(mb[:, None, :], s, _NEG_INF)
+        if causal:
+            qp = qi0 + q_pos[:block_q][None, :, None]
+            kp = kb_idx * block_k + kv_pos[:block_k][None, None, :]
+            s = jnp.where(kp <= qp, s, _NEG_INF)
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0, jnp.exp(s - lse_blk[:, :, None]))
+        return p, kb
+
+    n_qb, n_kb = Tq // block_q, Tk // block_k
+
+    def dq_one(_, qi):
+        qi0 = qi * block_q
+        q_blk = jax.lax.dynamic_slice_in_dim(qf, qi0, block_q, axis=1)
+        lse_blk = jax.lax.dynamic_slice_in_dim(lse, qi0, block_q, axis=1)
+        g_blk = jax.lax.dynamic_slice_in_dim(gf, qi0, block_q, axis=1)
+        d_blk = jax.lax.dynamic_slice_in_dim(delta, qi0, block_q, axis=1)
+
+        def inner(ki, dq_acc):
+            p, kb = p_block(q_blk, lse_blk, ki, kf, qi0)
+            vb = jax.lax.dynamic_slice_in_dim(vf, ki * block_k, block_k, axis=1)
+            dp = jnp.einsum("bqd,bkd->bqk", g_blk, vb,
+                            preferred_element_type=jnp.float32)
+            ds = p * (dp - d_blk[:, :, None])
+            return dq_acc + jnp.einsum("bqk,bkd->bqd", ds, kb,
+                                       preferred_element_type=jnp.float32) * scale
+
+        dq_blk = jax.lax.fori_loop(0, n_kb, inner,
+                                   jnp.zeros((BH, block_q, Dp), jnp.float32))
+        return None, dq_blk
+
+    _, dq_blocks = jax.lax.scan(dq_one, None, jnp.arange(n_qb))
+    dq = jnp.reshape(dq_blocks.transpose(1, 0, 2, 3), (BH, Tq, Dp))
+
+    def dkv_one(_, ki):
+        ki0 = ki * block_k
+        kb = jax.lax.dynamic_slice_in_dim(kf, ki0, block_k, axis=1)
+        vb = jax.lax.dynamic_slice_in_dim(vf, ki0, block_k, axis=1)
+
+        def inner(qi, carry):
+            dk_acc, dv_acc = carry
+            qi0 = qi * block_q
+            q_blk = jax.lax.dynamic_slice_in_dim(qf, qi0, block_q, axis=1)
+            lse_blk = jax.lax.dynamic_slice_in_dim(lse, qi0, block_q, axis=1)
+            g_blk = jax.lax.dynamic_slice_in_dim(gf, qi0, block_q, axis=1)
+            d_blk = jax.lax.dynamic_slice_in_dim(delta, qi0, block_q, axis=1)
+            p, _ = p_block(q_blk, lse_blk, ki, kf, qi0)
+            dv_acc = dv_acc + jnp.einsum("bqk,bqd->bkd", p, g_blk,
+                                         preferred_element_type=jnp.float32)
+            dp = jnp.einsum("bqd,bkd->bqk", g_blk, vb,
+                            preferred_element_type=jnp.float32)
+            ds = p * (dp - d_blk[:, :, None])
+            dk_acc = dk_acc + jnp.einsum("bqk,bqd->bkd", ds, q_blk,
+                                         preferred_element_type=jnp.float32) * scale
+            return dk_acc, dv_acc
+
+        dk_blk, dv_blk = jax.lax.fori_loop(
+            0, n_qb, inner, (jnp.zeros((BH, block_k, Dp), jnp.float32),
+                             jnp.zeros((BH, block_k, Dp), jnp.float32)))
+        return None, (dk_blk, dv_blk)
+
+    _, (dk_blocks, dv_blocks) = jax.lax.scan(dkv_one, None, jnp.arange(n_kb))
+    dk = jnp.reshape(dk_blocks.transpose(1, 0, 2, 3), (BH, Tk, Dp))
+    dv = jnp.reshape(dv_blocks.transpose(1, 0, 2, 3), (BH, Tk, Dp))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, kv_mask=None, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused blockwise attention. [B, T, H, D] layout, differentiable.
+
+    Pads T to the block size and D to the 128-lane TPU tile (zero-padding D
+    leaves dot products unchanged; padded kv positions are masked; padded q
+    rows are sliced away).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, Tk), bool)
+
+    block_q = min(block_q, _ceil_to(Tq, 8))
+    block_k = min(block_k, _ceil_to(Tk, 8))
+    Tq_p, Tk_p = _ceil_to(Tq, block_q), _ceil_to(Tk, block_k)
+    Dp = _ceil_to(D, 128)
+    scale_fix = np.sqrt(Dp) / np.sqrt(D)  # kernel scales by 1/sqrt(Dp); undo
+
+    def to_bh(x, T, Tp):
+        x = jnp.pad(x, ((0, 0), (0, Tp - T), (0, 0), (0, Dp - D)))
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, Tp, Dp)
+
+    qb = to_bh(q * jnp.asarray(scale_fix, q.dtype), Tq, Tq_p)
+    kb = to_bh(k, Tk, Tk_p)
+    vb = to_bh(v, Tk, Tk_p)
+    maskb = jnp.pad(kv_mask, ((0, 0), (0, Tk_p - Tk)))
+    maskb = jnp.broadcast_to(maskb[:, None, :], (B, H, Tk_p)).reshape(B * H, Tk_p)
+
+    out = _flash_core(qb, kb, vb, maskb, causal, block_q, block_k)
+    out = out.reshape(B, H, Tq_p, Dp)[:, :, :Tq, :D]
+    return jnp.transpose(out, (0, 2, 1, 3))

@@ -1,0 +1,133 @@
+"""Ring attention — sequence/context parallelism over a named mesh axis.
+
+Net-new capability (SURVEY.md §5 "Long-context / sequence parallelism:
+absent" in the reference): sequences longer than one chip's HBM are sharded
+over the ``seq`` mesh axis; each device holds a Q/K/V shard, and K/V blocks
+rotate around the ring via ``jax.lax.ppermute`` (ICI neighbor exchange) while
+a running-softmax accumulates the local contribution — attention memory stays
+O(T/n per device) and the K/V transfer overlaps with block compute in XLA's
+pipeline.
+
+``ring_attention`` is the collective form, called INSIDE ``jax.shard_map``
+with per-device shards. ``ring_attention_sharded`` wraps full arrays for
+callers holding a :class:`~synapseml_tpu.parallel.MeshContext`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str, axis_size: int, kv_mask=None,
+                   causal: bool = False):
+    """Blockwise ring attention over ``axis_name``; call inside ``shard_map``.
+
+    Args:
+      q, k, v: local shards ``[B, T_local, H, D]`` (equal-length shards; global
+        position of row t on shard i is ``i * T_local + t``).
+      axis_name: mesh axis carrying the sequence dimension.
+      axis_size: static size of that axis (ring length).
+      kv_mask: optional ``[B, T_local]`` bool for the local K/V shard.
+      causal: apply a global causal mask built from shard offsets.
+
+    Fully-masked query rows yield zeros. Accumulation is float32.
+    """
+    B, T, H, D = q.shape
+    my = jax.lax.axis_index(axis_name)
+    scale = 1.0 / np.sqrt(D)
+    qf = q.astype(jnp.float32)
+
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, T), bool)
+
+    q_pos = my * T + jnp.arange(T)                      # [T] global positions
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(s, carry):
+        k_cur, v_cur, mask_cur, m, l, acc = carry
+        origin = (my - s) % axis_size                   # shard the block came from
+        kv_pos = origin * T + jnp.arange(T)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(mask_cur[:, None, None, :], scores, _NEG_INF)
+        if causal:
+            allowed = kv_pos[None, :] <= q_pos[:, None]  # [T, T]
+            scores = jnp.where(allowed[None, None], scores, _NEG_INF)
+        blk_max = jnp.max(scores, axis=-1)              # [B, H, T]
+        new_m = jnp.maximum(m, blk_max)
+        alpha = jnp.exp(m - new_m)
+        # gated: fully-masked rows keep p == 0 (zero output, zero gradient)
+        p = jnp.where(scores <= _NEG_INF * 0.5, 0.0,
+                      jnp.exp(scores - new_m[..., None]))  # [B, H, Tq, Tk]
+        new_l = l * alpha + jnp.sum(p, axis=-1)
+        new_acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        # rotate K/V/mask to the next device; the final rotation restores the
+        # original residency (harmless) and keeps the loop body uniform
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm)
+        return k_nxt, v_nxt, mask_nxt, new_m, new_l, new_acc
+
+    # derive accumulators from q so they carry the same shard_map
+    # varying-axes type as the loop outputs (check_vma)
+    zeros_bht = jnp.transpose(jnp.sum(qf, axis=-1) * 0.0, (0, 2, 1))
+    m0 = zeros_bht + _NEG_INF
+    l0 = zeros_bht
+    acc0 = jnp.transpose(qf * 0.0, (0, 2, 1, 3))
+    carry = (k, v, kv_mask, m0, l0, acc0)
+    carry = jax.lax.fori_loop(0, axis_size, step, carry, unroll=True)
+    _, _, _, m, l, acc = carry
+    out = acc / jnp.maximum(l, 1e-30)[..., None]        # [B, H, T, D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _mesh_of(mesh_like):
+    """Accept a MeshContext, a jax Mesh, or an AbstractMesh."""
+    mesh = getattr(mesh_like, "mesh", mesh_like)
+    sizes = dict(zip(mesh.axis_names, mesh.axis_sizes
+                     if hasattr(mesh, "axis_sizes") else mesh.devices.shape))
+    return mesh, sizes
+
+
+def ring_attention_sharded(mesh_ctx, q, k, v, kv_mask=None, causal: bool = False,
+                           seq_axis: str = "seq", batch_axes=("data", "fsdp"),
+                           head_axis: str | None = "tensor"):
+    """Full-array entry point: shard_map ``ring_attention`` over the mesh.
+
+    q, k, v: ``[B, T, H, D]`` global arrays (T divisible by the seq-axis size).
+    ``mesh_ctx`` may be a :class:`~synapseml_tpu.parallel.MeshContext`, a
+    ``jax.sharding.Mesh``, or an ``AbstractMesh``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    mesh, sizes = _mesh_of(mesh_ctx)
+    n = sizes.get(seq_axis, 1)
+    H = q.shape[2]
+    batch_axes = tuple(a for a in batch_axes if a in sizes)
+    head = (head_axis if head_axis and head_axis in sizes
+            and H % max(sizes.get(head_axis, 1), 1) == 0 else None)
+    if n <= 1:
+        from .attention import reference_attention
+        return reference_attention(q, k, v, kv_mask=kv_mask, causal=causal)
+    qkv_spec = P(batch_axes or None, seq_axis, head, None)
+    mask_spec = P(batch_axes or None, seq_axis)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, axis_size=n,
+                           causal=causal)
+    mapped = jax.shard_map(
+        lambda q_, k_, v_, m_: fn(q_, k_, v_, kv_mask=m_),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+    )
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:2], bool)
+    return mapped(q, k, v, kv_mask)

@@ -33,14 +33,12 @@ def roc_auc(y_true: np.ndarray, scores: np.ndarray) -> float:
     n_pos, n_neg = int(y.sum()), int((~y).sum())
     if n_pos == 0 or n_neg == 0:
         return float("nan")
-    order = np.argsort(scores, kind="stable")
-    ranks = np.empty(len(scores), dtype=np.float64)
-    ranks[order] = np.arange(1, len(scores) + 1)
     s = np.asarray(scores, dtype=np.float64)
-    for v in np.unique(s):  # average ranks over ties
-        m = s == v
-        if m.sum() > 1:
-            ranks[m] = ranks[m].mean()
+    # average ranks over ties in O(n log n): group start/end from unique counts
+    _, inverse, counts = np.unique(s, return_inverse=True, return_counts=True)
+    ends = np.cumsum(counts)
+    avg_rank = ends - (counts - 1) / 2.0  # mean of [end-count+1 .. end]
+    ranks = avg_rank[inverse]
     return float((ranks[y].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
 
 
@@ -128,6 +126,10 @@ class ComputePerInstanceStatistics(Transformer):
                 probs = np.asarray(np.stack([np.atleast_1d(np.asarray(v, np.float64))
                                              for v in p[pc]]))
                 y = np.asarray(p[self.get("label_col")])
+                if not np.issubdtype(y.dtype, np.number):
+                    # string/categorical labels: index by sorted unique value,
+                    # matching ValueIndexer / TrainClassifier's label ordering
+                    y = np.searchsorted(np.unique(y), y)
                 if probs.shape[1] == 1:  # binary prob of positive class
                     pr = np.clip(probs[:, 0], 1e-12, 1 - 1e-12)
                     return -(y * np.log(pr) + (1 - y) * np.log(1 - pr))
