@@ -87,7 +87,13 @@ def concat_partitions(parts: Sequence[Partition]) -> Partition:
             merged = np.empty(sum(len(c) for c in cols), dtype=object)
             i = 0
             for c in cols:
-                merged[i : i + len(c)] = c
+                if c.dtype == object:
+                    merged[i : i + len(c)] = c
+                else:
+                    # rectangular partition merging into a ragged column:
+                    # assign row-by-row so numpy doesn't try to broadcast
+                    for j in range(len(c)):
+                        merged[i + j] = c[j]
                 i += len(c)
             out[k] = merged
         else:

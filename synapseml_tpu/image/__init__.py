@@ -1,0 +1,34 @@
+"""Image preprocessing — the OpenCV module rebuilt without OpenCV.
+
+Reference (SURVEY.md §2.4): ``opencv/.../ImageTransformer.scala`` (stage
+pipeline over OpenCV JNI Mats), ``ImageSetAugmenter.scala:18``,
+``core/.../image/UnrollImage.scala``, ``core/.../image/Superpixel.scala``.
+
+TPU-native design: images are numpy HWC arrays in DataFrame columns (ragged
+sizes allowed via object columns). Per-image geometry ops (resize/crop/flip)
+are vectorized numpy on the host data plane; the *output* of the pipeline is a
+rectangular [N, C, H, W] float tensor column sized for the device — the whole
+point of the preprocessing stage is to produce static-shaped, batched input
+for jitted model transformers (cf. ImageTransformer's toTensor mode,
+``ImageTransformer.scala:413``).
+"""
+
+from .transforms import (
+    CenterCrop,
+    ColorFormat,
+    Crop,
+    Flip,
+    GaussianBlur,
+    ImageTransformer,
+    Resize,
+    Threshold,
+)
+from .augment import ImageSetAugmenter
+from .unroll import UnrollImage
+from .superpixel import SuperpixelTransformer, slic_segments
+
+__all__ = [
+    "ImageTransformer", "Resize", "Crop", "CenterCrop", "ColorFormat", "Flip",
+    "GaussianBlur", "Threshold", "ImageSetAugmenter", "UnrollImage",
+    "SuperpixelTransformer", "slic_segments",
+]

@@ -1,0 +1,35 @@
+"""UnrollImage (reference ``core/.../image/UnrollImage.scala:169,204``):
+image column -> flat float vector column (the classical-ML feature bridge,
+e.g. for TrainClassifier / KNN over raw pixels)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param
+from ..core.pipeline import Transformer
+from .transforms import as_image
+
+__all__ = ["UnrollImage"]
+
+
+class UnrollImage(Transformer):
+    feature_name = "image"
+
+    input_col = Param("input_col", "image column", default="image")
+    output_col = Param("output_col", "flattened vector column", default="unrolled")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+
+        def per_part(p):
+            flats = [as_image(x).ravel() for x in p[self.get("input_col")]]
+            lens = {len(f) for f in flats}
+            if len(lens) == 1 and flats:
+                return np.stack(flats)
+            out = np.empty(len(flats), dtype=object)
+            out[:] = flats
+            return out
+
+        return df.with_column(self.get("output_col"), per_part)

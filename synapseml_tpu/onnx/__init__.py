@@ -1,0 +1,33 @@
+"""ONNX inference on TPU — model import, conversion to XLA, batch transform.
+
+Reference module: ``deep-learning/src/main/scala/.../onnx/`` (ONNXModel,
+ONNXHub, ImageFeaturizer — SURVEY.md §2.3). The ONNX Runtime JNI session is
+replaced by a protobuf decode (:mod:`proto`, no onnx package needed) + an
+ONNX->JAX conversion (:mod:`convert`) whose output XLA compiles straight into
+TPU executables.
+"""
+
+from .convert import ConvertedModel, convert_graph
+from .featurizer import ImageFeaturizer
+from .hub import ONNXHub
+from .model import ONNXModel, slice_model_at_outputs
+from .proto import (
+    AttributeProto,
+    GraphProto,
+    ModelProto,
+    NodeProto,
+    OperatorSetId,
+    TensorProto,
+    ValueInfoProto,
+    encode_model,
+    numpy_to_tensor,
+    parse_model,
+    tensor_to_numpy,
+)
+
+__all__ = [
+    "ONNXModel", "ONNXHub", "ImageFeaturizer", "ConvertedModel", "convert_graph",
+    "slice_model_at_outputs", "ModelProto", "GraphProto", "NodeProto",
+    "TensorProto", "AttributeProto", "ValueInfoProto", "OperatorSetId",
+    "parse_model", "encode_model", "numpy_to_tensor", "tensor_to_numpy",
+]

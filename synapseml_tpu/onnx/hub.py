@@ -1,0 +1,91 @@
+"""ONNXHub — model-zoo client (reference ``onnx/ONNXHub.scala:72-255``).
+
+The reference fetches a manifest JSON + SHA-checked model files from the
+github onnx/models zoo into an HDFS-compatible cache. This environment has no
+egress, so the hub is cache-first: models and a ``manifest.json`` live under
+``hub_dir`` (``~/.cache/synapseml_tpu/onnx`` by default, or $SYNAPSEML_TPU_HUB);
+a missing model raises with the expected path instead of downloading.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+__all__ = ["ONNXHub"]
+
+
+class ONNXHub:
+    def __init__(self, hub_dir: str | None = None):
+        self.hub_dir = hub_dir or os.environ.get(
+            "SYNAPSEML_TPU_HUB",
+            os.path.join(os.path.expanduser("~"), ".cache", "synapseml_tpu", "onnx"))
+
+    # -------- manifest --------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.hub_dir, "manifest.json")
+
+    def list_models(self) -> list[dict]:
+        path = self._manifest_path()
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return json.load(f)
+
+    def get_model_info(self, name: str) -> dict:
+        matches = [m for m in self.list_models()
+                   if m.get("model", "").lower() == name.lower()
+                   or m.get("model_path", "") == name]
+        if not matches:
+            raise KeyError(f"model {name!r} not in hub manifest "
+                           f"({self._manifest_path()}); available: "
+                           f"{[m.get('model') for m in self.list_models()]}")
+        # newest opset wins (reference picks max opset version)
+        return max(matches, key=lambda m: m.get("opset_version", 0))
+
+    # -------- models --------
+    def model_path(self, name: str) -> str:
+        try:
+            info = self.get_model_info(name)
+            rel = info.get("model_path") or f"{name}.onnx"
+        except KeyError:
+            rel = f"{name}.onnx"
+        return os.path.join(self.hub_dir, rel)
+
+    def load(self, name: str, verify_sha: bool = True) -> bytes:
+        path = self.model_path(name)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"ONNX model {name!r} not cached at {path}. This environment "
+                f"has no network egress: place the .onnx file there (and "
+                f"optionally a manifest.json entry) to use the hub.")
+        with open(path, "rb") as f:
+            data = f.read()
+        if verify_sha:
+            try:
+                expect = self.get_model_info(name).get("model_sha256")
+            except KeyError:
+                expect = None
+            if expect:
+                got = hashlib.sha256(data).hexdigest()
+                if got != expect:
+                    raise ValueError(f"sha256 mismatch for {name}: {got} != {expect}")
+        return data
+
+    def save(self, name: str, data: bytes, extra_info: dict | None = None) -> str:
+        """Register a model into the local hub (test/setup convenience)."""
+        os.makedirs(self.hub_dir, exist_ok=True)
+        rel = f"{name}.onnx"
+        with open(os.path.join(self.hub_dir, rel), "wb") as f:
+            f.write(data)
+        manifest = self.list_models()
+        manifest = [m for m in manifest if m.get("model") != name]
+        entry = {"model": name, "model_path": rel,
+                 "model_sha256": hashlib.sha256(data).hexdigest(),
+                 "opset_version": 17}
+        entry.update(extra_info or {})
+        manifest.append(entry)
+        with open(self._manifest_path(), "w") as f:
+            json.dump(manifest, f, indent=2)
+        return os.path.join(self.hub_dir, rel)

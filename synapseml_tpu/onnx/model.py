@@ -1,0 +1,204 @@
+"""ONNXModel — distributed batch inference transformer, XLA-resident.
+
+Reference: ``deep-learning/.../onnx/ONNXModel.scala:145-423`` (transform:211,
+transformInner:230-256, softmax/argmax post-cols :258-301, model slicing via
+``ONNXUtils.sliceModelAtOutputs:267-352``) and ``ONNXRuntime.scala:25-107``.
+
+TPU-native shape of the same pipeline (SURVEY.md §3.3 "TPU rebuild" note):
+  * model bytes -> :class:`~synapseml_tpu.onnx.convert.ConvertedModel` once
+    (broadcast analog: the converted fn is shared across partitions);
+  * per-partition OrtSession -> ONE jitted XLA executable, cached per input
+    shape signature;
+  * FixedMiniBatch(10) + dynamic batches -> fixed-size padded microbatches so
+    every batch hits the SAME compiled program (static shapes, no recompiles);
+  * softMaxDict / argMaxDict post-processing fused into the same jit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Transformer
+from .convert import ConvertedModel
+from .proto import GraphProto, ModelProto, ValueInfoProto, parse_model
+
+__all__ = ["ONNXModel", "slice_model_at_outputs"]
+
+
+def slice_model_at_outputs(model_bytes: bytes, output_names: list[str]) -> bytes:
+    """Cut the graph at (possibly intermediate) values — the reference's
+    protobuf surgery (``ONNXUtils.sliceModelAtOutputs:267-352``): keep only
+    nodes/initializers reachable backwards from ``output_names``."""
+    m = parse_model(model_bytes)
+    g = m.graph
+    produced_by = {}
+    for n in g.node:
+        for o in n.output:
+            produced_by[o] = n
+    needed_values: set[str] = set()
+    needed_nodes: list = []
+    seen_nodes: set[int] = set()
+    stack = list(output_names)
+    while stack:
+        v = stack.pop()
+        if v in needed_values:
+            continue
+        needed_values.add(v)
+        n = produced_by.get(v)
+        if n is not None and id(n) not in seen_nodes:
+            seen_nodes.add(id(n))
+            needed_nodes.append(n)
+            stack.extend([i for i in n.input if i])
+    ordered = [n for n in g.node if id(n) in seen_nodes]
+    known = {vi.name: vi for vi in list(g.output) + list(g.value_info) + list(g.input)}
+    new_outputs = [known.get(name, ValueInfoProto(name=name)) for name in output_names]
+    init_names = {t.name for t in g.initializer}
+    new_graph = GraphProto(
+        node=ordered,
+        name=g.name + "_sliced",
+        initializer=[t for t in g.initializer if t.name in needed_values],
+        input=[vi for vi in g.input
+               if vi.name in needed_values and vi.name not in init_names],
+        output=new_outputs,
+        value_info=g.value_info,
+    )
+    return ModelProto(ir_version=m.ir_version, producer_name=m.producer_name,
+                      graph=new_graph, opset_import=m.opset_import).encode()
+
+
+class ONNXModel(Transformer):
+    """(ref ``ONNXModel.scala:145``)"""
+
+    feature_name = "onnx"
+
+    model_payload = ComplexParam("model_payload", "ONNX model protobuf bytes")
+    feed_dict = ComplexParam("feed_dict", "model input name -> DataFrame column",
+                             default=None)
+    fetch_dict = ComplexParam("fetch_dict", "output column -> model output name",
+                              default=None)
+    mini_batch_size = Param("mini_batch_size", "rows per padded device batch",
+                            default=64, converter=TypeConverters.to_int)
+    softmax_dict = ComplexParam("softmax_dict", "input col -> softmax output col",
+                                default=None)
+    argmax_dict = ComplexParam("argmax_dict", "input col -> argmax output col",
+                               default=None)
+
+    def __init__(self, model_bytes: bytes | None = None, **kw):
+        super().__init__(**kw)
+        if model_bytes is not None:
+            self.set(model_payload=model_bytes)
+
+    # NOTE: stage deserialization constructs via cls.__new__ (serialization
+    # .load_stage:168), bypassing __init__ — runtime caches therefore live
+    # behind lazy accessors, never as __init__-assigned attributes.
+    @property
+    def _jit_cache_map(self) -> dict:
+        return self.__dict__.setdefault("_jit_cache", {})
+
+    # -------- model management --------
+    def set_model_location(self, path: str) -> "ONNXModel":
+        with open(path, "rb") as f:
+            return self.set(model_payload=f.read())
+
+    def slice_at_outputs(self, output_names: list[str]) -> "ONNXModel":
+        """Re-target the model at intermediate outputs (headless featurization,
+        ref ``ONNXModel.setSliceAtOutputs`` / ImageFeaturizer ``extraPorts``)."""
+        self.set(model_payload=slice_model_at_outputs(self.get("model_payload"),
+                                                      list(output_names)))
+        self.__dict__.pop("_converted", None)
+        self._jit_cache_map.clear()
+        return self
+
+    @property
+    def converted(self) -> ConvertedModel:
+        if self.__dict__.get("_converted") is None:
+            payload = self.get("model_payload")
+            if payload is None:
+                raise ValueError("ONNXModel: model_payload not set")
+            self.__dict__["_converted"] = ConvertedModel(parse_model(payload))
+        return self.__dict__["_converted"]
+
+    @property
+    def model_input_names(self) -> list[str]:
+        return self.converted.input_names
+
+    @property
+    def model_output_names(self) -> list[str]:
+        return self.converted.output_names
+
+    # -------- transform --------
+    def _resolved_feeds(self) -> dict:
+        feeds = self.get("feed_dict")
+        if feeds:
+            return dict(feeds)
+        names = self.model_input_names
+        if len(names) == 1:
+            return {names[0]: "features"}
+        raise ValueError(f"feed_dict required for multi-input model {names}")
+
+    def _resolved_fetches(self) -> dict:
+        fetches = self.get("fetch_dict")
+        if fetches:
+            return dict(fetches)
+        return {f"out_{n}" if n in ("", None) else n: n
+                for n in self.model_output_names}
+
+    def _jitted(self, feeds: dict, fetches: dict):
+        """One jitted program: model + post softmax/argmax cols fused."""
+        import jax
+        import jax.numpy as jnp
+
+        key = (tuple(sorted(feeds.items())), tuple(sorted(fetches.items())))
+        if key in self._jit_cache_map:
+            return self._jit_cache_map[key]
+        conv = self.converted
+        soft = dict(self.get("softmax_dict") or {})
+        arg = dict(self.get("argmax_dict") or {})
+        out_col_of = {v: k for k, v in fetches.items()}
+
+        def fn(*arrays):
+            outs = conv(**dict(zip(sorted(feeds), arrays)))
+            cols = {out_col_of[name]: val for name, val in outs.items()
+                    if name in out_col_of}
+            for src, dst in soft.items():
+                cols[dst] = jax.nn.softmax(cols[src], axis=-1)
+            for src, dst in arg.items():
+                cols[dst] = jnp.argmax(cols[src], axis=-1).astype(jnp.int32)
+            return cols
+
+        jitted = jax.jit(fn)
+        self._jit_cache_map[key] = jitted
+        return jitted
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        feeds = self._resolved_feeds()
+        fetches = self._resolved_fetches()
+        self.require_columns(df, *feeds.values())
+        B = self.get("mini_batch_size")
+        jitted = self._jitted(feeds, fetches)
+
+        def per_part(p):
+            n = len(next(iter(p.values()))) if p else 0
+            cols_in = {name: np.asarray(np.stack(list(p[col])))
+                       if p[col].dtype == object else np.asarray(p[col])
+                       for name, col in feeds.items()}
+            results: dict[str, list] = {}
+            for start in range(0, n, B):
+                stop = min(start + B, n)
+                batch = {k: v[start:stop] for k, v in cols_in.items()}
+                pad = B - (stop - start)
+                if pad:  # pad to the fixed batch size -> same compiled program
+                    batch = {k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                             for k, v in batch.items()}
+                out = jitted(*[batch[k] for k in sorted(feeds)])
+                for col, val in out.items():
+                    arr = np.asarray(val)[: stop - start]
+                    results.setdefault(col, []).append(arr)
+            q = dict(p)
+            for col, chunks in results.items():
+                q[col] = np.concatenate(chunks, axis=0) if chunks else np.empty(0)
+            return q
+
+        return df.map_partitions(per_part)

@@ -60,52 +60,61 @@ def reference_attention(q, k, v, kv_mask=None, causal: bool = False,
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
-                      block_k: int, kv_len: int, scale: float, causal: bool,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                      m_scr, l_scr, acc_scr, *,
+                      block_k: int, n_kblocks: int, scale: float, causal: bool,
                       block_q: int):
-    """One (batch*head, q-block) program: stream all K/V blocks through VMEM."""
+    """One (batch*head, q-block, kv-block) program. Only ONE block_k-sized K/V
+    tile is VMEM-resident at a time (streamed by the grid's innermost
+    dimension); the running max/sum/accumulator live in VMEM scratch that
+    persists across the kv dimension and is written out on the last step."""
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * scale            # [block_q, D]
     q_blk = pl.program_id(1)
+    kv_blk = pl.program_id(2)
 
-    m = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros(q.shape, jnp.float32)
+    @pl.when(kv_blk == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    n_kblocks = kv_len // block_k
-    if causal:
-        # skip blocks fully above the diagonal: kv block i is visible to this
-        # q block iff i * block_k <= q_blk * block_q + block_q - 1
-        n_kblocks = jnp.minimum(
-            n_kblocks, ((q_blk + 1) * block_q + block_k - 1) // block_k)
-
-    def body(i, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale        # [block_q, D]
+        k_blk = k_ref[0].astype(jnp.float32)            # [block_k, D]
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [bq, bk]
-        valid = mask_ref[0, 0, pl.dslice(i * block_k, block_k)] != 0  # [bk]
+        valid = mask_ref[0, 0] != 0                     # [bk]
         s = jnp.where(valid[None, :], s, _NEG_INF)
         if causal:
             q_pos = q_blk * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            kv_pos = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            kv_pos = kv_blk * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(kv_pos <= q_pos, s, _NEG_INF)
+        m = m_scr[:, 0]
         new_m = jnp.maximum(m, jnp.max(s, axis=1))
         alpha = jnp.exp(m - new_m)
         # gate, not just subtract: for fully-masked rows s == new_m == -1e30
         # and exp(0) would count masked entries (f32 absorbs log(l) into -1e30)
         p = jnp.where(s <= _NEG_INF * 0.5, 0.0, jnp.exp(s - new_m[:, None]))
-        new_l = l * alpha + jnp.sum(p, axis=1)
-        new_acc = acc * alpha[:, None] + jax.lax.dot_general(
+        l_scr[...] = (l_scr[...] * alpha[:, None]
+                      + jnp.broadcast_to(jnp.sum(p, axis=1)[:, None], l_scr.shape))
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return new_m, new_l, new_acc
+        m_scr[...] = jnp.broadcast_to(new_m[:, None], m_scr.shape)
 
-    m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m, l, acc))
-    safe_l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, :, 0] = m + jnp.log(safe_l)
+    if causal:
+        # skip kv blocks fully above the diagonal
+        pl.when(kv_blk * block_k <= (q_blk + 1) * block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kv_blk == n_kblocks - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_scr[...] / safe_l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, :, 0] = m_scr[:, 0] + jnp.log(safe_l)
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -122,28 +131,37 @@ def _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k):
     """q,k,v: [BH, T, Dp]; kv_mask: [BH, Tk] bool. Returns (out, lse)."""
     from jax.experimental import pallas as pl
 
+    from jax.experimental.pallas import tpu as pltpu
+
     BH, Tq, Dp = q.shape
     Tk = k.shape[1]
+    n_kblocks = Tk // block_k
     scale = 1.0 / np.sqrt(q.shape[-1])
-    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k, kv_len=Tk,
-                               scale=scale, causal=causal, block_q=block_q)
-    grid = (BH, Tq // block_q)
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
+                               n_kblocks=n_kblocks, scale=scale, causal=causal,
+                               block_q=block_q)
+    grid = (BH, Tq // block_q, n_kblocks)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Tk, Dp), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Tk, Dp), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, Tk), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, Dp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, Dp), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, Dp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tq, Dp), q.dtype),
             jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max (lane-bcast)
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum (lane-bcast)
+            pltpu.VMEM((block_q, Dp), jnp.float32),    # output accumulator
         ],
         interpret=_pick_interpret(),
     )(q, k, v, kv_mask.astype(jnp.int32)[:, None, :])

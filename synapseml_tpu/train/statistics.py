@@ -122,14 +122,20 @@ class ComputePerInstanceStatistics(Transformer):
                                         == np.asarray(p[self.get("label_col")])).astype(np.float64))
         pc = self.get("scored_probabilities_col")
         if pc and pc in df.columns:
+            # global class set (not per-partition: a partition missing a class
+            # would silently shift every label's probability index)
+            all_labels = np.asarray(df.collect_column(self.get("label_col")))
+            classes = (np.unique(all_labels)
+                       if not np.issubdtype(all_labels.dtype, np.number) else None)
+
             def logloss(p):
                 probs = np.asarray(np.stack([np.atleast_1d(np.asarray(v, np.float64))
                                              for v in p[pc]]))
                 y = np.asarray(p[self.get("label_col")])
-                if not np.issubdtype(y.dtype, np.number):
-                    # string/categorical labels: index by sorted unique value,
-                    # matching ValueIndexer / TrainClassifier's label ordering
-                    y = np.searchsorted(np.unique(y), y)
+                if classes is not None:
+                    # string/categorical labels: index by globally-sorted
+                    # unique value, matching ValueIndexer's label ordering
+                    y = np.searchsorted(classes, y)
                 if probs.shape[1] == 1:  # binary prob of positive class
                     pr = np.clip(probs[:, 0], 1e-12, 1 - 1e-12)
                     return -(y * np.log(pr) + (1 - y) * np.log(1 - pr))

@@ -1,0 +1,111 @@
+"""image module: transformer stage pipeline, augmenter, unroll, superpixels."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.image import (
+    ImageSetAugmenter,
+    ImageTransformer,
+    SuperpixelTransformer,
+    UnrollImage,
+    slic_segments,
+)
+from synapseml_tpu.image.transforms import bilinear_resize
+
+
+def make_image_df(n=4, h=24, w=32, c=3, seed=0, ragged=False):
+    rs = np.random.default_rng(seed)
+    imgs = []
+    for i in range(n):
+        hh = h + (i * 4 if ragged else 0)
+        imgs.append(rs.integers(0, 256, size=(hh, w, c)).astype(np.float32))
+    return DataFrame.from_dict({"image": imgs, "label": np.arange(n)}, num_partitions=2)
+
+
+def test_resize_crop_normalize_tensor_pipeline():
+    df = make_image_df(ragged=True)
+    it = (ImageTransformer(input_col="image", output_col="features")
+          .resize(size=20, keep_aspect_ratio=True)
+          .center_crop(16, 16)
+          .normalize(means=[0.485, 0.456, 0.406], stds=[0.229, 0.224, 0.225],
+                     color_scale_factor=1 / 255.0))
+    out = it.transform(df)
+    feats = out.partitions[0]["features"]
+    assert feats.shape[1:] == (3, 16, 16)  # CHW, rectangular stack
+    assert feats.dtype == np.float32
+    assert abs(float(feats.mean())) < 5  # normalized scale
+
+
+def test_bilinear_resize_identity_and_shape():
+    img = np.arange(12, dtype=np.float32).reshape(3, 4, 1)
+    assert np.array_equal(bilinear_resize(img, 3, 4), img)
+    up = bilinear_resize(img, 6, 8)
+    assert up.shape == (6, 8, 1)
+    assert up.min() >= img.min() - 1e-5 and up.max() <= img.max() + 1e-5
+
+
+def test_flip_and_threshold_and_gray():
+    df = make_image_df(n=2)
+    it = (ImageTransformer(input_col="image", output_col="out")
+          .color_format("gray").threshold(127, 255).flip(1))
+    out = it.transform(df).collect_column("out")
+    first = out[0]
+    assert first.shape[-1] in (1,)  # gray
+    assert set(np.unique(first)).issubset({0.0, 255.0})
+    # horizontal flip of threshold equals threshold of flip
+    it2 = (ImageTransformer(input_col="image", output_col="out")
+           .flip(1).color_format("gray").threshold(127, 255))
+    out2 = it2.transform(df).collect_column("out")
+    np.testing.assert_array_equal(out[0], out2[0])
+
+
+def test_gaussian_blur_smooths():
+    rs = np.random.default_rng(0)
+    img = rs.normal(size=(16, 16, 1)).astype(np.float32)
+    df = DataFrame.from_dict({"image": [img]})
+    out = (ImageTransformer(input_col="image", output_col="out")
+           .gaussian_blur(sigma=2.0).transform(df).collect_column("out")[0])
+    assert float(np.var(out)) < float(np.var(img))
+    assert abs(float(out.mean()) - float(img.mean())) < 0.05  # kernel sums to 1
+
+
+def test_augmenter_doubles_rows():
+    df = make_image_df(n=3)
+    aug = ImageSetAugmenter(input_col="image", output_col="image",
+                            flip_left_right=True, flip_up_down=True)
+    out = aug.transform(df)
+    assert out.count() == 9  # original + lr + ud
+    imgs = out.collect_column("image")
+    np.testing.assert_array_equal(np.asarray(imgs[3]), np.asarray(imgs[0])[:, ::-1])
+
+
+def test_unroll():
+    df = make_image_df(n=3, h=8, w=8)
+    out = UnrollImage(input_col="image", output_col="vec").transform(df)
+    vecs = out.partitions[0]["vec"]
+    assert vecs.shape[-1] == 8 * 8 * 3
+
+
+def test_slic_superpixels():
+    # two clearly-separated color regions
+    img = np.zeros((32, 32, 3), np.float32)
+    img[:, 16:] = 255.0
+    labels = slic_segments(img, cell_size=8.0)
+    assert labels.shape == (32, 32)
+    n = labels.max() + 1
+    assert 4 <= n <= 40
+    # no superpixel straddles the color boundary
+    for k in range(n):
+        cols = img[labels == k][:, 0]
+        assert cols.std() < 1.0
+
+    df = DataFrame.from_dict({"image": [img]})
+    out = SuperpixelTransformer(cell_size=8.0).transform(df)
+    assert out.collect_column("superpixels")[0].shape == (32, 32)
+
+
+def test_missing_column_errors():
+    df = make_image_df()
+    with pytest.raises(ValueError, match="input column"):
+        ImageTransformer(input_col="nope").transform(df)

@@ -1,0 +1,214 @@
+"""onnx module: proto codec roundtrip, ONNX->JAX conversion, ONNXModel
+transformer (padding, post-cols, slicing), hub, ImageFeaturizer."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.onnx import (
+    AttributeProto,
+    GraphProto,
+    ImageFeaturizer,
+    ModelProto,
+    NodeProto,
+    ONNXHub,
+    ONNXModel,
+    ValueInfoProto,
+    convert_graph,
+    numpy_to_tensor,
+    parse_model,
+    slice_model_at_outputs,
+)
+from synapseml_tpu.onnx import proto as P
+
+
+def node(op, inputs, outputs, **attrs):
+    return NodeProto(input=list(inputs), output=list(outputs), op_type=op,
+                     attribute=[AttributeProto.make(k, v) for k, v in attrs.items()])
+
+
+def make_mlp_bytes(seed=0, din=4, dh=8, dout=3):
+    rs = np.random.default_rng(seed)
+    W1 = rs.normal(size=(din, dh)).astype(np.float32)
+    b1 = rs.normal(size=(dh,)).astype(np.float32)
+    W2 = rs.normal(size=(dh, dout)).astype(np.float32)
+    b2 = rs.normal(size=(dout,)).astype(np.float32)
+    g = GraphProto(
+        name="mlp",
+        node=[
+            node("Gemm", ["x", "W1", "b1"], ["h_pre"]),
+            node("Relu", ["h_pre"], ["h"]),
+            node("Gemm", ["h", "W2", "b2"], ["logits"]),
+            node("Softmax", ["logits"], ["probs"], axis=-1),
+        ],
+        initializer=[numpy_to_tensor(W1, "W1"), numpy_to_tensor(b1, "b1"),
+                     numpy_to_tensor(W2, "W2"), numpy_to_tensor(b2, "b2")],
+        input=[ValueInfoProto(name="x", elem_type=P.FLOAT, dims=["N", din])],
+        output=[ValueInfoProto(name="probs", elem_type=P.FLOAT, dims=["N", dout])],
+    )
+    return ModelProto(graph=g).encode(), (W1, b1, W2, b2)
+
+
+def mlp_reference(x, W1, b1, W2, b2):
+    h = np.maximum(x @ W1 + b1, 0)
+    logits = h @ W2 + b2
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    return h, logits, e / e.sum(-1, keepdims=True)
+
+
+def test_proto_roundtrip():
+    data, (W1, *_rest) = make_mlp_bytes()
+    m = parse_model(data)
+    assert m.graph.name == "mlp"
+    assert [n.op_type for n in m.graph.node] == ["Gemm", "Relu", "Gemm", "Softmax"]
+    re_encoded = m.encode()
+    m2 = parse_model(re_encoded)
+    assert [t.name for t in m2.graph.initializer] == ["W1", "b1", "W2", "b2"]
+    np.testing.assert_array_equal(P.tensor_to_numpy(m2.graph.initializer[0]), W1)
+    assert m2.graph.input[0].dims == ["N", 4]
+
+
+def test_convert_mlp_matches_numpy():
+    data, weights = make_mlp_bytes()
+    conv = convert_graph(data)
+    assert conv.input_names == ["x"]
+    x = np.random.default_rng(1).normal(size=(5, 4)).astype(np.float32)
+    out = conv(x=x)
+    _, _, probs = mlp_reference(x, *weights)
+    np.testing.assert_allclose(np.asarray(out["probs"]), probs, atol=1e-5)
+
+
+def test_convert_conv_ops():
+    # 1x1 conv with known weights == per-pixel linear map; then global pooling
+    rs = np.random.default_rng(0)
+    W = rs.normal(size=(2, 3, 1, 1)).astype(np.float32)  # OIHW
+    b = rs.normal(size=(2,)).astype(np.float32)
+    g = GraphProto(
+        name="cnn",
+        node=[
+            node("Conv", ["x", "W", "b"], ["c"], kernel_shape=[1, 1]),
+            node("Relu", ["c"], ["r"]),
+            node("GlobalAveragePool", ["r"], ["gap"]),
+            node("Flatten", ["gap"], ["flat"], axis=1),
+        ],
+        initializer=[numpy_to_tensor(W, "W"), numpy_to_tensor(b, "b")],
+        input=[ValueInfoProto(name="x", dims=["N", 3, 6, 6])],
+        output=[ValueInfoProto(name="flat", dims=["N", 2])],
+    )
+    data = ModelProto(graph=g).encode()
+    x = rs.normal(size=(2, 3, 6, 6)).astype(np.float32)
+    out = np.asarray(convert_graph(data)(x=x)["flat"])
+    ref = np.maximum(np.einsum("nchw,oc->nohw", x, W[:, :, 0, 0]) + b[None, :, None, None], 0)
+    ref = ref.mean(axis=(2, 3))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_convert_maxpool_batchnorm():
+    scale = np.asarray([2.0], np.float32)
+    bias = np.asarray([1.0], np.float32)
+    mean = np.asarray([0.5], np.float32)
+    var = np.asarray([4.0], np.float32)
+    g = GraphProto(
+        name="bnpool",
+        node=[
+            node("BatchNormalization", ["x", "s", "bB", "m", "v"], ["bn"], epsilon=0.0),
+            node("MaxPool", ["bn"], ["mp"], kernel_shape=[2, 2], strides=[2, 2]),
+        ],
+        initializer=[numpy_to_tensor(scale, "s"), numpy_to_tensor(bias, "bB"),
+                     numpy_to_tensor(mean, "m"), numpy_to_tensor(var, "v")],
+        input=[ValueInfoProto(name="x", dims=["N", 1, 4, 4])],
+        output=[ValueInfoProto(name="mp", dims=["N", 1, 2, 2])],
+    )
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = np.asarray(convert_graph(ModelProto(graph=g).encode())(x=x)["mp"])
+    bn = (x - 0.5) / 2.0 * 2.0 + 1.0
+    ref = bn.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_unsupported_op_raises_at_conversion():
+    g = GraphProto(node=[node("NonexistentOp", ["x"], ["y"])],
+                   input=[ValueInfoProto(name="x", dims=[1])],
+                   output=[ValueInfoProto(name="y", dims=[1])])
+    with pytest.raises(NotImplementedError, match="NonexistentOp"):
+        convert_graph(ModelProto(graph=g).encode())
+
+
+def test_onnx_model_transform_with_post_cols():
+    data, weights = make_mlp_bytes()
+    rs = np.random.default_rng(2)
+    X = rs.normal(size=(23, 4)).astype(np.float32)  # 23 % batch 8 != 0 -> padding
+    df = DataFrame.from_dict({"features": X, "row": np.arange(23)}, num_partitions=3)
+    om = ONNXModel(model_bytes=data, mini_batch_size=8,
+                   feed_dict={"x": "features"}, fetch_dict={"probs": "probs"},
+                   argmax_dict={"probs": "prediction"})
+    out = om.transform(df)
+    probs = np.stack(list(out.collect_column("probs")))
+    _, _, ref = mlp_reference(X, *weights)
+    np.testing.assert_allclose(probs, ref, atol=1e-5)
+    preds = out.collect_column("prediction")
+    np.testing.assert_array_equal(preds, ref.argmax(-1))
+    assert out.collect_column("row").tolist() == list(range(23))
+
+
+def test_model_slicing():
+    data, (W1, b1, *_rest) = make_mlp_bytes()
+    sliced = slice_model_at_outputs(data, ["h"])
+    conv = convert_graph(sliced)
+    assert conv.output_names == ["h"]
+    assert [n.op_type for n in conv.model.graph.node] == ["Gemm", "Relu"]
+    assert set(conv.weights) == {"W1", "b1"}
+    x = np.random.default_rng(3).normal(size=(4, 4)).astype(np.float32)
+    h_ref = np.maximum(x @ W1 + b1, 0)
+    np.testing.assert_allclose(np.asarray(conv(x=x)["h"]), h_ref, atol=1e-5)
+
+
+def test_hub_roundtrip(tmp_path):
+    hub = ONNXHub(hub_dir=str(tmp_path))
+    data, _ = make_mlp_bytes()
+    hub.save("tiny-mlp", data)
+    assert hub.load("tiny-mlp") == data
+    assert hub.get_model_info("tiny-mlp")["model_sha256"]
+    with pytest.raises(FileNotFoundError, match="no network egress"):
+        hub.load("resnet50")
+    # sha mismatch detection
+    with open(hub.model_path("tiny-mlp"), "ab") as f:
+        f.write(b"corrupt")
+    with pytest.raises(ValueError, match="sha256 mismatch"):
+        hub.load("tiny-mlp")
+
+
+def test_image_featurizer_headless(tmp_path):
+    rs = np.random.default_rng(0)
+    W = rs.normal(size=(5, 3, 3, 3)).astype(np.float32)
+    b = np.zeros(5, np.float32)
+    Wfc = rs.normal(size=(5, 2)).astype(np.float32)
+    g = GraphProto(
+        name="tiny-vision",
+        node=[
+            node("Conv", ["img", "W", "b"], ["c"], kernel_shape=[3, 3],
+                 strides=[2, 2], pads=[1, 1, 1, 1]),
+            node("Relu", ["c"], ["feat"]),
+            node("GlobalAveragePool", ["feat"], ["pooled"]),
+            node("Flatten", ["pooled"], ["emb"], axis=1),
+            node("MatMul", ["emb", "Wfc"], ["logits"]),
+        ],
+        initializer=[numpy_to_tensor(W, "W"), numpy_to_tensor(b, "b"),
+                     numpy_to_tensor(Wfc, "Wfc")],
+        input=[ValueInfoProto(name="img", dims=["N", 3, 16, 16])],
+        output=[ValueInfoProto(name="logits", dims=["N", 2])],
+    )
+    data = ModelProto(graph=g).encode()
+    imgs = [rs.integers(0, 256, size=(20, 24, 3)).astype(np.float32) for _ in range(3)]
+    df = DataFrame.from_dict({"image": imgs})
+    feats = (ImageFeaturizer(input_col="image", output_col="features",
+                             image_height=16, image_width=16, head_less=True,
+                             feature_tensor_name="emb", mini_batch_size=4)
+             .set(model_payload=data).transform(df))
+    out = feats.partitions[0]["features"]
+    assert out.shape == (3, 5)  # cut at embedding, head (MatMul) dropped
+    full = (ImageFeaturizer(input_col="image", output_col="features",
+                            image_height=16, image_width=16, head_less=False,
+                            mini_batch_size=4)
+            .set(model_payload=data).transform(df))
+    assert full.partitions[0]["features"].shape == (3, 2)
