@@ -108,3 +108,16 @@ def cluster_info() -> ClusterInfo:
         total_devices=jax.device_count(),
         platform=jax.devices()[0].platform,
     )
+
+
+def stack_vector_column(col, dtype="float32"):
+    """Coerce a DataFrame vector column (rectangular ndarray or object column
+    of per-row vectors) to a [N, D] array of the given dtype."""
+    import numpy as np
+
+    arr = np.asarray(col)
+    if arr.dtype == object:
+        if len(arr) == 0:
+            return np.zeros((0, 0), dtype)
+        arr = np.stack([np.asarray(v) for v in arr])
+    return arr.astype(dtype)

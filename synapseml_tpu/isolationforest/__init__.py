@@ -1,0 +1,11 @@
+"""Isolation forest anomaly detection.
+
+Reference: ``isolationforest/IsolationForest.scala:19-74`` — a thin wrapper
+over com.linkedin.isolation-forest (SURVEY.md §2.5). Here the algorithm is
+native to the framework: trees fit on host numpy (cheap, data-subsampled),
+stored as flat arrays, and scored by a vectorized traversal.
+"""
+
+from .iforest import IsolationForest, IsolationForestModel
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

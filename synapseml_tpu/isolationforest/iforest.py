@@ -1,0 +1,164 @@
+"""Isolation forest: random-split trees; anomaly score 2^(-E[pathlen]/c(n)).
+
+Param surface mirrors the reference wrapper (``IsolationForest.scala:19-74``:
+numEstimators, maxSamples, maxFeatures, bootstrap, contamination,
+scoreCol/predictedLabelCol).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..core.utils import stack_vector_column
+
+__all__ = ["IsolationForest", "IsolationForestModel"]
+
+
+def _c_factor(n: float) -> float:
+    """Average BST unsuccessful-search path length c(n)."""
+    if n <= 1:
+        return 0.0
+    h = np.log(n - 1) + np.euler_gamma
+    return 2.0 * h - 2.0 * (n - 1) / n
+
+
+def _build_tree(X: np.ndarray, rng, height_limit: int, feature_idx: np.ndarray):
+    """Arrays: feature[node], threshold[node], left/right child (-1 = leaf),
+    size[node] (samples reaching the node; leaves adjust path length by c(size))."""
+    feature, threshold, left, right, size = [], [], [], [], []
+
+    def grow(rows: np.ndarray, depth: int) -> int:
+        node = len(feature)
+        feature.append(-1)
+        threshold.append(0.0)
+        left.append(-1)
+        right.append(-1)
+        size.append(len(rows))
+        if depth >= height_limit or len(rows) <= 1:
+            return node
+        cols = feature_idx[rng.permutation(len(feature_idx))]
+        for f in cols:
+            vals = X[rows, f]
+            lo, hi = vals.min(), vals.max()
+            if hi > lo:
+                split = rng.uniform(lo, hi)
+                feature[node] = int(f)
+                threshold[node] = float(split)
+                mask = vals < split
+                left[node] = grow(rows[mask], depth + 1)
+                right[node] = grow(rows[~mask], depth + 1)
+                return node
+        return node  # all candidate features constant -> leaf
+
+    grow(np.arange(len(X)), 0)
+    return (np.asarray(feature, np.int32), np.asarray(threshold, np.float32),
+            np.asarray(left, np.int32), np.asarray(right, np.int32),
+            np.asarray(size, np.int32))
+
+
+def _c_factor_vec(n: np.ndarray) -> np.ndarray:
+    n = np.asarray(n, np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        h = np.log(np.maximum(n - 1, 1e-12)) + np.euler_gamma
+        c = 2.0 * h - 2.0 * (n - 1) / np.maximum(n, 1e-12)
+    return np.where(n <= 1, 0.0, c)
+
+
+def _path_lengths(X: np.ndarray, tree) -> np.ndarray:
+    feature, threshold, left, right, size = tree
+    n = len(X)
+    node = np.zeros(n, np.int32)
+    depth = np.zeros(n, np.float32)
+    active = feature[node] >= 0
+    while np.any(active):
+        rows = np.nonzero(active)[0]
+        cur = node[rows]
+        f = feature[cur]
+        go_left = X[rows, f] < threshold[cur]
+        node[rows] = np.where(go_left, left[cur], right[cur])
+        depth[rows] += 1.0
+        active = feature[node] >= 0
+    return depth + _c_factor_vec(size[node]).astype(np.float32)
+
+
+class IsolationForest(Estimator):
+    feature_name = "isolationforest"
+
+    features_col = Param("features_col", "feature matrix column", default="features")
+    num_estimators = Param("num_estimators", "number of trees", default=100,
+                           converter=TypeConverters.to_int)
+    max_samples = Param("max_samples", "samples per tree (<=1.0: fraction)",
+                        default=256.0, converter=TypeConverters.to_float)
+    max_features = Param("max_features", "features per tree (<=1.0: fraction)",
+                         default=1.0, converter=TypeConverters.to_float)
+    bootstrap = Param("bootstrap", "sample with replacement", default=False,
+                      converter=TypeConverters.to_bool)
+    contamination = Param("contamination", "expected anomaly fraction (0 = "
+                          "score only, threshold 0.5)", default=0.0,
+                          converter=TypeConverters.to_float)
+    score_col = Param("score_col", "anomaly score column", default="outlierScore")
+    predicted_label_col = Param("predicted_label_col", "0/1 anomaly column",
+                                default="predictedLabel")
+    random_seed = Param("random_seed", "rng seed", default=1,
+                        converter=TypeConverters.to_int)
+
+    def _fit(self, df: DataFrame) -> "IsolationForestModel":
+        self.require_columns(df, self.get("features_col"))
+        X = stack_vector_column(df.collect_column(self.get("features_col")))
+        n, d = X.shape
+        rng = np.random.default_rng(self.get("random_seed"))
+        ms = self.get("max_samples")
+        n_sub = int(round(ms * n)) if ms <= 1.0 else int(min(ms, n))
+        n_sub = max(n_sub, 2)
+        mf = self.get("max_features")
+        n_feat = max(int(round(mf * d)) if mf <= 1.0 else int(min(mf, d)), 1)
+        height = int(np.ceil(np.log2(max(n_sub, 2))))
+        trees = []
+        for _ in range(self.get("num_estimators")):
+            rows = (rng.integers(0, n, n_sub) if self.get("bootstrap")
+                    else rng.permutation(n)[:n_sub])
+            feats = rng.permutation(d)[:n_feat]
+            trees.append(_build_tree(X[rows], rng, height, feats))
+        model = IsolationForestModel(
+            trees=trees, subsample_size=n_sub,
+            features_col=self.get("features_col"),
+            score_col=self.get("score_col"),
+            predicted_label_col=self.get("predicted_label_col"))
+        contamination = self.get("contamination")
+        if contamination > 0:
+            scores = model._scores(X)
+            model.set(threshold=float(np.quantile(scores, 1.0 - contamination)))
+        return model
+
+
+class IsolationForestModel(Model):
+    trees = ComplexParam("trees", "list of flat tree arrays")
+    subsample_size = Param("subsample_size", "samples per tree at fit",
+                           converter=TypeConverters.to_int)
+    threshold = Param("threshold", "score threshold for the 0/1 label", default=0.5,
+                      converter=TypeConverters.to_float)
+    features_col = Param("features_col", "feature matrix column", default="features")
+    score_col = Param("score_col", "anomaly score column", default="outlierScore")
+    predicted_label_col = Param("predicted_label_col", "0/1 anomaly column",
+                                default="predictedLabel")
+
+    def _scores(self, X: np.ndarray) -> np.ndarray:
+        trees = self.get("trees")
+        depths = np.mean([_path_lengths(X, t) for t in trees], axis=0)
+        c = _c_factor(float(self.get("subsample_size")))
+        return np.power(2.0, -depths / max(c, 1e-9))
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("features_col"))
+
+        def score(p):
+            return self._scores(stack_vector_column(p[self.get("features_col")]))
+
+        out = df.with_column(self.get("score_col"), score)
+        thr = self.get("threshold")
+        return out.with_column(
+            self.get("predicted_label_col"),
+            lambda p: (np.asarray(p[self.get("score_col")]) >= thr).astype(np.int32))

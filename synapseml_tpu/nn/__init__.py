@@ -1,0 +1,12 @@
+"""Nearest neighbors (reference ``core/.../nn/`` — SURVEY.md §2.5).
+
+The reference builds a serializable BallTree (``nn/BallTree.scala:33-280``)
+because CPU pruning beats brute force there. On TPU the opposite holds
+(SURVEY.md §7 step 8): one [Q, N] distance matmul on the MXU + `lax.top_k`
+beats tree traversal's irregular control flow by orders of magnitude, and the
+index is just the feature matrix resident in HBM.
+"""
+
+from .knn import KNN, KNNModel, ConditionalKNN, ConditionalKNNModel
+
+__all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]

@@ -1,0 +1,162 @@
+"""Brute-force matmul KNN + conditional KNN.
+
+Reference surface: ``KNN.scala:49`` / ``ConditionalKNN`` fitted on a
+features+values DataFrame, transform adds a column of the k best matches per
+query row (ref ``nn/KNN.scala``, ``ConditionalBallTree`` restricts candidates
+to per-query allowed labels).
+
+TPU design: squared L2 distance decomposes as |q|^2 - 2 q·x + |x|^2, so the
+hot loop is ONE [Q, N] matmul (MXU) + top_k; queries stream through in fixed
+padded batches so every batch reuses the same executable. Conditional
+filtering is a mask added to the distance matrix, not a tree walk.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..core.utils import stack_vector_column as _stack_features
+
+__all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]
+
+_INF = np.float32(3.0e38)
+
+
+class _KNNBase(Estimator):
+    features_col = Param("features_col", "feature vector column", default="features")
+    values_col = Param("values_col", "payload column returned with matches",
+                       default="values")
+    label_col = Param("label_col", "conditioner label column (conditional only)",
+                      default="labels")
+    output_col = Param("output_col", "matches column", default="output")
+    k = Param("k", "number of neighbors", default=5, converter=TypeConverters.to_int)
+    query_batch = Param("query_batch", "padded query rows per device batch",
+                        default=256, converter=TypeConverters.to_int)
+
+
+class KNN(_KNNBase):
+    """(ref ``nn/KNN.scala:49``)"""
+
+    feature_name = "nn"
+
+    def _fit(self, df: DataFrame) -> "KNNModel":
+        self.require_columns(df, self.get("features_col"), self.get("values_col"))
+        X = _stack_features(df.collect_column(self.get("features_col")))
+        vals = np.asarray(df.collect_column(self.get("values_col")))
+        return KNNModel(index=X, values=vals,
+                        features_col=self.get("features_col"),
+                        output_col=self.get("output_col"),
+                        k=self.get("k"), query_batch=self.get("query_batch"))
+
+
+class KNNModel(Model):
+    index = ComplexParam("index", "[N, D] indexed feature matrix")
+    values = ComplexParam("values", "payload per indexed row")
+    labels = ComplexParam("labels", "conditioner label per indexed row", default=None)
+    features_col = Param("features_col", "feature vector column", default="features")
+    output_col = Param("output_col", "matches column", default="output")
+    k = Param("k", "number of neighbors", default=5, converter=TypeConverters.to_int)
+    query_batch = Param("query_batch", "padded query rows per device batch",
+                        default=256, converter=TypeConverters.to_int)
+
+    def _topk_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        if self.__dict__.get("_jitted") is None:
+            X = jnp.asarray(self.get("index"))           # [N, D]
+            x_sq = jnp.sum(X * X, axis=1)                # [N]
+            k = min(self.get("k"), X.shape[0])
+
+            def fn(Q, mask_bias):
+                # [Q, N] squared distances via one MXU matmul
+                d = (jnp.sum(Q * Q, axis=1, keepdims=True)
+                     - 2.0 * Q @ X.T + x_sq[None, :]) + mask_bias
+                neg_d, idx = jax.lax.top_k(-d, k)
+                return -neg_d, idx
+
+            self.__dict__["_jitted"] = jax.jit(fn)
+        return self.__dict__["_jitted"]
+
+    def _match_bias(self, p, n: int) -> np.ndarray:
+        """[rows, N] additive bias (0 = allowed); plain KNN allows everything."""
+        return np.zeros((n, len(self.get("index"))), np.float32)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("features_col"))
+        vals = self.get("values")
+        labels = self.get("labels")
+        B = self.get("query_batch")
+        fn = self._topk_fn()
+
+        def per_part(p):
+            Q = _stack_features(p[self.get("features_col")])
+            n = len(Q)
+            bias = self._match_bias(p, n)
+            matches = np.empty(n, dtype=object)
+            for s in range(0, n, B):
+                e = min(s + B, n)
+                pad = B - (e - s)
+                Qb = np.pad(Q[s:e], ((0, pad), (0, 0)))
+                Bb = np.pad(bias[s:e], ((0, pad), (0, 0)))
+                dist, idx = (np.asarray(a) for a in fn(Qb, Bb))
+                for i in range(e - s):
+                    row = []
+                    for d, j in zip(dist[i], idx[i]):
+                        if d >= _INF / 2:  # filtered out (conditional)
+                            continue
+                        match = {"value": vals[j], "distance": float(np.sqrt(max(d, 0.0))),
+                                 "index": int(j)}
+                        if labels is not None:
+                            match["label"] = labels[j]
+                        row.append(match)
+                    matches[s + i] = row
+            q = dict(p)
+            q[self.get("output_col")] = matches
+            return q
+
+        return df.map_partitions(per_part)
+
+
+class ConditionalKNN(_KNNBase):
+    """(ref ``nn/ConditionalKNN.scala``) — neighbors restricted per query to
+    rows whose label is in the query's ``conditioner`` set."""
+
+    feature_name = "nn"
+
+    conditioner_col = Param("conditioner_col", "column of allowed-label sets",
+                            default="conditioner")
+
+    def _fit(self, df: DataFrame) -> "ConditionalKNNModel":
+        self.require_columns(df, self.get("features_col"), self.get("values_col"),
+                             self.get("label_col"))
+        X = _stack_features(df.collect_column(self.get("features_col")))
+        vals = np.asarray(df.collect_column(self.get("values_col")))
+        labels = np.asarray(df.collect_column(self.get("label_col")))
+        return ConditionalKNNModel(index=X, values=vals, labels=labels,
+                                   features_col=self.get("features_col"),
+                                   output_col=self.get("output_col"),
+                                   conditioner_col=self.get("conditioner_col"),
+                                   k=self.get("k"), query_batch=self.get("query_batch"))
+
+
+class ConditionalKNNModel(KNNModel):
+    conditioner_col = Param("conditioner_col", "column of allowed-label sets",
+                            default="conditioner")
+
+    def _match_bias(self, p, n: int) -> np.ndarray:
+        labels = np.asarray(self.get("labels"))
+        conds = p[self.get("conditioner_col")]
+        bias = np.full((n, len(labels)), _INF, np.float32)
+        for i in range(n):
+            allowed = conds[i]
+            allowed = {allowed} if np.isscalar(allowed) else set(np.asarray(allowed).tolist())
+            bias[i, np.isin(labels, list(allowed))] = 0.0
+        return bias
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("conditioner_col"))
+        return super()._transform(df)

@@ -238,13 +238,28 @@ def _layernorm(ins, attrs):
 
 # ---------------- pooling ----------------
 
+def _same_explicit_pads(in_sizes, kernel, strides, lower: bool):
+    out = []
+    for i, k, s in zip(in_sizes, kernel, strides):
+        o = -(-i // s)
+        total = max((o - 1) * s + k - i, 0)
+        a, b = total // 2, total - total // 2
+        out.append((b, a) if lower else (a, b))
+    return out
+
+
 def _pool(x, attrs, reducer, init, is_avg=False):
     rank = x.ndim - 2
     kernel = attrs["kernel_shape"]
     strides = attrs.get("strides") or [1] * rank
     pads = _conv_pads(attrs, rank)
-    if isinstance(pads, str):
-        padding = {"SAME_UPPER": "SAME", "VALID": "VALID", "SAME_LOWER": "SAME"}[pads]
+    if pads == "VALID":
+        padding = "VALID"
+    elif isinstance(pads, str):
+        # SAME_UPPER / SAME_LOWER differ in which side takes the odd pad;
+        # reduce_window's 'SAME' is upper, so compute explicit pads instead
+        padding = [(0, 0), (0, 0)] + _same_explicit_pads(
+            x.shape[2:], kernel, strides, lower=pads == "SAME_LOWER")
     else:
         padding = [(0, 0), (0, 0)] + list(pads)
     window = (1, 1) + tuple(kernel)
@@ -304,6 +319,8 @@ def _reshape(ins, attrs):
 def _flatten(ins, attrs):
     x = ins[0]
     ax = attrs.get("axis", 1)
+    if ax < 0:
+        ax += x.ndim
     lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
     return jnp.reshape(x, (lead, -1))
 

@@ -67,8 +67,14 @@ class ImageFeaturizer(Transformer):
         if payload is None:
             raise ValueError("ImageFeaturizer: model_payload not set "
                              "(set_model / set_model_location)")
-        if self.get("head_less") and self.get("feature_tensor_name"):
-            payload = slice_model_at_outputs(payload, [self.get("feature_tensor_name")])
+        if self.get("head_less"):
+            cut = self.get("feature_tensor_name")
+            if not cut:
+                raise ValueError(
+                    "ImageFeaturizer: head_less=True requires "
+                    "feature_tensor_name (the intermediate output to cut at); "
+                    "set head_less=False to use the full model's outputs")
+            payload = slice_model_at_outputs(payload, [cut])
         om = ONNXModel(model_bytes=payload,
                        mini_batch_size=self.get("mini_batch_size"))
         in_name = om.model_input_names[0]

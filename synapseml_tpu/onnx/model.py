@@ -179,8 +179,18 @@ class ONNXModel(Transformer):
         B = self.get("mini_batch_size")
         jitted = self._jitted(feeds, fetches)
 
+        soft = dict(self.get("softmax_dict") or {})
+        arg = dict(self.get("argmax_dict") or {})
+        out_cols = list(fetches) + list(soft.values()) + list(arg.values())
+
         def per_part(p):
             n = len(next(iter(p.values()))) if p else 0
+            if n == 0:
+                # keep the schema consistent across partitions
+                q = dict(p)
+                for col in out_cols:
+                    q[col] = np.empty(0)
+                return q
             cols_in = {name: np.asarray(np.stack(list(p[col])))
                        if p[col].dtype == object else np.asarray(p[col])
                        for name, col in feeds.items()}
@@ -197,7 +207,8 @@ class ONNXModel(Transformer):
                     arr = np.asarray(val)[: stop - start]
                     results.setdefault(col, []).append(arr)
             q = dict(p)
-            for col, chunks in results.items():
+            for col in out_cols:  # deterministic order (jit sorts dict keys)
+                chunks = results.get(col, [])
                 q[col] = np.concatenate(chunks, axis=0) if chunks else np.empty(0)
             return q
 

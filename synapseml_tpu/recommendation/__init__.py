@@ -1,0 +1,16 @@
+"""Recommendation toolkit (reference ``core/.../recommendation/`` — SURVEY.md
+§2.5): SAR item-item recommender with time-decayed affinity, id indexing,
+ranking metrics and train/validation split.
+
+TPU design: SAR's score = userAffinity @ itemSimilarity is a single [U, I] x
+[I, I] matmul; both matrices are built with vectorized bincount-style numpy on
+the host and scored via a jitted top_k per user batch.
+"""
+
+from .indexer import RecommendationIndexer, RecommendationIndexerModel
+from .sar import SAR, SARModel
+from .evaluator import RankingEvaluator
+from .adapter import RankingAdapter, RankingTrainValidationSplit
+
+__all__ = ["SAR", "SARModel", "RecommendationIndexer", "RecommendationIndexerModel",
+           "RankingEvaluator", "RankingAdapter", "RankingTrainValidationSplit"]

@@ -1,0 +1,129 @@
+"""RankingAdapter + RankingTrainValidationSplit (reference
+``RankingAdapter.scala:19``, ``RankingTrainValidationSplit.scala:25``).
+
+RankingAdapter fits any recommender and reshapes its output into the
+(per-user predicted list, per-user ground-truth list) rows RankingEvaluator
+consumes. RankingTrainValidationSplit does a stratified-by-user temporal/random
+split and sweeps estimator param maps, keeping the best by ranking metric.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from .evaluator import RankingEvaluator
+
+__all__ = ["RankingAdapter", "RankingAdapterModel", "RankingTrainValidationSplit"]
+
+
+def _group_items(users: np.ndarray, items: np.ndarray) -> dict:
+    out: dict = {}
+    for u, i in zip(users.tolist(), items.tolist()):
+        out.setdefault(u, []).append(i)
+    return out
+
+
+class RankingAdapter(Estimator):
+    feature_name = "recommendation"
+
+    recommender = ComplexParam("recommender", "estimator producing a recommender model")
+    k = Param("k", "recommendations per user", default=10, converter=TypeConverters.to_int)
+    user_col = Param("user_col", "indexed user column", default="user_idx")
+    item_col = Param("item_col", "indexed item column", default="item_idx")
+
+    def _fit(self, df: DataFrame) -> "RankingAdapterModel":
+        model = self.get("recommender").fit(df)
+        return RankingAdapterModel(recommender_model=model, k=self.get("k"),
+                                   user_col=self.get("user_col"),
+                                   item_col=self.get("item_col"))
+
+
+class RankingAdapterModel(Model):
+    recommender_model = ComplexParam("recommender_model", "fitted recommender")
+    k = Param("k", "recommendations per user", default=10, converter=TypeConverters.to_int)
+    user_col = Param("user_col", "indexed user column", default="user_idx")
+    item_col = Param("item_col", "indexed item column", default="item_idx")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        """df = held-out interactions; emits one row per user:
+        prediction (ranked recs) + label (true items)."""
+        self.require_columns(df, self.get("user_col"), self.get("item_col"))
+        model = self.get("recommender_model")
+        recs = model.recommend_for_all_users(self.get("k"))
+        rec_of = dict(zip(recs.collect_column(self.get("user_col")).tolist(),
+                          list(recs.collect_column("recommendations"))))
+        truth = _group_items(np.asarray(df.collect_column(self.get("user_col"))),
+                             np.asarray(df.collect_column(self.get("item_col"))))
+        users = sorted(truth)
+        pred_col = np.empty(len(users), dtype=object)
+        label_col = np.empty(len(users), dtype=object)
+        for n, u in enumerate(users):
+            pred_col[n] = np.asarray(rec_of.get(u, []), np.int32)
+            label_col[n] = np.asarray(truth[u], np.int32)
+        return DataFrame.from_dict({self.get("user_col"): np.asarray(users),
+                                    "prediction": pred_col, "label": label_col})
+
+
+class RankingTrainValidationSplit(Estimator):
+    """(ref ``RankingTrainValidationSplit.scala:25``) — per-user holdout split +
+    param sweep scored by a ranking metric."""
+
+    feature_name = "recommendation"
+
+    estimator = ComplexParam("estimator", "recommender estimator to sweep")
+    estimator_param_maps = ComplexParam("estimator_param_maps",
+                                        "list of param dicts (empty = single fit)",
+                                        default=None)
+    evaluator = ComplexParam("evaluator", "RankingEvaluator", default=None)
+    train_ratio = Param("train_ratio", "per-user train fraction", default=0.75,
+                        converter=TypeConverters.to_float)
+    user_col = Param("user_col", "indexed user column", default="user_idx")
+    item_col = Param("item_col", "indexed item column", default="item_idx")
+    seed = Param("seed", "split seed", default=0, converter=TypeConverters.to_int)
+
+    def split_per_user(self, df: DataFrame) -> tuple[DataFrame, DataFrame]:
+        users = np.asarray(df.collect_column(self.get("user_col")))
+        rs = np.random.default_rng(self.get("seed"))
+        ratio = self.get("train_ratio")
+        train_mask = np.zeros(len(users), bool)
+        for u in np.unique(users):
+            idx = np.nonzero(users == u)[0]
+            perm = rs.permutation(len(idx))
+            n_train = max(int(round(len(idx) * ratio)), 1)
+            train_mask[idx[perm[:n_train]]] = True
+        whole = df.collect()
+        train = DataFrame([{k: v[train_mask] for k, v in whole.items()}])
+        test = DataFrame([{k: v[~train_mask] for k, v in whole.items()}])
+        return train, test
+
+    def _fit(self, df: DataFrame) -> "RankingTrainValidationSplitModel":
+        self.require_columns(df, self.get("user_col"), self.get("item_col"))
+        train, test = self.split_per_user(df)
+        evaluator = self.get("evaluator") or RankingEvaluator()
+        maps = self.get("estimator_param_maps") or [{}]
+        results = []
+        for params in maps:
+            est = self.get("estimator").copy(params if params else None)
+            adapter = RankingAdapter(recommender=est, k=evaluator.get("k"),
+                                     user_col=self.get("user_col"),
+                                     item_col=self.get("item_col"))
+            model = adapter.fit(train)
+            metric = evaluator.evaluate(model.transform(test))
+            results.append((params, metric, model))
+        best = max(results, key=lambda r: r[1])
+        return RankingTrainValidationSplitModel(
+            best_model=best[2], validation_metrics=[r[1] for r in results])
+
+
+class RankingTrainValidationSplitModel(Model):
+    best_model = ComplexParam("best_model", "winning RankingAdapterModel")
+    validation_metrics = ComplexParam("validation_metrics", "metric per param map")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return self.get("best_model").transform(df)
+
+    def recommend_for_all_users(self, k: int) -> DataFrame:
+        return self.get("best_model").get("recommender_model").recommend_for_all_users(k)

@@ -1,0 +1,88 @@
+"""RankingEvaluator (reference ``RankingEvaluator.scala`` /
+``RecommendationHelper.scala``): NDCG@k, MAP@k, precision@k, recall@k over
+(prediction list, ground-truth list) rows."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, TypeConverters
+from ..core.pipeline import Transformer
+
+__all__ = ["RankingEvaluator", "ndcg_at_k", "map_at_k", "precision_at_k", "recall_at_k"]
+
+
+def _as_list(v):
+    return list(np.asarray(v).ravel())
+
+
+def ndcg_at_k(pred, truth, k: int) -> float:
+    pred, truth = _as_list(pred)[:k], set(_as_list(truth))
+    if not truth:
+        return 0.0
+    dcg = sum(1.0 / np.log2(i + 2) for i, p in enumerate(pred) if p in truth)
+    idcg = sum(1.0 / np.log2(i + 2) for i in range(min(len(truth), k)))
+    return float(dcg / idcg) if idcg > 0 else 0.0
+
+
+def map_at_k(pred, truth, k: int) -> float:
+    pred, truth = _as_list(pred)[:k], set(_as_list(truth))
+    if not truth:
+        return 0.0
+    hits, score = 0, 0.0
+    for i, p in enumerate(pred):
+        if p in truth:
+            hits += 1
+            score += hits / (i + 1)
+    return float(score / min(len(truth), k))
+
+
+def precision_at_k(pred, truth, k: int) -> float:
+    pred, truth = _as_list(pred)[:k], set(_as_list(truth))
+    return float(len([p for p in pred if p in truth]) / k) if k else 0.0
+
+
+def recall_at_k(pred, truth, k: int) -> float:
+    pred, truth = _as_list(pred)[:k], set(_as_list(truth))
+    if not truth:
+        return 0.0
+    return float(len([p for p in pred if p in truth]) / len(truth))
+
+
+_METRICS = {"ndcgAt": ndcg_at_k, "map": map_at_k,
+            "precisionAtk": precision_at_k, "recallAtK": recall_at_k}
+
+
+class RankingEvaluator(Transformer):
+    """Consumes a DataFrame with per-user prediction and ground-truth item
+    lists; emits a one-row metrics DataFrame (all metrics) — SparkML evaluators
+    return a scalar via ``evaluate``, kept here too."""
+
+    feature_name = "recommendation"
+
+    prediction_col = Param("prediction_col", "ranked predicted item list column",
+                           default="prediction")
+    label_col = Param("label_col", "ground-truth item list column", default="label")
+    k = Param("k", "cutoff", default=10, converter=TypeConverters.to_int)
+    metric_name = Param("metric_name", "ndcgAt | map | precisionAtk | recallAtK",
+                        default="ndcgAt", validator=lambda v: v in _METRICS)
+
+    def evaluate(self, df: DataFrame) -> float:
+        self.require_columns(df, self.get("prediction_col"), self.get("label_col"))
+        fn = _METRICS[self.get("metric_name")]
+        preds = df.collect_column(self.get("prediction_col"))
+        labels = df.collect_column(self.get("label_col"))
+        k = self.get("k")
+        vals = [fn(p, t, k) for p, t in zip(preds, labels)]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("prediction_col"), self.get("label_col"))
+        preds = df.collect_column(self.get("prediction_col"))
+        labels = df.collect_column(self.get("label_col"))
+        k = self.get("k")
+        row = {name: np.asarray([np.mean([fn(p, t, k) for p, t in zip(preds, labels)])
+                                 if len(preds) else 0.0])
+               for name, fn in _METRICS.items()}
+        return DataFrame([row])

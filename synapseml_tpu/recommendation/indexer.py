@@ -1,0 +1,69 @@
+"""RecommendationIndexer (reference ``RecommendationIndexer.scala``):
+string/arbitrary user+item ids -> contiguous integer indices (and back)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param
+from ..core.pipeline import Estimator, Model
+
+__all__ = ["RecommendationIndexer", "RecommendationIndexerModel"]
+
+
+class RecommendationIndexer(Estimator):
+    feature_name = "recommendation"
+
+    user_input_col = Param("user_input_col", "raw user id column", default="user")
+    item_input_col = Param("item_input_col", "raw item id column", default="item")
+    user_output_col = Param("user_output_col", "indexed user column", default="user_idx")
+    item_output_col = Param("item_output_col", "indexed item column", default="item_idx")
+
+    def _fit(self, df: DataFrame) -> "RecommendationIndexerModel":
+        self.require_columns(df, self.get("user_input_col"), self.get("item_input_col"))
+        users = np.unique(np.asarray(df.collect_column(self.get("user_input_col"))))
+        items = np.unique(np.asarray(df.collect_column(self.get("item_input_col"))))
+        return RecommendationIndexerModel(
+            user_levels=users, item_levels=items,
+            user_input_col=self.get("user_input_col"),
+            item_input_col=self.get("item_input_col"),
+            user_output_col=self.get("user_output_col"),
+            item_output_col=self.get("item_output_col"))
+
+
+class RecommendationIndexerModel(Model):
+    user_levels = ComplexParam("user_levels", "sorted unique user ids")
+    item_levels = ComplexParam("item_levels", "sorted unique item ids")
+    user_input_col = Param("user_input_col", "raw user id column", default="user")
+    item_input_col = Param("item_input_col", "raw item id column", default="item")
+    user_output_col = Param("user_output_col", "indexed user column", default="user_idx")
+    item_output_col = Param("item_output_col", "indexed item column", default="item_idx")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("user_input_col"), self.get("item_input_col"))
+        ul = np.asarray(self.get("user_levels"))
+        il = np.asarray(self.get("item_levels"))
+
+        def index_col(levels, col):
+            def fn(p):
+                vals = np.asarray(p[col])
+                idx = np.searchsorted(levels, vals)
+                idx = np.clip(idx, 0, len(levels) - 1)
+                missing = levels[idx] != vals
+                if np.any(missing):
+                    raise ValueError(f"unseen ids in column {col}: "
+                                     f"{np.asarray(vals)[missing][:5].tolist()}")
+                return idx.astype(np.int32)
+            return fn
+
+        return (df.with_column(self.get("user_output_col"),
+                               index_col(ul, self.get("user_input_col")))
+                  .with_column(self.get("item_output_col"),
+                               index_col(il, self.get("item_input_col"))))
+
+    def recover_user(self, idx: np.ndarray) -> np.ndarray:
+        return np.asarray(self.get("user_levels"))[np.asarray(idx, int)]
+
+    def recover_item(self, idx: np.ndarray) -> np.ndarray:
+        return np.asarray(self.get("item_levels"))[np.asarray(idx, int)]

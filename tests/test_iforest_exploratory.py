@@ -1,0 +1,87 @@
+"""isolation forest + data balance measures."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.exploratory import (
+    AggregateBalanceMeasure,
+    DistributionBalanceMeasure,
+    FeatureBalanceMeasure,
+)
+from synapseml_tpu.isolationforest import IsolationForest, IsolationForestModel
+
+
+def make_anomaly_df(n=300, n_outliers=10, d=4, seed=0):
+    rs = np.random.default_rng(seed)
+    inliers = rs.normal(0, 1, size=(n - n_outliers, d))
+    outliers = rs.normal(0, 1, size=(n_outliers, d)) + 8.0
+    X = np.vstack([inliers, outliers]).astype(np.float32)
+    is_outlier = np.zeros(n, bool)
+    is_outlier[-n_outliers:] = True
+    return DataFrame.from_dict({"features": X, "truth": is_outlier}), is_outlier
+
+
+def test_isolation_forest_separates_outliers():
+    df, truth = make_anomaly_df()
+    model = IsolationForest(num_estimators=50, max_samples=64.0,
+                            contamination=10 / 300).fit(df)
+    out = model.transform(df)
+    scores = out.collect_column("outlierScore")
+    assert scores[truth].mean() > scores[~truth].mean() + 0.1
+    preds = out.collect_column("predictedLabel").astype(bool)
+    # most true outliers flagged
+    assert preds[truth].mean() > 0.8
+    assert preds[~truth].mean() < 0.1
+
+
+def test_isolation_forest_save_load(tmp_path):
+    df, _ = make_anomaly_df(n=100, n_outliers=5)
+    model = IsolationForest(num_estimators=20, contamination=0.05).fit(df)
+    before = model.transform(df).collect_column("outlierScore")
+    model.save(str(tmp_path / "if"))
+    after = IsolationForestModel.load(str(tmp_path / "if")).transform(df) \
+        .collect_column("outlierScore")
+    np.testing.assert_allclose(before, after)
+
+
+def test_feature_balance_measure():
+    rs = np.random.default_rng(0)
+    n = 2000
+    gender = rs.choice(["m", "f"], size=n)
+    # biased label: m positive 80%, f positive 20%
+    y = np.where(gender == "m", rs.random(n) < 0.8, rs.random(n) < 0.2).astype(int)
+    df = DataFrame.from_dict({"gender": gender, "label": y})
+    out = FeatureBalanceMeasure(sensitive_cols=["gender"]).transform(df)
+    row = out.collect_rows()[0]
+    # classes sorted: ClassA=f, ClassB=m -> dp = p(y|f) - p(y|m) ~ -0.6
+    assert row["ClassA"] == "f" and row["ClassB"] == "m"
+    assert row["dp"] == pytest.approx(-0.6, abs=0.07)
+    # balanced feature -> dp ~ 0
+    fair = DataFrame.from_dict({"gender": gender,
+                                "label": (rs.random(n) < 0.5).astype(int)})
+    row2 = FeatureBalanceMeasure(sensitive_cols=["gender"]).transform(fair).collect_rows()[0]
+    assert abs(row2["dp"]) < 0.07
+
+
+def test_distribution_balance_measure():
+    skewed = DataFrame.from_dict({"eth": np.asarray(["a"] * 90 + ["b"] * 10)})
+    uniform = DataFrame.from_dict({"eth": np.asarray(["a", "b"] * 50)})
+    m_skew = DistributionBalanceMeasure(sensitive_cols=["eth"]).transform(skewed).collect_rows()[0]
+    m_unif = DistributionBalanceMeasure(sensitive_cols=["eth"]).transform(uniform).collect_rows()[0]
+    for key in ("kl_divergence", "js_dist", "total_variation_dist", "chi_sq_stat"):
+        assert m_skew[key] > m_unif[key]
+        assert m_unif[key] == pytest.approx(0.0, abs=1e-9)
+    assert m_skew["total_variation_dist"] == pytest.approx(0.4, abs=1e-9)
+
+
+def test_aggregate_balance_measure():
+    perfectly_balanced = DataFrame.from_dict({"a": np.asarray(["x", "y"] * 50)})
+    out = AggregateBalanceMeasure(sensitive_cols=["a"]).transform(perfectly_balanced)
+    row = out.collect_rows()[0]
+    assert row["atkinson_index"] == pytest.approx(0.0, abs=1e-9)
+    assert row["theil_t_index"] == pytest.approx(0.0, abs=1e-9)
+    skew = DataFrame.from_dict({"a": np.asarray(["x"] * 99 + ["y"])})
+    row2 = AggregateBalanceMeasure(sensitive_cols=["a"]).transform(skew).collect_rows()[0]
+    assert row2["atkinson_index"] > 0.3
+    assert row2["theil_t_index"] > 0.3

@@ -212,3 +212,40 @@ def test_image_featurizer_headless(tmp_path):
                             mini_batch_size=4)
             .set(model_payload=data).transform(df))
     assert full.partitions[0]["features"].shape == (3, 2)
+
+
+def test_empty_partitions_keep_schema():
+    data, _ = make_mlp_bytes()
+    df = DataFrame.from_dict({"features": np.ones((2, 4), np.float32)},
+                             num_partitions=4)  # 2 empty partitions
+    om = ONNXModel(model_bytes=data, feed_dict={"x": "features"},
+                   fetch_dict={"probs": "probs"}, argmax_dict={"probs": "pred"})
+    out = om.transform(df)
+    assert out.count() == 2
+    assert len(out.collect_column("pred")) == 2
+
+
+def test_flatten_negative_axis_and_same_lower_pool():
+    g = GraphProto(name="f", node=[node("Flatten", ["x"], ["y"], axis=-1)],
+                   input=[ValueInfoProto(name="x", dims=[2, 3, 4])],
+                   output=[ValueInfoProto(name="y", dims=[6, 4])])
+    y = convert_graph(ModelProto(graph=g).encode())(x=np.zeros((2, 3, 4), np.float32))["y"]
+    assert np.asarray(y).shape == (6, 4)
+    g2 = GraphProto(name="p",
+                    node=[node("MaxPool", ["x"], ["y"], kernel_shape=[2, 2],
+                               strides=[2, 2], auto_pad="SAME_LOWER")],
+                    input=[ValueInfoProto(name="x", dims=["N", 1, 3, 3])],
+                    output=[ValueInfoProto(name="y", dims=["N", 1, 2, 2])])
+    x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    y2 = np.asarray(convert_graph(ModelProto(graph=g2).encode())(x=x)["y"])
+    assert y2.reshape(2, 2).tolist() == [[0.0, 2.0], [6.0, 8.0]]  # pad at begin
+
+
+def test_headless_without_tensor_name_raises():
+    data, _ = make_mlp_bytes()
+    rs = np.random.default_rng(0)
+    imgs = [rs.integers(0, 256, (8, 8, 3)).astype(np.float32)]
+    df = DataFrame.from_dict({"image": imgs})
+    feat = ImageFeaturizer(head_less=True).set(model_payload=data)
+    with pytest.raises(ValueError, match="feature_tensor_name"):
+        feat.transform(df)
