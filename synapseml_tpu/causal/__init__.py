@@ -1,0 +1,19 @@
+"""Causal inference toolkit (reference ``core/.../causal/`` — SURVEY.md §2.5):
+DoubleMLEstimator (cross-fitted ATE), OrthoForestDMLEstimator (heterogeneous
+effects), the diff-in-diff family (DiffInDiffEstimator, SyntheticControl,
+SyntheticDiffInDiff with simplex-constrained weight solvers — the reference's
+``causal/opt/{MirrorDescent,ConstrainedLeastSquare}.scala``), and
+ResidualTransformer."""
+
+from .dml import DoubleMLEstimator, DoubleMLModel, OrthoForestDMLEstimator, OrthoForestDMLModel
+from .did import DiffInDiffEstimator, SyntheticControlEstimator, SyntheticDiffInDiffEstimator
+from .residual import ResidualTransformer
+from .opt import constrained_least_squares, mirror_descent_simplex
+
+__all__ = [
+    "DoubleMLEstimator", "DoubleMLModel",
+    "OrthoForestDMLEstimator", "OrthoForestDMLModel",
+    "DiffInDiffEstimator", "SyntheticControlEstimator",
+    "SyntheticDiffInDiffEstimator", "ResidualTransformer",
+    "mirror_descent_simplex", "constrained_least_squares",
+]

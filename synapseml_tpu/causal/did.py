@@ -1,0 +1,147 @@
+"""Difference-in-differences family (reference ``causal/DiffInDiffEstimator``,
+``SyntheticControlEstimator``, ``SyntheticDiffInDiffEstimator:28``).
+
+DiD: OLS with the interaction term Y ~ treat + post + treat*post; the
+interaction coefficient is the effect, its OLS standard error is reported.
+
+Synthetic control: simplex unit weights fitted on pre-period control outcomes
+to match the treated pre-trajectory (``constrained_least_squares``); effect =
+post-period treated mean minus synthetic-control mean.
+
+Synthetic DiD: unit AND time simplex weights (both with ridge + intercept per
+Arkhangelsky et al.), effect from the weighted DiD regression.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+from .opt import constrained_least_squares
+
+__all__ = ["DiffInDiffEstimator", "SyntheticControlEstimator",
+           "SyntheticDiffInDiffEstimator", "DiffInDiffModel"]
+
+
+class DiffInDiffModel(Model):
+    treatment_effect = Param("treatment_effect", "estimated effect",
+                             converter=TypeConverters.to_float)
+    standard_error = Param("standard_error", "OLS standard error", default=None)
+    unit_weights = Param("unit_weights", "synthetic control unit weights",
+                         default=None)
+    time_weights = Param("time_weights", "synthetic DiD time weights", default=None)
+
+    def get_treatment_effect(self) -> float:
+        return self.get("treatment_effect")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return df.with_column(
+            "effect", lambda p: np.full(len(next(iter(p.values()))),
+                                        self.get("treatment_effect")))
+
+
+class _DiDBase(Estimator):
+    outcome_col = Param("outcome_col", "outcome column", default="outcome")
+    treatment_col = Param("treatment_col", "treatment-group indicator", default="treatment")
+    post_treatment_col = Param("post_treatment_col", "post-period indicator",
+                               default="postTreatment")
+
+
+class DiffInDiffEstimator(_DiDBase):
+    """(ref ``DiffInDiffEstimator.scala``)"""
+
+    feature_name = "causal"
+
+    def _fit(self, df: DataFrame) -> DiffInDiffModel:
+        self.require_columns(df, self.get("outcome_col"), self.get("treatment_col"),
+                             self.get("post_treatment_col"))
+        y = np.asarray(df.collect_column(self.get("outcome_col")), np.float64)
+        t = np.asarray(df.collect_column(self.get("treatment_col")), np.float64)
+        s = np.asarray(df.collect_column(self.get("post_treatment_col")), np.float64)
+        X = np.stack([np.ones_like(y), t, s, t * s], axis=1)
+        coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+        resid = y - X @ coef
+        dof = max(len(y) - X.shape[1], 1)
+        sigma2 = float(resid @ resid) / dof
+        cov = sigma2 * np.linalg.inv(X.T @ X)
+        return DiffInDiffModel(treatment_effect=float(coef[3]),
+                               standard_error=float(np.sqrt(cov[3, 3])))
+
+
+class SyntheticControlEstimator(_DiDBase):
+    """(ref ``SyntheticControlEstimator.scala``) — panel data: unit_col x
+    time_col grid; exactly one treated unit group, treatment starts when
+    post_treatment_col flips to 1."""
+
+    feature_name = "causal"
+
+    unit_col = Param("unit_col", "panel unit id column", default="unit")
+    time_col = Param("time_col", "panel time column", default="time")
+    ridge = Param("ridge", "weight-solver ridge", default=1e-6,
+                  converter=TypeConverters.to_float)
+
+    def _panel(self, df: DataFrame):
+        units = np.asarray(df.collect_column(self.get("unit_col")))
+        times = np.asarray(df.collect_column(self.get("time_col")))
+        y = np.asarray(df.collect_column(self.get("outcome_col")), np.float64)
+        treat = np.asarray(df.collect_column(self.get("treatment_col")), np.float64)
+        post = np.asarray(df.collect_column(self.get("post_treatment_col")), np.float64)
+        u_levels, u_idx = np.unique(units, return_inverse=True)
+        t_levels, t_idx = np.unique(times, return_inverse=True)
+        Y = np.zeros((len(u_levels), len(t_levels)))
+        Y[u_idx, t_idx] = y
+        treated_units = np.zeros(len(u_levels), bool)
+        treated_units[u_idx[treat > 0]] = True
+        post_times = np.zeros(len(t_levels), bool)
+        post_times[t_idx[post > 0]] = True
+        return Y, treated_units, post_times, u_levels, t_levels
+
+    def _fit(self, df: DataFrame) -> DiffInDiffModel:
+        self.require_columns(df, self.get("outcome_col"), self.get("treatment_col"),
+                             self.get("post_treatment_col"), self.get("unit_col"),
+                             self.get("time_col"))
+        Y, treated, post, _, _ = self._panel(df)
+        pre = ~post
+        ctrl = Y[~treated]
+        target = Y[treated].mean(axis=0)
+        w, _ = constrained_least_squares(ctrl[:, pre].T, target[pre],
+                                         ridge=self.get("ridge"))
+        synth_post = w @ ctrl[:, post]
+        effect = float(target[post].mean() - synth_post.mean())
+        return DiffInDiffModel(treatment_effect=effect,
+                               unit_weights=w.tolist())
+
+
+class SyntheticDiffInDiffEstimator(SyntheticControlEstimator):
+    """(ref ``SyntheticDiffInDiffEstimator.scala:28``)"""
+
+    feature_name = "causal"
+
+    def _fit(self, df: DataFrame) -> DiffInDiffModel:
+        self.require_columns(df, self.get("outcome_col"), self.get("treatment_col"),
+                             self.get("post_treatment_col"), self.get("unit_col"),
+                             self.get("time_col"))
+        Y, treated, post, _, _ = self._panel(df)
+        pre = ~post
+        ctrl, trt = Y[~treated], Y[treated]
+        target = trt.mean(axis=0)
+        # unit weights: match treated pre-trajectory with intercept (sdid)
+        w_unit, _ = constrained_least_squares(ctrl[:, pre].T, target[pre],
+                                              ridge=self.get("ridge"),
+                                              fit_intercept=True)
+        # time weights: pre-periods predicting the post-period average
+        post_avg = ctrl[:, post].mean(axis=1)
+        w_time, _ = constrained_least_squares(ctrl[:, pre], post_avg,
+                                              ridge=self.get("ridge"),
+                                              fit_intercept=True)
+        # weighted DiD
+        trt_post = target[post].mean()
+        trt_pre = float(w_time @ target[pre])
+        ctrl_post = float(w_unit @ ctrl[:, post].mean(axis=1))
+        ctrl_pre = float(w_unit @ (ctrl[:, pre] @ w_time))
+        effect = (trt_post - trt_pre) - (ctrl_post - ctrl_pre)
+        return DiffInDiffModel(treatment_effect=float(effect),
+                               unit_weights=w_unit.tolist(),
+                               time_weights=w_time.tolist())

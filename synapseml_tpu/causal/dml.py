@@ -1,0 +1,248 @@
+"""Double machine learning (reference ``causal/DoubleMLEstimator.scala:63``,
+``OrthoForestDMLEstimator.scala:31``).
+
+DoubleML: cross-fitted partially-linear model. Per sample-split iteration:
+fit outcome model E[Y|X] and treatment model E[T|X] on fold A, residualize
+fold B (and vice versa), then ATE = sum(res_t * res_y) / sum(res_t^2) over
+the residualized data. Repeated over ``max_iter`` random splits; the final
+ATE is the median (the reference averages percentiles) and the confidence
+interval comes from the percentile distribution of per-split estimates.
+
+OrthoForestDML: heterogeneous (per-row) effects — residualize exactly like
+DML, then fit a depth-limited regression tree on heterogeneity features where
+each leaf's value is the local ratio sum(res_t*res_y)/sum(res_t^2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model, Transformer
+
+__all__ = ["DoubleMLEstimator", "DoubleMLModel",
+           "OrthoForestDMLEstimator", "OrthoForestDMLModel"]
+
+
+def _predictions(model: Transformer, df: DataFrame, pred_col_hint: str | None = None) -> np.ndarray:
+    scored = model.transform(df)
+    for col in ([pred_col_hint] if pred_col_hint else []) + ["probability", "prediction"]:
+        if col and col in scored.columns:
+            vals = scored.collect_column(col)
+            if vals.dtype == object or (len(vals) and hasattr(vals[0], "__len__")):
+                arr = np.stack([np.atleast_1d(np.asarray(v, np.float64)) for v in vals])
+                return arr[:, -1] if arr.shape[1] > 1 else arr[:, 0]
+            return np.asarray(vals, np.float64)
+    raise ValueError(f"no prediction column found in {scored.columns}")
+
+
+def _residualize(df: DataFrame, outcome_model_est, treatment_model_est,
+                 outcome_col: str, treatment_col: str, folds: tuple,
+                 pred_col: str | None) -> tuple[np.ndarray, np.ndarray]:
+    """Cross-fit: model trained on the other fold predicts this fold."""
+    y = np.asarray(df.collect_column(outcome_col), np.float64)
+    t = np.asarray(df.collect_column(treatment_col), np.float64)
+    whole = df.collect()
+    res_y = np.zeros_like(y)
+    res_t = np.zeros_like(t)
+    for fold_idx, other_idx in (folds, folds[::-1]):
+        train = DataFrame([{k: v[other_idx] for k, v in whole.items()}])
+        test = DataFrame([{k: v[fold_idx] for k, v in whole.items()}])
+        om = outcome_model_est.copy().fit(train)
+        tm = treatment_model_est.copy().fit(train)
+        res_y[fold_idx] = y[fold_idx] - _predictions(om, test, pred_col)
+        res_t[fold_idx] = t[fold_idx] - _predictions(tm, test, pred_col)
+    return res_y, res_t
+
+
+class DoubleMLEstimator(Estimator):
+    """(ref ``DoubleMLEstimator.scala:63``)"""
+
+    feature_name = "causal"
+
+    outcome_model = ComplexParam("outcome_model", "estimator for E[Y|X]")
+    treatment_model = ComplexParam("treatment_model", "estimator for E[T|X]")
+    outcome_col = Param("outcome_col", "outcome column", default="outcome")
+    treatment_col = Param("treatment_col", "treatment column", default="treatment")
+    max_iter = Param("max_iter", "number of sample-splitting repetitions",
+                     default=1, converter=TypeConverters.to_int)
+    confidence_level = Param("confidence_level", "CI level", default=0.975,
+                             converter=TypeConverters.to_float)
+    prediction_col = Param("prediction_col", "nuisance models' output column",
+                           default=None)
+    seed = Param("seed", "rng seed", default=0, converter=TypeConverters.to_int)
+
+    def _fit(self, df: DataFrame) -> "DoubleMLModel":
+        self.require_columns(df, self.get("outcome_col"), self.get("treatment_col"))
+        n = df.count()
+        rng = np.random.default_rng(self.get("seed"))
+        estimates = []
+        for _ in range(self.get("max_iter")):
+            perm = rng.permutation(n)
+            half = n // 2
+            folds = (np.sort(perm[:half]), np.sort(perm[half:]))
+            res_y, res_t = _residualize(
+                df, self.get("outcome_model"), self.get("treatment_model"),
+                self.get("outcome_col"), self.get("treatment_col"), folds,
+                self.get("prediction_col"))
+            denom = float(res_t @ res_t)
+            if denom < 1e-12:
+                continue
+            estimates.append(float(res_t @ res_y) / denom)
+        if not estimates:
+            raise RuntimeError("DoubleML: treatment residuals are all ~0 "
+                               "(treatment fully predictable from confounders?)")
+        estimates = np.asarray(estimates)
+        level = self.get("confidence_level")
+        lo, hi = (np.percentile(estimates, [(1 - level) * 100, level * 100])
+                  if len(estimates) > 1 else (estimates[0], estimates[0]))
+        return DoubleMLModel(ate=float(np.median(estimates)),
+                             ci=[float(lo), float(hi)],
+                             raw_estimates=estimates.tolist())
+
+
+class DoubleMLModel(Model):
+    ate = Param("ate", "average treatment effect", converter=TypeConverters.to_float)
+    ci = ComplexParam("ci", "[low, high] percentile confidence interval")
+    raw_estimates = ComplexParam("raw_estimates", "per-split ATE estimates")
+
+    def get_avg_treatment_effect(self) -> float:
+        return self.get("ate")
+
+    def get_confidence_interval(self) -> list:
+        return list(self.get("ci"))
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return df.with_column("effect",
+                              lambda p: np.full(len(next(iter(p.values()))),
+                                                self.get("ate")))
+
+
+# ---------------------------------------------------------------------------
+# Ortho forest (heterogeneous effects)
+# ---------------------------------------------------------------------------
+
+def _grow_effect_tree(H: np.ndarray, res_y: np.ndarray, res_t: np.ndarray,
+                      max_depth: int, min_leaf: int):
+    """Regression tree on heterogeneity features H; leaf value = local DML
+    ratio. Split criterion: maximize variance of the child effects."""
+    feature, threshold, left, right, value = [], [], [], [], []
+
+    def effect(idx):
+        denom = float(res_t[idx] @ res_t[idx])
+        return float(res_t[idx] @ res_y[idx]) / denom if denom > 1e-12 else 0.0
+
+    def grow(idx: np.ndarray, depth: int) -> int:
+        node = len(feature)
+        feature.append(-1)
+        threshold.append(0.0)
+        left.append(-1)
+        right.append(-1)
+        value.append(effect(idx))
+        if depth >= max_depth or len(idx) < 2 * min_leaf:
+            return node
+        best = None
+        for f in range(H.shape[1]):
+            vals = H[idx, f]
+            for q in np.quantile(vals, [0.25, 0.5, 0.75]):
+                lmask = vals <= q
+                nl, nr = int(lmask.sum()), int((~lmask).sum())
+                if nl < min_leaf or nr < min_leaf:
+                    continue
+                el, er = effect(idx[lmask]), effect(idx[~lmask])
+                score = nl * nr * (el - er) ** 2
+                if best is None or score > best[0]:
+                    best = (score, f, q, lmask)
+        if best is None or best[0] <= 0:
+            return node
+        _, f, q, lmask = best
+        feature[node] = f
+        threshold[node] = float(q)
+        left[node] = grow(idx[lmask], depth + 1)
+        right[node] = grow(idx[~lmask], depth + 1)
+        return node
+
+    grow(np.arange(len(H)), 0)
+    return (np.asarray(feature, np.int32), np.asarray(threshold, np.float64),
+            np.asarray(left, np.int32), np.asarray(right, np.int32),
+            np.asarray(value, np.float64))
+
+
+def _tree_predict(H: np.ndarray, tree) -> np.ndarray:
+    feature, threshold, left, right, value = tree
+    node = np.zeros(len(H), np.int32)
+    active = feature[node] >= 0
+    while np.any(active):
+        rows = np.nonzero(active)[0]
+        cur = node[rows]
+        go_left = H[rows, feature[cur]] <= threshold[cur]
+        node[rows] = np.where(go_left, left[cur], right[cur])
+        active = feature[node] >= 0
+    return value[node]
+
+
+class OrthoForestDMLEstimator(Estimator):
+    """(ref ``OrthoForestDMLEstimator.scala:31``)"""
+
+    feature_name = "causal"
+
+    outcome_model = ComplexParam("outcome_model", "estimator for E[Y|X]")
+    treatment_model = ComplexParam("treatment_model", "estimator for E[T|X]")
+    outcome_col = Param("outcome_col", "outcome column", default="outcome")
+    treatment_col = Param("treatment_col", "treatment column", default="treatment")
+    heterogeneity_cols = ComplexParam("heterogeneity_cols",
+                                      "columns the effect may vary over")
+    num_trees = Param("num_trees", "trees in the effect forest", default=20,
+                      converter=TypeConverters.to_int)
+    max_depth = Param("max_depth", "effect tree depth", default=3,
+                      converter=TypeConverters.to_int)
+    min_samples_leaf = Param("min_samples_leaf", "min rows per leaf", default=10,
+                             converter=TypeConverters.to_int)
+    output_col = Param("output_col", "per-row effect column", default="effect")
+    prediction_col = Param("prediction_col", "nuisance models' output column",
+                           default=None)
+    seed = Param("seed", "rng seed", default=0, converter=TypeConverters.to_int)
+
+    def _fit(self, df: DataFrame) -> "OrthoForestDMLModel":
+        hcols = self.get("heterogeneity_cols")
+        self.require_columns(df, self.get("outcome_col"), self.get("treatment_col"),
+                             *hcols)
+        n = df.count()
+        rng = np.random.default_rng(self.get("seed"))
+        perm = rng.permutation(n)
+        half = n // 2
+        folds = (np.sort(perm[:half]), np.sort(perm[half:]))
+        res_y, res_t = _residualize(
+            df, self.get("outcome_model"), self.get("treatment_model"),
+            self.get("outcome_col"), self.get("treatment_col"), folds,
+            self.get("prediction_col"))
+        H = np.stack([np.asarray(df.collect_column(c), np.float64) for c in hcols],
+                     axis=1)
+        trees = []
+        for _ in range(self.get("num_trees")):
+            idx = rng.integers(0, n, n)  # bootstrap
+            trees.append(_grow_effect_tree(H[idx], res_y[idx], res_t[idx],
+                                           self.get("max_depth"),
+                                           self.get("min_samples_leaf")))
+        return OrthoForestDMLModel(trees=trees, heterogeneity_cols=list(hcols),
+                                   output_col=self.get("output_col"))
+
+
+class OrthoForestDMLModel(Model):
+    trees = ComplexParam("trees", "effect forest (flat arrays)")
+    heterogeneity_cols = ComplexParam("heterogeneity_cols", "effect feature columns")
+    output_col = Param("output_col", "per-row effect column", default="effect")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        hcols = self.get("heterogeneity_cols")
+        self.require_columns(df, *hcols)
+
+        def per_part(p):
+            H = np.stack([np.asarray(p[c], np.float64) for c in hcols], axis=1)
+            preds = np.mean([_tree_predict(H, t) for t in self.get("trees")], axis=0)
+            q = dict(p)
+            q[self.get("output_col")] = preds
+            return q
+
+        return df.map_partitions(per_part)

@@ -1,0 +1,40 @@
+"""ResidualTransformer (reference ``causal/ResidualTransformer.scala``):
+residual column = observed - predicted (class-1 probability when the
+prediction column holds probability vectors)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, TypeConverters
+from ..core.pipeline import Transformer
+
+__all__ = ["ResidualTransformer"]
+
+
+class ResidualTransformer(Transformer):
+    feature_name = "causal"
+
+    observed_col = Param("observed_col", "observed outcome column", default="label")
+    predicted_col = Param("predicted_col", "prediction column", default="prediction")
+    output_col = Param("output_col", "residual column", default="residual")
+    class_index = Param("class_index", "probability index when predictions are vectors",
+                        default=1, converter=TypeConverters.to_int)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("observed_col"), self.get("predicted_col"))
+
+        def resid(p):
+            obs = np.asarray(p[self.get("observed_col")], np.float64)
+            pred = p[self.get("predicted_col")]
+            if pred.dtype == object or (hasattr(pred[0], "__len__")
+                                        and not np.isscalar(pred[0])):
+                arr = np.stack([np.atleast_1d(np.asarray(v, np.float64)) for v in pred])
+                idx = min(self.get("class_index"), arr.shape[1] - 1)
+                pred = arr[:, idx]
+            else:
+                pred = np.asarray(pred, np.float64)
+            return obs - pred
+
+        return df.with_column(self.get("output_col"), resid)

@@ -162,6 +162,12 @@ class Params(metaclass=_ParamsMeta):
                 raise KeyError(f"{type(self).__name__} has no param {k!r}; "
                                f"available: {sorted(self._param_registry)}")
             self._param_values[k] = self._param_registry[k].coerce(v)
+        if kwargs:
+            # runtime caches (jitted closures etc.) live in __dict__ under
+            # "_cache_*" keys; any param change invalidates them so a baked-in
+            # param value can never go stale (stages advertise mutability)
+            for key in [k for k in self.__dict__ if k.startswith("_cache_")]:
+                del self.__dict__[key]
         return self
 
     def clear(self, name: str) -> "Params":

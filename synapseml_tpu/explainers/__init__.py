@@ -1,0 +1,23 @@
+"""Model-agnostic local explainers (responsible AI).
+
+Reference: ``core/.../explainers/`` (SURVEY.md §2.5) — ``LIMEBase:137`` +
+Tabular/Vector/Image/Text LIME, ``KernelSHAPBase:37`` + variants, samplers,
+a lasso solver on breeze, and ``ICETransformer:126`` (ICE/PDP).
+
+TPU design: all perturbed samples for a whole partition are scored in ONE
+model.transform call (the underlying model batches them onto the device —
+SURVEY.md §7 step 8's "perturbation batches through the TPU inference path"),
+then the local weighted linear models are solved per row with vectorized
+numpy/jax least squares.
+"""
+
+from .lasso import lasso_regression, weighted_least_squares
+from .lime import ImageLIME, TabularLIME, TextLIME, VectorLIME
+from .shap import ImageSHAP, TabularSHAP, TextSHAP, VectorSHAP
+from .ice import ICETransformer
+
+__all__ = [
+    "TabularLIME", "VectorLIME", "ImageLIME", "TextLIME",
+    "TabularSHAP", "VectorSHAP", "ImageSHAP", "TextSHAP",
+    "ICETransformer", "lasso_regression", "weighted_least_squares",
+]

@@ -1,0 +1,46 @@
+"""Shared machinery for local explainers (reference ``explainers/LIMEBase.scala``
+/ ``KernelSHAPBase.scala`` common structure: sample -> score through the model
+-> fit local surrogate per row)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Transformer
+
+__all__ = ["LocalExplainerBase"]
+
+
+class LocalExplainerBase(Transformer):
+    """Common params + the one-shot scoring path: ALL samples for a partition
+    go through model.transform in a single DataFrame."""
+
+    model = ComplexParam("model", "fitted Transformer to explain")
+    target_col = Param("target_col", "model output column holding scores",
+                       default="probability")
+    target_classes = ComplexParam("target_classes",
+                                  "class indices to explain (default [0])",
+                                  default=None)
+    output_col = Param("output_col", "explanation column", default="explanation")
+    num_samples = Param("num_samples", "perturbations per row", default=256,
+                        converter=TypeConverters.to_int)
+    seed = Param("seed", "rng seed", default=0, converter=TypeConverters.to_int)
+
+    def _score_samples(self, sample_df: DataFrame) -> np.ndarray:
+        """Run the wrapped model; returns [n_samples_total, n_targets]."""
+        scored = self.get("model").transform(sample_df)
+        col = scored.collect_column(self.get("target_col"))
+        arr = np.asarray(np.stack([np.atleast_1d(np.asarray(v, np.float64))
+                                   for v in col]))
+        targets = self.get("target_classes") or [0]
+        idx = [t if t < arr.shape[1] else arr.shape[1] - 1 for t in targets]
+        return arr[:, idx]
+
+    @staticmethod
+    def _pack_explanations(coef_rows: list) -> np.ndarray:
+        out = np.empty(len(coef_rows), dtype=object)
+        for i, c in enumerate(coef_rows):
+            out[i] = np.asarray(c, np.float32)
+        return out

@@ -1,0 +1,56 @@
+"""Weighted linear solvers for the local surrogate models.
+
+Reference: ``explainers/LassoRegression.scala`` (90 LoC on breeze) — weighted
+lasso via coordinate descent — and the weighted least squares KernelSHAP uses.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["lasso_regression", "weighted_least_squares"]
+
+
+def weighted_least_squares(X: np.ndarray, y: np.ndarray, w: np.ndarray,
+                           ridge: float = 1e-8) -> tuple[np.ndarray, float]:
+    """argmin_b sum_i w_i (y_i - b0 - X_i b)^2. Returns (coefs, intercept)."""
+    Xb = np.concatenate([X, np.ones((len(X), 1))], axis=1)
+    W = w[:, None]
+    A = Xb.T @ (W * Xb) + ridge * np.eye(Xb.shape[1])
+    b = Xb.T @ (w * y)
+    sol = np.linalg.solve(A, b)
+    return sol[:-1], float(sol[-1])
+
+
+def lasso_regression(X: np.ndarray, y: np.ndarray, w: np.ndarray,
+                     alpha: float = 0.01, n_iter: int = 200,
+                     tol: float = 1e-7) -> tuple[np.ndarray, float]:
+    """Weighted lasso by cyclic coordinate descent with soft thresholding
+    (the reference's breeze solver, ``LassoRegression.scala``)."""
+    n, d = X.shape
+    w = np.asarray(w, np.float64)
+    sw = w.sum() or 1.0
+    # center by weighted means so the intercept drops out of the descent
+    x_mean = (w[:, None] * X).sum(0) / sw
+    y_mean = float((w * y).sum() / sw)
+    Xc = X - x_mean
+    yc = y - y_mean
+    beta = np.zeros(d)
+    col_sq = (w[:, None] * Xc * Xc).sum(0)
+    resid = yc - Xc @ beta
+    for _ in range(n_iter):
+        max_delta = 0.0
+        for j in range(d):
+            if col_sq[j] <= 1e-12:
+                continue
+            rho = float((w * (resid + Xc[:, j] * beta[j]) * Xc[:, j]).sum())
+            new_b = np.sign(rho) * max(abs(rho) - alpha * sw, 0.0) / col_sq[j]
+            delta = new_b - beta[j]
+            if delta != 0.0:
+                resid -= Xc[:, j] * delta
+                beta[j] = new_b
+                max_delta = max(max_delta, abs(delta))
+        if max_delta < tol:
+            break
+    intercept = y_mean - float(x_mean @ beta)
+    return beta, intercept

@@ -1,0 +1,225 @@
+"""KernelSHAP — Shapley values via the weighted-least-squares kernel trick.
+
+Reference: ``explainers/KernelSHAPBase.scala:37`` + variants and
+``KernelSHAPSampler.scala``: sample coalitions z in {0,1}^M with Shapley-kernel
+weights pi(z) = (M-1) / (C(M,|z|) |z| (M-|z|)), score f(h(z)), solve the
+constrained weighted regression so that phi0 = f(background) and
+sum(phi) + phi0 = f(x).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from .base import LocalExplainerBase
+from .lasso import weighted_least_squares
+
+__all__ = ["TabularSHAP", "VectorSHAP", "ImageSHAP", "TextSHAP"]
+
+
+def shapley_kernel_weight(M: int, s: int) -> float:
+    if s == 0 or s == M:
+        return 1e6  # enforced almost exactly (reference uses infinite weight)
+    return (M - 1) / (math.comb(M, s) * s * (M - s))
+
+
+def sample_coalitions(M: int, n_samples: int, rng) -> tuple[np.ndarray, np.ndarray]:
+    """[S, M] binary coalition matrix + kernel weights; always includes the
+    empty and full coalitions (they pin phi0 and the efficiency constraint)."""
+    states = [np.zeros(M, bool), np.ones(M, bool)]
+    weights = [shapley_kernel_weight(M, 0), shapley_kernel_weight(M, M)]
+    # enumerate when feasible, sample otherwise (reference sampler behavior)
+    if 2 ** M <= n_samples:
+        for code in range(1, 2 ** M - 1):
+            z = np.asarray([(code >> b) & 1 for b in range(M)], bool)
+            states.append(z)
+            weights.append(shapley_kernel_weight(M, int(z.sum())))
+    else:
+        sizes = np.arange(1, M)
+        size_w = np.asarray([shapley_kernel_weight(M, s) * math.comb(M, s)
+                             for s in sizes])
+        size_p = size_w / size_w.sum()
+        for _ in range(n_samples - 2):
+            s = rng.choice(sizes, p=size_p)
+            z = np.zeros(M, bool)
+            z[rng.choice(M, size=s, replace=False)] = True
+            states.append(z)
+            weights.append(shapley_kernel_weight(M, s))
+    return np.asarray(states), np.asarray(weights, np.float64)
+
+
+def solve_shap(Z: np.ndarray, y: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """Weighted least squares on the coalition design; returns [M+1] with
+    phi0 last."""
+    coefs, intercept = weighted_least_squares(Z.astype(np.float64), y, w)
+    return np.concatenate([coefs, [intercept]])
+
+
+class _KernelSHAPBase(LocalExplainerBase):
+    def _explain_rows(self, make_samples, K_of_row, rows, score_input_builder):
+        """Shared loop: rows -> coalitions -> model scores -> phi vectors."""
+        rng = np.random.default_rng(self.get("seed"))
+        S = self.get("num_samples")
+        expl = []
+        for r in rows:
+            K = K_of_row(r)
+            states, w = sample_coalitions(K, S, rng)
+            samples = make_samples(r, states)
+            scores = self._score_samples(score_input_builder(samples))
+            phis = [solve_shap(states, scores[:, t], w)
+                    for t in range(scores.shape[1])]
+            expl.append(np.stack(phis))  # [T, K+1]
+        return expl
+
+
+class VectorSHAP(_KernelSHAPBase):
+    """(ref ``VectorSHAP.scala``) feature-vector rows; off features are
+    replaced by the background mean (or sampled background rows)."""
+
+    feature_name = "explainers"
+
+    input_col = Param("input_col", "feature vector column", default="features")
+    background_data = ComplexParam("background_data", "background DataFrame",
+                                   default=None)
+
+    def _background(self, df: DataFrame) -> np.ndarray:
+        bg = self.get("background_data") or df
+        X = np.stack([np.asarray(v, np.float64)
+                      for v in bg.collect_column(self.get("input_col"))])
+        return X.mean(axis=0)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        bg = self._background(df)
+
+        def per_part(p):
+            X = np.stack([np.asarray(v, np.float64) for v in p[self.get("input_col")]])
+
+            expl = self._explain_rows(
+                make_samples=lambda x, states: np.where(states, x[None, :], bg[None, :]),
+                K_of_row=lambda x: len(x),
+                rows=list(X),
+                score_input_builder=lambda samples: DataFrame.from_dict(
+                    {self.get("input_col"): samples.astype(np.float32)}),
+            )
+            q = dict(p)
+            q[self.get("output_col")] = self._pack_explanations(expl)
+            return q
+
+        return df.map_partitions(per_part)
+
+
+class TabularSHAP(VectorSHAP):
+    """(ref ``TabularSHAP.scala``) named numeric columns."""
+
+    input_cols = ComplexParam("input_cols", "numeric feature columns")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        cols = self.get("input_cols")
+        self.require_columns(df, *cols)
+        vec_col = "_shap_features"
+        assembled = df.with_column(
+            vec_col, lambda p: np.stack([np.asarray(p[c], np.float32) for c in cols], axis=1))
+        inner_model = self.get("model")
+
+        class _Unpack:
+            def transform(self_inner, sdf: DataFrame) -> DataFrame:
+                X = np.asarray(np.stack(list(sdf.collect_column(vec_col))))
+                return inner_model.transform(DataFrame.from_dict(
+                    {c: X[:, i] for i, c in enumerate(cols)}))
+
+        proxy = self.copy()
+        proxy.set(model=_Unpack(), input_col=vec_col)
+        if self.get("background_data") is not None:
+            bgd = self.get("background_data")
+            proxy.set(background_data=bgd.with_column(
+                vec_col, lambda p: np.stack([np.asarray(p[c], np.float32) for c in cols], axis=1)))
+        out = VectorSHAP._transform(proxy, assembled)
+        return out.drop(vec_col)
+
+
+class ImageSHAP(_KernelSHAPBase):
+    """(ref ``ImageSHAP.scala``) superpixels as players; off superpixels
+    blanked to the image mean color."""
+
+    feature_name = "explainers"
+
+    input_col = Param("input_col", "image column", default="image")
+    cell_size = Param("cell_size", "SLIC seed pitch", default=16.0,
+                      converter=TypeConverters.to_float)
+    modifier = Param("modifier", "SLIC color weight", default=130.0,
+                     converter=TypeConverters.to_float)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        from ..image.superpixel import slic_segments
+        from ..image.transforms import as_image
+
+        self.require_columns(df, self.get("input_col"))
+
+        def per_part(p):
+            imgs = [as_image(v) for v in p[self.get("input_col")]]
+            expl = []
+            for im in imgs:
+                labels = slic_segments(im, self.get("cell_size"), self.get("modifier"))
+                fill = im.mean(axis=(0, 1))
+
+                def make_samples(_, states, im=im, labels=labels, fill=fill):
+                    masks = states[:, labels]              # [S, H, W]
+                    return np.where(masks[:, :, :, None], im[None], fill[None, None, None, :])
+
+                phis = self._explain_rows(
+                    make_samples=make_samples,
+                    K_of_row=lambda _im, K=int(labels.max()) + 1: K,
+                    rows=[im],
+                    score_input_builder=lambda samples: DataFrame.from_dict(
+                        {self.get("input_col"): [s for s in samples]}),
+                )
+                expl.extend(phis)
+            q = dict(p)
+            q[self.get("output_col")] = self._pack_explanations(expl)
+            return q
+
+        return df.map_partitions(per_part)
+
+
+class TextSHAP(_KernelSHAPBase):
+    """(ref ``TextSHAP.scala``) tokens as players; off tokens dropped."""
+
+    feature_name = "explainers"
+
+    input_col = Param("input_col", "text column", default="text")
+    token_col = Param("token_col", "token list output column", default="tokens")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+
+        def per_part(p):
+            texts = [str(t) for t in p[self.get("input_col")]]
+            expl = []
+            token_rows = np.empty(len(texts), dtype=object)
+            for r, text in enumerate(texts):
+                tokens = text.split()
+                token_rows[r] = np.asarray(tokens, dtype=object)
+
+                def make_samples(_, states, tokens=tokens):
+                    return [" ".join(t for t, on in zip(tokens, st) if on)
+                            for st in states]
+
+                phis = self._explain_rows(
+                    make_samples=make_samples,
+                    K_of_row=lambda _t, K=max(len(tokens), 1): K,
+                    rows=[text],
+                    score_input_builder=lambda samples: DataFrame.from_dict(
+                        {self.get("input_col"): samples}),
+                )
+                expl.extend(phis)
+            q = dict(p)
+            q[self.get("output_col")] = self._pack_explanations(expl)
+            q[self.get("token_col")] = token_rows
+            return q
+
+        return df.map_partitions(per_part)

@@ -81,7 +81,10 @@ class FeatureBalanceMeasure(Transformer):
                     rows["ClassB"].append(b)
                     measure_rows.append(self._pair_measures(pa, pb, pa_y, pb_y, py))
         out = {k: np.asarray(v) for k, v in rows.items()}
-        for key in (measure_rows[0] if measure_rows else {}):
+        # static measure schema even with zero class pairs (schema stability)
+        keys = (list(measure_rows[0]) if measure_rows
+                else list(self._pair_measures(0.5, 0.5, 0.25, 0.25, 0.5)))
+        for key in keys:
             out[key] = np.asarray([m[key] for m in measure_rows])
         return DataFrame([out])
 
@@ -123,7 +126,9 @@ class DistributionBalanceMeasure(Transformer):
             out["FeatureName"].append(col)
             measures.append(self._measures(counts.astype(float)))
         result = {"FeatureName": np.asarray(out["FeatureName"])}
-        for key in (measures[0] if measures else {}):
+        keys = (list(measures[0]) if measures
+                else list(self._measures(np.asarray([1.0]))))
+        for key in keys:
             result[key] = np.asarray([m[key] for m in measures])
         return DataFrame([result])
 

@@ -66,43 +66,51 @@ class KNNModel(Model):
         import jax
         import jax.numpy as jnp
 
-        if self.__dict__.get("_jitted") is None:
+        if self.__dict__.get("_cache_jitted") is None:
             X = jnp.asarray(self.get("index"))           # [N, D]
             x_sq = jnp.sum(X * X, axis=1)                # [N]
             k = min(self.get("k"), X.shape[0])
 
-            def fn(Q, mask_bias):
+            def fn(Q, mask_bias=None):
                 # [Q, N] squared distances via one MXU matmul
                 d = (jnp.sum(Q * Q, axis=1, keepdims=True)
-                     - 2.0 * Q @ X.T + x_sq[None, :]) + mask_bias
+                     - 2.0 * Q @ X.T + x_sq[None, :])
+                if mask_bias is not None:
+                    d = d + mask_bias
                 neg_d, idx = jax.lax.top_k(-d, k)
                 return -neg_d, idx
 
-            self.__dict__["_jitted"] = jax.jit(fn)
-        return self.__dict__["_jitted"]
+            self.__dict__["_cache_jitted"] = (jax.jit(fn),
+                                              jax.jit(lambda Q, b: fn(Q, b)))
+        return self.__dict__["_cache_jitted"]
 
-    def _match_bias(self, p, n: int) -> np.ndarray:
-        """[rows, N] additive bias (0 = allowed); plain KNN allows everything."""
-        return np.zeros((n, len(self.get("index"))), np.float32)
+    def _match_bias(self, p, s: int, e: int) -> np.ndarray | None:
+        """[e-s, N] additive bias (0 = allowed) for one query batch;
+        None (plain KNN) means everything is allowed — no bias matrix is
+        materialized or shipped to the device."""
+        return None
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("features_col"))
         vals = self.get("values")
         labels = self.get("labels")
         B = self.get("query_batch")
-        fn = self._topk_fn()
+        fn_plain, fn_bias = self._topk_fn()
 
         def per_part(p):
             Q = _stack_features(p[self.get("features_col")])
             n = len(Q)
-            bias = self._match_bias(p, n)
             matches = np.empty(n, dtype=object)
             for s in range(0, n, B):
                 e = min(s + B, n)
                 pad = B - (e - s)
                 Qb = np.pad(Q[s:e], ((0, pad), (0, 0)))
-                Bb = np.pad(bias[s:e], ((0, pad), (0, 0)))
-                dist, idx = (np.asarray(a) for a in fn(Qb, Bb))
+                bias = self._match_bias(p, s, e)
+                if bias is None:
+                    out = fn_plain(Qb)
+                else:
+                    out = fn_bias(Qb, np.pad(bias, ((0, pad), (0, 0))))
+                dist, idx = (np.asarray(a) for a in out)
                 for i in range(e - s):
                     row = []
                     for d, j in zip(dist[i], idx[i]):
@@ -147,11 +155,11 @@ class ConditionalKNNModel(KNNModel):
     conditioner_col = Param("conditioner_col", "column of allowed-label sets",
                             default="conditioner")
 
-    def _match_bias(self, p, n: int) -> np.ndarray:
+    def _match_bias(self, p, s: int, e: int) -> np.ndarray:
         labels = np.asarray(self.get("labels"))
-        conds = p[self.get("conditioner_col")]
-        bias = np.full((n, len(labels)), _INF, np.float32)
-        for i in range(n):
+        conds = p[self.get("conditioner_col")][s:e]
+        bias = np.full((e - s, len(labels)), _INF, np.float32)
+        for i in range(e - s):
             allowed = conds[i]
             allowed = {allowed} if np.isscalar(allowed) else set(np.asarray(allowed).tolist())
             bias[i, np.isin(labels, list(allowed))] = 0.0

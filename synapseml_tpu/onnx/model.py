@@ -95,7 +95,7 @@ class ONNXModel(Transformer):
     # behind lazy accessors, never as __init__-assigned attributes.
     @property
     def _jit_cache_map(self) -> dict:
-        return self.__dict__.setdefault("_jit_cache", {})
+        return self.__dict__.setdefault("_cache_jit", {})
 
     # -------- model management --------
     def set_model_location(self, path: str) -> "ONNXModel":
@@ -107,18 +107,18 @@ class ONNXModel(Transformer):
         ref ``ONNXModel.setSliceAtOutputs`` / ImageFeaturizer ``extraPorts``)."""
         self.set(model_payload=slice_model_at_outputs(self.get("model_payload"),
                                                       list(output_names)))
-        self.__dict__.pop("_converted", None)
+        self.__dict__.pop("_cache_converted", None)
         self._jit_cache_map.clear()
         return self
 
     @property
     def converted(self) -> ConvertedModel:
-        if self.__dict__.get("_converted") is None:
+        if self.__dict__.get("_cache_converted") is None:
             payload = self.get("model_payload")
             if payload is None:
                 raise ValueError("ONNXModel: model_payload not set")
-            self.__dict__["_converted"] = ConvertedModel(parse_model(payload))
-        return self.__dict__["_converted"]
+            self.__dict__["_cache_converted"] = ConvertedModel(parse_model(payload))
+        return self.__dict__["_cache_converted"]
 
     @property
     def model_input_names(self) -> list[str]:
@@ -186,11 +186,7 @@ class ONNXModel(Transformer):
         def per_part(p):
             n = len(next(iter(p.values()))) if p else 0
             if n == 0:
-                # keep the schema consistent across partitions
-                q = dict(p)
-                for col in out_cols:
-                    q[col] = np.empty(0)
-                return q
+                return None  # placeholders filled from a non-empty partition
             cols_in = {name: np.asarray(np.stack(list(p[col])))
                        if p[col].dtype == object else np.asarray(p[col])
                        for name, col in feeds.items()}
@@ -212,4 +208,21 @@ class ONNXModel(Transformer):
                 q[col] = np.concatenate(chunks, axis=0) if chunks else np.empty(0)
             return q
 
-        return df.map_partitions(per_part)
+        processed = [per_part(p) for p in df.partitions]
+        # empty partitions: placeholder columns with the dtype/trailing shape
+        # of a non-empty partition's outputs (schema + dtype stability)
+        template = next((q for q in processed if q is not None), None)
+        out_parts = []
+        for p, q in zip(df.partitions, processed):
+            if q is not None:
+                out_parts.append(q)
+                continue
+            q = dict(p)
+            for col in out_cols:
+                if template is not None:
+                    ref = template[col]
+                    q[col] = np.empty((0,) + ref.shape[1:], dtype=ref.dtype)
+                else:
+                    q[col] = np.empty(0)
+            out_parts.append(q)
+        return DataFrame(out_parts)

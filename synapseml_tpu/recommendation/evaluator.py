@@ -14,7 +14,13 @@ __all__ = ["RankingEvaluator", "ndcg_at_k", "map_at_k", "precision_at_k", "recal
 
 
 def _as_list(v):
-    return list(np.asarray(v).ravel())
+    vals = list(np.asarray(v).ravel())
+    seen, out = set(), []
+    for x in vals:  # dedupe, keeping rank order: duplicates must not double-count
+        if x not in seen:
+            seen.add(x)
+            out.append(x)
+    return out
 
 
 def ndcg_at_k(pred, truth, k: int) -> float:

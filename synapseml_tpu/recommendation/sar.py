@@ -43,17 +43,21 @@ class SAR(Estimator):
 
     def _fit(self, df: DataFrame) -> "SARModel":
         self.require_columns(df, self.get("user_col"), self.get("item_col"))
+        # fail fast on typos: a user-set column name must exist (None = off)
+        if self.get("rating_col"):
+            self.require_columns(df, self.get("rating_col"))
+        if self.get("time_col"):
+            self.require_columns(df, self.get("time_col"))
         users = np.asarray(df.collect_column(self.get("user_col")), np.int64)
         items = np.asarray(df.collect_column(self.get("item_col")), np.int64)
         n_users = int(users.max()) + 1 if len(users) else 0
         n_items = int(items.max()) + 1 if len(items) else 0
 
         ratings = (np.asarray(df.collect_column(self.get("rating_col")), np.float64)
-                   if self.get("rating_col") and self.get("rating_col") in df.columns
-                   else np.ones(len(users)))
+                   if self.get("rating_col") else np.ones(len(users)))
 
         # ---- affinity: sum of ratings with half-life time decay ----
-        if self.get("time_col") and self.get("time_col") in df.columns:
+        if self.get("time_col"):
             t = np.asarray(df.collect_column(self.get("time_col")), np.float64)
             t_ref = t.max() if len(t) else 0.0
             half_life_s = self.get("time_decay_coeff") * 86400.0
@@ -105,7 +109,7 @@ class SARModel(Model):
         import jax
         import jax.numpy as jnp
 
-        if self.__dict__.get("_jitted") is None:
+        if self.__dict__.get("_cache_jitted") is None:
             sim = jnp.asarray(self.get("item_data_frame"))
 
             def fn(aff_block, seen_block, k):
@@ -114,8 +118,8 @@ class SARModel(Model):
                 vals, idx = jax.lax.top_k(scores, k)
                 return vals, idx
 
-            self.__dict__["_jitted"] = jax.jit(fn, static_argnums=2)
-        return self.__dict__["_jitted"]
+            self.__dict__["_cache_jitted"] = jax.jit(fn, static_argnums=2)
+        return self.__dict__["_cache_jitted"]
 
     def recommend_for_all_users(self, k: int, batch: int = 512) -> DataFrame:
         aff = np.asarray(self.get("user_data_frame"))
@@ -133,13 +137,18 @@ class SARModel(Model):
                            np.pad(seen[s:e], ((0, pad), (0, 0))), k)
             vals, idx = np.asarray(vals)[: e - s], np.asarray(idx)[: e - s]
             for i in range(e - s):
+                keep = np.isfinite(vals[i])  # drop masked (seen) top_k fills
                 users.append(s + i)
-                recs.append(idx[i].astype(np.int32))
-                ratings.append(vals[i].astype(np.float32))
+                recs.append(idx[i][keep].astype(np.int32))
+                ratings.append(vals[i][keep].astype(np.float32))
+        rec_col = np.empty(len(recs), dtype=object)
+        rat_col = np.empty(len(ratings), dtype=object)
+        rec_col[:] = recs
+        rat_col[:] = ratings
         return DataFrame.from_dict({
             self.get("user_col"): np.asarray(users, np.int32),
-            "recommendations": np.asarray(recs),
-            "ratings": np.asarray(ratings),
+            "recommendations": rec_col,
+            "ratings": rat_col,
         })
 
     def _transform(self, df: DataFrame) -> DataFrame:

@@ -1,0 +1,171 @@
+"""causal module: DML ATE recovery under confounding, ortho forest
+heterogeneity, DiD family on synthetic panels, simplex solvers."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.core.pipeline import Estimator, Model
+from synapseml_tpu.core.params import ComplexParam, Param
+from synapseml_tpu.causal import (
+    DiffInDiffEstimator,
+    DoubleMLEstimator,
+    OrthoForestDMLEstimator,
+    ResidualTransformer,
+    SyntheticControlEstimator,
+    SyntheticDiffInDiffEstimator,
+    constrained_least_squares,
+    mirror_descent_simplex,
+)
+
+
+class RidgeRegressor(Estimator):
+    """Minimal nuisance learner: ridge on the 'features' vector column,
+    predicting the column named by label_col."""
+
+    label_col = Param("label_col", "target column", default="label")
+
+    def _fit(self, df):
+        X = np.stack([np.asarray(v, np.float64) for v in df.collect_column("features")])
+        y = np.asarray(df.collect_column(self.get("label_col")), np.float64)
+        Xb = np.concatenate([X, np.ones((len(X), 1))], axis=1)
+        coef = np.linalg.solve(Xb.T @ Xb + 1e-6 * np.eye(Xb.shape[1]), Xb.T @ y)
+        return RidgeModel(coef=coef.tolist())
+
+
+class RidgeModel(Model):
+    coef = ComplexParam("coef", "weights+intercept")
+
+    def _transform(self, df):
+        c = np.asarray(self.get("coef"))
+
+        def pred(p):
+            X = np.stack([np.asarray(v, np.float64) for v in p["features"]])
+            return X @ c[:-1] + c[-1]
+
+        return df.with_column("prediction", pred)
+
+
+def make_confounded(n=600, tau=2.0, seed=0):
+    rs = np.random.default_rng(seed)
+    X = rs.normal(size=(n, 3))
+    t = X @ np.asarray([1.0, -0.5, 0.2]) + 0.5 * rs.normal(size=n)
+    y = tau * t + X @ np.asarray([2.0, 1.0, -1.0]) + 0.5 * rs.normal(size=n)
+    return DataFrame.from_dict({"features": X.astype(np.float32),
+                                "treatment": t, "outcome": y})
+
+
+def test_simplex_solvers():
+    rs = np.random.default_rng(0)
+    A = rs.normal(size=(50, 4))
+    w_true = np.asarray([0.5, 0.3, 0.2, 0.0])
+    b = A @ w_true
+    w = mirror_descent_simplex(A, b, n_iter=5000)
+    np.testing.assert_allclose(w, w_true, atol=0.02)
+    assert w.sum() == pytest.approx(1.0)
+    w2, b0 = constrained_least_squares(A, b + 5.0, fit_intercept=True, n_iter=5000)
+    np.testing.assert_allclose(w2, w_true, atol=0.05)
+    assert b0 == pytest.approx(5.0, abs=0.1)
+
+
+def test_residual_transformer():
+    df = DataFrame.from_dict({"label": [1.0, 0.0, 1.0],
+                              "prediction": [0.8, 0.3, 0.5]})
+    out = ResidualTransformer(observed_col="label").transform(df)
+    np.testing.assert_allclose(out.collect_column("residual"), [0.2, -0.3, 0.5])
+
+
+def test_double_ml_recovers_ate_under_confounding():
+    df = make_confounded(tau=2.0)
+    # naive OLS is badly biased by the confounders
+    t = df.collect_column("treatment")
+    y = df.collect_column("outcome")
+    naive = float((t @ y) / (t @ t))
+    assert abs(naive - 2.0) > 0.5
+
+    dml = DoubleMLEstimator(outcome_model=RidgeRegressor(label_col="outcome"),
+                            treatment_model=RidgeRegressor(label_col="treatment"),
+                            max_iter=5, seed=1)
+    model = dml.fit(df)
+    ate = model.get_avg_treatment_effect()
+    assert ate == pytest.approx(2.0, abs=0.15)
+    lo, hi = model.get_confidence_interval()
+    assert lo <= ate <= hi
+    # transform stamps the effect
+    assert model.transform(df).collect_column("effect")[0] == pytest.approx(ate)
+
+
+def test_ortho_forest_heterogeneous_effects():
+    rs = np.random.default_rng(2)
+    n = 800
+    X = rs.normal(size=(n, 2))
+    h = rs.uniform(-1, 1, n)
+    tau = np.where(h > 0, 3.0, 1.0)
+    t = X @ np.asarray([0.8, -0.4]) + 0.5 * rs.normal(size=n)
+    y = tau * t + X @ np.asarray([1.0, 1.0]) + 0.3 * rs.normal(size=n)
+    df = DataFrame.from_dict({"features": X.astype(np.float32), "h": h,
+                              "treatment": t, "outcome": y})
+    est = OrthoForestDMLEstimator(
+        outcome_model=RidgeRegressor(label_col="outcome"),
+        treatment_model=RidgeRegressor(label_col="treatment"),
+        heterogeneity_cols=["h"], num_trees=10, max_depth=2,
+        min_samples_leaf=20, seed=0)
+    model = est.fit(df)
+    out = model.transform(df)
+    eff = out.collect_column("effect")
+    assert eff[h > 0.3].mean() == pytest.approx(3.0, abs=0.5)
+    assert eff[h < -0.3].mean() == pytest.approx(1.0, abs=0.5)
+
+
+def test_diff_in_diff():
+    rs = np.random.default_rng(3)
+    n = 2000
+    treat = rs.integers(0, 2, n).astype(float)
+    post = rs.integers(0, 2, n).astype(float)
+    y = 1.0 + 0.5 * treat + 1.5 * post + 2.5 * treat * post + 0.1 * rs.normal(size=n)
+    df = DataFrame.from_dict({"outcome": y, "treatment": treat, "postTreatment": post})
+    model = DiffInDiffEstimator().fit(df)
+    assert model.get_treatment_effect() == pytest.approx(2.5, abs=0.05)
+    assert model.get("standard_error") < 0.05
+
+
+def make_panel(tau=4.0, seed=0):
+    """10 control units; treated unit = 0.6*u0 + 0.4*u1 (+effect after t=7)."""
+    rs = np.random.default_rng(seed)
+    T = 12
+    base = rs.normal(size=(10, 1)) * 2 + rs.normal(size=(10, T)) * 0.1 \
+        + np.linspace(0, 1, T)[None, :] * rs.uniform(0.5, 2, (10, 1))
+    treated = 0.6 * base[0] + 0.4 * base[1]
+    post = np.arange(T) >= 7
+    treated = treated + tau * post
+    rows = {"unit": [], "time": [], "outcome": [], "treatment": [], "postTreatment": []}
+    for u in range(10):
+        for t in range(T):
+            rows["unit"].append(f"c{u}")
+            rows["time"].append(t)
+            rows["outcome"].append(base[u, t])
+            rows["treatment"].append(0.0)
+            rows["postTreatment"].append(float(post[t]))
+    for t in range(T):
+        rows["unit"].append("treated")
+        rows["time"].append(t)
+        rows["outcome"].append(treated[t])
+        rows["treatment"].append(1.0)
+        rows["postTreatment"].append(float(post[t]))
+    return DataFrame.from_dict({k: np.asarray(v) for k, v in rows.items()})
+
+
+def test_synthetic_control():
+    df = make_panel(tau=4.0)
+    model = SyntheticControlEstimator(unit_col="unit", time_col="time").fit(df)
+    assert model.get_treatment_effect() == pytest.approx(4.0, abs=0.3)
+    w = np.asarray(model.get("unit_weights"))
+    assert w.sum() == pytest.approx(1.0, abs=1e-6)
+    assert w[0] + w[1] > 0.85  # mass on the true donors
+
+
+def test_synthetic_diff_in_diff():
+    df = make_panel(tau=4.0, seed=1)
+    model = SyntheticDiffInDiffEstimator(unit_col="unit", time_col="time").fit(df)
+    assert model.get_treatment_effect() == pytest.approx(4.0, abs=0.4)
+    assert np.asarray(model.get("time_weights")).sum() == pytest.approx(1.0, abs=1e-6)

@@ -1,0 +1,204 @@
+"""Explainers: LIME/SHAP against analytic ground truth on linear models, ICE."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.core.pipeline import Transformer
+from synapseml_tpu.explainers import (
+    ICETransformer,
+    ImageLIME,
+    ImageSHAP,
+    TabularSHAP,
+    TextLIME,
+    TextSHAP,
+    VectorLIME,
+    VectorSHAP,
+    lasso_regression,
+    weighted_least_squares,
+)
+
+
+class LinearScorer(Transformer):
+    """score = x @ w + b, exposed as a 1-column 'probability'."""
+
+    def __init__(self, w, b=0.0, input_col="features", **kw):
+        super().__init__(**kw)
+        self._w = np.asarray(w, np.float64)
+        self._b = b
+        self._input_col = input_col
+
+    def _transform(self, df):
+        def score(p):
+            X = np.stack([np.asarray(v, np.float64) for v in p[self._input_col]])
+            s = X @ self._w + self._b
+            return np.asarray([np.asarray([v]) for v in s])
+
+        return df.with_column("probability", score)
+
+
+def test_solvers():
+    rs = np.random.default_rng(0)
+    X = rs.normal(size=(200, 4))
+    beta_true = np.asarray([2.0, -1.0, 0.0, 0.5])
+    y = X @ beta_true + 3.0
+    w = np.ones(200)
+    coef, b0 = weighted_least_squares(X, y, w)
+    np.testing.assert_allclose(coef, beta_true, atol=1e-6)
+    assert b0 == pytest.approx(3.0, abs=1e-6)
+    coef_l, b0_l = lasso_regression(X, y, w, alpha=1e-4)
+    np.testing.assert_allclose(coef_l, beta_true, atol=1e-2)
+    # strong alpha shrinks everything toward 0
+    coef_strong, _ = lasso_regression(X, y, w, alpha=10.0)
+    assert np.abs(coef_strong).sum() < np.abs(coef_l).sum()
+
+
+def test_vector_shap_linear_model_exact():
+    """For a linear model, SHAP values are w_i * (x_i - E[x_i]) exactly."""
+    rs = np.random.default_rng(1)
+    w = np.asarray([1.0, -2.0, 0.5, 0.0])
+    X = rs.normal(size=(30, 4)).astype(np.float32)
+    df = DataFrame.from_dict({"features": X})
+    shap = VectorSHAP(model=LinearScorer(w, b=1.0), target_col="probability",
+                      num_samples=64, seed=0, background_data=df)
+    out = shap.transform(df.limit(5))
+    bg_mean = X.mean(axis=0)
+    for i, phi in enumerate(out.collect_column("explanation")):
+        phi = np.asarray(phi)[0]                  # [K+1], phi0 last
+        expected = w * (X[i] - bg_mean)
+        np.testing.assert_allclose(phi[:-1], expected, atol=5e-2)
+        # efficiency: phi0 + sum(phi) == f(x)
+        fx = float(X[i] @ w + 1.0)
+        assert phi.sum() == pytest.approx(fx, abs=5e-2)
+
+
+def test_tabular_shap_matches_vector():
+    rs = np.random.default_rng(2)
+    w = np.asarray([1.5, -1.0])
+    X = rs.normal(size=(20, 2)).astype(np.float32)
+    df = DataFrame.from_dict({"a": X[:, 0], "b": X[:, 1]})
+
+    class ColScorer(Transformer):
+        def _transform(self, sdf):
+            def score(p):
+                s = np.asarray(p["a"], np.float64) * 1.5 - np.asarray(p["b"], np.float64)
+                return np.asarray([np.asarray([v]) for v in s])
+            return sdf.with_column("probability", score)
+
+    shap = TabularSHAP(model=ColScorer(), input_cols=["a", "b"],
+                       target_col="probability", num_samples=16, seed=0,
+                       background_data=df)
+    out = shap.transform(df.limit(4))
+    bg = X.mean(axis=0)
+    for i, phi in enumerate(out.collect_column("explanation")):
+        phi = np.asarray(phi)[0]
+        np.testing.assert_allclose(phi[:-1], w * (X[i] - bg), atol=5e-2)
+
+
+def test_vector_lime_recovers_linear_signs():
+    rs = np.random.default_rng(3)
+    w = np.asarray([3.0, -2.0, 0.0])
+    X = rs.normal(size=(40, 3)).astype(np.float32)
+    df = DataFrame.from_dict({"features": X})
+    lime = VectorLIME(model=LinearScorer(w), target_col="probability",
+                      num_samples=200, seed=0, regularization=1e-4,
+                      background_data=df)
+    out = lime.transform(df.limit(3))
+    std = X.std(axis=0)
+    for coefs in out.collect_column("explanation"):
+        c = np.asarray(coefs)[0]                  # standardized design -> w*std
+        np.testing.assert_allclose(c, w * std, rtol=0.15, atol=0.05)
+
+
+def test_text_explainers_find_key_token():
+    class KeywordScorer(Transformer):
+        def _transform(self, sdf):
+            def score(p):
+                return np.asarray([np.asarray([1.0 if "good" in str(t).split() else 0.0])
+                                   for t in p["text"]])
+            return sdf.with_column("probability", score)
+
+    df = DataFrame.from_dict({"text": ["this is a good movie", "bad film overall"]})
+    lime = TextLIME(model=KeywordScorer(), target_col="probability",
+                    num_samples=64, seed=0, regularization=1e-4)
+    out = lime.transform(df)
+    tokens0 = list(out.collect_column("tokens")[0])
+    coefs0 = np.asarray(out.collect_column("explanation")[0])[0]
+    assert tokens0[int(np.argmax(coefs0))] == "good"
+    # second row: no 'good' token -> flat zero scores -> near-zero coefs
+    coefs1 = np.asarray(out.collect_column("explanation")[1])[0]
+    assert np.abs(coefs1).max() < 0.05
+
+    shap = TextSHAP(model=KeywordScorer(), target_col="probability",
+                    num_samples=64, seed=0)
+    sout = shap.transform(df.limit(1))
+    phi = np.asarray(sout.collect_column("explanation")[0])[0]
+    toks = list(sout.collect_column("tokens")[0])
+    assert toks[int(np.argmax(phi[:-1]))] == "good"
+
+
+def test_image_explainers_localize_signal():
+    """Model scores the mean of the left half; explanations should put the
+    mass on left-half superpixels."""
+
+    class LeftHalfScorer(Transformer):
+        def _transform(self, sdf):
+            def score(p):
+                out = []
+                for im in p["image"]:
+                    im = np.asarray(im, np.float64)
+                    out.append(np.asarray([im[:, : im.shape[1] // 2].mean()]))
+                return np.asarray(out)
+            return sdf.with_column("probability", score)
+
+    # four flat quadrants -> SLIC segments match quadrants exactly
+    img = np.zeros((24, 24, 1), np.float32)
+    img[:12, :12] = 60.0
+    img[:12, 12:] = 120.0
+    img[12:, :12] = 180.0
+    img[12:, 12:] = 240.0
+    df = DataFrame.from_dict({"image": [img]})
+    for cls, kw in [(ImageLIME, dict(num_samples=64, regularization=1e-4)),
+                    (ImageSHAP, dict(num_samples=64))]:
+        expl = cls(model=LeftHalfScorer(), target_col="probability",
+                   cell_size=12.0, seed=0, **kw).transform(df)
+        from synapseml_tpu.image import slic_segments
+        labels = slic_segments(img, cell_size=12.0)
+        coefs = np.asarray(expl.collect_column("explanation")[0])[0]
+        K = labels.max() + 1
+        centers = np.asarray([np.mean(np.nonzero(labels == k)[1]) for k in range(K)])
+        left = centers < 12
+        left_mass = np.abs(coefs[:K][left]).sum()
+        right_mass = np.abs(coefs[:K][~left]).sum()
+        assert left_mass > 2 * right_mass, f"{cls.__name__}: {left_mass} vs {right_mass}"
+
+
+def test_ice_transformer():
+    class SquareScorer(Transformer):
+        def _transform(self, sdf):
+            return sdf.with_column(
+                "probability",
+                lambda p: np.asarray([np.asarray([float(v) ** 2]) for v in p["x"]]))
+
+    rs = np.random.default_rng(0)
+    df = DataFrame.from_dict({"x": rs.uniform(-2, 2, 30).astype(np.float32),
+                              "cat": rs.choice(["u", "v"], 30)})
+    ice = ICETransformer(model=SquareScorer(), target_col="probability",
+                         numeric_features=["x"], num_splits=5, kind="individual")
+    out = ice.transform(df)
+    curve = out.collect_column("x_dependence")[0]
+    grid_vals = sorted(float(k) for k in curve.keys())
+    ys = [curve[str(g)][0] for g in grid_vals] if str(grid_vals[0]) in curve else None
+    # curve follows x^2 over the grid regardless of the row
+    for k, v in curve.items():
+        assert v[0] == pytest.approx(float(k) ** 2, abs=1e-4)
+
+    pdp = ICETransformer(model=SquareScorer(), target_col="probability",
+                         numeric_features=["x"], num_splits=5, kind="average")
+    avg = pdp.transform(df)
+    row = avg.collect_column("x_dependence")[0]
+    for k, v in row.items():
+        assert v[0] == pytest.approx(float(k) ** 2, abs=1e-4)
+
+    with pytest.raises(ValueError, match="numeric_features"):
+        ICETransformer(model=SquareScorer()).transform(df)
