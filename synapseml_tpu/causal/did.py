@@ -91,7 +91,16 @@ class SyntheticControlEstimator(_DiDBase):
         u_levels, u_idx = np.unique(units, return_inverse=True)
         t_levels, t_idx = np.unique(times, return_inverse=True)
         Y = np.zeros((len(u_levels), len(t_levels)))
+        filled = np.zeros(Y.shape, bool)
         Y[u_idx, t_idx] = y
+        filled[u_idx, t_idx] = True
+        if not filled.all():
+            missing = np.argwhere(~filled)[:5]
+            pairs = [(str(u_levels[i]), str(t_levels[j])) for i, j in missing]
+            raise ValueError(
+                f"unbalanced panel: {int((~filled).sum())} missing "
+                f"(unit, time) cells, e.g. {pairs}; synthetic-control weights "
+                f"require a complete outcome grid")
         treated_units = np.zeros(len(u_levels), bool)
         treated_units[u_idx[treat > 0]] = True
         post_times = np.zeros(len(t_levels), bool)

@@ -110,6 +110,11 @@ class TabularLIME(VectorLIME):
 
         proxy = self.copy()
         proxy.set(model=_Unpack(), input_col=vec_col)
+        if self.get("background_data") is not None:
+            bgd = self.get("background_data")
+            proxy.set(background_data=bgd.with_column(
+                vec_col, lambda p: np.stack([np.asarray(p[c], np.float32)
+                                             for c in cols], axis=1)))
         out = VectorLIME._transform(proxy, assembled)
         return out.drop(vec_col)
 

@@ -1,0 +1,26 @@
+"""IO: HTTP-on-DataFrame client stack + model serving.
+
+Reference: ``core/.../io/http/`` and the Spark Serving sources/sinks under
+``org/apache/spark/sql/execution/streaming/`` (SURVEY.md §2.5, §3.4).
+"""
+
+from .http import (
+    AsyncHTTPClient,
+    CustomInputParser,
+    HTTPRequest,
+    HTTPResponse,
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    SimpleHTTPTransformer,
+    StringOutputParser,
+    send_with_retries,
+)
+from .serving import ServingServer, serve_pipeline
+
+__all__ = [
+    "HTTPRequest", "HTTPResponse", "HTTPTransformer", "SimpleHTTPTransformer",
+    "JSONInputParser", "JSONOutputParser", "CustomInputParser",
+    "StringOutputParser", "AsyncHTTPClient", "send_with_retries",
+    "ServingServer", "serve_pipeline",
+]

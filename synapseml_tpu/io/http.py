@@ -1,0 +1,264 @@
+"""HTTP-on-DataFrame: requests/responses as column values.
+
+Reference (SURVEY.md §2.5): ``io/http/HTTPSchema.scala`` (request/response
+structs), ``HTTPClients.scala`` (``HandlingUtils.advancedUDF`` retry/backoff/
+429 handling :66-230, ``AsyncHTTPClient`` :232), ``Clients.scala:12-66``
+(buffered async futures), ``HTTPTransformer.scala:97-152``,
+``SimpleHTTPTransformer.scala:66-182`` and ``Parsers.scala``.
+
+Python-native: stdlib ``urllib`` for transport (zero deps), a thread pool for
+the async buffered client (the reference's concurrency/concurrentTimeout
+params), exponential backoff honoring Retry-After on 429/503.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Transformer
+
+__all__ = ["HTTPRequest", "HTTPResponse", "send_with_retries", "AsyncHTTPClient",
+           "HTTPTransformer", "SimpleHTTPTransformer", "JSONInputParser",
+           "JSONOutputParser", "CustomInputParser", "StringOutputParser"]
+
+
+@dataclasses.dataclass
+class HTTPRequest:
+    """(ref ``HTTPSchema.scala`` HTTPRequestData)"""
+
+    url: str
+    method: str = "GET"
+    headers: dict = dataclasses.field(default_factory=dict)
+    entity: bytes | str | None = None
+
+    def to_urllib(self) -> urllib.request.Request:
+        data = self.entity
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        return urllib.request.Request(self.url, data=data, method=self.method,
+                                      headers=dict(self.headers))
+
+
+@dataclasses.dataclass
+class HTTPResponse:
+    """(ref ``HTTPSchema.scala`` HTTPResponseData)"""
+
+    status_code: int
+    reason: str = ""
+    headers: dict = dataclasses.field(default_factory=dict)
+    entity: bytes = b""
+    error: str | None = None
+
+    @property
+    def text(self) -> str:
+        return self.entity.decode("utf-8", "replace")
+
+    def json(self):
+        return json.loads(self.text)
+
+
+_RETRY_STATUSES = (429, 500, 502, 503, 504)
+
+
+def send_with_retries(request: HTTPRequest, backoffs_ms=(100, 500, 1000),
+                      timeout_s: float = 60.0) -> HTTPResponse:
+    """(ref ``HandlingUtils.advancedUDF`` — retry on 429/5xx with backoff,
+    honoring Retry-After.) Network errors after the last retry return a
+    response row with ``error`` set rather than raising (errors-as-data, like
+    the reference's error column)."""
+    last_err = None
+    for attempt in range(len(backoffs_ms) + 1):
+        try:
+            with urllib.request.urlopen(request.to_urllib(), timeout=timeout_s) as r:
+                return HTTPResponse(status_code=r.status, reason=r.reason or "",
+                                    headers=dict(r.headers), entity=r.read())
+        except urllib.error.HTTPError as e:
+            body = e.read() if hasattr(e, "read") else b""
+            if e.code in _RETRY_STATUSES and attempt < len(backoffs_ms):
+                retry_after = e.headers.get("Retry-After") if e.headers else None
+                try:
+                    # Retry-After may be an HTTP-date, not just seconds
+                    wait_ms = float(retry_after) * 1000.0
+                except (TypeError, ValueError):
+                    wait_ms = backoffs_ms[attempt]
+                time.sleep(wait_ms / 1000.0)
+                last_err = e
+                continue
+            return HTTPResponse(status_code=e.code, reason=str(e.reason),
+                                headers=dict(e.headers or {}), entity=body)
+        except (urllib.error.URLError, OSError) as e:
+            last_err = e
+            if attempt < len(backoffs_ms):
+                time.sleep(backoffs_ms[attempt] / 1000.0)
+                continue
+            return HTTPResponse(status_code=0, reason="connection error",
+                                error=str(last_err))
+    return HTTPResponse(status_code=0, reason="unreachable", error=str(last_err))
+
+
+class AsyncHTTPClient:
+    """Buffered-future client (ref ``AsyncHTTPClient`` ``HTTPClients.scala:232``,
+    ``Clients.scala:48-66``): up to ``concurrency`` requests in flight,
+    responses returned in request order."""
+
+    def __init__(self, concurrency: int = 8, timeout_s: float = 60.0,
+                 backoffs_ms=(100, 500, 1000)):
+        self.concurrency = max(int(concurrency), 1)
+        self.timeout_s = timeout_s
+        self.backoffs_ms = tuple(backoffs_ms)
+
+    def send_all(self, requests: list[HTTPRequest | None]) -> list[HTTPResponse | None]:
+        with concurrent.futures.ThreadPoolExecutor(self.concurrency) as pool:
+            futures = [None if r is None else
+                       pool.submit(send_with_retries, r, self.backoffs_ms, self.timeout_s)
+                       for r in requests]
+            return [None if f is None else f.result() for f in futures]
+
+
+class HTTPTransformer(Transformer):
+    """request col (HTTPRequest or None) -> response col
+    (ref ``HTTPTransformer.scala:97-152``; None rows pass through as None,
+    matching the reference's null handling)."""
+
+    feature_name = "io_http"
+
+    input_col = Param("input_col", "HTTPRequest column", default="request")
+    output_col = Param("output_col", "HTTPResponse column", default="response")
+    concurrency = Param("concurrency", "in-flight requests per partition",
+                        default=8, converter=TypeConverters.to_int)
+    timeout_s = Param("timeout_s", "per-request timeout seconds", default=60.0,
+                      converter=TypeConverters.to_float)
+    backoffs_ms = ComplexParam("backoffs_ms", "retry backoff schedule",
+                               default=(100, 500, 1000))
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        client = AsyncHTTPClient(self.get("concurrency"), self.get("timeout_s"),
+                                 self.get("backoffs_ms"))
+
+        def per_part(p):
+            reqs = list(p[self.get("input_col")])
+            resps = client.send_all(reqs)
+            out = np.empty(len(resps), dtype=object)
+            out[:] = resps
+            q = dict(p)
+            q[self.get("output_col")] = out
+            return q
+
+        return df.map_partitions(per_part)
+
+
+# ---------------------------------------------------------------------------
+# parsers (ref Parsers.scala)
+# ---------------------------------------------------------------------------
+
+class JSONInputParser:
+    """row dict -> POST HTTPRequest with a JSON body (ref ``JSONInputParser``)."""
+
+    def __init__(self, url: str, headers: dict | None = None, method: str = "POST"):
+        self.url = url
+        self.headers = {"Content-Type": "application/json", **(headers or {})}
+        self.method = method
+
+    def __call__(self, row: dict) -> HTTPRequest:
+        clean = {k: (v.item() if isinstance(v, np.generic) else
+                     v.tolist() if isinstance(v, np.ndarray) else v)
+                 for k, v in row.items()}
+        return HTTPRequest(url=self.url, method=self.method, headers=self.headers,
+                           entity=json.dumps(clean))
+
+
+class CustomInputParser:
+    """Arbitrary row -> HTTPRequest function (ref ``CustomInputParser``)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, row: dict) -> HTTPRequest:
+        return self.fn(row)
+
+
+class JSONOutputParser:
+    """HTTPResponse -> parsed JSON (ref ``JSONOutputParser``); non-2xx or
+    unparseable -> None (the error column carries the reason)."""
+
+    def __call__(self, resp: HTTPResponse | None):
+        if resp is None or resp.status_code // 100 != 2:
+            return None
+        try:
+            return resp.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return None
+
+
+class StringOutputParser:
+    def __call__(self, resp: HTTPResponse | None):
+        return None if resp is None else resp.text
+
+
+class SimpleHTTPTransformer(Transformer):
+    """input parser -> HTTPTransformer -> output parser, with an errors column
+    for failed rows (ref ``SimpleHTTPTransformer.scala:66-182``)."""
+
+    feature_name = "io_http"
+
+    input_col = Param("input_col", "column fed to the input parser", default="input")
+    output_col = Param("output_col", "parsed output column", default="output")
+    error_col = Param("error_col", "per-row error column", default="errors")
+    input_parser = ComplexParam("input_parser", "row -> HTTPRequest callable")
+    output_parser = ComplexParam("output_parser", "HTTPResponse -> value callable",
+                                 default=None)
+    concurrency = Param("concurrency", "in-flight requests", default=8,
+                        converter=TypeConverters.to_int)
+    timeout_s = Param("timeout_s", "request timeout", default=60.0,
+                      converter=TypeConverters.to_float)
+    backoffs_ms = ComplexParam("backoffs_ms", "retry backoff schedule",
+                               default=(100, 500, 1000))
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        parser = self.get("input_parser")
+        out_parser = self.get("output_parser") or JSONOutputParser()
+        http = HTTPTransformer(
+            input_col="_http_request", output_col="_http_response",
+            concurrency=self.get("concurrency"), timeout_s=self.get("timeout_s"),
+            backoffs_ms=self.get("backoffs_ms"))
+
+        def build_requests(p):
+            col = p[self.get("input_col")]
+            reqs = np.empty(len(col), dtype=object)
+            for i, v in enumerate(col):
+                row = v if isinstance(v, dict) else {self.get("input_col"): v}
+                reqs[i] = None if v is None else parser(row)
+            return reqs
+
+        with_req = df.with_column("_http_request", build_requests)
+        responded = http.transform(with_req)
+
+        def parse(p):
+            resps = p["_http_response"]
+            parsed = np.empty(len(resps), dtype=object)
+            errors = np.empty(len(resps), dtype=object)
+            for i, r in enumerate(resps):
+                parsed[i] = out_parser(r)
+                if r is None:
+                    errors[i] = None
+                elif r.error or r.status_code // 100 != 2:
+                    errors[i] = r.error or f"HTTP {r.status_code}: {r.reason}"
+                else:
+                    errors[i] = None
+            q = dict(p)
+            q[self.get("output_col")] = parsed
+            q[self.get("error_col")] = errors
+            return q
+
+        return responded.map_partitions(parse).drop("_http_request", "_http_response")

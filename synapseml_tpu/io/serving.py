@@ -1,0 +1,197 @@
+"""Model serving: HTTP requests in, pipeline transform, replies out.
+
+Reference (SURVEY.md §3.4): Spark Serving's ``HTTPSourceV2``/``HTTPSinkV2`` —
+an HTTP source enqueues requests as rows tagged with a request id, the user
+pipeline transforms request rows into reply rows, and the sink routes each
+reply back to the originating open connection by request id
+(``continuous/HTTPSinkV2.scala:74-154``, ``HTTPServerUtils.respond``).
+
+Here: a threaded stdlib HTTP server parks each connection on an Event;
+``ServingServer.read_batch`` drains the queue into a DataFrame (micro-batch
+mode, ``HTTPMicroBatchReader`` analog); ``reply_batch`` completes the parked
+exchanges. ``serve_pipeline`` wires a Transformer into the loop — micro-batch
+with ``batch_interval_ms`` or per-request continuous mode (``interval=0``,
+the reference's sub-millisecond continuous path).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+
+__all__ = ["ServingServer", "serve_pipeline"]
+
+
+class _Exchange:
+    def __init__(self, request_id: str, method: str, path: str, headers: dict,
+                 body: bytes):
+        self.request_id = request_id
+        self.method = method
+        self.path = path
+        self.headers = headers
+        self.body = body
+        self.reply_event = threading.Event()
+        self.reply_body: bytes = b""
+        self.reply_status: int = 200
+        self.reply_headers: dict = {}
+
+    def respond(self, body, status: int = 200, headers: dict | None = None):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body).encode()
+            headers = {"Content-Type": "application/json", **(headers or {})}
+        elif isinstance(body, str):
+            body = body.encode()
+        self.reply_body = body or b""
+        self.reply_status = status
+        self.reply_headers = headers or {}
+        self.reply_event.set()
+
+
+class ServingServer:
+    """(ref ``HTTPSourceV2``/``DistributedHTTPSource``)"""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 reply_timeout_s: float = 30.0):
+        self.reply_timeout_s = reply_timeout_s
+        self._queue: "queue.Queue[_Exchange]" = queue.Queue()
+        self._pending: dict[str, _Exchange] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _handle(self, method: str):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                ex = _Exchange(uuid.uuid4().hex, method, self.path,
+                               dict(self.headers), body)
+                with outer._lock:
+                    outer._pending[ex.request_id] = ex
+                outer._queue.put(ex)
+                ok = ex.reply_event.wait(outer.reply_timeout_s)
+                with outer._lock:
+                    outer._pending.pop(ex.request_id, None)
+                if not ok:
+                    self.send_response(504)
+                    self.end_headers()
+                    return
+                self.send_response(ex.reply_status)
+                for k, v in ex.reply_headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(ex.reply_body)))
+                self.end_headers()
+                self.wfile.write(ex.reply_body)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._running = False
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServingServer":
+        self._thread.start()
+        self._running = True
+        return self
+
+    def stop(self) -> None:
+        if self._running:
+            self._server.shutdown()
+            self._server.server_close()
+            self._running = False
+
+    # ---- micro-batch source/sink API (HTTPMicroBatchReader / HTTPWriter) ----
+    def read_batch(self, max_rows: int = 1024, timeout_s: float = 0.1) -> DataFrame:
+        """Drain queued requests into a DataFrame (id, method, path, body)."""
+        exchanges: list[_Exchange] = []
+        try:
+            exchanges.append(self._queue.get(timeout=timeout_s))
+            while len(exchanges) < max_rows:
+                exchanges.append(self._queue.get_nowait())
+        except queue.Empty:
+            pass
+        if not exchanges:
+            return DataFrame([{}])
+        ids = np.asarray([e.request_id for e in exchanges], dtype=object)
+        return DataFrame([{
+            "id": ids,
+            "method": np.asarray([e.method for e in exchanges], dtype=object),
+            "path": np.asarray([e.path for e in exchanges], dtype=object),
+            "body": np.asarray([e.body for e in exchanges], dtype=object),
+        }])
+
+    def reply_batch(self, df: DataFrame, id_col: str = "id",
+                    reply_col: str = "reply", status: int = 200) -> int:
+        """Route replies back by request id (``HTTPSinkV2`` / ``ServingUDFs``)."""
+        if df.is_empty():
+            return 0
+        n = 0
+        ids = df.collect_column(id_col)
+        replies = df.collect_column(reply_col)
+        for rid, reply in zip(ids, replies):
+            with self._lock:
+                ex = self._pending.get(str(rid))
+            if ex is not None:
+                ex.respond(reply, status=status)
+                n += 1
+        return n
+
+
+def serve_pipeline(pipeline, port: int = 0, batch_interval_ms: int = 10,
+                   input_col: str = "body", reply_col: str = "reply",
+                   parse_json: bool = True) -> ServingServer:
+    """Run a Transformer as an HTTP service: request body -> ``input_col`` ->
+    pipeline.transform -> ``reply_col`` -> response body. ``batch_interval_ms=0``
+    replies per-request (continuous mode)."""
+    server = ServingServer(port=port).start()
+
+    def loop():
+        while server._running:
+            batch = server.read_batch(
+                max_rows=1 if batch_interval_ms == 0 else 1024,
+                timeout_s=max(batch_interval_ms, 10) / 1000.0)
+            if batch.is_empty():
+                continue
+            if parse_json:
+                def parse(p):
+                    out = np.empty(len(p["body"]), dtype=object)
+                    for i, b in enumerate(p["body"]):
+                        try:
+                            out[i] = json.loads(b.decode() or "null")
+                        except (json.JSONDecodeError, UnicodeDecodeError):
+                            out[i] = None
+                    return out
+
+                batch = batch.with_column(input_col, parse)
+            elif input_col != "body":
+                batch = batch.with_column(input_col, lambda p: p["body"])
+            try:
+                replied = pipeline.transform(batch)
+                server.reply_batch(replied, reply_col=reply_col)
+            except Exception as e:  # noqa: BLE001 - serve loop must survive
+                err = {"error": str(e)}
+                fallback = batch.with_column(reply_col,
+                                             lambda p: np.asarray([err] * len(p["id"]),
+                                                                  dtype=object))
+                server.reply_batch(fallback, reply_col=reply_col, status=500)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return server

@@ -1,0 +1,29 @@
+"""AI services as transformers (reference ``cognitive/`` module, SURVEY.md
+§2.6): CognitiveServicesBase composition over the HTTP fabric, the OpenAI
+family (chat/completion/embedding/prompt), text analytics, translation, and
+the Azure Search writer.
+
+All engine-independent: each service builds authenticated per-row requests
+from ServiceParams (value-or-column) and parses JSON replies; transport is
+:mod:`synapseml_tpu.io.http` (retry/backoff/429 built in).
+"""
+
+from .base import CognitiveServiceBase, HasAsyncReply
+from .openai import (
+    OpenAIChatCompletion,
+    OpenAICompletion,
+    OpenAIDefaults,
+    OpenAIEmbedding,
+    OpenAIPrompt,
+)
+from .text import AnalyzeText, EntityRecognizer, KeyPhraseExtractor, LanguageDetector, TextSentiment
+from .translate import Translate
+from .search import AzureSearchWriter
+
+__all__ = [
+    "CognitiveServiceBase", "HasAsyncReply",
+    "OpenAIChatCompletion", "OpenAICompletion", "OpenAIEmbedding",
+    "OpenAIPrompt", "OpenAIDefaults",
+    "AnalyzeText", "TextSentiment", "KeyPhraseExtractor", "LanguageDetector",
+    "EntityRecognizer", "Translate", "AzureSearchWriter",
+]

@@ -1,0 +1,154 @@
+"""CognitiveServiceBase (reference ``services/CognitiveServiceBase.scala:540-612``):
+a Transformer = pack per-row params -> SimpleHTTPTransformer(inputFunc with
+auth headers) -> unpack/parse -> drop temp cols.
+
+ServiceParams (``HasServiceParams:34``): every request field is either a
+literal applied to all rows or the name of a column with per-row values —
+``set_x("v")`` vs ``set_x_col("colname")``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, ServiceParam, TypeConverters
+from ..core.pipeline import Transformer
+from ..io.http import (
+    AsyncHTTPClient,
+    HTTPRequest,
+    HTTPResponse,
+)
+
+__all__ = ["CognitiveServiceBase", "HasAsyncReply"]
+
+
+class CognitiveServiceBase(Transformer):
+    """Subclasses define ``build_request(row_params) -> HTTPRequest`` and
+    (optionally) ``parse_response(json) -> value``."""
+
+    feature_name = "services"
+
+    subscription_key = ServiceParam("subscription_key", "API key (or column)")
+    url = Param("url", "service endpoint URL")
+    output_col = Param("output_col", "parsed response column", default="out")
+    error_col = Param("error_col", "per-row error column", default="errors")
+    concurrency = Param("concurrency", "in-flight requests", default=4,
+                        converter=TypeConverters.to_int)
+    timeout_s = Param("timeout_s", "request timeout", default=60.0,
+                      converter=TypeConverters.to_float)
+
+    # ---- subclass hooks -------------------------------------------------
+    def build_request(self, row_params: dict) -> HTTPRequest | None:
+        raise NotImplementedError
+
+    def parse_response(self, payload):
+        return payload
+
+    def auth_headers(self, row_params: dict) -> dict:
+        key = row_params.get("subscription_key")
+        return {"Ocp-Apim-Subscription-Key": key} if key else {}
+
+    def service_param_names(self) -> list[str]:
+        return [name for name, p in self.params().items()
+                if isinstance(p, ServiceParam)]
+
+    # ---- engine ---------------------------------------------------------
+    def _row_params(self, p: dict, n: int) -> list[dict]:
+        names = self.service_param_names()
+        per_param = {name: self.resolve_row_param(name, p, n) for name in names}
+        return [{name: per_param[name][i] for name in names} for i in range(n)]
+
+    def handle_response(self, resp: HTTPResponse | None) -> tuple:
+        """-> (parsed value, error or None)"""
+        if resp is None:
+            return None, None
+        if resp.error or resp.status_code // 100 != 2:
+            return None, resp.error or f"HTTP {resp.status_code}: {resp.reason}"
+        try:
+            return self.parse_response(resp.json()), None
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            return None, f"unparseable response: {e}"
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        client = AsyncHTTPClient(self.get("concurrency"), self.get("timeout_s"))
+
+        def per_part(p):
+            n = len(next(iter(p.values()))) if p else 0
+            rows = self._row_params(p, n)
+            requests = [self.build_request(r) for r in rows]
+            responses = client.send_all(requests)
+            responses = self.post_process_responses(requests, responses, client)
+            parsed = np.empty(n, dtype=object)
+            errors = np.empty(n, dtype=object)
+            for i, resp in enumerate(responses):
+                parsed[i], errors[i] = self.handle_response(resp)
+            q = dict(p)
+            q[self.get("output_col")] = parsed
+            q[self.get("error_col")] = errors
+            return q
+
+        return df.map_partitions(per_part)
+
+    def post_process_responses(self, requests, responses, client):
+        """Hook for async/LRO polling (overridden by HasAsyncReply)."""
+        return responses
+
+
+class HasAsyncReply(CognitiveServiceBase):
+    """Long-running-operation support (reference ``HasAsyncReply`` /
+    ``AnalyzeTextLongRunningOperations.scala``): a 202 reply carries an
+    Operation-Location to poll until status is succeeded/failed."""
+
+    polling_interval_s = Param("polling_interval_s", "poll sleep", default=0.25,
+                               converter=TypeConverters.to_float)
+    max_poll_attempts = Param("max_poll_attempts", "max polls per row", default=40,
+                              converter=TypeConverters.to_int)
+
+    def poll_headers(self) -> dict:
+        return {}
+
+    def is_done(self, payload) -> bool:
+        status = str(payload.get("status", "")).lower() if isinstance(payload, dict) else ""
+        return status in ("succeeded", "failed", "partiallycompleted")
+
+    def post_process_responses(self, requests, responses, client):
+        out = list(responses)
+        # all pending operations poll together each sweep: wall-clock is
+        # O(polls), not O(rows * polls)
+        pending: dict[int, str] = {}
+        for i, resp in enumerate(out):
+            if resp is not None and resp.status_code == 202:
+                loc = (resp.headers.get("Operation-Location")
+                       or resp.headers.get("operation-location"))
+                if loc:
+                    pending[i] = loc
+        for _ in range(self.get("max_poll_attempts")):
+            if not pending:
+                break
+            time.sleep(self.get("polling_interval_s"))
+            idxs = list(pending)
+            polled = client.send_all([HTTPRequest(url=pending[i], method="GET",
+                                                  headers=self.poll_headers())
+                                      for i in idxs])
+            for i, resp in zip(idxs, polled):
+                if resp is None or resp.status_code // 100 != 2:
+                    out[i] = resp
+                    del pending[i]
+                    continue
+                try:
+                    done = self.is_done(resp.json())
+                except json.JSONDecodeError:
+                    out[i] = resp
+                    del pending[i]
+                    continue
+                if done:
+                    out[i] = resp
+                    del pending[i]
+        for i in pending:
+            out[i] = HTTPResponse(status_code=0, reason="LRO timeout",
+                                  error="long-running operation timed out")
+        return out

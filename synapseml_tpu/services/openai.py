@@ -1,0 +1,273 @@
+"""OpenAI services (reference ``services/openai/``):
+OpenAIChatCompletion:98, OpenAICompletion, OpenAIEmbedding:27, and
+OpenAIPrompt (``OpenAIPrompt.scala:40-767`` — column template interpolation +
+json/regex/delimiter output parsers) with OpenAIDefaults global params.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import GlobalParams, Param, ServiceParam, TypeConverters
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase
+
+__all__ = ["OpenAIChatCompletion", "OpenAICompletion", "OpenAIEmbedding",
+           "OpenAIPrompt", "OpenAIDefaults"]
+
+
+class OpenAIDefaults:
+    """(ref ``OpenAIDefaults.scala`` over GlobalParams) — session-wide
+    deployment/key/url defaults for every OpenAI stage."""
+
+    @staticmethod
+    def set_deployment_name(v: str) -> None:
+        GlobalParams.set_default("_OpenAIBase", "deployment_name", v)
+
+    @staticmethod
+    def set_subscription_key(v: str) -> None:
+        GlobalParams.set_default("_OpenAIBase", "subscription_key", v)
+
+    @staticmethod
+    def set_url(v: str) -> None:
+        GlobalParams.set_default("_OpenAIBase", "url", v)
+
+    @staticmethod
+    def set_temperature(v: float) -> None:
+        GlobalParams.set_default("_OpenAIBase", "temperature", v)
+
+    @staticmethod
+    def reset() -> None:
+        GlobalParams.reset()
+
+
+class _OpenAIBase(CognitiveServiceBase):
+    deployment_name = ServiceParam("deployment_name", "model deployment name")
+    temperature = ServiceParam("temperature", "sampling temperature", default=None)
+    max_tokens = ServiceParam("max_tokens", "max generated tokens", default=None)
+    api_version = Param("api_version", "API version query param",
+                        default="2024-02-01")
+
+    def auth_headers(self, row_params: dict) -> dict:
+        key = row_params.get("subscription_key")
+        return {"api-key": key, "Content-Type": "application/json"} if key \
+            else {"Content-Type": "application/json"}
+
+    def _endpoint(self, row_params: dict, path: str) -> str:
+        base = (self.get("url") or "").rstrip("/")
+        dep = row_params.get("deployment_name")
+        return f"{base}/openai/deployments/{dep}/{path}?api-version={self.get('api_version')}"
+
+    def _common_body(self, row_params: dict) -> dict:
+        body = {}
+        if row_params.get("temperature") is not None:
+            body["temperature"] = float(row_params["temperature"])
+        if row_params.get("max_tokens") is not None:
+            body["max_tokens"] = int(row_params["max_tokens"])
+        return body
+
+
+class OpenAIChatCompletion(_OpenAIBase):
+    """(ref ``OpenAIChatCompletion.scala:98``) — messages col holds a list of
+    {role, content} dicts."""
+
+    messages_col = Param("messages_col", "chat messages column", default="messages")
+    output_col = Param("output_col", "reply column", default="chat_completions")
+
+    def service_param_names(self):
+        return super().service_param_names() + ["_messages"]
+
+    def _row_params(self, p, n):
+        rows = CognitiveServiceBase._row_params(self, p, n)
+        msgs = p[self.get("messages_col")]
+        for i, r in enumerate(rows):
+            r["_messages"] = msgs[i]
+        return rows
+
+    def resolve_row_param(self, name, partition, n):
+        if name == "_messages":
+            return [None] * n  # filled by _row_params
+        return super().resolve_row_param(name, partition, n)
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        msgs = rp.get("_messages")
+        if msgs is None:
+            return None
+        msgs = [dict(m) for m in (msgs.tolist() if isinstance(msgs, np.ndarray) else msgs)]
+        body = {"messages": msgs, **self._common_body(rp)}
+        return HTTPRequest(url=self._endpoint(rp, "chat/completions"), method="POST",
+                           headers=self.auth_headers(rp), entity=json.dumps(body))
+
+    def parse_response(self, payload):
+        return payload
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("messages_col"))
+        return super()._transform(df)
+
+
+class OpenAICompletion(_OpenAIBase):
+    """(ref ``OpenAICompletion.scala``)"""
+
+    prompt_col = Param("prompt_col", "prompt column", default="prompt")
+    output_col = Param("output_col", "completion column", default="completions")
+
+    def service_param_names(self):
+        return super().service_param_names() + ["_prompt"]
+
+    def _row_params(self, p, n):
+        rows = CognitiveServiceBase._row_params(self, p, n)
+        prompts = p[self.get("prompt_col")]
+        for i, r in enumerate(rows):
+            r["_prompt"] = prompts[i]
+        return rows
+
+    def resolve_row_param(self, name, partition, n):
+        if name == "_prompt":
+            return [None] * n
+        return super().resolve_row_param(name, partition, n)
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        if rp.get("_prompt") is None:
+            return None
+        body = {"prompt": str(rp["_prompt"]), **self._common_body(rp)}
+        return HTTPRequest(url=self._endpoint(rp, "completions"), method="POST",
+                           headers=self.auth_headers(rp), entity=json.dumps(body))
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("prompt_col"))
+        return super()._transform(df)
+
+
+class OpenAIEmbedding(_OpenAIBase):
+    """(ref ``OpenAIEmbedding.scala:27``) — emits the embedding vector
+    directly (not the raw payload)."""
+
+    text_col = Param("text_col", "text column", default="text")
+    output_col = Param("output_col", "embedding column", default="embedding")
+
+    def service_param_names(self):
+        return super().service_param_names() + ["_text"]
+
+    def _row_params(self, p, n):
+        rows = CognitiveServiceBase._row_params(self, p, n)
+        texts = p[self.get("text_col")]
+        for i, r in enumerate(rows):
+            r["_text"] = texts[i]
+        return rows
+
+    def resolve_row_param(self, name, partition, n):
+        if name == "_text":
+            return [None] * n
+        return super().resolve_row_param(name, partition, n)
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        if rp.get("_text") is None:
+            return None
+        return HTTPRequest(url=self._endpoint(rp, "embeddings"), method="POST",
+                           headers=self.auth_headers(rp),
+                           entity=json.dumps({"input": str(rp["_text"])}))
+
+    def parse_response(self, payload):
+        data = payload.get("data") or []
+        if data and "embedding" in data[0]:
+            return np.asarray(data[0]["embedding"], np.float32)
+        return None
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("text_col"))
+        return super()._transform(df)
+
+
+# ---------------------------------------------------------------------------
+# OpenAIPrompt
+# ---------------------------------------------------------------------------
+
+_TEMPLATE_RE = re.compile(r"\{(\w+)\}")
+
+
+def parse_json_output(text: str, schema_hint=None):
+    """Extract the first JSON object/array from the reply."""
+    text = text.strip()
+    for start, end in (("{", "}"), ("[", "]")):
+        i = text.find(start)
+        if i >= 0:
+            j = text.rfind(end)
+            if j > i:
+                try:
+                    return json.loads(text[i : j + 1])
+                except json.JSONDecodeError:
+                    continue
+    return None
+
+
+class OpenAIPrompt(_OpenAIBase):
+    """(ref ``OpenAIPrompt.scala:40-767``) — prompt template interpolated from
+    columns; post parsers: none | json | regex | csv (``:731-767``)."""
+
+    prompt_template = Param("prompt_template",
+                            "template with {column} placeholders")
+    output_col = Param("output_col", "parsed output column", default="outParsedOutput")
+    post_processing = Param("post_processing", "none | json | regex | csv",
+                            default="none",
+                            validator=lambda v: v in ("none", "json", "regex", "csv"))
+    post_processing_options = Param("post_processing_options",
+                                    "dict: regexGroup/regex or delimiter",
+                                    default=None)
+    system_prompt = Param("system_prompt", "optional system message", default=None)
+
+    def service_param_names(self):
+        return super().service_param_names() + ["_prompt"]
+
+    def _row_params(self, p, n):
+        rows = CognitiveServiceBase._row_params(self, p, n)
+        template = self.get("prompt_template")
+        cols = _TEMPLATE_RE.findall(template)
+        missing = [c for c in cols if c not in p]
+        if missing:
+            raise ValueError(f"OpenAIPrompt: template columns {missing} "
+                             f"not in DataFrame")
+        for i, r in enumerate(rows):
+            # substitute ONLY known {column} placeholders so literal braces in
+            # the prompt (e.g. JSON examples) pass through untouched
+            r["_prompt"] = _TEMPLATE_RE.sub(
+                lambda m: str(p[m.group(1)][i]) if m.group(1) in p else m.group(0),
+                template)
+        return rows
+
+    def resolve_row_param(self, name, partition, n):
+        if name == "_prompt":
+            return [None] * n
+        return super().resolve_row_param(name, partition, n)
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        msgs = []
+        if self.get("system_prompt"):
+            msgs.append({"role": "system", "content": self.get("system_prompt")})
+        msgs.append({"role": "user", "content": rp["_prompt"]})
+        body = {"messages": msgs, **self._common_body(rp)}
+        return HTTPRequest(url=self._endpoint(rp, "chat/completions"), method="POST",
+                           headers=self.auth_headers(rp), entity=json.dumps(body))
+
+    def parse_response(self, payload):
+        try:
+            text = payload["choices"][0]["message"]["content"]
+        except (KeyError, IndexError, TypeError):
+            return None
+        mode = self.get("post_processing")
+        opts = self.get("post_processing_options") or {}
+        if mode == "none":
+            return text
+        if mode == "json":
+            return parse_json_output(text)
+        if mode == "regex":
+            m = re.search(opts.get("regex", "(.*)"), text, re.DOTALL)
+            return m.group(int(opts.get("regexGroup", 1))) if m else None
+        if mode == "csv":
+            delim = opts.get("delimiter", ",")
+            return [s.strip() for s in text.strip().split(delim)]
+        return text

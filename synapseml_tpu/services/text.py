@@ -1,0 +1,107 @@
+"""Text analytics services (reference ``services/text/TextAnalytics.scala`` /
+``language/AnalyzeText.scala``): the analyze-text task surface — sentiment,
+key phrases, language detection, entity recognition."""
+
+from __future__ import annotations
+
+import json
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, ServiceParam
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase
+
+__all__ = ["AnalyzeText", "TextSentiment", "KeyPhraseExtractor",
+           "LanguageDetector", "EntityRecognizer"]
+
+
+class AnalyzeText(CognitiveServiceBase):
+    """(ref ``AnalyzeText.scala``) generic analyze-text task."""
+
+    kind = Param("kind", "SentimentAnalysis | KeyPhraseExtraction | "
+                 "LanguageDetection | EntityRecognition",
+                 default="SentimentAnalysis")
+    text_col = Param("text_col", "document text column", default="text")
+    language = ServiceParam("language", "document language", default="en")
+    api_version = Param("api_version", "API version", default="2023-04-01")
+
+    def service_param_names(self):
+        return super().service_param_names() + ["_text"]
+
+    def _row_params(self, p, n):
+        rows = CognitiveServiceBase._row_params(self, p, n)
+        texts = p[self.get("text_col")]
+        for i, r in enumerate(rows):
+            r["_text"] = texts[i]
+        return rows
+
+    def resolve_row_param(self, name, partition, n):
+        if name == "_text":
+            return [None] * n
+        return super().resolve_row_param(name, partition, n)
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        if rp.get("_text") is None:
+            return None
+        kind = self.get("kind")
+        doc = {"id": "0", "text": str(rp["_text"])}
+        if kind != "LanguageDetection":
+            doc["language"] = rp.get("language") or "en"
+        body = {"kind": kind,
+                "analysisInput": {"documents": [doc]},
+                "parameters": {}}
+        url = (f"{(self.get('url') or '').rstrip('/')}"
+               f"/language/:analyze-text?api-version={self.get('api_version')}")
+        headers = {"Content-Type": "application/json", **self.auth_headers(rp)}
+        return HTTPRequest(url=url, method="POST", headers=headers,
+                           entity=json.dumps(body))
+
+    def parse_response(self, payload):
+        try:
+            return payload["results"]["documents"][0]
+        except (KeyError, IndexError, TypeError):
+            return payload
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("text_col"))
+        return super()._transform(df)
+
+
+class TextSentiment(AnalyzeText):
+    """(ref ``TextSentiment``)"""
+
+    kind = Param("kind", "fixed task", default="SentimentAnalysis")
+    output_col = Param("output_col", "sentiment column", default="sentiment")
+
+    def parse_response(self, payload):
+        doc = super().parse_response(payload)
+        return doc.get("sentiment", doc) if isinstance(doc, dict) else doc
+
+
+class KeyPhraseExtractor(AnalyzeText):
+    kind = Param("kind", "fixed task", default="KeyPhraseExtraction")
+    output_col = Param("output_col", "key phrase column", default="keyPhrases")
+
+    def parse_response(self, payload):
+        doc = super().parse_response(payload)
+        return doc.get("keyPhrases", doc) if isinstance(doc, dict) else doc
+
+
+class LanguageDetector(AnalyzeText):
+    kind = Param("kind", "fixed task", default="LanguageDetection")
+    output_col = Param("output_col", "language column", default="language")
+
+    def parse_response(self, payload):
+        doc = super().parse_response(payload)
+        if isinstance(doc, dict) and "detectedLanguage" in doc:
+            return doc["detectedLanguage"]
+        return doc
+
+
+class EntityRecognizer(AnalyzeText):
+    kind = Param("kind", "fixed task", default="EntityRecognition")
+    output_col = Param("output_col", "entities column", default="entities")
+
+    def parse_response(self, payload):
+        doc = super().parse_response(payload)
+        return doc.get("entities", doc) if isinstance(doc, dict) else doc

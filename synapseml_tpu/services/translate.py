@@ -1,0 +1,58 @@
+"""Translate (reference ``services/translate/Translate.scala``)."""
+
+from __future__ import annotations
+
+import json
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, ServiceParam
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase
+
+__all__ = ["Translate"]
+
+
+class Translate(CognitiveServiceBase):
+    text_col = Param("text_col", "text column", default="text")
+    to_language = ServiceParam("to_language", "target language(s), str or list")
+    from_language = ServiceParam("from_language", "source language", default=None)
+    output_col = Param("output_col", "translations column", default="translation")
+    api_version = Param("api_version", "API version", default="3.0")
+
+    def service_param_names(self):
+        return super().service_param_names() + ["_text"]
+
+    def _row_params(self, p, n):
+        rows = CognitiveServiceBase._row_params(self, p, n)
+        texts = p[self.get("text_col")]
+        for i, r in enumerate(rows):
+            r["_text"] = texts[i]
+        return rows
+
+    def resolve_row_param(self, name, partition, n):
+        if name == "_text":
+            return [None] * n
+        return super().resolve_row_param(name, partition, n)
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        if rp.get("_text") is None:
+            return None
+        to = rp.get("to_language")
+        to = [to] if isinstance(to, str) else list(to or [])
+        qs = f"api-version={self.get('api_version')}" + "".join(f"&to={t}" for t in to)
+        if rp.get("from_language"):
+            qs += f"&from={rp['from_language']}"
+        url = f"{(self.get('url') or '').rstrip('/')}/translate?{qs}"
+        headers = {"Content-Type": "application/json", **self.auth_headers(rp)}
+        return HTTPRequest(url=url, method="POST", headers=headers,
+                           entity=json.dumps([{"Text": str(rp["_text"])}]))
+
+    def parse_response(self, payload):
+        try:
+            return [t["text"] for t in payload[0]["translations"]]
+        except (KeyError, IndexError, TypeError):
+            return payload
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("text_col"))
+        return super()._transform(df)
