@@ -1,0 +1,11 @@
+"""CyberML (reference python-only ``core/src/main/python/synapse/ml/cyber/`` —
+SURVEY.md §2.5): user-resource access anomaly detection via collaborative
+filtering (``anomaly/collaborative_filtering.py``, 1226 LoC), complement
+access sampling, and per-tenant feature scalers/indexers."""
+
+from .anomaly import AccessAnomaly, AccessAnomalyModel, ComplementAccessTransformer
+from .features import IdIndexer, IdIndexerModel, PartitionedMinMaxScaler, PartitionedStandardScaler
+
+__all__ = ["AccessAnomaly", "AccessAnomalyModel", "ComplementAccessTransformer",
+           "IdIndexer", "IdIndexerModel", "PartitionedStandardScaler",
+           "PartitionedMinMaxScaler"]

@@ -1,0 +1,198 @@
+"""AccessAnomaly (reference ``cyber/anomaly/collaborative_filtering.py:616``):
+per-tenant ALS over (user, resource) access counts; the anomaly score of an
+observed access is its standardized NEGATIVE predicted affinity — accesses the
+factor model finds unlikely score high.
+
+TPU shape: the ALS normal equations are dense batched solves (jax
+``vmap(solve)`` over users/resources); per-tenant models are independent.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model, Transformer
+
+__all__ = ["AccessAnomaly", "AccessAnomalyModel", "ComplementAccessTransformer"]
+
+_DEFAULT_TENANT = "__single_tenant__"
+
+
+def _als(counts: np.ndarray, rank: int, reg: float, n_iter: int, seed: int,
+         alpha: float = 1.0):
+    """Implicit-feedback ALS on a dense [U, R] count matrix -> (U_f, R_f)."""
+    import jax
+    import jax.numpy as jnp
+
+    U, R = counts.shape
+    rng = np.random.default_rng(seed)
+    u_f = jnp.asarray(rng.normal(scale=0.1, size=(U, rank)), jnp.float32)
+    r_f = jnp.asarray(rng.normal(scale=0.1, size=(R, rank)), jnp.float32)
+    conf = jnp.asarray(1.0 + alpha * counts, jnp.float32)     # confidence
+    pref = jnp.asarray((counts > 0).astype(np.float32))       # preference
+    eye = jnp.eye(rank, dtype=jnp.float32) * reg
+
+    @jax.jit
+    def solve_side(fixed, conf_rows, pref_rows):
+        # per row i: (Fᵀ C_i F + λI) x = Fᵀ C_i p_i
+        def one(c, p):
+            A = (fixed.T * c) @ fixed + eye
+            b = (fixed.T * c) @ p
+            return jnp.linalg.solve(A, b)
+
+        return jax.vmap(one)(conf_rows, pref_rows)
+
+    for _ in range(n_iter):
+        u_f = solve_side(r_f, conf, pref)
+        r_f = solve_side(u_f, conf.T, pref.T)
+    return np.asarray(u_f), np.asarray(r_f)
+
+
+class AccessAnomaly(Estimator):
+    feature_name = "cyber"
+
+    tenant_col = Param("tenant_col", "tenant column (None = single tenant)",
+                       default=None)
+    user_col = Param("user_col", "user column", default="user")
+    res_col = Param("res_col", "resource column", default="res")
+    likelihood_col = Param("likelihood_col", "access count/weight column "
+                           "(None = 1 per row)", default=None)
+    rank = Param("rank", "latent factor rank", default=10,
+                 converter=TypeConverters.to_int)
+    reg = Param("reg", "ALS ridge", default=0.1, converter=TypeConverters.to_float)
+    max_iter = Param("max_iter", "ALS iterations", default=10,
+                     converter=TypeConverters.to_int)
+    seed = Param("seed", "rng seed", default=0, converter=TypeConverters.to_int)
+    output_col = Param("output_col", "anomaly score column", default="anomaly_score")
+
+    def _fit(self, df: DataFrame) -> "AccessAnomalyModel":
+        self.require_columns(df, self.get("user_col"), self.get("res_col"))
+        if self.get("likelihood_col"):
+            self.require_columns(df, self.get("likelihood_col"))
+        tc = self.get("tenant_col")
+        if tc:
+            self.require_columns(df, tc)
+        # ids handled as strings THROUGHOUT so np.unique's sort order matches
+        # the searchsorted at scoring time (numeric ids would sort differently)
+        users = np.asarray(df.collect_column(self.get("user_col"))).astype(str)
+        ress = np.asarray(df.collect_column(self.get("res_col"))).astype(str)
+        tenants = (np.asarray(df.collect_column(tc)) if tc
+                   else np.full(len(users), _DEFAULT_TENANT, dtype=object))
+        weights = (np.asarray(df.collect_column(self.get("likelihood_col")), np.float64)
+                   if self.get("likelihood_col") else np.ones(len(users)))
+        models = {}
+        for tenant in np.unique(tenants):
+            m = tenants == tenant
+            u_levels, u_idx = np.unique(users[m], return_inverse=True)
+            r_levels, r_idx = np.unique(ress[m], return_inverse=True)
+            counts = np.zeros((len(u_levels), len(r_levels)), np.float64)
+            np.add.at(counts, (u_idx, r_idx), weights[m])
+            u_f, r_f = _als(counts, min(self.get("rank"),
+                                        min(counts.shape) or 1),
+                            self.get("reg"), self.get("max_iter"), self.get("seed"))
+            # standardize affinity over OBSERVED accesses within the tenant
+            aff = np.sum(u_f[u_idx] * r_f[r_idx], axis=1)
+            mu, sd = float(aff.mean()), float(aff.std() or 1.0)
+            # unicode (not object) arrays: the npz pytree serializer is
+            # pickle-free, object arrays would fail to load
+            models[str(tenant)] = {"users": u_levels, "res": r_levels,
+                                   "u_f": u_f, "r_f": r_f, "mean": mu, "std": sd}
+        return AccessAnomalyModel(tenant_models=models,
+                                  tenant_col=tc, user_col=self.get("user_col"),
+                                  res_col=self.get("res_col"),
+                                  output_col=self.get("output_col"))
+
+
+class AccessAnomalyModel(Model):
+    tenant_models = ComplexParam("tenant_models", "per-tenant factor models")
+    tenant_col = Param("tenant_col", "tenant column", default=None)
+    user_col = Param("user_col", "user column", default="user")
+    res_col = Param("res_col", "resource column", default="res")
+    output_col = Param("output_col", "anomaly score column", default="anomaly_score")
+
+    def _score_one(self, tenant, user, res) -> float:
+        m = self.get("tenant_models").get(str(tenant))
+        if m is None:
+            return float("nan")
+        user, res = str(user), str(res)
+        ui = np.searchsorted(m["users"], user)
+        ri = np.searchsorted(m["res"], res)
+        unseen_u = ui >= len(m["users"]) or m["users"][ui] != user
+        unseen_r = ri >= len(m["res"]) or m["res"][ri] != res
+        if unseen_u or unseen_r:
+            return 2.0  # unseen entity: highly unusual for this tenant
+        aff = float(m["u_f"][ui] @ m["r_f"][ri])
+        return (m["mean"] - aff) / m["std"]  # low affinity -> high score
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("user_col"), self.get("res_col"))
+        tc = self.get("tenant_col")
+
+        def score(p):
+            n = len(p[self.get("user_col")])
+            tenants = p[tc] if tc else [_DEFAULT_TENANT] * n
+            return np.asarray([
+                self._score_one(tenants[i], p[self.get("user_col")][i],
+                                p[self.get("res_col")][i])
+                for i in range(n)], np.float64)
+
+        return df.with_column(self.get("output_col"), score)
+
+
+class ComplementAccessTransformer(Transformer):
+    """(ref ``cyber/anomaly/ComplementAccessTransformer``) — emit (user, res)
+    pairs the user did NOT access (sampled), for evaluation against observed
+    accesses."""
+
+    feature_name = "cyber"
+
+    tenant_col = Param("tenant_col", "tenant column", default=None)
+    user_col = Param("user_col", "user column", default="user")
+    res_col = Param("res_col", "resource column", default="res")
+    factor = Param("factor", "complement rows per observed row", default=1,
+                   converter=TypeConverters.to_int)
+    seed = Param("seed", "rng seed", default=0, converter=TypeConverters.to_int)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("user_col"), self.get("res_col"))
+        tc = self.get("tenant_col")
+        # ids handled as strings THROUGHOUT so np.unique's sort order matches
+        # the searchsorted at scoring time (numeric ids would sort differently)
+        users = np.asarray(df.collect_column(self.get("user_col"))).astype(str)
+        ress = np.asarray(df.collect_column(self.get("res_col"))).astype(str)
+        tenants = (np.asarray(df.collect_column(tc)) if tc
+                   else np.full(len(users), _DEFAULT_TENANT, dtype=object))
+        rng = np.random.default_rng(self.get("seed"))
+        out_rows = {self.get("user_col"): [], self.get("res_col"): []}
+        if tc:
+            out_rows[tc] = []
+        for tenant in np.unique(tenants):
+            m = tenants == tenant
+            seen = set(zip(users[m].tolist(), ress[m].tolist()))
+            t_users = np.unique(users[m])
+            t_res = np.unique(ress[m])
+            want = int(m.sum()) * self.get("factor")
+            budget = len(t_users) * len(t_res) - len(seen)
+            want = min(want, max(budget, 0))
+            got = 0
+            attempts = 0
+            emitted = set()
+            while got < want and attempts < want * 50:
+                u = t_users[rng.integers(len(t_users))]
+                r = t_res[rng.integers(len(t_res))]
+                attempts += 1
+                key = (u, r)
+                if key in seen or key in emitted:
+                    continue
+                emitted.add(key)
+                out_rows[self.get("user_col")].append(u)
+                out_rows[self.get("res_col")].append(r)
+                if tc:
+                    out_rows[tc].append(tenant)
+                got += 1
+        if not out_rows[self.get("user_col")]:
+            return DataFrame([{}])
+        return DataFrame.from_dict({k: np.asarray(v, dtype=object)
+                                    for k, v in out_rows.items()})

@@ -1,0 +1,155 @@
+"""Per-tenant feature utilities (reference ``cyber/feature/{scalers,indexers}.py``):
+scalers standardize/min-max a numeric column WITHIN each tenant partition;
+IdIndexer assigns per-tenant contiguous integer ids."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Estimator, Model
+
+__all__ = ["PartitionedStandardScaler", "PartitionedMinMaxScaler",
+           "IdIndexer", "IdIndexerModel"]
+
+_DEFAULT_TENANT = "__single_tenant__"
+
+
+class _PartitionedScalerBase(Estimator):
+    tenant_col = Param("tenant_col", "tenant column (None = global)", default=None)
+    input_col = Param("input_col", "numeric column", default="value")
+    output_col = Param("output_col", "scaled column", default="scaled")
+
+    def _tenants_of(self, df: DataFrame) -> np.ndarray:
+        tc = self.get("tenant_col")
+        n = df.count()
+        return (np.asarray(df.collect_column(tc)) if tc
+                else np.full(n, _DEFAULT_TENANT, dtype=object))
+
+    def _stats(self, vals: np.ndarray) -> dict:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _fit(self, df: DataFrame) -> "_PartitionedScalerModel":
+        self.require_columns(df, self.get("input_col"))
+        if self.get("tenant_col"):
+            self.require_columns(df, self.get("tenant_col"))
+        vals = np.asarray(df.collect_column(self.get("input_col")), np.float64)
+        tenants = self._tenants_of(df)
+        stats = {str(t): self._stats(vals[tenants == t]) for t in np.unique(tenants)}
+        return _PartitionedScalerModel(
+            stats=stats, kind=type(self).__name__,
+            tenant_col=self.get("tenant_col"), input_col=self.get("input_col"),
+            output_col=self.get("output_col"))
+
+
+class PartitionedStandardScaler(_PartitionedScalerBase):
+    """(ref ``cyber/feature/scalers.py`` StandardScalarScaler)"""
+
+    feature_name = "cyber"
+
+    def _stats(self, vals: np.ndarray) -> dict:
+        return {"mean": float(vals.mean()) if len(vals) else 0.0,
+                "std": float(vals.std()) or 1.0}
+
+
+class PartitionedMinMaxScaler(_PartitionedScalerBase):
+    """(ref ``cyber/feature/scalers.py`` LinearScalarScaler)"""
+
+    feature_name = "cyber"
+
+    min_value = Param("min_value", "target range min", default=0.0,
+                      converter=TypeConverters.to_float)
+    max_value = Param("max_value", "target range max", default=1.0,
+                      converter=TypeConverters.to_float)
+
+    def _stats(self, vals: np.ndarray) -> dict:
+        lo = float(vals.min()) if len(vals) else 0.0
+        hi = float(vals.max()) if len(vals) else 1.0
+        return {"lo": lo, "hi": hi, "t_lo": self.get("min_value"),
+                "t_hi": self.get("max_value")}
+
+
+class _PartitionedScalerModel(Model):
+    stats = ComplexParam("stats", "per-tenant statistics")
+    kind = Param("kind", "scaler flavor")
+    tenant_col = Param("tenant_col", "tenant column", default=None)
+    input_col = Param("input_col", "numeric column", default="value")
+    output_col = Param("output_col", "scaled column", default="scaled")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        tc = self.get("tenant_col")
+        stats = self.get("stats")
+        standard = self.get("kind") == "PartitionedStandardScaler"
+
+        def scale(p):
+            vals = np.asarray(p[self.get("input_col")], np.float64)
+            tenants = p[tc] if tc else [_DEFAULT_TENANT] * len(vals)
+            out = np.zeros(len(vals))
+            for i, (v, t) in enumerate(zip(vals, tenants)):
+                s = stats.get(str(t))
+                if s is None:
+                    out[i] = np.nan
+                elif standard:
+                    out[i] = (v - s["mean"]) / s["std"]
+                else:
+                    span = (s["hi"] - s["lo"]) or 1.0
+                    out[i] = s["t_lo"] + (v - s["lo"]) / span * (s["t_hi"] - s["t_lo"])
+            return out
+
+        return df.with_column(self.get("output_col"), scale)
+
+
+class IdIndexer(Estimator):
+    """(ref ``cyber/feature/indexers.py``) per-tenant contiguous ids."""
+
+    feature_name = "cyber"
+
+    tenant_col = Param("tenant_col", "tenant column (None = global)", default=None)
+    input_col = Param("input_col", "id column", default="user")
+    output_col = Param("output_col", "indexed column", default="user_id")
+    reset_per_partition = Param("reset_per_partition", "ids restart per tenant",
+                                default=True, converter=TypeConverters.to_bool)
+
+    def _fit(self, df: DataFrame) -> "IdIndexerModel":
+        self.require_columns(df, self.get("input_col"))
+        if self.get("tenant_col"):
+            self.require_columns(df, self.get("tenant_col"))
+        vals = np.asarray(df.collect_column(self.get("input_col")))
+        tc = self.get("tenant_col")
+        tenants = (np.asarray(df.collect_column(tc)) if tc
+                   else np.full(len(vals), _DEFAULT_TENANT, dtype=object))
+        mapping: dict = {}
+        if self.get("reset_per_partition"):
+            for t in np.unique(tenants):
+                levels = np.unique(vals[tenants == t])
+                mapping[str(t)] = {str(v): i for i, v in enumerate(levels)}
+        else:
+            levels = np.unique(vals)
+            flat = {str(v): i for i, v in enumerate(levels)}
+            for t in np.unique(tenants):
+                mapping[str(t)] = flat
+        return IdIndexerModel(mapping=mapping, tenant_col=tc,
+                              input_col=self.get("input_col"),
+                              output_col=self.get("output_col"))
+
+
+class IdIndexerModel(Model):
+    mapping = ComplexParam("mapping", "tenant -> value -> id")
+    tenant_col = Param("tenant_col", "tenant column", default=None)
+    input_col = Param("input_col", "id column", default="user")
+    output_col = Param("output_col", "indexed column", default="user_id")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        tc = self.get("tenant_col")
+        mapping = self.get("mapping")
+
+        def index(p):
+            vals = p[self.get("input_col")]
+            tenants = p[tc] if tc else [_DEFAULT_TENANT] * len(vals)
+            return np.asarray([mapping.get(str(t), {}).get(str(v), -1)
+                               for t, v in zip(tenants, vals)], np.int64)
+
+        return df.with_column(self.get("output_col"), index)

@@ -1,0 +1,16 @@
+"""HuggingFace-style LLM transformers (reference ``deep-learning/.../hf/`` —
+SURVEY.md §2.3): batch causal-LM generation and sentence embedding as
+DataFrame transformers.
+
+TPU design: the reference broadcasts a torch model per partition
+(``HuggingFaceCausalLMTransform.py:103-331``); here ONE jitted
+prefill+decode program (static prompt buckets, KV cache in HBM,
+``flax_nets.llama.greedy_generate``) serves every partition, and the
+embedder pools a Flax encoder instead of sentence-transformers
+(``HuggingFaceSentenceEmbedder.py:26-228``).
+"""
+
+from .causal_lm import HuggingFaceCausalLM
+from .embedder import HuggingFaceSentenceEmbedder
+
+__all__ = ["HuggingFaceCausalLM", "HuggingFaceSentenceEmbedder"]

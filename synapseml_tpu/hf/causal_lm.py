@@ -1,0 +1,144 @@
+"""HuggingFaceCausalLM (reference ``hf/HuggingFaceCausalLMTransform.py:103-331``).
+
+Batch LLM inference as a Transformer: prompts (or chat message lists) ->
+tokenize -> pad to a static prompt bucket -> jitted prefill+decode
+(``greedy_generate``: KV cache, lax.while_loop, early EOS exit) -> detokenize.
+
+Model loading: ``set_params`` with a flax param pytree (e.g. restored from an
+orbax checkpoint), or random init from the architecture preset for smoke
+tests. Tokenization: a transformers tokenizer when available locally
+(decode-capable), else token-id passthrough columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Transformer
+from ..models.flax_nets.llama import LlamaLM, greedy_generate, llama2_7b, llama_tiny
+__all__ = ["HuggingFaceCausalLM"]
+
+_ARCHS = {"llama2-7b": llama2_7b, "llama-tiny": llama_tiny}
+
+
+def default_chat_template(messages) -> str:
+    """Minimal chat template (reference applies the HF tokenizer's template;
+    ``HuggingFaceCausalLMTransform.py`` chat mode)."""
+    parts = [f"<|{m['role']}|>\n{m['content']}" for m in messages]
+    return "\n".join(parts) + "\n<|assistant|>\n"
+
+
+class HuggingFaceCausalLM(Transformer):
+    feature_name = "hf"
+
+    model_name = Param("model_name", "architecture preset", default="llama-tiny",
+                       validator=lambda v: v in _ARCHS)
+    model_params = ComplexParam("model_params", "flax param pytree (None = random init)",
+                                default=None)
+    tokenizer = ComplexParam("tokenizer", "tokenizer spec/object", default=None)
+    input_col = Param("input_col", "prompt text column (completion mode)",
+                      default="prompt")
+    messages_col = Param("messages_col", "chat messages column (chat mode, "
+                         "takes precedence when set)", default=None)
+    output_col = Param("output_col", "generated text column", default="completions")
+    max_new_tokens = Param("max_new_tokens", "tokens to generate", default=32,
+                           converter=TypeConverters.to_int)
+    prompt_bucket = Param("prompt_bucket", "pad prompts to multiples of this",
+                          default=64, converter=TypeConverters.to_int)
+    batch_size = Param("batch_size", "rows per padded device batch", default=8,
+                       converter=TypeConverters.to_int)
+    eos_id = Param("eos_id", "stop token id", default=None)
+
+    # ---- lazy model/tokenizer ----
+    def _model_and_params(self):
+        if self.__dict__.get("_cache_model") is None:
+            from ..models.tokenizer import resolve_tokenizer
+
+            tok = resolve_tokenizer(self.get("tokenizer"))
+            cfg = _ARCHS[self.get("model_name")](vocab_size=tok.vocab_size)
+            model = LlamaLM(cfg, decode=True)  # KV-cache mode for generate
+            params = self.get("model_params")
+            if params is None:
+                import jax
+                import jax.numpy as jnp
+
+                B, T = 1, 8
+                variables = LlamaLM(cfg).init(jax.random.PRNGKey(0),
+                                              jnp.zeros((B, T), jnp.int32))
+                params = variables["params"]
+            self.__dict__["_cache_model"] = (model, params, tok)
+        return self.__dict__["_cache_model"]
+
+    def _generate_fn(self, B: int, P: int):
+        import jax
+
+        key = ("gen", B, P, self.get("max_new_tokens"))
+        cache = self.__dict__.setdefault("_cache_gen", {})
+        if key not in cache:
+            model, params, _ = self._model_and_params()
+
+            def fn(ids, mask):
+                return greedy_generate(model, params, ids,
+                                       self.get("max_new_tokens"),
+                                       eos_id=self.get("eos_id"),
+                                       prompt_mask=mask)
+
+            cache[key] = jax.jit(fn)
+        return cache[key]
+
+    def _texts_of(self, p) -> list[str]:
+        mc = self.get("messages_col")
+        if mc:
+            return [default_chat_template(list(m)) for m in p[mc]]
+        return [str(t) for t in p[self.get("input_col")]]
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        mc = self.get("messages_col")
+        self.require_columns(df, mc if mc else self.get("input_col"))
+        model, params, tok = self._model_and_params()
+        B = self.get("batch_size")
+        bucket = self.get("prompt_bucket")
+
+        def per_part(p):
+            n = len(next(iter(p.values()))) if p else 0
+            if n == 0:
+                return None
+            texts = self._texts_of(p)
+            enc = tok(texts, max_len=model.cfg.max_len -
+                      self.get("max_new_tokens"), multiple_of=bucket)
+            ids = np.asarray(enc["input_ids"], np.int32)
+            mask = np.asarray(enc["attention_mask"], np.int32)
+            P = ids.shape[1]
+            fn = self._generate_fn(B, P)
+            outs = []
+            for s in range(0, n, B):
+                e = min(s + B, n)
+                pad = B - (e - s)
+                ib = np.pad(ids[s:e], ((0, pad), (0, 0)))
+                mb = np.pad(mask[s:e], ((0, pad), (0, 0)), constant_values=1)
+                gen = np.asarray(fn(ib, mb))[: e - s]
+                outs.append(gen[:, P:])                     # generated ids only
+            gen_ids = np.concatenate(outs, axis=0)
+            col = np.empty(n, dtype=object)
+            decode = getattr(tok, "decode", None)
+            for i in range(n):
+                toks = gen_ids[i]
+                if self.get("eos_id") is not None:
+                    stop = np.nonzero(toks == self.get("eos_id"))[0]
+                    if len(stop):
+                        toks = toks[: stop[0]]
+                col[i] = decode(toks.tolist()) if decode else toks
+            q = dict(p)
+            q[self.get("output_col")] = col
+            return q
+
+        parts = [per_part(p) for p in df.partitions]
+        out_parts = []
+        for p, q in zip(df.partitions, parts):
+            if q is None:
+                q = dict(p)
+                q[self.get("output_col")] = np.empty(0, dtype=object)
+            out_parts.append(q)
+        return DataFrame(out_parts)

@@ -1,0 +1,119 @@
+"""HuggingFaceSentenceEmbedder (reference ``hf/HuggingFaceSentenceEmbedder.py:26-228``,
+sentence-transformers + optional TensorRT): text -> pooled encoder embedding.
+
+Here: a Flax BERT-style encoder jitted once per batch shape; masked mean
+pooling (the sentence-transformers default) or CLS pooling; L2 normalization
+optional. Padded fixed-size batches keep one compiled program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param, TypeConverters
+from ..core.pipeline import Transformer
+from ..models.flax_nets.bert import BertEmbeddings, bert_base, bert_tiny
+from ..models.flax_nets.transformer import Encoder
+
+__all__ = ["HuggingFaceSentenceEmbedder"]
+
+_ARCHS = {"bert-base": bert_base, "bert-tiny": bert_tiny}
+
+
+class _BertEncoder:
+    """Embeddings + encoder stack (no classification head)."""
+
+    def __init__(self, cfg):
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, input_ids, attention_mask):
+                x = BertEmbeddings(cfg, name="embeddings")(input_ids)
+                mask = attention_mask[:, None, None, :].astype(bool)
+                return Encoder(cfg, name="encoder")(x, mask)
+
+        self.net = Net()
+        self.cfg = cfg
+
+
+class HuggingFaceSentenceEmbedder(Transformer):
+    feature_name = "hf"
+
+    model_name = Param("model_name", "encoder preset", default="bert-tiny",
+                       validator=lambda v: v in _ARCHS)
+    model_params = ComplexParam("model_params", "flax param pytree (None = random)",
+                                default=None)
+    tokenizer = ComplexParam("tokenizer", "tokenizer spec/object", default=None)
+    input_col = Param("input_col", "text column", default="text")
+    output_col = Param("output_col", "embedding column", default="embeddings")
+    pooling = Param("pooling", "mean | cls", default="mean",
+                    validator=lambda v: v in ("mean", "cls"))
+    normalize = Param("normalize", "L2-normalize embeddings", default=True,
+                      converter=TypeConverters.to_bool)
+    max_token_len = Param("max_token_len", "truncation length", default=128,
+                          converter=TypeConverters.to_int)
+    batch_size = Param("batch_size", "rows per padded batch", default=32,
+                       converter=TypeConverters.to_int)
+
+    def _setup(self):
+        if self.__dict__.get("_cache_model") is None:
+            import jax
+            import jax.numpy as jnp
+
+            from ..models.tokenizer import resolve_tokenizer
+
+            tok = resolve_tokenizer(self.get("tokenizer"))
+            cfg = _ARCHS[self.get("model_name")](vocab_size=tok.vocab_size,
+                                                 dtype=jnp.float32)
+            enc = _BertEncoder(cfg)
+            params = self.get("model_params")
+            if params is None:
+                params = enc.net.init(jax.random.PRNGKey(0),
+                                      jnp.zeros((1, 8), jnp.int32),
+                                      jnp.ones((1, 8), jnp.int32))["params"]
+
+            def embed(ids, mask):
+                h = enc.net.apply({"params": params}, ids, mask)  # [B,T,H]
+                if self.get("pooling") == "cls":
+                    pooled = h[:, 0]
+                else:
+                    m = mask[:, :, None].astype(h.dtype)
+                    pooled = jnp.sum(h * m, axis=1) / jnp.maximum(
+                        jnp.sum(m, axis=1), 1e-9)
+                if self.get("normalize"):
+                    pooled = pooled / jnp.maximum(
+                        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+                return pooled
+
+            self.__dict__["_cache_model"] = (jax.jit(embed), tok)
+        return self.__dict__["_cache_model"]
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        embed, tok = self._setup()
+        B = self.get("batch_size")
+
+        def per_part(p):
+            texts = [str(t) for t in p[self.get("input_col")]]
+            n = len(texts)
+            if n == 0:
+                q = dict(p)
+                q[self.get("output_col")] = np.empty((0, 0), np.float32)
+                return q
+            enc = tok(texts, max_len=self.get("max_token_len"))
+            ids = np.asarray(enc["input_ids"], np.int32)
+            mask = np.asarray(enc["attention_mask"], np.int32)
+            chunks = []
+            for s in range(0, n, B):
+                e = min(s + B, n)
+                pad = B - (e - s)
+                ib = np.pad(ids[s:e], ((0, pad), (0, 0)))
+                mb = np.pad(mask[s:e], ((0, pad), (0, 0)), constant_values=1)
+                chunks.append(np.asarray(embed(ib, mb))[: e - s])
+            q = dict(p)
+            q[self.get("output_col")] = np.concatenate(chunks, axis=0)
+            return q
+
+        return df.map_partitions(per_part)

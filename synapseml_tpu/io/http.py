@@ -115,13 +115,32 @@ class AsyncHTTPClient:
         self.concurrency = max(int(concurrency), 1)
         self.timeout_s = timeout_s
         self.backoffs_ms = tuple(backoffs_ms)
+        self._pool: concurrent.futures.ThreadPoolExecutor | None = None
+
+    def _executor(self) -> concurrent.futures.ThreadPoolExecutor:
+        # one long-lived pool per client: repeated send_all calls (e.g. LRO
+        # polling sweeps) must not pay thread creation each time
+        if self._pool is None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(self.concurrency)
+        return self._pool
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):  # best-effort cleanup
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def send_all(self, requests: list[HTTPRequest | None]) -> list[HTTPResponse | None]:
-        with concurrent.futures.ThreadPoolExecutor(self.concurrency) as pool:
-            futures = [None if r is None else
-                       pool.submit(send_with_retries, r, self.backoffs_ms, self.timeout_s)
-                       for r in requests]
-            return [None if f is None else f.result() for f in futures]
+        pool = self._executor()
+        futures = [None if r is None else
+                   pool.submit(send_with_retries, r, self.backoffs_ms, self.timeout_s)
+                   for r in requests]
+        return [None if f is None else f.result() for f in futures]
 
 
 class HTTPTransformer(Transformer):

@@ -118,7 +118,7 @@ class DeepTextClassifier(Estimator, _TextParams):
 
         host_params = jax.tree.map(np.asarray, state.params)
         return DeepTextModel(
-            params=host_params,
+            model_params=host_params,
             tokenizer_config=tok.to_config(),
             checkpoint=self.get("checkpoint"),
             num_classes=self.get("num_classes"),
@@ -134,7 +134,7 @@ class DeepTextClassifier(Estimator, _TextParams):
 class DeepTextModel(Model, _TextParams):
     feature_name = "deep_learning"
 
-    params = ComplexParam("params", "trained Flax parameter pytree")
+    model_params = ComplexParam("model_params", "trained Flax parameter pytree")
     tokenizer_config = ComplexParam("tokenizer_config", "tokenizer config dict")
     train_metrics = ComplexParam("train_metrics", "loss/throughput trace", default=None)
 
@@ -163,7 +163,7 @@ class DeepTextModel(Model, _TextParams):
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("text_col"))
         apply = self._get_apply()
-        params = self.get("params")
+        params = self.get("model_params")
         bs = self.get("batch_size")
 
         def per_part(part):

@@ -83,7 +83,7 @@ class DeepVisionClassifier(Estimator, _VisionParams):
                            batch_size=bs, total_steps=total, seed=self.get("seed"))
 
         return DeepVisionModel(
-            params=jax.tree.map(np.asarray, state.params),
+            model_params=jax.tree.map(np.asarray, state.params),
             batch_stats=(jax.tree.map(np.asarray, state.batch_stats)
                          if state.batch_stats is not None else None),
             backbone=self.get("backbone"), num_classes=self.get("num_classes"),
@@ -96,7 +96,7 @@ class DeepVisionClassifier(Estimator, _VisionParams):
 class DeepVisionModel(Model, _VisionParams):
     feature_name = "deep_learning"
 
-    params = ComplexParam("params", "trained parameter pytree")
+    model_params = ComplexParam("model_params", "trained parameter pytree")
     batch_stats = ComplexParam("batch_stats", "BN running stats", default=None)
     train_metrics = ComplexParam("train_metrics", "loss/throughput trace", default=None)
 
@@ -123,7 +123,7 @@ class DeepVisionModel(Model, _VisionParams):
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("image_col"))
         apply = self._get_apply()
-        variables = {"params": self.get("params")}
+        variables = {"params": self.get("model_params")}
         if self.get("batch_stats") is not None:
             variables["batch_stats"] = self.get("batch_stats")
         bs = self.get("batch_size")

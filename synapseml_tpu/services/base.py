@@ -3,8 +3,8 @@ a Transformer = pack per-row params -> SimpleHTTPTransformer(inputFunc with
 auth headers) -> unpack/parse -> drop temp cols.
 
 ServiceParams (``HasServiceParams:34``): every request field is either a
-literal applied to all rows or the name of a column with per-row values —
-``set_x("v")`` vs ``set_x_col("colname")``.
+literal applied to all rows — ``stage.set(x="v")`` — or bound to a column
+with per-row values — ``stage.set(x=("col", "colname"))``.
 """
 
 from __future__ import annotations
@@ -56,11 +56,23 @@ class CognitiveServiceBase(Transformer):
         return [name for name, p in self.params().items()
                 if isinstance(p, ServiceParam)]
 
+    def input_bindings(self) -> dict:
+        """pseudo row-param name -> Param holding an input COLUMN name.
+        Declared bindings are validated against the DataFrame and injected
+        per row into ``build_request``'s row_params (one shared mechanism
+        instead of per-service plumbing)."""
+        return {}
+
     # ---- engine ---------------------------------------------------------
     def _row_params(self, p: dict, n: int) -> list[dict]:
         names = self.service_param_names()
         per_param = {name: self.resolve_row_param(name, p, n) for name in names}
-        return [{name: per_param[name][i] for name in names} for i in range(n)]
+        rows = [{name: per_param[name][i] for name in names} for i in range(n)]
+        for key, col_param in self.input_bindings().items():
+            col = p[self.get(col_param)]
+            for i, r in enumerate(rows):
+                r[key] = col[i]
+        return rows
 
     def handle_response(self, resp: HTTPResponse | None) -> tuple:
         """-> (parsed value, error or None)"""
@@ -74,6 +86,8 @@ class CognitiveServiceBase(Transformer):
             return None, f"unparseable response: {e}"
 
     def _transform(self, df: DataFrame) -> DataFrame:
+        for col_param in self.input_bindings().values():
+            self.require_columns(df, self.get(col_param))
         client = AsyncHTTPClient(self.get("concurrency"), self.get("timeout_s"))
 
         def per_part(p):

@@ -78,20 +78,8 @@ class OpenAIChatCompletion(_OpenAIBase):
     messages_col = Param("messages_col", "chat messages column", default="messages")
     output_col = Param("output_col", "reply column", default="chat_completions")
 
-    def service_param_names(self):
-        return super().service_param_names() + ["_messages"]
-
-    def _row_params(self, p, n):
-        rows = CognitiveServiceBase._row_params(self, p, n)
-        msgs = p[self.get("messages_col")]
-        for i, r in enumerate(rows):
-            r["_messages"] = msgs[i]
-        return rows
-
-    def resolve_row_param(self, name, partition, n):
-        if name == "_messages":
-            return [None] * n  # filled by _row_params
-        return super().resolve_row_param(name, partition, n)
+    def input_bindings(self):
+        return {"_messages": "messages_col"}
 
     def build_request(self, rp: dict) -> HTTPRequest | None:
         msgs = rp.get("_messages")
@@ -105,10 +93,6 @@ class OpenAIChatCompletion(_OpenAIBase):
     def parse_response(self, payload):
         return payload
 
-    def _transform(self, df: DataFrame) -> DataFrame:
-        self.require_columns(df, self.get("messages_col"))
-        return super()._transform(df)
-
 
 class OpenAICompletion(_OpenAIBase):
     """(ref ``OpenAICompletion.scala``)"""
@@ -116,20 +100,8 @@ class OpenAICompletion(_OpenAIBase):
     prompt_col = Param("prompt_col", "prompt column", default="prompt")
     output_col = Param("output_col", "completion column", default="completions")
 
-    def service_param_names(self):
-        return super().service_param_names() + ["_prompt"]
-
-    def _row_params(self, p, n):
-        rows = CognitiveServiceBase._row_params(self, p, n)
-        prompts = p[self.get("prompt_col")]
-        for i, r in enumerate(rows):
-            r["_prompt"] = prompts[i]
-        return rows
-
-    def resolve_row_param(self, name, partition, n):
-        if name == "_prompt":
-            return [None] * n
-        return super().resolve_row_param(name, partition, n)
+    def input_bindings(self):
+        return {"_prompt": "prompt_col"}
 
     def build_request(self, rp: dict) -> HTTPRequest | None:
         if rp.get("_prompt") is None:
@@ -137,10 +109,6 @@ class OpenAICompletion(_OpenAIBase):
         body = {"prompt": str(rp["_prompt"]), **self._common_body(rp)}
         return HTTPRequest(url=self._endpoint(rp, "completions"), method="POST",
                            headers=self.auth_headers(rp), entity=json.dumps(body))
-
-    def _transform(self, df: DataFrame) -> DataFrame:
-        self.require_columns(df, self.get("prompt_col"))
-        return super()._transform(df)
 
 
 class OpenAIEmbedding(_OpenAIBase):
@@ -150,20 +118,8 @@ class OpenAIEmbedding(_OpenAIBase):
     text_col = Param("text_col", "text column", default="text")
     output_col = Param("output_col", "embedding column", default="embedding")
 
-    def service_param_names(self):
-        return super().service_param_names() + ["_text"]
-
-    def _row_params(self, p, n):
-        rows = CognitiveServiceBase._row_params(self, p, n)
-        texts = p[self.get("text_col")]
-        for i, r in enumerate(rows):
-            r["_text"] = texts[i]
-        return rows
-
-    def resolve_row_param(self, name, partition, n):
-        if name == "_text":
-            return [None] * n
-        return super().resolve_row_param(name, partition, n)
+    def input_bindings(self):
+        return {"_text": "text_col"}
 
     def build_request(self, rp: dict) -> HTTPRequest | None:
         if rp.get("_text") is None:
@@ -177,10 +133,6 @@ class OpenAIEmbedding(_OpenAIBase):
         if data and "embedding" in data[0]:
             return np.asarray(data[0]["embedding"], np.float32)
         return None
-
-    def _transform(self, df: DataFrame) -> DataFrame:
-        self.require_columns(df, self.get("text_col"))
-        return super()._transform(df)
 
 
 # ---------------------------------------------------------------------------
@@ -220,11 +172,8 @@ class OpenAIPrompt(_OpenAIBase):
                                     default=None)
     system_prompt = Param("system_prompt", "optional system message", default=None)
 
-    def service_param_names(self):
-        return super().service_param_names() + ["_prompt"]
-
     def _row_params(self, p, n):
-        rows = CognitiveServiceBase._row_params(self, p, n)
+        rows = super()._row_params(p, n)
         template = self.get("prompt_template")
         cols = _TEMPLATE_RE.findall(template)
         missing = [c for c in cols if c not in p]
@@ -238,11 +187,6 @@ class OpenAIPrompt(_OpenAIBase):
                 lambda m: str(p[m.group(1)][i]) if m.group(1) in p else m.group(0),
                 template)
         return rows
-
-    def resolve_row_param(self, name, partition, n):
-        if name == "_prompt":
-            return [None] * n
-        return super().resolve_row_param(name, partition, n)
 
     def build_request(self, rp: dict) -> HTTPRequest | None:
         msgs = []
@@ -266,7 +210,12 @@ class OpenAIPrompt(_OpenAIBase):
             return parse_json_output(text)
         if mode == "regex":
             m = re.search(opts.get("regex", "(.*)"), text, re.DOTALL)
-            return m.group(int(opts.get("regexGroup", 1))) if m else None
+            if not m:
+                return None
+            try:
+                return m.group(int(opts.get("regexGroup", 1)))
+            except (IndexError, re.error):  # regexGroup beyond capture groups
+                return None
         if mode == "csv":
             delim = opts.get("delimiter", ",")
             return [s.strip() for s in text.strip().split(delim)]

@@ -44,7 +44,13 @@ class AzureSearchWriter(CognitiveServiceBase):
             doc["@search.action"] = (str(r[action_col]) if action_col else "upload")
             docs.append(doc)
         B = self.get("batch_size")
-        key = self.resolve_row_param("subscription_key", {}, 1)[0]
+        key = self.get("subscription_key")
+        if isinstance(key, tuple) and key[0] == "col":
+            raise ValueError("AzureSearchWriter: subscription_key must be a "
+                             "literal (the whole table writes with one key), "
+                             "not a column binding")
+        if isinstance(key, tuple) and key[0] == "lit":
+            key = key[1]
         headers = {"Content-Type": "application/json",
                    **({"api-key": key} if key else {})}
         requests = [HTTPRequest(url=self._endpoint(), method="POST", headers=headers,

@@ -19,20 +19,8 @@ class Translate(CognitiveServiceBase):
     output_col = Param("output_col", "translations column", default="translation")
     api_version = Param("api_version", "API version", default="3.0")
 
-    def service_param_names(self):
-        return super().service_param_names() + ["_text"]
-
-    def _row_params(self, p, n):
-        rows = CognitiveServiceBase._row_params(self, p, n)
-        texts = p[self.get("text_col")]
-        for i, r in enumerate(rows):
-            r["_text"] = texts[i]
-        return rows
-
-    def resolve_row_param(self, name, partition, n):
-        if name == "_text":
-            return [None] * n
-        return super().resolve_row_param(name, partition, n)
+    def input_bindings(self):
+        return {"_text": "text_col"}
 
     def build_request(self, rp: dict) -> HTTPRequest | None:
         if rp.get("_text") is None:
@@ -52,7 +40,3 @@ class Translate(CognitiveServiceBase):
             return [t["text"] for t in payload[0]["translations"]]
         except (KeyError, IndexError, TypeError):
             return payload
-
-    def _transform(self, df: DataFrame) -> DataFrame:
-        self.require_columns(df, self.get("text_col"))
-        return super()._transform(df)

@@ -1,0 +1,148 @@
+"""hf (causal LM generation + embedder) and cyber (AccessAnomaly, scalers)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.cyber import (
+    AccessAnomaly,
+    ComplementAccessTransformer,
+    IdIndexer,
+    PartitionedMinMaxScaler,
+    PartitionedStandardScaler,
+)
+from synapseml_tpu.hf import HuggingFaceCausalLM, HuggingFaceSentenceEmbedder
+
+
+# ---------------- hf ----------------
+
+def test_causal_lm_generates():
+    df = DataFrame.from_dict({"prompt": ["hello world", "the quick brown fox",
+                                         "a"]}, num_partitions=2)
+    lm = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=5,
+                             prompt_bucket=8, batch_size=2)
+    out = lm.transform(df)
+    gens = out.collect_column("completions")
+    assert len(gens) == 3
+    for g in gens:
+        assert len(np.asarray(g)) == 5  # token ids (hashing tokenizer, no decode)
+    # deterministic greedy decode
+    gens2 = lm.transform(df).collect_column("completions")
+    for a, b in zip(gens, gens2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_causal_lm_chat_mode():
+    msgs = np.empty(1, dtype=object)
+    msgs[0] = [{"role": "system", "content": "be brief"},
+               {"role": "user", "content": "hi"}]
+    df = DataFrame.from_dict({"messages": msgs})
+    lm = HuggingFaceCausalLM(model_name="llama-tiny", messages_col="messages",
+                             max_new_tokens=3, prompt_bucket=16, batch_size=1)
+    out = lm.transform(df).collect_column("completions")
+    assert len(np.asarray(out[0])) == 3
+
+
+def test_sentence_embedder():
+    df = DataFrame.from_dict({"text": ["alpha beta", "alpha beta", "zzz qqq xxx"]},
+                             num_partitions=2)
+    emb = HuggingFaceSentenceEmbedder(model_name="bert-tiny", batch_size=2,
+                                      max_token_len=16)
+    out = emb.transform(df)
+    E = np.stack(list(out.collect_column("embeddings")))
+    assert E.shape[0] == 3
+    np.testing.assert_allclose(np.linalg.norm(E, axis=1), 1.0, atol=1e-5)
+    # identical texts -> identical embeddings; different text -> different
+    np.testing.assert_allclose(E[0], E[1], atol=1e-6)
+    assert np.abs(E[0] - E[2]).max() > 1e-4
+    # cls pooling differs from mean pooling
+    emb_cls = HuggingFaceSentenceEmbedder(model_name="bert-tiny", pooling="cls",
+                                          batch_size=2, max_token_len=16)
+    E_cls = np.stack(list(emb_cls.transform(df).collect_column("embeddings")))
+    assert np.abs(E - E_cls).max() > 1e-4
+
+
+# ---------------- cyber ----------------
+
+def make_access_df(seed=0):
+    """Two tenants; in tenant A, users u0-u3 access r0-r3 heavily, u4 only r9."""
+    rs = np.random.default_rng(seed)
+    rows = {"tenant": [], "user": [], "res": []}
+    for _ in range(300):
+        u = f"u{rs.integers(0, 4)}"
+        r = f"r{rs.integers(0, 4)}"
+        rows["tenant"].append("A")
+        rows["user"].append(u)
+        rows["res"].append(r)
+    for _ in range(30):
+        rows["tenant"].append("A")
+        rows["user"].append("u4")
+        rows["res"].append("r9")
+    for _ in range(50):
+        rows["tenant"].append("B")
+        rows["user"].append(f"u{rs.integers(0, 3)}")
+        rows["res"].append(f"s{rs.integers(0, 3)}")
+    return DataFrame.from_dict({k: np.asarray(v, dtype=object)
+                                for k, v in rows.items()})
+
+
+def test_access_anomaly():
+    df = make_access_df()
+    model = AccessAnomaly(tenant_col="tenant", rank=4, max_iter=8).fit(df)
+    # normal access (u0 -> r0, heavily seen) vs cross-clique (u4 -> r0: never)
+    test = DataFrame.from_dict({
+        "tenant": np.asarray(["A", "A", "A"], dtype=object),
+        "user": np.asarray(["u0", "u4", "unknown_user"], dtype=object),
+        "res": np.asarray(["r0", "r0", "r0"], dtype=object)})
+    scores = model.transform(test).collect_column("anomaly_score")
+    assert scores[1] > scores[0] + 0.5   # unusual access scores higher
+    assert scores[2] == 2.0              # unseen entity
+    # unknown tenant -> nan
+    t2 = DataFrame.from_dict({"tenant": np.asarray(["Z"], dtype=object),
+                              "user": np.asarray(["u0"], dtype=object),
+                              "res": np.asarray(["r0"], dtype=object)})
+    assert np.isnan(model.transform(t2).collect_column("anomaly_score")[0])
+
+
+def test_complement_access():
+    df = make_access_df()
+    comp = ComplementAccessTransformer(tenant_col="tenant", factor=1, seed=0)
+    out = comp.transform(df)
+    assert out.count() > 0
+    seen = set(zip(df.collect_column("tenant"), df.collect_column("user"),
+                   df.collect_column("res")))
+    for row in out.collect_rows():
+        assert (row["tenant"], row["user"], row["res"]) not in seen
+
+
+def test_partitioned_scalers():
+    df = DataFrame.from_dict({
+        "tenant": np.asarray(["A"] * 50 + ["B"] * 50, dtype=object),
+        "value": np.concatenate([np.random.default_rng(0).normal(10, 2, 50),
+                                 np.random.default_rng(1).normal(-5, 0.5, 50)])})
+    out = (PartitionedStandardScaler(tenant_col="tenant", input_col="value")
+           .fit(df).transform(df))
+    scaled = out.collect_column("scaled")
+    tenants = out.collect_column("tenant")
+    for t in ("A", "B"):
+        vals = scaled[tenants == t]
+        assert abs(vals.mean()) < 1e-9
+        assert abs(vals.std() - 1.0) < 1e-9
+
+    mm = (PartitionedMinMaxScaler(tenant_col="tenant", input_col="value",
+                                  min_value=0.0, max_value=1.0).fit(df).transform(df))
+    mvals = mm.collect_column("scaled")
+    assert mvals.min() == pytest.approx(0.0) and mvals.max() == pytest.approx(1.0)
+
+
+def test_id_indexer():
+    df = DataFrame.from_dict({
+        "tenant": np.asarray(["A", "A", "B", "B"], dtype=object),
+        "user": np.asarray(["x", "y", "x", "z"], dtype=object)})
+    model = IdIndexer(tenant_col="tenant", input_col="user").fit(df)
+    ids = model.transform(df).collect_column("user_id")
+    assert ids[0] != ids[1]          # distinct users distinct ids
+    assert ids[0] == 0 and ids[2] == 0  # per-tenant reset
+    unseen = DataFrame.from_dict({"tenant": np.asarray(["A"], dtype=object),
+                                  "user": np.asarray(["nope"], dtype=object)})
+    assert model.transform(unseen).collect_column("user_id")[0] == -1

@@ -56,7 +56,16 @@ class MockServiceHandler(BaseHTTPRequestHandler):
         return json.loads(self.rfile.read(n).decode() or "null")
 
     def do_GET(self):
-        if self.path.startswith("/flaky/"):
+        if self.path.startswith("/flaky-date/"):
+            key = self.path.split("/")[-1]
+            MockServiceHandler.flaky_counts[key] = \
+                MockServiceHandler.flaky_counts.get(key, 0) + 1
+            if MockServiceHandler.flaky_counts[key] < 2:
+                self._reply({"err": "throttled"}, status=429,
+                            headers={"Retry-After": "Wed, 21 Oct 2026 07:28:00 GMT"})
+            else:
+                self._reply({"ok": True})
+        elif self.path.startswith("/flaky/"):
             key = self.path.split("/")[-1]
             MockServiceHandler.flaky_counts[key] = \
                 MockServiceHandler.flaky_counts.get(key, 0) + 1
@@ -328,12 +337,10 @@ def test_prompt_with_literal_braces(mock_server):
 
 
 def test_retry_after_http_date(mock_server):
-    # date-formatted Retry-After must fall back to the backoff schedule
-    import synapseml_tpu.io.http as H
-
-    class DateHandler(MockServiceHandler):
-        pass
-
-    # simulate via monkeypatched parse path: just check float() guard directly
-    resp = send_with_retries(HTTPRequest(url=f"{mock_server}/echo"))
+    # date-formatted Retry-After must fall back to the backoff schedule,
+    # not crash in float()
+    MockServiceHandler.flaky_counts.clear()
+    resp = send_with_retries(HTTPRequest(url=f"{mock_server}/flaky-date/x"),
+                             backoffs_ms=(5, 5))
     assert resp.status_code == 200
+    assert resp.json()["ok"] is True

@@ -144,10 +144,10 @@ def test_deep_text_layer_freezing():
                                   unfreeze_layers=unfreeze).fit(df)
 
     def layer0(m):
-        return np.asarray(m.get("params")["encoder"]["layer_0"]["attn"]["q"]["kernel"])
+        return np.asarray(m.get("model_params")["encoder"]["layer_0"]["attn"]["q"]["kernel"])
 
     def head(m):
-        return np.asarray(m.get("params")["classifier"]["kernel"])
+        return np.asarray(m.get("model_params")["classifier"]["kernel"])
 
     m_f1, m_f2 = fit(df_a, 1), fit(df_b, 1)
     # frozen layer_0 stays at (seed-deterministic) init: identical across runs
