@@ -1,0 +1,124 @@
+"""Codegen (reference ``core/.../codegen/Wrappable.scala`` + CodegenPlugin —
+SURVEY.md §1 L7).
+
+The reference reflects over Scala params to EMIT Python/R wrapper classes.
+This framework is Python-first, so codegen shrinks to what remains useful
+(SURVEY.md §7 step 9): reflection-driven artifacts FROM the param registry —
+markdown API reference per module and a machine-readable stage manifest
+(the piece wrapper generators and doc sites consume).
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import json
+import os
+import pkgutil
+
+from ..core.params import ComplexParam, Param, ServiceParam
+from ..core.pipeline import Estimator, Model, PipelineStage, Transformer
+
+__all__ = ["discover_stages", "stage_manifest", "generate_markdown_docs",
+           "write_docs"]
+
+_ABSTRACT = {"PipelineStage", "Transformer", "Estimator", "Model"}
+
+
+def discover_stages() -> dict[str, type]:
+    """Every PipelineStage subclass in the package (the Wrappable walk —
+    ref ``JarLoadingUtils`` reflection)."""
+    import synapseml_tpu
+
+    classes: dict[str, type] = {}
+    for modinfo in pkgutil.walk_packages(synapseml_tpu.__path__,
+                                         prefix="synapseml_tpu."):
+        mod = importlib.import_module(modinfo.name)
+        for name, obj in vars(mod).items():
+            if (inspect.isclass(obj) and issubclass(obj, PipelineStage)
+                    and obj.__module__.startswith("synapseml_tpu")
+                    and not name.startswith("_")
+                    and obj.__name__ not in _ABSTRACT):
+                classes[f"{obj.__module__}.{name}"] = obj
+    return classes
+
+
+def _param_kind(p: Param) -> str:
+    if isinstance(p, ServiceParam):
+        return "service (value or ('col', name))"
+    if isinstance(p, ComplexParam):
+        return "complex (non-JSON)"
+    return "simple"
+
+
+def _stage_kind(cls: type) -> str:
+    if issubclass(cls, Model):
+        return "Model"
+    if issubclass(cls, Estimator):
+        return "Estimator"
+    if issubclass(cls, Transformer):
+        return "Transformer"
+    return "Stage"
+
+
+def stage_manifest() -> list[dict]:
+    """Machine-readable stage descriptors (wrapper-generator input)."""
+    out = []
+    for full_name, cls in sorted(discover_stages().items()):
+        out.append({
+            "class": full_name,
+            "name": cls.__name__,
+            "module": cls.__module__,
+            "kind": _stage_kind(cls),
+            "feature": getattr(cls, "feature_name", None),
+            "doc": inspect.getdoc(cls) or "",
+            "params": [
+                {"name": name, "doc": p.doc, "default": repr(p.default),
+                 "kind": _param_kind(p)}
+                for name, p in sorted(cls.params().items())
+            ],
+        })
+    return out
+
+
+def generate_markdown_docs() -> dict[str, str]:
+    """module family -> markdown API reference."""
+    by_family: dict[str, list[dict]] = {}
+    for entry in stage_manifest():
+        family = entry["module"].split(".")[1]
+        by_family.setdefault(family, []).append(entry)
+    docs = {}
+    for family, entries in sorted(by_family.items()):
+        lines = [f"# `synapseml_tpu.{family}`", ""]
+        for e in entries:
+            lines.append(f"## {e['name']} ({e['kind']})")
+            lines.append("")
+            if e["doc"]:
+                lines.append(e["doc"])
+                lines.append("")
+            if e["params"]:
+                lines.append("| param | kind | default | doc |")
+                lines.append("|---|---|---|---|")
+                for p in e["params"]:
+                    doc = p["doc"].replace("|", "\\|")
+                    lines.append(f"| `{p['name']}` | {p['kind']} | "
+                                 f"`{p['default']}` | {doc} |")
+                lines.append("")
+        docs[family] = "\n".join(lines)
+    return docs
+
+
+def write_docs(output_dir: str) -> list[str]:
+    """Emit docs/api/*.md + stages.json; returns written paths."""
+    os.makedirs(output_dir, exist_ok=True)
+    written = []
+    for family, md in generate_markdown_docs().items():
+        path = os.path.join(output_dir, f"{family}.md")
+        with open(path, "w") as f:
+            f.write(md)
+        written.append(path)
+    manifest_path = os.path.join(output_dir, "stages.json")
+    with open(manifest_path, "w") as f:
+        json.dump(stage_manifest(), f, indent=2)
+    written.append(manifest_path)
+    return written

@@ -49,11 +49,26 @@ class TextFeaturizerModel(Model):
         toks = _ngrams(_tokenize(text, self.get("to_lower_case")), self.get("n_gram_length"))
         return [hash_feature(g, "", nbits) for g in toks]
 
+    def _docs_buckets(self, texts) -> list:
+        """Per-doc bucket id arrays; unigram path goes through the native C++
+        tokenizer+hasher when built (same tokens, same murmur, same mask)."""
+        if self.get("n_gram_length") <= 1:
+            from .. import native
+            from ..vw.hashing import namespace_seed
+
+            nbits = int(np.log2(self.get("num_features")))
+            buckets = native.docs_token_hashes(
+                [str(t) for t in texts], seed=namespace_seed(""),
+                num_bits=nbits, lower=self.get("to_lower_case"))
+            if buckets is not None:
+                return buckets
+        return [self._doc_buckets(t) for t in texts]
+
     def _tf(self, texts) -> np.ndarray:
         d = self.get("num_features")
         out = np.zeros((len(texts), d), np.float32)
-        for i, t in enumerate(texts):
-            for b in self._doc_buckets(t):
+        for i, buckets in enumerate(self._docs_buckets(texts)):
+            for b in buckets:
                 out[i, b] += 1.0
         if self.get("binary"):
             out = (out > 0).astype(np.float32)
@@ -99,8 +114,8 @@ class TextFeaturizer(Estimator):
             # streamed per-doc bucket sets: O(num_features) memory, never the
             # dense (n_docs x num_features) TF matrix
             docfreq = np.zeros(self.get("num_features"), np.float64)
-            for t in texts:
-                for b in set(model._doc_buckets(t)):
+            for buckets in model._docs_buckets(texts):
+                for b in set(np.asarray(buckets).tolist()):
                     docfreq[b] += 1.0
             n_docs = max(len(texts), 1)
             idf = np.log((n_docs + 1.0) / (docfreq + 1.0))  # SparkML IDF formula

@@ -1,0 +1,137 @@
+"""Native (C++) host runtime — the framework's L0 layer.
+
+The reference loads prebuilt C++ engines through ``NativeLoader.java``
+(SURVEY.md §1 L1). Here the native library is small (the device compute is
+XLA; the host hot loops are hashing/tokenization), builds from source with
+g++ on first use, binds via ctypes, and every entry point has a pure-Python
+fallback so the package works without a toolchain.
+
+Public surface:
+  * ``available()`` — did the library build/load?
+  * ``murmur3_batch(names, seed, num_bits)`` — vectorized VW feature hashing
+  * ``docs_token_hashes(texts, seed, num_bits, lower)`` — tokenize+hash whole
+    documents in one call (TextFeaturizer / VW text path)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["available", "murmur3_32_native", "murmur3_batch", "docs_token_hashes",
+           "library_path"]
+
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src",
+                    "native_ops.cpp")
+
+
+def library_path() -> str:
+    cache = os.environ.get("SYNAPSEML_TPU_NATIVE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "synapseml_tpu", "native")
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, "libnative_ops.so")
+
+
+def _build() -> str | None:
+    out = library_path()
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(_SRC):
+        return out
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", out],
+            check=True, capture_output=True, timeout=120)
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _load():
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.nat_murmur3_32.restype = ctypes.c_uint32
+        lib.nat_murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                       ctypes.c_uint32]
+        lib.nat_murmur3_batch.restype = None
+        lib.nat_murmur3_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]
+        lib.nat_docs_token_hashes.restype = None
+        lib.nat_docs_token_hashes.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def murmur3_32_native(data: bytes, seed: int = 0) -> int | None:
+    lib = _load()
+    if lib is None:
+        return None
+    return int(lib.nat_murmur3_32(data, len(data), seed & 0xFFFFFFFF))
+
+
+def _pack(strings: list[bytes]) -> tuple[bytes, np.ndarray]:
+    offsets = np.zeros(len(strings) + 1, np.int64)
+    np.cumsum([len(s) for s in strings], out=offsets[1:])
+    return b"".join(strings), offsets
+
+
+def murmur3_batch(names: list[str], seed: int = 0, num_bits: int = 32) -> np.ndarray | None:
+    """n feature names -> n masked hashes; None when the library is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    data, offsets = _pack([n.encode("utf-8") for n in names])
+    out = np.zeros(len(names), np.uint32)
+    mask = (1 << num_bits) - 1 if num_bits < 32 else 0xFFFFFFFF
+    lib.nat_murmur3_batch(
+        data, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(names), seed & 0xFFFFFFFF, mask,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    return out
+
+
+def docs_token_hashes(texts: list[str], seed: int = 0, num_bits: int = 18,
+                      lower: bool = True, max_tokens_per_doc: int = 4096):
+    """Tokenize+hash documents natively -> list of per-doc bucket arrays;
+    None when the library is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    data, offsets = _pack([t.encode("utf-8") for t in texts])
+    n = len(texts)
+    out = np.zeros(n * max_tokens_per_doc, np.uint32)
+    counts = np.zeros(n, np.int64)
+    mask = (1 << num_bits) - 1 if num_bits < 32 else 0xFFFFFFFF
+    lib.nat_docs_token_hashes(
+        data, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        seed & 0xFFFFFFFF, mask, 1 if lower else 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        max_tokens_per_doc,
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return [out[i * max_tokens_per_doc : i * max_tokens_per_doc + counts[i]].copy()
+            for i in range(n)]

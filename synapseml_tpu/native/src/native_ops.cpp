@@ -1,0 +1,123 @@
+// Native runtime ops for synapseml_tpu.
+//
+// The reference ships C++ engines for its hot host-side loops (LightGBM's
+// dataset marshaling, VW's parser+hasher — SURVEY.md §1 L0). The TPU compute
+// path is XLA; what stays on the host is feature hashing and tokenization,
+// implemented here and bound via ctypes (no pybind11 in this toolchain).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 native_ops.cpp -o libnative_ops.so
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t C1 = 0xcc9e2d51u;
+constexpr uint32_t C2 = 0x1b873593u;
+
+inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+uint32_t murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  uint32_t h = seed;
+  const int64_t nblocks = len / 4;
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k;
+    std::memcpy(&k, data + 4 * i, 4);  // little-endian hosts only (x86/ARM LE)
+    k *= C1;
+    k = rotl32(k, 15);
+    k *= C2;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5 + 0xe6546b64u;
+  }
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k = 0;
+  switch (len & 3) {
+    case 3: k ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k ^= static_cast<uint32_t>(tail[1]) << 8;  [[fallthrough]];
+    case 1:
+      k ^= tail[0];
+      k *= C1;
+      k = rotl32(k, 15);
+      k *= C2;
+      h ^= k;
+  }
+  h ^= static_cast<uint32_t>(len);
+  return fmix32(h);
+}
+
+inline bool is_token_char(uint8_t c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_';
+}
+
+inline uint8_t lower(uint8_t c) {
+  return (c >= 'A' && c <= 'Z') ? c + 32 : c;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Single hash (parity check with the Python implementation).
+uint32_t nat_murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  return murmur3_32(data, len, seed);
+}
+
+// Batch: n strings as concatenated bytes + (n+1) offsets -> n hashes.
+void nat_murmur3_batch(const uint8_t* data, const int64_t* offsets, int64_t n,
+                       uint32_t seed, uint32_t mask, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = murmur3_32(data + offsets[i], offsets[i + 1] - offsets[i], seed) & mask;
+  }
+}
+
+// Tokenize one document ([A-Za-z0-9_]+ runs, optional ASCII lowercase) and
+// hash every token: out gets up to max_tokens bucket ids; returns the count.
+// Matches hash_feature(token, namespace_seed=seed) & mask on the Python side.
+int64_t nat_doc_token_hashes(const uint8_t* text, int64_t len, uint32_t seed,
+                             uint32_t mask, int32_t do_lower, uint32_t* out,
+                             int64_t max_tokens) {
+  int64_t count = 0;
+  int64_t i = 0;
+  std::vector<uint8_t> buf(256);
+  while (i < len && count < max_tokens) {
+    while (i < len && !is_token_char(text[i])) i++;
+    if (i >= len) break;
+    int64_t tlen = 0;
+    while (i < len && is_token_char(text[i])) {
+      if (tlen >= static_cast<int64_t>(buf.size())) buf.resize(buf.size() * 2);
+      buf[tlen++] = do_lower ? lower(text[i]) : text[i];
+      i++;
+    }
+    out[count++] = murmur3_32(buf.data(), tlen, seed) & mask;
+  }
+  return count;
+}
+
+// Batch variant over documents (concatenated bytes + offsets). out is
+// [n_docs * max_tokens_per_doc]; counts receives per-doc token counts.
+void nat_docs_token_hashes(const uint8_t* data, const int64_t* offsets,
+                           int64_t n_docs, uint32_t seed, uint32_t mask,
+                           int32_t do_lower, uint32_t* out,
+                           int64_t max_tokens_per_doc, int64_t* counts) {
+  for (int64_t d = 0; d < n_docs; d++) {
+    counts[d] = nat_doc_token_hashes(
+        data + offsets[d], offsets[d + 1] - offsets[d], seed, mask, do_lower,
+        out + d * max_tokens_per_doc, max_tokens_per_doc);
+  }
+}
+
+}  // extern "C"

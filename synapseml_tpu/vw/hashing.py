@@ -66,3 +66,18 @@ def namespace_seed(namespace: str) -> int:
 @functools.lru_cache(maxsize=1 << 20)
 def hash_feature(name: str, namespace: str = "", num_bits: int = 18) -> int:
     return murmur3_32(name.encode("utf-8"), namespace_seed(namespace)) & ((1 << num_bits) - 1)
+
+
+def hash_features_batch(names, namespace: str = "", num_bits: int = 18):
+    """Vectorized feature hashing: the C++ batch kernel when built
+    (:mod:`synapseml_tpu.native`), else the cached Python path."""
+    from .. import native
+
+    out = native.murmur3_batch(list(names), seed=namespace_seed(namespace),
+                               num_bits=num_bits)
+    if out is not None:
+        return out
+    import numpy as np
+
+    return np.asarray([hash_feature(n, namespace, num_bits) for n in names],
+                      dtype=np.uint32)

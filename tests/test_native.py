@@ -1,0 +1,99 @@
+"""native C++ ops: build, parity with the pure-Python implementations, and
+the TextFeaturizer fast path."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import native
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.featurize import TextFeaturizer
+from synapseml_tpu.vw.hashing import hash_feature, hash_features_batch, murmur3_32, namespace_seed
+
+
+def test_native_builds():
+    # g++ is part of the environment contract: the library must build
+    assert native.available(), "native library failed to build/load"
+
+
+def test_murmur_parity():
+    cases = [b"", b"a", b"ab", b"abc", b"abcd", b"hello world",
+             "naïve café".encode("utf-8"), b"x" * 1000]
+    for data in cases:
+        for seed in (0, 42, 0xDEADBEEF):
+            assert native.murmur3_32_native(data, seed) == murmur3_32(data, seed), \
+                (data, seed)
+
+
+def test_murmur_batch_parity():
+    names = [f"feature_{i}" for i in range(100)] + ["", "a", "日本語"]
+    got = native.murmur3_batch(names, seed=namespace_seed("ns"), num_bits=18)
+    want = [hash_feature(n, "ns", 18) for n in names]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batch_api_with_and_without_native():
+    names = ["alpha", "beta", "gamma"]
+    out = hash_features_batch(names, "", 18)
+    np.testing.assert_array_equal(out, [hash_feature(n, "", 18) for n in names])
+
+
+def test_docs_token_hashes_parity():
+    texts = ["Hello World foo_bar", "  multiple   spaces\tand\nlines ",
+             "punct!u@a#tion, splits;tokens", "", "UPPER lower 123",
+             "tok" * 200]  # long token (> 256 bytes) exercises buffer growth
+    nbits = 12
+    got = native.docs_token_hashes(texts, seed=namespace_seed(""), num_bits=nbits,
+                                   lower=True)
+    assert got is not None
+    import re
+
+    for text, hashes in zip(texts, got):
+        toks = re.findall(r"[A-Za-z0-9_]+", text.lower())
+        want = [hash_feature(t, "", nbits) for t in toks]
+        np.testing.assert_array_equal(hashes, want), text
+
+
+def test_text_featurizer_native_matches_python(monkeypatch):
+    texts = ["the quick brown fox", "jumps over the lazy dog", "the the the"]
+    df = DataFrame.from_dict({"text": texts})
+    model = TextFeaturizer(num_features=256, use_idf=True).fit(df)
+    native_out = model.transform(df).collect_column("features")
+
+    # force the pure-Python path and compare
+    monkeypatch.setattr(native, "docs_token_hashes", lambda *a, **k: None)
+    model2 = TextFeaturizer(num_features=256, use_idf=True).fit(df)
+    python_out = model2.transform(df).collect_column("features")
+    np.testing.assert_allclose(np.asarray(native_out), np.asarray(python_out),
+                               atol=1e-6)
+
+
+def test_native_speedup_sanity():
+    import time
+
+    names = [f"col_{i}_value_{i % 97}" for i in range(20000)]
+    t0 = time.perf_counter()
+    native.murmur3_batch(names, 0, 18)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    [murmur3_32.__wrapped__(n.encode(), 0) for n in names[:2000]]  # uncached
+    t_python = (time.perf_counter() - t0) * 10  # scale to 20k
+    assert t_native < t_python, f"native {t_native:.4f}s vs python {t_python:.4f}s"
+
+
+def test_codegen_docs(tmp_path):
+    from synapseml_tpu.codegen import discover_stages, generate_markdown_docs, write_docs
+
+    stages = discover_stages()
+    assert len(stages) > 80
+    docs = generate_markdown_docs()
+    assert "gbdt" in docs and "LightGBMClassifier" in docs["gbdt"]
+    assert "| param |" in docs["gbdt"]
+    written = write_docs(str(tmp_path / "api"))
+    assert any(p.endswith("stages.json") for p in written)
+    import json
+
+    with open([p for p in written if p.endswith("stages.json")][0]) as f:
+        manifest = json.load(f)
+    entry = next(e for e in manifest if e["name"] == "ONNXModel")
+    assert entry["kind"] == "Transformer"
+    assert any(p["name"] == "model_payload" for p in entry["params"])
