@@ -53,19 +53,69 @@ def _roundtrip_latency(n_trials: int = 5) -> float:
     return float(np.median(ts))
 
 
-def run_bench():
-    import jax
+# bf16 peak TFLOPs per chip, by device_kind substring (for MFU)
+_CHIP_PEAK_TFLOPS = [
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0), ("v6", 918.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+]
 
-    # honor JAX_PLATFORMS even though the container's sitecustomize imported
-    # jax before this process could set env vars
+
+def _chip_peak_tflops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _CHIP_PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def _init_devices(max_tries: int = 5):
+    """Initialize a jax backend with retry/backoff; fall back to CPU.
+
+    The TPU tunnel is flaky (round-1 bench died on a single UNAVAILABLE at
+    backend init); a bench that can't survive that records nothing. Retries
+    clear any half-initialized backend, back off, and ultimately drop to the
+    CPU smoke path so the driver always gets a JSON line (rc=0).
+    """
+    import jax
+    import jax.extend.backend  # noqa: F401  (jax.extend is not auto-imported)
+
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    last_err = None
+    for attempt in range(max_tries):
+        try:
+            devs = jax.devices()
+            if devs:
+                return devs
+        except Exception as e:  # UNAVAILABLE / backend setup errors
+            last_err = e
+            try:
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            print(f"# backend init failed (try {attempt + 1}/{max_tries}): "
+                  f"{type(last_err).__name__}: {last_err}", flush=True)
+            if attempt + 1 < max_tries:
+                time.sleep(min(10.0 * (2 ** attempt), 120.0))
+    print("# backend unavailable after retries; falling back to CPU", flush=True)
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass
+    return jax.devices()
+
+
+def run_bench():
+    import jax
+
+    devices = _init_devices()
     from synapseml_tpu.models.flax_nets.bert import BertClassifier, bert_base, bert_tiny
     from synapseml_tpu.models.trainer import Trainer, TrainerConfig
     from synapseml_tpu.parallel.mesh import MeshConfig, create_mesh
 
-    platform = jax.devices()[0].platform
+    platform = devices[0].platform
     on_tpu = platform not in ("cpu",)
     if on_tpu:
         cfg = bert_base()          # 110M params, the reference DeepTextClassifier default
@@ -103,7 +153,7 @@ def run_bench():
     n_params = sum(int(np.prod(np.shape(x))) for x in jax.tree.leaves(state.params))
     tflops = 6 * n_params * B * T / step_s / 1e12
 
-    return {
+    result = {
         "metric": "DeepTextClassifier BERT-base fine-tune throughput"
                   if on_tpu else "DeepTextClassifier bert-tiny (CPU smoke)",
         "value": round(samples_per_sec_chip, 2),
@@ -115,16 +165,25 @@ def run_bench():
         "model_tflops_per_sec": round(tflops, 1),
         "final_loss": round(loss, 4),
     }
+    peak = _chip_peak_tflops(getattr(devices[0], "device_kind", "") or "")
+    if on_tpu and peak:
+        result["mfu"] = round(tflops / n_chips / peak, 4)
+    return result
 
 
 def main():
     result = run_bench()
-    baseline = None
+    recorded = {}
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:
             recorded = json.load(f)
-        baseline = recorded.get(result["metric"])
+    baseline = recorded.get(result["metric"])
     result["vs_baseline"] = round(result["value"] / baseline, 3) if baseline else 1.0
+    if baseline is None and result["platform"] != "cpu":
+        # seed the round-over-round baseline with the first real TPU number
+        recorded[result["metric"]] = result["value"]
+        with open(BASELINE_FILE, "w") as f:
+            json.dump(recorded, f, indent=1)
     print(json.dumps(result))
 
 
