@@ -20,10 +20,16 @@ __all__ = ["TextFeaturizer", "TextFeaturizerModel", "PageSplitter", "MultiNGram"
 
 _TOKEN_RE = re.compile(r"[A-Za-z0-9_]+")
 
+# ASCII-only lowercase so the Python path buckets identically to the native C++
+# tokenizer: str.lower() maps e.g. 'K' (Kelvin sign) -> 'k' and can synthesize
+# ASCII letters from non-ASCII input, which the C++ path treats as separators.
+_ASCII_LOWER = str.maketrans(
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZ", "abcdefghijklmnopqrstuvwxyz")
+
 
 def _tokenize(text: str, lower: bool) -> list[str]:
     s = str(text)
-    return _TOKEN_RE.findall(s.lower() if lower else s)
+    return _TOKEN_RE.findall(s.translate(_ASCII_LOWER) if lower else s)
 
 
 def _ngrams(tokens: list[str], n: int) -> list[str]:

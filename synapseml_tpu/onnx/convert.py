@@ -383,7 +383,14 @@ def _slice(ins, attrs):
         steps = [1] * len(starts)
     idx = [slice(None)] * x.ndim
     for s, e, a, st in zip(starts, ends, axes, steps):
-        idx[a] = slice(s, None if e >= np.iinfo(np.int32).max else e, st)
+        # normalize "to end" sentinels explicitly: exporters emit anything from
+        # INT32_MAX to INT64_MAX (positive step) / INT64_MIN (negative step)
+        dim = x.shape[a]
+        if st > 0:
+            e = None if e >= dim else e
+        else:
+            e = None if e <= -dim - 1 else e
+        idx[a] = slice(s, e, st)
     return x[tuple(idx)]
 
 
@@ -530,7 +537,11 @@ class ConvertedModel:
     def __call__(self, **inputs):
         g = self.model.graph
         env: dict[str, object] = {}
-        env.update({k: jnp.asarray(v) for k, v in self.weights.items()})
+        # int64 initializers (Slice ends, Reshape shapes, axes...) stay numpy:
+        # jnp.asarray under disabled-x64 wraps them to int32 (INT64_MAX -> -1),
+        # corrupting "to end" sentinels before the op ever sees them
+        env.update({k: v if v.dtype in (np.int64, np.uint64) else jnp.asarray(v)
+                    for k, v in self.weights.items()})
         for name in self.input_names:
             if name not in inputs:
                 raise KeyError(f"missing input {name!r}; expects {self.input_names}")

@@ -128,6 +128,12 @@ _DTYPE_TO_NP = {
     INT16: np.int16, INT32: np.int32, INT64: np.int64, BOOL: np.bool_,
     FLOAT16: np.float16, DOUBLE: np.float64, UINT32: np.uint32, UINT64: np.uint64,
 }
+try:  # bfloat16 is a numpy extension type shipped with jax
+    import ml_dtypes as _ml_dtypes
+
+    _DTYPE_TO_NP[BFLOAT16] = _ml_dtypes.bfloat16
+except ImportError:  # pragma: no cover
+    pass
 _NP_TO_DTYPE = {np.dtype(v): k for k, v in _DTYPE_TO_NP.items()}
 
 
@@ -205,7 +211,11 @@ def tensor_to_numpy(t: TensorProto) -> np.ndarray:
     elif t.int64_data:
         arr = np.asarray(t.int64_data, dtype=np_dtype)
     elif t.int32_data:
-        arr = np.asarray(t.int32_data, dtype=np_dtype)
+        if t.data_type in (FLOAT16, BFLOAT16):
+            # ONNX stores fp16/bf16 in int32_data as uint16 bit patterns
+            arr = np.asarray(t.int32_data, dtype=np.uint16).view(np_dtype)
+        else:
+            arr = np.asarray(t.int32_data, dtype=np_dtype)
     elif t.double_data:
         arr = np.asarray(t.double_data, dtype=np_dtype)
     else:

@@ -275,6 +275,12 @@ def flash_attention(q, k, v, kv_mask=None, causal: bool = False,
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    if causal and Tq != Tk:
+        # the kernel aligns q/kv positions at 0 with no offset; a causal mask
+        # with Tq != Tk would be silently misaligned (cf. reference_attention's
+        # q_offset/kv_offset)
+        raise ValueError(f"causal flash_attention requires Tq == Tk, got "
+                         f"Tq={Tq} Tk={Tk}")
     if kv_mask is None:
         kv_mask = jnp.ones((B, Tk), bool)
 

@@ -249,3 +249,46 @@ def test_headless_without_tensor_name_raises():
     feat = ImageFeaturizer(head_less=True).set(model_payload=data)
     with pytest.raises(ValueError, match="feature_tensor_name"):
         feat.transform(df)
+
+
+def test_float16_int32_data_decoded_as_bit_patterns():
+    # fp16 stored via int32_data holds uint16 bit patterns: 15360 == 1.0
+    one, half = 15360, 14336
+    t = P.TensorProto(dims=[2], data_type=P.FLOAT16, int32_data=[one, half])
+    np.testing.assert_array_equal(P.tensor_to_numpy(t),
+                                  np.array([1.0, 0.5], np.float16))
+
+
+def test_bfloat16_raw_and_int32_data():
+    import ml_dtypes
+
+    vals = np.array([1.0, -2.5, 0.125], ml_dtypes.bfloat16)
+    t = P.TensorProto(dims=[3], data_type=P.BFLOAT16, raw_data=vals.tobytes())
+    np.testing.assert_array_equal(P.tensor_to_numpy(t), vals)
+    bits = vals.view(np.uint16)
+    t2 = P.TensorProto(dims=[3], data_type=P.BFLOAT16,
+                       int32_data=[int(b) for b in bits])
+    np.testing.assert_array_equal(P.tensor_to_numpy(t2), vals)
+
+
+@pytest.mark.parametrize("end,step,expect", [
+    (np.iinfo(np.int64).max, 1, slice(1, None, 1)),       # INT64_MAX "to end"
+    (2**31 + 7, 1, slice(1, None, 1)),                    # between 2^31 and 2^63
+    (3, 1, slice(1, 3, 1)),                               # plain end preserved
+    (np.iinfo(np.int64).min, -1, slice(3, None, -1)),     # negative-step to-start
+])
+def test_slice_end_sentinels(end, step, expect):
+    x = np.arange(20, dtype=np.float32).reshape(4, 5)
+    start = 1 if step > 0 else 3
+    g = GraphProto(
+        name="s",
+        node=[node("Slice", ["x", "st", "en", "ax", "sp"], ["y"])],
+        initializer=[numpy_to_tensor(np.array([start], np.int64), "st"),
+                     numpy_to_tensor(np.array([end], np.int64), "en"),
+                     numpy_to_tensor(np.array([0], np.int64), "ax"),
+                     numpy_to_tensor(np.array([step], np.int64), "sp")],
+        input=[ValueInfoProto(name="x", elem_type=P.FLOAT, dims=[4, 5])],
+        output=[ValueInfoProto(name="y", elem_type=P.FLOAT, dims=["M", 5])],
+    )
+    fn = convert_graph(ModelProto(graph=g).encode())
+    np.testing.assert_array_equal(np.asarray(fn(x=x)["y"]), x[expect])
