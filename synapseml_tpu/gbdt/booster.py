@@ -295,67 +295,115 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         else:
             vmetric = metric
 
-    rng = np.random.default_rng(seed)
-    grown_f, grown_t, grown_v, grown_g = [], [], [], []
-    best_metric, best_iter, since_best = np.inf, None, 0
-    ub = mapper.upper_bound_values()
+    # ---- fused iteration step: grad/hess + K trees + score updates in ONE
+    # dispatch (the reference's LGBM_BoosterUpdateOneIter hot-loop role; the
+    # round-1 host loop dispatched ~(depth+3)*K programs per iteration and
+    # synced every tree's arrays to host). RNG (bagging/feature sampling)
+    # lives on-device too, so the whole run can optionally lax.scan.
+    key0 = jax.random.PRNGKey(seed)
+    k_feat = max(1, int(round(f * feature_fraction)))
+    do_bag = bagging_fraction < 1.0 and bagging_freq > 0
 
-    for it in range(num_iterations):
-        g, h = grad_hess(scores, yd)
-        g = g * wd[:, None]
-        h = h * wd[:, None]
-        presence = base_presence
-        if bagging_fraction < 1.0 and bagging_freq > 0 and it % bagging_freq == 0:
-            mask = (rng.random(n + pad) < bagging_fraction).astype(np.float32)
-            bag = _device_put_sharded(mask, mesh) * base_presence
-            g = g * bag[:, None]
-            h = h * bag[:, None]
-            presence = bag
-        if feature_fraction < 1.0:
-            fmask = np.zeros(f, bool)
-            k_feat = max(1, int(round(f * feature_fraction)))
-            fmask[rng.choice(f, k_feat, replace=False)] = True
+    def _masks(it):
+        if do_bag:
+            # LightGBM semantics: resample every bagging_freq iters, keep the
+            # bag in between
+            bkey = jax.random.fold_in(key0, 2 * (it - it % bagging_freq))
+            bag = (jax.random.uniform(bkey, (n + pad,)) <
+                   bagging_fraction).astype(jnp.float32)
         else:
-            fmask = np.ones(f, bool)
-        fmask_d = jnp.asarray(fmask)
+            bag = jnp.ones(n + pad, jnp.float32)
+        if feature_fraction < 1.0:
+            fkey = jax.random.fold_in(key0, 2 * it + 1)
+            ranks = jnp.argsort(jnp.argsort(jax.random.uniform(fkey, (f,))))
+            fmask = ranks < k_feat
+        else:
+            fmask = jnp.ones(f, bool)
+        return bag, fmask
 
-        it_f, it_t, it_v, it_g = [], [], [], []
-        for k in range(K):
-            tree = T.grow_tree(bins, g[:, k], h[:, k], presence, cfg, fmask_d)
+    def one_iteration(carry, it):
+        scores, vscores = carry
+        bag, fmask = _masks(it)
+        presence = base_presence * bag
+        g, h = grad_hess(scores, yd)
+        w_eff = (wd * presence)[:, None]  # pads/bagged-out rows: zero grad AND count
+        g = g * w_eff
+        h = h * w_eff
+
+        def per_class(sc_pair, gh_k):
+            scores, vscores = sc_pair
+            gk, hk, k_idx = gh_k
+            tree = T.grow_tree(bins, gk, hk, presence, cfg, fmask)
             delta = T.traverse_binned(bins, tree, max_depth)
-            scores = scores.at[:, k].add(delta)
+            scores = jax.lax.dynamic_update_index_in_dim(
+                scores, scores[:, k_idx] + delta, k_idx, axis=1)
             if has_valid:
-                vscores = vscores.at[:, k].add(T.traverse_binned(vbins, tree, max_depth))
-            feat_h = np.asarray(tree.feature)
-            thr_h = np.asarray(tree.threshold_bin)
-            thr_val = np.where(feat_h >= 0,
-                               ub[np.maximum(feat_h, 0), thr_h], 0.0).astype(np.float32)
-            it_f.append(feat_h)
-            it_t.append(thr_val)
-            it_v.append(np.asarray(tree.leaf_value))
-            it_g.append(np.asarray(tree.gain))
-        grown_f.append(np.stack(it_f))
-        grown_t.append(np.stack(it_t))
-        grown_v.append(np.stack(it_v))
-        grown_g.append(np.stack(it_g))
+                vd = T.traverse_binned(vbins, tree, max_depth)
+                vscores = jax.lax.dynamic_update_index_in_dim(
+                    vscores, vscores[:, k_idx] + vd, k_idx, axis=1)
+            return (scores, vscores), tree
 
-        if callbacks:
-            for cb in callbacks:
-                cb(iteration=it, scores=scores)
+        (scores, vscores), trees = jax.lax.scan(
+            per_class, (scores, vscores),
+            (jnp.swapaxes(g, 0, 1), jnp.swapaxes(h, 0, 1),
+             jnp.arange(K, dtype=jnp.int32)))
+        return (scores, vscores), trees
 
-        if has_valid and early_stopping_round > 0:
-            m = float(vmetric(vscores, vy))
-            if verbose:
-                print(f"[{it}] valid {o.metric_name}={m:.6f}")
-            if m < best_metric - 1e-12:
-                best_metric, best_iter, since_best = m, it + 1, 0
-            else:
-                since_best += 1
-                if since_best >= early_stopping_round:
-                    break
+    if not has_valid:
+        vscores = jnp.zeros((1, K), jnp.float32)  # placeholder carry leaf
+
+    best_metric, best_iter, since_best = np.inf, None, 0
+    use_full_scan = not (has_valid and early_stopping_round > 0) and not callbacks
+
+    if use_full_scan:
+        # no per-iteration host decision needed: the ENTIRE training run is
+        # one compiled program
+        @jax.jit
+        def run_all(scores, vscores):
+            return jax.lax.scan(one_iteration, (scores, vscores),
+                                jnp.arange(num_iterations, dtype=jnp.int32))
+
+        (scores, vscores), trees = run_all(scores, vscores)
+        feat_dev, thr_dev = trees.feature, trees.threshold_bin   # (T, K, M)
+        val_dev, gain_dev = trees.leaf_value, trees.gain
+    else:
+        iter_jit = jax.jit(one_iteration)
+        acc_f, acc_t, acc_v, acc_g = [], [], [], []
+        for it in range(num_iterations):
+            (scores, vscores), trees = iter_jit(
+                (scores, vscores), jnp.asarray(it, jnp.int32))
+            # device arrays accumulate WITHOUT host sync; fetched once at the end
+            acc_f.append(trees.feature)
+            acc_t.append(trees.threshold_bin)
+            acc_v.append(trees.leaf_value)
+            acc_g.append(trees.gain)
+            if callbacks:
+                for cb in callbacks:
+                    cb(iteration=it, scores=scores)
+            if has_valid and early_stopping_round > 0:
+                m = float(vmetric(vscores, vy))
+                if verbose:
+                    print(f"[{it}] valid {o.metric_name}={m:.6f}")
+                if m < best_metric - 1e-12:
+                    best_metric, best_iter, since_best = m, it + 1, 0
+                else:
+                    since_best += 1
+                    if since_best >= early_stopping_round:
+                        break
+        feat_dev = jnp.stack(acc_f)
+        thr_dev = jnp.stack(acc_t)
+        val_dev = jnp.stack(acc_v)
+        gain_dev = jnp.stack(acc_g)
+
+    # ONE host transfer for the whole forest; bin->value thresholds on host
+    ub = mapper.upper_bound_values()
+    feat_h = np.asarray(feat_dev)
+    thr_bin_h = np.asarray(thr_dev)
+    thr_val_h = np.where(feat_h >= 0,
+                         ub[np.maximum(feat_h, 0), thr_bin_h], 0.0).astype(np.float32)
 
     booster = TpuBooster(
-        np.stack(grown_f), np.stack(grown_t), np.stack(grown_v), np.stack(grown_g),
+        feat_h, thr_val_h, np.asarray(val_dev), np.asarray(gain_dev),
         max_depth=max_depth, num_model_out=K, objective=o.name, init_score=init,
         num_features=f, best_iteration=best_iter,
         params={"num_iterations": num_iterations, "learning_rate": learning_rate,

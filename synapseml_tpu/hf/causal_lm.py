@@ -33,8 +33,8 @@ def default_chat_template(messages) -> str:
 class HuggingFaceCausalLM(Transformer):
     feature_name = "hf"
 
-    model_name = Param("model_name", "architecture preset", default="llama-tiny",
-                       validator=lambda v: v in _ARCHS)
+    model_name = Param("model_name", "architecture preset or local HF checkpoint dir",
+                       default="llama-tiny")
     model_params = ComplexParam("model_params", "flax param pytree (None = random init)",
                                 default=None)
     tokenizer = ComplexParam("tokenizer", "tokenizer spec/object", default=None)
@@ -54,12 +54,18 @@ class HuggingFaceCausalLM(Transformer):
     # ---- lazy model/tokenizer ----
     def _model_and_params(self):
         if self.__dict__.get("_cache_model") is None:
-            from ..models.tokenizer import resolve_tokenizer
+            # pretrained-dir or preset (the reference's
+            # AutoModelForCausalLM.from_pretrained path,
+            # hf/HuggingFaceCausalLMTransform.py:103-331)
+            from ..models.convert_hf import pretrained_causal_lm, resolve_model_source
 
-            tok = resolve_tokenizer(self.get("tokenizer"))
-            cfg = _ARCHS[self.get("model_name")](vocab_size=tok.vocab_size)
-            model = LlamaLM(cfg, decode=True)  # KV-cache mode for generate
+            cfg, loaded, tok = resolve_model_source(
+                self.get("model_name"), _ARCHS, self.get("tokenizer"),
+                pretrained_causal_lm)
             params = self.get("model_params")
+            if params is None:
+                params = loaded
+            model = LlamaLM(cfg, decode=True)  # KV-cache mode for generate
             if params is None:
                 import jax
                 import jax.numpy as jnp

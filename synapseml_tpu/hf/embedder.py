@@ -41,8 +41,8 @@ class _BertEncoder:
 class HuggingFaceSentenceEmbedder(Transformer):
     feature_name = "hf"
 
-    model_name = Param("model_name", "encoder preset", default="bert-tiny",
-                       validator=lambda v: v in _ARCHS)
+    model_name = Param("model_name", "encoder preset or local HF checkpoint dir",
+                       default="bert-tiny")
     model_params = ComplexParam("model_params", "flax param pytree (None = random)",
                                 default=None)
     tokenizer = ComplexParam("tokenizer", "tokenizer spec/object", default=None)
@@ -62,13 +62,26 @@ class HuggingFaceSentenceEmbedder(Transformer):
             import jax
             import jax.numpy as jnp
 
-            from ..models.tokenizer import resolve_tokenizer
+            # pretrained-dir or preset (the reference's sentence-transformers
+            # load path, hf/HuggingFaceSentenceEmbedder.py:26-228)
+            import functools
 
-            tok = resolve_tokenizer(self.get("tokenizer"))
-            cfg = _ARCHS[self.get("model_name")](vocab_size=tok.vocab_size,
-                                                 dtype=jnp.float32)
-            enc = _BertEncoder(cfg)
+            from ..models.convert_hf import (
+                legacy_prenorm_fixup,
+                pretrained_encoder,
+                resolve_model_source,
+            )
+
+            cfg, loaded, tok = resolve_model_source(
+                self.get("model_name"), _ARCHS, self.get("tokenizer"),
+                functools.partial(pretrained_encoder, dtype=jnp.float32),
+                preset_kwargs={"dtype": jnp.float32})
             params = self.get("model_params")
+            if params is None:
+                params = loaded
+            elif loaded is None:
+                cfg = legacy_prenorm_fixup(cfg, params)
+            enc = _BertEncoder(cfg)
             if params is None:
                 params = enc.net.init(jax.random.PRNGKey(0),
                                       jnp.zeros((1, 8), jnp.int32),

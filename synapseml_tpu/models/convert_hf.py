@@ -1,0 +1,572 @@
+"""Pretrained-checkpoint ingestion: HF safetensors -> flax_nets param pytrees.
+
+The reference's DL estimators exist to fine-tune *pretrained* models
+(``dl/DeepTextClassifier.py:27-288`` loads ``AutoModelForSequenceClassification``,
+``dl/DeepVisionClassifier.py:31-268`` torchvision backbones,
+``hf/HuggingFaceCausalLMTransform.py:103-331`` ``AutoModelForCausalLM``,
+``hf/HuggingFaceSentenceEmbedder.py:26-228`` sentence-transformers). This
+module is the TPU-native equivalent of that loading path: explicit key maps +
+transpose rules from HF/torchvision ``state_dict`` layouts to our Flax modules,
+reading safetensors directly (no torch in the load path).
+
+Supported families: BERT (post-norm encoder), ViT-B/16-style, Llama (incl.
+GQA), ResNet (torchvision and HF ``microsoft/resnet-*`` naming).
+
+Conventions recap (torch Linear stores ``weight[out, in]``; Flax Dense kernels
+are ``[in, out]``):
+  * Dense:        kernel = W.T, bias = b
+  * QKV DenseGeneral: kernel = W.T.reshape(hidden, heads, head_dim)
+  * Out-proj DenseGeneral(axis=(-2,-1)): kernel = W.T.reshape(heads, hd, hidden)
+  * Conv2d:       kernel = W.transpose(2, 3, 1, 0)   (OIHW -> HWIO)
+  * Embedding:    used as-is
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "load_safetensors", "load_checkpoint",
+    "is_checkpoint_dir", "tokenizer_for_checkpoint",
+    "bert_config_from_hf", "bert_params_from_hf",
+    "vit_config_from_hf", "vit_params_from_hf",
+    "llama_config_from_hf", "llama_params_from_hf",
+    "resnet_variables_from_torch", "resnet_arch_from_hf_config",
+    "pretrained_text_classifier", "pretrained_encoder",
+    "pretrained_vision", "pretrained_causal_lm",
+]
+
+
+# ---------------------------------------------------------------------------
+# safetensors / checkpoint-dir reading
+# ---------------------------------------------------------------------------
+
+def load_safetensors(path: str) -> dict[str, np.ndarray]:
+    """Read one ``.safetensors`` file (or a sharded ``*.index.json``) into
+    a flat ``{key: np.ndarray}`` state dict."""
+    if path.endswith(".index.json"):
+        with open(path) as f:
+            index = json.load(f)
+        base = os.path.dirname(path)
+        out: dict[str, np.ndarray] = {}
+        for shard in sorted(set(index["weight_map"].values())):
+            out.update(load_safetensors(os.path.join(base, shard)))
+        return out
+    from safetensors.numpy import load_file
+
+    return dict(load_file(path))
+
+
+def load_checkpoint(ckpt_dir: str) -> tuple[dict, dict[str, np.ndarray]]:
+    """(config.json dict, state dict) from an HF-format checkpoint directory."""
+    cfg_path = os.path.join(ckpt_dir, "config.json")
+    config: dict = {}
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            config = json.load(f)
+    for name in ("model.safetensors", "model.safetensors.index.json"):
+        p = os.path.join(ckpt_dir, name)
+        if os.path.exists(p):
+            return config, load_safetensors(p)
+    raise FileNotFoundError(
+        f"no model.safetensors[.index.json] in {ckpt_dir!r} "
+        f"(found: {sorted(os.listdir(ckpt_dir)) if os.path.isdir(ckpt_dir) else 'missing dir'})")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-dir helpers shared by the four pretrained estimator paths
+# ---------------------------------------------------------------------------
+
+def is_checkpoint_dir(name) -> bool:
+    """True when ``name`` points at a local HF-format checkpoint directory."""
+    return isinstance(name, (str, os.PathLike)) and os.path.isdir(str(name))
+
+
+def resolve_model_source(name, presets: dict, tokenizer_spec, loader,
+                         preset_kwargs: dict | None = None):
+    """Shared checkpoint-dir-vs-preset dispatch for the pretrained transformer
+    paths (HuggingFaceCausalLM / HuggingFaceSentenceEmbedder).
+
+    -> (cfg, pretrained_params_or_None, tokenizer)."""
+    from .tokenizer import resolve_tokenizer
+
+    if name in presets:  # presets win over a same-named local directory
+        tok = resolve_tokenizer(tokenizer_spec)
+        cfg = presets[name](vocab_size=tok.vocab_size, **(preset_kwargs or {}))
+        return cfg, None, tok
+    if is_checkpoint_dir(name):
+        cfg, params = loader(str(name))
+        tok = tokenizer_for_checkpoint(tokenizer_spec, str(name), cfg.vocab_size)
+        return cfg, params, tok
+    raise ValueError(f"unknown model_name {name!r}; presets: {sorted(presets)} "
+                     f"or a local HF checkpoint dir")
+
+
+def legacy_prenorm_fixup(cfg, params):
+    """Saved artifacts from before the BERT post-norm change carry pre-norm
+    param layouts (an encoder-level final norm) with no arch_config; rebuild
+    the architecture they were trained as instead of silently mis-evaluating."""
+    import dataclasses
+
+    enc = params.get("encoder", {}) if isinstance(params, dict) else {}
+    if cfg.norm_position == "post" and ("LayerNorm_0" in enc or "RMSNorm_0" in enc):
+        return dataclasses.replace(cfg, norm_position="pre", norm_eps=1e-6,
+                                   act="gelu_tanh")
+    return cfg
+
+
+def tokenizer_for_checkpoint(spec, ckpt_dir: str, model_vocab: int):
+    """Resolve the tokenizer for a pretrained checkpoint.
+
+    ``spec`` wins when given; otherwise try the checkpoint dir's own tokenizer
+    files. Always guard the resolved vocab against the checkpoint's embedding
+    table — oversized ids would be silently clamped by XLA gather and produce
+    garbage, not an error."""
+    from .tokenizer import resolve_tokenizer
+
+    if spec is not None:
+        tok = resolve_tokenizer(spec)
+    else:
+        try:
+            tok = resolve_tokenizer(str(ckpt_dir))
+        except ValueError as e:
+            raise ValueError(
+                f"checkpoint dir {ckpt_dir!r} has no loadable tokenizer files; "
+                f"pass tokenizer= explicitly (e.g. HashingTokenizer("
+                f"vocab_size={model_vocab})) or an HF tokenizer name") from e
+    if tok.vocab_size > model_vocab:
+        raise ValueError(
+            f"tokenizer vocab ({tok.vocab_size}) exceeds the checkpoint's "
+            f"embedding table ({model_vocab}); ids would be silently clamped")
+    return tok
+
+
+# ---------------------------------------------------------------------------
+# shared small helpers
+# ---------------------------------------------------------------------------
+
+def _dense(sd, key: str) -> dict:
+    out = {"kernel": np.ascontiguousarray(sd[f"{key}.weight"].T)}
+    if f"{key}.bias" in sd:
+        out["bias"] = sd[f"{key}.bias"]
+    return out
+
+
+def _qkv(sd, key: str, heads: int, head_dim: int) -> dict:
+    w = sd[f"{key}.weight"]  # [heads*hd, hidden]
+    hidden = w.shape[1]
+    out = {"kernel": np.ascontiguousarray(w.T).reshape(hidden, heads, head_dim)}
+    out["bias"] = (sd[f"{key}.bias"].reshape(heads, head_dim)
+                   if f"{key}.bias" in sd
+                   else np.zeros((heads, head_dim), w.dtype))
+    return out
+
+
+def _oproj(sd, key: str, heads: int, head_dim: int) -> dict:
+    w = sd[f"{key}.weight"]  # [hidden, heads*hd]
+    hidden = w.shape[0]
+    out = {"kernel": np.ascontiguousarray(w.T).reshape(heads, head_dim, hidden)}
+    out["bias"] = sd[f"{key}.bias"] if f"{key}.bias" in sd else np.zeros((hidden,), w.dtype)
+    return out
+
+
+def _ln(sd, key: str) -> dict:
+    return {"scale": sd[f"{key}.weight"], "bias": sd[f"{key}.bias"]}
+
+
+def _conv(sd, key: str) -> dict:
+    out = {"kernel": np.ascontiguousarray(sd[f"{key}.weight"].transpose(2, 3, 1, 0))}
+    if f"{key}.bias" in sd:
+        out["bias"] = sd[f"{key}.bias"]
+    return out
+
+
+def _strip_prefix(sd: dict, *candidates: str) -> dict:
+    """Strip a known top-level prefix (e.g. 'bert.') if present. Non-prefixed
+    keys (heads like 'classifier.weight') are kept; a stripped key wins on
+    collision with a bare key of the same name."""
+    for pref in candidates:
+        if any(k.startswith(pref) for k in sd):
+            return {k: v for k, v in sd.items() if not k.startswith(pref)} | \
+                   {k[len(pref):]: v for k, v in sd.items() if k.startswith(pref)}
+    return sd
+
+
+def _zero_bias(shape, dtype=np.float32):
+    return np.zeros(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# BERT
+# ---------------------------------------------------------------------------
+
+def bert_config_from_hf(config: dict, **overrides) -> Any:
+    """HF bert config.json -> TransformerConfig (post-norm, exact gelu)."""
+    from .flax_nets.bert import BertConfig
+
+    kw = dict(
+        vocab_size=config.get("vocab_size", 30522),
+        hidden=config.get("hidden_size", 768),
+        n_layers=config.get("num_hidden_layers", 12),
+        n_heads=config.get("num_attention_heads", 12),
+        mlp_dim=config.get("intermediate_size", 3072),
+        max_len=config.get("max_position_embeddings", 512),
+        norm_eps=config.get("layer_norm_eps", 1e-12),
+    )
+    act = config.get("hidden_act", "gelu")
+    kw["act"] = {"gelu": "gelu", "gelu_new": "gelu_tanh",
+                 "gelu_pytorch_tanh": "gelu_tanh"}.get(act, act)
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+def bert_params_from_hf(sd: dict[str, np.ndarray], num_classes: int | None = None,
+                        seed: int = 0, n_heads: int | None = None) -> dict:
+    """HF BertModel / BertForSequenceClassification state dict ->
+    ``BertClassifier`` param pytree.
+
+    When the checkpoint has no classifier head (plain BertModel) and
+    ``num_classes`` is given, the head is seeded with small random values
+    (the transfer-learning init of ``LitDeepTextModel``)."""
+    body = _strip_prefix(sd, "bert.")
+    n_layers = 1 + max(int(k.split(".")[2]) for k in body if k.startswith("encoder.layer."))
+    hidden = body["embeddings.word_embeddings.weight"].shape[1]
+    if n_heads is None:  # standalone fallback; prefer the config.json value
+        n_heads = max(hidden // 64, 1)
+    head_dim = hidden // n_heads
+
+    params: dict[str, Any] = {
+        "embeddings": {
+            "word": {"embedding": body["embeddings.word_embeddings.weight"]},
+            "position": {"embedding": body["embeddings.position_embeddings.weight"]},
+            "segment": {"embedding": body["embeddings.token_type_embeddings.weight"]},
+            "LayerNorm_0": _ln(body, "embeddings.LayerNorm"),
+        },
+        "encoder": {},
+    }
+    for i in range(n_layers):
+        p = f"encoder.layer.{i}"
+        params["encoder"][f"layer_{i}"] = {
+            "attn": {
+                "q": _qkv(body, f"{p}.attention.self.query", n_heads, head_dim),
+                "k": _qkv(body, f"{p}.attention.self.key", n_heads, head_dim),
+                "v": _qkv(body, f"{p}.attention.self.value", n_heads, head_dim),
+                "o": _oproj(body, f"{p}.attention.output.dense", n_heads, head_dim),
+            },
+            "LayerNorm_0": _ln(body, f"{p}.attention.output.LayerNorm"),
+            "mlp": {
+                "up": _dense(body, f"{p}.intermediate.dense"),
+                "down": _dense(body, f"{p}.output.dense"),
+            },
+            "LayerNorm_1": _ln(body, f"{p}.output.LayerNorm"),
+        }
+    if "pooler.dense.weight" in body:
+        params["pooler"] = _dense(body, "pooler.dense")
+    if "classifier.weight" in sd:
+        params["classifier"] = _dense(sd, "classifier")
+    if num_classes is not None:
+        if "pooler" not in params:
+            rng = np.random.default_rng(seed)
+            params["pooler"] = {
+                "kernel": rng.normal(0, 0.02, (hidden, hidden)).astype(np.float32),
+                "bias": _zero_bias((hidden,))}
+        head = params.get("classifier")
+        if head is None or head["kernel"].shape[1] != num_classes:
+            rng = np.random.default_rng(seed + 1)
+            params["classifier"] = {
+                "kernel": rng.normal(0, 0.02, (hidden, num_classes)).astype(np.float32),
+                "bias": _zero_bias((num_classes,))}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# ViT
+# ---------------------------------------------------------------------------
+
+def vit_config_from_hf(config: dict, **overrides) -> Any:
+    from .flax_nets.vit import vit_b16
+
+    image, patch = config.get("image_size", 224), config.get("patch_size", 16)
+    kw = dict(
+        hidden=config.get("hidden_size", 768),
+        n_layers=config.get("num_hidden_layers", 12),
+        n_heads=config.get("num_attention_heads", 12),
+        mlp_dim=config.get("intermediate_size", 3072),
+        max_len=1 + (image // patch) ** 2,
+        norm_eps=config.get("layer_norm_eps", 1e-12),
+    )
+    act = config.get("hidden_act", "gelu")
+    kw["act"] = {"gelu": "gelu", "gelu_new": "gelu_tanh",
+                 "gelu_pytorch_tanh": "gelu_tanh"}.get(act, act)
+    kw.update(overrides)
+    return vit_b16(**kw)
+
+
+def vit_params_from_hf(sd: dict[str, np.ndarray], num_classes: int | None = None,
+                       seed: int = 0, n_heads: int | None = None) -> dict:
+    """HF ViTModel / ViTForImageClassification -> ``ViTClassifier`` params."""
+    body = _strip_prefix(sd, "vit.")
+    n_layers = 1 + max(int(k.split(".")[2]) for k in body if k.startswith("encoder.layer."))
+    hidden = body["embeddings.cls_token"].shape[-1]
+    if n_heads is None:
+        n_heads = max(hidden // 64, 1)
+    head_dim = hidden // n_heads
+
+    params: dict[str, Any] = {
+        "cls": body["embeddings.cls_token"],
+        "pos_embed": body["embeddings.position_embeddings"],
+        "patch_embed": _conv(body, "embeddings.patch_embeddings.projection"),
+        "encoder": {"LayerNorm_0": _ln(body, "layernorm")},  # final (pre-norm)
+    }
+    for i in range(n_layers):
+        p = f"encoder.layer.{i}"
+        params["encoder"][f"layer_{i}"] = {
+            "LayerNorm_0": _ln(body, f"{p}.layernorm_before"),
+            "attn": {
+                "q": _qkv(body, f"{p}.attention.attention.query", n_heads, head_dim),
+                "k": _qkv(body, f"{p}.attention.attention.key", n_heads, head_dim),
+                "v": _qkv(body, f"{p}.attention.attention.value", n_heads, head_dim),
+                "o": _oproj(body, f"{p}.attention.output.dense", n_heads, head_dim),
+            },
+            "LayerNorm_1": _ln(body, f"{p}.layernorm_after"),
+            "mlp": {
+                "up": _dense(body, f"{p}.intermediate.dense"),
+                "down": _dense(body, f"{p}.output.dense"),
+            },
+        }
+    if "classifier.weight" in sd:
+        params["head"] = _dense(sd, "classifier")
+    if num_classes is not None:
+        head = params.get("head")
+        if head is None or head["kernel"].shape[1] != num_classes:
+            rng = np.random.default_rng(seed)
+            params["head"] = {
+                "kernel": rng.normal(0, 0.02, (hidden, num_classes)).astype(np.float32),
+                "bias": _zero_bias((num_classes,))}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Llama
+# ---------------------------------------------------------------------------
+
+def llama_config_from_hf(config: dict, **overrides) -> Any:
+    from .flax_nets.llama import llama2_7b
+
+    kw = dict(
+        vocab_size=config.get("vocab_size", 32000),
+        hidden=config.get("hidden_size", 4096),
+        n_layers=config.get("num_hidden_layers", 32),
+        n_heads=config.get("num_attention_heads", 32),
+        n_kv_heads=config.get("num_key_value_heads",
+                              config.get("num_attention_heads", 32)),
+        mlp_dim=config.get("intermediate_size", 11008),
+        max_len=config.get("max_position_embeddings", 4096),
+        norm_eps=config.get("rms_norm_eps", 1e-5),
+        rope_theta=config.get("rope_theta", 10000.0),
+    )
+    kw.update(overrides)
+    return llama2_7b(**kw)
+
+
+def llama_params_from_hf(sd: dict[str, np.ndarray],
+                         n_heads: int | None = None) -> dict:
+    """HF LlamaForCausalLM (or bare LlamaModel) -> ``LlamaLM`` params.
+
+    Handles GQA (kv head count inferred from k_proj shape) and tied
+    embeddings (missing lm_head falls back to embed_tokens.T)."""
+    body = _strip_prefix(sd, "model.")
+    n_layers = 1 + max(int(k.split(".")[1]) for k in body if k.startswith("layers."))
+    embed = body["embed_tokens.weight"]
+    hidden = embed.shape[1]
+    q0 = body["layers.0.self_attn.q_proj.weight"]
+    k0 = body["layers.0.self_attn.k_proj.weight"]
+    if n_heads is None:  # standalone fallback; prefer the config.json value
+        n_heads = max(hidden // 64, 1)
+    head_dim = q0.shape[0] // n_heads
+    n_kv = k0.shape[0] // head_dim
+
+    decoder: dict[str, Any] = {}
+    for i in range(n_layers):
+        p = f"layers.{i}"
+        decoder[f"layer_{i}"] = {
+            "RMSNorm_0": {"scale": body[f"{p}.input_layernorm.weight"]},
+            "attn": {
+                "q": _qkv(body, f"{p}.self_attn.q_proj", n_heads, head_dim),
+                "k": _qkv(body, f"{p}.self_attn.k_proj", n_kv, head_dim),
+                "v": _qkv(body, f"{p}.self_attn.v_proj", n_kv, head_dim),
+                "o": _oproj(body, f"{p}.self_attn.o_proj", n_heads, head_dim),
+            },
+            "RMSNorm_1": {"scale": body[f"{p}.post_attention_layernorm.weight"]},
+            "mlp": {
+                "gate": _dense(body, f"{p}.mlp.gate_proj"),
+                "up": _dense(body, f"{p}.mlp.up_proj"),
+                "down": _dense(body, f"{p}.mlp.down_proj"),
+            },
+        }
+        for proj in ("gate", "up", "down"):
+            d = decoder[f"layer_{i}"]["mlp"][proj]
+            if "bias" not in d:
+                d["bias"] = _zero_bias((d["kernel"].shape[1],), d["kernel"].dtype)
+    decoder["RMSNorm_0"] = {"scale": body["norm.weight"]}
+
+    lm_head = (np.ascontiguousarray(sd["lm_head.weight"].T)
+               if "lm_head.weight" in sd else np.ascontiguousarray(embed.T))
+    return {"embed": {"embedding": embed}, "decoder": decoder,
+            "lm_head": {"kernel": lm_head}}
+
+
+# ---------------------------------------------------------------------------
+# ResNet
+# ---------------------------------------------------------------------------
+
+def _hf_resnet_to_torchvision_keys(sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Translate HF ``microsoft/resnet-*`` keys to torchvision naming.
+
+    HF layout (default ``downsample_in_bottleneck=False`` matches torchvision
+    v1.5 math — stride on the 3x3): ``resnet.embedder.embedder.convolution`` ->
+    conv1, ``resnet.encoder.stages.{s}.layers.{j}.layer.{k}.{convolution,
+    normalization}`` -> layer{s+1}.{j}.conv{k+1}/bn{k+1}, ``shortcut`` ->
+    downsample, ``classifier.1`` -> fc."""
+    out: dict[str, np.ndarray] = {}
+    for k, v in sd.items():
+        k = k.removeprefix("resnet.")
+        if k.startswith("embedder.embedder."):
+            rest = k.split("embedder.embedder.", 1)[1]
+            if rest.startswith("convolution."):
+                out["conv1." + rest.split(".", 1)[1]] = v
+            else:  # normalization.*
+                out["bn1." + rest.split(".", 1)[1]] = v
+        elif k.startswith("encoder.stages."):
+            parts = k.split(".")
+            s, j = int(parts[2]), int(parts[4])
+            rest = parts[5:]
+            if rest[0] == "layer":  # layer.{k}.convolution/normalization
+                kk = int(rest[1])
+                mod = "conv" if rest[2] == "convolution" else "bn"
+                out[f"layer{s + 1}.{j}.{mod}{kk + 1}.{'.'.join(rest[3:])}"] = v
+            elif rest[0] == "shortcut":
+                mod = "0" if rest[1] == "convolution" else "1"
+                out[f"layer{s + 1}.{j}.downsample.{mod}.{'.'.join(rest[2:])}"] = v
+        elif k.startswith("classifier."):
+            out["fc." + k.split(".", 2)[2]] = v
+        else:
+            out[k] = v
+    return out
+
+
+def resnet_variables_from_torch(sd: dict[str, np.ndarray]) -> dict:
+    """torchvision-style ResNet state dict -> ``{"params", "batch_stats"}``
+    variables for ``flax_nets.resnet.ResNet``. Accepts HF resnet naming too."""
+    if any(k.startswith(("resnet.", "embedder.", "encoder.stages.")) for k in sd):
+        sd = _hf_resnet_to_torchvision_keys(sd)
+
+    params: dict[str, Any] = {"stem": _conv(sd, "conv1"),
+                              "stem_bn": {"scale": sd["bn1.weight"], "bias": sd["bn1.bias"]}}
+    stats: dict[str, Any] = {"stem_bn": {"mean": sd["bn1.running_mean"],
+                                         "var": sd["bn1.running_var"]}}
+
+    stages = sorted({int(k[5]) for k in sd if k.startswith("layer")})
+    for s in stages:
+        blocks = sorted({int(k.split(".")[1]) for k in sd if k.startswith(f"layer{s}.")})
+        for j in blocks:
+            name = f"stage{s - 1}_block{j}"
+            base = f"layer{s}.{j}"
+            p: dict[str, Any] = {}
+            st: dict[str, Any] = {}
+            convs = sorted({k.split(".")[2] for k in sd
+                            if k.startswith(f"{base}.conv")})
+            for c in convs:
+                n = c[-1]
+                p[f"conv{n}"] = _conv(sd, f"{base}.conv{n}")
+                p[f"bn{n}"] = {"scale": sd[f"{base}.bn{n}.weight"],
+                               "bias": sd[f"{base}.bn{n}.bias"]}
+                st[f"bn{n}"] = {"mean": sd[f"{base}.bn{n}.running_mean"],
+                                "var": sd[f"{base}.bn{n}.running_var"]}
+            if f"{base}.downsample.0.weight" in sd:
+                p["proj"] = _conv(sd, f"{base}.downsample.0")
+                p["bn_proj"] = {"scale": sd[f"{base}.downsample.1.weight"],
+                                "bias": sd[f"{base}.downsample.1.bias"]}
+                st["bn_proj"] = {"mean": sd[f"{base}.downsample.1.running_mean"],
+                                 "var": sd[f"{base}.downsample.1.running_var"]}
+            params[name] = p
+            stats[name] = st
+    if "fc.weight" in sd:
+        params["head"] = _dense(sd, "fc")
+    return {"params": params, "batch_stats": stats}
+
+
+def resnet_arch_from_hf_config(config: dict) -> dict:
+    """HF resnet config.json -> ``ResNet(...)`` constructor kwargs."""
+    depths = config.get("depths", [3, 4, 6, 3])
+    layer_type = config.get("layer_type", "bottleneck")
+    return {"stage_sizes": tuple(depths),
+            "block": "bottleneck" if layer_type == "bottleneck" else "basic",
+            "width": config.get("embedding_size", 64)}
+
+
+# ---------------------------------------------------------------------------
+# high-level checkpoint-directory entry points
+# ---------------------------------------------------------------------------
+
+def pretrained_text_classifier(ckpt_dir: str, num_classes: int, seed: int = 0,
+                               **cfg_overrides):
+    """(TransformerConfig, params) for ``BertClassifier`` from a local HF dir."""
+    config, sd = load_checkpoint(ckpt_dir)
+    cfg = bert_config_from_hf(config, **cfg_overrides)
+    return cfg, bert_params_from_hf(sd, num_classes=num_classes, seed=seed,
+                                    n_heads=cfg.n_heads)
+
+
+def pretrained_encoder(ckpt_dir: str, **cfg_overrides):
+    """(TransformerConfig, params) for the headless BERT encoder
+    (HuggingFaceSentenceEmbedder backbone)."""
+    config, sd = load_checkpoint(ckpt_dir)
+    cfg = bert_config_from_hf(config, **cfg_overrides)
+    params = bert_params_from_hf(sd, n_heads=cfg.n_heads)
+    params.pop("pooler", None)
+    params.pop("classifier", None)
+    return cfg, params
+
+
+def pretrained_vision(ckpt_dir: str, num_classes: int | None = None, seed: int = 0,
+                      **cfg_overrides):
+    """(module-or-config info, variables) for vision checkpoints.
+
+    Returns ``("vit", cfg, {"params": ...})`` or
+    ``("resnet", arch_kwargs, {"params": ..., "batch_stats": ...})``."""
+    config, sd = load_checkpoint(ckpt_dir)
+    mt = config.get("model_type", "")
+    if mt == "vit" or any(k.startswith(("vit.", "embeddings.cls_token")) for k in sd):
+        cfg = vit_config_from_hf(config, **cfg_overrides)
+        info = {"cfg": cfg, "patch": config.get("patch_size", 16)}
+        return "vit", info, {"params": vit_params_from_hf(
+            sd, num_classes=num_classes, seed=seed, n_heads=cfg.n_heads)}
+    if mt == "resnet" or any("resnet" in k or k.startswith("layer1.") for k in sd):
+        arch = resnet_arch_from_hf_config(config)
+        variables = resnet_variables_from_torch(sd)
+        if num_classes is not None:
+            head = variables["params"].get("head")
+            if head is None or head["kernel"].shape[1] != num_classes:
+                if head is not None:
+                    feat = head["kernel"].shape[0]
+                else:  # final stage width: width * 2^(stages-1) * expansion
+                    expansion = 4 if arch["block"] == "bottleneck" else 1
+                    feat = arch["width"] * (2 ** (len(arch["stage_sizes"]) - 1)) * expansion
+                rng = np.random.default_rng(seed)
+                variables["params"]["head"] = {
+                    "kernel": rng.normal(0, 0.02, (feat, num_classes)).astype(np.float32),
+                    "bias": _zero_bias((num_classes,))}
+        return "resnet", arch, variables
+    raise ValueError(f"unrecognized vision checkpoint (model_type={mt!r})")
+
+
+def pretrained_causal_lm(ckpt_dir: str, **cfg_overrides):
+    """(TransformerConfig, params) for ``LlamaLM`` from a local HF dir."""
+    config, sd = load_checkpoint(ckpt_dir)
+    cfg = llama_config_from_hf(config, **cfg_overrides)
+    return cfg, llama_params_from_hf(sd, n_heads=cfg.n_heads)

@@ -17,7 +17,8 @@ __all__ = ["BertConfig", "BertClassifier", "bert_base", "bert_tiny"]
 
 def BertConfig(**kw) -> TransformerConfig:
     defaults = dict(vocab_size=30522, hidden=768, n_layers=12, n_heads=12,
-                    mlp_dim=3072, max_len=512, norm="layernorm", act="gelu")
+                    mlp_dim=3072, max_len=512, norm="layernorm", act="gelu",
+                    norm_position="post", norm_eps=1e-12)
     defaults.update(kw)
     return TransformerConfig(**defaults)
 
@@ -50,7 +51,8 @@ class BertEmbeddings(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         x = x + embed("segment", self.n_segments)(token_type_ids)
-        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype)(x)
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout, deterministic=not self.has_rng("dropout"))(x)
         return x

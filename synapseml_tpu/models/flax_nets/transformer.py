@@ -45,6 +45,10 @@ class TransformerConfig:
     use_rope: bool = False
     rope_theta: float = 10000.0
     norm: str = "layernorm"  # or "rmsnorm"
+    # 'pre' (norm before attn/mlp + final encoder norm — ViT/Llama) or 'post'
+    # (norm after each residual add, no final norm — original BERT). Post-norm
+    # is required for faithful ingestion of HF BERT checkpoints.
+    norm_position: str = "pre"
     gated_mlp: bool = False  # SwiGLU when True
     act: str = "gelu"
     remat: bool = False
@@ -52,7 +56,11 @@ class TransformerConfig:
     # attention backend: 'einsum' (XLA, always available), 'flash' (Pallas
     # blockwise kernel, ops.flash_attention), 'ring' (sequence-parallel ring
     # over `seq_axis`, ops.ring_attention — requires a live mesh whose
-    # seq axis size > 1; falls back to flash/einsum otherwise)
+    # seq axis size > 1; falls back to flash/einsum otherwise).
+    # 'einsum' is the measured-fastest default on v5e at T=128..4096
+    # (docs/BENCHMARKS.md) — XLA's fused attention beats the Pallas kernel;
+    # use 'flash' only when the O(T^2) score buffer doesn't fit, 'ring' for
+    # true long-context over the mesh.
     attn_impl: str = "einsum"
     seq_axis: str = "seq"
 
@@ -66,7 +74,10 @@ class TransformerConfig:
 
 
 def _act_fn(name: str) -> Callable:
-    return {"gelu": nn.gelu, "relu": nn.relu, "silu": nn.silu}[name]
+    # 'gelu' is the exact erf form (what HF BERT/ViT checkpoints were trained
+    # with); 'gelu_tanh' is the cheaper approximation
+    return {"gelu": lambda x: nn.gelu(x, approximate=False),
+            "gelu_tanh": nn.gelu, "relu": nn.relu, "silu": nn.silu}[name]
 
 
 class RMSNorm(nn.Module):
@@ -279,12 +290,19 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None, positions=None):
         cfg = self.cfg
-        h = _norm(cfg)(x)
-        h = Attention(cfg, decode=self.decode, name="attn")(h, mask, positions)
-        x = x + h
-        h = _norm(cfg)(x)
-        h = MlpBlock(cfg, name="mlp")(h)
-        x = x + h
+        if cfg.norm_position == "post":
+            # original-BERT residual structure: add then norm
+            h = Attention(cfg, decode=self.decode, name="attn")(x, mask, positions)
+            x = _norm(cfg)(x + h)
+            h = MlpBlock(cfg, name="mlp")(x)
+            x = _norm(cfg)(x + h)
+        else:
+            h = _norm(cfg)(x)
+            h = Attention(cfg, decode=self.decode, name="attn")(h, mask, positions)
+            x = x + h
+            h = _norm(cfg)(x)
+            h = MlpBlock(cfg, name="mlp")(h)
+            x = x + h
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
 
@@ -303,4 +321,6 @@ class Encoder(nn.Module):
             block_cls = nn.remat(Block, static_argnums=())
         for i in range(cfg.n_layers):
             x = block_cls(cfg, decode=self.decode, name=f"layer_{i}")(x, mask, positions)
+        if cfg.norm_position == "post":
+            return x  # post-norm blocks already end normalized
         return _norm(cfg)(x)

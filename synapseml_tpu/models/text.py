@@ -35,7 +35,10 @@ def _resolve_arch(name: str):
         return _ARCHS[name]
     except KeyError:
         raise ValueError(f"unknown checkpoint {name!r}; available presets: "
-                         f"{sorted(_ARCHS)}") from None
+                         f"{sorted(_ARCHS)} (or pass a local HF checkpoint "
+                         f"directory for pretrained weights)") from None
+
+
 
 
 class _TextParams:
@@ -70,6 +73,10 @@ class DeepTextClassifier(Estimator, _TextParams):
                        "(horovod backward_passes_per_step analog)", default=1,
                        converter=TypeConverters.to_int)
     seed = Param("seed", "init seed", default=0, converter=TypeConverters.to_int)
+    attn_impl = Param("attn_impl", "attention backend: einsum | flash | ring "
+                      "(None = architecture default; 'ring' needs a mesh with "
+                      "a seq axis > 1)", default=None,
+                      validator=lambda v: v in (None, "einsum", "flash", "ring"))
     tokenizer = ComplexParam("tokenizer", "tokenizer object/config/name", default=None)
     mesh_config = ComplexParam("mesh_config", "MeshConfig override", default=None)
     weight_decay = Param("weight_decay", "adamw weight decay", default=0.01,
@@ -93,8 +100,26 @@ class DeepTextClassifier(Estimator, _TextParams):
         return frozen
 
     def _fit(self, df: DataFrame) -> "DeepTextModel":
-        tok = resolve_tokenizer(self.get("tokenizer"))
-        cfg = self._make_config(tok.vocab_size)
+        from .convert_hf import is_checkpoint_dir, tokenizer_for_checkpoint
+
+        ck = self.get("checkpoint")
+        init_params = None
+        if is_checkpoint_dir(ck):
+            # local HF checkpoint directory: pretrained weights + its tokenizer
+            # (the reference's AutoModelForSequenceClassification.from_pretrained
+            # transfer-learning path, dl/DeepTextClassifier.py:27-288)
+            from .convert_hf import pretrained_text_classifier
+
+            cfg, init_params = pretrained_text_classifier(
+                ck, num_classes=self.get("num_classes"), seed=self.get("seed"))
+            tok = tokenizer_for_checkpoint(self.get("tokenizer"), ck, cfg.vocab_size)
+        else:
+            tok = resolve_tokenizer(self.get("tokenizer"))
+            cfg = self._make_config(tok.vocab_size)
+        if self.get("attn_impl"):
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, attn_impl=self.get("attn_impl"))
         mesh = create_mesh(self.get("mesh_config") or MeshConfig())
         module = BertClassifier(cfg, num_classes=self.get("num_classes"))
 
@@ -114,11 +139,15 @@ class DeepTextClassifier(Estimator, _TextParams):
         )
         trainer = Trainer(module, mesh, tcfg)
         state = fit_arrays(trainer, data, batch_size=bs, total_steps=total,
-                           seed=self.get("seed"))
+                           seed=self.get("seed"), init_params=init_params)
 
         host_params = jax.tree.map(np.asarray, state.params)
+        # always persist the arch: a preset's meaning may evolve (e.g. the
+        # pre->post-norm change) and a saved model must keep evaluating with
+        # the architecture it was trained as
         return DeepTextModel(
             model_params=host_params,
+            arch_config=cfg,
             tokenizer_config=tok.to_config(),
             checkpoint=self.get("checkpoint"),
             num_classes=self.get("num_classes"),
@@ -135,6 +164,8 @@ class DeepTextModel(Model, _TextParams):
     feature_name = "deep_learning"
 
     model_params = ComplexParam("model_params", "trained Flax parameter pytree")
+    arch_config = ComplexParam("arch_config", "TransformerConfig (pretrained-dir "
+                               "fits; None = resolve checkpoint preset)", default=None)
     tokenizer_config = ComplexParam("tokenizer_config", "tokenizer config dict")
     train_metrics = ComplexParam("train_metrics", "loss/throughput trace", default=None)
 
@@ -148,7 +179,12 @@ class DeepTextModel(Model, _TextParams):
     def _get_apply(self):
         if self._apply_fn is None:
             tok = resolve_tokenizer(self.get("tokenizer_config"))
-            cfg = _resolve_arch(self.get("checkpoint"))(vocab_size=tok.vocab_size)
+            cfg = self.get("arch_config")
+            if cfg is None:
+                from .convert_hf import legacy_prenorm_fixup
+
+                cfg = _resolve_arch(self.get("checkpoint"))(vocab_size=tok.vocab_size)
+                cfg = legacy_prenorm_fixup(cfg, self.get("model_params"))
             module = BertClassifier(cfg, num_classes=self.get("num_classes"))
 
             @jax.jit

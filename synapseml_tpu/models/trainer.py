@@ -59,6 +59,39 @@ class TrainerConfig:
     b2: float = 0.999
 
 
+def _graft_params(boxed, values):
+    """Replace the values inside a (possibly nn.Partitioned-boxed) init tree
+    with pretrained host arrays, keeping the partitioning metadata. Every
+    module param must exist in ``values`` with a matching shape."""
+    from flax.core import meta
+
+    flat_vals = {"/".join(str(getattr(k, "key", k)) for k in path): v
+                 for path, v in jax.tree_util.tree_flatten_with_path(values)[0]}
+    used = set()
+
+    def pick(path, x):
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        if key not in flat_vals:
+            raise KeyError(f"pretrained params missing {key!r}; has "
+                           f"{sorted(flat_vals)[:8]}...")
+        used.add(key)
+        v = np.asarray(flat_vals[key])
+        target = x.value if isinstance(x, meta.Partitioned) else x
+        if tuple(v.shape) != tuple(np.shape(target)):
+            raise ValueError(f"shape mismatch for {key!r}: checkpoint "
+                             f"{v.shape} vs module {np.shape(target)}")
+        v = v.astype(np.asarray(target).dtype)
+        return x.replace_boxed(v) if isinstance(x, meta.Partitioned) else v
+
+    out = jax.tree_util.tree_map_with_path(
+        pick, boxed, is_leaf=lambda x: isinstance(x, meta.Partitioned))
+    unused = set(flat_vals) - used
+    if unused:
+        raise ValueError(f"checkpoint keys not consumed by the module: "
+                         f"{sorted(unused)[:8]}... — key map out of sync")
+    return out
+
+
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                        mask: jax.Array | None = None) -> jax.Array:
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -137,15 +170,26 @@ class Trainer:
         return TrainState(params=params, opt_state=opt_state,
                           step=jnp.asarray(step, jnp.int32), batch_stats=batch_stats)
 
-    def init_state(self, example_batch: dict, rng: jax.Array | None = None) -> TrainState:
+    def init_state(self, example_batch: dict, rng: jax.Array | None = None,
+                   init_params=None, init_batch_stats=None) -> TrainState:
+        """Fresh state; ``init_params`` (host pytree, e.g. from
+        models.convert_hf) grafts pretrained values into the module's
+        Partitioned boxes so they inherit the logical shardings — the
+        transfer-learning entry the reference gets from HF/torchvision
+        ``from_pretrained``."""
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         inputs = self._model_inputs(example_batch)
         with self.mesh.mesh:
             variables = self.module.init(rng, **inputs)
-        params = self._unbox_with_sharding(variables["params"])
+        boxed = variables["params"]
+        if init_params is not None:
+            boxed = _graft_params(boxed, init_params)
+        params = self._unbox_with_sharding(boxed)
         batch_stats = None
         if self.has_batch_stats and "batch_stats" in variables:
-            batch_stats = self._unbox_with_sharding(variables["batch_stats"])
+            batch_stats = self._unbox_with_sharding(
+                _graft_params(variables["batch_stats"], init_batch_stats)
+                if init_batch_stats is not None else variables["batch_stats"])
         tx = _make_optimizer(self.cfg, params)
         self._tx = tx
         opt_state = tx.init(params)
@@ -272,7 +316,7 @@ def plan_fit(n: int, batch_size: int, epochs: int, max_steps: int) -> tuple[int,
 
 
 def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: int,
-               seed: int) -> "TrainState":
+               seed: int, init_params=None, init_batch_stats=None) -> "TrainState":
     """Shared estimator fit loop: shuffling epochs over host arrays with
     mesh-aligned padded batches (one place for batch alignment, so any
     (batch_size, n, #devices) combination shards — batches are padded to a
@@ -292,5 +336,7 @@ def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: 
                 yield {**b.data, "_valid": b.mask.astype(np.float32)}
 
     it = batch_iter()
-    state = trainer.init_state(next(it), jax.random.PRNGKey(seed))
+    state = trainer.init_state(next(it), jax.random.PRNGKey(seed),
+                               init_params=init_params,
+                               init_batch_stats=init_batch_stats)
     return trainer.fit(state, it, max_steps=total_steps)

@@ -24,7 +24,18 @@ from .trainer import Trainer, TrainerConfig, fit_arrays, plan_fit
 __all__ = ["DeepVisionClassifier", "DeepVisionModel"]
 
 
-def _build_module(backbone: str, num_classes: int):
+def _build_module(backbone: str, num_classes: int, arch_spec=None):
+    """(module, has_batch_stats). ``backbone`` is a preset name or a local HF
+    checkpoint dir (handled by the caller via ``arch_spec`` from
+    convert_hf.pretrained_vision)."""
+    if arch_spec is not None:
+        kind, info = arch_spec
+        if kind == "vit":
+            return ViTClassifier(info["cfg"], num_classes=num_classes,
+                                 patch=info["patch"]), False
+        from .flax_nets.resnet import ResNet
+
+        return ResNet(num_classes=num_classes, **info), True
     if backbone == "vit_b16":
         return ViTClassifier(vit_b16(), num_classes=num_classes, patch=16), False
     if backbone == "vit_tiny":
@@ -36,7 +47,8 @@ def _build_module(backbone: str, num_classes: int):
     if backbone == "resnet_tiny":
         return resnet_tiny(num_classes=num_classes), True
     raise ValueError(f"unknown backbone {backbone!r}; "
-                     "have vit_b16|vit_tiny|resnet50|resnet18|resnet_tiny")
+                     "have vit_b16|vit_tiny|resnet50|resnet18|resnet_tiny "
+                     "or a local HF checkpoint directory")
 
 
 class _VisionParams:
@@ -66,7 +78,23 @@ class DeepVisionClassifier(Estimator, _VisionParams):
     mesh_config = ComplexParam("mesh_config", "MeshConfig override", default=None)
 
     def _fit(self, df: DataFrame) -> "DeepVisionModel":
-        module, has_bn = _build_module(self.get("backbone"), self.get("num_classes"))
+        from .convert_hf import is_checkpoint_dir
+
+        arch_spec = None
+        init_params = init_stats = None
+        if is_checkpoint_dir(self.get("backbone")):
+            # local HF/torchvision-format checkpoint (the reference's
+            # torchvision-backbone transfer path, dl/DeepVisionClassifier.py)
+            from .convert_hf import pretrained_vision
+
+            kind, info, variables = pretrained_vision(
+                self.get("backbone"), num_classes=self.get("num_classes"),
+                seed=self.get("seed"))
+            arch_spec = (kind, info)
+            init_params = variables["params"]
+            init_stats = variables.get("batch_stats")
+        module, has_bn = _build_module(self.get("backbone"), self.get("num_classes"),
+                                       arch_spec)
         mesh = create_mesh(self.get("mesh_config") or MeshConfig())
 
         labels = df.collect_column(self.get("label_col")).astype(np.int32)
@@ -80,12 +108,14 @@ class DeepVisionClassifier(Estimator, _VisionParams):
                                         warmup_steps=max(total // 10, 1)),
                           has_batch_stats=has_bn)
         state = fit_arrays(trainer, {"x": images, "labels": labels},
-                           batch_size=bs, total_steps=total, seed=self.get("seed"))
+                           batch_size=bs, total_steps=total, seed=self.get("seed"),
+                           init_params=init_params, init_batch_stats=init_stats)
 
         return DeepVisionModel(
             model_params=jax.tree.map(np.asarray, state.params),
             batch_stats=(jax.tree.map(np.asarray, state.batch_stats)
                          if state.batch_stats is not None else None),
+            arch_spec=arch_spec,
             backbone=self.get("backbone"), num_classes=self.get("num_classes"),
             image_col=self.get("image_col"), prediction_col=self.get("prediction_col"),
             scores_col=self.get("scores_col"), batch_size=self.get("batch_size"),
@@ -98,6 +128,8 @@ class DeepVisionModel(Model, _VisionParams):
 
     model_params = ComplexParam("model_params", "trained parameter pytree")
     batch_stats = ComplexParam("batch_stats", "BN running stats", default=None)
+    arch_spec = ComplexParam("arch_spec", "(kind, info) for pretrained-dir fits",
+                             default=None)
     train_metrics = ComplexParam("train_metrics", "loss/throughput trace", default=None)
 
     def __init__(self, **kw):
@@ -109,7 +141,8 @@ class DeepVisionModel(Model, _VisionParams):
 
     def _get_apply(self):
         if self._apply_fn is None:
-            module, has_bn = _build_module(self.get("backbone"), self.get("num_classes"))
+            module, has_bn = _build_module(self.get("backbone"), self.get("num_classes"),
+                                           self.get("arch_spec"))
 
             @jax.jit
             def apply(variables, x):

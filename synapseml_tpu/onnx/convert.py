@@ -331,9 +331,23 @@ def _transpose(ins, attrs):
     return jnp.transpose(ins[0], perm)
 
 
+
+def _host_i64(ins) -> bool:
+    """True when every present input is a host numpy array of 64-bit ints —
+    the shape/index-constant chains (Concat/Cast/Squeeze/Unsqueeze of Slice
+    ends etc.). Computing those with numpy preserves INT64 sentinels that
+    jnp would wrap to int32 under disabled x64."""
+    present = [x for x in ins if x is not None]
+    return bool(present) and all(
+        isinstance(x, np.ndarray) and x.dtype in (np.int64, np.uint64)
+        for x in present)
+
 @op("Concat")
 def _concat(ins, attrs):
-    return jnp.concatenate([x for x in ins if x is not None], axis=attrs["axis"])
+    xs = [x for x in ins if x is not None]
+    if _host_i64(ins):
+        return np.concatenate(xs, axis=attrs["axis"])
+    return jnp.concatenate(xs, axis=attrs["axis"])
 
 
 @op("Split")
@@ -354,7 +368,8 @@ def _split(ins, attrs):
 def _squeeze(ins, attrs):
     axes = (tuple(int(a) for a in np.asarray(ins[1]))
             if len(ins) > 1 and ins[1] is not None else attrs.get("axes"))
-    return jnp.squeeze(ins[0], axis=tuple(axes) if axes else None)
+    xp = np if _host_i64(ins[:1]) else jnp
+    return xp.squeeze(ins[0], axis=tuple(axes) if axes else None)
 
 
 @op("Unsqueeze")
@@ -362,8 +377,9 @@ def _unsqueeze(ins, attrs):
     axes = (tuple(int(a) for a in np.asarray(ins[1]))
             if len(ins) > 1 and ins[1] is not None else tuple(attrs.get("axes")))
     x = ins[0]
+    xp = np if _host_i64(ins[:1]) else jnp
     for a in sorted(axes):
-        x = jnp.expand_dims(x, a)
+        x = xp.expand_dims(x, a)
     return x
 
 
@@ -429,6 +445,8 @@ def _cast(ins, attrs):
     np_dtype = {P.FLOAT: jnp.float32, P.INT64: jnp.int64, P.INT32: jnp.int32,
                 P.DOUBLE: jnp.float64, P.BOOL: jnp.bool_, P.FLOAT16: jnp.float16,
                 P.BFLOAT16: jnp.bfloat16, P.UINT8: jnp.uint8, P.INT8: jnp.int8}[to]
+    if isinstance(ins[0], np.ndarray) and to == P.INT64:
+        return ins[0].astype(np.int64)  # keep host int64 (sentinel-safe)
     return ins[0].astype(np_dtype)
 
 

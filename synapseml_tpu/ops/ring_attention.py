@@ -4,9 +4,17 @@ Net-new capability (SURVEY.md §5 "Long-context / sequence parallelism:
 absent" in the reference): sequences longer than one chip's HBM are sharded
 over the ``seq`` mesh axis; each device holds a Q/K/V shard, and K/V blocks
 rotate around the ring via ``jax.lax.ppermute`` (ICI neighbor exchange) while
-a running-softmax accumulates the local contribution — attention memory stays
-O(T/n per device) and the K/V transfer overlaps with block compute in XLA's
-pipeline.
+a running softmax accumulates the local contribution.
+
+Memory/compile properties (long-context hardening):
+  * the ring loop is ROLLED (``lax.fori_loop``) — compile size is independent
+    of the ring length;
+  * the inner block attention is CHUNKED (``lax.scan`` over K/V chunks with a
+    running max/denominator) — no ``[T_loc, T_loc]`` score materialization;
+    peak per-device live scores are ``[B, H, T_loc, chunk]``;
+  * backward is a CUSTOM VJP that saves only (out, lse) and recomputes
+    probabilities per ring step (flash-attention-style two-pass), with dK/dV
+    accumulators traveling around the ring back to their owner shard.
 
 ``ring_attention`` is the collective form, called INSIDE ``jax.shard_map``
 with per-device shards. ``ring_attention_sharded`` wraps full arrays for
@@ -24,8 +32,191 @@ import numpy as np
 _NEG_INF = -1e30
 
 
+def _pick_chunk(t_local: int, chunk: int) -> int:
+    """Largest divisor of t_local that is <= chunk (static shapes for scan)."""
+    c = min(chunk, t_local)
+    while t_local % c:
+        c -= 1
+    return max(c, 1)
+
+
+def _block_fwd(qf, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, m, l, acc,
+               chunk):
+    """Fold one K/V block into the running softmax, scanning over chunks.
+
+    qf: [B, T, H, D] f32; k_blk/v_blk: [B, T, H, D]; mask_blk: [B, T] bool;
+    kv_pos0: scalar global position of the block's first row.
+    m, l: [B, H, T]; acc: [B, H, T, D]. Returns updated (m, l, acc).
+    """
+    B, T, H, D = qf.shape
+    C = _pick_chunk(T, chunk)
+    n_chunks = T // C
+    scale = 1.0 / np.sqrt(D)
+
+    def body(carry, c_idx):
+        m, l, acc = carry
+        start = c_idx * C
+        ks = jax.lax.dynamic_slice_in_dim(k_blk, start, C, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(v_blk, start, C, axis=1)
+        ms = jax.lax.dynamic_slice_in_dim(mask_blk, start, C, axis=1)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, ks.astype(jnp.float32),
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(ms[:, None, None, :], scores, _NEG_INF)
+        if causal:
+            kv_pos = kv_pos0 + start + jnp.arange(C)
+            allowed = kv_pos[None, :] <= q_pos[:, None]        # [T, C]
+            scores = jnp.where(allowed[None, None], scores, _NEG_INF)
+        blk_max = jnp.max(scores, axis=-1)                     # [B, H, T]
+        new_m = jnp.maximum(m, blk_max)
+        alpha = jnp.exp(m - new_m)
+        # gated: fully-masked rows keep p == 0 (zero output, zero gradient)
+        p = jnp.where(scores <= _NEG_INF * 0.5, 0.0,
+                      jnp.exp(scores - new_m[..., None]))      # [B, H, T, C]
+        new_l = l * alpha + jnp.sum(p, axis=-1)
+        new_acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vs.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return (new_m, new_l, new_acc), None
+
+    (m, l, acc), _ = jax.lax.scan(body, (m, l, acc), jnp.arange(n_chunks))
+    return m, l, acc
+
+
+def _block_bwd(qf, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, lse, do,
+               delta, dq, dk_blk, dv_blk, chunk):
+    """Backward for one visiting K/V block: accumulate local dq and the
+    block's traveling dk/dv. All f32. lse: [B, H, T]; do: [B, H, T, D];
+    delta: [B, H, T] (sum(do * out)). Returns (dq, dk_blk, dv_blk)."""
+    B, T, H, D = qf.shape
+    C = _pick_chunk(T, chunk)
+    n_chunks = T // C
+    scale = 1.0 / np.sqrt(D)
+
+    def body(carry, c_idx):
+        dq, dk_blk, dv_blk = carry
+        start = c_idx * C
+        ks = jax.lax.dynamic_slice_in_dim(k_blk, start, C, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(v_blk, start, C, axis=1)
+        ms = jax.lax.dynamic_slice_in_dim(mask_blk, start, C, axis=1)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, ks,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(ms[:, None, None, :], scores, _NEG_INF)
+        if causal:
+            kv_pos = kv_pos0 + start + jnp.arange(C)
+            allowed = kv_pos[None, :] <= q_pos[:, None]
+            scores = jnp.where(allowed[None, None], scores, _NEG_INF)
+        p = jnp.where(scores <= _NEG_INF * 0.5, 0.0,
+                      jnp.exp(scores - lse[..., None]))        # [B, H, T, C]
+        dv_c = jnp.einsum("bhqk,bhqd->bkhd", p, do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bkhd->bhqk", do, vs,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None])                       # [B, H, T, C]
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, ks,
+                             preferred_element_type=jnp.float32) * scale
+        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf,
+                          preferred_element_type=jnp.float32) * scale
+        dk_blk = jax.lax.dynamic_update_slice_in_dim(
+            dk_blk, jax.lax.dynamic_slice_in_dim(dk_blk, start, C, 1) + dk_c,
+            start, axis=1)
+        dv_blk = jax.lax.dynamic_update_slice_in_dim(
+            dv_blk, jax.lax.dynamic_slice_in_dim(dv_blk, start, C, 1) + dv_c,
+            start, axis=1)
+        return (dq, dk_blk, dv_blk), None
+
+    (dq, dk_blk, dv_blk), _ = jax.lax.scan(body, (dq, dk_blk, dv_blk),
+                                           jnp.arange(n_chunks))
+    return dq, dk_blk, dv_blk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_core(q, k, v, kv_mask, axis_name, axis_size, causal, chunk):
+    out, _ = _ring_fwd_impl(q, k, v, kv_mask, axis_name, axis_size, causal, chunk)
+    return out
+
+
+def _ring_fwd_impl(q, k, v, kv_mask, axis_name, axis_size, causal, chunk):
+    B, T, H, D = q.shape
+    my = jax.lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+    q_pos = my * T + jnp.arange(T)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(s, carry):
+        k_cur, v_cur, mask_cur, m, l, acc = carry
+        origin = (my - s) % axis_size
+        m, l, acc = _block_fwd(qf, q_pos, k_cur, v_cur, mask_cur, origin * T,
+                               causal, m, l, acc, chunk)
+        # rotate K/V/mask to the next device; the final rotation restores the
+        # original residency and keeps the loop body uniform
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm)
+        return k_nxt, v_nxt, mask_nxt, m, l, acc
+
+    # derive accumulators from q so they carry the same shard_map
+    # varying-axes type as the loop outputs (check_vma)
+    zeros_bht = jnp.transpose(jnp.sum(qf, axis=-1) * 0.0, (0, 2, 1))
+    m0 = zeros_bht + _NEG_INF
+    l0 = zeros_bht
+    acc0 = jnp.transpose(qf * 0.0, (0, 2, 1, 3))
+    carry = (k, v, kv_mask, m0, l0, acc0)
+    carry = jax.lax.fori_loop(0, axis_size, step, carry)
+    _, _, _, m, l, acc = carry
+    out = acc / jnp.maximum(l, 1e-30)[..., None]               # [B, H, T, D]
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))                   # [B, H, T]
+    out_bthd = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    return out_bthd, (out, lse)
+
+
+def _ring_core_fwd(q, k, v, kv_mask, axis_name, axis_size, causal, chunk):
+    out_bthd, (out_f32, lse) = _ring_fwd_impl(q, k, v, kv_mask, axis_name,
+                                              axis_size, causal, chunk)
+    return out_bthd, (q, k, v, kv_mask, out_f32, lse)
+
+
+def _ring_core_bwd(axis_name, axis_size, causal, chunk, res, g):
+    q, k, v, kv_mask, out, lse = res
+    B, T, H, D = q.shape
+    my = jax.lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+    q_pos = my * T + jnp.arange(T)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    do = jnp.transpose(g.astype(jnp.float32), (0, 2, 1, 3))    # [B, H, T, D]
+    # re-apply the softmax-normalization jacobian piece: out = acc / l and
+    # d(acc/l) folds into ds via delta = sum(do * out)
+    delta = jnp.sum(do * out, axis=-1)                         # [B, H, T]
+
+    def step(s, carry):
+        k_cur, v_cur, mask_cur, dk_cur, dv_cur, dq = carry
+        origin = (my - s) % axis_size
+        dq, dk_cur, dv_cur = _block_bwd(
+            qf, q_pos, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            mask_cur, origin * T, causal, lse, do, delta, dq, dk_cur, dv_cur,
+            chunk)
+        # dk/dv travel WITH their block so every shard adds its contribution;
+        # after axis_size rotations they are back at the owner
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return k_nxt, v_nxt, mask_nxt, dk_nxt, dv_nxt, dq
+
+    dk0 = qf * 0.0
+    dv0 = qf * 0.0
+    dq0 = qf * 0.0
+    carry = (k, v, kv_mask, dk0, dv0, dq0)
+    _, _, _, dk, dv, dq = jax.lax.fori_loop(0, axis_size, step, carry)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None)
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
 def ring_attention(q, k, v, axis_name: str, axis_size: int, kv_mask=None,
-                   causal: bool = False):
+                   causal: bool = False, chunk: int = 512):
     """Blockwise ring attention over ``axis_name``; call inside ``shard_map``.
 
     Args:
@@ -35,59 +226,15 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int, kv_mask=None,
       axis_size: static size of that axis (ring length).
       kv_mask: optional ``[B, T_local]`` bool for the local K/V shard.
       causal: apply a global causal mask built from shard offsets.
+      chunk: inner K/V chunk size bounding live score memory to
+        ``[B, H, T_local, chunk]``.
 
-    Fully-masked query rows yield zeros. Accumulation is float32.
+    Fully-masked query rows yield zeros. Accumulation is float32;
+    differentiable via a recompute-per-ring-step custom VJP.
     """
-    B, T, H, D = q.shape
-    my = jax.lax.axis_index(axis_name)
-    scale = 1.0 / np.sqrt(D)
-    qf = q.astype(jnp.float32)
-
     if kv_mask is None:
-        kv_mask = jnp.ones((B, T), bool)
-
-    q_pos = my * T + jnp.arange(T)                      # [T] global positions
-
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-
-    def step(s, carry):
-        k_cur, v_cur, mask_cur, m, l, acc = carry
-        origin = (my - s) % axis_size                   # shard the block came from
-        kv_pos = origin * T + jnp.arange(T)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
-                            preferred_element_type=jnp.float32) * scale
-        scores = jnp.where(mask_cur[:, None, None, :], scores, _NEG_INF)
-        if causal:
-            allowed = kv_pos[None, :] <= q_pos[:, None]  # [T, T]
-            scores = jnp.where(allowed[None, None], scores, _NEG_INF)
-        blk_max = jnp.max(scores, axis=-1)              # [B, H, T]
-        new_m = jnp.maximum(m, blk_max)
-        alpha = jnp.exp(m - new_m)
-        # gated: fully-masked rows keep p == 0 (zero output, zero gradient)
-        p = jnp.where(scores <= _NEG_INF * 0.5, 0.0,
-                      jnp.exp(scores - new_m[..., None]))  # [B, H, Tq, Tk]
-        new_l = l * alpha + jnp.sum(p, axis=-1)
-        new_acc = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32)
-        # rotate K/V/mask to the next device; the final rotation restores the
-        # original residency (harmless) and keeps the loop body uniform
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm)
-        return k_nxt, v_nxt, mask_nxt, new_m, new_l, new_acc
-
-    # derive accumulators from q so they carry the same shard_map
-    # varying-axes type as the loop outputs (check_vma)
-    zeros_bht = jnp.transpose(jnp.sum(qf, axis=-1) * 0.0, (0, 2, 1))
-    m0 = zeros_bht + _NEG_INF
-    l0 = zeros_bht
-    acc0 = jnp.transpose(qf * 0.0, (0, 2, 1, 3))
-    carry = (k, v, kv_mask, m0, l0, acc0)
-    carry = jax.lax.fori_loop(0, axis_size, step, carry, unroll=True)
-    _, _, _, m, l, acc = carry
-    out = acc / jnp.maximum(l, 1e-30)[..., None]        # [B, H, T, D]
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+        kv_mask = jnp.ones(q.shape[:2], bool)
+    return _ring_core(q, k, v, kv_mask, axis_name, axis_size, causal, chunk)
 
 
 def _mesh_of(mesh_like):
@@ -100,7 +247,7 @@ def _mesh_of(mesh_like):
 
 def ring_attention_sharded(mesh_ctx, q, k, v, kv_mask=None, causal: bool = False,
                            seq_axis: str = "seq", batch_axes=("data", "fsdp"),
-                           head_axis: str | None = "tensor"):
+                           head_axis: str | None = "tensor", chunk: int = 512):
     """Full-array entry point: shard_map ``ring_attention`` over the mesh.
 
     q, k, v: ``[B, T, H, D]`` global arrays (T divisible by the seq-axis size).
@@ -121,7 +268,7 @@ def ring_attention_sharded(mesh_ctx, q, k, v, kv_mask=None, causal: bool = False
     qkv_spec = P(batch_axes or None, seq_axis, head, None)
     mask_spec = P(batch_axes or None, seq_axis)
     fn = functools.partial(ring_attention, axis_name=seq_axis, axis_size=n,
-                           causal=causal)
+                           causal=causal, chunk=chunk)
     mapped = jax.shard_map(
         lambda q_, k_, v_, m_: fn(q_, k_, v_, kv_mask=m_),
         mesh=mesh,

@@ -1,7 +1,9 @@
 """AI services as transformers (reference ``cognitive/`` module, SURVEY.md
 §2.6): CognitiveServicesBase composition over the HTTP fabric, the OpenAI
-family (chat/completion/embedding/prompt), text analytics, translation, and
-the Azure Search writer.
+family (chat/completion/embedding/prompt), text analytics, translation,
+form recognizer (+ ontology learner), computer vision, face, anomaly
+detection (simple + multivariate LRO), geospatial, speech, AI Foundry,
+LangChain, and the Azure Search writer.
 
 All engine-independent: each service builds authenticated per-row requests
 from ServiceParams (value-or-column) and parses JSON replies; transport is
@@ -19,6 +21,37 @@ from .openai import (
 from .text import AnalyzeText, EntityRecognizer, KeyPhraseExtractor, LanguageDetector, TextSentiment
 from .translate import Translate
 from .search import AzureSearchWriter
+from .form import (
+    AnalyzeBusinessCards,
+    AnalyzeDocument,
+    AnalyzeIDDocuments,
+    AnalyzeInvoices,
+    AnalyzeLayout,
+    AnalyzeReceipts,
+    FormOntologyLearner,
+    FormOntologyTransformer,
+)
+from .vision import (
+    OCR,
+    AnalyzeImage,
+    DescribeImage,
+    GenerateThumbnails,
+    ReadImage,
+    RecognizeDomainSpecificContent,
+    TagImage,
+)
+from .face import DetectFace, FindSimilarFace, GroupFaces, IdentifyFaces, VerifyFaces
+from .anomaly import (
+    DetectAnomalies,
+    DetectLastAnomaly,
+    DetectMultivariateAnomaly,
+    FitMultivariateAnomaly,
+    SimpleDetectAnomalies,
+)
+from .geospatial import AddressGeocoder, CheckPointInPolygon, ReverseAddressGeocoder
+from .speech import SpeechToText, TextToSpeech
+from .aifoundry import AIFoundryChatCompletion
+from .langchain import LangChainTransformer
 
 __all__ = [
     "CognitiveServiceBase", "HasAsyncReply",
@@ -26,4 +59,15 @@ __all__ = [
     "OpenAIPrompt", "OpenAIDefaults",
     "AnalyzeText", "TextSentiment", "KeyPhraseExtractor", "LanguageDetector",
     "EntityRecognizer", "Translate", "AzureSearchWriter",
+    "AnalyzeDocument", "AnalyzeLayout", "AnalyzeReceipts", "AnalyzeInvoices",
+    "AnalyzeBusinessCards", "AnalyzeIDDocuments", "FormOntologyLearner",
+    "FormOntologyTransformer",
+    "AnalyzeImage", "DescribeImage", "TagImage", "OCR", "ReadImage",
+    "GenerateThumbnails", "RecognizeDomainSpecificContent",
+    "DetectFace", "FindSimilarFace", "GroupFaces", "IdentifyFaces", "VerifyFaces",
+    "DetectLastAnomaly", "DetectAnomalies", "SimpleDetectAnomalies",
+    "FitMultivariateAnomaly", "DetectMultivariateAnomaly",
+    "AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon",
+    "SpeechToText", "TextToSpeech", "AIFoundryChatCompletion",
+    "LangChainTransformer",
 ]

@@ -1,0 +1,51 @@
+"""AI Foundry chat service.
+
+Reference: ``cognitive/.../services/aifoundry/AIFoundryChatCompletion.scala`` —
+chat completions against an AI Foundry (serverless / models-as-a-service)
+endpoint: flat ``/chat/completions`` route with a ``model`` body field and
+bearer auth, vs the Azure OpenAI deployment-path route.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..core.params import Param, ServiceParam
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase
+
+__all__ = ["AIFoundryChatCompletion"]
+
+
+class AIFoundryChatCompletion(CognitiveServiceBase):
+    messages_col = Param("messages_col", "chat messages column", default="messages")
+    output_col = Param("output_col", "reply column", default="chat_completions")
+    model = ServiceParam("model", "model name routed by the endpoint", default=None)
+    temperature = ServiceParam("temperature", "sampling temperature", default=None)
+    max_tokens = ServiceParam("max_tokens", "max generated tokens", default=None)
+    api_version = Param("api_version", "API version query param", default=None)
+
+    def input_bindings(self):
+        return {"_messages": "messages_col"}
+
+    def auth_headers(self, rp):
+        key = rp.get("subscription_key")
+        return {"Authorization": f"Bearer {key}"} if key else {}
+
+    def build_request(self, rp):
+        if rp.get("_messages") is None:
+            return None
+        body = {"messages": [dict(m) for m in rp["_messages"]]}
+        for field in ("model", "temperature", "max_tokens"):
+            if rp.get(field) is not None:
+                body[field] = rp[field]
+        url = f"{(self.get('url') or '').rstrip('/')}/chat/completions"
+        if self.get("api_version"):
+            url += f"?api-version={self.get('api_version')}"
+        return self.json_request(rp, url, body)
+
+    def parse_response(self, payload):
+        try:
+            return payload["choices"][0]["message"]["content"]
+        except (KeyError, IndexError, TypeError):
+            return payload

@@ -1,0 +1,260 @@
+"""Anomaly detector services.
+
+Reference: ``cognitive/.../services/anomaly/AnomalyDetection.scala``
+(DetectLastAnomaly / DetectAnomalies / SimpleDetectAnomalies over timestamped
+series) and ``MultivariateAnomalyDetection.scala:184-269`` (FitMultivariate-
+AnomalyDetector: an *Estimator* whose fit() runs an LRO training job and whose
+model polls inference jobs).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, ServiceParam, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..io.http import HTTPRequest, send_with_retries
+from .base import CognitiveServiceBase, HasAsyncReply
+
+__all__ = ["DetectLastAnomaly", "DetectAnomalies", "SimpleDetectAnomalies",
+           "FitMultivariateAnomaly", "DetectMultivariateAnomaly"]
+
+
+class _AnomalyBase(CognitiveServiceBase):
+    granularity = ServiceParam("granularity", "series granularity "
+                               "(yearly|monthly|weekly|daily|hourly|minutely)",
+                               default="daily")
+    max_anomaly_ratio = ServiceParam("max_anomaly_ratio", "expected anomaly "
+                                     "fraction", default=None)
+    sensitivity = ServiceParam("sensitivity", "detection sensitivity 0-99",
+                               default=None)
+
+    def _base(self) -> str:
+        return f"{(self.get('url') or '').rstrip('/')}/anomalydetector/v1.0"
+
+    def _series_body(self, rp: dict, series) -> dict:
+        body = {"series": list(series), "granularity": rp.get("granularity") or "daily"}
+        if rp.get("max_anomaly_ratio") is not None:
+            body["maxAnomalyRatio"] = float(rp["max_anomaly_ratio"])
+        if rp.get("sensitivity") is not None:
+            body["sensitivity"] = int(rp["sensitivity"])
+        return body
+
+
+class DetectLastAnomaly(_AnomalyBase):
+    """(ref ``DetectLastAnomaly``) — is the latest point of the series anomalous."""
+
+    series_col = Param("series_col", "column of [{timestamp, value}] lists",
+                       default="series")
+
+    def input_bindings(self):
+        return {"_series": "series_col"}
+
+    def build_request(self, rp):
+        if rp.get("_series") is None:
+            return None
+        return self.json_request(rp, f"{self._base()}/timeseries/last/detect",
+                                 self._series_body(rp, rp["_series"]))
+
+
+class DetectAnomalies(_AnomalyBase):
+    """(ref ``DetectAnomalies``) — whole-series batch detection."""
+
+    series_col = Param("series_col", "column of [{timestamp, value}] lists",
+                       default="series")
+
+    def input_bindings(self):
+        return {"_series": "series_col"}
+
+    def build_request(self, rp):
+        if rp.get("_series") is None:
+            return None
+        return self.json_request(rp, f"{self._base()}/timeseries/entire/detect",
+                                 self._series_body(rp, rp["_series"]))
+
+
+class SimpleDetectAnomalies(_AnomalyBase):
+    """(ref ``SimpleDetectAnomalies``) — long-format rows (group, timestamp,
+    value): groups are assembled into series, detected in one call per group,
+    and the per-point verdict is joined back onto the rows."""
+
+    group_col = Param("group_col", "series grouping column", default="group")
+    timestamp_col = Param("timestamp_col", "timestamp column", default="timestamp")
+    value_col = Param("value_col", "value column", default="value")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("group_col"), self.get("timestamp_col"),
+                             self.get("value_col"))
+        gcol, tcol, vcol = (self.get("group_col"), self.get("timestamp_col"),
+                            self.get("value_col"))
+        # assemble one series per group (driver-side; series are small)
+        groups: dict = {}
+        for p in df.partitions:
+            for g, t, v in zip(p[gcol], p[tcol], p[vcol]):
+                groups.setdefault(g, []).append({"timestamp": str(t),
+                                                 "value": float(v)})
+        rp0 = {}
+        for name in self.service_param_names():
+            v = self.get(name)
+            if isinstance(v, tuple) and len(v) == 2 and v[0] == "lit":
+                v = v[1]
+            elif isinstance(v, tuple) and len(v) == 2 and v[0] == "col":
+                raise ValueError(
+                    f"SimpleDetectAnomalies resolves {name!r} once per group; "
+                    f"column-bound values are not supported — pass a literal")
+            rp0[name] = v
+        results: dict = {}
+        for g, series in groups.items():
+            series = sorted(series, key=lambda d: d["timestamp"])
+            req = self.json_request(rp0, f"{self._base()}/timeseries/entire/detect",
+                                    self._series_body(rp0, series))
+            resp = send_with_retries(req, timeout_s=self.get("timeout_s"))
+            parsed, err = self.handle_response(resp)
+            results[g] = ({d["timestamp"]: i for i, d in enumerate(series)},
+                          parsed, err)
+
+        def per_part(p):
+            n = len(p[gcol])
+            out_v = np.empty(n, dtype=object)
+            out_e = np.empty(n, dtype=object)
+            for i in range(n):
+                index, parsed, err = results[p[gcol][i]]
+                out_e[i] = err
+                if err or not isinstance(parsed, dict):
+                    out_v[i] = None
+                    continue
+                j = index.get(str(p[tcol][i]))
+                flags = parsed.get("isAnomaly", [])
+                out_v[i] = bool(flags[j]) if j is not None and j < len(flags) else None
+            q = dict(p)
+            q[self.get("output_col")] = out_v
+            q[self.get("error_col")] = out_e
+            return q
+
+        return df.map_partitions(per_part)
+
+
+class FitMultivariateAnomaly(Estimator):
+    """(ref ``MultivariateAnomalyDetection.scala:184-269`` FitMultivariate-
+    AnomalyDetector) — POSTs a training job over a blob of aligned series,
+    polls the model until ready, and returns a DetectMultivariateAnomaly
+    carrying the trained model id."""
+
+    feature_name = "services"
+
+    subscription_key = ServiceParam("subscription_key", "API key")
+    url = Param("url", "service endpoint URL")
+    source = Param("source", "SAS URL (or path) of the training data blob")
+    start_time = Param("start_time", "training window start (ISO8601)")
+    end_time = Param("end_time", "training window end (ISO8601)")
+    sliding_window = Param("sliding_window", "model sliding window", default=300,
+                           converter=TypeConverters.to_int)
+    align_mode = Param("align_mode", "Inner | Outer", default="Outer")
+    fill_na_method = Param("fill_na_method", "Previous | Linear | Fixed | Zero",
+                           default="Linear")
+    polling_interval_s = Param("polling_interval_s", "poll sleep", default=0.25,
+                               converter=TypeConverters.to_float)
+    max_poll_attempts = Param("max_poll_attempts", "max polls", default=100,
+                              converter=TypeConverters.to_int)
+    timeout_s = Param("timeout_s", "request timeout", default=60.0,
+                      converter=TypeConverters.to_float)
+
+    def _headers(self) -> dict:
+        key = self.get("subscription_key")
+        if isinstance(key, tuple):
+            key = None
+        h = {"Content-Type": "application/json"}
+        if key:
+            h["Ocp-Apim-Subscription-Key"] = key
+        return h
+
+    def _fit(self, df: DataFrame) -> "DetectMultivariateAnomaly":
+        base = f"{(self.get('url') or '').rstrip('/')}/anomalydetector/v1.1-preview/multivariate"
+        body = {"source": self.get("source"),
+                "startTime": self.get("start_time"),
+                "endTime": self.get("end_time"),
+                "slidingWindow": self.get("sliding_window"),
+                "alignPolicy": {"alignMode": self.get("align_mode"),
+                                "fillNAMethod": self.get("fill_na_method")}}
+        resp = send_with_retries(
+            HTTPRequest(url=f"{base}/models", method="POST",
+                        headers=self._headers(), entity=json.dumps(body)),
+            timeout_s=self.get("timeout_s"))
+        if resp is None or resp.status_code not in (200, 201, 202):
+            raise RuntimeError(f"multivariate training submit failed: "
+                               f"{getattr(resp, 'status_code', None)} "
+                               f"{getattr(resp, 'error', '')}")
+        loc = (resp.headers.get("Location") or resp.headers.get("location") or "")
+        model_id = loc.rstrip("/").rsplit("/", 1)[-1] if loc else ""
+        if not model_id:
+            try:
+                model_id = resp.json().get("modelId", "")
+            except Exception:
+                model_id = ""
+        if not model_id:
+            raise RuntimeError(
+                f"training submit returned no model id (no Location header, "
+                f"no modelId in body): HTTP {resp.status_code}")
+        # poll model status until READY/FAILED
+        for _ in range(self.get("max_poll_attempts")):
+            time.sleep(self.get("polling_interval_s"))
+            st = send_with_retries(HTTPRequest(url=f"{base}/models/{model_id}",
+                                               headers=self._headers()),
+                                   timeout_s=self.get("timeout_s"))
+            if st is None:
+                continue
+            info = st.json()
+            status = str(info.get("modelInfo", {}).get("status", "")).upper()
+            if status == "READY":
+                return DetectMultivariateAnomaly(
+                    url=self.get("url"), subscription_key=self.get("subscription_key"),
+                    model_id=model_id)
+            if status == "FAILED":
+                raise RuntimeError(f"multivariate training failed: "
+                                   f"{info.get('modelInfo', {}).get('errors')}")
+        raise TimeoutError(f"multivariate model {model_id} not ready after "
+                           f"{self.get('max_poll_attempts')} polls")
+
+
+class DetectMultivariateAnomaly(Model, HasAsyncReply):
+    """Inference side: POST detect job for a window, poll the result."""
+
+    feature_name = "services"
+
+    model_id = Param("model_id", "trained model id")
+    source_col = Param("source_col", "column of data SAS URLs", default="source")
+    start_time_col = Param("start_time_col", "window start column", default="startTime")
+    end_time_col = Param("end_time_col", "window end column", default="endTime")
+
+    def input_bindings(self):
+        return {"_source": "source_col", "_start": "start_time_col",
+                "_end": "end_time_col"}
+
+    def build_request(self, rp):
+        if rp.get("_source") is None:
+            return None
+        base = (f"{(self.get('url') or '').rstrip('/')}/anomalydetector/"
+                f"v1.1-preview/multivariate/models/{self.get('model_id')}/detect")
+        body = {"source": str(rp["_source"]), "startTime": str(rp["_start"]),
+                "endTime": str(rp["_end"])}
+        return self.json_request(rp, base, body)
+
+    def poll_location(self, resp):
+        # this API family returns the result job URL in the plain Location
+        # header (cf. FitMultivariateAnomaly), not Operation-Location
+        return (super().poll_location(resp) or resp.headers.get("Location")
+                or resp.headers.get("location"))
+
+    def is_done(self, payload):
+        status = str(payload.get("summary", {}).get("status", "")).upper() \
+            if isinstance(payload, dict) else ""
+        return status in ("READY", "FAILED")
+
+    def parse_response(self, payload):
+        if isinstance(payload, dict) and "results" in payload:
+            return payload["results"]
+        return payload

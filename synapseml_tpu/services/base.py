@@ -74,6 +74,15 @@ class CognitiveServiceBase(Transformer):
                 r[key] = col[i]
         return rows
 
+    def json_request(self, row_params: dict, url: str, body: dict,
+                     method: str = "POST") -> HTTPRequest:
+        """Authenticated JSON request — the shared construction used by every
+        JSON-bodied service."""
+        headers = {"Content-Type": "application/json",
+                   **self.auth_headers(row_params)}
+        return HTTPRequest(url=url, method=method, headers=headers,
+                           entity=json.dumps(body))
+
     def handle_response(self, resp: HTTPResponse | None) -> tuple:
         """-> (parsed value, error or None)"""
         if resp is None:
@@ -122,12 +131,30 @@ class HasAsyncReply(CognitiveServiceBase):
     max_poll_attempts = Param("max_poll_attempts", "max polls per row", default=40,
                               converter=TypeConverters.to_int)
 
-    def poll_headers(self) -> dict:
-        return {}
+    _AUTH_HEADERS = ("Ocp-Apim-Subscription-Key", "api-key", "Authorization")
+
+    def poll_headers(self, request: HTTPRequest | None = None) -> dict:
+        """Auth for poll GETs: reuse the originating request's resolved auth
+        headers (covers column-bound per-row keys), else the literal key."""
+        if request is not None:
+            h = {k: v for k, v in request.headers.items()
+                 if k in self._AUTH_HEADERS}
+            if h:
+                return h
+        key = self.get("subscription_key")
+        if isinstance(key, tuple):
+            key = None
+        return {"Ocp-Apim-Subscription-Key": key} if key else {}
 
     def is_done(self, payload) -> bool:
         status = str(payload.get("status", "")).lower() if isinstance(payload, dict) else ""
         return status in ("succeeded", "failed", "partiallycompleted")
+
+    def poll_location(self, resp: HTTPResponse) -> str | None:
+        """Where to poll a pending operation (override for services that use
+        the plain Location header, e.g. multivariate anomaly)."""
+        return (resp.headers.get("Operation-Location")
+                or resp.headers.get("operation-location"))
 
     def post_process_responses(self, requests, responses, client):
         out = list(responses)
@@ -135,9 +162,8 @@ class HasAsyncReply(CognitiveServiceBase):
         # O(polls), not O(rows * polls)
         pending: dict[int, str] = {}
         for i, resp in enumerate(out):
-            if resp is not None and resp.status_code == 202:
-                loc = (resp.headers.get("Operation-Location")
-                       or resp.headers.get("operation-location"))
+            if resp is not None and resp.status_code in (201, 202):
+                loc = self.poll_location(resp)
                 if loc:
                     pending[i] = loc
         for _ in range(self.get("max_poll_attempts")):
@@ -145,9 +171,10 @@ class HasAsyncReply(CognitiveServiceBase):
                 break
             time.sleep(self.get("polling_interval_s"))
             idxs = list(pending)
-            polled = client.send_all([HTTPRequest(url=pending[i], method="GET",
-                                                  headers=self.poll_headers())
-                                      for i in idxs])
+            polled = client.send_all(
+                [HTTPRequest(url=pending[i], method="GET",
+                             headers=self.poll_headers(requests[i]))
+                 for i in idxs])
             for i, resp in zip(idxs, polled):
                 if resp is None or resp.status_code // 100 != 2:
                     out[i] = resp

@@ -1,0 +1,120 @@
+"""Face services.
+
+Reference: ``cognitive/.../services/face/Face.scala`` — DetectFace,
+FindSimilarFace, GroupFaces, IdentifyFaces, VerifyFaces over the v1.0 face API.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..core.params import Param, ServiceParam
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase
+
+__all__ = ["DetectFace", "FindSimilarFace", "GroupFaces", "IdentifyFaces",
+           "VerifyFaces"]
+
+
+class _FaceBase(CognitiveServiceBase):
+    def _base(self) -> str:
+        return f"{(self.get('url') or '').rstrip('/')}/face/v1.0"
+
+
+class DetectFace(_FaceBase):
+    """(ref ``DetectFace``)"""
+
+    image_url_col = Param("image_url_col", "column of image URLs", default="url")
+    return_face_id = ServiceParam("return_face_id", "include faceId", default=True)
+    return_face_landmarks = ServiceParam("return_face_landmarks",
+                                         "include landmarks", default=False)
+    return_face_attributes = ServiceParam(
+        "return_face_attributes", "comma-joined attributes (age, gender, "
+        "headPose, smile, glasses, emotion, ...)", default=None)
+
+    def input_bindings(self):
+        return {"_url": "image_url_col"}
+
+    def build_request(self, rp):
+        if rp.get("_url") is None:
+            return None
+        q = [f"returnFaceId={str(bool(rp.get('return_face_id'))).lower()}",
+             f"returnFaceLandmarks={str(bool(rp.get('return_face_landmarks'))).lower()}"]
+        if rp.get("return_face_attributes"):
+            q.append(f"returnFaceAttributes={rp['return_face_attributes']}")
+        return self.json_request(rp, f"{self._base()}/detect?{'&'.join(q)}",
+                                  {"url": str(rp["_url"])})
+
+
+class FindSimilarFace(_FaceBase):
+    """(ref ``FindSimilar``)"""
+
+    face_id_col = Param("face_id_col", "query faceId column", default="faceId")
+    face_ids = ServiceParam("face_ids", "candidate faceId list (or column)")
+    max_candidates = ServiceParam("max_candidates", "max results", default=20)
+
+    def input_bindings(self):
+        return {"_face_id": "face_id_col"}
+
+    def build_request(self, rp):
+        if rp.get("_face_id") is None:
+            return None
+        body = {"faceId": str(rp["_face_id"]),
+                "faceIds": list(rp.get("face_ids") or []),
+                "maxNumOfCandidatesReturned": rp.get("max_candidates") or 20}
+        return self.json_request(rp, f"{self._base()}/findsimilars", body)
+
+
+class GroupFaces(_FaceBase):
+    """(ref ``GroupFaces``)"""
+
+    face_ids_col = Param("face_ids_col", "column of faceId lists", default="faceIds")
+
+    def input_bindings(self):
+        return {"_face_ids": "face_ids_col"}
+
+    def build_request(self, rp):
+        if rp.get("_face_ids") is None:
+            return None
+        return self.json_request(rp, f"{self._base()}/group",
+                                  {"faceIds": list(rp["_face_ids"])})
+
+
+class IdentifyFaces(_FaceBase):
+    """(ref ``IdentifyFaces``)"""
+
+    face_ids_col = Param("face_ids_col", "column of faceId lists", default="faceIds")
+    person_group_id = ServiceParam("person_group_id", "person group to search")
+    max_candidates = ServiceParam("max_candidates", "candidates per face", default=1)
+    confidence_threshold = ServiceParam("confidence_threshold",
+                                        "identification threshold", default=None)
+
+    def input_bindings(self):
+        return {"_face_ids": "face_ids_col"}
+
+    def build_request(self, rp):
+        if rp.get("_face_ids") is None:
+            return None
+        body = {"faceIds": list(rp["_face_ids"]),
+                "personGroupId": rp.get("person_group_id"),
+                "maxNumOfCandidatesReturned": rp.get("max_candidates") or 1}
+        if rp.get("confidence_threshold") is not None:
+            body["confidenceThreshold"] = float(rp["confidence_threshold"])
+        return self.json_request(rp, f"{self._base()}/identify", body)
+
+
+class VerifyFaces(_FaceBase):
+    """(ref ``VerifyFaces``) — same-person check for two face ids."""
+
+    face_id1_col = Param("face_id1_col", "first faceId column", default="faceId1")
+    face_id2_col = Param("face_id2_col", "second faceId column", default="faceId2")
+
+    def input_bindings(self):
+        return {"_id1": "face_id1_col", "_id2": "face_id2_col"}
+
+    def build_request(self, rp):
+        if rp.get("_id1") is None or rp.get("_id2") is None:
+            return None
+        return self.json_request(rp, f"{self._base()}/verify",
+                                  {"faceId1": str(rp["_id1"]),
+                                   "faceId2": str(rp["_id2"])})

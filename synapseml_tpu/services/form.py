@@ -1,0 +1,201 @@
+"""Form recognizer / document intelligence services.
+
+Reference: ``cognitive/.../services/form/FormRecognizer.scala`` (AnalyzeDocument
+family — LRO transformers posting a document URL or bytes and polling the
+result) and ``FormOntologyLearner.scala`` (an Estimator that unions the
+per-document field schemas of analyzed forms into one ontology).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import Param, ServiceParam, TypeConverters
+from ..core.pipeline import Estimator, Model
+from ..io.http import HTTPRequest
+from .base import HasAsyncReply
+
+__all__ = ["AnalyzeDocument", "AnalyzeLayout", "AnalyzeReceipts",
+           "AnalyzeInvoices", "AnalyzeBusinessCards", "AnalyzeIDDocuments",
+           "FormOntologyLearner", "FormOntologyTransformer"]
+
+
+class AnalyzeDocument(HasAsyncReply):
+    """(ref ``FormRecognizer.scala`` AnalyzeDocument) — POST a document (URL
+    column or bytes column) to a prebuilt/custom model; 202 + poll."""
+
+    model_id = Param("model_id", "prebuilt-* or custom model id",
+                     default="prebuilt-document")
+    image_url_col = Param("image_url_col", "column of document URLs (exclusive "
+                          "with image_bytes_col)", default=None)
+    image_bytes_col = Param("image_bytes_col", "column of raw document bytes",
+                            default=None)
+    api_version = Param("api_version", "API version", default="2023-07-31")
+    pages = ServiceParam("pages", "page range, e.g. '1-3'", default=None)
+    locale = ServiceParam("locale", "document locale hint", default=None)
+
+    def input_bindings(self):
+        out = {}
+        if self.get("image_url_col"):
+            out["_url"] = "image_url_col"
+        if self.get("image_bytes_col"):
+            out["_bytes"] = "image_bytes_col"
+        if not out:
+            raise ValueError(f"{type(self).__name__} needs image_url_col or "
+                             f"image_bytes_col")
+        return out
+
+    def _endpoint(self) -> str:
+        query = f"api-version={self.get('api_version')}"
+        return (f"{(self.get('url') or '').rstrip('/')}/formrecognizer/"
+                f"documentModels/{self.get('model_id')}:analyze?{query}")
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        url = self._endpoint()
+        params = {k: rp.get(k) for k in ("pages", "locale") if rp.get(k)}
+        if params:
+            url += "&" + "&".join(f"{k}={v}" for k, v in params.items())
+        if rp.get("_url") is not None:
+            return self.json_request(rp, url, {"urlSource": str(rp["_url"])})
+        if rp.get("_bytes") is not None:
+            headers = {"Content-Type": "application/octet-stream",
+                       **self.auth_headers(rp)}
+            return HTTPRequest(url=url, method="POST", headers=headers,
+                               entity=bytes(rp["_bytes"]))
+        return None
+
+    def parse_response(self, payload):
+        if isinstance(payload, dict) and "analyzeResult" in payload:
+            return payload["analyzeResult"]
+        return payload
+
+
+class AnalyzeLayout(AnalyzeDocument):
+    model_id = Param("model_id", "fixed model", default="prebuilt-layout")
+
+
+class AnalyzeReceipts(AnalyzeDocument):
+    model_id = Param("model_id", "fixed model", default="prebuilt-receipt")
+
+
+class AnalyzeInvoices(AnalyzeDocument):
+    model_id = Param("model_id", "fixed model", default="prebuilt-invoice")
+
+
+class AnalyzeBusinessCards(AnalyzeDocument):
+    model_id = Param("model_id", "fixed model", default="prebuilt-businessCard")
+
+
+class AnalyzeIDDocuments(AnalyzeDocument):
+    model_id = Param("model_id", "fixed model", default="prebuilt-idDocument")
+
+
+def _walk_fields(fields: dict, prefix: str = "") -> list[tuple[str, str]]:
+    """Flatten a documents[].fields dict into (dotted name, value type)."""
+    out = []
+    for name, spec in (fields or {}).items():
+        if not isinstance(spec, dict):
+            continue
+        t = spec.get("type", "string")
+        path = f"{prefix}{name}"
+        out.append((path, t))
+        if t == "object":
+            out.extend(_walk_fields(spec.get("valueObject", {}), path + "."))
+        elif t == "array":
+            for item in spec.get("valueArray", [])[:1]:
+                if isinstance(item, dict) and item.get("type") == "object":
+                    out.extend(_walk_fields(item.get("valueObject", {}),
+                                            path + "[]."))
+    return out
+
+
+class FormOntologyLearner(Estimator):
+    """(ref ``FormOntologyLearner.scala``) — unions the field schemas seen in
+    a column of AnalyzeDocument results into one ontology, producing a
+    transformer that projects each document onto the learned columns."""
+
+    feature_name = "services"
+
+    input_col = Param("input_col", "column of analyzeResult payloads",
+                      default="analysis")
+    output_col = Param("output_col", "projected ontology struct column",
+                       default="ontology")
+    min_frequency = Param("min_frequency", "drop fields seen fewer times",
+                          default=1, converter=TypeConverters.to_int)
+
+    def _fit(self, df: DataFrame) -> "FormOntologyTransformer":
+        self.require_columns(df, self.get("input_col"))
+        counts: Counter = Counter()
+        types: dict[str, str] = {}
+        for payload in df.collect_column(self.get("input_col")):
+            if not isinstance(payload, dict):
+                continue
+            for doc in payload.get("documents", []):
+                for path, t in _walk_fields(doc.get("fields", {})):
+                    counts[path] += 1
+                    types.setdefault(path, t)
+        fields = sorted(p for p, c in counts.items()
+                        if c >= self.get("min_frequency"))
+        return FormOntologyTransformer(
+            input_col=self.get("input_col"), output_col=self.get("output_col"),
+            ontology={p: types[p] for p in fields})
+
+
+class FormOntologyTransformer(Model):
+    feature_name = "services"
+
+    input_col = Param("input_col", "column of analyzeResult payloads",
+                      default="analysis")
+    output_col = Param("output_col", "projected struct column", default="ontology")
+    ontology = Param("ontology", "learned {field path: type}", default=None)
+
+    @staticmethod
+    def _value_of(spec: dict):
+        if not isinstance(spec, dict):
+            return None
+        t = spec.get("type", "string")
+        t_key = t[0].upper() + t[1:] if t else ""  # camelCase-safe (phoneNumber)
+        for key in (f"value{t_key}", "valueString", "valueNumber",
+                    "valueDate", "content"):
+            if key in spec:
+                return spec[key]
+        return spec.get("content")
+
+    def _project(self, payload) -> dict:
+        out = {p: None for p in (self.get("ontology") or {})}
+        if not isinstance(payload, dict):
+            return out
+        for doc in payload.get("documents", []):
+            flat: dict[str, dict] = {}
+
+            def flatten(fields, prefix=""):
+                for name, spec in (fields or {}).items():
+                    if not isinstance(spec, dict):
+                        continue
+                    flat[f"{prefix}{name}"] = spec
+                    if spec.get("type") == "object":
+                        flatten(spec.get("valueObject", {}), f"{prefix}{name}.")
+
+            flatten(doc.get("fields", {}))
+            for p in out:
+                if out[p] is None and p in flat:
+                    out[p] = self._value_of(flat[p])
+        return out
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+
+        def per_part(p):
+            vals = p[self.get("input_col")]
+            col = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                col[i] = self._project(v)
+            q = dict(p)
+            q[self.get("output_col")] = col
+            return q
+
+        return df.map_partitions(per_part)

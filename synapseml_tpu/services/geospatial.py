@@ -1,0 +1,94 @@
+"""Azure Maps geospatial services.
+
+Reference: ``cognitive/.../services/geospatial/{AzureMapsGeocode,
+CheckPointInPolygon}.scala`` — address geocoding, reverse geocoding, and
+point-in-polygon checks (subscription key rides the query string for Maps).
+"""
+
+from __future__ import annotations
+
+from ..core.params import Param, ServiceParam
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase
+
+__all__ = ["AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon"]
+
+
+class _MapsBase(CognitiveServiceBase):
+    api_version = Param("api_version", "maps API version", default="1.0")
+
+    def _key(self, rp: dict) -> str:
+        return rp.get("subscription_key") or ""
+
+    def _base(self) -> str:
+        return (self.get("url") or "https://atlas.microsoft.com").rstrip("/")
+
+
+class AddressGeocoder(_MapsBase):
+    """(ref ``AzureMapsGeocode``) — address string -> lat/lon candidates."""
+
+    address_col = Param("address_col", "address column", default="address")
+    limit = ServiceParam("limit", "max results", default=1)
+
+    def input_bindings(self):
+        return {"_address": "address_col"}
+
+    def build_request(self, rp):
+        if rp.get("_address") is None:
+            return None
+        from urllib.parse import quote
+
+        url = (f"{self._base()}/search/address/json?api-version="
+               f"{self.get('api_version')}&subscription-key={self._key(rp)}"
+               f"&query={quote(str(rp['_address']))}&limit={rp.get('limit') or 1}")
+        return HTTPRequest(url=url, method="GET")
+
+    def parse_response(self, payload):
+        return payload.get("results", payload) if isinstance(payload, dict) else payload
+
+
+class ReverseAddressGeocoder(_MapsBase):
+    """(ref reverse geocode) — (lat, lon) -> nearest address."""
+
+    lat_col = Param("lat_col", "latitude column", default="lat")
+    lon_col = Param("lon_col", "longitude column", default="lon")
+
+    def input_bindings(self):
+        return {"_lat": "lat_col", "_lon": "lon_col"}
+
+    def build_request(self, rp):
+        if rp.get("_lat") is None or rp.get("_lon") is None:
+            return None
+        url = (f"{self._base()}/search/address/reverse/json?api-version="
+               f"{self.get('api_version')}&subscription-key={self._key(rp)}"
+               f"&query={float(rp['_lat'])},{float(rp['_lon'])}")
+        return HTTPRequest(url=url, method="GET")
+
+    def parse_response(self, payload):
+        return payload.get("addresses", payload) if isinstance(payload, dict) else payload
+
+
+class CheckPointInPolygon(_MapsBase):
+    """(ref ``CheckPointInPolygon``) — is (lat, lon) inside a stored geofence
+    polygon (udid references uploaded geojson)."""
+
+    lat_col = Param("lat_col", "latitude column", default="lat")
+    lon_col = Param("lon_col", "longitude column", default="lon")
+    user_data_id = ServiceParam("user_data_id", "uploaded polygon udid")
+
+    def input_bindings(self):
+        return {"_lat": "lat_col", "_lon": "lon_col"}
+
+    def build_request(self, rp):
+        if rp.get("_lat") is None or rp.get("_lon") is None:
+            return None
+        url = (f"{self._base()}/spatial/pointInPolygon/json?api-version="
+               f"{self.get('api_version')}&subscription-key={self._key(rp)}"
+               f"&udid={rp.get('user_data_id') or ''}"
+               f"&lat={float(rp['_lat'])}&lon={float(rp['_lon'])}")
+        return HTTPRequest(url=url, method="GET")
+
+    def parse_response(self, payload):
+        if isinstance(payload, dict) and "result" in payload:
+            return payload["result"]
+        return payload

@@ -1,0 +1,61 @@
+"""LangChain transformer.
+
+Reference: ``cognitive/src/main/python/synapse/ml/services/langchain/
+LangchainTransform.py`` — wraps a LangChain chain as a SparkML transformer
+(text column in, chain output column out). Here the chain may be any object
+exposing ``invoke``/``run``/``__call__`` (a langchain chain when that package
+is present, or any callable), applied per row with per-row error capture.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param
+from ..core.pipeline import Transformer
+
+__all__ = ["LangChainTransformer"]
+
+
+def _call_chain(chain, text: str):
+    if hasattr(chain, "invoke"):
+        return chain.invoke(text)
+    if hasattr(chain, "run"):
+        return chain.run(text)
+    if callable(chain):
+        return chain(text)
+    raise TypeError(f"chain {type(chain).__name__} has no invoke/run/__call__")
+
+
+class LangChainTransformer(Transformer):
+    feature_name = "services"
+
+    chain = ComplexParam("chain", "langchain chain (or any callable)")
+    input_col = Param("input_col", "text input column", default="text")
+    output_col = Param("output_col", "chain output column", default="out")
+    error_col = Param("error_col", "per-row error column", default="errors")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        self.require_columns(df, self.get("input_col"))
+        chain = self.get("chain")
+        if chain is None:
+            raise ValueError("LangChainTransformer requires chain=")
+
+        def per_part(p):
+            texts = p[self.get("input_col")]
+            out = np.empty(len(texts), dtype=object)
+            errs = np.empty(len(texts), dtype=object)
+            for i, t in enumerate(texts):
+                try:
+                    out[i] = _call_chain(chain, str(t))
+                    errs[i] = None
+                except Exception as e:  # chain errors are data errors, not crashes
+                    out[i] = None
+                    errs[i] = f"{type(e).__name__}: {e}"
+            q = dict(p)
+            q[self.get("output_col")] = out
+            q[self.get("error_col")] = errs
+            return q
+
+        return df.map_partitions(per_part)

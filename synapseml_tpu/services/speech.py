@@ -1,0 +1,91 @@
+"""Speech services (REST).
+
+Reference: ``cognitive/.../services/speech/SpeechToTextSDK.scala:125-650``
+wraps the native Speech client SDK over streamed audio; here the REST
+short-audio endpoint covers the same transform surface (audio bytes column ->
+transcription column) without a native dependency, plus TextToSpeech
+(``TextToSpeech.scala``).
+"""
+
+from __future__ import annotations
+
+from ..core.params import Param, ServiceParam
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase
+
+__all__ = ["SpeechToText", "TextToSpeech"]
+
+
+class SpeechToText(CognitiveServiceBase):
+    """Audio bytes -> recognition JSON (DisplayText, offsets).
+
+    ``url`` is the region endpoint, e.g.
+    ``https://<region>.stt.speech.microsoft.com``."""
+
+    audio_col = Param("audio_col", "column of audio bytes (WAV/OGG)",
+                      default="audio")
+    language = ServiceParam("language", "recognition language", default="en-US")
+    format = ServiceParam("format", "simple | detailed", default="simple")
+    profanity = ServiceParam("profanity", "masked | removed | raw", default=None)
+    audio_format = Param("audio_format", "content type of the audio bytes",
+                         default="audio/wav; codecs=audio/pcm; samplerate=16000")
+
+    def input_bindings(self):
+        return {"_audio": "audio_col"}
+
+    def build_request(self, rp):
+        if rp.get("_audio") is None:
+            return None
+        q = [f"language={rp.get('language') or 'en-US'}",
+             f"format={rp.get('format') or 'simple'}"]
+        if rp.get("profanity"):
+            q.append(f"profanity={rp['profanity']}")
+        url = (f"{(self.get('url') or '').rstrip('/')}/speech/recognition/"
+               f"conversation/cognitiveservices/v1?{'&'.join(q)}")
+        headers = {"Content-Type": self.get("audio_format"),
+                   "Accept": "application/json", **self.auth_headers(rp)}
+        return HTTPRequest(url=url, method="POST", headers=headers,
+                           entity=bytes(rp["_audio"]))
+
+
+class TextToSpeech(CognitiveServiceBase):
+    """Text -> synthesized audio bytes (SSML POST).
+
+    ``url`` is the region TTS endpoint, e.g.
+    ``https://<region>.tts.speech.microsoft.com``."""
+
+    text_col = Param("text_col", "text column", default="text")
+    voice = ServiceParam("voice", "voice name", default="en-US-JennyNeural")
+    language = ServiceParam("language", "language", default="en-US")
+    output_format = Param("output_format", "audio output format",
+                          default="riff-16khz-16bit-mono-pcm")
+
+    def input_bindings(self):
+        return {"_text": "text_col"}
+
+    def build_request(self, rp):
+        if rp.get("_text") is None:
+            return None
+        def esc(s, attr=False):
+            s = (str(s).replace("&", "&amp;").replace("<", "&lt;")
+                 .replace(">", "&gt;"))
+            return s.replace('"', "&quot;").replace("'", "&apos;") if attr else s
+
+        lang = esc(rp.get("language") or "en-US", attr=True)
+        voice = esc(rp.get("voice") or "en-US-JennyNeural", attr=True)
+        text = esc(rp["_text"])
+        ssml = (f"<speak version='1.0' xml:lang='{lang}'>"
+                f"<voice xml:lang='{lang}' name='{voice}'>{text}</voice></speak>")
+        url = f"{(self.get('url') or '').rstrip('/')}/cognitiveservices/v1"
+        headers = {"Content-Type": "application/ssml+xml",
+                   "X-Microsoft-OutputFormat": self.get("output_format"),
+                   **self.auth_headers(rp)}
+        return HTTPRequest(url=url, method="POST", headers=headers, entity=ssml)
+
+    def handle_response(self, resp):
+        # binary audio body, not JSON
+        if resp is None:
+            return None, None
+        if resp.error or resp.status_code // 100 != 2:
+            return None, resp.error or f"HTTP {resp.status_code}: {resp.reason}"
+        return resp.entity, None

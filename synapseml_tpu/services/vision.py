@@ -1,0 +1,142 @@
+"""Computer vision services.
+
+Reference: ``cognitive/.../services/vision/ComputerVision.scala`` —
+AnalyzeImage / DescribeImage / TagImage / OCR / ReadImage (LRO) /
+GenerateThumbnails / RecognizeDomainSpecificContent, each posting an image URL
+or raw bytes.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..core.params import Param, ServiceParam
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase, HasAsyncReply
+
+__all__ = ["AnalyzeImage", "DescribeImage", "TagImage", "OCR", "ReadImage",
+           "GenerateThumbnails", "RecognizeDomainSpecificContent"]
+
+
+class _ImageInput(CognitiveServiceBase):
+    """Shared image-url-or-bytes input handling (ref ``HasImageInput``)."""
+
+    image_url_col = Param("image_url_col", "column of image URLs", default=None)
+    image_bytes_col = Param("image_bytes_col", "column of raw image bytes",
+                            default=None)
+
+    def input_bindings(self):
+        out = {}
+        if self.get("image_url_col"):
+            out["_url"] = "image_url_col"
+        if self.get("image_bytes_col"):
+            out["_bytes"] = "image_bytes_col"
+        if not out:
+            raise ValueError(f"{type(self).__name__} needs image_url_col or "
+                             f"image_bytes_col")
+        return out
+
+    def _image_request(self, rp: dict, url: str) -> HTTPRequest | None:
+        if rp.get("_url") is not None:
+            return self.json_request(rp, url, {"url": str(rp["_url"])})
+        if rp.get("_bytes") is not None:
+            headers = {"Content-Type": "application/octet-stream",
+                       **self.auth_headers(rp)}
+            return HTTPRequest(url=url, method="POST", headers=headers,
+                               entity=bytes(rp["_bytes"]))
+        return None
+
+    def _base(self) -> str:
+        return f"{(self.get('url') or '').rstrip('/')}/vision/v3.2"
+
+
+class AnalyzeImage(_ImageInput):
+    """(ref ``AnalyzeImage``)"""
+
+    visual_features = ServiceParam(
+        "visual_features", "comma-joined features (Categories, Tags, "
+        "Description, Faces, Objects, Color, Adult, Brands)", default="Tags")
+    details = ServiceParam("details", "Celebrities and/or Landmarks", default=None)
+    language = ServiceParam("language", "response language", default="en")
+
+    def build_request(self, rp):
+        q = [f"visualFeatures={rp.get('visual_features') or 'Tags'}",
+             f"language={rp.get('language') or 'en'}"]
+        if rp.get("details"):
+            q.append(f"details={rp['details']}")
+        return self._image_request(rp, f"{self._base()}/analyze?{'&'.join(q)}")
+
+
+class DescribeImage(_ImageInput):
+    max_candidates = ServiceParam("max_candidates", "caption candidates", default=1)
+
+    def build_request(self, rp):
+        return self._image_request(
+            rp, f"{self._base()}/describe?maxCandidates={rp.get('max_candidates') or 1}")
+
+    def parse_response(self, payload):
+        return payload.get("description", payload) if isinstance(payload, dict) else payload
+
+
+class TagImage(_ImageInput):
+    def build_request(self, rp):
+        return self._image_request(rp, f"{self._base()}/tag")
+
+    def parse_response(self, payload):
+        return payload.get("tags", payload) if isinstance(payload, dict) else payload
+
+
+class OCR(_ImageInput):
+    """(ref ``OCR``) — synchronous printed-text recognition."""
+
+    detect_orientation = ServiceParam("detect_orientation", "detect rotation",
+                                      default=True)
+
+    def build_request(self, rp):
+        return self._image_request(
+            rp, f"{self._base()}/ocr?detectOrientation="
+                f"{str(bool(rp.get('detect_orientation'))).lower()}")
+
+
+class ReadImage(_ImageInput, HasAsyncReply):
+    """(ref ``ReadImage``) — the async Read API: 202 + Operation-Location."""
+
+    def build_request(self, rp):
+        return self._image_request(rp, f"{self._base()}/read/analyze")
+
+    def parse_response(self, payload):
+        if isinstance(payload, dict) and "analyzeResult" in payload:
+            return payload["analyzeResult"]
+        return payload
+
+
+class GenerateThumbnails(_ImageInput):
+    width = ServiceParam("width", "thumbnail width", default=64)
+    height = ServiceParam("height", "thumbnail height", default=64)
+    smart_cropping = ServiceParam("smart_cropping", "smart crop", default=True)
+
+    def build_request(self, rp):
+        return self._image_request(
+            rp, f"{self._base()}/generateThumbnail?width={rp.get('width') or 64}"
+                f"&height={rp.get('height') or 64}"
+                f"&smartCropping={str(bool(rp.get('smart_cropping'))).lower()}")
+
+    def handle_response(self, resp):
+        # binary thumbnail body, not JSON
+        if resp is None:
+            return None, None
+        if resp.error or resp.status_code // 100 != 2:
+            return None, resp.error or f"HTTP {resp.status_code}: {resp.reason}"
+        return resp.entity, None
+
+
+class RecognizeDomainSpecificContent(_ImageInput):
+    model = Param("model", "domain model: celebrities | landmarks",
+                  default="celebrities")
+
+    def build_request(self, rp):
+        return self._image_request(
+            rp, f"{self._base()}/models/{self.get('model')}/analyze")
+
+    def parse_response(self, payload):
+        return payload.get("result", payload) if isinstance(payload, dict) else payload

@@ -172,3 +172,23 @@ def test_deep_vision_classifier_runs():
     out = model.transform(df)
     acc = float(np.mean(out.collect_column("prediction") == labels))
     assert acc > 0.8, f"train accuracy {acc} too low"
+
+
+def test_deep_text_attn_impl_ring_on_seq_mesh():
+    """attn_impl='ring' wired through DeepTextClassifier: fit + transform on a
+    mesh with a seq axis (the long-context path the reference lacks)."""
+    import synapseml_tpu as st
+    from synapseml_tpu.models import DeepTextClassifier
+    from synapseml_tpu.parallel import MeshConfig
+
+    rows = [{"text": "good great fine", "label": 1},
+            {"text": "bad awful poor", "label": 0}] * 8
+    df = st.DataFrame.from_rows(rows)
+    model = DeepTextClassifier(
+        checkpoint="bert-tiny", num_classes=2, batch_size=8, max_token_len=16,
+        max_steps=6, learning_rate=3e-3, attn_impl="ring",
+        mesh_config=MeshConfig(data=-1, seq=2)).fit(df)
+    assert model.get("arch_config").attn_impl == "ring"
+    out = model.transform(df)
+    probs = np.asarray(list(out.collect_column("scores")))
+    assert probs.shape == (16, 2) and np.all(np.isfinite(probs))

@@ -292,3 +292,28 @@ def test_slice_end_sentinels(end, step, expect):
     )
     fn = convert_graph(ModelProto(graph=g).encode())
     np.testing.assert_array_equal(np.asarray(fn(x=x)["y"]), x[expect])
+
+
+def test_slice_sentinel_survives_concat_cast_chain():
+    """INT64_MAX 'to end' built through Concat/Cast/Unsqueeze of int64
+    constants (a common exporter pattern) must not wrap to -1."""
+    big = np.iinfo(np.int64).max
+    g = GraphProto(
+        name="chain",
+        node=[
+            node("Unsqueeze", ["e0", "zero"], ["e0u"]),
+            node("Cast", ["e0u"], ["e0c"], to=P.INT64),
+            node("Concat", ["e0c"], ["ends"], axis=0),
+            node("Slice", ["x", "st", "ends", "ax", "sp"], ["y"]),
+        ],
+        initializer=[numpy_to_tensor(np.array(big, np.int64), "e0"),
+                     numpy_to_tensor(np.array([0], np.int64), "zero"),
+                     numpy_to_tensor(np.array([1], np.int64), "st"),
+                     numpy_to_tensor(np.array([0], np.int64), "ax"),
+                     numpy_to_tensor(np.array([1], np.int64), "sp")],
+        input=[ValueInfoProto(name="x", elem_type=P.FLOAT, dims=[4, 5])],
+        output=[ValueInfoProto(name="y", elem_type=P.FLOAT, dims=["M", 5])],
+    )
+    x = np.arange(20, dtype=np.float32).reshape(4, 5)
+    fn = convert_graph(ModelProto(graph=g).encode())
+    np.testing.assert_array_equal(np.asarray(fn(x=x)["y"]), x[1:])

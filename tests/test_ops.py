@@ -125,3 +125,55 @@ def test_encoder_attn_impls_agree():
         out_ring = Encoder(dataclasses.replace(base, attn_impl="ring")).apply(variables, x, mask)
     np.testing.assert_allclose(np.asarray(out_einsum)[valid],
                                np.asarray(out_ring)[valid], atol=2e-4)
+
+
+def test_ring_attention_grad_matches_reference_with_mask():
+    """Custom-VJP gradients == autodiff through reference_attention, with
+    padding mask + causal + chunked inner (chunk < T_local)."""
+    mesh = create_mesh(MeshConfig(seq=4))
+    rs = np.random.default_rng(7)
+    B, T, H, D = 2, 64, 2, 16
+    q = jnp.asarray(rs.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rs.normal(size=(B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rs.normal(size=(B, T, H, D)), jnp.float32)
+    mask = np.ones((B, T), bool)
+    mask[1, 50:] = False
+    mask = jnp.asarray(mask)
+    w = jnp.asarray(rs.normal(size=(B, T, H, D)), jnp.float32)  # cotangent mix
+
+    def loss_ring(q, k, v):
+        out = ring_attention_sharded(mesh, q, k, v, kv_mask=mask, causal=True,
+                                     chunk=8)
+        return jnp.sum(out * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, kv_mask=mask, causal=True) * w)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_ring_attention_long_context_32k():
+    """T=32k over an 8-way seq mesh: rolled ring + chunked inner must compile
+    (compile size independent of ring length) and run without a [T_loc, T_loc]
+    score materialization. reference check on a strided sample of rows."""
+    mesh = create_mesh(MeshConfig(seq=8))
+    rs = np.random.default_rng(11)
+    B, T, H, D = 1, 32768, 1, 64
+    q = jnp.asarray(rs.normal(size=(B, T, H, D)), jnp.bfloat16)
+    k = jnp.asarray(rs.normal(size=(B, T, H, D)), jnp.bfloat16)
+    v = jnp.asarray(rs.normal(size=(B, T, H, D)), jnp.bfloat16)
+    out = np.asarray(ring_attention_sharded(mesh, q, k, v, causal=True,
+                                            chunk=1024))
+    assert out.shape == (B, T, H, D)
+    assert np.all(np.isfinite(out))
+    # spot-check rows against local attention over their causal prefix
+    qf, kf, vf = (np.asarray(x, np.float32) for x in (q, k, v))
+    for t in (0, 5000, 20000, 32767):
+        s = (qf[0, t, 0] @ kf[0, : t + 1, 0].T) / np.sqrt(D)
+        p = np.exp(s - s.max())
+        p /= p.sum()
+        np.testing.assert_allclose(out[0, t, 0], p @ vf[0, : t + 1, 0],
+                                   atol=3e-2)

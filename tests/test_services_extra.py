@@ -1,0 +1,310 @@
+"""Round-2 cognitive service breadth (form/vision/face/anomaly/geospatial/
+speech/aifoundry/langchain) against a local mock server — the reference tests
+these against live Azure endpoints (``CognitiveServicesCommon``); the mock
+keeps the same request/response shapes."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.services import (
+    AddressGeocoder,
+    AIFoundryChatCompletion,
+    AnalyzeDocument,
+    AnalyzeImage,
+    AnalyzeInvoices,
+    CheckPointInPolygon,
+    DescribeImage,
+    DetectAnomalies,
+    DetectFace,
+    DetectLastAnomaly,
+    DetectMultivariateAnomaly,
+    FitMultivariateAnomaly,
+    FormOntologyLearner,
+    GenerateThumbnails,
+    LangChainTransformer,
+    ReadImage,
+    ReverseAddressGeocoder,
+    SimpleDetectAnomalies,
+    SpeechToText,
+    TextToSpeech,
+    VerifyFaces,
+)
+
+
+class Handler(BaseHTTPRequestHandler):
+    lro: dict = {}
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, payload, status=200, headers=None):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _bytes(self, data, status=200):
+        self.send_response(status)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    def do_GET(self):  # noqa: N802
+        p = self.path.split("?")[0]
+        if p.startswith("/lro/"):
+            op = p.rsplit("/", 1)[-1]
+            n = Handler.lro.get(op, 0)
+            Handler.lro[op] = n + 1
+            if n < 1:
+                return self._json({"status": "running"})
+            if op.startswith("mvdetect"):
+                return self._json({"summary": {"status": "READY"},
+                                   "results": [{"timestamp": "t0", "value": {
+                                       "isAnomaly": False}}]})
+            if op.startswith("form"):
+                return self._json({
+                    "status": "succeeded",
+                    "analyzeResult": {"content": "INVOICE #42", "documents": [
+                        {"fields": {"Total": {"type": "number", "valueNumber": 42.5},
+                                    "Vendor": {"type": "string",
+                                               "valueString": "Tailspin"}}}]}})
+            return self._json({"status": "succeeded",
+                               "analyzeResult": {"readResults": [
+                                   {"lines": [{"text": "hello"}]}]}})
+        if "/search/address/reverse/json" in p:
+            return self._json({"addresses": [{"address": {"freeformAddress": "1 Main St"}}]})
+        if "/search/address/json" in p:
+            return self._json({"results": [{"position": {"lat": 47.6, "lon": -122.1}}]})
+        if "/spatial/pointInPolygon/json" in p:
+            return self._json({"result": {"pointInPolygons": True}})
+        if "/multivariate/models/" in p:
+            return self._json({"modelInfo": {"status": "READY"}})
+        return self._json({"error": f"unknown GET {p}"}, 404)
+
+    def do_POST(self):  # noqa: N802
+        p = self.path.split("?")[0]
+        body = self._body()
+        host = f"http://{self.headers.get('Host')}"
+        if "documentModels/" in p and ":analyze" in p:
+            op = "form1"
+            Handler.lro.setdefault(op, 0)
+            return self._json({}, 202,
+                              {"Operation-Location": f"{host}/lro/{op}"})
+        if p.endswith("/vision/v3.2/analyze"):
+            assert json.loads(body)["url"]
+            return self._json({"tags": [{"name": "cat", "confidence": 0.99}]})
+        if p.endswith("/vision/v3.2/describe"):
+            return self._json({"description": {"captions": [{"text": "a cat"}]}})
+        if p.endswith("/vision/v3.2/read/analyze"):
+            op = "read1"
+            Handler.lro.setdefault(op, 0)
+            return self._json({}, 202,
+                              {"Operation-Location": f"{host}/lro/{op}"})
+        if "/vision/v3.2/generateThumbnail" in p:
+            return self._bytes(b"\x89PNGfake")
+        if p.endswith("/face/v1.0/detect"):
+            return self._json([{"faceId": "f-1", "faceRectangle": {"top": 1}}])
+        if p.endswith("/face/v1.0/verify"):
+            b = json.loads(body)
+            return self._json({"isIdentical": b["faceId1"] == b["faceId2"],
+                               "confidence": 0.9})
+        if p.endswith("/timeseries/last/detect"):
+            return self._json({"isAnomaly": True, "expectedValue": 1.0})
+        if p.endswith("/timeseries/entire/detect"):
+            n = len(json.loads(body)["series"])
+            flags = [i == n - 1 for i in range(n)]
+            return self._json({"isAnomaly": flags})
+        if p.endswith("/multivariate/models"):
+            return self._json({"modelId": "mv-7"}, 201,
+                              {"Location": f"{host}/multivariate/models/mv-7"})
+        if "/multivariate/models/" in p and p.endswith("/detect"):
+            # real API: 201/202 with the result job URL in Location (NOT
+            # Operation-Location) — exercises DetectMultivariateAnomaly's
+            # poll_location override
+            op = "mvdetect"
+            Handler.lro.setdefault(op, 0)
+            return self._json({}, 202, {"Location": f"{host}/lro/{op}"})
+        if "/speech/recognition/" in p:
+            assert body == b"RIFFaudio"
+            return self._json({"RecognitionStatus": "Success",
+                               "DisplayText": "hello world"})
+        if p.endswith("/cognitiveservices/v1"):  # TTS
+            assert b"<speak" in body
+            return self._bytes(b"RIFFsynth")
+        if p.endswith("/chat/completions"):
+            assert self.headers.get("Authorization") == "Bearer k"
+            return self._json({"choices": [{"message": {
+                "content": "foundry says hi"}}]})
+        return self._json({"error": f"unknown POST {p}"}, 404)
+
+
+@pytest.fixture(scope="module")
+def server():
+    Handler.lro = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_analyze_document_lro_and_ontology(server):
+    df = DataFrame.from_rows([{"doc": "https://x/invoice.pdf"}])
+    az = AnalyzeDocument(url=server, subscription_key="k", image_url_col="doc",
+                         polling_interval_s=0.01)
+    out = az.transform(df)
+    res = list(out.collect_column("out"))[0]
+    assert res["content"] == "INVOICE #42"
+    assert list(out.collect_column("errors"))[0] is None
+
+    learner = FormOntologyLearner(input_col="out", output_col="onto")
+    model = learner.fit(out)
+    onto = model.get("ontology")
+    assert set(onto) == {"Total", "Vendor"} and onto["Total"] == "number"
+    proj = list(model.transform(out).collect_column("onto"))[0]
+    assert proj == {"Total": 42.5, "Vendor": "Tailspin"}
+
+
+def test_analyze_invoices_bytes_input(server):
+    Handler.lro["form1"] = 0
+    df = DataFrame.from_rows([{"raw": b"%PDF-fake"}])
+    az = AnalyzeInvoices(url=server, subscription_key="k", image_bytes_col="raw",
+                         polling_interval_s=0.01)
+    res = list(az.transform(df).collect_column("out"))[0]
+    assert "documents" in res
+
+
+def test_vision_family(server):
+    df = DataFrame.from_rows([{"img": "https://x/cat.png"}])
+    tags = list(AnalyzeImage(url=server, subscription_key="k",
+                             image_url_col="img").transform(df)
+                .collect_column("out"))[0]
+    assert tags["tags"][0]["name"] == "cat"
+    desc = list(DescribeImage(url=server, subscription_key="k",
+                              image_url_col="img").transform(df)
+                .collect_column("out"))[0]
+    assert desc["captions"][0]["text"] == "a cat"
+    read = list(ReadImage(url=server, subscription_key="k", image_url_col="img",
+                          polling_interval_s=0.01).transform(df)
+                .collect_column("out"))[0]
+    assert read["readResults"][0]["lines"][0]["text"] == "hello"
+    thumb = list(GenerateThumbnails(url=server, subscription_key="k",
+                                    image_url_col="img").transform(df)
+                 .collect_column("out"))[0]
+    assert thumb.startswith(b"\x89PNG")
+
+
+def test_face_family(server):
+    df = DataFrame.from_rows([{"url": "https://x/face.png"}])
+    det = list(DetectFace(url=server, subscription_key="k").transform(df)
+               .collect_column("out"))[0]
+    assert det[0]["faceId"] == "f-1"
+    df2 = DataFrame.from_rows([{"faceId1": "a", "faceId2": "a"},
+                               {"faceId1": "a", "faceId2": "b"}])
+    ver = list(VerifyFaces(url=server, subscription_key="k").transform(df2)
+               .collect_column("out"))
+    assert ver[0]["isIdentical"] is True and ver[1]["isIdentical"] is False
+
+
+def test_anomaly_family(server):
+    series = [{"timestamp": f"2024-01-0{i+1}T00:00:00Z", "value": float(i)}
+              for i in range(4)]
+    df = DataFrame.from_rows([{"series": series}])
+    last = list(DetectLastAnomaly(url=server, subscription_key="k")
+                .transform(df).collect_column("out"))[0]
+    assert last["isAnomaly"] is True
+    ent = list(DetectAnomalies(url=server, subscription_key="k")
+               .transform(df).collect_column("out"))[0]
+    assert ent["isAnomaly"] == [False, False, False, True]
+
+    rows = [{"group": "g1", "timestamp": s["timestamp"], "value": s["value"]}
+            for s in series]
+    sdf = DataFrame.from_rows(rows)
+    sda = SimpleDetectAnomalies(url=server, subscription_key="k",
+                                output_col="isAnomaly")
+    out = sda.transform(sdf)
+    flags = list(out.collect_column("isAnomaly"))
+    assert flags == [False, False, False, True]
+
+
+def test_multivariate_anomaly_fit_detect(server):
+    est = FitMultivariateAnomaly(url=server, subscription_key="k",
+                                 source="https://blob/sas", polling_interval_s=0.01,
+                                 start_time="2024-01-01T00:00:00Z",
+                                 end_time="2024-02-01T00:00:00Z")
+    model = est.fit(DataFrame.from_rows([{"x": 1}]))
+    assert isinstance(model, DetectMultivariateAnomaly)
+    assert model.get("model_id") == "mv-7"
+    df = DataFrame.from_rows([{"source": "https://blob/sas2",
+                               "startTime": "t0", "endTime": "t1"}])
+    res = list(model.transform(df).collect_column("out"))[0]
+    assert res[0]["value"]["isAnomaly"] is False
+
+
+def test_geospatial_family(server):
+    df = DataFrame.from_rows([{"address": "1 Main St, Seattle"}])
+    geo = list(AddressGeocoder(url=server, subscription_key="k").transform(df)
+               .collect_column("out"))[0]
+    assert geo[0]["position"]["lat"] == 47.6
+    df2 = DataFrame.from_rows([{"lat": 47.6, "lon": -122.1}])
+    rev = list(ReverseAddressGeocoder(url=server, subscription_key="k")
+               .transform(df2).collect_column("out"))[0]
+    assert rev[0]["address"]["freeformAddress"] == "1 Main St"
+    pip_ = list(CheckPointInPolygon(url=server, subscription_key="k",
+                                    user_data_id="u1").transform(df2)
+                .collect_column("out"))[0]
+    assert pip_["pointInPolygons"] is True
+
+
+def test_speech_family(server):
+    df = DataFrame.from_rows([{"audio": b"RIFFaudio"}])
+    stt = list(SpeechToText(url=server, subscription_key="k").transform(df)
+               .collect_column("out"))[0]
+    assert stt["DisplayText"] == "hello world"
+    df2 = DataFrame.from_rows([{"text": "hi <there>"}])
+    tts = list(TextToSpeech(url=server, subscription_key="k").transform(df2)
+               .collect_column("out"))[0]
+    assert tts == b"RIFFsynth"
+
+
+def test_aifoundry_chat(server):
+    df = DataFrame.from_rows([{"messages": [{"role": "user", "content": "hi"}]}])
+    out = list(AIFoundryChatCompletion(url=server, subscription_key="k",
+                                       model="m1").transform(df)
+               .collect_column("chat_completions"))[0]
+    assert out == "foundry says hi"
+
+
+def test_langchain_transformer():
+    class FakeChain:
+        def invoke(self, text):
+            if "boom" in text:
+                raise RuntimeError("chain exploded")
+            return text.upper()
+
+    df = DataFrame.from_rows([{"text": "hello"}, {"text": "boom"}])
+    out = LangChainTransformer(chain=FakeChain()).transform(df)
+    vals = list(out.collect_column("out"))
+    errs = list(out.collect_column("errors"))
+    assert vals[0] == "HELLO" and vals[1] is None
+    assert errs[0] is None and "chain exploded" in errs[1]
+
+
+def test_missing_image_input_raises(server):
+    df = DataFrame.from_rows([{"img": "x"}])
+    with pytest.raises(ValueError, match="image_url_col or"):
+        AnalyzeImage(url=server, subscription_key="k").transform(df)
