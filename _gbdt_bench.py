@@ -1,0 +1,31 @@
+"""Higgs-1M-shaped GBDT training throughput on the TPU (BASELINE.md config:
+LightGBM Higgs-1M, 100 iterations, binary)."""
+import time, json
+import numpy as np
+
+def main():
+    import jax
+    from synapseml_tpu.gbdt.booster import train_booster
+    print("platform:", jax.devices()[0].platform, flush=True)
+    rng = np.random.default_rng(0)
+    N, F = 1_000_000, 28
+    X = rng.normal(size=(N, F)).astype(np.float32)
+    w = rng.normal(size=F); w[F//2:] = 0
+    logits = X @ w * 0.5 + rng.normal(size=N) * 0.5
+    y = (logits > 0).astype(np.float32)
+    t0 = time.perf_counter()
+    booster = train_booster(X, y, objective="binary", num_iterations=100,
+                            learning_rate=0.1, num_leaves=31, max_bin=255)
+    train_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    p = booster.predict(X[:100_000])
+    pred_s = time.perf_counter() - t0
+    auc_y, auc_p = y[:100_000], np.asarray(p).ravel()
+    order = np.argsort(auc_p)
+    ranks = np.empty(len(order)); ranks[order] = np.arange(1, len(order)+1)
+    n1 = auc_y.sum(); n0 = len(auc_y) - n1
+    auc = (ranks[auc_y == 1].sum() - n1*(n1+1)/2) / (n1*n0)
+    print(json.dumps({"train_s": round(train_s, 2), "pred_100k_s": round(pred_s, 3),
+                      "auc": round(float(auc), 4),
+                      "rows_per_sec": round(N*100/train_s)}))
+main()

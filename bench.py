@@ -53,19 +53,10 @@ def _roundtrip_latency(n_trials: int = 5) -> float:
     return float(np.median(ts))
 
 
-# bf16 peak TFLOPs per chip, by device_kind substring (for MFU)
-_CHIP_PEAK_TFLOPS = [
-    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0), ("v6", 918.0),
-    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
-]
-
-
 def _chip_peak_tflops(device_kind: str):
-    kind = device_kind.lower()
-    for key, peak in _CHIP_PEAK_TFLOPS:
-        if key in kind:
-            return peak
-    return None
+    from synapseml_tpu.core.instrumentation import chip_peak_tflops
+
+    return chip_peak_tflops(device_kind)
 
 
 def _init_devices(max_tries: int = 5):

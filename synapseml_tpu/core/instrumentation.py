@@ -1,0 +1,84 @@
+"""Per-phase instrumentation measures + profiler trace helper.
+
+Reference: ``lightgbm/.../LightGBMPerformance.scala`` —
+``TaskInstrumentationMeasures`` mark columnStatistics/rowStatistics/sampling/
+network-init/dataset-prep/training windows and travel back with results; VW
+returns ``TrainingStats`` per partition (``VowpalWabbitBaseLearner.scala:71-96``).
+Here one collector serves every engine: estimators thread an
+``InstrumentationMeasures`` through fit and attach ``.to_dict()`` to the model
+(``train_measures`` param), and ``profile_trace`` wraps ``jax.profiler.trace``
+for on-demand XLA-level traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+__all__ = ["InstrumentationMeasures", "profile_trace", "chip_peak_tflops"]
+
+# bf16 peak TFLOPs per chip, by device_kind substring (for MFU reporting)
+_CHIP_PEAK_TFLOPS = [
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0), ("v6", 918.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+]
+
+
+def chip_peak_tflops(device_kind: str) -> float | None:
+    kind = (device_kind or "").lower()
+    for key, peak in _CHIP_PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+class InstrumentationMeasures:
+    """Named wall-clock phase windows + point marks + counters.
+
+    ``measure(name)`` windows accumulate across repeated entries (loop
+    phases); ``count(name)`` tallies events; everything exports as one flat
+    dict of ``*_ms`` / ``*_count`` / mark timestamps.
+    """
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._phases: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+        self._marks: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def measure(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._phases[name] = (self._phases.get(name, 0.0)
+                                  + (time.perf_counter() - start) * 1e3)
+
+    def mark(self, name: str) -> None:
+        self._marks[name] = (time.perf_counter() - self._t0) * 1e3
+
+    def count(self, name: str, n: int = 1) -> None:
+        self._counts[name] = self._counts.get(name, 0) + n
+
+    def phase_ms(self, name: str) -> float:
+        return self._phases.get(name, 0.0)
+
+    def to_dict(self) -> dict:
+        out = {f"{k}_ms": round(v, 3) for k, v in self._phases.items()}
+        out.update({f"{k}_count": v for k, v in self._counts.items()})
+        out.update({f"{k}_at_ms": round(v, 3) for k, v in self._marks.items()})
+        out["total_ms"] = round((time.perf_counter() - self._t0) * 1e3, 3)
+        return out
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
+    """``jax.profiler.trace`` context: captures an XLA/TPU trace viewable in
+    TensorBoard/Perfetto. The SURVEY §5 tracing-subsystem analog — wrap any
+    fit/transform/bench region."""
+    import jax.profiler
+
+    with jax.profiler.trace(log_dir, create_perfetto_trace=False):
+        yield

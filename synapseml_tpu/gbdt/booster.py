@@ -35,12 +35,15 @@ class TpuBooster:
                  leaf_value: np.ndarray, gain: np.ndarray, *, max_depth: int,
                  num_model_out: int, objective: str, init_score: np.ndarray,
                  num_features: int, params: dict | None = None,
-                 best_iteration: int | None = None):
+                 best_iteration: int | None = None,
+                 cover: np.ndarray | None = None,
+                 average_output: bool = False):
         # stacked (num_iters, K, M)
         self.feature = feature
         self.threshold_value = threshold_value
         self.leaf_value = leaf_value
         self.gain = gain
+        self.cover = cover
         self.max_depth = int(max_depth)
         self.num_model_out = int(num_model_out)
         self.objective = objective
@@ -48,6 +51,7 @@ class TpuBooster:
         self.num_features = int(num_features)
         self.params = dict(params or {})
         self.best_iteration = best_iteration
+        self.average_output = bool(average_output)  # rf mode: mean over trees
         self._predict_cache: dict[Any, Callable] = {}
 
     @property
@@ -74,11 +78,13 @@ class TpuBooster:
             depth = self.max_depth
             K = self.num_model_out
 
+            avg = 1.0 / num_iters if self.average_output else 1.0
+
             @jax.jit
             def raw(x):
                 outs = [T.predict_raw_forest(x, feat[:, k], thr[:, k], val[:, k], depth)
                         for k in range(K)]
-                return jnp.stack(outs, axis=1) + init[None, :]
+                return jnp.stack(outs, axis=1) * avg + init[None, :]
 
             self._predict_cache[key] = raw
         return self._predict_cache[key]
@@ -96,6 +102,26 @@ class TpuBooster:
         s = self.raw_score(features, num_iterations)
         o = obj.get_objective(self.objective, num_class=self.num_model_out)
         return np.asarray(o.transform(jnp.asarray(s)))
+
+    def predict_contrib(self, features: np.ndarray) -> np.ndarray:
+        """(N, K, F+1) exact TreeSHAP contributions + bias column (reference
+        ``LightGBMBooster.featuresShap``, ``booster/LightGBMBooster.scala:418``).
+        Additivity: ``contrib.sum(-1) == raw_score``."""
+        if self.cover is None:
+            raise ValueError("this booster has no per-node cover statistics "
+                             "(trained before TreeSHAP support); retrain to "
+                             "enable predict_contrib")
+        from .shap import forest_shap
+
+        n_it = self.best_iteration or self.num_iterations
+        contrib = forest_shap(self.feature[:n_it], self.threshold_value[:n_it],
+                              self.leaf_value[:n_it], self.cover[:n_it],
+                              np.zeros_like(self.init_score),
+                              np.asarray(features, np.float64))
+        if self.average_output:  # rf: raw = init + mean(trees)
+            contrib = contrib / n_it
+        contrib[:, :, -1] += np.asarray(self.init_score, np.float64)
+        return contrib
 
     def predict_leaf(self, features: np.ndarray) -> np.ndarray:
         """(N, T*K) per-tree leaf node index (reference ``predictLeaf``)."""
@@ -125,14 +151,17 @@ class TpuBooster:
     # ---------------- persistence ----------------
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
-        np.savez_compressed(
-            os.path.join(path, "trees.npz"),
-            feature=self.feature, threshold_value=self.threshold_value,
-            leaf_value=self.leaf_value, gain=self.gain, init_score=self.init_score)
+        arrays = dict(feature=self.feature, threshold_value=self.threshold_value,
+                      leaf_value=self.leaf_value, gain=self.gain,
+                      init_score=self.init_score)
+        if self.cover is not None:
+            arrays["cover"] = self.cover
+        np.savez_compressed(os.path.join(path, "trees.npz"), **arrays)
         meta = {
             "max_depth": self.max_depth, "num_model_out": self.num_model_out,
             "objective": self.objective, "num_features": self.num_features,
             "params": self.params, "best_iteration": self.best_iteration,
+            "average_output": self.average_output,
         }
         with open(os.path.join(path, "booster.json"), "w") as f:
             json.dump(meta, f, indent=2)
@@ -143,7 +172,10 @@ class TpuBooster:
             meta = json.load(f)
         z = np.load(os.path.join(path, "trees.npz"))
         return cls(z["feature"], z["threshold_value"], z["leaf_value"], z["gain"],
-                   init_score=z["init_score"], **{k: meta[k] for k in
+                   init_score=z["init_score"],
+                   cover=z["cover"] if "cover" in z.files else None,
+                   average_output=meta.get("average_output", False),
+                   **{k: meta[k] for k in
                    ("max_depth", "num_model_out", "objective", "num_features",
                     "params", "best_iteration")})
 
@@ -192,10 +224,26 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                   early_stopping_round: int = 0, seed: int = 0,
                   mesh=None, objective_alpha: float | None = None,
                   callbacks: Sequence[Callable] | None = None,
-                  verbose: bool = False) -> TpuBooster:
+                  boosting_type: str = "gbdt", top_rate: float = 0.2,
+                  other_rate: float = 0.1, drop_rate: float = 0.1,
+                  max_drop: int = 50, skip_drop: float = 0.5,
+                  measures=None, verbose: bool = False) -> TpuBooster:
     """Grow a forest. The full binned matrix + running scores stay on device
     for the whole run; pass ``mesh`` to shard rows over its ``data`` axis
-    (multi-host DP — the reference's NetworkManager/ring role)."""
+    (multi-host DP — the reference's NetworkManager/ring role).
+
+    ``boosting_type``: 'gbdt' | 'goss' (gradient one-side sampling, LightGBM
+    top_rate/other_rate semantics) | 'dart' (tree dropout with 1/(k+1)
+    normalization) | 'rf' (bagged trees on init-score gradients, averaged
+    output) — the reference's boostingType surface
+    (``params/LightGBMParams.scala``)."""
+    if boosting_type not in ("gbdt", "goss", "dart", "rf"):
+        raise ValueError(f"boosting_type must be gbdt|goss|dart|rf, got "
+                         f"{boosting_type!r}")
+    if measures is None:
+        from ..core.instrumentation import InstrumentationMeasures
+
+        measures = InstrumentationMeasures()
     x = np.asarray(features, dtype=np.float64)
     y = np.asarray(labels, dtype=np.float32)
     n, f = x.shape
@@ -205,7 +253,8 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     max_depth = min(max_depth, 12)  # heap arrays are 2^(d+1); bound memory
 
     mapper = BinMapper(max_bin=max_bin, seed=seed)
-    bins_np = mapper.fit_transform(x).astype(np.int32)
+    with measures.measure("binning"):  # the reference's dataset-prep window
+        bins_np = mapper.fit_transform(x).astype(np.int32)
 
     # pad rows to a multiple of the data-axis size for even sharding
     pad = 0
@@ -226,10 +275,11 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                           **({"alpha": objective_alpha} if objective_alpha is not None else {}))
     K = o.num_model_out
 
-    bins = _device_put_sharded(bins_np, mesh)
-    yd = _device_put_sharded(y, mesh)
-    base_presence = _device_put_sharded(presence_np, mesh)
-    wd = _device_put_sharded(w_np, mesh)
+    with measures.measure("device_transfer"):
+        bins = _device_put_sharded(bins_np, mesh)
+        yd = _device_put_sharded(y, mesh)
+        base_presence = _device_put_sharded(presence_np, mesh)
+        wd = _device_put_sharded(w_np, mesh)
 
     # ranking: bind padded-group lambda computation
     is_rank = o.name == "lambdarank"
@@ -271,7 +321,10 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
 
     cfg = T.GrowthConfig(max_depth=max_depth, num_leaves=num_leaves,
                          num_bins=mapper.num_bins, lambda_l1=lambda_l1,
-                         lambda_l2=lambda_l2, learning_rate=learning_rate,
+                         lambda_l2=lambda_l2,
+                         # rf: no shrinkage, output is averaged (LightGBM forces
+                         # shrinkage 1 in rf mode)
+                         learning_rate=1.0 if boosting_type == "rf" else learning_rate,
                          min_data_in_leaf=min_data_in_leaf,
                          min_sum_hessian=min_sum_hessian,
                          min_gain_to_split=min_gain_to_split)
@@ -302,7 +355,12 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     # lives on-device too, so the whole run can optionally lax.scan.
     key0 = jax.random.PRNGKey(seed)
     k_feat = max(1, int(round(f * feature_fraction)))
-    do_bag = bagging_fraction < 1.0 and bagging_freq > 0
+    if boosting_type == "rf" and not (bagging_fraction < 1.0 and bagging_freq > 0):
+        # rf requires bagging (LightGBM errors; we default it on)
+        bagging_fraction, bagging_freq = 0.632, 1
+    do_bag = (bagging_fraction < 1.0 and bagging_freq > 0
+              and boosting_type != "goss")  # goss replaces bagging
+    k_top = max(1, int(round(top_rate * n)))
 
     def _masks(it):
         if do_bag:
@@ -321,39 +379,100 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
             fmask = jnp.ones(f, bool)
         return bag, fmask
 
-    def one_iteration(carry, it):
-        scores, vscores = carry
-        bag, fmask = _masks(it)
-        presence = base_presence * bag
-        g, h = grad_hess(scores, yd)
-        w_eff = (wd * presence)[:, None]  # pads/bagged-out rows: zero grad AND count
-        g = g * w_eff
-        h = h * w_eff
+    def make_iteration(update_train: bool = True, update_valid: bool = True):
+        def one_iteration(carry, it):
+            scores, vscores = carry
+            bag, fmask = _masks(it)
+            presence = base_presence * bag
+            g, h = grad_hess(scores, yd)
+            if boosting_type == "goss":
+                # keep the top_rate fraction by |grad|, sample other_rate of
+                # the rest, amplify the sampled small-gradient rows
+                gmag = jnp.sum(jnp.abs(g), axis=1) * wd * base_presence
+                thresh = jnp.sort(gmag)[-k_top]
+                is_top = gmag >= thresh
+                rkey = jax.random.fold_in(key0, 3 * it + 2)
+                sampled = (~is_top) & (jax.random.uniform(rkey, (n + pad,))
+                                       < other_rate)
+                sel = (is_top | sampled).astype(jnp.float32)
+                amp = (1.0 - top_rate) / max(other_rate, 1e-12)
+                w_goss = jnp.where(is_top, 1.0, amp) * sel
+                presence = base_presence * sel
+                w_eff = (wd * w_goss * base_presence)[:, None]
+            else:
+                w_eff = (wd * presence)[:, None]  # pads/bagged-out: zero grad AND count
+            g = g * w_eff
+            h = h * w_eff
 
-        def per_class(sc_pair, gh_k):
-            scores, vscores = sc_pair
-            gk, hk, k_idx = gh_k
-            tree = T.grow_tree(bins, gk, hk, presence, cfg, fmask)
-            delta = T.traverse_binned(bins, tree, max_depth)
-            scores = jax.lax.dynamic_update_index_in_dim(
-                scores, scores[:, k_idx] + delta, k_idx, axis=1)
-            if has_valid:
-                vd = T.traverse_binned(vbins, tree, max_depth)
-                vscores = jax.lax.dynamic_update_index_in_dim(
-                    vscores, vscores[:, k_idx] + vd, k_idx, axis=1)
-            return (scores, vscores), tree
+            def per_class(sc_pair, gh_k):
+                scores, vscores = sc_pair
+                gk, hk, k_idx = gh_k
+                tree = T.grow_tree(bins, gk, hk, presence, cfg, fmask)
+                if update_train:
+                    delta = T.traverse_binned(bins, tree, max_depth)
+                    scores = jax.lax.dynamic_update_index_in_dim(
+                        scores, scores[:, k_idx] + delta, k_idx, axis=1)
+                if has_valid and update_valid:
+                    vd = T.traverse_binned(vbins, tree, max_depth)
+                    vscores = jax.lax.dynamic_update_index_in_dim(
+                        vscores, vscores[:, k_idx] + vd, k_idx, axis=1)
+                return (scores, vscores), tree
 
-        (scores, vscores), trees = jax.lax.scan(
-            per_class, (scores, vscores),
-            (jnp.swapaxes(g, 0, 1), jnp.swapaxes(h, 0, 1),
-             jnp.arange(K, dtype=jnp.int32)))
-        return (scores, vscores), trees
+            (scores, vscores), trees = jax.lax.scan(
+                per_class, (scores, vscores),
+                (jnp.swapaxes(g, 0, 1), jnp.swapaxes(h, 0, 1),
+                 jnp.arange(K, dtype=jnp.int32)))
+            return (scores, vscores), trees
+        return one_iteration
+
+    one_iteration = make_iteration(update_train=boosting_type != "rf")
 
     if not has_valid:
         vscores = jnp.zeros((1, K), jnp.float32)  # placeholder carry leaf
 
     best_metric, best_iter, since_best = np.inf, None, 0
-    use_full_scan = not (has_valid and early_stopping_round > 0) and not callbacks
+    use_full_scan = (not (has_valid and early_stopping_round > 0)
+                     and not callbacks and boosting_type != "dart")
+
+    def check_early_stop(it, vscores, on_best=None) -> bool:
+        """Shared early-stopping bookkeeping; returns True to stop."""
+        nonlocal best_metric, best_iter, since_best
+        if not (has_valid and early_stopping_round > 0):
+            return False
+        v_eval = vscores
+        if boosting_type == "rf":
+            # rf predicts the AVERAGE of trees: metric on init + mean
+            v_eval = jnp.asarray(init)[None, :] + \
+                (vscores - jnp.asarray(init)[None, :]) / (it + 1)
+        m = float(vmetric(v_eval, vy))
+        if verbose:
+            print(f"[{it}] valid {o.metric_name}={m:.6f}")
+        if m < best_metric - 1e-12:
+            best_metric, best_iter, since_best = m, it + 1, 0
+            if on_best is not None:
+                on_best()
+        else:
+            since_best += 1
+            if since_best >= early_stopping_round:
+                return True
+        return False
+
+    def forest_delta(feat_s, thr_s, val_s, data_bins):
+        """Summed per-class outputs of a stack of trees: (D, K, M) -> (N, K)."""
+        def one(acc, tkm):
+            fe, th, va = tkm
+
+            def per_k(c, fkv):
+                f1, t1, v1 = fkv
+                tree = T.TreeArrays(f1, t1, v1, v1, v1)  # gain/cover unused
+                return c, T.traverse_binned(data_bins, tree, max_depth)
+
+            _, deltas = jax.lax.scan(per_k, 0, (fe, th, va))  # (K, N)
+            return acc + jnp.swapaxes(deltas, 0, 1), None
+
+        out0 = jnp.zeros((data_bins.shape[0], K), jnp.float32)
+        out, _ = jax.lax.scan(one, out0, (feat_s, thr_s, val_s))
+        return out
 
     if use_full_scan:
         # no per-iteration host decision needed: the ENTIRE training run is
@@ -363,39 +482,120 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
             return jax.lax.scan(one_iteration, (scores, vscores),
                                 jnp.arange(num_iterations, dtype=jnp.int32))
 
-        (scores, vscores), trees = run_all(scores, vscores)
+        with measures.measure("training"):
+            (scores, vscores), trees = run_all(scores, vscores)
+            jax.block_until_ready(trees.feature)
+        measures.count("iterations", num_iterations)
         feat_dev, thr_dev = trees.feature, trees.threshold_bin   # (T, K, M)
-        val_dev, gain_dev = trees.leaf_value, trees.gain
+        val_dev, gain_dev, cover_dev = trees.leaf_value, trees.gain, trees.cover
+    elif boosting_type == "dart":
+        # DART (tree dropout): per iteration, drop a random subset of grown
+        # trees, fit against the reduced scores, then renormalize — new tree
+        # by 1/(k+1), dropped trees by k/(k+1). Inherently sequential (past
+        # trees mutate), so this always runs the host loop.
+        forest_delta_j = jax.jit(forest_delta)
+        dart_iter = jax.jit(make_iteration(update_train=False, update_valid=False))
+        drop_rng = np.random.default_rng(seed + 17)
+        acc_f, acc_t, acc_v, acc_g, acc_c = [], [], [], [], []
+        # later drops rescale EARLIER trees' leaf values in place, so the
+        # model measured at best_iter is only reproducible from a snapshot
+        best_v = None
+
+        def snapshot():
+            nonlocal best_v
+            best_v = list(acc_v)
+
+        for it in range(num_iterations):
+            dropped: list[int] = []
+            if acc_f and drop_rng.random() >= skip_drop:
+                mask = drop_rng.random(len(acc_f)) < drop_rate
+                dropped = [int(i) for i in np.nonzero(mask)[0][:max_drop]]
+                if not dropped:
+                    dropped = [int(drop_rng.integers(len(acc_f)))]
+            measures.count("iterations")
+            vdelta_drop = None
+            if dropped:
+                measures.count("trees_dropped", len(dropped))
+            if dropped:
+                fs = jnp.stack([acc_f[i] for i in dropped])
+                ts = jnp.stack([acc_t[i] for i in dropped])
+                vs = jnp.stack([acc_v[i] for i in dropped])
+                delta_drop = forest_delta_j(fs, ts, vs, bins)
+                scores_red = scores - delta_drop
+                if has_valid:
+                    vdelta_drop = forest_delta_j(fs, ts, vs, vbins)
+                    vscores = vscores - vdelta_drop
+            else:
+                scores_red = scores
+            _, trees = dart_iter((scores_red, vscores),
+                                 jnp.asarray(it, jnp.int32))
+            kd = len(dropped)
+            norm_new = 1.0 / (kd + 1)
+            delta_new = forest_delta_j(trees.feature[None], trees.threshold_bin[None],
+                                       trees.leaf_value[None], bins)
+            scores = scores_red + delta_new * norm_new
+            if has_valid:
+                vdelta_new = forest_delta_j(trees.feature[None],
+                                            trees.threshold_bin[None],
+                                            trees.leaf_value[None], vbins)
+                vscores = vscores + vdelta_new * norm_new
+            if dropped:
+                norm_drop = kd / (kd + 1.0)
+                for i in dropped:
+                    acc_v[i] = acc_v[i] * norm_drop
+                scores = scores + delta_drop * norm_drop
+                if has_valid:
+                    vscores = vscores + vdelta_drop * norm_drop
+            acc_f.append(trees.feature)
+            acc_t.append(trees.threshold_bin)
+            acc_v.append(trees.leaf_value * norm_new)
+            acc_g.append(trees.gain)
+            acc_c.append(trees.cover)
+            if callbacks:
+                for cb in callbacks:
+                    cb(iteration=it, scores=scores)
+            if check_early_stop(it, vscores, on_best=snapshot):
+                break
+        if best_iter is not None and best_v is not None:
+            # return exactly the model that was measured best: its trees with
+            # their scales AS OF that iteration
+            acc_f, acc_t = acc_f[:best_iter], acc_t[:best_iter]
+            acc_g, acc_c = acc_g[:best_iter], acc_c[:best_iter]
+            acc_v = best_v[:best_iter]
+        feat_dev = jnp.stack(acc_f)
+        thr_dev = jnp.stack(acc_t)
+        val_dev = jnp.stack(acc_v)
+        gain_dev = jnp.stack(acc_g)
+        cover_dev = jnp.stack(acc_c)
     else:
         iter_jit = jax.jit(one_iteration)
-        acc_f, acc_t, acc_v, acc_g = [], [], [], []
+        acc_f, acc_t, acc_v, acc_g, acc_c = [], [], [], [], []
         for it in range(num_iterations):
-            (scores, vscores), trees = iter_jit(
-                (scores, vscores), jnp.asarray(it, jnp.int32))
+            measures.count("iterations")
+            with measures.measure("training"):
+                (scores, vscores), trees = iter_jit(
+                    (scores, vscores), jnp.asarray(it, jnp.int32))
             # device arrays accumulate WITHOUT host sync; fetched once at the end
             acc_f.append(trees.feature)
             acc_t.append(trees.threshold_bin)
             acc_v.append(trees.leaf_value)
             acc_g.append(trees.gain)
+            acc_c.append(trees.cover)
             if callbacks:
                 for cb in callbacks:
                     cb(iteration=it, scores=scores)
-            if has_valid and early_stopping_round > 0:
-                m = float(vmetric(vscores, vy))
-                if verbose:
-                    print(f"[{it}] valid {o.metric_name}={m:.6f}")
-                if m < best_metric - 1e-12:
-                    best_metric, best_iter, since_best = m, it + 1, 0
-                else:
-                    since_best += 1
-                    if since_best >= early_stopping_round:
-                        break
+            if check_early_stop(it, vscores):
+                break
+        with measures.measure("training"):
+            jax.block_until_ready(acc_f[-1])  # fold trailing async into the window
         feat_dev = jnp.stack(acc_f)
         thr_dev = jnp.stack(acc_t)
         val_dev = jnp.stack(acc_v)
         gain_dev = jnp.stack(acc_g)
+        cover_dev = jnp.stack(acc_c)
 
     # ONE host transfer for the whole forest; bin->value thresholds on host
+    measures.mark("train_done")
     ub = mapper.upper_bound_values()
     feat_h = np.asarray(feat_dev)
     thr_bin_h = np.asarray(thr_dev)
@@ -404,9 +604,13 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
 
     booster = TpuBooster(
         feat_h, thr_val_h, np.asarray(val_dev), np.asarray(gain_dev),
+        cover=np.asarray(cover_dev),
         max_depth=max_depth, num_model_out=K, objective=o.name, init_score=init,
         num_features=f, best_iteration=best_iter,
+        average_output=boosting_type == "rf",
         params={"num_iterations": num_iterations, "learning_rate": learning_rate,
-                "num_leaves": num_leaves, "max_bin": max_bin})
+                "num_leaves": num_leaves, "max_bin": max_bin,
+                "boosting_type": boosting_type})
     booster.bin_mapper = mapper
+    booster.train_measures = measures.to_dict()
     return booster

@@ -67,6 +67,18 @@ class _LightGBMParams:
                              converter=TypeConverters.to_float)
     bagging_freq = Param("bagging_freq", "bagging every k iterations (0=off)",
                          default=0, converter=TypeConverters.to_int)
+    boosting_type = Param("boosting_type", "gbdt | goss | dart | rf "
+                          "(reference boostingType)", default="gbdt")
+    top_rate = Param("top_rate", "goss: keep fraction by |grad|", default=0.2,
+                     converter=TypeConverters.to_float)
+    other_rate = Param("other_rate", "goss: sample fraction of the rest",
+                       default=0.1, converter=TypeConverters.to_float)
+    drop_rate = Param("drop_rate", "dart: per-tree dropout probability",
+                      default=0.1, converter=TypeConverters.to_float)
+    max_drop = Param("max_drop", "dart: max trees dropped per iteration",
+                     default=50, converter=TypeConverters.to_int)
+    skip_drop = Param("skip_drop", "dart: probability of skipping dropout",
+                      default=0.5, converter=TypeConverters.to_float)
     early_stopping_round = Param("early_stopping_round", "stop after k rounds without "
                                  "validation improvement (0=off)", default=0,
                                  converter=TypeConverters.to_int)
@@ -124,6 +136,10 @@ class _LightGBMParams:
             bagging_fraction=self.get("bagging_fraction"),
             bagging_freq=self.get("bagging_freq"),
             early_stopping_round=self.get("early_stopping_round"),
+            boosting_type=self.get("boosting_type"),
+            top_rate=self.get("top_rate"), other_rate=self.get("other_rate"),
+            drop_rate=self.get("drop_rate"), max_drop=self.get("max_drop"),
+            skip_drop=self.get("skip_drop"),
             seed=self.get("seed"),
             verbose=self.get("verbosity") > 0,
             mesh=self._mesh(),
@@ -132,9 +148,29 @@ class _LightGBMParams:
 
 class _LightGBMModelBase(Model, _LightGBMParams):
     booster = ComplexParam("booster", "trained TpuBooster")
+    features_shap_col = Param("features_shap_col", "when set, adds per-row "
+                              "TreeSHAP contributions (F features + bias; "
+                              "reference featuresShap)", default=None)
 
     def get_booster(self):
         return self.get("booster")
+
+    def get_train_measures(self) -> dict:
+        """Per-phase training instrumentation (reference
+        ``TaskInstrumentationMeasures``, ``LightGBMPerformance.scala``)."""
+        return getattr(self.get_booster(), "train_measures", {})
+
+    def predict_contrib(self, features) -> np.ndarray:
+        """Exact TreeSHAP contributions (N, K, F+1) — reference
+        ``LightGBMBooster.featuresShap`` surface."""
+        return self.get_booster().predict_contrib(features)
+
+    def _maybe_shap(self, out: dict, x) -> None:
+        col = self.get("features_shap_col")
+        if col:
+            contrib = self.get_booster().predict_contrib(x)
+            # single-output models emit (N, F+1); multiclass (N, K, F+1)
+            out[col] = contrib[:, 0, :] if contrib.shape[1] == 1 else contrib
 
     def get_feature_importances(self, importance_type: str = "split") -> np.ndarray:
         return self.get_booster().feature_importance(importance_type)
@@ -218,6 +254,7 @@ class LightGBMClassificationModel(_LightGBMModelBase):
             out[self.get("raw_prediction_col")] = raw
             out[self.get("probability_col")] = prob2
             out[self.get("prediction_col")] = classes[pred_idx]
+            self._maybe_shap(out, x)
             return out
 
         return df.map_partitions(per_part)
@@ -265,8 +302,10 @@ class LightGBMRegressionModel(_LightGBMModelBase):
 
         def per_part(part):
             sub = DataFrame([part])
+            x = self._features(sub)
             out = dict(part)
-            out[self.get("prediction_col")] = b.predict(self._features(sub))
+            out[self.get("prediction_col")] = b.predict(x)
+            self._maybe_shap(out, x)
             return out
 
         return df.map_partitions(per_part)
@@ -318,8 +357,10 @@ class LightGBMRankerModel(_LightGBMModelBase):
 
         def per_part(part):
             sub = DataFrame([part])
+            x = self._features(sub)
             out = dict(part)
-            out[self.get("prediction_col")] = b.predict(self._features(sub))
+            out[self.get("prediction_col")] = b.predict(x)
+            self._maybe_shap(out, x)
             return out
 
         return df.map_partitions(per_part)

@@ -56,6 +56,7 @@ class TreeArrays(NamedTuple):
     threshold_bin: jax.Array  # (M,) int32, split: bin <= thr goes left
     leaf_value: jax.Array  # (M,) float32
     gain: jax.Array  # (M,) float32, split gain (0 at leaves) — feeds importance
+    cover: jax.Array  # (M,) float32, rows reaching the node — feeds TreeSHAP
 
 
 def max_nodes(max_depth: int) -> int:
@@ -108,7 +109,7 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
 
     @jax.jit
     def step(bins, grad, hess, presence, node_of_row, feature, threshold_bin,
-             leaf_value, node_gain, feat_mask, leaf_count):
+             leaf_value, node_gain, node_cover, feat_mask, leaf_count):
         hist = _level_histogram(bins, grad, hess, presence, node_of_row, base, width, B)
         cum = jnp.cumsum(hist, axis=2)  # (W, F, B, 3)
         total = cum[:, 0, -1, :]  # (W, 3) — feature 0's full sum == node totals
@@ -150,6 +151,7 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
         value = _leaf_value(g_tot, h_tot, cfg)
         leaf_value = leaf_value.at[node_ids].set(jnp.where(active & ~do_split, value, 0.0))
         node_gain = node_gain.at[node_ids].set(jnp.where(do_split, best_gain, 0.0))
+        node_cover = node_cover.at[node_ids].set(c_tot)
         leaf_count = leaf_count + jnp.sum(do_split.astype(jnp.int32))
 
         # partition rows of split nodes to children
@@ -161,7 +163,8 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
         go_left = row_bin.astype(jnp.int32) <= best_thr[rel]
         child = 2 * node_of_row + jnp.where(go_left, 1, 2)
         node_of_row = jnp.where(row_split, child, node_of_row)
-        return node_of_row, feature, threshold_bin, leaf_value, node_gain, leaf_count
+        return (node_of_row, feature, threshold_bin, leaf_value, node_gain,
+                node_cover, leaf_count)
 
     return step
 
@@ -171,7 +174,7 @@ def _make_final_level(base: int, width: int, cfg: GrowthConfig):
     just per-node g/h totals)."""
 
     @jax.jit
-    def step(grad, hess, presence, node_of_row, leaf_value):
+    def step(grad, hess, presence, node_of_row, leaf_value, node_cover):
         valid = (node_of_row >= base) & (node_of_row < base + width)
         rel = jnp.where(valid, node_of_row - base, 0)
         zero = jnp.zeros_like(grad)
@@ -181,7 +184,8 @@ def _make_final_level(base: int, width: int, cfg: GrowthConfig):
         active = tot[:, 2] > 0
         value = _leaf_value(tot[:, 0], tot[:, 1], cfg)
         node_ids = base + jnp.arange(width, dtype=jnp.int32)
-        return leaf_value.at[node_ids].set(jnp.where(active, value, 0.0))
+        return (leaf_value.at[node_ids].set(jnp.where(active, value, 0.0)),
+                node_cover.at[node_ids].set(tot[:, 2]))
 
     return step
 
@@ -204,16 +208,19 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, presence: jax.A
     threshold_bin = jnp.zeros(m, jnp.int32)
     leaf_value = jnp.zeros(m, jnp.float32)
     node_gain = jnp.zeros(m, jnp.float32)
+    node_cover = jnp.zeros(m, jnp.float32)
     node_of_row = jnp.zeros(bins.shape[0], jnp.int32)
     leaf_count = jnp.asarray(1, jnp.int32)
 
     steps, final = _level_steps(cfg)
     for step in steps:
-        node_of_row, feature, threshold_bin, leaf_value, node_gain, leaf_count = step(
+        (node_of_row, feature, threshold_bin, leaf_value, node_gain, node_cover,
+         leaf_count) = step(
             bins, grad, hess, presence, node_of_row, feature, threshold_bin,
-            leaf_value, node_gain, feat_mask, leaf_count)
-    leaf_value = final(grad, hess, presence, node_of_row, leaf_value)
-    return TreeArrays(feature, threshold_bin, leaf_value, node_gain)
+            leaf_value, node_gain, node_cover, feat_mask, leaf_count)
+    leaf_value, node_cover = final(grad, hess, presence, node_of_row,
+                                   leaf_value, node_cover)
+    return TreeArrays(feature, threshold_bin, leaf_value, node_gain, node_cover)
 
 
 @functools.partial(jax.jit, static_argnums=(2,))

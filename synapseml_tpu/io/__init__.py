@@ -17,10 +17,13 @@ from .http import (
     send_with_retries,
 )
 from .serving import ServingServer, serve_pipeline
+from .files import read_binary_files, read_image_files
+from .powerbi import PowerBIWriter
+from .distributed_serving import serve_pipeline_distributed
 
 __all__ = [
     "HTTPRequest", "HTTPResponse", "HTTPTransformer", "SimpleHTTPTransformer",
     "JSONInputParser", "JSONOutputParser", "CustomInputParser",
     "StringOutputParser", "AsyncHTTPClient", "send_with_retries",
-    "ServingServer", "serve_pipeline",
+    "ServingServer", "serve_pipeline", "read_binary_files", "read_image_files", "PowerBIWriter", "serve_pipeline_distributed",
 ]

@@ -1,0 +1,265 @@
+"""Distributed serving: per-worker HTTP servers + driver routing front.
+
+Reference: ``streaming/DistributedHTTPSource.scala:88-203`` — every executor
+runs a ``JVMSharedServer`` and requests are served wherever they land, with
+the driver service collecting worker endpoints
+(``DriverServiceUtils``, ``continuous/HTTPSourceV2.scala:132-202``). Here:
+
+  * ``worker_main`` — one OS process per partition-worker, running
+    ``serve_pipeline`` on its own port and registering (host, port) with the
+    driver registry;
+  * ``WorkerRegistry`` — the driver-side registration endpoint (worker list =
+    the routing table);
+  * ``RoutingFront`` — the one public port: forwards each request round-robin
+    to a live worker, skipping dead ones (the shared-server role).
+
+``serve_pipeline_distributed`` wires all three and returns the front.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["WorkerRegistry", "RoutingFront", "serve_pipeline_distributed",
+           "worker_main"]
+
+
+class WorkerRegistry:
+    """Driver-side worker registration (DriverServiceUtils analog): workers
+    POST {host, port, pid}; the routing table is the registered list."""
+
+    def __init__(self):
+        self._workers: list[dict] = []
+        self._lock = threading.Lock()
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                info = json.loads(self.rfile.read(n))
+                with registry._lock:
+                    registry._workers.append(info)
+                body = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def workers(self) -> list[dict]:
+        with self._lock:
+            return list(self._workers)
+
+    def wait_for(self, n: int, timeout_s: float = 60.0) -> list[dict]:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            w = self.workers()
+            if len(w) >= n:
+                return w
+            time.sleep(0.05)
+        raise TimeoutError(f"only {len(self.workers())}/{n} workers registered")
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RoutingFront:
+    """One public port; round-robin forwarding to live workers. A worker that
+    fails a request is marked dead and the request retried on the next one."""
+
+    def __init__(self, workers: list[dict], port: int = 0,
+                 timeout_s: float = 60.0):
+        self._workers = list(workers)
+        self._dead: set[int] = set()
+        self._rr = 0
+        self._lock = threading.Lock()
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _forward(self, method: str):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else None
+                for _ in range(len(front._workers)):
+                    idx = front._next_worker()
+                    if idx is None:
+                        break
+                    w = front._workers[idx]
+                    url = f"http://{w['host']}:{w['port']}{self.path}"
+                    req = urllib.request.Request(url, data=body, method=method,
+                                                 headers={k: v for k, v in
+                                                          self.headers.items()
+                                                          if k.lower() != "host"})
+                    try:
+                        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                            payload = r.read()
+                            self.send_response(r.status)
+                            self.send_header("Content-Length", str(len(payload)))
+                            self.send_header("X-Served-By", str(w.get("pid", idx)))
+                            self.end_headers()
+                            self.wfile.write(payload)
+                            return
+                    except urllib.error.HTTPError as e:
+                        payload = e.read()
+                        self.send_response(e.code)
+                        self.send_header("Content-Length", str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                        return
+                    except (urllib.error.URLError, OSError):
+                        with front._lock:
+                            front._dead.add(idx)  # skip it from now on
+                self.send_response(503)
+                self.end_headers()
+
+            def do_GET(self):
+                self._forward("GET")
+
+            def do_POST(self):
+                self._forward("POST")
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _next_worker(self) -> int | None:
+        with self._lock:
+            n = len(self._workers)
+            for _ in range(n):
+                idx = self._rr % n
+                self._rr += 1
+                if idx not in self._dead:
+                    return idx
+        return None
+
+    @property
+    def address(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def worker_main(pipeline_path: str, registry_address: str,
+                batch_interval_ms: int = 0) -> None:
+    """Worker process entry: load the pickled pipeline, serve it, register,
+    then park forever (the per-executor server loop)."""
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    from .serving import serve_pipeline
+
+    with open(pipeline_path, "rb") as f:
+        pipeline = pickle.load(f)
+    server = serve_pipeline(pipeline, batch_interval_ms=batch_interval_ms)
+    info = {"host": server.host, "port": server.port, "pid": os.getpid()}
+    urllib.request.urlopen(urllib.request.Request(
+        registry_address, data=json.dumps(info).encode(), method="POST",
+        headers={"Content-Type": "application/json"}), timeout=30).read()
+    print(f"worker ready {info}", flush=True)
+    while True:  # killed by the parent
+        time.sleep(1.0)
+
+
+class DistributedServing:
+    """Handle owning the registry, worker processes, and routing front."""
+
+    def __init__(self, front: RoutingFront, registry: WorkerRegistry,
+                 procs: list, tmp_file: str):
+        self.front = front
+        self.registry = registry
+        self.procs = procs
+        self._tmp_file = tmp_file
+
+    @property
+    def address(self) -> str:
+        return self.front.address
+
+    def stop(self) -> None:
+        self.front.close()
+        self.registry.close()
+        for p in self.procs:
+            p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        try:
+            os.unlink(self._tmp_file)
+        except OSError:
+            pass
+
+
+def serve_pipeline_distributed(pipeline, num_workers: int = 2,
+                               batch_interval_ms: int = 0,
+                               startup_timeout_s: float = 90.0) -> DistributedServing:
+    """Serve a (picklable) Transformer across ``num_workers`` OS processes
+    behind one routed public port — the DistributedHTTPSource analog."""
+    import tempfile
+
+    fd, path = tempfile.mkstemp(suffix=".pipeline.pkl")
+    with os.fdopen(fd, "wb") as f:
+        pickle.dump(pipeline, f)
+
+    registry = WorkerRegistry()
+    code = ("from synapseml_tpu.io.distributed_serving import worker_main; "
+            f"worker_main({path!r}, {registry.address + '/register'!r}, "
+            f"{batch_interval_ms})")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    paths = [repo_root]
+    # unpickling user-defined Transformer classes in the worker needs their
+    # defining module importable
+    cls_mod = sys.modules.get(type(pipeline).__module__)
+    mod_file = getattr(cls_mod, "__file__", None)
+    if mod_file:
+        paths.append(os.path.dirname(os.path.abspath(mod_file)))
+    env["PYTHONPATH"] = os.pathsep.join(paths + [env.get("PYTHONPATH", "")])
+    procs = [subprocess.Popen([sys.executable, "-c", code], env=env)
+             for _ in range(num_workers)]
+    try:
+        workers = registry.wait_for(num_workers, timeout_s=startup_timeout_s)
+    except TimeoutError:
+        for p in procs:
+            p.terminate()
+        registry.close()
+        raise
+    front = RoutingFront(workers)
+    return DistributedServing(front, registry, procs, path)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,93 @@
+"""Binary / image file data sources.
+
+Reference: ``core/.../io/binary/BinaryFileFormat.scala`` (binary-file
+DataSource: path/length/modificationTime/content rows) and
+``org/apache/spark/ml/source/image/PatchedImageFileFormat.scala`` (image data
+source decoding to the Spark image schema). Here the rows land in the columnar
+DataFrame plane; images decode to [H, W, C] uint8 numpy (the layout
+``image.ImageTransformer`` consumes).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import io as _io
+import os
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+
+__all__ = ["read_binary_files", "read_image_files"]
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tif", ".tiff", ".webp")
+
+
+def _resolve_paths(path: str, recursive: bool, exts: tuple[str, ...] | None) -> list[str]:
+    if os.path.isdir(path):
+        pattern = os.path.join(path, "**" if recursive else "", "*")
+        paths = _glob.glob(pattern, recursive=recursive)
+    else:
+        paths = _glob.glob(path, recursive=recursive)
+    out = [p for p in paths if os.path.isfile(p)]
+    if exts is not None:
+        out = [p for p in out if p.lower().endswith(exts)]
+    return sorted(out)
+
+
+def _partitioned(rows: list[dict], num_partitions: int) -> DataFrame:
+    if not rows:
+        return DataFrame.from_rows([], num_partitions=1)
+    return DataFrame.from_rows(rows, num_partitions=min(num_partitions, len(rows)))
+
+
+def read_binary_files(path: str, recursive: bool = True, num_partitions: int = 1,
+                      extensions: tuple[str, ...] | None = None) -> DataFrame:
+    """Directory/glob -> rows of (path, length, modification_time, content).
+
+    The ``BinaryFileFormat`` schema; ``content`` is raw bytes."""
+    rows = []
+    for p in _resolve_paths(path, recursive, extensions):
+        st = os.stat(p)
+        with open(p, "rb") as f:
+            content = f.read()
+        rows.append({"path": os.path.abspath(p), "length": st.st_size,
+                     "modification_time": st.st_mtime, "content": content})
+    return _partitioned(rows, num_partitions)
+
+
+def decode_image_bytes(data: bytes) -> np.ndarray:
+    """bytes -> [H, W, C] uint8 (RGB; grayscale promoted to 3 channels)."""
+    from PIL import Image
+
+    img = Image.open(_io.BytesIO(data))
+    if img.mode not in ("RGB", "L"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = np.stack([arr] * 3, axis=-1)
+    return arr.astype(np.uint8)
+
+
+def read_image_files(path: str, recursive: bool = True, num_partitions: int = 1,
+                     drop_invalid: bool = True) -> DataFrame:
+    """Directory/glob -> rows of (path, image, height, width, channels).
+
+    ``image`` is [H, W, C] uint8 — directly consumable by
+    ``image.ImageTransformer`` (the PatchedImageFileFormat role)."""
+    rows = []
+    for p in _resolve_paths(path, recursive, _IMAGE_EXTS):
+        with open(p, "rb") as f:
+            data = f.read()
+        try:
+            arr = decode_image_bytes(data)
+        except Exception:
+            if drop_invalid:
+                continue
+            rows.append({"path": os.path.abspath(p), "image": None,
+                         "height": 0, "width": 0, "channels": 0})
+            continue
+        rows.append({"path": os.path.abspath(p), "image": arr,
+                     "height": arr.shape[0], "width": arr.shape[1],
+                     "channels": arr.shape[2]})
+    return _partitioned(rows, num_partitions)

@@ -58,9 +58,11 @@ class ServingServer:
     """(ref ``HTTPSourceV2``/``DistributedHTTPSource``)"""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 reply_timeout_s: float = 30.0):
+                 reply_timeout_s: float = 30.0, max_queue: int = 4096):
         self.reply_timeout_s = reply_timeout_s
-        self._queue: "queue.Queue[_Exchange]" = queue.Queue()
+        # bounded: a stalled pipeline sheds load with 503s instead of parking
+        # unbounded connections (backpressure the round-1 loop lacked)
+        self._queue: "queue.Queue[_Exchange]" = queue.Queue(maxsize=max_queue)
         self._pending: dict[str, _Exchange] = {}
         self._lock = threading.Lock()
         outer = self
@@ -76,7 +78,14 @@ class ServingServer:
                                dict(self.headers), body)
                 with outer._lock:
                     outer._pending[ex.request_id] = ex
-                outer._queue.put(ex)
+                try:
+                    outer._queue.put_nowait(ex)
+                except queue.Full:
+                    with outer._lock:
+                        outer._pending.pop(ex.request_id, None)
+                    self.send_response(503)  # shed load under backpressure
+                    self.end_headers()
+                    return
                 ok = ex.reply_event.wait(outer.reply_timeout_s)
                 with outer._lock:
                     outer._pending.pop(ex.request_id, None)
@@ -128,7 +137,11 @@ class ServingServer:
         except queue.Empty:
             pass
         if not exchanges:
-            return DataFrame([{}])
+            # schema'd empty batch (not an empty-dict partition, which breaks
+            # downstream schema checks)
+            empty = np.empty(0, dtype=object)
+            return DataFrame([{"id": empty, "method": empty.copy(),
+                               "path": empty.copy(), "body": empty.copy()}])
         ids = np.asarray([e.request_id for e in exchanges], dtype=object)
         return DataFrame([{
             "id": ids,
@@ -156,10 +169,12 @@ class ServingServer:
 
 def serve_pipeline(pipeline, port: int = 0, batch_interval_ms: int = 10,
                    input_col: str = "body", reply_col: str = "reply",
-                   parse_json: bool = True) -> ServingServer:
+                   parse_json: bool = True, num_threads: int = 1) -> ServingServer:
     """Run a Transformer as an HTTP service: request body -> ``input_col`` ->
     pipeline.transform -> ``reply_col`` -> response body. ``batch_interval_ms=0``
-    replies per-request (continuous mode)."""
+    replies per-request (continuous mode); ``num_threads`` transform loops
+    drain the queue concurrently (for pipelines that release the GIL or do
+    IO — the reference's concurrent continuous path)."""
     server = ServingServer(port=port).start()
 
     def loop():
@@ -192,6 +207,6 @@ def serve_pipeline(pipeline, port: int = 0, batch_interval_ms: int = 10,
                                                                   dtype=object))
                 server.reply_batch(fallback, reply_col=reply_col, status=500)
 
-    t = threading.Thread(target=loop, daemon=True)
-    t.start()
+    for _ in range(max(num_threads, 1)):
+        threading.Thread(target=loop, daemon=True).start()
     return server

@@ -279,24 +279,47 @@ class Trainer:
                            batch_stats=sd.get("batch_stats")), metrics)
 
     # ---- loop ----
+    def _flops_per_token(self, params) -> int:
+        n_params = sum(int(np.prod(np.shape(x))) for x in jax.tree.leaves(params))
+        return 6 * n_params  # fwd + bwd matmul FLOPs per token estimate
+
     def fit(self, state: TrainState, batch_iter: Iterator[dict], max_steps: int,
             log_every: int = 50, callback: Callable[[int, dict], None] | None = None
             ) -> TrainState:
+        from ..core.instrumentation import chip_peak_tflops
+
         t0 = time.perf_counter()
         n_samples = 0
+        n_tokens = 0
+        flops_per_token = self._flops_per_token(state.params)
+        dev = jax.devices()[0]
+        peak = (chip_peak_tflops(getattr(dev, "device_kind", "") or "")
+                if dev.platform == "tpu" else None)
         for i, batch in enumerate(batch_iter):
             if i >= max_steps:
                 break
             state, metrics = self.train_step(state, batch)
             first = next(iter(batch.values()))
             n_samples += int(np.shape(first)[0])
+            # the 6ND flops estimate is only meaningful for token models —
+            # count tokens from the id tensor, not an arbitrary batch entry
+            ids = batch.get("input_ids")
+            if ids is not None:
+                n_tokens += int(np.prod(np.shape(ids)))
             if callback is not None:
                 callback(i, metrics)
             if (i + 1) % log_every == 0:
                 loss = float(metrics["loss"])
                 dt = time.perf_counter() - t0
-                self._metrics.append({"step": i + 1, "loss": loss,
-                                      "samples_per_sec": n_samples / dt})
+                entry = {"step": i + 1, "loss": loss,
+                         "samples_per_sec": n_samples / dt}
+                if n_tokens:
+                    entry["model_tflops_per_sec"] = (flops_per_token * n_tokens
+                                                     / dt / 1e12)
+                    if peak:
+                        entry["mfu"] = round(entry["model_tflops_per_sec"]
+                                             / jax.device_count() / peak, 4)
+                self._metrics.append(entry)
         return state
 
     @property

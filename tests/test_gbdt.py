@@ -223,3 +223,206 @@ class TestSharded:
         b = train_booster(x, y, objective="binary", num_iterations=5,
                           learning_rate=0.3, mesh=mesh_dp8.mesh)
         assert ((b.predict(x) > 0.5) == y).mean() > 0.9
+
+
+# ---------------------------------------------------------------------------
+# TreeSHAP (reference featuresShap, booster/LightGBMBooster.scala:418)
+# ---------------------------------------------------------------------------
+
+def _expectation(feature, threshold, value, cover, x, known):
+    """Conditional expectation of a heap tree given the feature subset
+    ``known`` (the Shapley value function for trees)."""
+    def rec(node):
+        f = int(feature[node])
+        if f < 0:
+            return float(value[node])
+        left, right = 2 * node + 1, 2 * node + 2
+        if f in known:
+            go_left = x[f] <= threshold[node]
+            return rec(left if go_left else right)
+        c = max(float(cover[node]), 1e-12)
+        return (cover[left] / c) * rec(left) + (cover[right] / c) * rec(right)
+    return rec(0)
+
+
+def _brute_shapley(feature, threshold, value, cover, x, F):
+    import itertools, math
+    phi = np.zeros(F + 1)
+    full = set(range(F))
+    for i in range(F):
+        others = full - {i}
+        for r in range(len(others) + 1):
+            for S in itertools.combinations(sorted(others), r):
+                S = set(S)
+                w = (math.factorial(len(S)) * math.factorial(F - len(S) - 1)
+                     / math.factorial(F))
+                phi[i] += w * (_expectation(feature, threshold, value, cover, x, S | {i})
+                               - _expectation(feature, threshold, value, cover, x, S))
+    phi[F] = _expectation(feature, threshold, value, cover, x, set())
+    return phi
+
+
+def test_treeshap_matches_bruteforce_shapley():
+    """forest_shap == exact Shapley values computed by subset enumeration."""
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    rs = np.random.default_rng(5)
+    N, F = 400, 3
+    X = rs.normal(size=(N, F))
+    y = (2 * X[:, 0] - X[:, 1] + 0.5 * X[:, 0] * X[:, 2]
+         + 0.1 * rs.normal(size=N)).astype(np.float32)
+    b = train_booster(X, y, objective="regression", num_iterations=5,
+                      learning_rate=0.5, num_leaves=8, max_depth=3)
+    contrib = b.predict_contrib(X[:4])
+    for i in range(4):
+        want = np.zeros(F + 1)
+        for t in range(b.num_iterations):
+            want += _brute_shapley(b.feature[t, 0], b.threshold_value[t, 0],
+                                   b.leaf_value[t, 0], b.cover[t, 0], X[i], F)
+        want[F] += float(b.init_score[0])
+        np.testing.assert_allclose(contrib[i, 0], want, atol=1e-5)
+
+
+def test_treeshap_additivity_and_duplicate_features():
+    """sum(contrib) == raw_score even with repeated features on a path
+    (deep trees split the same feature multiple times)."""
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    rs = np.random.default_rng(6)
+    N, F = 500, 2
+    X = rs.normal(size=(N, F))
+    y = (np.sin(2 * X[:, 0]) + 0.3 * X[:, 1]).astype(np.float32)  # needs repeated splits on f0
+    b = train_booster(X, y, objective="regression", num_iterations=10,
+                      learning_rate=0.3, num_leaves=16, max_depth=5)
+    Xt = X[:50]
+    contrib = b.predict_contrib(Xt)
+    np.testing.assert_allclose(contrib[:, 0, :].sum(-1), b.raw_score(Xt)[:, 0],
+                               atol=1e-4)
+
+
+def test_treeshap_multiclass_and_model_column():
+    import synapseml_tpu as st
+    from synapseml_tpu.gbdt import LightGBMClassifier
+
+    rs = np.random.default_rng(7)
+    N, F = 300, 4
+    X = rs.normal(size=(N, F))
+    y = np.argmax(X[:, :3] + 0.3 * rs.normal(size=(N, 3)), axis=1)
+    df = st.DataFrame.from_rows(
+        [{"features": X[i], "label": int(y[i])} for i in range(N)])
+    model = LightGBMClassifier(num_iterations=8, learning_rate=0.3).fit(df)
+    model.set(features_shap_col="shap")
+    out = model.transform(df)
+    shap_col = np.stack(list(out.collect_column("shap")))
+    assert shap_col.shape == (N, 3, F + 1)
+    raw = np.stack(list(out.collect_column("rawPrediction")))
+    np.testing.assert_allclose(shap_col.sum(-1), raw, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# boosting modes (reference params/LightGBMParams.scala boostingType)
+# ---------------------------------------------------------------------------
+
+def _mode_dataset(seed=8, n=800):
+    rs = np.random.default_rng(seed)
+    X = rs.normal(size=(n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+@pytest.mark.parametrize("mode", ["goss", "dart", "rf"])
+def test_boosting_modes_accuracy(mode):
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    X, y = _mode_dataset()
+    kw = dict(objective="binary", num_iterations=30, num_leaves=15, seed=0)
+    if mode == "rf":
+        kw.update(bagging_fraction=0.7, bagging_freq=1, num_iterations=40)
+    else:
+        kw.update(learning_rate=0.2)
+    b = train_booster(X, y, boosting_type=mode, **kw)
+    acc = float(np.mean((b.predict(X) >= 0.5) == y))
+    assert acc > 0.9, f"{mode} acc={acc}"
+    assert b.params["boosting_type"] == mode
+    if mode == "rf":
+        assert b.average_output
+        # averaged output keeps probabilities calibrated-ish (not summed blowup)
+        p = b.predict(X)
+        assert 0.0 < p.mean() < 1.0
+
+
+def test_dart_additivity_after_rescaling():
+    """DART mutates past trees; prediction from stored arrays must equal the
+    training-time running scores (consistency of the normalization)."""
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    X, y = _mode_dataset(seed=9, n=300)
+    b = train_booster(X, y, objective="binary", boosting_type="dart",
+                      num_iterations=12, learning_rate=0.3, num_leaves=7,
+                      drop_rate=0.4, skip_drop=0.2, seed=3)
+    # TreeSHAP additivity also exercises cover+scaled leaves coherently
+    contrib = b.predict_contrib(X[:20])
+    np.testing.assert_allclose(contrib[:, 0, :].sum(-1), b.raw_score(X[:20])[:, 0],
+                               atol=1e-4)
+
+
+def test_rf_with_early_stopping():
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    X, y = _mode_dataset(seed=10)
+    b = train_booster(X[:600], y[:600], objective="binary", boosting_type="rf",
+                      bagging_fraction=0.7, bagging_freq=1, num_iterations=40,
+                      valid_features=X[600:], valid_labels=y[600:],
+                      early_stopping_round=5)
+    acc = float(np.mean((b.predict(X[600:]) >= 0.5) == y[600:]))
+    assert acc > 0.85
+
+
+def test_train_measures_instrumentation():
+    """Per-phase timing travels with the model (reference
+    TaskInstrumentationMeasures, LightGBMPerformance.scala)."""
+    import synapseml_tpu as st
+    from synapseml_tpu.gbdt import LightGBMRegressor
+
+    rs = np.random.default_rng(11)
+    X = rs.normal(size=(200, 3))
+    y = X[:, 0].astype(np.float32)
+    df = st.DataFrame.from_rows(
+        [{"features": X[i], "label": float(y[i])} for i in range(200)])
+    model = LightGBMRegressor(num_iterations=5).fit(df)
+    m = model.get_train_measures()
+    assert m["iterations_count"] == 5
+    assert m["binning_ms"] > 0 and m["training_ms"] > 0
+    assert m["total_ms"] >= m["training_ms"]
+
+
+def test_rf_shap_additivity():
+    """rf averages trees; SHAP must scale accordingly (review regression)."""
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    X, y = _mode_dataset(seed=12, n=300)
+    b = train_booster(X, y, objective="binary", boosting_type="rf",
+                      bagging_fraction=0.7, bagging_freq=1, num_iterations=10)
+    contrib = b.predict_contrib(X[:20])
+    np.testing.assert_allclose(contrib[:, 0, :].sum(-1), b.raw_score(X[:20])[:, 0],
+                               atol=1e-4)
+
+
+def test_dart_early_stopping_returns_measured_model():
+    """With DART + early stopping, the returned trees must reproduce the
+    validation scores that selected best_iteration (later drop-normalizations
+    must not leak into the returned model)."""
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    X, y = _mode_dataset(seed=13, n=600)
+    b = train_booster(X[:400], y[:400], objective="binary", boosting_type="dart",
+                      num_iterations=25, learning_rate=0.3, drop_rate=0.5,
+                      skip_drop=0.1, valid_features=X[400:], valid_labels=y[400:],
+                      early_stopping_round=3, seed=5)
+    assert b.best_iteration is not None
+    # stored forest is trimmed to the best iteration with snapshot leaf scales
+    assert b.feature.shape[0] == b.best_iteration
+    # additivity still holds on the snapshot
+    contrib = b.predict_contrib(X[:10])
+    np.testing.assert_allclose(contrib[:, 0, :].sum(-1), b.raw_score(X[:10])[:, 0],
+                               atol=1e-4)
