@@ -68,3 +68,7 @@ def tabular_df():
 @pytest.fixture()
 def regression_df():
     return make_tabular_df(classes=0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running (full-size model) tests")
