@@ -1,0 +1,35 @@
+# %% [markdown]
+# # ONNX inference without ONNX Runtime
+# `ONNXModel` converts the graph ONCE to a jittable JAX function that XLA
+# compiles for the device (the reference's per-partition OrtSession +
+# CUDA EP, onnx/ONNXModel.scala:145-423). Here: a small MLP built with the
+# in-repo proto writer; real exported graphs work the same
+# (see tests/test_onnx_resnet.py for a genuine torch ResNet-50 export).
+
+# %%
+import numpy as np
+
+import synapseml_tpu as st
+from synapseml_tpu.onnx import (
+    AttributeProto, GraphProto, ModelProto, NodeProto, ONNXModel,
+    ValueInfoProto, numpy_to_tensor,
+)
+from synapseml_tpu.onnx import proto as P
+
+rs = np.random.default_rng(1)
+W = rs.normal(size=(4, 3)).astype(np.float32)
+node = NodeProto(input=["x", "W"], output=["logits"], op_type="MatMul")
+g = GraphProto(name="mlp", node=[node],
+               initializer=[numpy_to_tensor(W, "W")],
+               input=[ValueInfoProto(name="x", elem_type=P.FLOAT, dims=["N", 4])],
+               output=[ValueInfoProto(name="logits", elem_type=P.FLOAT, dims=["N", 3])])
+model_bytes = ModelProto(graph=g).encode()
+
+df = st.DataFrame.from_dict({"feat": rs.normal(size=(10, 4)).astype(np.float32)})
+om = ONNXModel(model_bytes=model_bytes, mini_batch_size=4,
+               feed_dict={"x": "feat"}, fetch_dict={"logits": "logits"},
+               softmax_dict={"logits": "probs"}, argmax_dict={"logits": "pred"})
+out = om.transform(df)
+probs = np.stack(list(out.collect_column("probs")))
+assert probs.shape == (10, 3) and np.allclose(probs.sum(-1), 1.0, atol=1e-5)
+print("predictions:", out.collect_column("pred").tolist())

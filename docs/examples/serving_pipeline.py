@@ -1,0 +1,37 @@
+# %% [markdown]
+# # Sub-millisecond model serving
+# Spark Serving's HTTP source/sink (streaming/HTTPSourceV2.scala) as a
+# threaded server: requests become DataFrame rows, the pipeline transforms
+# them, replies route back by request id. `serve_pipeline_distributed` runs
+# the same thing across worker OS processes behind one routed port.
+
+# %%
+import json
+import urllib.request
+
+import numpy as np
+
+from synapseml_tpu.core.pipeline import Transformer
+from synapseml_tpu.io import serve_pipeline
+
+
+class Doubler(Transformer):
+    def _transform(self, df):
+        def per_part(p):
+            out = dict(p)
+            out["reply"] = np.asarray(
+                [{"doubled": (b or {}).get("x", 0) * 2} for b in p["body"]],
+                dtype=object)
+            return out
+
+        return df.map_partitions(per_part)
+
+
+server = serve_pipeline(Doubler(), batch_interval_ms=0)  # continuous mode
+req = urllib.request.Request(server.address, data=json.dumps({"x": 21}).encode(),
+                             method="POST")
+with urllib.request.urlopen(req, timeout=30) as r:
+    reply = json.loads(r.read())
+print("reply:", reply)
+assert reply == {"doubled": 42}
+server.stop()

@@ -286,45 +286,61 @@ class Trainer:
     def fit(self, state: TrainState, batch_iter: Iterator[dict], max_steps: int,
             log_every: int = 50, callback: Callable[[int, dict], None] | None = None
             ) -> TrainState:
-        from ..core.instrumentation import chip_peak_tflops
-
-        t0 = time.perf_counter()
-        n_samples = 0
-        n_tokens = 0
-        flops_per_token = self._flops_per_token(state.params)
-        dev = jax.devices()[0]
-        peak = (chip_peak_tflops(getattr(dev, "device_kind", "") or "")
-                if dev.platform == "tpu" else None)
+        meter = _ThroughputMeter(self, state.params)
         for i, batch in enumerate(batch_iter):
             if i >= max_steps:
                 break
             state, metrics = self.train_step(state, batch)
-            first = next(iter(batch.values()))
-            n_samples += int(np.shape(first)[0])
-            # the 6ND flops estimate is only meaningful for token models —
-            # count tokens from the id tensor, not an arbitrary batch entry
-            ids = batch.get("input_ids")
-            if ids is not None:
-                n_tokens += int(np.prod(np.shape(ids)))
+            meter.observe(batch, steps=1)
             if callback is not None:
                 callback(i, metrics)
             if (i + 1) % log_every == 0:
-                loss = float(metrics["loss"])
-                dt = time.perf_counter() - t0
-                entry = {"step": i + 1, "loss": loss,
-                         "samples_per_sec": n_samples / dt}
-                if n_tokens:
-                    entry["model_tflops_per_sec"] = (flops_per_token * n_tokens
-                                                     / dt / 1e12)
-                    if peak:
-                        entry["mfu"] = round(entry["model_tflops_per_sec"]
-                                             / jax.device_count() / peak, 4)
-                self._metrics.append(entry)
+                self._metrics.append(meter.entry(float(metrics["loss"])))
         return state
 
     @property
     def metrics(self) -> list[dict]:
         return self._metrics
+
+
+class _ThroughputMeter:
+    """Shared samples/sec + 6ND TFLOP/s + MFU accounting for both the
+    per-step and scan-chunked fit loops. Tokens come from the ``input_ids``
+    tensor only — the estimate is meaningless for pixel inputs."""
+
+    def __init__(self, trainer: "Trainer", params):
+        from ..core.instrumentation import chip_peak_tflops
+
+        self.t0 = time.perf_counter()
+        self.steps = 0
+        self.n_samples = 0
+        self.n_tokens = 0
+        self.flops_per_token = trainer._flops_per_token(params)
+        dev = jax.devices()[0]
+        self.peak = (chip_peak_tflops(getattr(dev, "device_kind", "") or "")
+                     if dev.platform == "tpu" else None)
+
+    def observe(self, batch: dict, steps: int) -> None:
+        """``batch`` leaves are (B, ...) when steps==1, (K, B, ...) stacked
+        when steps==K."""
+        self.steps += steps
+        first = np.shape(next(iter(batch.values())))
+        self.n_samples += int(np.prod(first[: (2 if steps > 1 else 1)]))
+        ids = batch.get("input_ids")
+        if ids is not None:
+            self.n_tokens += int(np.prod(np.shape(ids)))
+
+    def entry(self, loss: float) -> dict:
+        dt = time.perf_counter() - self.t0
+        out = {"step": self.steps, "loss": loss,
+               "samples_per_sec": self.n_samples / dt}
+        if self.n_tokens:
+            out["model_tflops_per_sec"] = (self.flops_per_token * self.n_tokens
+                                           / dt / 1e12)
+            if self.peak:
+                out["mfu"] = round(out["model_tflops_per_sec"]
+                                   / jax.device_count() / self.peak, 4)
+        return out
 
 
 def plan_fit(n: int, batch_size: int, epochs: int, max_steps: int) -> tuple[int, int]:
@@ -339,11 +355,22 @@ def plan_fit(n: int, batch_size: int, epochs: int, max_steps: int) -> tuple[int,
 
 
 def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: int,
-               seed: int, init_params=None, init_batch_stats=None) -> "TrainState":
+               seed: int, init_params=None, init_batch_stats=None,
+               scan_chunk: int = 8) -> "TrainState":
     """Shared estimator fit loop: shuffling epochs over host arrays with
     mesh-aligned padded batches (one place for batch alignment, so any
     (batch_size, n, #devices) combination shards — batches are padded to a
-    multiple of the mesh data-parallel size and carry a ``_valid`` mask)."""
+    multiple of the mesh data-parallel size and carry a ``_valid`` mask).
+
+    Throughput design (SURVEY §7 step 4 — input pipeline is the hard part):
+    ``scan_chunk`` optimizer steps run in ONE ``lax.scan`` dispatch, and a
+    background thread assembles the NEXT stacked chunk while the device runs
+    the current one (double buffering) — host batch prep and device compute
+    overlap instead of alternating. ``scan_chunk=1`` falls back to the
+    per-step loop (needed for per-step callbacks)."""
+    import queue
+    import threading
+
     from ..parallel.batching import batches
 
     n = next(iter(data.values())).shape[0]
@@ -359,7 +386,73 @@ def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: 
                 yield {**b.data, "_valid": b.mask.astype(np.float32)}
 
     it = batch_iter()
-    state = trainer.init_state(next(it), jax.random.PRNGKey(seed),
+    first = next(it)
+    state = trainer.init_state(first, jax.random.PRNGKey(seed),
                                init_params=init_params,
                                init_batch_stats=init_batch_stats)
-    return trainer.fit(state, it, max_steps=total_steps)
+    if scan_chunk <= 1 or total_steps <= 1:
+        def chain():
+            yield first
+            yield from it
+
+        return trainer.fit(state, chain(), max_steps=total_steps)
+
+    # ---- chunked + prefetched path ----
+    def stack_chunk(bs: list[dict]) -> dict:
+        return {k: np.stack([b[k] for b in bs]) for k in bs[0]}
+
+    chunks: "queue.Queue" = queue.Queue(maxsize=2)  # double buffer
+    # only FULL chunks go through the scan program (one compile); the
+    # remainder runs per-step to avoid recompiling the whole scan for a
+    # one-off short leading dimension
+    n_full = total_steps // scan_chunk
+    remainder = total_steps - n_full * scan_chunk
+    stop = threading.Event()  # consumer died: unblock the producer
+
+    def producer():
+        try:
+            pending = [first]
+            for _ in range(n_full):
+                while len(pending) < scan_chunk:
+                    pending.append(next(it))
+                item = stack_chunk(pending[:scan_chunk])
+                pending = pending[scan_chunk:]
+                while not stop.is_set():
+                    try:
+                        chunks.put(item, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            tail = list(pending)
+            while len(tail) < remainder:
+                tail.append(next(it))
+            chunks.put(("tail", tail[:remainder]))
+        except BaseException as e:  # surface producer errors to the consumer
+            chunks.put(e)
+
+    threading.Thread(target=producer, daemon=True).start()
+
+    meter = _ThroughputMeter(trainer, state.params)
+    try:
+        for _ in range(n_full):
+            chunk = chunks.get()
+            if isinstance(chunk, BaseException):
+                raise chunk
+            state, metrics = trainer.train_steps_scan(state, chunk)
+            meter.observe(chunk, steps=scan_chunk)
+            trainer._metrics.append(
+                meter.entry(float(np.asarray(metrics["loss"])[-1])))
+        if remainder:
+            tail = chunks.get()
+            if isinstance(tail, BaseException):
+                raise tail
+            _, tail_batches = tail
+            for b in tail_batches:
+                state, metrics = trainer.train_step(state, b)
+                meter.observe(b, steps=1)
+            trainer._metrics.append(meter.entry(float(metrics["loss"])))
+    finally:
+        stop.set()
+    return state

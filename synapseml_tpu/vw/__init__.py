@@ -29,8 +29,11 @@ from .policyeval import (
     snips,
 )
 from .dsjson import VowpalWabbitDSJsonTransformer
+from .estimators import VowpalWabbitProgressive
+from .sync import SyncSchedule, SyncSchedulePassBoundary, SyncScheduleRowCount
 
 __all__ = [
+    "VowpalWabbitProgressive", "SyncSchedule", "SyncSchedulePassBoundary", "SyncScheduleRowCount",
     "VowpalWabbitFeaturizer",
     "VowpalWabbitClassifier",
     "VowpalWabbitClassificationModel",

@@ -24,6 +24,7 @@ __all__ = [
     "VowpalWabbitClassifier", "VowpalWabbitClassificationModel",
     "VowpalWabbitRegressor", "VowpalWabbitRegressionModel",
     "VowpalWabbitGeneric", "VowpalWabbitGenericModel",
+    "VowpalWabbitProgressive",
 ]
 
 
@@ -252,3 +253,61 @@ class VowpalWabbitGenericModel(_VWModelBase):
         if self.get("loss_function") == "logistic":
             raw = 1.0 / (1.0 + np.exp(-raw))
         return df.with_column(self.get("prediction_col"), raw)
+
+
+class VowpalWabbitProgressive(Estimator, _VWBaseParams):
+    """Progressive (streaming-eval) mode: fit() consumes rows IN ORDER, and
+    the returned model's training trace carries each row's one-step-ahead
+    prediction — the model's output for a row BEFORE learning from it
+    (reference ``VowpalWabbitBaseProgressive.scala``). ``transform_progressive``
+    does both in one shot, appending the progressive prediction column.
+
+    ``batch_size=1`` reproduces VW's strictly-online updates; larger batches
+    trade per-row fidelity for MXU throughput (rows inside a batch share the
+    pre-batch weights)."""
+
+    feature_name = "vw"
+
+    loss_function = Param("loss_function", "squared | logistic | hinge | quantile",
+                          default="squared")
+    progressive_col = Param("progressive_col", "one-step-ahead prediction column",
+                            default="progressive_prediction")
+
+    def transform_progressive(self, df: DataFrame) -> tuple[DataFrame, "VowpalWabbitRegressionModel"]:
+        """(df + progressive column, trained model)."""
+        from .learner import train_linear_progressive
+
+        idx, val = self._sparse(df)
+        self.require_columns(df, self.get("label_col"))
+        labels = np.asarray(df.collect_column(self.get("label_col")), np.float32)
+        if self.get("loss_function") == "logistic":
+            labels = np.where(labels > 0, 1.0, -1.0).astype(np.float32)
+        logistic = self.get("loss_function") == "logistic"
+        w, preds = train_linear_progressive(
+            idx, val, labels, self._config(self.get("loss_function")),
+            weights=self._weights_arr(df),
+            initial_weights=self.get("initial_model"))
+        if logistic:
+            # progressive outputs are probabilities for logistic loss
+            # (matching VowpalWabbitGenericModel's link function)
+            preds = 1.0 / (1.0 + np.exp(-preds))
+        offsets = np.cumsum([0] + [len(next(iter(p.values()))) for p in df.partitions])
+        parts = []
+        for i, p in enumerate(df.partitions):
+            q = dict(p)
+            q[self.get("progressive_col")] = preds[offsets[i]:offsets[i + 1]]
+            parts.append(q)
+        model_cls = (VowpalWabbitClassificationModel if logistic
+                     else VowpalWabbitRegressionModel)
+        model = model_cls(model_weights=w)
+        if logistic:
+            orig = np.unique(np.asarray(
+                df.collect_column(self.get("label_col"))))
+            model.set(classes=orig if len(orig) == 2 else np.asarray([0.0, 1.0]))
+        model.set(**{k: v for k, v in self._param_values.items()
+                     if model.has_param(k)})
+        return DataFrame(parts), model
+
+    def _fit(self, df: DataFrame):
+        _, model = self.transform_progressive(df)
+        return model

@@ -93,8 +93,14 @@ def _run_pass(w, acc, step0, idx, val, y, wt, cfg: LinearConfig, num_batches: in
 
 def train_linear(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
                  cfg: LinearConfig, weights: np.ndarray | None = None,
-                 initial_weights: np.ndarray | None = None) -> np.ndarray:
-    """Train and return the weight vector (2^bits,) as numpy."""
+                 initial_weights: np.ndarray | None = None,
+                 initial_state: tuple | None = None,
+                 return_state: bool = False):
+    """Train and return the weight vector (2^bits,) as numpy.
+
+    ``initial_state``/``return_state`` carry the (AdaGrad accumulator, step
+    counter) learner state across calls — partition-replica training syncs
+    weights at schedule boundaries but must NOT restart the lr schedule."""
     n = indices.shape[0]
     dim = 1 << cfg.num_bits
     if initial_weights is not None and np.shape(initial_weights) != (dim,):
@@ -102,12 +108,16 @@ def train_linear(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
                          f"({dim},) implied by num_bits={cfg.num_bits}")
     w = (jnp.asarray(initial_weights, jnp.float32) if initial_weights is not None
          else jnp.zeros(dim, jnp.float32))
-    acc = jnp.full(dim, 1e-8, jnp.float32)
+    if initial_state is not None:
+        acc = jnp.asarray(initial_state[0], jnp.float32)
+        step = jnp.asarray(initial_state[1], jnp.float32)
+    else:
+        acc = jnp.full(dim, 1e-8, jnp.float32)
+        step = jnp.asarray(0.0, jnp.float32)
     wt_np = np.ones(n, np.float32) if weights is None else np.asarray(weights, np.float32)
 
     bs = max(1, min(cfg.batch_size, n))
     rng = np.random.default_rng(cfg.seed)
-    step = jnp.asarray(0.0, jnp.float32)
     for _ in range(cfg.num_passes):
         order = rng.permutation(n)
         pad = (-n) % bs
@@ -120,9 +130,119 @@ def train_linear(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
         bw = jnp.asarray(wt_np[order] * (np.arange(len(order)) < n).astype(np.float32)
                          if pad else wt_np[order])
         w, acc, step = _run_pass(w, acc, step, bi, bv, by, bw, cfg, num_batches)
+    if return_state:
+        return np.asarray(w), (np.asarray(acc), float(step))
     return np.asarray(w)
 
 
 @functools.partial(jax.jit, static_argnames=())
 def linear_predict(w: jax.Array, idx: jax.Array, val: jax.Array) -> jax.Array:
     return jnp.sum(jnp.take(w, idx, axis=0) * val, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_batches"))
+def _run_pass_progressive(w, acc, step0, idx, val, y, wt, cfg: LinearConfig,
+                          num_batches: int):
+    """Like _run_pass, but also emits each batch's PRE-update predictions —
+    VW's progressive validation (one-step-ahead) output."""
+
+    def body(carry, batch):
+        w, acc, t = carry
+        bi, bv, by, bw = batch
+        pred = jnp.sum(jnp.take(w, bi, axis=0) * bv, axis=1)  # pre-update
+        g = _loss_grad(cfg.loss, pred, by, cfg.quantile_tau) * bw
+        lr = cfg.learning_rate / jnp.power(t + 1.0, cfg.power_t)
+        gv = g[:, None] * bv
+        if cfg.adaptive:
+            acc = acc.at[bi].add(gv * gv)
+            denom = jnp.sqrt(jnp.take(acc, bi, axis=0)) + 1e-8
+            upd = gv / denom
+        else:
+            upd = gv
+        w = w.at[bi].add(-lr * upd)
+        if cfg.l2 > 0.0:
+            w = w * (1.0 - lr * cfg.l2)
+        if cfg.l1 > 0.0:
+            w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - lr * cfg.l1, 0.0)
+        return (w, acc, t + 1.0), pred
+
+    batches = (idx.reshape(num_batches, -1, idx.shape[1]),
+               val.reshape(num_batches, -1, val.shape[1]),
+               y.reshape(num_batches, -1),
+               wt.reshape(num_batches, -1))
+    (w, acc, step), preds = jax.lax.scan(body, (w, acc, step0), batches)
+    return w, acc, step, preds.reshape(-1)
+
+
+def train_linear_progressive(indices: np.ndarray, values: np.ndarray,
+                             labels: np.ndarray, cfg: LinearConfig,
+                             weights: np.ndarray | None = None,
+                             initial_weights: np.ndarray | None = None
+                             ) -> tuple[np.ndarray, np.ndarray]:
+    """Streaming-order single pass returning (weights, one-step-ahead preds).
+
+    Reference: ``VowpalWabbitBaseProgressive.scala`` — transform-time online
+    training where every row's output is the model's prediction BEFORE that
+    row updates it. Rows are consumed in order (no shuffle); within a
+    minibatch all rows see the pre-batch weights (batch_size=1 reproduces
+    VW's strictly-online behavior)."""
+    n = indices.shape[0]
+    dim = 1 << cfg.num_bits
+    w = (jnp.asarray(initial_weights, jnp.float32) if initial_weights is not None
+         else jnp.zeros(dim, jnp.float32))
+    acc = jnp.full(dim, 1e-8, jnp.float32)
+    wt_np = np.ones(n, np.float32) if weights is None else np.asarray(weights, np.float32)
+
+    bs = max(1, min(cfg.batch_size, n))
+    pad = (-n) % bs
+    order = np.arange(n + pad) % n if pad else np.arange(n)
+    num_batches = len(order) // bs
+    mask = (np.arange(len(order)) < n).astype(np.float32)
+    w, acc, _, preds = _run_pass_progressive(
+        w, acc, jnp.asarray(0.0, jnp.float32),
+        jnp.asarray(indices[order]), jnp.asarray(values[order]),
+        jnp.asarray(np.asarray(labels, np.float32)[order]),
+        jnp.asarray(wt_np[order] * mask), cfg, num_batches)
+    return np.asarray(w), np.asarray(preds)[:n]
+
+
+def train_linear_partitioned(parts: list[tuple[np.ndarray, np.ndarray, np.ndarray]],
+                             cfg: LinearConfig, sync_schedule=None,
+                             initial_weights: np.ndarray | None = None) -> np.ndarray:
+    """Partition-replica training with weight averaging at schedule boundaries.
+
+    The explicit analog of VW's spanning-tree AllReduce driven by
+    ``VowpalWabbitSyncSchedule.scala:72``: each partition trains its own
+    replica between sync points; at each boundary the replicas all-reduce
+    (average). ``parts``: per-partition (indices, values, labels). The fused
+    GSPMD path (train_linear on sharded rows) syncs every minibatch and
+    strictly dominates; this exists for reference-semantics parity and for
+    DCN-limited topologies where sync frequency matters."""
+    from .sync import SyncSchedulePassBoundary
+
+    schedule = sync_schedule or SyncSchedulePassBoundary()
+    dim = 1 << cfg.num_bits
+    w = (np.asarray(initial_weights, np.float32) if initial_weights is not None
+         else np.zeros(dim, np.float32))
+    # windows cover the LARGEST partition so no partition's tail is dropped;
+    # learner state (AdaGrad acc, step) persists per partition across windows
+    # (weights average, state doesn't — matching VW, which AllReduces weights
+    # but keeps each node's learner state)
+    n_max = max(p[0].shape[0] for p in parts)
+    states: list[tuple | None] = [None] * len(parts)
+    for lo, hi in schedule.boundaries(n_max, cfg.num_passes):
+        replicas = []
+        for i, (idx, val, y) in enumerate(parts):
+            m = idx.shape[0]
+            s, e = min(lo, m), min(hi, m)
+            if s >= e:
+                replicas.append(w)
+                continue
+            sub_cfg = cfg._replace(num_passes=1)
+            wi, states[i] = train_linear(idx[s:e], val[s:e], y[s:e], sub_cfg,
+                                         initial_weights=w,
+                                         initial_state=states[i],
+                                         return_state=True)
+            replicas.append(wi)
+        w = np.mean(np.stack(replicas), axis=0)  # the AllReduce
+    return w

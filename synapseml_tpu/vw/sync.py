@@ -1,0 +1,41 @@
+"""Sync schedules for partition-replica training.
+
+Reference: ``VowpalWabbitSyncSchedule.scala:72`` — decides, by row count, when
+partitions AllReduce their weights between VW passes. Our fused GSPMD path
+syncs every minibatch (strictly stronger); these objects exist for the
+reference's explicit-schedule surface, used by
+``learner.train_linear_partitioned``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["SyncSchedule", "SyncSchedulePassBoundary", "SyncScheduleRowCount"]
+
+
+class SyncSchedule:
+    """Yields (row_lo, row_hi) training windows; replicas average after each."""
+
+    def boundaries(self, n_rows: int, num_passes: int):
+        raise NotImplementedError
+
+
+class SyncSchedulePassBoundary(SyncSchedule):
+    """One sync per pass over the data (the reference default)."""
+
+    def boundaries(self, n_rows: int, num_passes: int):
+        for _ in range(max(num_passes, 1)):
+            yield (0, n_rows)
+
+
+class SyncScheduleRowCount(SyncSchedule):
+    """Sync every ``rows_per_sync`` rows (the row-count schedule)."""
+
+    def __init__(self, rows_per_sync: int):
+        if rows_per_sync <= 0:
+            raise ValueError("rows_per_sync must be positive")
+        self.rows_per_sync = rows_per_sync
+
+    def boundaries(self, n_rows: int, num_passes: int):
+        for _ in range(max(num_passes, 1)):
+            for lo in range(0, n_rows, self.rows_per_sync):
+                yield (lo, min(lo + self.rows_per_sync, n_rows))

@@ -1,0 +1,21 @@
+"""Docs-as-tests: every example under docs/examples runs end to end
+(the reference's nbtest notebook-E2E tier, core/src/test/.../nbtest/)."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = sorted((pathlib.Path(__file__).parent.parent / "docs" / "examples")
+                  .glob("*.py"))
+
+
+@pytest.mark.parametrize("example", EXAMPLES, ids=lambda p: p.name)
+def test_example_runs(example):
+    env = {"PATH": "/usr/bin:/bin", "HOME": "/root",
+           "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(example.parent.parent.parent),
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    proc = subprocess.run([sys.executable, str(example)], env=env,
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, f"{example.name} failed:\n{proc.stdout}\n{proc.stderr}"
