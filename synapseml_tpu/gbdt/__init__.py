@@ -9,6 +9,7 @@ histogram (``segment_sum`` per depth level) with an ICI ``psum`` over the
 
 from .binning import BinMapper
 from .booster import TpuBooster
+from .interop import ImportedBooster, parse_lightgbm_string, to_lightgbm_string
 from .estimators import (
     LightGBMClassificationModel,
     LightGBMClassifier,
@@ -21,6 +22,9 @@ from .estimators import (
 __all__ = [
     "BinMapper",
     "TpuBooster",
+    "ImportedBooster",
+    "parse_lightgbm_string",
+    "to_lightgbm_string",
     "LightGBMClassifier",
     "LightGBMClassificationModel",
     "LightGBMRegressor",

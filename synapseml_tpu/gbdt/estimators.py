@@ -177,13 +177,16 @@ class _LightGBMModelBase(Model, _LightGBMParams):
 
     def save_native_model(self, path: str) -> None:
         """Reference ``saveNativeModel`` — writes the standalone booster dir
-        (npz + json + text dump)."""
-        b = self.get_booster()
-        b.save(path)
+        (npz + json) plus ``model.txt`` in LightGBM's own text format, loadable
+        by stock LightGBM tooling (booster/LightGBMBooster.scala:458)."""
         import os
 
+        from .interop import to_lightgbm_string
+
+        b = self.get_booster()
+        b.save(path)
         with open(os.path.join(path, "model.txt"), "w") as f:
-            f.write(b.dump_text())
+            f.write(to_lightgbm_string(b))
 
 
 # ---------------- classification ----------------

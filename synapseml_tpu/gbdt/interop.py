@@ -1,0 +1,324 @@
+"""LightGBM model-string interop: emit and parse LightGBM ``model.txt``.
+
+Reference: ``booster/LightGBMBooster.scala`` round-trips the native model
+string (``saveNativeModel:458`` / the ``modelString`` param that warm-starts
+training and rehydrates models). Here:
+
+  * :func:`to_lightgbm_string` — serialize a :class:`TpuBooster` in LightGBM's
+    text format (child-array trees, ``Tree=N`` blocks), so models trained on
+    TPU load into stock LightGBM tooling;
+  * :func:`parse_lightgbm_string` / :class:`ImportedBooster` — load a model
+    produced by real LightGBM (arbitrary tree shapes, not just our heap
+    layout) and serve it through the same jitted predict path, so existing
+    LightGBM models migrate in.
+
+LightGBM node encoding recap: per tree, arrays index INTERNAL nodes
+(``num_leaves - 1`` of them); ``left_child``/``right_child`` entries >= 0 are
+internal node ids, negative entries are leaves encoded as ``~leaf_idx``
+(= ``-leaf-1``). ``decision_type`` bit 1 = categorical (unsupported here),
+bit 2 = default-left (missing values go left).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["to_lightgbm_string", "parse_lightgbm_string", "ImportedBooster"]
+
+_CAT_MASK = 1
+_DEFAULT_LEFT_MASK = 2
+# decision_type bits 2-3: missing_type (0=None, 1=Zero, 2=NaN)
+_MISSING_NONE, _MISSING_ZERO, _MISSING_NAN = 0, 1, 2
+_ZERO_THRESHOLD = 1e-35
+
+
+# ---------------------------------------------------------------------------
+# export: heap trees -> LightGBM child arrays
+# ---------------------------------------------------------------------------
+
+def _heap_to_children(feature: np.ndarray, threshold: np.ndarray,
+                      leaf_value: np.ndarray, gain: np.ndarray):
+    """One heap tree -> (split_feature, split_gain, threshold, left, right,
+    leaf_values) in LightGBM encoding."""
+    internal: list[int] = []          # heap idx of internal nodes, BFS order
+    leaves: list[int] = []            # heap idx of leaf nodes, BFS order
+    index_of: dict[int, int] = {}
+
+    order = [0]
+    while order:
+        h = order.pop(0)
+        if feature[h] >= 0:
+            index_of[h] = len(internal)
+            internal.append(h)
+            order.append(2 * h + 1)
+            order.append(2 * h + 2)
+        else:
+            index_of[h] = ~len(leaves)
+            leaves.append(h)
+    if not internal:  # single-leaf tree
+        return ([], [], [], [], [], [float(leaf_value[0])])
+
+    left = [index_of[2 * h + 1] for h in internal]
+    right = [index_of[2 * h + 2] for h in internal]
+    return ([int(feature[h]) for h in internal],
+            [float(gain[h]) for h in internal],
+            [float(threshold[h]) for h in internal],
+            left, right,
+            [float(leaf_value[h]) for h in leaves])
+
+
+def to_lightgbm_string(booster) -> str:
+    """Serialize a TpuBooster as a LightGBM model.txt string.
+
+    ``init_score`` is folded into each class's FIRST tree (LightGBM's
+    boost_from_average bakes the prior into leaf values the same way)."""
+    K = booster.num_model_out
+    T = booster.best_iteration or booster.num_iterations
+    obj = {"binary": "binary sigmoid:1", "multiclass": f"multiclass num_class:{K}",
+           "lambdarank": "lambdarank"}.get(booster.objective, "regression")
+    out = [
+        "tree", "version=v3",
+        f"num_class={K if booster.objective == 'multiclass' else 1}",
+        f"num_tree_per_iteration={K}",
+        "label_index=0",
+        f"max_feature_idx={booster.num_features - 1}",
+        f"objective={obj}",
+        "feature_names=" + " ".join(f"Column_{i}" for i in range(booster.num_features)),
+        "feature_infos=" + " ".join(["[-inf:inf]"] * booster.num_features),
+        f"average_output={int(getattr(booster, 'average_output', False))}",
+        "",
+    ]
+    for t in range(T):
+        for k in range(K):
+            feat, gain, thr, left, right, leaf_vals = _heap_to_children(
+                booster.feature[t, k], booster.threshold_value[t, k],
+                booster.leaf_value[t, k], booster.gain[t, k])
+            if t == 0:
+                adj = float(booster.init_score[k])
+                if getattr(booster, "average_output", False):
+                    # rf predict divides the tree sum by T before adding init;
+                    # folding init*T keeps (init*T + sum)/T == init + sum/T
+                    adj *= T
+                leaf_vals = [v + adj for v in leaf_vals]
+            n_leaves = len(leaf_vals)
+            blk = [f"Tree={t * K + k}", f"num_leaves={n_leaves}", "num_cat=0"]
+            if feat:
+                blk += [
+                    "split_feature=" + " ".join(map(str, feat)),
+                    "split_gain=" + " ".join(f"{g:.17g}" for g in gain),
+                    "threshold=" + " ".join(f"{v:.17g}" for v in thr),
+                    # our trees route NaN right: missing_type=NaN (bits 2-3
+                    # = 2 -> value 8), default_left=0
+                    "decision_type=" + " ".join(["8"] * len(feat)),
+                    "left_child=" + " ".join(map(str, left)),
+                    "right_child=" + " ".join(map(str, right)),
+                ]
+            blk += ["leaf_value=" + " ".join(f"{v:.17g}" for v in leaf_vals),
+                    "shrinkage=1", ""]
+            out += blk
+    out += ["end of trees", "", "parameters:", "end of parameters", ""]
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# import: LightGBM model.txt -> jitted predictor
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Tree:
+    split_feature: np.ndarray
+    threshold: np.ndarray
+    left: np.ndarray
+    right: np.ndarray
+    leaf_value: np.ndarray
+    default_left: np.ndarray
+    missing_type: np.ndarray
+
+
+@dataclass
+class ImportedBooster:
+    """A LightGBM-format forest served by a jitted child-array walker.
+    API-compatible with TpuBooster's scoring surface so it slots into the
+    LightGBM*Model transformers (``booster=`` param)."""
+
+    trees: list[_Tree]
+    num_model_out: int
+    objective: str
+    num_features: int
+    average_output: bool = False
+    init_score: np.ndarray = field(default_factory=lambda: np.zeros(1, np.float32))
+    best_iteration: int | None = None
+
+    @property
+    def num_iterations(self) -> int:
+        return len(self.trees) // max(self.num_model_out, 1)
+
+    def _packed(self):
+        """Pad per-tree arrays to a common internal-node count and stack."""
+        if getattr(self, "_packed_cache", None) is None:
+            m = max(max(len(t.split_feature), 1) for t in self.trees)
+            L = max(max(len(t.leaf_value), 1) for t in self.trees)
+
+            def pad(a, n, fill):
+                a = np.asarray(a)
+                return np.concatenate([a, np.full(n - len(a), fill, a.dtype)]) \
+                    if len(a) < n else a
+
+            self._packed_cache = tuple(
+                np.stack([pad(getattr(t, name), m if name != "leaf_value" else L,
+                              fill) for t in self.trees])
+                for name, fill in (("split_feature", 0), ("threshold", 0.0),
+                                   ("left", -1), ("right", -1),
+                                   ("leaf_value", 0.0), ("default_left", 0),
+                                   ("missing_type", 0)))
+        return self._packed_cache
+
+    def raw_score(self, features: np.ndarray,
+                  num_iterations: int | None = None) -> np.ndarray:
+        feat, thr, left, right, leafv, dleft, mtype = self._packed()
+        K = self.num_model_out
+        n_it = num_iterations or self.best_iteration or self.num_iterations
+        n_it = min(n_it, self.num_iterations)
+        x = jnp.asarray(np.asarray(features, np.float32))
+        total = _walk_forest(x, jnp.asarray(feat), jnp.asarray(thr, jnp.float32),
+                             jnp.asarray(left), jnp.asarray(right),
+                             jnp.asarray(leafv, jnp.float32),
+                             jnp.asarray(dleft), jnp.asarray(mtype), K, n_it,
+                             int(np.ceil(np.log2(leafv.shape[1] + 1))) + 2)
+        out = np.asarray(total)
+        if self.average_output:
+            out = out / n_it
+        return out + np.asarray(self.init_score)[None, :]
+
+    def predict(self, features: np.ndarray,
+                num_iterations: int | None = None) -> np.ndarray:
+        from . import objectives as obj
+
+        s = self.raw_score(features, num_iterations)
+        o = obj.get_objective(
+            "binary" if self.objective.startswith("binary")
+            else "multiclass" if self.objective.startswith("multiclass")
+            else "regression", num_class=max(self.num_model_out, 2))
+        return np.asarray(o.transform(jnp.asarray(s)))
+
+
+@functools.partial(jax.jit, static_argnums=(8, 9, 10))
+def _walk_forest(x, feat, thr, left, right, leafv, dleft, mtype, K: int,
+                 n_it: int, max_depth: int):
+    """Sum leaf values over trees [0, n_it*K), per class K. Node state is the
+    LightGBM encoding itself: >=0 internal, negative = settled leaf."""
+    N = x.shape[0]
+
+    def one_tree(t_idx):
+        tf, tt = feat[t_idx], thr[t_idx]
+        tl, tr, dv, mt = left[t_idx], right[t_idx], dleft[t_idx], mtype[t_idx]
+
+        def body(_, node):
+            live = node >= 0
+            idx = jnp.maximum(node, 0)
+            f = tf[idx]
+            v = jnp.take_along_axis(x, f[:, None].astype(jnp.int32), axis=1)[:, 0]
+            m = mt[idx]
+            # missing_type semantics: Zero -> |v|<=1e-35 or NaN is missing;
+            # NaN -> NaN is missing; None -> NaN still falls to the default
+            is_nan = jnp.isnan(v)
+            is_missing = jnp.where(m == _MISSING_ZERO,
+                                   is_nan | (jnp.abs(v) <= _ZERO_THRESHOLD),
+                                   is_nan)
+            go_left = jnp.where(is_missing, dv[idx] > 0, v <= tt[idx])
+            nxt = jnp.where(go_left, tl[idx], tr[idx])
+            return jnp.where(live, nxt, node)
+
+        node = jax.lax.fori_loop(0, max_depth + leafv.shape[1], body,
+                                 jnp.zeros(N, jnp.int32))
+        leaf_idx = jnp.maximum(~node, 0)  # ~leaf encoding; live nodes can't remain
+        return leafv[t_idx, leaf_idx]
+
+    def per_class(k):
+        def add_iter(t, acc):
+            return acc + one_tree(t * K + k)
+
+        return jax.lax.fori_loop(0, n_it, add_iter, jnp.zeros(N, jnp.float32))
+
+    return jnp.stack([per_class(k) for k in range(K)], axis=1)
+
+
+def _parse_block(lines: list[str]) -> dict:
+    out = {}
+    for ln in lines:
+        if "=" in ln:
+            k, v = ln.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def parse_lightgbm_string(text: str) -> ImportedBooster:
+    """Parse a LightGBM model.txt (stock LightGBM or our export)."""
+    header_lines: list[str] = []
+    bare_flags: set[str] = set()
+    tree_blocks: list[list[str]] = []
+    cur: list[str] | None = None
+    for ln in text.splitlines():
+        s = ln.strip()
+        if s.startswith("Tree="):
+            cur = [s]
+            tree_blocks.append(cur)
+        elif s == "end of trees":
+            cur = None
+        elif cur is not None:
+            cur.append(s)
+        elif s:
+            if "=" not in s:
+                bare_flags.add(s)  # stock writes e.g. 'average_output' bare
+            header_lines.append(s)
+    head = _parse_block(header_lines)
+    objective = head.get("objective", "regression")
+    num_tpi = int(head.get("num_tree_per_iteration", 1))
+    num_features = int(head.get("max_feature_idx", 0)) + 1
+
+    trees: list[_Tree] = []
+    for blk in tree_blocks:
+        d = _parse_block(blk)
+        n_leaves = int(d.get("num_leaves", 1))
+        if int(d.get("num_cat", 0)) > 0 or any(
+                int(t) & _CAT_MASK for t in d.get("decision_type", "").split()):
+            raise NotImplementedError("categorical splits are not supported")
+        if "split_feature" in d and n_leaves > 1:
+            dt = [int(t) for t in d["decision_type"].split()]
+            trees.append(_Tree(
+                split_feature=np.asarray(d["split_feature"].split(), np.int32),
+                threshold=np.asarray(d["threshold"].split(), np.float64),
+                left=np.asarray(d["left_child"].split(), np.int32),
+                right=np.asarray(d["right_child"].split(), np.int32),
+                leaf_value=np.asarray(d["leaf_value"].split(), np.float64),
+                default_left=np.asarray(
+                    [(t & _DEFAULT_LEFT_MASK) > 0 for t in dt], np.int32),
+                missing_type=np.asarray([(t >> 2) & 3 for t in dt], np.int32)))
+        else:
+            trees.append(_Tree(
+                split_feature=np.zeros(0, np.int32),
+                threshold=np.zeros(0, np.float64),
+                left=np.zeros(0, np.int32), right=np.zeros(0, np.int32),
+                leaf_value=np.asarray(d["leaf_value"].split(), np.float64),
+                default_left=np.zeros(0, np.int32),
+                missing_type=np.zeros(0, np.int32)))
+
+    if objective.startswith("multiclass"):
+        K = num_tpi
+        base = "multiclass"
+    elif objective.startswith("binary"):
+        K, base = 1, "binary"
+    elif objective.startswith("lambdarank"):
+        K, base = 1, "lambdarank"
+    else:
+        K, base = 1, "regression"
+    avg = (head.get("average_output", "0") == "1"
+           or "average_output" in bare_flags)
+    return ImportedBooster(trees=trees, num_model_out=K, objective=base,
+                           num_features=num_features, average_output=avg,
+                           init_score=np.zeros(K, np.float32))

@@ -50,6 +50,10 @@ class HuggingFaceCausalLM(Transformer):
     batch_size = Param("batch_size", "rows per padded device batch", default=8,
                        converter=TypeConverters.to_int)
     eos_id = Param("eos_id", "stop token id", default=None)
+    mesh_config = ComplexParam(
+        "mesh_config", "MeshConfig for sharded inference: params shard over "
+        "tensor/fsdp axes per the logical rules (the Llama-2-7B "
+        "sharded-batch-inference BASELINE config)", default=None)
 
     # ---- lazy model/tokenizer ----
     def _model_and_params(self):
@@ -74,7 +78,25 @@ class HuggingFaceCausalLM(Transformer):
                 variables = LlamaLM(cfg).init(jax.random.PRNGKey(0),
                                               jnp.zeros((B, T), jnp.int32))
                 params = variables["params"]
-            self.__dict__["_cache_model"] = (model, params, tok)
+            mesh = None
+            if self.get("mesh_config") is not None:
+                # sharded batch inference: weights distribute over the mesh
+                # (tensor/fsdp per logical rules); XLA inserts the activation
+                # collectives during generate
+                import jax
+                import jax.numpy as jnp
+
+                from ..parallel.mesh import create_mesh, shard_inference_params
+                from flax.core import meta
+
+                mesh = create_mesh(self.get("mesh_config"))
+                plain = jax.tree.map(
+                    lambda x: x.value if isinstance(x, meta.Partitioned) else x,
+                    params, is_leaf=lambda x: isinstance(x, meta.Partitioned))
+                params = shard_inference_params(
+                    LlamaLM(cfg), {"input_ids": jnp.zeros((1, 8), jnp.int32)},
+                    plain, mesh)
+            self.__dict__["_cache_model"] = (model, params, tok, mesh)
         return self.__dict__["_cache_model"]
 
     def _generate_fn(self, B: int, P: int):
@@ -83,7 +105,7 @@ class HuggingFaceCausalLM(Transformer):
         key = ("gen", B, P, self.get("max_new_tokens"))
         cache = self.__dict__.setdefault("_cache_gen", {})
         if key not in cache:
-            model, params, _ = self._model_and_params()
+            model, params, _, mesh = self._model_and_params()
 
             def fn(ids, mask):
                 return greedy_generate(model, params, ids,
@@ -91,7 +113,15 @@ class HuggingFaceCausalLM(Transformer):
                                        eos_id=self.get("eos_id"),
                                        prompt_mask=mask)
 
-            cache[key] = jax.jit(fn)
+            jitted = jax.jit(fn)
+            if mesh is not None:
+                def run(ids, mask, _j=jitted, _m=mesh):
+                    with _m.mesh:
+                        return _j(ids, mask)
+
+                cache[key] = run
+            else:
+                cache[key] = jitted
         return cache[key]
 
     def _texts_of(self, p) -> list[str]:
@@ -103,7 +133,7 @@ class HuggingFaceCausalLM(Transformer):
     def _transform(self, df: DataFrame) -> DataFrame:
         mc = self.get("messages_col")
         self.require_columns(df, mc if mc else self.get("input_col"))
-        model, params, tok = self._model_and_params()
+        model, params, tok, _mesh = self._model_and_params()
         B = self.get("batch_size")
         bucket = self.get("prompt_bucket")
 

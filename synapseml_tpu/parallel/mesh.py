@@ -29,7 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["MeshConfig", "MeshContext", "create_mesh", "batch_sharding", "replicated",
-           "logical_axis_rules", "shard_params", "P"]
+           "logical_axis_rules", "shard_params", "shard_inference_params", "P"]
 
 AXES = ("data", "fsdp", "tensor", "seq", "expert")
 
@@ -178,3 +178,36 @@ def shard_params(params: Any, mesh_ctx: MeshContext, rules: Sequence[tuple[str, 
 
     return jax.tree.map(to_sharding, params,
                         is_leaf=lambda x: isinstance(x, meta.Partitioned))
+
+
+def shard_inference_params(module, example_inputs: dict, params, mesh_ctx,
+                           rules: Sequence[tuple[str, Any]] | None = None):
+    """Place a PLAIN param pytree (e.g. from models.convert_hf) onto the mesh
+    with the module's logical shardings — the inference-side analog of the
+    trainer's init-time sharding (Llama-2-7B sharded batch inference,
+    BASELINE.md). The module is abstractly initialized (eval_shape: no
+    compute, no memory) just to recover each param's ``nn.Partitioned`` axis
+    names; values then device_put with those shardings.
+    """
+    import jax
+
+    from flax.core import meta
+
+    abstract = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0), **example_inputs))
+    boxes = abstract["params"]
+    flat_boxes = {tuple(str(getattr(k, "key", k)) for k in path): leaf
+                  for path, leaf in jax.tree_util.tree_flatten_with_path(
+                      boxes, is_leaf=lambda x: isinstance(x, meta.Partitioned))[0]}
+
+    # re-box the plain values with the module's metadata, then delegate to
+    # shard_params so train and inference placement share one code path
+    def rebox(path, v):
+        key = tuple(str(getattr(k, "key", k)) for k in path)
+        box = flat_boxes.get(key)
+        if isinstance(box, meta.Partitioned):
+            return box.replace_boxed(v)
+        return v
+
+    boxed = jax.tree_util.tree_map_with_path(rebox, params)
+    return shard_params(boxed, mesh_ctx, rules)

@@ -426,3 +426,185 @@ def test_dart_early_stopping_returns_measured_model():
     contrib = b.predict_contrib(X[:10])
     np.testing.assert_allclose(contrib[:, 0, :].sum(-1), b.raw_score(X[:10])[:, 0],
                                atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# LightGBM model-string interop (reference saveNativeModel / modelString,
+# booster/LightGBMBooster.scala:458)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("objective", ["regression", "binary", "multiclass"])
+def test_lightgbm_string_round_trip(objective):
+    from synapseml_tpu.gbdt import parse_lightgbm_string, to_lightgbm_string
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    rs = np.random.default_rng(21)
+    X = rs.normal(size=(300, 5))
+    if objective == "multiclass":
+        y = np.argmax(X[:, :3], axis=1).astype(np.float32)
+        kw = {"num_class": 3}
+    elif objective == "binary":
+        y = (X[:, 0] > 0).astype(np.float32)
+        kw = {}
+    else:
+        y = (X[:, 0] * 2 + X[:, 1]).astype(np.float32)
+        kw = {}
+    b = train_booster(X, y, objective=objective, num_iterations=8,
+                      learning_rate=0.3, num_leaves=7, **kw)
+    text = to_lightgbm_string(b)
+    assert "Tree=0" in text and "end of trees" in text
+    imp = parse_lightgbm_string(text)
+    np.testing.assert_allclose(imp.raw_score(X[:50]), b.raw_score(X[:50]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(imp.predict(X[:50])),
+                               np.asarray(b.predict(X[:50])),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_parse_handwritten_lightgbm_file():
+    """Pin the format semantics against a hand-computed stock-LightGBM-style
+    file: negative-child leaf encoding, default-left NaN routing."""
+    from synapseml_tpu.gbdt import parse_lightgbm_string
+
+    text = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+max_feature_idx=1
+objective=regression
+
+Tree=0
+num_leaves=3
+num_cat=0
+split_feature=0 1
+split_gain=10 5
+threshold=0.5 -1
+decision_type=10 0
+left_child=1 -2
+right_child=-1 -3
+leaf_value=100 200 300
+shrinkage=1
+
+end of trees
+
+parameters:
+end of parameters
+"""
+    # node0: f0<=0.5, decision_type=10 = default-left + missing_type NaN
+    # node1: f1<=-1, decision_type=0 = default-right
+    imp = parse_lightgbm_string(text)
+    X = np.array([
+        [0.4, -2.0],   # left, left   -> 200
+        [0.4, 0.0],    # left, right  -> 300
+        [0.6, 9.9],    # right        -> 100
+        [np.nan, 0.0], # default-left at node0, right at node1 -> 300
+        [0.4, np.nan], # left, default-RIGHT at node1 -> 300
+    ])
+    got = imp.raw_score(X)[:, 0]
+    np.testing.assert_allclose(got, [200, 300, 100, 300, 300])
+
+
+def test_imported_booster_in_model_transformer(tmp_path):
+    """save_native_model writes LightGBM format; the parsed booster slots into
+    the classification model transformer."""
+    import synapseml_tpu as st
+    from synapseml_tpu.gbdt import (LightGBMClassificationModel,
+                                    LightGBMClassifier, parse_lightgbm_string)
+
+    rs = np.random.default_rng(22)
+    X = rs.normal(size=(200, 4))
+    y = (X[:, 0] - X[:, 1] > 0).astype(int)
+    df = st.DataFrame.from_rows([{"features": X[i], "label": int(y[i])}
+                                 for i in range(200)])
+    model = LightGBMClassifier(num_iterations=10, learning_rate=0.3).fit(df)
+    model.save_native_model(str(tmp_path / "native"))
+    text = (tmp_path / "native" / "model.txt").read_text()
+    assert "objective=binary sigmoid:1" in text
+
+    imported = parse_lightgbm_string(text)
+    m2 = LightGBMClassificationModel(booster=imported,
+                                     classes=model.get("classes"))
+    out1 = model.transform(df)
+    out2 = m2.transform(df)
+    np.testing.assert_array_equal(out1.collect_column("prediction"),
+                                  out2.collect_column("prediction"))
+    np.testing.assert_allclose(
+        np.stack(list(out1.collect_column("probability"))),
+        np.stack(list(out2.collect_column("probability"))), atol=1e-5)
+
+
+def test_rf_mode_string_round_trip():
+    from synapseml_tpu.gbdt import parse_lightgbm_string, to_lightgbm_string
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    X, y = _mode_dataset(seed=23, n=300)
+    b = train_booster(X, y, objective="binary", boosting_type="rf",
+                      bagging_fraction=0.7, bagging_freq=1, num_iterations=6)
+    imp = parse_lightgbm_string(to_lightgbm_string(b))
+    assert imp.average_output
+    np.testing.assert_allclose(imp.raw_score(X[:40]), b.raw_score(X[:40]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lightgbm_string_nan_round_trip():
+    """NaN routing survives export/import (our trees route NaN right; the
+    export declares missing_type=NaN with default-right)."""
+    from synapseml_tpu.gbdt import parse_lightgbm_string, to_lightgbm_string
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    rs = np.random.default_rng(24)
+    X = rs.normal(size=(400, 3))
+    X[rs.random(400) < 0.2, 0] = np.nan  # NaNs in a split feature
+    y = (np.nan_to_num(X[:, 0]) + X[:, 1] > 0).astype(np.float32)
+    b = train_booster(X, y, objective="binary", num_iterations=6,
+                      learning_rate=0.3, num_leaves=7)
+    imp = parse_lightgbm_string(to_lightgbm_string(b))
+    Xt = X[:80]
+    np.testing.assert_allclose(imp.raw_score(Xt), b.raw_score(Xt),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_imported_zero_as_missing_semantics():
+    """missing_type=Zero (decision_type bit value 4): 0.0 and NaN follow the
+    default direction."""
+    from synapseml_tpu.gbdt import parse_lightgbm_string
+
+    text = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+max_feature_idx=0
+objective=regression
+
+Tree=0
+num_leaves=2
+num_cat=0
+split_feature=0
+split_gain=1
+threshold=-5
+decision_type=6
+left_child=-1
+right_child=-2
+leaf_value=111 222
+shrinkage=1
+
+end of trees
+"""
+    # decision_type=6 = default_left(2) + missing_type Zero(4):
+    # 0.0 and NaN are missing -> LEFT (111); ordinary values compare to -5
+    imp = parse_lightgbm_string(text)
+    got = imp.raw_score(np.array([[0.0], [np.nan], [-7.0], [3.0]]))[:, 0]
+    np.testing.assert_allclose(got, [111, 111, 111, 222])
+
+
+def test_imported_num_iterations_clamped():
+    from synapseml_tpu.gbdt import parse_lightgbm_string, to_lightgbm_string
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    rs = np.random.default_rng(25)
+    X = rs.normal(size=(200, 3))
+    y = X[:, 0].astype(np.float32)
+    b = train_booster(X, y, objective="regression", num_iterations=5)
+    imp = parse_lightgbm_string(to_lightgbm_string(b))
+    np.testing.assert_allclose(imp.raw_score(X[:10], num_iterations=50),
+                               imp.raw_score(X[:10]), rtol=1e-6)
