@@ -184,7 +184,9 @@ class _LightGBMModelBase(Model, _LightGBMParams):
         from .interop import to_lightgbm_string
 
         b = self.get_booster()
-        b.save(path)
+        os.makedirs(path, exist_ok=True)
+        if hasattr(b, "save"):  # ImportedBooster persists via model.txt only
+            b.save(path)
         with open(os.path.join(path, "model.txt"), "w") as f:
             f.write(to_lightgbm_string(b))
 

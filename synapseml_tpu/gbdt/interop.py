@@ -73,14 +73,20 @@ def _heap_to_children(feature: np.ndarray, threshold: np.ndarray,
 
 
 def to_lightgbm_string(booster) -> str:
-    """Serialize a TpuBooster as a LightGBM model.txt string.
+    """Serialize a TpuBooster (heap trees) or ImportedBooster (child arrays)
+    as a LightGBM model.txt string.
 
     ``init_score`` is folded into each class's FIRST tree (LightGBM's
     boost_from_average bakes the prior into leaf values the same way)."""
+    if isinstance(booster, ImportedBooster):
+        return _imported_to_string(booster)
     K = booster.num_model_out
     T = booster.best_iteration or booster.num_iterations
-    obj = {"binary": "binary sigmoid:1", "multiclass": f"multiclass num_class:{K}",
-           "lambdarank": "lambdarank"}.get(booster.objective, "regression")
+    # LightGBM objective strings; link-carrying regressions pass through by
+    # name so the round-trip (and stock LightGBM) keep the link function
+    obj = {"binary": "binary sigmoid:1",
+           "multiclass": f"multiclass num_class:{K}",
+           "lambdarank": "lambdarank"}.get(booster.objective, booster.objective)
     out = [
         "tree", "version=v3",
         f"num_class={K if booster.objective == 'multiclass' else 1}",
@@ -90,9 +96,11 @@ def to_lightgbm_string(booster) -> str:
         f"objective={obj}",
         "feature_names=" + " ".join(f"Column_{i}" for i in range(booster.num_features)),
         "feature_infos=" + " ".join(["[-inf:inf]"] * booster.num_features),
-        f"average_output={int(getattr(booster, 'average_output', False))}",
-        "",
     ]
+    if getattr(booster, "average_output", False):
+        # stock LightGBM writes this flag BARE and reads it by key presence
+        out.append("average_output")
+    out.append("")
     for t in range(T):
         for k in range(K):
             feat, gain, thr, left, right, leaf_vals = _heap_to_children(
@@ -200,10 +208,11 @@ class ImportedBooster:
         from . import objectives as obj
 
         s = self.raw_score(features, num_iterations)
-        o = obj.get_objective(
-            "binary" if self.objective.startswith("binary")
-            else "multiclass" if self.objective.startswith("multiclass")
-            else "regression", num_class=max(self.num_model_out, 2))
+        try:
+            o = obj.get_objective(self.objective,
+                                  num_class=max(self.num_model_out, 2))
+        except (KeyError, ValueError):
+            o = obj.get_objective("regression", num_class=2)
         return np.asarray(o.transform(jnp.asarray(s)))
 
 
@@ -308,13 +317,15 @@ def parse_lightgbm_string(text: str) -> ImportedBooster:
                 default_left=np.zeros(0, np.int32),
                 missing_type=np.zeros(0, np.int32)))
 
-    if objective.startswith("multiclass"):
-        K = num_tpi
-        base = "multiclass"
-    elif objective.startswith("binary"):
+    first = objective.split()[0] if objective else "regression"
+    if first == "multiclass":
+        K, base = num_tpi, "multiclass"
+    elif first == "binary":
         K, base = 1, "binary"
-    elif objective.startswith("lambdarank"):
+    elif first == "lambdarank":
         K, base = 1, "lambdarank"
+    elif first in ("regression_l1", "huber", "poisson", "quantile"):
+        K, base = 1, first  # link-carrying regression objectives
     else:
         K, base = 1, "regression"
     avg = (head.get("average_output", "0") == "1"
@@ -322,3 +333,38 @@ def parse_lightgbm_string(text: str) -> ImportedBooster:
     return ImportedBooster(trees=trees, num_model_out=K, objective=base,
                            num_features=num_features, average_output=avg,
                            init_score=np.zeros(K, np.float32))
+
+
+def _imported_to_string(b: "ImportedBooster") -> str:
+    """Re-serialize an imported child-array forest (migrate-in models persist
+    too — saveNativeModel parity for ImportedBooster-backed transformers)."""
+    K = b.num_model_out
+    obj = {"binary": "binary sigmoid:1",
+           "multiclass": f"multiclass num_class:{K}",
+           "lambdarank": "lambdarank"}.get(b.objective, b.objective)
+    out = ["tree", "version=v3",
+           f"num_class={K if b.objective == 'multiclass' else 1}",
+           f"num_tree_per_iteration={K}", "label_index=0",
+           f"max_feature_idx={b.num_features - 1}",
+           f"objective={obj}",
+           "feature_names=" + " ".join(f"Column_{i}" for i in range(b.num_features)),
+           "feature_infos=" + " ".join(["[-inf:inf]"] * b.num_features)]
+    if b.average_output:
+        out.append("average_output")
+    out.append("")
+    for i, t in enumerate(b.trees):
+        blk = [f"Tree={i}", f"num_leaves={len(t.leaf_value)}", "num_cat=0"]
+        if len(t.split_feature):
+            dts = [int(_DEFAULT_LEFT_MASK * bool(dl)) | (int(mt) << 2)
+                   for dl, mt in zip(t.default_left, t.missing_type)]
+            blk += ["split_feature=" + " ".join(map(str, t.split_feature)),
+                    "split_gain=" + " ".join(["0"] * len(t.split_feature)),
+                    "threshold=" + " ".join(f"{v:.17g}" for v in t.threshold),
+                    "decision_type=" + " ".join(map(str, dts)),
+                    "left_child=" + " ".join(map(str, t.left)),
+                    "right_child=" + " ".join(map(str, t.right))]
+        blk += ["leaf_value=" + " ".join(f"{v:.17g}" for v in t.leaf_value),
+                "shrinkage=1", ""]
+        out += blk
+    out += ["end of trees", "", "parameters:", "end of parameters", ""]
+    return "\n".join(out)

@@ -55,6 +55,16 @@ class HuggingFaceCausalLM(Transformer):
         "tensor/fsdp axes per the logical rules (the Llama-2-7B "
         "sharded-batch-inference BASELINE config)", default=None)
 
+    _CACHE_KEYS = frozenset({"model_name", "model_params", "tokenizer",
+                             "mesh_config", "max_new_tokens", "eos_id"})
+
+    def set(self, **kw):
+        out = super().set(**kw)
+        if self._CACHE_KEYS & kw.keys():
+            self.__dict__.pop("_cache_model", None)
+            self.__dict__.pop("_cache_gen", None)
+        return out
+
     # ---- lazy model/tokenizer ----
     def _model_and_params(self):
         if self.__dict__.get("_cache_model") is None:
@@ -115,9 +125,16 @@ class HuggingFaceCausalLM(Transformer):
 
             jitted = jax.jit(fn)
             if mesh is not None:
+                dp = mesh.data_parallel_size()
+                if B % dp:
+                    raise ValueError(
+                        f"batch_size ({B}) must be a multiple of the mesh "
+                        f"data-parallel size ({dp}) for sharded generation")
+
                 def run(ids, mask, _j=jitted, _m=mesh):
                     with _m.mesh:
-                        return _j(ids, mask)
+                        # batch shards over data/fsdp; params already placed
+                        return _j(_m.shard_batch(ids), _m.shard_batch(mask))
 
                 cache[key] = run
             else:

@@ -164,6 +164,10 @@ class DeepTextModel(Model, _TextParams):
     feature_name = "deep_learning"
 
     model_params = ComplexParam("model_params", "trained Flax parameter pytree")
+    mesh_config = ComplexParam(
+        "mesh_config", "MeshConfig for sharded inference (params + batches "
+        "distribute over the mesh; explainer perturbation batches ride the "
+        "same path)", default=None)
     arch_config = ComplexParam("arch_config", "TransformerConfig (pretrained-dir "
                                "fits; None = resolve checkpoint preset)", default=None)
     tokenizer_config = ComplexParam("tokenizer_config", "tokenizer config dict")
@@ -176,8 +180,19 @@ class DeepTextModel(Model, _TextParams):
     def _post_load(self):
         self._apply_fn = None
 
+    _APPLY_KEYS = frozenset({"model_params", "arch_config", "tokenizer_config",
+                             "checkpoint", "num_classes", "mesh_config"})
+
+    def set(self, **kw):
+        out = super().set(**kw)
+        if self._APPLY_KEYS & kw.keys():
+            self._apply_fn = None  # cached closure captured the old values
+        return out
+
     def _get_apply(self):
         if self._apply_fn is None:
+            import jax.numpy as jnp
+
             tok = resolve_tokenizer(self.get("tokenizer_config"))
             cfg = self.get("arch_config")
             if cfg is None:
@@ -187,20 +202,39 @@ class DeepTextModel(Model, _TextParams):
                 cfg = legacy_prenorm_fixup(cfg, self.get("model_params"))
             module = BertClassifier(cfg, num_classes=self.get("num_classes"))
 
+            params = self.get("model_params")
+            mesh = None
+            if self.get("mesh_config") is not None:
+                from ..parallel.mesh import shard_inference_params
+
+                mesh = create_mesh(self.get("mesh_config"))
+                params = shard_inference_params(
+                    module, {"input_ids": jnp.zeros((1, 8), jnp.int32),
+                             "attention_mask": jnp.ones((1, 8), jnp.int32)},
+                    params, mesh)
+
             @jax.jit
             def apply(params, input_ids, attention_mask):
                 logits = module.apply({"params": params}, input_ids, attention_mask)
                 return jax.nn.softmax(logits, axis=-1)
 
+            def run(ids, mask):
+                if mesh is not None:
+                    with mesh.mesh:
+                        return apply(params, mesh.shard_batch(ids),
+                                     mesh.shard_batch(mask))
+                return apply(params, ids, mask)
+
             self._tok = tok
-            self._apply_fn = apply
+            self._mesh = mesh
+            self._apply_fn = run
         return self._apply_fn
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("text_col"))
-        apply = self._get_apply()
-        params = self.get("model_params")
+        run = self._get_apply()
         bs = self.get("batch_size")
+        dp = self._mesh.data_parallel_size() if self._mesh is not None else 1
 
         def per_part(part):
             texts = list(part[self.get("text_col")])
@@ -212,8 +246,8 @@ class DeepTextModel(Model, _TextParams):
                 return out
             enc = self._tok(texts, max_len=self.get("max_token_len"))
             probs_chunks = []
-            for b in batches(enc, bs):
-                p = apply(params, b.data["input_ids"], b.data["attention_mask"])
+            for b in batches(enc, bs, multiple_of=dp):
+                p = run(b.data["input_ids"], b.data["attention_mask"])
                 probs_chunks.append(np.asarray(p)[: b.n_valid])
             probs = np.concatenate(probs_chunks, axis=0)
             out = dict(part)

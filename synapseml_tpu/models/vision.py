@@ -130,6 +130,8 @@ class DeepVisionModel(Model, _VisionParams):
     batch_stats = ComplexParam("batch_stats", "BN running stats", default=None)
     arch_spec = ComplexParam("arch_spec", "(kind, info) for pretrained-dir fits",
                              default=None)
+    mesh_config = ComplexParam("mesh_config", "MeshConfig for sharded inference",
+                               default=None)
     train_metrics = ComplexParam("train_metrics", "loss/throughput trace", default=None)
 
     def __init__(self, **kw):
@@ -139,27 +141,52 @@ class DeepVisionModel(Model, _VisionParams):
     def _post_load(self):
         self._apply_fn = None
 
+    _APPLY_KEYS = frozenset({"model_params", "batch_stats", "arch_spec",
+                             "backbone", "num_classes", "mesh_config"})
+
+    def set(self, **kw):
+        out = super().set(**kw)
+        if self._APPLY_KEYS & kw.keys():
+            self._apply_fn = None  # cached closure captured the old values
+        return out
+
     def _get_apply(self):
         if self._apply_fn is None:
             module, has_bn = _build_module(self.get("backbone"), self.get("num_classes"),
                                            self.get("arch_spec"))
+            variables = {"params": self.get("model_params")}
+            if self.get("batch_stats") is not None:
+                variables["batch_stats"] = self.get("batch_stats")
+            mesh = None
+            if self.get("mesh_config") is not None:
+                # batch-sharded inference; explainer perturbation batches ride
+                # this path too (SURVEY §7 step 8)
+                mesh = create_mesh(self.get("mesh_config"))
+                variables = jax.tree.map(
+                    lambda v: jax.device_put(np.asarray(v), mesh.replicated()),
+                    variables)
 
             @jax.jit
             def apply(variables, x):
                 logits = module.apply(variables, x)
                 return jax.nn.softmax(logits, axis=-1)
 
+            def run(x):
+                if mesh is not None:
+                    with mesh.mesh:
+                        return apply(variables, mesh.shard_batch(x))
+                return apply(variables, x)
+
             self._module_has_bn = has_bn
-            self._apply_fn = apply
+            self._mesh = mesh
+            self._apply_fn = run
         return self._apply_fn
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("image_col"))
-        apply = self._get_apply()
-        variables = {"params": self.get("model_params")}
-        if self.get("batch_stats") is not None:
-            variables["batch_stats"] = self.get("batch_stats")
+        run = self._get_apply()
         bs = self.get("batch_size")
+        dp = self._mesh.data_parallel_size() if self._mesh is not None else 1
 
         def per_part(part):
             imgs = part[self.get("image_col")]
@@ -171,8 +198,8 @@ class DeepVisionModel(Model, _VisionParams):
                 return out
             x = np.stack(list(imgs)).astype(np.float32)
             chunks = []
-            for b in batches({"x": x}, bs):
-                p = apply(variables, b.data["x"])
+            for b in batches({"x": x}, bs, multiple_of=dp):
+                p = run(b.data["x"])
                 chunks.append(np.asarray(p)[: b.n_valid])
             probs = np.concatenate(chunks, axis=0)
             out = dict(part)

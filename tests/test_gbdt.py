@@ -608,3 +608,63 @@ def test_imported_num_iterations_clamped():
     imp = parse_lightgbm_string(to_lightgbm_string(b))
     np.testing.assert_allclose(imp.raw_score(X[:10], num_iterations=50),
                                imp.raw_score(X[:10]), rtol=1e-6)
+
+
+def test_poisson_objective_string_round_trip():
+    """Link-carrying objectives survive the model-string round-trip (review
+    regression: poisson must not degrade to plain regression)."""
+    from synapseml_tpu.gbdt import parse_lightgbm_string, to_lightgbm_string
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    rs = np.random.default_rng(26)
+    X = rs.normal(size=(300, 3))
+    y = rs.poisson(np.exp(0.5 * X[:, 0])).astype(np.float32)
+    b = train_booster(X, y, objective="poisson", num_iterations=6,
+                      learning_rate=0.2)
+    text = to_lightgbm_string(b)
+    assert "objective=poisson" in text
+    assert "average_output" not in text  # presence == true in stock LightGBM
+    imp = parse_lightgbm_string(text)
+    np.testing.assert_allclose(np.asarray(imp.predict(X[:30])).ravel(),
+                               np.asarray(b.predict(X[:30])).ravel(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_imported_booster_save_native_round_trip(tmp_path):
+    """Migrate-in models persist: ImportedBooster-backed transformers
+    save_native_model and reload with identical scores."""
+    import synapseml_tpu as st
+    from synapseml_tpu.gbdt import (LightGBMRegressionModel, LightGBMRegressor,
+                                    parse_lightgbm_string, to_lightgbm_string)
+
+    rs = np.random.default_rng(27)
+    X = rs.normal(size=(150, 3))
+    y = X[:, 0].astype(np.float32)
+    df = st.DataFrame.from_rows([{"features": X[i], "label": float(y[i])}
+                                 for i in range(150)])
+    m = LightGBMRegressor(num_iterations=5).fit(df)
+    imp = parse_lightgbm_string(to_lightgbm_string(m.get_booster()))
+    m2 = LightGBMRegressionModel(booster=imp)
+    m2.save_native_model(str(tmp_path / "n2"))
+    re_imp = parse_lightgbm_string((tmp_path / "n2" / "model.txt").read_text())
+    np.testing.assert_allclose(re_imp.raw_score(X[:20]), imp.raw_score(X[:20]),
+                               rtol=1e-6)
+
+
+def test_model_cache_invalidated_on_set():
+    """set(model_params=...) after a transform must take effect (review
+    regression: the cached apply closure froze the old weights)."""
+    import synapseml_tpu as st
+    from synapseml_tpu.models import DeepTextClassifier
+
+    rows = [{"text": "good", "label": 1}, {"text": "bad", "label": 0}] * 8
+    df = st.DataFrame.from_rows(rows)
+    m = DeepTextClassifier(checkpoint="bert-tiny", num_classes=2, batch_size=8,
+                           max_token_len=8, max_steps=5,
+                           learning_rate=3e-3).fit(df)
+    p1 = np.stack(list(m.transform(df).collect_column("scores")))
+    import jax
+    zeroed = jax.tree.map(np.zeros_like, m.get("model_params"))
+    m.set(model_params=zeroed)
+    p2 = np.stack(list(m.transform(df).collect_column("scores")))
+    assert not np.allclose(p1, p2)  # new params actually used

@@ -168,7 +168,7 @@ def test_causal_lm_sharded_inference_matches_unsharded():
     df = DataFrame.from_rows([{"prompt": "the quick brown fox"},
                               {"prompt": "hello world again"}])
     kw = dict(model_name="llama-tiny", model_params=params, tokenizer=tok,
-              max_new_tokens=6, batch_size=2, prompt_bucket=8)
+              max_new_tokens=6, batch_size=4, prompt_bucket=8)
     plain = HuggingFaceCausalLM(**kw).transform(df)
     sharded = HuggingFaceCausalLM(
         **kw, mesh_config=MeshConfig(data=2, fsdp=2, tensor=2, seq=1)).transform(df)
