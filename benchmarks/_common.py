@@ -1,0 +1,18 @@
+"""Shared setup for benchmark scripts."""
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def init_jax():
+    """Import jax honoring $JAX_PLATFORMS via the config API (sitecustomize
+    pins jax_platforms=axon at interpreter boot, so env alone is ignored).
+    Returns (jax module, platform string, device count)."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    devs = jax.devices()
+    return jax, devs[0].platform, len(devs)

@@ -1,0 +1,46 @@
+"""einsum vs flash attention, BERT-base train step (results: docs/BENCHMARKS.md)."""
+import dataclasses, json, sys, time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    from synapseml_tpu.models.flax_nets.bert import BertClassifier, bert_base, bert_tiny
+    from synapseml_tpu.models.trainer import Trainer, TrainerConfig
+    from synapseml_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    on_tpu = platform == "tpu"
+    results = {}
+    for T, B in ((128, 32), (512, 8)) if on_tpu else ((32, 8),):
+        for impl in ("einsum", "flash"):
+            base = bert_base() if on_tpu else bert_tiny()
+            cfg = dataclasses.replace(base, attn_impl=impl)
+            tr = Trainer(BertClassifier(cfg, num_classes=2),
+                         create_mesh(MeshConfig(data=-1)),
+                         TrainerConfig(learning_rate=5e-5, total_steps=1000))
+            rng = np.random.default_rng(0)
+            batch = {"input_ids": rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32),
+                     "attention_mask": np.ones((B, T), np.int32),
+                     "labels": rng.integers(0, 2, (B,)).astype(np.int32)}
+            state = tr.init_state(batch)
+            k = 16 if on_tpu else 4
+            stacked = jax.tree.map(lambda x: np.broadcast_to(x, (k,) + x.shape).copy(), batch)
+            st, m = tr.train_steps_scan(state, stacked)
+            float(np.asarray(m["loss"])[-1])
+            best = 1e9
+            for _ in range(3):
+                t0 = time.perf_counter()
+                st, m = tr.train_steps_scan(st, stacked)
+                np.asarray(m["loss"])
+                best = min(best, time.perf_counter() - t0)
+            results[f"T{T}_{impl}_ms"] = round(best / k * 1e3, 2)
+    print(json.dumps(results))
+
+main()

@@ -1,0 +1,44 @@
+"""Higgs-1M-shaped GBDT training throughput on the TPU (BASELINE.md config:
+LightGBM Higgs-1M, 100 iterations, binary)."""
+import json, sys, time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    from synapseml_tpu.gbdt.booster import train_booster
+    print("platform:", platform, flush=True)
+    rng = np.random.default_rng(0)
+    # full Higgs-1M shape on the chip; smoke scale elsewhere
+    N, F = (1_000_000, 28) if platform == "tpu" else (50_000, 28)
+    X = rng.normal(size=(N, F)).astype(np.float32)
+    w = rng.normal(size=F); w[F//2:] = 0
+    logits = X @ w * 0.5 + rng.normal(size=N) * 0.5
+    y = (logits > 0).astype(np.float32)
+    t0 = time.perf_counter()
+    n_iter = 100 if platform == "tpu" else 20
+    booster = train_booster(X, y, objective="binary", num_iterations=n_iter,
+                            learning_rate=0.1, num_leaves=31, max_bin=255)
+    train_s = time.perf_counter() - t0
+    n_pred = min(100_000, N)
+    t0 = time.perf_counter()
+    p = booster.predict(X[:n_pred])
+    pred_s = time.perf_counter() - t0
+    auc_y, auc_p = y[:n_pred], np.asarray(p).ravel()
+    order = np.argsort(auc_p)
+    ranks = np.empty(len(order)); ranks[order] = np.arange(1, len(order)+1)
+    n1 = auc_y.sum(); n0 = len(auc_y) - n1
+    auc = (ranks[auc_y == 1].sum() - n1*(n1+1)/2) / (n1*n0)
+    print(json.dumps({"metric": "LightGBM Higgs-1M train" if platform == "tpu"
+                      else "LightGBM 50k (CPU smoke)",
+                      "train_s": round(train_s, 2),
+                      "pred_rows": n_pred, "pred_s": round(pred_s, 3),
+                      "auc": round(float(auc), 4),
+                      "row_iters_per_sec": round(N * n_iter / train_s)}))
+main()

@@ -1,0 +1,41 @@
+"""ONNXModel ResNet-50 inference imgs/sec (BASELINE.md ONNX config): a REAL
+torch-exported ResNet-50 graph through the proto codec + converter + jit."""
+import json, sys, time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+sys.path.insert(0, str(Path(__file__).parent.parent / "tests"))
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    import torch
+    from _torch_resnet import export_onnx_bytes, resnet50, resnet_small
+    from synapseml_tpu.onnx import convert_graph
+
+    on_tpu = platform == "tpu"
+    torch.manual_seed(0)
+    model = (resnet50() if on_tpu else resnet_small()).eval()
+    S = 224 if on_tpu else 32
+    data = export_onnx_bytes(model, torch.zeros(1, 3, S, S))
+    conv = convert_graph(data)
+    fn = jax.jit(lambda x: conv(input=x)["logits"])
+    B = 64 if on_tpu else 8
+    x = np.random.default_rng(0).normal(size=(B, 3, S, S)).astype(np.float32)
+    np.asarray(fn(x))  # compile
+    best = 1e9
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(fn(x))
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({"metric": "ONNX ResNet-50 inference" if on_tpu
+                      else "ONNX resnet-small (CPU smoke)",
+                      "value": round(B / best, 1), "unit": "imgs/sec",
+                      "batch": B, "image": S}))
+
+main()

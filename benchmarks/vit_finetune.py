@@ -1,0 +1,45 @@
+"""ViT-B/16 fine-tune throughput (BASELINE.md DeepVisionClassifier config)."""
+import json, sys, time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    from synapseml_tpu.models.flax_nets.vit import ViTClassifier, vit_b16, vit_tiny
+    from synapseml_tpu.models.trainer import Trainer, TrainerConfig
+    from synapseml_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    on_tpu = platform == "tpu"
+    cfg = vit_b16() if on_tpu else vit_tiny()
+    patch = 16 if on_tpu else 8
+    B, S = (64, 224) if on_tpu else (8, 32)
+    model = ViTClassifier(cfg, num_classes=1000 if on_tpu else 10, patch=patch)
+    tr = Trainer(model, create_mesh(MeshConfig(data=-1)),
+                 TrainerConfig(learning_rate=1e-4, total_steps=1000))
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.normal(size=(B, S, S, 3)).astype(np.float32),
+             "labels": rng.integers(0, 10, (B,)).astype(np.int32)}
+    state = tr.init_state(batch)
+    k = 16 if on_tpu else 4
+    stacked = jax.tree.map(lambda x: np.broadcast_to(x, (k,) + x.shape).copy(), batch)
+    st, m = tr.train_steps_scan(state, stacked)
+    float(np.asarray(m["loss"])[-1])  # compile+run
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st, m = tr.train_steps_scan(st, stacked)
+        np.asarray(m["loss"])
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({"metric": "ViT-B/16 fine-tune" if on_tpu else "vit-tiny (CPU smoke)",
+                      "value": round(B * k / best / n_chips, 2),
+                      "unit": "samples/sec/chip", "n_chips": n_chips,
+                      "step_ms": round(best / k * 1e3, 2)}))
+
+main()

@@ -37,14 +37,14 @@ class ViTClassifier(nn.Module):
     patch: int = 16
 
     @nn.compact
-    def __call__(self, images):
+    def __call__(self, x):
         cfg = self.cfg
         x = nn.Conv(cfg.hidden, kernel_size=(self.patch, self.patch),
                     strides=(self.patch, self.patch), dtype=cfg.dtype,
                     param_dtype=cfg.param_dtype,
                     kernel_init=nn.with_logical_partitioning(
                         nn.initializers.xavier_uniform(), (None, None, None, "embed")),
-                    name="patch_embed")(images.astype(cfg.dtype))
+                    name="patch_embed")(x.astype(cfg.dtype))
         B, h, w, _ = x.shape
         x = x.reshape(B, h * w, cfg.hidden)
         cls = self.param("cls", nn.with_logical_partitioning(

@@ -192,3 +192,25 @@ def test_deep_text_attn_impl_ring_on_seq_mesh():
     out = model.transform(df)
     probs = np.asarray(list(out.collect_column("scores")))
     assert probs.shape == (16, 2) and np.all(np.isfinite(probs))
+
+
+def test_deep_vision_classifier_vit_backbone():
+    """ViT through the ESTIMATOR surface (regression: the x-vs-images kwarg
+    mismatch meant vit backbones only worked via direct module calls)."""
+    import synapseml_tpu as st
+    from synapseml_tpu.models import DeepVisionClassifier
+
+    rs = np.random.default_rng(0)
+    rows = []
+    for i in range(16):
+        label = i % 2
+        img = np.full((16, 16, 3), label, np.float32) + \
+            rs.normal(0, 0.1, (16, 16, 3)).astype(np.float32)
+        rows.append({"image": img, "label": label})
+    df = st.DataFrame.from_rows(rows)
+    model = DeepVisionClassifier(backbone="vit_tiny", num_classes=2,
+                                 batch_size=8, max_steps=8,
+                                 learning_rate=3e-3).fit(df)
+    out = model.transform(df)
+    probs = np.asarray(list(out.collect_column("scores")))
+    assert probs.shape == (16, 2) and np.all(np.isfinite(probs))
