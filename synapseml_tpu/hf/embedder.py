@@ -56,6 +56,18 @@ class HuggingFaceSentenceEmbedder(Transformer):
                           converter=TypeConverters.to_int)
     batch_size = Param("batch_size", "rows per padded batch", default=32,
                        converter=TypeConverters.to_int)
+    mesh_config = ComplexParam("mesh_config", "MeshConfig for sharded "
+                               "embedding (params + batches over the mesh)",
+                               default=None)
+
+    _CACHE_KEYS = frozenset({"model_name", "model_params", "tokenizer",
+                             "mesh_config", "pooling", "normalize"})
+
+    def set(self, **kw):
+        out = super().set(**kw)
+        if self._CACHE_KEYS & kw.keys():
+            self.__dict__.pop("_cache_model", None)
+        return out
 
     def _setup(self):
         if self.__dict__.get("_cache_model") is None:
@@ -86,8 +98,22 @@ class HuggingFaceSentenceEmbedder(Transformer):
                 params = enc.net.init(jax.random.PRNGKey(0),
                                       jnp.zeros((1, 8), jnp.int32),
                                       jnp.ones((1, 8), jnp.int32))["params"]
+            mesh = None
+            if self.get("mesh_config") is not None:
+                from ..parallel.mesh import create_mesh, shard_inference_params
 
-            def embed(ids, mask):
+                mesh = create_mesh(self.get("mesh_config"))
+                if self.get("batch_size") % mesh.data_parallel_size():
+                    raise ValueError(
+                        f"batch_size ({self.get('batch_size')}) must be a "
+                        f"multiple of the mesh data-parallel size "
+                        f"({mesh.data_parallel_size()})")
+                params = shard_inference_params(
+                    enc.net, {"input_ids": jnp.zeros((1, 8), jnp.int32),
+                              "attention_mask": jnp.ones((1, 8), jnp.int32)},
+                    params, mesh)
+
+            def embed_fn(ids, mask):
                 h = enc.net.apply({"params": params}, ids, mask)  # [B,T,H]
                 if self.get("pooling") == "cls":
                     pooled = h[:, 0]
@@ -100,7 +126,14 @@ class HuggingFaceSentenceEmbedder(Transformer):
                         jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
                 return pooled
 
-            self.__dict__["_cache_model"] = (jax.jit(embed), tok)
+            jitted = jax.jit(embed_fn)
+            if mesh is not None:
+                def embed(ids, mask, _j=jitted, _m=mesh):
+                    with _m.mesh:
+                        return _j(_m.shard_batch(ids), _m.shard_batch(mask))
+            else:
+                embed = jitted
+            self.__dict__["_cache_model"] = (embed, tok)
         return self.__dict__["_cache_model"]
 
     def _transform(self, df: DataFrame) -> DataFrame:

@@ -195,3 +195,18 @@ def test_causal_lm_sharded_inference_matches_unsharded():
     # mlp kernels shard over tensor too
     up = placed["decoder"]["layer_0"]["mlp"]["up"]["kernel"]
     assert up.addressable_shards[0].data.shape != up.shape
+
+
+def test_sentence_embedder_sharded_matches_unsharded():
+    from synapseml_tpu.hf import HuggingFaceSentenceEmbedder
+    from synapseml_tpu.parallel import MeshConfig
+
+    df = DataFrame.from_rows([{"text": "alpha beta gamma"},
+                              {"text": "delta epsilon"}] * 4)
+    kw = dict(model_name="bert-tiny", max_token_len=16, batch_size=8)
+    plain = HuggingFaceSentenceEmbedder(**kw).transform(df)
+    sharded = HuggingFaceSentenceEmbedder(
+        **kw, mesh_config=MeshConfig(data=-1, fsdp=2)).transform(df)
+    a = np.asarray(list(plain.collect_column("embeddings")))
+    b = np.asarray(list(sharded.collect_column("embeddings")))
+    np.testing.assert_allclose(a, b, atol=1e-5)
