@@ -199,6 +199,20 @@ class TpuBooster:
         return "\n".join(lines)
 
 
+def _checked_monotone(constraints, num_features: int) -> tuple:
+    """Validate per-feature monotone constraints (silent broadcast/clamp under
+    jit would misapply a wrong-length list)."""
+    if constraints is None:
+        return ()
+    out = tuple(int(c) for c in constraints)
+    if len(out) != num_features:
+        raise ValueError(f"monotone_constraints has {len(out)} entries for "
+                         f"{num_features} features")
+    if any(c not in (-1, 0, 1) for c in out):
+        raise ValueError(f"monotone_constraints entries must be -1/0/+1: {out}")
+    return out if any(out) else ()  # all-zero == unconstrained
+
+
 def _device_put_sharded(arr: jax.Array, mesh) -> jax.Array:
     if mesh is None:
         return jnp.asarray(arr)
@@ -227,6 +241,8 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                   boosting_type: str = "gbdt", top_rate: float = 0.2,
                   other_rate: float = 0.1, drop_rate: float = 0.1,
                   max_drop: int = 50, skip_drop: float = 0.5,
+                  monotone_constraints=None, scale_pos_weight: float = 1.0,
+                  is_unbalance: bool = False,
                   measures=None, verbose: bool = False) -> TpuBooster:
     """Grow a forest. The full binned matrix + running scores stay on device
     for the whole run; pass ``mesh`` to shard rows over its ``data`` axis
@@ -270,6 +286,17 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     w_np = np.ones(n + pad, np.float32)
     if weights is not None:
         w_np[:n] = np.asarray(weights, dtype=np.float32)
+    if is_unbalance and scale_pos_weight != 1.0:
+        # match LightGBM: the two knobs conflict
+        raise ValueError("set either is_unbalance or scale_pos_weight, not both")
+    if objective == "binary" and (is_unbalance or scale_pos_weight != 1.0):
+        # positive-class reweighting (reference scalePosWeight/isUnbalance)
+        pos = y[:n] > 0
+        spw = scale_pos_weight
+        if is_unbalance:
+            n_pos = max(int(pos.sum()), 1)
+            spw = (n - n_pos) / n_pos
+        w_np[:n] = np.where(pos, w_np[:n] * spw, w_np[:n])
 
     o = obj.get_objective(objective, num_class=num_class,
                           **({"alpha": objective_alpha} if objective_alpha is not None else {}))
@@ -322,6 +349,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     cfg = T.GrowthConfig(max_depth=max_depth, num_leaves=num_leaves,
                          num_bins=mapper.num_bins, lambda_l1=lambda_l1,
                          lambda_l2=lambda_l2,
+                         monotone_constraints=_checked_monotone(monotone_constraints, f),
                          # rf: no shrinkage, output is averaged (LightGBM forces
                          # shrinkage 1 in rf mode)
                          learning_rate=1.0 if boosting_type == "rf" else learning_rate,

@@ -79,6 +79,9 @@ class _LightGBMParams:
                      default=50, converter=TypeConverters.to_int)
     skip_drop = Param("skip_drop", "dart: probability of skipping dropout",
                       default=0.5, converter=TypeConverters.to_float)
+    monotone_constraints = ComplexParam(
+        "monotone_constraints", "per-feature +1/-1/0 monotonicity "
+        "(reference monotoneConstraints; 'basic' method)", default=None)
     early_stopping_round = Param("early_stopping_round", "stop after k rounds without "
                                  "validation improvement (0=off)", default=0,
                                  converter=TypeConverters.to_int)
@@ -137,6 +140,7 @@ class _LightGBMParams:
             bagging_freq=self.get("bagging_freq"),
             early_stopping_round=self.get("early_stopping_round"),
             boosting_type=self.get("boosting_type"),
+            monotone_constraints=self.get("monotone_constraints"),
             top_rate=self.get("top_rate"), other_rate=self.get("other_rate"),
             drop_rate=self.get("drop_rate"), max_drop=self.get("max_drop"),
             skip_drop=self.get("skip_drop"),
@@ -198,6 +202,12 @@ class LightGBMClassifier(Estimator, _LightGBMParams):
 
     objective = Param("objective", "binary | multiclass (auto-detected from labels "
                       "when left at default)", default="auto")
+    scale_pos_weight = Param("scale_pos_weight", "positive-class weight "
+                             "multiplier (binary)", default=1.0,
+                             converter=TypeConverters.to_float)
+    is_unbalance = Param("is_unbalance", "auto-weight positives by "
+                         "n_neg/n_pos (binary)", default=False,
+                         converter=TypeConverters.to_bool)
     probability_col = Param("probability_col", "class probabilities output column",
                             default="probability")
     raw_prediction_col = Param("raw_prediction_col", "raw margin output column",
@@ -224,7 +234,9 @@ class LightGBMClassifier(Estimator, _LightGBMParams):
 
         booster = train_booster(
             x, y.astype(np.float32), objective=objective, num_class=num_class,
-            weights=w, valid_features=vx, valid_labels=vy, **self._train_kwargs())
+            weights=w, valid_features=vx, valid_labels=vy,
+            scale_pos_weight=self.get("scale_pos_weight"),
+            is_unbalance=self.get("is_unbalance"), **self._train_kwargs())
         model = LightGBMClassificationModel(booster=booster, classes=classes)
         model.set(**{k: v for k, v in self._param_values.items()
                      if model.has_param(k)})

@@ -47,6 +47,10 @@ class GrowthConfig(NamedTuple):
     min_data_in_leaf: int
     min_sum_hessian: float
     min_gain_to_split: float
+    # per-feature monotone constraints (+1/-1/0), () = unconstrained
+    # (reference params/LightGBMParams.scala monotoneConstraints; the 'basic'
+    # method: split-direction gating + child-value midpoint bounds)
+    monotone_constraints: tuple = ()
 
 
 class TreeArrays(NamedTuple):
@@ -107,9 +111,13 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
     B = cfg.num_bins
     num_thresholds = B - 1  # thresholds 0..B-2; the NaN bin is never a left-inclusive cut
 
+    mono = (np.asarray(cfg.monotone_constraints, np.int32)
+            if any(cfg.monotone_constraints) else None)
+
     @jax.jit
     def step(bins, grad, hess, presence, node_of_row, feature, threshold_bin,
-             leaf_value, node_gain, node_cover, feat_mask, leaf_count):
+             leaf_value, node_gain, node_cover, feat_mask, leaf_count,
+             node_lo, node_hi):
         hist = _level_histogram(bins, grad, hess, presence, node_of_row, base, width, B)
         cum = jnp.cumsum(hist, axis=2)  # (W, F, B, 3)
         total = cum[:, 0, -1, :]  # (W, 3) — feature 0's full sum == node totals
@@ -126,6 +134,13 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
         ok = ((cl >= cfg.min_data_in_leaf) & (cr >= cfg.min_data_in_leaf)
               & (hl >= cfg.min_sum_hessian) & (hr >= cfg.min_sum_hessian)
               & feat_mask[None, :, None])
+        if mono is not None:
+            # monotone gating: a split on a constrained feature is only valid
+            # if the would-be child values respect the direction
+            vl = _leaf_value(gl, hl, cfg)
+            vr = _leaf_value(gr, hr, cfg)
+            c = jnp.asarray(mono)[None, :, None]
+            ok &= jnp.where(c > 0, vl <= vr, jnp.where(c < 0, vl >= vr, True))
         gain = jnp.where(ok, gain, -jnp.inf)
 
         flat = gain.reshape(width, -1)
@@ -147,12 +162,38 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
         node_ids = base + jnp.arange(width, dtype=jnp.int32)
         feature = feature.at[node_ids].set(jnp.where(do_split, best_feat, -1))
         threshold_bin = threshold_bin.at[node_ids].set(jnp.where(do_split, best_thr, 0))
-        # active nodes that do not split become final leaves now
-        value = _leaf_value(g_tot, h_tot, cfg)
+        lo = node_lo[node_ids]
+        hi = node_hi[node_ids]
+        # active nodes that do not split become final leaves now (clamped to
+        # the monotone bounds inherited from ancestors)
+        value = jnp.clip(_leaf_value(g_tot, h_tot, cfg), lo, hi)
         leaf_value = leaf_value.at[node_ids].set(jnp.where(active & ~do_split, value, 0.0))
         node_gain = node_gain.at[node_ids].set(jnp.where(do_split, best_gain, 0.0))
         node_cover = node_cover.at[node_ids].set(c_tot)
         leaf_count = leaf_count + jnp.sum(do_split.astype(jnp.int32))
+
+        # propagate monotone bounds to children: on a +1 split the left
+        # subtree is capped at the midpoint and the right floored (basic
+        # method); unconstrained splits inherit the parent bounds
+        left_ids = 2 * node_ids + 1
+        right_ids = 2 * node_ids + 2
+        if mono is not None:
+            bvl = jnp.take_along_axis(
+                _leaf_value(gl, hl, cfg).reshape(width, -1), best_idx[:, None], 1)[:, 0]
+            bvr = jnp.take_along_axis(
+                _leaf_value(gr, hr, cfg).reshape(width, -1), best_idx[:, None], 1)[:, 0]
+            mid = jnp.clip((bvl + bvr) * 0.5, lo, hi)
+            cf = jnp.asarray(mono)[best_feat]
+            l_hi = jnp.where(do_split & (cf > 0), jnp.minimum(hi, mid), hi)
+            r_lo = jnp.where(do_split & (cf > 0), jnp.maximum(lo, mid), lo)
+            l_lo = jnp.where(do_split & (cf < 0), jnp.maximum(lo, mid), lo)
+            r_hi = jnp.where(do_split & (cf < 0), jnp.minimum(hi, mid), hi)
+        else:
+            l_lo, l_hi, r_lo, r_hi = lo, hi, lo, hi
+        node_lo = node_lo.at[left_ids].set(l_lo)
+        node_hi = node_hi.at[left_ids].set(l_hi)
+        node_lo = node_lo.at[right_ids].set(r_lo)
+        node_hi = node_hi.at[right_ids].set(r_hi)
 
         # partition rows of split nodes to children
         here = (node_of_row >= base) & (node_of_row < base + width)
@@ -164,7 +205,7 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
         child = 2 * node_of_row + jnp.where(go_left, 1, 2)
         node_of_row = jnp.where(row_split, child, node_of_row)
         return (node_of_row, feature, threshold_bin, leaf_value, node_gain,
-                node_cover, leaf_count)
+                node_cover, leaf_count, node_lo, node_hi)
 
     return step
 
@@ -174,7 +215,8 @@ def _make_final_level(base: int, width: int, cfg: GrowthConfig):
     just per-node g/h totals)."""
 
     @jax.jit
-    def step(grad, hess, presence, node_of_row, leaf_value, node_cover):
+    def step(grad, hess, presence, node_of_row, leaf_value, node_cover,
+             node_lo, node_hi):
         valid = (node_of_row >= base) & (node_of_row < base + width)
         rel = jnp.where(valid, node_of_row - base, 0)
         zero = jnp.zeros_like(grad)
@@ -182,8 +224,9 @@ def _make_final_level(base: int, width: int, cfg: GrowthConfig):
                           jnp.where(valid, presence, zero)], axis=-1)
         tot = jax.ops.segment_sum(data, rel, num_segments=width)  # (W, 3)
         active = tot[:, 2] > 0
-        value = _leaf_value(tot[:, 0], tot[:, 1], cfg)
         node_ids = base + jnp.arange(width, dtype=jnp.int32)
+        value = jnp.clip(_leaf_value(tot[:, 0], tot[:, 1], cfg),
+                         node_lo[node_ids], node_hi[node_ids])
         return (leaf_value.at[node_ids].set(jnp.where(active, value, 0.0)),
                 node_cover.at[node_ids].set(tot[:, 2]))
 
@@ -209,17 +252,20 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, presence: jax.A
     leaf_value = jnp.zeros(m, jnp.float32)
     node_gain = jnp.zeros(m, jnp.float32)
     node_cover = jnp.zeros(m, jnp.float32)
+    node_lo = jnp.full(m, -jnp.inf, jnp.float32)
+    node_hi = jnp.full(m, jnp.inf, jnp.float32)
     node_of_row = jnp.zeros(bins.shape[0], jnp.int32)
     leaf_count = jnp.asarray(1, jnp.int32)
 
     steps, final = _level_steps(cfg)
     for step in steps:
         (node_of_row, feature, threshold_bin, leaf_value, node_gain, node_cover,
-         leaf_count) = step(
+         leaf_count, node_lo, node_hi) = step(
             bins, grad, hess, presence, node_of_row, feature, threshold_bin,
-            leaf_value, node_gain, node_cover, feat_mask, leaf_count)
+            leaf_value, node_gain, node_cover, feat_mask, leaf_count,
+            node_lo, node_hi)
     leaf_value, node_cover = final(grad, hess, presence, node_of_row,
-                                   leaf_value, node_cover)
+                                   leaf_value, node_cover, node_lo, node_hi)
     return TreeArrays(feature, threshold_bin, leaf_value, node_gain, node_cover)
 
 

@@ -668,3 +668,77 @@ def test_model_cache_invalidated_on_set():
     m.set(model_params=zeroed)
     p2 = np.stack(list(m.transform(df).collect_column("scores")))
     assert not np.allclose(p1, p2)  # new params actually used
+
+
+def test_monotone_constraints_enforced():
+    """monotone_constraints (+1 on f0): predictions must be non-decreasing in
+    f0 along a sweep with other features fixed (reference monotoneConstraints,
+    'basic' method: split gating + midpoint bounds)."""
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    rs = np.random.default_rng(30)
+    N = 1500
+    X = rs.uniform(-2, 2, size=(N, 3))
+    # monotone-increasing signal in f0 with heavy noise (unconstrained trees
+    # will show local violations)
+    y = (X[:, 0] + 0.3 * np.sin(6 * X[:, 0]) + X[:, 1]
+         + 0.6 * rs.normal(size=N)).astype(np.float32)
+    kw = dict(objective="regression", num_iterations=40, learning_rate=0.15,
+              num_leaves=31, seed=0)
+    b_mono = train_booster(X, y, monotone_constraints=[1, 0, 0], **kw)
+
+    sweep = np.linspace(-2, 2, 201)
+    for other in (-1.0, 0.0, 1.0):
+        grid = np.stack([sweep, np.full_like(sweep, other),
+                         np.full_like(sweep, other)], axis=1)
+        pred = np.asarray(b_mono.predict(grid)).ravel()
+        diffs = np.diff(pred)
+        assert np.all(diffs >= -1e-5), \
+            f"monotonicity violated: min diff {diffs.min()}"
+    # still a useful model, not a constant
+    assert np.std(np.asarray(b_mono.predict(X[:200]))) > 0.3
+
+
+def test_scale_pos_weight_and_is_unbalance():
+    """Positive reweighting shifts predicted probabilities upward on an
+    imbalanced binary task (reference scalePosWeight / isUnbalance)."""
+    import synapseml_tpu as st
+    from synapseml_tpu.gbdt import LightGBMClassifier
+
+    rs = np.random.default_rng(31)
+    N = 1000
+    X = rs.normal(size=(N, 4))
+    # noisy imbalanced task (~14% positives): leaves stay impure, so class
+    # weighting actually moves the fitted probabilities
+    y = ((X[:, 0] + rs.normal(0, 1.0, N) > 1.5)).astype(int)
+    df = st.DataFrame.from_rows([{"features": X[i], "label": int(y[i])}
+                                 for i in range(N)])
+    base = LightGBMClassifier(num_iterations=20).fit(df)
+    up = LightGBMClassifier(num_iterations=20, is_unbalance=True).fit(df)
+    p0 = np.stack(list(base.transform(df).collect_column("probability")))[:, 1]
+    p1 = np.stack(list(up.transform(df).collect_column("probability")))[:, 1]
+    assert p1.mean() > p0.mean() + 0.02  # reweighting raised positive mass
+    # recall on positives improves
+    r0 = ((p0 >= 0.5) & (y == 1)).sum() / max(y.sum(), 1)
+    r1 = ((p1 >= 0.5) & (y == 1)).sum() / max(y.sum(), 1)
+    assert r1 >= r0
+
+
+def test_monotone_constraint_validation():
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    X = np.random.default_rng(0).normal(size=(100, 3))
+    y = X[:, 0].astype(np.float32)
+    with pytest.raises(ValueError, match="3 features"):
+        train_booster(X, y, objective="regression", num_iterations=2,
+                      monotone_constraints=[1])
+    with pytest.raises(ValueError, match="-1/0"):
+        train_booster(X, y, objective="regression", num_iterations=2,
+                      monotone_constraints=[2, 0, 0])
+    with pytest.raises(ValueError, match="not both"):
+        train_booster(X, (y > 0).astype(np.float32), objective="binary",
+                      num_iterations=2, is_unbalance=True, scale_pos_weight=5.0)
+    # all-zero == unconstrained (no constrained program compiled)
+    b = train_booster(X, y, objective="regression", num_iterations=2,
+                      monotone_constraints=[0, 0, 0])
+    assert b.num_iterations == 2
