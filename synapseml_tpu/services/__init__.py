@@ -17,6 +17,7 @@ from .openai import (
     OpenAIDefaults,
     OpenAIEmbedding,
     OpenAIPrompt,
+    OpenAIResponses,
 )
 from .text import AnalyzeText, EntityRecognizer, KeyPhraseExtractor, LanguageDetector, TextSentiment
 from .translate import Translate
@@ -56,7 +57,7 @@ from .langchain import LangChainTransformer
 __all__ = [
     "CognitiveServiceBase", "HasAsyncReply",
     "OpenAIChatCompletion", "OpenAICompletion", "OpenAIEmbedding",
-    "OpenAIPrompt", "OpenAIDefaults",
+    "OpenAIPrompt", "OpenAIResponses", "OpenAIDefaults",
     "AnalyzeText", "TextSentiment", "KeyPhraseExtractor", "LanguageDetector",
     "EntityRecognizer", "Translate", "AzureSearchWriter",
     "AnalyzeDocument", "AnalyzeLayout", "AnalyzeReceipts", "AnalyzeInvoices",

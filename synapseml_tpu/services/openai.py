@@ -17,7 +17,7 @@ from ..io.http import HTTPRequest
 from .base import CognitiveServiceBase
 
 __all__ = ["OpenAIChatCompletion", "OpenAICompletion", "OpenAIEmbedding",
-           "OpenAIPrompt", "OpenAIDefaults"]
+           "OpenAIPrompt", "OpenAIResponses", "OpenAIDefaults"]
 
 
 class OpenAIDefaults:
@@ -92,6 +92,42 @@ class OpenAIChatCompletion(_OpenAIBase):
 
     def parse_response(self, payload):
         return payload
+
+
+class OpenAIResponses(_OpenAIBase):
+    """(ref ``OpenAIResponses.scala``) — the /responses API: ``input`` is a
+    string or a messages list; parses ``output[].content[].text``."""
+
+    input_col = Param("input_col", "input column (string or messages list)",
+                      default="input")
+    output_col = Param("output_col", "response text column", default="responses")
+
+    def input_bindings(self):
+        return {"_input": "input_col"}
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        val = rp.get("_input")
+        if val is None:
+            return None
+        if isinstance(val, np.ndarray):
+            val = val.tolist()
+        if isinstance(val, (list, tuple)):
+            val = [dict(m) for m in val]
+        else:
+            val = str(val)
+        body = {"input": val, **self._common_body(rp)}
+        base = (self.get("url") or "").rstrip("/")
+        url = f"{base}/openai/responses?api-version={self.get('api_version')}"
+        return HTTPRequest(url=url, method="POST",
+                           headers=self.auth_headers(rp), entity=json.dumps(body))
+
+    def parse_response(self, payload):
+        try:
+            texts = [c.get("text", "") for item in payload.get("output", [])
+                     for c in item.get("content", []) if c.get("type") == "output_text"]
+            return "".join(texts) if texts else payload
+        except AttributeError:
+            return payload
 
 
 class OpenAICompletion(_OpenAIBase):

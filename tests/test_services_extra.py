@@ -145,6 +145,11 @@ class Handler(BaseHTTPRequestHandler):
         if p.endswith("/cognitiveservices/v1"):  # TTS
             assert b"<speak" in body
             return self._bytes(b"RIFFsynth")
+        if p.endswith("/openai/responses"):
+            body_j = json.loads(body)
+            assert "input" in body_j
+            return self._json({"output": [{"content": [
+                {"type": "output_text", "text": "resp: ok"}]}]})
         if p.endswith("/chat/completions"):
             assert self.headers.get("Authorization") == "Bearer k"
             return self._json({"choices": [{"message": {
@@ -308,3 +313,14 @@ def test_missing_image_input_raises(server):
     df = DataFrame.from_rows([{"img": "x"}])
     with pytest.raises(ValueError, match="image_url_col or"):
         AnalyzeImage(url=server, subscription_key="k").transform(df)
+
+
+def test_openai_responses(server):
+    from synapseml_tpu.services import OpenAIResponses
+
+    df = DataFrame.from_rows([{"input": "hello"},
+                              {"input": [{"role": "user", "content": "hi"}]}])
+    out = OpenAIResponses(url=server, subscription_key="k",
+                          deployment_name="d").transform(df)
+    vals = list(out.collect_column("responses"))
+    assert vals == ["resp: ok", "resp: ok"]
