@@ -1,6 +1,7 @@
 """Flagship benchmark: DeepTextClassifier BERT-base fine-tune throughput.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} and
+exits 0 regardless of TPU-relay state.
 
 Method: K optimizer steps run on-device inside one lax.scan dispatch
 (Trainer.train_steps_scan), so host/tunnel round-trip latency is excluded by
@@ -8,9 +9,21 @@ subtracting the fetch latency of a trivial jitted function (measured on the
 same path); only one scan program is compiled (the remote-compile relay is
 flaky under many compilations).
 
+Hang-proofing (rounds 1+2 both failed to emit a JSON line — r01 raised on
+UNAVAILABLE, r02 hung inside jax.devices() until the driver's rc=124 kill):
+the parent process never imports jax. The measurement runs in a CHILD process
+with two staged deadlines — the backend must come up within BACKEND_UP_TIMEOUT_S
+(a hung relay is detected early), and the result must arrive within the
+child's total budget. Fast transient failures (the relay raising UNAVAILABLE,
+the round-1 mode) are retried with backoff; a hang (the round-2 mode) is
+killed at the deadline and demoted to a CPU child. Note JAX_PLATFORMS=cpu env
+alone is ignored here — sitecustomize pins the tunnel backend at interpreter
+boot — so the CPU child forces jax.config.update("jax_platforms", "cpu")
+in-process. If every child dies, the parent still prints a JSON line.
+
 The reference publishes no hardware numbers for this path (BASELINE.md — the
 horovod.spark BERT fine-tune is only accuracy-gated), so the baseline is this
-framework's own round-1 single-v5e-chip measurement recorded in
+framework's own round-2 single-v5e-chip measurement recorded in
 PERF_BASELINE.json; vs_baseline tracks round-over-round progress.
 """
 
@@ -18,12 +31,26 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
+import threading
 import time
 
 import numpy as np
 
-BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "PERF_BASELINE.json")
+REPO = os.path.dirname(os.path.abspath(__file__))
+BASELINE_FILE = os.path.join(REPO, "PERF_BASELINE.json")
 
+BACKEND_UP_TIMEOUT_S = 75   # deadline for jax.devices() inside the child
+TPU_CHILD_TIMEOUT_S = 420   # full measurement on the chip (~2-4 min when healthy)
+CPU_CHILD_TIMEOUT_S = 360   # bert-tiny smoke on CPU
+TPU_FAST_FAIL_S = 120       # child death this early = transient raise, worth a retry
+TPU_MAX_ATTEMPTS = 2
+
+
+# --------------------------------------------------------------------------
+# child: the actual measurement (runs in a subprocess with staged deadlines)
+# --------------------------------------------------------------------------
 
 def _timed_scan(trainer, state, batch, k):
     import jax
@@ -53,55 +80,10 @@ def _roundtrip_latency(n_trials: int = 5) -> float:
     return float(np.median(ts))
 
 
-def _chip_peak_tflops(device_kind: str):
+def run_bench(devices):
+    import jax
+
     from synapseml_tpu.core.instrumentation import chip_peak_tflops
-
-    return chip_peak_tflops(device_kind)
-
-
-def _init_devices(max_tries: int = 5):
-    """Initialize a jax backend with retry/backoff; fall back to CPU.
-
-    The TPU tunnel is flaky (round-1 bench died on a single UNAVAILABLE at
-    backend init); a bench that can't survive that records nothing. Retries
-    clear any half-initialized backend, back off, and ultimately drop to the
-    CPU smoke path so the driver always gets a JSON line (rc=0).
-    """
-    import jax
-    import jax.extend.backend  # noqa: F401  (jax.extend is not auto-imported)
-
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
-    last_err = None
-    for attempt in range(max_tries):
-        try:
-            devs = jax.devices()
-            if devs:
-                return devs
-        except Exception as e:  # UNAVAILABLE / backend setup errors
-            last_err = e
-            try:
-                jax.extend.backend.clear_backends()
-            except Exception:
-                pass
-            print(f"# backend init failed (try {attempt + 1}/{max_tries}): "
-                  f"{type(last_err).__name__}: {last_err}", flush=True)
-            if attempt + 1 < max_tries:
-                time.sleep(min(10.0 * (2 ** attempt), 120.0))
-    print("# backend unavailable after retries; falling back to CPU", flush=True)
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.extend.backend.clear_backends()
-    except Exception:
-        pass
-    return jax.devices()
-
-
-def run_bench():
-    import jax
-
-    devices = _init_devices()
     from synapseml_tpu.models.flax_nets.bert import BertClassifier, bert_base, bert_tiny
     from synapseml_tpu.models.trainer import Trainer, TrainerConfig
     from synapseml_tpu.parallel.mesh import MeshConfig, create_mesh
@@ -156,49 +138,150 @@ def run_bench():
         "model_tflops_per_sec": round(tflops, 1),
         "final_loss": round(loss, 4),
     }
-    peak = _chip_peak_tflops(getattr(devices[0], "device_kind", "") or "")
+    peak = chip_peak_tflops(getattr(devices[0], "device_kind", "") or "")
     if on_tpu and peak:
         result["mfu"] = round(tflops / n_chips / peak, 4)
     return result
 
 
-def _run_bench_resilient():
-    """One retry on CPU if the TPU path dies mid-bench (compile/scan/fetch can
-    hit the same UNAVAILABLE tunnel flake as backend init)."""
-    try:
-        return run_bench()
-    except Exception as e:
-        print(f"# bench failed on primary backend: {type(e).__name__}: {e}; "
-              f"retrying on CPU", flush=True)
-        import jax
-        import jax.extend.backend
-
-        try:
-            jax.extend.backend.clear_backends()
-        except Exception:
-            pass
-        jax.config.update("jax_platforms", "cpu")
+def _child_main(platform: str) -> None:
+    """Bring up the backend (announce it), measure, print the result line."""
+    if platform == "cpu":
+        # Env vars are NOT enough: the site hook pins the tunnel backend at
+        # interpreter boot, so force the platform through the config API.
         os.environ["JAX_PLATFORMS"] = "cpu"
-        return run_bench()
+    from benchmarks._common import init_jax
+
+    jax, _, _ = init_jax()
+    devices = jax.devices()
+    print("BENCH_UP " + json.dumps(
+        {"platform": devices[0].platform, "n": len(devices),
+         "device_kind": getattr(devices[0], "device_kind", "")}), flush=True)
+    result = run_bench(devices)
+    print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 
-def main():
-    result = _run_bench_resilient()
+# --------------------------------------------------------------------------
+# parent: orchestration (never imports jax, cannot hang)
+# --------------------------------------------------------------------------
+
+def _log(msg: str) -> None:
+    print(f"# {msg}", flush=True)
+
+
+def _run_child(platform: str, up_timeout_s: float, total_timeout_s: float):
+    """Run a bench child with staged deadlines.
+
+    Returns (result-dict-or-None, reason, elapsed_s, killed). The backend
+    must announce BENCH_UP within up_timeout_s (catches a hung relay early)
+    and BENCH_RESULT must arrive within total_timeout_s; `killed` is True
+    when a deadline fired (a hang), False when the child died on its own.
+    """
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", platform],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=REPO,
+    )
+    lines: list = []
+    done = threading.Event()
+
+    def _reader():
+        for line in proc.stdout:
+            lines.append(line.rstrip("\n"))
+        done.set()
+
+    t = threading.Thread(target=_reader, daemon=True)
+    t.start()
+    start = time.monotonic()
+
+    def _find(tag):
+        for line in lines:
+            if line.startswith(tag):
+                try:
+                    return json.loads(line[len(tag):])
+                except json.JSONDecodeError:
+                    continue  # mangled line (interleaved child output); keep scanning
+        return None
+
+    def _kill(why):
+        proc.kill()
+        proc.wait()
+        return None, why, time.monotonic() - start, True
+
+    while time.monotonic() - start < up_timeout_s:
+        if _find("BENCH_UP") or done.is_set():
+            break
+        time.sleep(0.5)
+    else:
+        return _kill(f"backend init exceeded {up_timeout_s}s (relay hang)")
+
+    while time.monotonic() - start < total_timeout_s and not done.is_set():
+        time.sleep(0.5)
+    if not done.is_set():
+        return _kill(f"bench exceeded {total_timeout_s}s")
+    proc.wait()
+
+    result = _find("BENCH_RESULT")
+    if result is not None:
+        return result, None, time.monotonic() - start, False
+    tail = " | ".join(line for line in lines[-6:] if not line.startswith("BENCH_UP"))
+    return None, f"rc={proc.returncode}: {tail[-500:]}", time.monotonic() - start, False
+
+
+def main() -> None:
+    if "--child" in sys.argv:
+        _child_main(sys.argv[sys.argv.index("--child") + 1])
+        return
+
+    reason = None
+    result = None
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        _log("JAX_PLATFORMS=cpu requested; skipping the TPU attempt")
+    else:
+        for attempt in range(TPU_MAX_ATTEMPTS):
+            result, err, elapsed, killed = _run_child(
+                "tpu", BACKEND_UP_TIMEOUT_S, TPU_CHILD_TIMEOUT_S)
+            if result is not None:
+                reason = None  # a retry that succeeded is a clean TPU number
+                break
+            # A fast death is the relay *raising* (round-1 mode): retry with
+            # backoff. A deadline kill is a *hang* (round-2 mode): do not
+            # re-wait, demote to CPU immediately.
+            transient = elapsed < TPU_FAST_FAIL_S and not killed
+            reason = f"tpu attempt {attempt + 1} failed ({err}); cpu fallback"
+            _log(reason)
+            if not (transient and attempt + 1 < TPU_MAX_ATTEMPTS):
+                break
+            time.sleep(20.0)
+
+    if result is None:
+        result, err, _, _ = _run_child("cpu", CPU_CHILD_TIMEOUT_S, CPU_CHILD_TIMEOUT_S)
+        if result is None:
+            _log(f"cpu bench failed too: {err}")
+            result = {
+                "metric": "DeepTextClassifier bert-tiny (CPU smoke)",
+                "value": 0.0, "unit": "samples/sec/chip", "platform": "none",
+                "error": err, "vs_baseline": 0.0,
+            }
+            if reason:
+                result["reason"] = reason
+            print(json.dumps(result), flush=True)
+            return
+
     recorded = {}
     if os.path.exists(BASELINE_FILE):
         try:
             with open(BASELINE_FILE) as f:
                 recorded = json.load(f)
         except (json.JSONDecodeError, OSError) as e:
-            print(f"# ignoring unreadable {BASELINE_FILE}: {e}", flush=True)
+            _log(f"ignoring unreadable {BASELINE_FILE}: {e}")
     baseline = recorded.get(result["metric"])
+    if isinstance(baseline, dict):  # rich entries: {"value": N, ...}
+        baseline = baseline.get("value")
     result["vs_baseline"] = round(result["value"] / baseline, 3) if baseline else 1.0
-    if baseline is None and result["platform"] != "cpu":
-        # seed the round-over-round baseline with the first real TPU number
-        recorded[result["metric"]] = result["value"]
-        with open(BASELINE_FILE, "w") as f:
-            json.dump(recorded, f, indent=1)
-    print(json.dumps(result))
+    if reason:
+        result["reason"] = reason
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
