@@ -123,12 +123,17 @@ class TpuBooster:
         contrib[:, :, -1] += np.asarray(self.init_score, np.float64)
         return contrib
 
-    def predict_leaf(self, features: np.ndarray) -> np.ndarray:
-        """(N, T*K) per-tree leaf node index (reference ``predictLeaf``)."""
+    def predict_leaf(self, features: np.ndarray,
+                     num_iterations: int | None = None) -> np.ndarray:
+        """(N, T*K) per-tree leaf node index (reference ``predictLeaf``).
+        Like ``raw_score``, truncates to ``best_iteration`` by default
+        (LightGBM's ``pred_leaf`` uses the best iteration too)."""
         x = jnp.asarray(np.asarray(features, dtype=np.float32))
-        t, k, m = self.feature.shape
-        feat = jnp.asarray(self.feature.reshape(t * k, m))
-        thr = jnp.asarray(self.threshold_value.reshape(t * k, m))
+        n_it = num_iterations or self.best_iteration or self.num_iterations
+        n_it = min(n_it, self.num_iterations)
+        t, k, m = self.feature[:n_it].shape
+        feat = jnp.asarray(self.feature[:n_it].reshape(t * k, m))
+        thr = jnp.asarray(self.threshold_value[:n_it].reshape(t * k, m))
         return np.asarray(T.leaf_index_forest(x, feat, thr, self.max_depth))
 
     # ---------------- introspection ----------------
@@ -382,6 +387,13 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     # synced every tree's arrays to host). RNG (bagging/feature sampling)
     # lives on-device too, so the whole run can optionally lax.scan.
     key0 = jax.random.PRNGKey(seed)
+    # Disjoint key domains per sampling purpose: folding purpose first, then
+    # iteration, can never collide across purposes (the old 2*it/3*it+2
+    # counter scheme reused identical derived keys, e.g. GOSS it=1 ==
+    # feature-fraction it=2).
+    key_bag = jax.random.fold_in(key0, 0)
+    key_feat = jax.random.fold_in(key0, 1)
+    key_goss = jax.random.fold_in(key0, 2)
     k_feat = max(1, int(round(f * feature_fraction)))
     if boosting_type == "rf" and not (bagging_fraction < 1.0 and bagging_freq > 0):
         # rf requires bagging (LightGBM errors; we default it on)
@@ -394,13 +406,13 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         if do_bag:
             # LightGBM semantics: resample every bagging_freq iters, keep the
             # bag in between
-            bkey = jax.random.fold_in(key0, 2 * (it - it % bagging_freq))
+            bkey = jax.random.fold_in(key_bag, it - it % bagging_freq)
             bag = (jax.random.uniform(bkey, (n + pad,)) <
                    bagging_fraction).astype(jnp.float32)
         else:
             bag = jnp.ones(n + pad, jnp.float32)
         if feature_fraction < 1.0:
-            fkey = jax.random.fold_in(key0, 2 * it + 1)
+            fkey = jax.random.fold_in(key_feat, it)
             ranks = jnp.argsort(jnp.argsort(jax.random.uniform(fkey, (f,))))
             fmask = ranks < k_feat
         else:
@@ -419,7 +431,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                 gmag = jnp.sum(jnp.abs(g), axis=1) * wd * base_presence
                 thresh = jnp.sort(gmag)[-k_top]
                 is_top = gmag >= thresh
-                rkey = jax.random.fold_in(key0, 3 * it + 2)
+                rkey = jax.random.fold_in(key_goss, it)
                 sampled = (~is_top) & (jax.random.uniform(rkey, (n + pad,))
                                        < other_rate)
                 sel = (is_top | sampled).astype(jnp.float32)

@@ -167,12 +167,18 @@ class _LightGBMModelBase(Model, _LightGBMParams):
     def predict_contrib(self, features) -> np.ndarray:
         """Exact TreeSHAP contributions (N, K, F+1) — reference
         ``LightGBMBooster.featuresShap`` surface."""
-        return self.get_booster().predict_contrib(features)
+        b = self.get_booster()
+        if not hasattr(b, "predict_contrib"):
+            raise NotImplementedError(
+                "TreeSHAP contributions need per-node cover statistics, which "
+                "boosters imported from LightGBM model strings don't carry; "
+                "retrain with this library (or score without features_shap_col)")
+        return b.predict_contrib(features)
 
     def _maybe_shap(self, out: dict, x) -> None:
         col = self.get("features_shap_col")
         if col:
-            contrib = self.get_booster().predict_contrib(x)
+            contrib = self.predict_contrib(x)
             # single-output models emit (N, F+1); multiclass (N, K, F+1)
             out[col] = contrib[:, 0, :] if contrib.shape[1] == 1 else contrib
 

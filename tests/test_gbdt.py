@@ -742,3 +742,40 @@ def test_monotone_constraint_validation():
     b = train_booster(X, y, objective="regression", num_iterations=2,
                       monotone_constraints=[0, 0, 0])
     assert b.num_iterations == 2
+
+
+def test_predict_leaf_truncates_to_best_iteration():
+    """predict_leaf follows best_iteration like raw_score (LightGBM defaults
+    pred_leaf to the best iteration too), with num_iterations override."""
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    X, y = _mode_dataset(seed=31, n=250)
+    b = train_booster(X, y, objective="binary", num_iterations=8)
+    assert b.predict_leaf(X[:20]).shape[1] == 8
+    b.best_iteration = 3
+    assert b.predict_leaf(X[:20]).shape[1] == 3
+    assert b.predict_leaf(X[:20], num_iterations=6).shape[1] == 6
+
+
+def test_imported_booster_shap_raises_clearly():
+    """features_shap_col / predict_contrib on an imported booster (no cover
+    stats) raise NotImplementedError, not an AttributeError."""
+    import synapseml_tpu as st
+    from synapseml_tpu.gbdt import (LightGBMClassificationModel,
+                                    LightGBMClassifier, parse_lightgbm_string,
+                                    to_lightgbm_string)
+
+    rs = np.random.default_rng(32)
+    X = rs.normal(size=(120, 3))
+    y = (X[:, 0] > 0).astype(int)
+    df = st.DataFrame.from_rows([{"features": X[i], "label": int(y[i])}
+                                 for i in range(120)])
+    model = LightGBMClassifier(num_iterations=4).fit(df)
+    imported = parse_lightgbm_string(to_lightgbm_string(model.get_booster()))
+    m2 = LightGBMClassificationModel(booster=imported,
+                                     classes=model.get("classes"),
+                                     features_shap_col="shap")
+    with pytest.raises(NotImplementedError, match="cover statistics"):
+        m2.transform(df)
+    with pytest.raises(NotImplementedError, match="cover statistics"):
+        m2.predict_contrib(X)
