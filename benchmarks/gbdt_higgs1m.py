@@ -15,24 +15,27 @@ def main():
     from synapseml_tpu.gbdt.booster import train_booster
     print("platform:", platform, flush=True)
     rng = np.random.default_rng(0)
-    # full Higgs-1M shape on the chip; smoke scale elsewhere
+    # full Higgs-1M shape on the chip; smoke scale elsewhere. AUC is computed
+    # on a HELD-OUT tail (never passed to train_booster), not training rows.
     N, F = (1_000_000, 28) if platform == "tpu" else (50_000, 28)
-    X = rng.normal(size=(N, F)).astype(np.float32)
+    n_test = min(100_000, N // 5)
+    X = rng.normal(size=(N + n_test, F)).astype(np.float32)
     w = rng.normal(size=F); w[F//2:] = 0
-    logits = X @ w * 0.5 + rng.normal(size=N) * 0.5
+    logits = X @ w * 0.5 + rng.normal(size=N + n_test) * 0.5
     y = (logits > 0).astype(np.float32)
     t0 = time.perf_counter()
     n_iter = 100 if platform == "tpu" else 20
-    booster = train_booster(X, y, objective="binary", num_iterations=n_iter,
-                            learning_rate=0.1, num_leaves=31, max_bin=255)
+    booster = train_booster(X[:N], y[:N], objective="binary",
+                            num_iterations=n_iter, learning_rate=0.1,
+                            num_leaves=31, max_bin=255)
     train_s = time.perf_counter() - t0
-    n_pred = min(100_000, N)
+    n_pred = n_test
     t0 = time.perf_counter()
-    p = booster.predict(X[:n_pred])
+    p = booster.predict(X[N:])
     pred_s = time.perf_counter() - t0
-    auc_y, auc_p = y[:n_pred], np.asarray(p).ravel()
-    order = np.argsort(auc_p)
-    ranks = np.empty(len(order)); ranks[order] = np.arange(1, len(order)+1)
+    auc_y, auc_p = y[N:], np.asarray(p).ravel()
+    from scipy.stats import rankdata
+    ranks = rankdata(auc_p)  # average tied ranks (exact Mann-Whitney)
     n1 = auc_y.sum(); n0 = len(auc_y) - n1
     auc = (ranks[auc_y == 1].sum() - n1*(n1+1)/2) / (n1*n0)
     print(json.dumps({"metric": "LightGBM Higgs-1M train" if platform == "tpu"

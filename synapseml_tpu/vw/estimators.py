@@ -20,6 +20,13 @@ from .hashing import hash_feature
 from .featurizer import pack_sparse
 from .learner import LinearConfig, linear_predict, train_linear
 
+
+def _stable_sigmoid(raw: np.ndarray) -> np.ndarray:
+    """Overflow-safe logistic link (the naive form overflows at |raw| > ~88)."""
+    e = np.exp(-np.abs(raw))
+    return np.where(raw >= 0, 1.0 / (1.0 + e), e / (1.0 + e))
+
+
 __all__ = [
     "VowpalWabbitClassifier", "VowpalWabbitClassificationModel",
     "VowpalWabbitRegressor", "VowpalWabbitRegressionModel",
@@ -138,7 +145,7 @@ class VowpalWabbitClassificationModel(_VWModelBase):
 
     def _transform(self, df: DataFrame) -> DataFrame:
         raw = self._raw_scores(df)
-        prob = 1.0 / (1.0 + np.exp(-raw))
+        prob = _stable_sigmoid(raw)
         classes = np.asarray(self.get("classes"))
         pred = classes[(prob >= 0.5).astype(int)]
         return (df.with_column(self.get("raw_prediction_col"), raw)
@@ -251,7 +258,7 @@ class VowpalWabbitGenericModel(_VWModelBase):
         w = jnp.asarray(self.get("model_weights"))
         raw = np.asarray(linear_predict(w, jnp.asarray(idx), jnp.asarray(val)))
         if self.get("loss_function") == "logistic":
-            raw = 1.0 / (1.0 + np.exp(-raw))
+            raw = _stable_sigmoid(raw)
         return df.with_column(self.get("prediction_col"), raw)
 
 
@@ -290,7 +297,7 @@ class VowpalWabbitProgressive(Estimator, _VWBaseParams):
         if logistic:
             # progressive outputs are probabilities for logistic loss
             # (matching VowpalWabbitGenericModel's link function)
-            preds = 1.0 / (1.0 + np.exp(-preds))
+            preds = _stable_sigmoid(preds)
         offsets = np.cumsum([0] + [len(next(iter(p.values()))) for p in df.partitions])
         parts = []
         for i, p in enumerate(df.partitions):

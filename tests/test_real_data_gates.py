@@ -1,0 +1,158 @@
+"""Real-data accuracy gates + cross-engine parity.
+
+The reference pins accuracy on REAL datasets (SURVEY §4;
+``lightgbm/src/test/resources/benchmarks/benchmarks_VerifyLightGBMClassifier
+StreamBasic.csv`` — BreastTissue 0.8774±0.07 etc., and the DL gate
+``deep-learning/src/test/python/synapsemltest/dl/test_deep_text_classifier.py``
+accuracy > 0.5 on real emotion data). The container has no egress, so the
+reference's exact datasets (BreastTissue, Higgs, emotion) can't be fetched;
+these gates use scikit-learn's BUNDLED real datasets instead — breast_cancer
+(569 real clinical records), wine, digits (1797 real handwritten images),
+diabetes (442 real patient records) — which are real measured data, not
+synthetic stand-ins, evaluated on held-out splits.
+
+Cross-engine parity: sklearn's HistGradientBoosting* is an independent
+LightGBM-style histogram GBDT available in-container; matching its held-out
+accuracy on the same split is the locally-falsifiable analog of the
+reference's stock-LightGBM comparisons.
+"""
+
+import numpy as np
+import pytest
+
+from sklearn.datasets import (load_breast_cancer, load_diabetes, load_digits,
+                              load_wine)
+
+import synapseml_tpu as st
+from synapseml_tpu.gbdt.booster import train_booster
+
+from test_benchmark_gates import _assert_gate  # tests/ is a rootdir, not a package
+
+
+def _split(X, y, seed=7, frac=0.75):
+    rs = np.random.default_rng(seed)
+    idx = rs.permutation(len(y))
+    k = int(len(y) * frac)
+    return (X[idx[:k]], y[idx[:k]], X[idx[k:]], y[idx[k:]])
+
+
+def _auc(scores, y):
+    from scipy.stats import rankdata
+
+    ranks = rankdata(scores)  # ties get averaged ranks (exact Mann-Whitney)
+    pos = y == 1
+    n1, n0 = pos.sum(), (~pos).sum()
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+def test_breast_cancer_auc_gate_and_parity():
+    """Binary AUC on real clinical data, held out, gated AND compared against
+    sklearn HistGradientBoosting with the same capacity on the same split."""
+    d = load_breast_cancer()
+    Xtr, ytr, Xte, yte = _split(d.data.astype(np.float32),
+                                d.target.astype(np.float32))
+    b = train_booster(Xtr, ytr, objective="binary", num_iterations=60,
+                      learning_rate=0.1, num_leaves=15, seed=0)
+    ours = _auc(b.predict(Xte).ravel(), yte)
+    _assert_gate("real_breast_cancer_gbdt_auc", ours)
+
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    h = HistGradientBoostingClassifier(
+        max_iter=60, learning_rate=0.1, max_leaf_nodes=15,
+        random_state=0).fit(Xtr, ytr)
+    theirs = _auc(h.predict_proba(Xte)[:, 1], yte)
+    assert ours >= theirs - 0.02, \
+        f"AUC parity vs sklearn HGB: ours {ours:.4f} vs theirs {theirs:.4f}"
+
+
+def test_wine_multiclass_gate():
+    d = load_wine()
+    Xtr, ytr, Xte, yte = _split(d.data.astype(np.float32),
+                                d.target.astype(np.float32))
+    b = train_booster(Xtr, ytr, objective="multiclass", num_class=3,
+                      num_iterations=40, learning_rate=0.1, num_leaves=7,
+                      seed=0)
+    acc = float(np.mean(np.argmax(b.predict(Xte), 1) == yte))
+    _assert_gate("real_wine_gbdt_accuracy", acc)
+
+
+def test_digits_multiclass_gate():
+    d = load_digits()
+    Xtr, ytr, Xte, yte = _split(d.data.astype(np.float32),
+                                d.target.astype(np.float32))
+    b = train_booster(Xtr, ytr, objective="multiclass", num_class=10,
+                      num_iterations=30, learning_rate=0.2, num_leaves=15,
+                      seed=0)
+    acc = float(np.mean(np.argmax(b.predict(Xte), 1) == yte))
+    _assert_gate("real_digits_gbdt_accuracy", acc)
+
+
+def test_diabetes_regression_gate_and_parity():
+    d = load_diabetes()
+    Xtr, ytr, Xte, yte = _split(d.data.astype(np.float32),
+                                d.target.astype(np.float32))
+    b = train_booster(Xtr, ytr, objective="regression", num_iterations=60,
+                      learning_rate=0.1, num_leaves=7, seed=0)
+    rmse = float(np.sqrt(np.mean((b.predict(Xte).ravel() - yte) ** 2)))
+    _assert_gate("real_diabetes_gbdt_rmse", rmse)
+
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    h = HistGradientBoostingRegressor(
+        max_iter=60, learning_rate=0.1, max_leaf_nodes=7,
+        random_state=0).fit(Xtr, ytr)
+    theirs = float(np.sqrt(np.mean((h.predict(Xte) - yte) ** 2)))
+    assert rmse <= theirs * 1.10, \
+        f"RMSE parity vs sklearn HGB: ours {rmse:.2f} vs theirs {theirs:.2f}"
+
+
+def test_vw_breast_cancer_gate():
+    """VW linear classifier through the estimator surface on real data."""
+    from synapseml_tpu.vw.estimators import VowpalWabbitClassifier
+
+    d = load_breast_cancer()
+    X = ((d.data - d.data.mean(0)) / (d.data.std(0) + 1e-9)).astype(np.float32)
+    rs = np.random.default_rng(7)
+    idx = rs.permutation(len(X))
+    k = int(len(X) * 0.75)
+    f = X.shape[1]
+
+    def mk(ix):
+        return st.DataFrame.from_rows(
+            [{"features_indices": np.arange(f, dtype=np.int32),
+              "features_values": X[i], "label": int(d.target[i])}
+             for i in ix])
+
+    m = VowpalWabbitClassifier(num_passes=10, learning_rate=0.5).fit(mk(idx[:k]))
+    out = m.transform(mk(idx[k:]))
+    acc = float(np.mean(out.collect_column("prediction")
+                        == out.collect_column("label")))
+    _assert_gate("real_breast_cancer_vw_accuracy", acc)
+    prob = np.asarray(list(out.collect_column("probability")), np.float64)
+    assert np.all((prob >= 0) & (prob <= 1)) and np.all(np.isfinite(prob))
+
+
+@pytest.mark.slow
+def test_deep_vision_digits_gate():
+    """DeepVisionClassifier fine-tune gate on real handwritten-digit images —
+    the analog of the reference's real-data DL gate (accuracy > 0.5,
+    test_deep_text_classifier.py:48-52); ours pins the measured accuracy."""
+    from synapseml_tpu.models.vision import DeepVisionClassifier
+
+    d = load_digits()
+    imgs = (d.images / 16.0).astype(np.float32)[..., None].repeat(3, -1)
+    rs = np.random.default_rng(7)
+    idx = rs.permutation(len(imgs))
+    tr, te = idx[:1200], idx[1200:]
+    df_tr = st.DataFrame.from_rows(
+        [{"image": imgs[i], "label": int(d.target[i])} for i in tr])
+    df_te = st.DataFrame.from_rows(
+        [{"image": imgs[i], "label": int(d.target[i])} for i in te])
+    m = DeepVisionClassifier(backbone="resnet_tiny", num_classes=10,
+                             batch_size=64, num_train_epochs=4,
+                             learning_rate=3e-3).fit(df_tr)
+    out = m.transform(df_te)
+    acc = float(np.mean(out.collect_column("prediction")
+                        == out.collect_column("label")))
+    _assert_gate("real_digits_resnet_tiny_accuracy", acc)
