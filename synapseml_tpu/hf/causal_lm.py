@@ -17,7 +17,7 @@ import numpy as np
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Transformer
-from ..models.flax_nets.llama import LlamaLM, greedy_generate, llama2_7b, llama_tiny
+from ..models.flax_nets.llama import LlamaLM, generate, llama2_7b, llama_tiny
 __all__ = ["HuggingFaceCausalLM"]
 
 _ARCHS = {"llama2-7b": llama2_7b, "llama-tiny": llama_tiny}
@@ -50,13 +50,29 @@ class HuggingFaceCausalLM(Transformer):
     batch_size = Param("batch_size", "rows per padded device batch", default=8,
                        converter=TypeConverters.to_int)
     eos_id = Param("eos_id", "stop token id", default=None)
+    do_sample = Param("do_sample", "sample instead of greedy decode (the "
+                      "reference forwards HF generate kwargs, "
+                      "HuggingFaceCausalLMTransform.py:284-331)", default=False,
+                      converter=TypeConverters.to_bool)
+    temperature = Param("temperature", "softmax temperature when sampling",
+                        default=1.0, converter=TypeConverters.to_float)
+    top_k = Param("top_k", "restrict sampling to the k most likely tokens "
+                  "(None = no limit)", default=None)
+    top_p = Param("top_p", "nucleus sampling: smallest token set with "
+                  "cumulative probability >= top_p (None = no limit)",
+                  default=None)
+    seed = Param("seed", "on-device RNG seed for sampling; a fixed seed makes "
+                 "sampled generation deterministic", default=0,
+                 converter=TypeConverters.to_int)
     mesh_config = ComplexParam(
         "mesh_config", "MeshConfig for sharded inference: params shard over "
         "tensor/fsdp axes per the logical rules (the Llama-2-7B "
         "sharded-batch-inference BASELINE config)", default=None)
 
     _CACHE_KEYS = frozenset({"model_name", "model_params", "tokenizer",
-                             "mesh_config", "max_new_tokens", "eos_id"})
+                             "mesh_config", "max_new_tokens", "eos_id",
+                             "do_sample", "temperature", "top_k", "top_p",
+                             "seed"})
 
     def set(self, **kw):
         out = super().set(**kw)
@@ -117,11 +133,25 @@ class HuggingFaceCausalLM(Transformer):
         if key not in cache:
             model, params, _, mesh = self._model_and_params()
 
-            def fn(ids, mask):
-                return greedy_generate(model, params, ids,
-                                       self.get("max_new_tokens"),
-                                       eos_id=self.get("eos_id"),
-                                       prompt_mask=mask)
+            sampling = self.get("do_sample")
+            temperature = float(self.get("temperature")) if sampling else 0.0
+            top_k = self.get("top_k")
+            top_p = self.get("top_p")
+            rng = jax.random.PRNGKey(self.get("seed")) if sampling else None
+
+            def fn(ids, mask, offset):
+                # fold the batch's global row offset into the stream so
+                # identical prompts in different batches draw different
+                # samples (same seed + same data stays reproducible)
+                r = None if rng is None else jax.random.fold_in(rng, offset)
+                return generate(model, params, ids,
+                                self.get("max_new_tokens"),
+                                eos_id=self.get("eos_id"),
+                                prompt_mask=mask,
+                                temperature=temperature,
+                                top_k=None if top_k is None else int(top_k),
+                                top_p=None if top_p is None else float(top_p),
+                                rng=r)
 
             jitted = jax.jit(fn)
             if mesh is not None:
@@ -131,10 +161,11 @@ class HuggingFaceCausalLM(Transformer):
                         f"batch_size ({B}) must be a multiple of the mesh "
                         f"data-parallel size ({dp}) for sharded generation")
 
-                def run(ids, mask, _j=jitted, _m=mesh):
+                def run(ids, mask, offset, _j=jitted, _m=mesh):
                     with _m.mesh:
                         # batch shards over data/fsdp; params already placed
-                        return _j(_m.shard_batch(ids), _m.shard_batch(mask))
+                        return _j(_m.shard_batch(ids), _m.shard_batch(mask),
+                                  offset)
 
                 cache[key] = run
             else:
@@ -154,7 +185,7 @@ class HuggingFaceCausalLM(Transformer):
         B = self.get("batch_size")
         bucket = self.get("prompt_bucket")
 
-        def per_part(p):
+        def per_part(p, part_offset):
             n = len(next(iter(p.values()))) if p else 0
             if n == 0:
                 return None
@@ -171,7 +202,7 @@ class HuggingFaceCausalLM(Transformer):
                 pad = B - (e - s)
                 ib = np.pad(ids[s:e], ((0, pad), (0, 0)))
                 mb = np.pad(mask[s:e], ((0, pad), (0, 0)), constant_values=1)
-                gen = np.asarray(fn(ib, mb))[: e - s]
+                gen = np.asarray(fn(ib, mb, np.int32(part_offset + s)))[: e - s]
                 outs.append(gen[:, P:])                     # generated ids only
             gen_ids = np.concatenate(outs, axis=0)
             col = np.empty(n, dtype=object)
@@ -187,7 +218,11 @@ class HuggingFaceCausalLM(Transformer):
             q[self.get("output_col")] = col
             return q
 
-        parts = [per_part(p) for p in df.partitions]
+        offsets = np.cumsum(
+            [0] + [len(next(iter(p.values()))) if p else 0
+                   for p in df.partitions[:-1]])
+        parts = [per_part(p, int(off))
+                 for p, off in zip(df.partitions, offsets)]
         out_parts = []
         for p, q in zip(df.partitions, parts):
             if q is None:

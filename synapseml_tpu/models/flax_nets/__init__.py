@@ -1,12 +1,12 @@
 from .bert import BertClassifier, bert_base, bert_tiny
-from .llama import LlamaLM, greedy_generate, llama2_7b, llama_tiny
+from .llama import LlamaLM, generate, greedy_generate, llama2_7b, llama_tiny
 from .resnet import ResNet, resnet18, resnet50, resnet_tiny
 from .transformer import Attention, Block, Encoder, RMSNorm, TransformerConfig
 from .vit import ViTClassifier, vit_b16, vit_tiny
 
 __all__ = [
     "BertClassifier", "bert_base", "bert_tiny",
-    "LlamaLM", "greedy_generate", "llama2_7b", "llama_tiny",
+    "LlamaLM", "generate", "greedy_generate", "llama2_7b", "llama_tiny",
     "ResNet", "resnet18", "resnet50", "resnet_tiny",
     "Attention", "Block", "Encoder", "RMSNorm", "TransformerConfig",
     "ViTClassifier", "vit_b16", "vit_tiny",

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from .transformer import Encoder, TransformerConfig
 
-__all__ = ["llama2_7b", "llama_tiny", "LlamaLM", "greedy_generate"]
+__all__ = ["llama2_7b", "llama_tiny", "LlamaLM", "generate", "greedy_generate"]
 
 
 def llama2_7b(**kw) -> TransformerConfig:
@@ -58,9 +58,50 @@ class LlamaLM(nn.Module):
         return logits
 
 
-def greedy_generate(model: LlamaLM, params, prompt_ids: jax.Array, max_new_tokens: int,
-                    eos_id: int | None = None,
-                    prompt_mask: jax.Array | None = None) -> jax.Array:
+def _make_selector(temperature: float, top_k: int | None, top_p: float | None):
+    """Token-selection fn [B,V] logits, key -> [B] ids. temperature<=0 is
+    greedy argmax; otherwise categorical sampling with optional top-k then
+    nucleus (top-p) filtering — the reference forwards the same HF generate
+    kwargs (``hf/HuggingFaceCausalLMTransform.py:284-331``). All branches are
+    resolved at trace time (the args are Python constants), so the compiled
+    program contains only the selected path."""
+    if temperature is None or temperature <= 0.0:
+        def select(logits, key):
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return select
+
+    def select(logits, key):
+        l = logits.astype(jnp.float32) / temperature
+        V = l.shape[-1]
+        # sort only the surviving support: top_k bounds the sort width, and
+        # renormalizing inside the kept set (softmax over the k values) is
+        # exactly HF's filter order (top_k mask, then nucleus on the
+        # renormalized remainder)
+        k = top_k if (top_k is not None and 0 < top_k < V) else V
+        if top_p is not None and top_p < 1.0:
+            vals, idx = jax.lax.top_k(l, k)  # [B, k] descending
+            probs = jax.nn.softmax(vals, axis=-1)
+            # keep tokens whose EXCLUSIVE cumulative mass is < top_p (the
+            # highest-prob token always survives)
+            keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+            masked = jnp.where(keep, vals, -jnp.inf)
+            j = jax.random.categorical(key, masked, axis=-1)
+            return jnp.take_along_axis(idx, j[:, None], axis=1)[:, 0].astype(jnp.int32)
+        if k < V:
+            vals, idx = jax.lax.top_k(l, k)
+            j = jax.random.categorical(key, vals, axis=-1)
+            return jnp.take_along_axis(idx, j[:, None], axis=1)[:, 0].astype(jnp.int32)
+        return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
+    return select
+
+
+def generate(model: LlamaLM, params, prompt_ids: jax.Array, max_new_tokens: int,
+             eos_id: int | None = None,
+             prompt_mask: jax.Array | None = None,
+             temperature: float = 0.0,
+             top_k: int | None = None,
+             top_p: float | None = None,
+             rng: jax.Array | None = None) -> jax.Array:
     """Prefill + lax.while_loop decode with KV cache — all static shapes.
 
     prompt_ids: [B, P] padded to a fixed prompt bucket; ``prompt_mask`` [B, P]
@@ -69,6 +110,10 @@ def greedy_generate(model: LlamaLM, params, prompt_ids: jax.Array, max_new_token
     REAL prompt token, not the pad tail. Generated tokens land at P, P+1, …
     regardless of per-row prompt length (uniform layout for unpadding).
     Returns [B, P + max_new_tokens].
+
+    temperature<=0 decodes greedily; otherwise sampling runs fully on-device
+    (jax.random.categorical with a per-step key folded from ``rng``), with
+    optional top_k and nucleus top_p filtering.
     """
     B, P = prompt_ids.shape
     cfg = model.cfg
@@ -81,6 +126,9 @@ def greedy_generate(model: LlamaLM, params, prompt_ids: jax.Array, max_new_token
         prompt_mask = jnp.ones((B, P), jnp.int32)
     prompt_mask = prompt_mask.astype(jnp.int32)
     lengths = jnp.sum(prompt_mask, axis=-1)  # [B]
+    select = _make_selector(temperature, top_k, top_p)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
 
     vars0 = model.init(jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32),
                        positions=jnp.zeros((B, 1), jnp.int32))
@@ -96,7 +144,7 @@ def greedy_generate(model: LlamaLM, params, prompt_ids: jax.Array, max_new_token
                                 positions=prefill_pos, mutable=["cache"],
                                 attention_mask=kv_mask)
     last_real = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-    next_tok = jnp.argmax(last_real, axis=-1).astype(jnp.int32)
+    next_tok = select(last_real, jax.random.fold_in(rng, 0))
 
     total = P + max_new_tokens
     out = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt_ids)
@@ -115,7 +163,7 @@ def greedy_generate(model: LlamaLM, params, prompt_ids: jax.Array, max_new_token
         logits, st = model.apply({"params": params, "cache": cache}, tok,
                                  positions=pos, mutable=["cache"],
                                  attention_mask=kv_mask)
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        nxt = select(logits[:, -1, :], jax.random.fold_in(rng, i + 1))
         if eos_id is not None:
             done = jnp.logical_or(done, nxt == eos_id)
             nxt = jnp.where(done, eos_id, nxt)
@@ -128,3 +176,12 @@ def greedy_generate(model: LlamaLM, params, prompt_ids: jax.Array, max_new_token
     _, out, _, _ = jax.lax.while_loop(cond, body, (jnp.zeros((), jnp.int32), out,
                                                    state["cache"], done0))
     return out
+
+
+def greedy_generate(model: LlamaLM, params, prompt_ids: jax.Array,
+                    max_new_tokens: int, eos_id: int | None = None,
+                    prompt_mask: jax.Array | None = None) -> jax.Array:
+    """Greedy decode — ``generate`` at temperature 0 (kept as the stable
+    name used by serving and tests)."""
+    return generate(model, params, prompt_ids, max_new_tokens, eos_id=eos_id,
+                    prompt_mask=prompt_mask, temperature=0.0)

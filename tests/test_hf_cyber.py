@@ -210,3 +210,106 @@ def test_sentence_embedder_sharded_matches_unsharded():
     a = np.asarray(list(plain.collect_column("embeddings")))
     b = np.asarray(list(sharded.collect_column("embeddings")))
     np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_sampled_generation_deterministic_under_seed():
+    """do_sample with a fixed seed is reproducible; changing the seed changes
+    the sample; top_k=1 sampling equals greedy (ref forwards HF generate
+    kwargs, HuggingFaceCausalLMTransform.py:284-331)."""
+    df = DataFrame.from_dict({"prompt": ["hello world", "the quick brown fox",
+                                         "another prompt here"]})
+    kw = dict(model_name="llama-tiny", max_new_tokens=8, prompt_bucket=8,
+              batch_size=4)
+    lm = HuggingFaceCausalLM(**kw, do_sample=True, temperature=0.9, top_p=0.95,
+                             seed=42)
+    a = [np.asarray(g) for g in lm.transform(df).collect_column("completions")]
+    b = [np.asarray(g) for g in lm.transform(df).collect_column("completions")]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+    lm.set(seed=43)
+    c = [np.asarray(g) for g in lm.transform(df).collect_column("completions")]
+    assert any(not np.array_equal(x, y) for x, y in zip(a, c)), \
+        "different seeds produced identical samples for every row"
+
+    greedy = [np.asarray(g) for g in HuggingFaceCausalLM(**kw).transform(df)
+              .collect_column("completions")]
+    k1 = [np.asarray(g) for g in
+          HuggingFaceCausalLM(**kw, do_sample=True, temperature=0.7, top_k=1,
+                              seed=7).transform(df).collect_column("completions")]
+    for x, y in zip(greedy, k1):
+        np.testing.assert_array_equal(x, y)
+
+    # identical prompts in DIFFERENT batches must draw different samples
+    # (per-batch RNG offset), not replay the same stream
+    dup = DataFrame.from_dict({"prompt": ["the same prompt"] * 3})
+    lm_dup = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=8,
+                                 prompt_bucket=8, batch_size=1, do_sample=True,
+                                 temperature=1.0, seed=5)
+    outs = [np.asarray(g)
+            for g in lm_dup.transform(dup).collect_column("completions")]
+    assert not np.array_equal(outs[0], outs[1]), \
+        "duplicate prompts in different batches replayed identical samples"
+
+
+def test_selector_topk_topp_masking():
+    """top-k and nucleus masks restrict the support exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.flax_nets.llama import _make_selector
+
+    # probs ~ [0.6, 0.3, 0.08, 0.02]
+    logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.08, 0.02]], jnp.float32))
+    keys = jax.random.split(jax.random.PRNGKey(0), 200)
+
+    top_p = _make_selector(1.0, None, 0.5)  # exclusive-cum < 0.5 -> {0}
+    toks = np.asarray([top_p(logits, k)[0] for k in keys[:50]])
+    assert set(toks) == {0}
+
+    top_p2 = _make_selector(1.0, None, 0.7)  # {0, 1}
+    toks = np.asarray([top_p2(logits, k)[0] for k in keys])
+    assert set(toks) <= {0, 1} and len(set(toks)) == 2
+
+    top_k2 = _make_selector(1.0, 2, None)
+    toks = np.asarray([top_k2(logits, k)[0] for k in keys])
+    assert set(toks) <= {0, 1}
+
+    greedy = _make_selector(0.0, None, None)
+    assert int(greedy(logits, keys[0])[0]) == 0
+
+
+@pytest.mark.slow
+def test_llama2_7b_code_path_reduced_width():
+    """Execute the REAL Llama-2-7B code path — all 32 layers, 32 heads, RoPE,
+    SwiGLU, KV cache, sampling — at reduced width, with params sharded over a
+    tensor x fsdp mesh (the BASELINE Llama-2-7B sharded-inference config,
+    previously validated only as an abstract footprint check)."""
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta
+
+    from synapseml_tpu.models.flax_nets.llama import (LlamaLM, generate,
+                                                      llama2_7b)
+    from synapseml_tpu.parallel import MeshConfig
+    from synapseml_tpu.parallel.mesh import create_mesh, shard_inference_params
+
+    cfg = llama2_7b(hidden=128, mlp_dim=344, max_len=64, vocab_size=512)
+    assert cfg.n_layers == 32 and cfg.n_heads == 32  # full 7B depth/structure
+    model = LlamaLM(cfg, decode=True)
+    params = LlamaLM(cfg).init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))["params"]
+    plain = jax.tree.map(lambda x: x.value if isinstance(x, meta.Partitioned) else x,
+                         params, is_leaf=lambda x: isinstance(x, meta.Partitioned))
+    mesh = create_mesh(MeshConfig(data=1, fsdp=2, tensor=4), allow_fewer=False)
+    placed = shard_inference_params(LlamaLM(cfg),
+                                    {"input_ids": jnp.zeros((1, 8), jnp.int32)},
+                                    plain, mesh)
+    B, P = 2, 8
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 512, (B, P)), jnp.int32)
+    with mesh.mesh:
+        out = generate(model, placed, ids, 4, temperature=0.8, top_k=50,
+                       top_p=0.9, rng=jax.random.PRNGKey(1))
+    out = np.asarray(out)
+    assert out.shape == (B, P + 4)
+    assert np.all((out >= 0) & (out < 512))
