@@ -16,3 +16,22 @@ def init_jax():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     devs = jax.devices()
     return jax, devs[0].platform, len(devs)
+
+
+from synapseml_tpu.core.pipeline import Transformer as _Transformer
+
+
+class EchoT(_Transformer):
+    """Picklable trivial Transformer for serving benchmarks (module-level so
+    worker processes can unpickle it by reference)."""
+
+    def _transform(self, df):
+        import numpy as np
+
+        def per_part(p):
+            out = dict(p)
+            out["reply"] = np.asarray([{"ok": True} for _ in p["body"]],
+                                      dtype=object)
+            return out
+
+        return df.map_partitions(per_part)

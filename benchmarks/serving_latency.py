@@ -1,0 +1,60 @@
+"""Serving-plane latency: request->reply p50/p99 for a trivial pipeline.
+
+Reference claim: "sub-millisecond latency" for the serving plane
+(``docs/Deploy Models/Overview.md:151-155``). Measures (a) a single
+``serve_pipeline`` worker hit directly and (b) the distributed plane
+(RoutingFront -> worker) which adds one proxy hop. Prints one JSON line.
+"""
+import json
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+
+
+def _bench(address: str, n: int = 400, warmup: int = 40) -> dict:
+    lat = []
+    body = json.dumps({"x": 1}).encode()
+    for i in range(n + warmup):
+        t0 = time.perf_counter()
+        req = urllib.request.Request(address, data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+        if i >= warmup:
+            lat.append((time.perf_counter() - t0) * 1e3)
+    lat.sort()
+    return {"p50_ms": round(lat[len(lat) // 2], 3),
+            "p99_ms": round(lat[int(len(lat) * 0.99)], 3),
+            "n": n}
+
+
+def main():
+    from _common import EchoT, init_jax
+
+    init_jax()
+    from synapseml_tpu.io.distributed_serving import serve_pipeline_distributed
+    from synapseml_tpu.io.serving import serve_pipeline
+
+    srv = serve_pipeline(EchoT(), batch_interval_ms=0)
+    direct = _bench(srv.address)
+    srv.stop()
+
+    handle = serve_pipeline_distributed(EchoT(), num_workers=2,
+                                        batch_interval_ms=0)
+    try:
+        routed = _bench(handle.address)
+    finally:
+        handle.stop()
+
+    print(json.dumps({"metric": "serving latency (trivial pipeline)",
+                      "direct": direct, "routed_2_workers": routed,
+                      "unit": "ms",
+                      "reference_claim": "sub-millisecond (Overview.md:151)"}))
+
+
+main()

@@ -36,7 +36,9 @@ __all__ = ["WorkerRegistry", "RoutingFront", "serve_pipeline_distributed",
 
 class WorkerRegistry:
     """Driver-side worker registration (DriverServiceUtils analog): workers
-    POST {host, port, pid}; the routing table is the registered list."""
+    POST {host, port, pid}; the routing table is the registered list. A
+    re-registration from the same (host, port) replaces the old entry, so a
+    restarted worker rejoins cleanly."""
 
     def __init__(self):
         self._workers: list[dict] = []
@@ -50,7 +52,11 @@ class WorkerRegistry:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length") or 0)
                 info = json.loads(self.rfile.read(n))
+                key = (info.get("host"), info.get("port"))
                 with registry._lock:
+                    registry._workers = [
+                        w for w in registry._workers
+                        if (w.get("host"), w.get("port")) != key]
                     registry._workers.append(info)
                 body = b"{}"
                 self.send_response(200)
@@ -72,6 +78,11 @@ class WorkerRegistry:
         with self._lock:
             return list(self._workers)
 
+    def remove_pid(self, pid: int) -> None:
+        """Drop a worker whose process is known dead (supervisor callback)."""
+        with self._lock:
+            self._workers = [w for w in self._workers if w.get("pid") != pid]
+
     def wait_for(self, n: int, timeout_s: float = 60.0) -> list[dict]:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
@@ -87,13 +98,33 @@ class WorkerRegistry:
 
 
 class RoutingFront:
-    """One public port; round-robin forwarding to live workers. A worker that
-    fails a request is marked dead and the request retried on the next one."""
+    """One public port; round-robin forwarding to live workers.
 
-    def __init__(self, workers: list[dict], port: int = 0,
-                 timeout_s: float = 60.0):
-        self._workers = list(workers)
-        self._dead: set[int] = set()
+    Reliability semantics (the reference's serve-where-it-lands plane never
+    loses workers permanently, ``DistributedHTTPSource.scala:88-203``):
+
+    * connect failures AND timeouts mark a worker dead for
+      ``resurrect_after_s`` seconds, after which it is probed again
+      (time-based resurrection — a slow-but-alive worker is excluded only
+      briefly, while a blackholed one stops stalling every rotation by
+      ``timeout_s``); any successful reply clears the mark immediately;
+    * when every worker is marked dead the least-recently-failed one is
+      probed anyway (the front degrades to retrying, never to a permanent
+      503);
+    * with a ``registry``, the routing table refreshes from it on every
+      request, so workers registered AFTER startup (restarts, scale-up) are
+      routed to immediately; a static ``workers`` list is merged in (the
+      registry entry wins on a (host, port) collision).
+    """
+
+    def __init__(self, workers: list[dict] | None = None, port: int = 0,
+                 timeout_s: float = 60.0, registry: "WorkerRegistry" = None,
+                 resurrect_after_s: float = 2.0):
+        if workers is None and registry is None:
+            raise ValueError("RoutingFront needs workers and/or a registry")
+        self._static_workers = list(workers or [])
+        self._registry = registry
+        self._dead: dict[tuple, float] = {}  # (host, port) -> time marked
         self._rr = 0
         self._lock = threading.Lock()
         front = self
@@ -105,11 +136,8 @@ class RoutingFront:
             def _forward(self, method: str):
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n) if n else None
-                for _ in range(len(front._workers)):
-                    idx = front._next_worker()
-                    if idx is None:
-                        break
-                    w = front._workers[idx]
+                for w in front._candidates():
+                    key = (w.get("host"), w.get("port"))
                     url = f"http://{w['host']}:{w['port']}{self.path}"
                     req = urllib.request.Request(url, data=body, method=method,
                                                  headers={k: v for k, v in
@@ -118,9 +146,11 @@ class RoutingFront:
                     try:
                         with urllib.request.urlopen(req, timeout=timeout_s) as r:
                             payload = r.read()
+                            with front._lock:
+                                front._dead.pop(key, None)  # proven alive
                             self.send_response(r.status)
                             self.send_header("Content-Length", str(len(payload)))
-                            self.send_header("X-Served-By", str(w.get("pid", idx)))
+                            self.send_header("X-Served-By", str(w.get("pid", "")))
                             self.end_headers()
                             self.wfile.write(payload)
                             return
@@ -133,7 +163,7 @@ class RoutingFront:
                         return
                     except (urllib.error.URLError, OSError):
                         with front._lock:
-                            front._dead.add(idx)  # skip it from now on
+                            front._dead[key] = time.monotonic()
                 self.send_response(503)
                 self.end_headers()
 
@@ -143,21 +173,41 @@ class RoutingFront:
             def do_POST(self):
                 self._forward("POST")
 
+        self._resurrect_after_s = resurrect_after_s
         self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
 
-    def _next_worker(self) -> int | None:
+    def _table(self) -> list[dict]:
+        if self._registry is None:
+            return self._static_workers
+        reg = self._registry.workers()
+        seen = {(w.get("host"), w.get("port")) for w in reg}
+        return reg + [w for w in self._static_workers
+                      if (w.get("host"), w.get("port")) not in seen]
+
+    def _candidates(self) -> list[dict]:
+        """Routing order for one request: alive + resurrection-due workers
+        round-robin rotated; if none, the least-recently-failed worker."""
+        table = self._table()
+        if not table:
+            return []
+        now = time.monotonic()
         with self._lock:
-            n = len(self._workers)
-            for _ in range(n):
-                idx = self._rr % n
-                self._rr += 1
-                if idx not in self._dead:
-                    return idx
-        return None
+            alive = [w for w in table
+                     if (now - self._dead.get((w.get("host"), w.get("port")),
+                                              -1e18)) >= self._resurrect_after_s]
+            self._rr += 1
+            rot = self._rr % max(len(alive), 1)
+        if alive:
+            return alive[rot:] + alive[:rot]
+        # everything recently failed: probe the stalest failure anyway
+        with self._lock:
+            stalest = min(table, key=lambda w: self._dead.get(
+                (w.get("host"), w.get("port")), 0.0))
+        return [stalest]
 
     @property
     def address(self) -> str:
@@ -190,20 +240,63 @@ def worker_main(pipeline_path: str, registry_address: str,
 
 
 class DistributedServing:
-    """Handle owning the registry, worker processes, and routing front."""
+    """Handle owning the registry, worker processes, and routing front.
+
+    A supervisor thread respawns any worker process that dies (the reference
+    relies on Spark re-launching failed executors; here the driver handle does
+    it): the replacement registers itself with the registry on startup and the
+    registry-backed front routes to it immediately."""
 
     def __init__(self, front: RoutingFront, registry: WorkerRegistry,
-                 procs: list, tmp_file: str):
+                 procs: list, tmp_file: str, spawn=None,
+                 supervise_interval_s: float = 0.25):
         self.front = front
         self.registry = registry
         self.procs = procs
         self._tmp_file = tmp_file
+        self._spawn = spawn
+        self._stopping = threading.Event()
+        self._supervisor = None
+        if spawn is not None:
+            self._supervisor = threading.Thread(
+                target=self._supervise, args=(supervise_interval_s,),
+                daemon=True)
+            self._supervisor.start()
+
+    def _supervise(self, interval_s: float) -> None:
+        # per-slot respawn backoff: a worker that keeps dying young (crash on
+        # startup: bad pickle, OOM on load) is respawned at a decaying rate
+        # (doubling delay, capped) instead of ~4 forks/sec forever; a spawn
+        # failure itself never kills the supervisor thread.
+        n = len(self.procs)
+        next_try, delay, spawned = [0.0] * n, [interval_s] * n, [0.0] * n
+        while not self._stopping.wait(interval_s):
+            now = time.monotonic()
+            for i, p in enumerate(self.procs):
+                if p.poll() is None:
+                    if now - spawned[i] > 10.0:
+                        delay[i] = interval_s  # survived long enough: reset
+                    continue
+                if self._stopping.is_set() or now < next_try[i]:
+                    continue
+                self.registry.remove_pid(p.pid)
+                try:
+                    self.procs[i] = self._spawn()
+                    spawned[i] = now
+                except OSError as e:
+                    print(f"# worker respawn failed (slot {i}): {e}",
+                          file=sys.stderr, flush=True)
+                delay[i] = min(delay[i] * 2, 10.0)
+                next_try[i] = now + delay[i]
 
     @property
     def address(self) -> str:
         return self.front.address
 
     def stop(self) -> None:
+        self._stopping.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5)
         self.front.close()
         self.registry.close()
         for p in self.procs:
@@ -246,17 +339,20 @@ def serve_pipeline_distributed(pipeline, num_workers: int = 2,
     if mod_file:
         paths.append(os.path.dirname(os.path.abspath(mod_file)))
     env["PYTHONPATH"] = os.pathsep.join(paths + [env.get("PYTHONPATH", "")])
-    procs = [subprocess.Popen([sys.executable, "-c", code], env=env)
-             for _ in range(num_workers)]
+
+    def spawn():
+        return subprocess.Popen([sys.executable, "-c", code], env=env)
+
+    procs = [spawn() for _ in range(num_workers)]
     try:
-        workers = registry.wait_for(num_workers, timeout_s=startup_timeout_s)
+        registry.wait_for(num_workers, timeout_s=startup_timeout_s)
     except TimeoutError:
         for p in procs:
             p.terminate()
         registry.close()
         raise
-    front = RoutingFront(workers)
-    return DistributedServing(front, registry, procs, path)
+    front = RoutingFront(registry=registry)
+    return DistributedServing(front, registry, procs, path, spawn=spawn)
 
 
 def _free_port() -> int:
