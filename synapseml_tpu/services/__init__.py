@@ -19,9 +19,11 @@ from .openai import (
     OpenAIPrompt,
     OpenAIResponses,
 )
-from .text import AnalyzeText, EntityRecognizer, KeyPhraseExtractor, LanguageDetector, TextSentiment
-from .translate import Translate
-from .search import AzureSearchWriter
+from .text import (AnalyzeText, AnalyzeTextLRO, EntityRecognizer,
+                   KeyPhraseExtractor, LanguageDetector, TextSentiment)
+from .translate import (BreakSentence, DictionaryExamples,
+                        DictionaryLookup, Translate, Transliterate)
+from .search import AzureSearchWriter, infer_index_schema
 from .form import (
     AnalyzeBusinessCards,
     AnalyzeDocument,
@@ -58,8 +60,10 @@ __all__ = [
     "CognitiveServiceBase", "HasAsyncReply",
     "OpenAIChatCompletion", "OpenAICompletion", "OpenAIEmbedding",
     "OpenAIPrompt", "OpenAIResponses", "OpenAIDefaults",
-    "AnalyzeText", "TextSentiment", "KeyPhraseExtractor", "LanguageDetector",
-    "EntityRecognizer", "Translate", "AzureSearchWriter",
+    "AnalyzeText", "AnalyzeTextLRO", "TextSentiment", "KeyPhraseExtractor",
+    "LanguageDetector", "EntityRecognizer", "Translate", "Transliterate",
+    "BreakSentence", "DictionaryLookup", "DictionaryExamples",
+    "AzureSearchWriter", "infer_index_schema",
     "AnalyzeDocument", "AnalyzeLayout", "AnalyzeReceipts", "AnalyzeInvoices",
     "AnalyzeBusinessCards", "AnalyzeIDDocuments", "FormOntologyLearner",
     "FormOntologyTransformer",

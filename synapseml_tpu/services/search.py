@@ -12,7 +12,55 @@ from ..core.params import Param, ServiceParam, TypeConverters
 from ..io.http import AsyncHTTPClient, HTTPRequest
 from .base import CognitiveServiceBase
 
-__all__ = ["AzureSearchWriter"]
+__all__ = ["AzureSearchWriter", "infer_index_schema"]
+
+
+def _edm_type(value) -> str:
+    """Map a sample column value to an EDM field type (the reference infers
+    the index schema from the Spark schema, ``AzureSearch.scala:147``
+    ``sparkTypeToEdmType``)."""
+    if isinstance(value, (bool, np.bool_)):
+        return "Edm.Boolean"
+    if isinstance(value, (int, np.integer)):
+        return "Edm.Int64"
+    if isinstance(value, (float, np.floating)):
+        return "Edm.Double"
+    if isinstance(value, (list, tuple, np.ndarray)):
+        inner = value[0] if len(value) else ""
+        return f"Collection({_edm_type(inner)})"
+    return "Edm.String"
+
+
+def infer_index_schema(df: DataFrame, index_name: str, key_col: str = "id",
+                       action_col: str | None = None,
+                       sample_rows: int = 64) -> dict:
+    """Build the index-definition JSON from the DataFrame's columns (reference
+    ``AzureSearch.scala:147`` generates the fields list the same way; the key
+    field is marked ``key`` and collections are non-sortable). Types come from
+    the first non-None value per column within a bounded sample (this runtime
+    has no static column schema to read, unlike the reference's Spark schema);
+    an all-None column falls back to Edm.String."""
+    rows = df.limit(sample_rows).collect_rows()
+    if not rows:
+        raise ValueError("cannot infer an index schema from an empty DataFrame")
+    if key_col not in rows[0]:
+        raise ValueError(f"key column {key_col!r} not in DataFrame columns "
+                         f"{sorted(rows[0])}")
+    fields = []
+    for name in rows[0]:
+        if name == action_col:
+            continue
+        value = next((r[name] for r in rows if r.get(name) is not None), None)
+        edm = _edm_type(value)
+        field = {"name": name, "type": edm,
+                 "searchable": edm in ("Edm.String", "Collection(Edm.String)"),
+                 "filterable": True, "retrievable": True,
+                 "sortable": not edm.startswith("Collection"),
+                 "facetable": not edm.startswith("Collection")}
+        if name == key_col:
+            field.update(type="Edm.String", key=True, sortable=True)
+        fields.append(field)
+    return {"name": index_name, "fields": fields}
 
 
 class AzureSearchWriter(CognitiveServiceBase):
@@ -24,26 +72,20 @@ class AzureSearchWriter(CognitiveServiceBase):
                        converter=TypeConverters.to_int)
     api_version = Param("api_version", "API version", default="2023-11-01")
     output_col = Param("output_col", "per-batch status column", default="status")
+    create_index_if_not_exists = Param(
+        "create_index_if_not_exists", "before writing, create the target "
+        "index when absent, with a schema inferred from the DataFrame or "
+        "taken from index_json (reference AzureSearchAPI.scala:64 "
+        "createIfNoneExists)", default=False, converter=TypeConverters.to_bool)
+    index_json = Param("index_json", "explicit index definition (dict or JSON "
+                       "string); None = infer from the DataFrame", default=None)
 
     def _endpoint(self) -> str:
         return (f"{(self.get('url') or '').rstrip('/')}/indexes/"
                 f"{self.get('index_name')}/docs/index"
                 f"?api-version={self.get('api_version')}")
 
-    def write(self, df: DataFrame) -> list[dict]:
-        """Push all rows; returns per-batch parsed replies."""
-        self.require_columns(df, self.get("key_col"))
-        client = AsyncHTTPClient(self.get("concurrency"), self.get("timeout_s"))
-        rows = df.collect_rows()
-        action_col = self.get("action_col")
-        docs = []
-        for r in rows:
-            doc = {k: (v.item() if isinstance(v, np.generic) else
-                       v.tolist() if isinstance(v, np.ndarray) else v)
-                   for k, v in r.items() if k != action_col}
-            doc["@search.action"] = (str(r[action_col]) if action_col else "upload")
-            docs.append(doc)
-        B = self.get("batch_size")
+    def _literal_key(self) -> str | None:
         key = self.get("subscription_key")
         if isinstance(key, tuple) and key[0] == "col":
             raise ValueError("AzureSearchWriter: subscription_key must be a "
@@ -51,6 +93,67 @@ class AzureSearchWriter(CognitiveServiceBase):
                              "not a column binding")
         if isinstance(key, tuple) and key[0] == "lit":
             key = key[1]
+        return key
+
+    def ensure_index(self, df: DataFrame, client: AsyncHTTPClient | None = None) -> bool:
+        """Create the index when it doesn't exist (reference
+        ``AzureSearchAPI.scala:64``): list existing index names, POST the
+        definition when absent. Returns True when a create happened."""
+        client = client or AsyncHTTPClient(1, self.get("timeout_s"))
+        base = (self.get("url") or "").rstrip("/")
+        ver = self.get("api_version")
+        key = self._literal_key()
+        headers = {"Content-Type": "application/json",
+                   **({"api-key": key} if key else {})}
+        listing = client.send_all([HTTPRequest(
+            url=f"{base}/indexes?api-version={ver}&$select=name",
+            method="GET", headers=headers)])[0]
+        parsed, err = self.handle_response(listing)
+        if err is not None:
+            raise RuntimeError(f"AzureSearchWriter: listing indexes failed: {err}")
+        names = {i.get("name") for i in (parsed or {}).get("value", [])}
+        if self.get("index_name") in names:
+            return False
+        schema = self.get("index_json")
+        if schema is None:
+            schema = infer_index_schema(df, self.get("index_name"),
+                                        self.get("key_col"),
+                                        self.get("action_col"))
+        elif isinstance(schema, str):
+            schema = json.loads(schema)
+        if schema.get("name") != self.get("index_name"):
+            raise ValueError(f"index_json name {schema.get('name')!r} != "
+                             f"index_name {self.get('index_name')!r}")
+        created = client.send_all([HTTPRequest(
+            url=f"{base}/indexes?api-version={ver}", method="POST",
+            headers=headers, entity=json.dumps(schema))])[0]
+        if created is None or created.status_code != 201:
+            raise RuntimeError(
+                "AzureSearchWriter: index creation failed: "
+                f"{getattr(created, 'status_code', None)} "
+                f"{getattr(created, 'text', '')[:300]}")
+        return True
+
+    def write(self, df: DataFrame) -> list[dict]:
+        """Push all rows; returns per-batch parsed replies."""
+        self.require_columns(df, self.get("key_col"))
+        client = AsyncHTTPClient(self.get("concurrency"), self.get("timeout_s"))
+        if self.get("create_index_if_not_exists"):
+            self.ensure_index(df, client)
+        rows = df.collect_rows()
+        action_col = self.get("action_col")
+        docs = []
+        key_col = self.get("key_col")
+        for r in rows:
+            doc = {k: (v.item() if isinstance(v, np.generic) else
+                       v.tolist() if isinstance(v, np.ndarray) else v)
+                   for k, v in r.items() if k != action_col}
+            # the index key field is always Edm.String (see infer_index_schema)
+            doc[key_col] = str(doc[key_col])
+            doc["@search.action"] = (str(r[action_col]) if action_col else "upload")
+            docs.append(doc)
+        B = self.get("batch_size")
+        key = self._literal_key()
         headers = {"Content-Type": "application/json",
                    **({"api-key": key} if key else {})}
         requests = [HTTPRequest(url=self._endpoint(), method="POST", headers=headers,

@@ -9,10 +9,10 @@ import json
 from ..core.dataframe import DataFrame
 from ..core.params import Param, ServiceParam
 from ..io.http import HTTPRequest
-from .base import CognitiveServiceBase
+from .base import CognitiveServiceBase, HasAsyncReply
 
-__all__ = ["AnalyzeText", "TextSentiment", "KeyPhraseExtractor",
-           "LanguageDetector", "EntityRecognizer"]
+__all__ = ["AnalyzeText", "AnalyzeTextLRO", "TextSentiment",
+           "KeyPhraseExtractor", "LanguageDetector", "EntityRecognizer"]
 
 
 class AnalyzeText(CognitiveServiceBase):
@@ -89,3 +89,58 @@ class EntityRecognizer(AnalyzeText):
     def parse_response(self, payload):
         doc = super().parse_response(payload)
         return doc.get("entities", doc) if isinstance(doc, dict) else doc
+
+
+class AnalyzeTextLRO(HasAsyncReply):
+    """Long-running analyze-text jobs (reference
+    ``language/AnalyzeTextLongRunningOperations.scala:65-145``): PII
+    redaction, healthcare entity extraction, extractive/abstractive
+    summarization. POSTs ``/language/analyze-text/jobs``, polls the
+    operation-location until the job completes, and returns the first task's
+    documents."""
+
+    kind = Param("kind", "PiiEntityRecognition | Healthcare | "
+                 "ExtractiveSummarization | AbstractiveSummarization "
+                 "| EntityRecognition | KeyPhraseExtraction",
+                 default="PiiEntityRecognition")
+    text_col = Param("text_col", "document text column", default="text")
+    language = ServiceParam("language", "document language", default="en")
+    task_parameters = Param("task_parameters", "per-kind task parameters, e.g. "
+                            "{'sentenceCount': 2} for summarization or "
+                            "{'domain': 'phi'} for PII", default=None)
+    api_version = Param("api_version", "API version", default="2023-04-01")
+    output_col = Param("output_col", "result column", default="analysis")
+
+    def input_bindings(self):
+        return {"_text": "text_col"}
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        if rp.get("_text") is None:
+            return None
+        doc = {"id": "0", "language": rp.get("language") or "en",
+               "text": str(rp["_text"])}
+        body = {"analysisInput": {"documents": [doc]},
+                "tasks": [{"kind": self.get("kind"),
+                           "parameters": self.get("task_parameters") or {}}]}
+        url = (f"{(self.get('url') or '').rstrip('/')}"
+               f"/language/analyze-text/jobs?api-version={self.get('api_version')}")
+        return self.json_request(rp, url, body)
+
+    def handle_response(self, resp):
+        parsed, err = super().handle_response(resp)
+        if err is None and parsed is not None:
+            # a completed-but-failed job is still HTTP 200; surface it as an
+            # error, not a result (the raw job state has no task documents, so
+            # parse_response passed it through unchanged)
+            payload = resp.json()
+            if (isinstance(payload, dict)
+                    and str(payload.get("status", "")).lower() == "failed"):
+                return None, (f"analyze-text job failed: "
+                              f"{json.dumps(payload.get('errors', []))[:500]}")
+        return parsed, err
+
+    def parse_response(self, payload):
+        try:
+            return payload["tasks"]["items"][0]["results"]["documents"][0]
+        except (KeyError, IndexError, TypeError):
+            return payload

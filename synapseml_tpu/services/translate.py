@@ -9,7 +9,8 @@ from ..core.params import Param, ServiceParam
 from ..io.http import HTTPRequest
 from .base import CognitiveServiceBase
 
-__all__ = ["Translate"]
+__all__ = ["Translate", "Transliterate", "BreakSentence", "DictionaryLookup",
+           "DictionaryExamples"]
 
 
 class Translate(CognitiveServiceBase):
@@ -38,5 +39,143 @@ class Translate(CognitiveServiceBase):
     def parse_response(self, payload):
         try:
             return [t["text"] for t in payload[0]["translations"]]
+        except (KeyError, IndexError, TypeError):
+            return payload
+
+
+class _TranslatorOp(CognitiveServiceBase):
+    """Shared plumbing for the single-text translator operations (reference
+    ``services/translate/Translate.scala`` sibling transformers)."""
+
+    text_col = Param("text_col", "text column", default="text")
+    api_version = Param("api_version", "API version", default="3.0")
+
+    def input_bindings(self):
+        return {"_text": "text_col"}
+
+    def _query(self, rp: dict) -> str:
+        raise NotImplementedError
+
+    def _path(self) -> str:
+        raise NotImplementedError
+
+    def _require(self, rp: dict, *names: str) -> None:
+        missing = [n for n in names if rp.get(n) in (None, "")]
+        if missing:
+            raise ValueError(f"{type(self).__name__} requires "
+                             f"{', '.join(missing)} to be set")
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        if rp.get("_text") is None:
+            return None
+        url = (f"{(self.get('url') or '').rstrip('/')}/{self._path()}"
+               f"?api-version={self.get('api_version')}{self._query(rp)}")
+        return self.json_request(rp, url, [{"Text": str(rp["_text"])}])
+
+
+class Transliterate(_TranslatorOp):
+    """Convert text between scripts (reference ``Transliterate``):
+    POST /transliterate with language + fromScript + toScript."""
+
+    language = ServiceParam("language", "language of the input text")
+    from_script = ServiceParam("from_script", "script of the input text")
+    to_script = ServiceParam("to_script", "target script")
+    output_col = Param("output_col", "transliteration column",
+                       default="transliteration")
+
+    def _path(self) -> str:
+        return "transliterate"
+
+    def _query(self, rp: dict) -> str:
+        self._require(rp, "language", "from_script", "to_script")
+        return (f"&language={rp['language']}"
+                f"&fromScript={rp['from_script']}"
+                f"&toScript={rp['to_script']}")
+
+    def parse_response(self, payload):
+        try:
+            return payload[0]["text"]
+        except (KeyError, IndexError, TypeError):
+            return payload
+
+
+class BreakSentence(_TranslatorOp):
+    """Sentence boundary lengths (reference ``BreakSentence``):
+    POST /breaksentence -> sentLen list."""
+
+    language = ServiceParam("language", "language hint", default=None)
+    output_col = Param("output_col", "sentence-length column",
+                       default="sent_len")
+
+    def _path(self) -> str:
+        return "breaksentence"
+
+    def _query(self, rp: dict) -> str:
+        return f"&language={rp['language']}" if rp.get("language") else ""
+
+    def parse_response(self, payload):
+        try:
+            return payload[0]["sentLen"]
+        except (KeyError, IndexError, TypeError):
+            return payload
+
+
+class DictionaryLookup(_TranslatorOp):
+    """Alternative translations for a word/phrase (reference
+    ``DictionaryLookup``): POST /dictionary/lookup with from + to."""
+
+    from_language = ServiceParam("from_language", "source language")
+    to_language = ServiceParam("to_language", "target language")
+    output_col = Param("output_col", "translations column",
+                       default="translations")
+
+    def _path(self) -> str:
+        return "dictionary/lookup"
+
+    def _query(self, rp: dict) -> str:
+        self._require(rp, "from_language", "to_language")
+        return (f"&from={rp['from_language']}"
+                f"&to={rp['to_language']}")
+
+    def parse_response(self, payload):
+        try:
+            return [t["normalizedTarget"] for t in payload[0]["translations"]]
+        except (KeyError, IndexError, TypeError):
+            return payload
+
+
+class DictionaryExamples(_TranslatorOp):
+    """Usage examples for a (text, translation) pair (reference
+    ``DictionaryExamples``): POST /dictionary/examples."""
+
+    translation_col = Param("translation_col", "chosen translation column",
+                            default="translation")
+    from_language = ServiceParam("from_language", "source language")
+    to_language = ServiceParam("to_language", "target language")
+    output_col = Param("output_col", "examples column", default="examples")
+
+    def input_bindings(self):
+        return {"_text": "text_col", "_translation": "translation_col"}
+
+    def _path(self) -> str:
+        return "dictionary/examples"
+
+    def _query(self, rp: dict) -> str:
+        self._require(rp, "from_language", "to_language")
+        return (f"&from={rp['from_language']}"
+                f"&to={rp['to_language']}")
+
+    def build_request(self, rp: dict) -> HTTPRequest | None:
+        if rp.get("_text") is None or rp.get("_translation") is None:
+            return None
+        url = (f"{(self.get('url') or '').rstrip('/')}/{self._path()}"
+               f"?api-version={self.get('api_version')}{self._query(rp)}")
+        return self.json_request(rp, url, [{"Text": str(rp["_text"]),
+                                            "Translation": str(rp["_translation"])}])
+
+    def parse_response(self, payload):
+        try:
+            return [e["targetPrefix"] + e["targetTerm"] + e["targetSuffix"]
+                    for e in payload[0]["examples"]]
         except (KeyError, IndexError, TypeError):
             return payload

@@ -1,0 +1,249 @@
+"""Round-3 service breadth against a local mock server: analyze-text LRO jobs
+(PII/healthcare/summarization, reference
+``AnalyzeTextLongRunningOperations.scala``), Azure Search index management
+(``AzureSearchAPI.scala:64`` createIfNoneExists + schema inference from the
+DataFrame, ``AzureSearch.scala:147``), and translator breadth
+(Transliterate/BreakSentence/DictionaryLookup/DictionaryExamples,
+``services/translate/Translate.scala``)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.services import (
+    AnalyzeTextLRO,
+    AzureSearchWriter,
+    BreakSentence,
+    DictionaryExamples,
+    DictionaryLookup,
+    Transliterate,
+    infer_index_schema,
+)
+
+
+class Handler(BaseHTTPRequestHandler):
+    lro: dict = {}
+    indexes: set = set()
+    created_schemas: list = []
+    job_bodies: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, payload, status=200, headers=None):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n)) if n else None
+
+    def do_GET(self):  # noqa: N802
+        p = self.path.split("?")[0]
+        if p.startswith("/language/analyze-text/jobs/"):
+            job = p.rsplit("/", 1)[-1]
+            n = Handler.lro.get(job, 0)
+            Handler.lro[job] = n + 1
+            if n < 1:
+                return self._json({"status": "running"})
+            kind = job.split(":")[0]
+            docs = {"PiiEntityRecognition": {
+                        "id": "0", "redactedText": "my name is ****",
+                        "entities": [{"text": "Satya", "category": "Person"}]},
+                    "Healthcare": {
+                        "id": "0", "entities": [{"text": "ibuprofen",
+                                                 "category": "MedicationName"}]},
+                    "ExtractiveSummarization": {
+                        "id": "0", "sentences": [{"text": "First.",
+                                                  "rankScore": 1.0}]}}
+            return self._json({"status": "succeeded", "tasks": {"items": [
+                {"kind": kind, "results": {"documents": [docs[kind]]}}]}})
+        if p == "/indexes":
+            return self._json({"value": [{"name": n} for n in Handler.indexes]})
+        return self._json({"error": f"unknown GET {p}"}, 404)
+
+    def do_POST(self):  # noqa: N802
+        p = self.path.split("?")[0]
+        body = self._body()
+        host = f"http://{self.headers.get('Host')}"
+        if p == "/language/analyze-text/jobs":
+            kind = body["tasks"][0]["kind"]
+            Handler.job_bodies.append(body)
+            job = f"{kind}:{len(Handler.job_bodies)}"
+            Handler.lro.setdefault(job, 0)
+            return self._json({}, 202, {
+                "Operation-Location": f"{host}/language/analyze-text/jobs/{job}"})
+        if p == "/indexes":
+            assert self.headers.get("api-key") == "k"
+            Handler.created_schemas.append(body)
+            Handler.indexes.add(body["name"])
+            return self._json({"name": body["name"]}, 201)
+        if p.startswith("/indexes/") and p.endswith("/docs/index"):
+            name = p.split("/")[2]
+            if name not in Handler.indexes:
+                return self._json({"error": {"message": "no such index"}}, 404)
+            return self._json({"value": [{"key": d.get("id"), "status": True}
+                                         for d in body["value"]]})
+        if p == "/transliterate":
+            return self._json([{"text": "namaste", "script": "Latn"}])
+        if p == "/breaksentence":
+            text = body[0]["Text"]
+            return self._json([{"sentLen": [len(s) + 1 for s in
+                                            text.split(".") if s]}])
+        if p == "/dictionary/lookup":
+            return self._json([{"translations": [
+                {"normalizedTarget": "volar"}, {"normalizedTarget": "mosca"}]}])
+        if p == "/dictionary/examples":
+            assert body[0]["Translation"] == "volar"
+            return self._json([{"examples": [
+                {"targetPrefix": "Quiero ", "targetTerm": "volar",
+                 "targetSuffix": " hoy."}]}])
+        return self._json({"error": f"unknown POST {p}"}, 404)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.mark.parametrize("kind,check", [
+    ("PiiEntityRecognition",
+     lambda r: r["redactedText"] == "my name is ****"),
+    ("Healthcare",
+     lambda r: r["entities"][0]["category"] == "MedicationName"),
+    ("ExtractiveSummarization",
+     lambda r: r["sentences"][0]["text"] == "First."),
+])
+def test_analyze_text_lro_kinds(server, kind, check):
+    df = DataFrame.from_dict({"text": ["my name is Satya"]})
+    t = AnalyzeTextLRO(url=server, subscription_key="k", kind=kind,
+                       polling_interval_s=0.01,
+                       task_parameters={"modelVersion": "latest"})
+    out = t.transform(df).collect_column("analysis")
+    assert check(out[0]), out[0]
+    sent = Handler.job_bodies[-1]
+    assert sent["tasks"][0]["kind"] == kind
+    assert sent["tasks"][0]["parameters"] == {"modelVersion": "latest"}
+    assert sent["analysisInput"]["documents"][0]["text"] == "my name is Satya"
+
+
+def test_infer_index_schema_types():
+    df = DataFrame.from_rows([{
+        "id": "a", "title": "doc one", "score": 1.5, "views": 7,
+        "flag": True, "tags": ["x", "y"], "vec": np.asarray([0.1, 0.2])}])
+    schema = infer_index_schema(df, "idx", key_col="id")
+    by_name = {f["name"]: f for f in schema["fields"]}
+    assert by_name["id"]["key"] is True
+    assert by_name["id"]["type"] == "Edm.String"
+    assert by_name["title"]["type"] == "Edm.String"
+    assert by_name["score"]["type"] == "Edm.Double"
+    assert by_name["views"]["type"] == "Edm.Int64"
+    assert by_name["flag"]["type"] == "Edm.Boolean"
+    assert by_name["tags"]["type"] == "Collection(Edm.String)"
+    assert by_name["vec"]["type"] == "Collection(Edm.Double)"
+    assert not by_name["tags"]["sortable"]
+    with pytest.raises(ValueError, match="key column"):
+        infer_index_schema(df, "idx", key_col="nope")
+
+
+def test_search_writer_creates_missing_index(server):
+    Handler.indexes.clear()
+    Handler.created_schemas.clear()
+    df = DataFrame.from_rows([{"id": "1", "title": "hello", "score": 0.5},
+                              {"id": "2", "title": "world", "score": 0.9}])
+    w = AzureSearchWriter(url=server, subscription_key="k",
+                          index_name="docs-v1",
+                          create_index_if_not_exists=True, batch_size=1)
+    statuses = w.write(df)
+    assert len(statuses) == 2 and all("error" not in s for s in statuses)
+    assert Handler.created_schemas[0]["name"] == "docs-v1"
+    # second write: index exists now, no second create
+    w.write(df)
+    assert len(Handler.created_schemas) == 1
+
+
+def test_search_writer_without_create_fails_on_missing_index(server):
+    Handler.indexes.clear()
+    df = DataFrame.from_rows([{"id": "1", "title": "x"}])
+    w = AzureSearchWriter(url=server, subscription_key="k",
+                          index_name="absent")
+    with pytest.raises(RuntimeError, match="failed batches"):
+        w._transform(df)
+
+
+def test_transliterate_breaksentence(server):
+    df = DataFrame.from_dict({"text": ["First. Second."]})
+    tr = Transliterate(url=server, subscription_key="k", language="hi",
+                       from_script="Deva", to_script="Latn")
+    assert tr.transform(df).collect_column("transliteration")[0] == "namaste"
+    bs = BreakSentence(url=server, subscription_key="k")
+    lens = bs.transform(df).collect_column("sent_len")[0]
+    assert list(lens) == [6, 8]
+
+
+def test_dictionary_lookup_and_examples(server):
+    df = DataFrame.from_dict({"text": ["fly"], "translation": ["volar"]})
+    dl = DictionaryLookup(url=server, subscription_key="k",
+                          from_language="en", to_language="es")
+    assert list(dl.transform(df).collect_column("translations")[0]) == \
+        ["volar", "mosca"]
+    de = DictionaryExamples(url=server, subscription_key="k",
+                            from_language="en", to_language="es")
+    assert list(de.transform(df).collect_column("examples")[0]) == \
+        ["Quiero volar hoy."]
+
+
+def test_analyze_text_lro_failed_job_is_an_error(server):
+    df = DataFrame.from_dict({"text": ["boom"]})
+    # mock: a kind the GET handler doesn't know -> craft via direct jobs map
+    t = AnalyzeTextLRO(url=server, subscription_key="k",
+                       kind="PiiEntityRecognition", polling_interval_s=0.01)
+    # make the next job report failed status
+    orig_get = Handler.do_GET
+
+    def failing_get(self):
+        p = self.path.split("?")[0]
+        if p.startswith("/language/analyze-text/jobs/"):
+            return self._json({"status": "failed",
+                               "errors": [{"code": "InvalidRequest"}]})
+        return orig_get(self)
+
+    Handler.do_GET = failing_get
+    try:
+        out = t.transform(df)
+        assert out.collect_column("analysis")[0] is None
+        assert "job failed" in out.collect_column("errors")[0]
+    finally:
+        Handler.do_GET = orig_get
+
+
+def test_translator_required_params_fail_fast(server):
+    df = DataFrame.from_dict({"text": ["hi"]})
+    with pytest.raises(ValueError, match="to_script"):
+        Transliterate(url=server, subscription_key="k",
+                      language="hi", from_script="Deva").transform(df)
+    with pytest.raises(ValueError, match="from_language, to_language"):
+        DictionaryLookup(url=server, subscription_key="k").transform(df)
+
+
+def test_infer_index_schema_skips_leading_nones():
+    df = DataFrame.from_rows([{"id": "a", "score": None},
+                              {"id": "b", "score": 2.5}])
+    schema = infer_index_schema(df, "idx", key_col="id")
+    by_name = {f["name"]: f for f in schema["fields"]}
+    assert by_name["score"]["type"] == "Edm.Double"
