@@ -15,12 +15,13 @@ import inspect
 import json
 import os
 import pkgutil
+import shutil
 
 from ..core.params import ComplexParam, Param, ServiceParam
 from ..core.pipeline import Estimator, Model, PipelineStage, Transformer
 
 __all__ = ["discover_stages", "stage_manifest", "generate_markdown_docs",
-           "write_docs"]
+           "write_docs", "emit_wrappers"]
 
 _ABSTRACT = {"PipelineStage", "Transformer", "Estimator", "Model"}
 
@@ -33,6 +34,11 @@ def discover_stages() -> dict[str, type]:
     classes: dict[str, type] = {}
     for modinfo in pkgutil.walk_packages(synapseml_tpu.__path__,
                                          prefix="synapseml_tpu."):
+        # never import __main__ scripts (side effects) or the generated
+        # wrappers themselves
+        if (modinfo.name.endswith("__main__")
+                or modinfo.name.startswith("synapseml_tpu.compat")):
+            continue
         mod = importlib.import_module(modinfo.name)
         for name, obj in vars(mod).items():
             if (inspect.isclass(obj) and issubclass(obj, PipelineStage)
@@ -122,3 +128,115 @@ def write_docs(output_dir: str) -> list[str]:
         json.dump(stage_manifest(), f, indent=2)
     written.append(manifest_path)
     return written
+
+
+# ---------------------------------------------------------------------------
+# wrapper emission (reference Wrappable.scala:56-389 pyGen: emit importable
+# pyspark-style wrapper classes from the stage manifest)
+# ---------------------------------------------------------------------------
+
+# our package -> the reference's python namespace segment
+_NAMESPACE_MAP = {
+    "gbdt": "lightgbm",
+    "image": "opencv",
+    "models": "dl",
+    "io": "io",
+}
+
+_WRAPPER_HEADER = '''"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+'''
+
+
+def _camel(name: str) -> str:
+    parts = name.split("_")
+    return "".join(p.capitalize() if p else "" for p in parts)
+
+
+def emit_wrappers(out_dir: str | None = None) -> list[str]:
+    """Write one wrapper module per reference namespace into
+    ``synapseml_tpu/compat`` (or ``out_dir``); returns written paths."""
+    if out_dir is None:
+        out_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "compat")
+    os.makedirs(out_dir, exist_ok=True)
+    by_ns: dict[str, list] = {}
+    for full_name, cls in sorted(discover_stages().items()):
+        pkg = cls.__module__.split(".")[1]
+        by_ns.setdefault(_NAMESPACE_MAP.get(pkg, pkg), []).append((full_name, cls))
+
+    # non-default out_dir must also carry the runtime base the generated
+    # modules import (the in-tree package has it committed)
+    base_src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "compat", "_base.py")
+    base_dst = os.path.join(out_dir, "_base.py")
+    if os.path.abspath(base_src) != os.path.abspath(base_dst):
+        shutil.copyfile(base_src, base_dst)
+
+    written = []
+    all_modules = []
+    for ns, entries in sorted(by_ns.items()):
+        seen = set()
+        lines = [_WRAPPER_HEADER]
+        for full_name, cls in entries:
+            if cls.__name__ in seen:  # same class re-exported via __init__
+                continue
+            seen.add(cls.__name__)
+            doc = (inspect.getdoc(cls) or "").split("\n")[0].replace('"""', "'")
+            lines.append(f"class {cls.__name__}(WrapperBase):")
+            lines.append(f'    """{doc or cls.__name__} (wraps '
+                         f'``{full_name}``)."""\n')
+            lines.append(f"    _target = {full_name!r}\n")
+            for pname in sorted(cls.params()):
+                camel = _camel(pname)
+                lines.append(f"    def set{camel}(self, value):")
+                lines.append(f"        return self._set({pname!r}, value)\n")
+                lines.append(f"    def get{camel}(self):")
+                lines.append(f"        return self._get({pname!r})\n")
+            lines.append("")
+        path = os.path.join(out_dir, f"{ns}.py")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        written.append(path)
+        all_modules.append(ns)
+
+    init_lines = ['"""Generated pyspark-style wrapper namespace — do not edit.',
+                  "",
+                  "``synapseml_tpu.compat.<ns>`` mirrors the reference's",
+                  "``synapse.ml.<ns>`` Python modules (camelCase setters/getters,",
+                  "chaining). Regenerate with ``python -m synapseml_tpu.codegen``.",
+                  '"""', "",
+                  "import importlib", ""]
+    init_lines.append("_MODULES = %r" % (all_modules,))
+    init_lines.append('''
+
+_REGISTRY = None
+
+
+def wrapper_for(stage_cls):
+    """The generated wrapper class for a native stage class, or None."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        _REGISTRY = {}
+        for ns in _MODULES:
+            mod = importlib.import_module(f"{__name__}.{ns}")
+            for name in dir(mod):
+                obj = getattr(mod, name)
+                if isinstance(obj, type) and getattr(obj, "_target", ""):
+                    _REGISTRY[obj._target] = obj
+    full = f"{stage_cls.__module__}.{stage_cls.__name__}"
+    return _REGISTRY.get(full)
+''')
+    init_path = os.path.join(out_dir, "__init__.py")
+    with open(init_path, "w") as f:
+        f.write("\n".join(init_lines))
+    written.append(init_path)
+    return written
+

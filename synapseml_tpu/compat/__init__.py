@@ -1,0 +1,28 @@
+"""Generated pyspark-style wrapper namespace — do not edit.
+
+``synapseml_tpu.compat.<ns>`` mirrors the reference's
+``synapse.ml.<ns>`` Python modules (camelCase setters/getters,
+chaining). Regenerate with ``python -m synapseml_tpu.codegen``.
+"""
+
+import importlib
+
+_MODULES = ['automl', 'causal', 'core', 'cyber', 'dl', 'explainers', 'exploratory', 'featurize', 'hf', 'io', 'isolationforest', 'lightgbm', 'nn', 'onnx', 'opencv', 'recommendation', 'services', 'stages', 'train', 'vw']
+
+
+_REGISTRY = None
+
+
+def wrapper_for(stage_cls):
+    """The generated wrapper class for a native stage class, or None."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        _REGISTRY = {}
+        for ns in _MODULES:
+            mod = importlib.import_module(f"{__name__}.{ns}")
+            for name in dir(mod):
+                obj = getattr(mod, name)
+                if isinstance(obj, type) and getattr(obj, "_target", ""):
+                    _REGISTRY[obj._target] = obj
+    full = f"{stage_cls.__module__}.{stage_cls.__name__}"
+    return _REGISTRY.get(full)

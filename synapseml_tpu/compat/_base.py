@@ -1,0 +1,80 @@
+"""Runtime base for the generated pyspark-style wrappers (see
+``synapseml_tpu.codegen.emit_wrappers``; reference
+``core/.../codegen/Wrappable.scala:56-389`` emits the analogous Python
+wrapper classes over Scala stages).
+
+A wrapper owns a real stage instance and exposes the reference's surface
+style: camelCase ``setX(value) -> self`` / ``getX()`` accessors, chaining
+construction, and ``fit``/``transform`` that accept and return the same
+DataFrames as the wrapped stage (``fit`` re-wraps the produced model when a
+generated wrapper exists for it).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["WrapperBase"]
+
+
+def _load(path: str):
+    mod, name = path.rsplit(".", 1)
+    return getattr(importlib.import_module(mod), name)
+
+
+def _wrap_result(obj):
+    """Wrap a produced stage (e.g. fit's model) when a wrapper is registered."""
+    from . import wrapper_for
+
+    cls = wrapper_for(type(obj))
+    return cls(_wrapped=obj) if cls is not None else obj
+
+
+class WrapperBase:
+    """Generated subclasses set ``_target`` (full path of the wrapped stage
+    class) and define camelCase accessors calling ``_set``/``_get``."""
+
+    _target: str = ""
+
+    def __init__(self, _wrapped=None, **kwargs):
+        self._stage = _wrapped if _wrapped is not None else _load(self._target)()
+        for k, v in kwargs.items():
+            self._set(_snake(k), v)
+
+    # ---- pyspark-style surface ----
+    def _set(self, name: str, value):
+        self._stage.set(**{name: value})
+        return self
+
+    def _get(self, name: str):
+        return self._stage.get(name)
+
+    def fit(self, df):
+        return _wrap_result(self._stage.fit(df))
+
+    def transform(self, df):
+        return self._stage.transform(df)
+
+    def save(self, path: str):
+        self._stage.save(path)
+        return self
+
+    def unwrap(self):
+        """The underlying native stage."""
+        return self._stage
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._stage!r})"
+
+
+def _snake(name: str) -> str:
+    """setNumIterations/getNumIterations-style camelCase -> num_iterations."""
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    s = "".join(out)
+    return s[1:] if s.startswith("_") else s

@@ -1,0 +1,147 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class BestModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.automl.tune.BestModel``)."""
+
+    _target = 'synapseml_tpu.automl.tune.BestModel'
+
+    def setAllResults(self, value):
+        return self._set('all_results', value)
+
+    def getAllResults(self):
+        return self._get('all_results')
+
+    def setBestMetric(self, value):
+        return self._set('best_metric', value)
+
+    def getBestMetric(self):
+        return self._get('best_metric')
+
+    def setBestModel(self, value):
+        return self._set('best_model', value)
+
+    def getBestModel(self):
+        return self._get('best_model')
+
+    def setBestParams(self, value):
+        return self._set('best_params', value)
+
+    def getBestParams(self):
+        return self._get('best_params')
+
+
+class FindBestModel(WrapperBase):
+    """Pick the best among already-specified models by eval metric (wraps ``synapseml_tpu.automl.tune.FindBestModel``)."""
+
+    _target = 'synapseml_tpu.automl.tune.FindBestModel'
+
+    def setEvaluationMetric(self, value):
+        return self._set('evaluation_metric', value)
+
+    def getEvaluationMetric(self):
+        return self._get('evaluation_metric')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setModels(self, value):
+        return self._set('models', value)
+
+    def getModels(self):
+        return self._get('models')
+
+
+class FindBestModelResult(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.automl.tune.FindBestModelResult``)."""
+
+    _target = 'synapseml_tpu.automl.tune.FindBestModelResult'
+
+    def setAllModelMetrics(self, value):
+        return self._set('all_model_metrics', value)
+
+    def getAllModelMetrics(self):
+        return self._get('all_model_metrics')
+
+    def setBestMetric(self, value):
+        return self._set('best_metric', value)
+
+    def getBestMetric(self):
+        return self._get('best_metric')
+
+    def setBestModel(self, value):
+        return self._set('best_model', value)
+
+    def getBestModel(self):
+        return self._get('best_model')
+
+
+class TuneHyperparameters(WrapperBase):
+    """Random/grid search over (possibly several) learners (wraps ``synapseml_tpu.automl.tune.TuneHyperparameters``)."""
+
+    _target = 'synapseml_tpu.automl.tune.TuneHyperparameters'
+
+    def setEvaluationMetric(self, value):
+        return self._set('evaluation_metric', value)
+
+    def getEvaluationMetric(self):
+        return self._get('evaluation_metric')
+
+    def setHyperparamSpace(self, value):
+        return self._set('hyperparam_space', value)
+
+    def getHyperparamSpace(self):
+        return self._get('hyperparam_space')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setModels(self, value):
+        return self._set('models', value)
+
+    def getModels(self):
+        return self._get('models')
+
+    def setNumRuns(self, value):
+        return self._set('num_runs', value)
+
+    def getNumRuns(self):
+        return self._get('num_runs')
+
+    def setParallelism(self, value):
+        return self._set('parallelism', value)
+
+    def getParallelism(self):
+        return self._get('parallelism')
+
+    def setSearchMode(self, value):
+        return self._set('search_mode', value)
+
+    def getSearchMode(self):
+        return self._get('search_mode')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setValidationFraction(self, value):
+        return self._set('validation_fraction', value)
+
+    def getValidationFraction(self):
+        return self._get('validation_fraction')
+

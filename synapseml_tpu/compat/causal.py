@@ -1,0 +1,351 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class DiffInDiffEstimator(WrapperBase):
+    """(ref ``DiffInDiffEstimator.scala``) (wraps ``synapseml_tpu.causal.did.DiffInDiffEstimator``)."""
+
+    _target = 'synapseml_tpu.causal.did.DiffInDiffEstimator'
+
+    def setOutcomeCol(self, value):
+        return self._set('outcome_col', value)
+
+    def getOutcomeCol(self):
+        return self._get('outcome_col')
+
+    def setPostTreatmentCol(self, value):
+        return self._set('post_treatment_col', value)
+
+    def getPostTreatmentCol(self):
+        return self._get('post_treatment_col')
+
+    def setTreatmentCol(self, value):
+        return self._set('treatment_col', value)
+
+    def getTreatmentCol(self):
+        return self._get('treatment_col')
+
+
+class DiffInDiffModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.causal.did.DiffInDiffModel``)."""
+
+    _target = 'synapseml_tpu.causal.did.DiffInDiffModel'
+
+    def setStandardError(self, value):
+        return self._set('standard_error', value)
+
+    def getStandardError(self):
+        return self._get('standard_error')
+
+    def setTimeWeights(self, value):
+        return self._set('time_weights', value)
+
+    def getTimeWeights(self):
+        return self._get('time_weights')
+
+    def setTreatmentEffect(self, value):
+        return self._set('treatment_effect', value)
+
+    def getTreatmentEffect(self):
+        return self._get('treatment_effect')
+
+    def setUnitWeights(self, value):
+        return self._set('unit_weights', value)
+
+    def getUnitWeights(self):
+        return self._get('unit_weights')
+
+
+class SyntheticControlEstimator(WrapperBase):
+    """(ref ``SyntheticControlEstimator.scala``) — panel data: unit_col x (wraps ``synapseml_tpu.causal.did.SyntheticControlEstimator``)."""
+
+    _target = 'synapseml_tpu.causal.did.SyntheticControlEstimator'
+
+    def setOutcomeCol(self, value):
+        return self._set('outcome_col', value)
+
+    def getOutcomeCol(self):
+        return self._get('outcome_col')
+
+    def setPostTreatmentCol(self, value):
+        return self._set('post_treatment_col', value)
+
+    def getPostTreatmentCol(self):
+        return self._get('post_treatment_col')
+
+    def setRidge(self, value):
+        return self._set('ridge', value)
+
+    def getRidge(self):
+        return self._get('ridge')
+
+    def setTimeCol(self, value):
+        return self._set('time_col', value)
+
+    def getTimeCol(self):
+        return self._get('time_col')
+
+    def setTreatmentCol(self, value):
+        return self._set('treatment_col', value)
+
+    def getTreatmentCol(self):
+        return self._get('treatment_col')
+
+    def setUnitCol(self, value):
+        return self._set('unit_col', value)
+
+    def getUnitCol(self):
+        return self._get('unit_col')
+
+
+class SyntheticDiffInDiffEstimator(WrapperBase):
+    """(ref ``SyntheticDiffInDiffEstimator.scala:28``) (wraps ``synapseml_tpu.causal.did.SyntheticDiffInDiffEstimator``)."""
+
+    _target = 'synapseml_tpu.causal.did.SyntheticDiffInDiffEstimator'
+
+    def setOutcomeCol(self, value):
+        return self._set('outcome_col', value)
+
+    def getOutcomeCol(self):
+        return self._get('outcome_col')
+
+    def setPostTreatmentCol(self, value):
+        return self._set('post_treatment_col', value)
+
+    def getPostTreatmentCol(self):
+        return self._get('post_treatment_col')
+
+    def setRidge(self, value):
+        return self._set('ridge', value)
+
+    def getRidge(self):
+        return self._get('ridge')
+
+    def setTimeCol(self, value):
+        return self._set('time_col', value)
+
+    def getTimeCol(self):
+        return self._get('time_col')
+
+    def setTreatmentCol(self, value):
+        return self._set('treatment_col', value)
+
+    def getTreatmentCol(self):
+        return self._get('treatment_col')
+
+    def setUnitCol(self, value):
+        return self._set('unit_col', value)
+
+    def getUnitCol(self):
+        return self._get('unit_col')
+
+
+class DoubleMLEstimator(WrapperBase):
+    """(ref ``DoubleMLEstimator.scala:63``) (wraps ``synapseml_tpu.causal.dml.DoubleMLEstimator``)."""
+
+    _target = 'synapseml_tpu.causal.dml.DoubleMLEstimator'
+
+    def setConfidenceLevel(self, value):
+        return self._set('confidence_level', value)
+
+    def getConfidenceLevel(self):
+        return self._get('confidence_level')
+
+    def setMaxIter(self, value):
+        return self._set('max_iter', value)
+
+    def getMaxIter(self):
+        return self._get('max_iter')
+
+    def setOutcomeCol(self, value):
+        return self._set('outcome_col', value)
+
+    def getOutcomeCol(self):
+        return self._get('outcome_col')
+
+    def setOutcomeModel(self, value):
+        return self._set('outcome_model', value)
+
+    def getOutcomeModel(self):
+        return self._get('outcome_model')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTreatmentCol(self, value):
+        return self._set('treatment_col', value)
+
+    def getTreatmentCol(self):
+        return self._get('treatment_col')
+
+    def setTreatmentModel(self, value):
+        return self._set('treatment_model', value)
+
+    def getTreatmentModel(self):
+        return self._get('treatment_model')
+
+
+class DoubleMLModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.causal.dml.DoubleMLModel``)."""
+
+    _target = 'synapseml_tpu.causal.dml.DoubleMLModel'
+
+    def setAte(self, value):
+        return self._set('ate', value)
+
+    def getAte(self):
+        return self._get('ate')
+
+    def setCi(self, value):
+        return self._set('ci', value)
+
+    def getCi(self):
+        return self._get('ci')
+
+    def setRawEstimates(self, value):
+        return self._set('raw_estimates', value)
+
+    def getRawEstimates(self):
+        return self._get('raw_estimates')
+
+
+class OrthoForestDMLEstimator(WrapperBase):
+    """(ref ``OrthoForestDMLEstimator.scala:31``) (wraps ``synapseml_tpu.causal.dml.OrthoForestDMLEstimator``)."""
+
+    _target = 'synapseml_tpu.causal.dml.OrthoForestDMLEstimator'
+
+    def setHeterogeneityCols(self, value):
+        return self._set('heterogeneity_cols', value)
+
+    def getHeterogeneityCols(self):
+        return self._get('heterogeneity_cols')
+
+    def setMaxDepth(self, value):
+        return self._set('max_depth', value)
+
+    def getMaxDepth(self):
+        return self._get('max_depth')
+
+    def setMinSamplesLeaf(self, value):
+        return self._set('min_samples_leaf', value)
+
+    def getMinSamplesLeaf(self):
+        return self._get('min_samples_leaf')
+
+    def setNumTrees(self, value):
+        return self._set('num_trees', value)
+
+    def getNumTrees(self):
+        return self._get('num_trees')
+
+    def setOutcomeCol(self, value):
+        return self._set('outcome_col', value)
+
+    def getOutcomeCol(self):
+        return self._get('outcome_col')
+
+    def setOutcomeModel(self, value):
+        return self._set('outcome_model', value)
+
+    def getOutcomeModel(self):
+        return self._get('outcome_model')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTreatmentCol(self, value):
+        return self._set('treatment_col', value)
+
+    def getTreatmentCol(self):
+        return self._get('treatment_col')
+
+    def setTreatmentModel(self, value):
+        return self._set('treatment_model', value)
+
+    def getTreatmentModel(self):
+        return self._get('treatment_model')
+
+
+class OrthoForestDMLModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.causal.dml.OrthoForestDMLModel``)."""
+
+    _target = 'synapseml_tpu.causal.dml.OrthoForestDMLModel'
+
+    def setHeterogeneityCols(self, value):
+        return self._set('heterogeneity_cols', value)
+
+    def getHeterogeneityCols(self):
+        return self._get('heterogeneity_cols')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setTrees(self, value):
+        return self._set('trees', value)
+
+    def getTrees(self):
+        return self._get('trees')
+
+
+class ResidualTransformer(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.causal.residual.ResidualTransformer``)."""
+
+    _target = 'synapseml_tpu.causal.residual.ResidualTransformer'
+
+    def setClassIndex(self, value):
+        return self._set('class_index', value)
+
+    def getClassIndex(self):
+        return self._get('class_index')
+
+    def setObservedCol(self, value):
+        return self._set('observed_col', value)
+
+    def getObservedCol(self):
+        return self._get('observed_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPredictedCol(self, value):
+        return self._set('predicted_col', value)
+
+    def getPredictedCol(self):
+        return self._get('predicted_col')
+

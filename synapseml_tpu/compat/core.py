@@ -1,0 +1,33 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class Pipeline(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.core.pipeline.Pipeline``)."""
+
+    _target = 'synapseml_tpu.core.pipeline.Pipeline'
+
+    def setStages(self, value):
+        return self._set('stages', value)
+
+    def getStages(self):
+        return self._get('stages')
+
+
+class PipelineModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.core.pipeline.PipelineModel``)."""
+
+    _target = 'synapseml_tpu.core.pipeline.PipelineModel'
+
+    def setStages(self, value):
+        return self._set('stages', value)
+
+    def getStages(self):
+        return self._get('stages')
+

@@ -1,0 +1,261 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class AccessAnomaly(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.cyber.anomaly.AccessAnomaly``)."""
+
+    _target = 'synapseml_tpu.cyber.anomaly.AccessAnomaly'
+
+    def setLikelihoodCol(self, value):
+        return self._set('likelihood_col', value)
+
+    def getLikelihoodCol(self):
+        return self._get('likelihood_col')
+
+    def setMaxIter(self, value):
+        return self._set('max_iter', value)
+
+    def getMaxIter(self):
+        return self._get('max_iter')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setRank(self, value):
+        return self._set('rank', value)
+
+    def getRank(self):
+        return self._get('rank')
+
+    def setReg(self, value):
+        return self._set('reg', value)
+
+    def getReg(self):
+        return self._get('reg')
+
+    def setResCol(self, value):
+        return self._set('res_col', value)
+
+    def getResCol(self):
+        return self._get('res_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTenantCol(self, value):
+        return self._set('tenant_col', value)
+
+    def getTenantCol(self):
+        return self._get('tenant_col')
+
+    def setUserCol(self, value):
+        return self._set('user_col', value)
+
+    def getUserCol(self):
+        return self._get('user_col')
+
+
+class AccessAnomalyModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.cyber.anomaly.AccessAnomalyModel``)."""
+
+    _target = 'synapseml_tpu.cyber.anomaly.AccessAnomalyModel'
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setResCol(self, value):
+        return self._set('res_col', value)
+
+    def getResCol(self):
+        return self._get('res_col')
+
+    def setTenantCol(self, value):
+        return self._set('tenant_col', value)
+
+    def getTenantCol(self):
+        return self._get('tenant_col')
+
+    def setTenantModels(self, value):
+        return self._set('tenant_models', value)
+
+    def getTenantModels(self):
+        return self._get('tenant_models')
+
+    def setUserCol(self, value):
+        return self._set('user_col', value)
+
+    def getUserCol(self):
+        return self._get('user_col')
+
+
+class ComplementAccessTransformer(WrapperBase):
+    """(ref ``cyber/anomaly/ComplementAccessTransformer``) — emit (user, res) (wraps ``synapseml_tpu.cyber.anomaly.ComplementAccessTransformer``)."""
+
+    _target = 'synapseml_tpu.cyber.anomaly.ComplementAccessTransformer'
+
+    def setFactor(self, value):
+        return self._set('factor', value)
+
+    def getFactor(self):
+        return self._get('factor')
+
+    def setResCol(self, value):
+        return self._set('res_col', value)
+
+    def getResCol(self):
+        return self._get('res_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTenantCol(self, value):
+        return self._set('tenant_col', value)
+
+    def getTenantCol(self):
+        return self._get('tenant_col')
+
+    def setUserCol(self, value):
+        return self._set('user_col', value)
+
+    def getUserCol(self):
+        return self._get('user_col')
+
+
+class IdIndexer(WrapperBase):
+    """(ref ``cyber/feature/indexers.py``) per-tenant contiguous ids. (wraps ``synapseml_tpu.cyber.features.IdIndexer``)."""
+
+    _target = 'synapseml_tpu.cyber.features.IdIndexer'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setResetPerPartition(self, value):
+        return self._set('reset_per_partition', value)
+
+    def getResetPerPartition(self):
+        return self._get('reset_per_partition')
+
+    def setTenantCol(self, value):
+        return self._set('tenant_col', value)
+
+    def getTenantCol(self):
+        return self._get('tenant_col')
+
+
+class IdIndexerModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.cyber.features.IdIndexerModel``)."""
+
+    _target = 'synapseml_tpu.cyber.features.IdIndexerModel'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setMapping(self, value):
+        return self._set('mapping', value)
+
+    def getMapping(self):
+        return self._get('mapping')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setTenantCol(self, value):
+        return self._set('tenant_col', value)
+
+    def getTenantCol(self):
+        return self._get('tenant_col')
+
+
+class PartitionedMinMaxScaler(WrapperBase):
+    """(ref ``cyber/feature/scalers.py`` LinearScalarScaler) (wraps ``synapseml_tpu.cyber.features.PartitionedMinMaxScaler``)."""
+
+    _target = 'synapseml_tpu.cyber.features.PartitionedMinMaxScaler'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setMaxValue(self, value):
+        return self._set('max_value', value)
+
+    def getMaxValue(self):
+        return self._get('max_value')
+
+    def setMinValue(self, value):
+        return self._set('min_value', value)
+
+    def getMinValue(self):
+        return self._get('min_value')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setTenantCol(self, value):
+        return self._set('tenant_col', value)
+
+    def getTenantCol(self):
+        return self._get('tenant_col')
+
+
+class PartitionedStandardScaler(WrapperBase):
+    """(ref ``cyber/feature/scalers.py`` StandardScalarScaler) (wraps ``synapseml_tpu.cyber.features.PartitionedStandardScaler``)."""
+
+    _target = 'synapseml_tpu.cyber.features.PartitionedStandardScaler'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setTenantCol(self, value):
+        return self._set('tenant_col', value)
+
+    def getTenantCol(self):
+        return self._get('tenant_col')
+

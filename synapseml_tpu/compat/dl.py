@@ -1,0 +1,363 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class DeepTextClassifier(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.models.text.DeepTextClassifier``)."""
+
+    _target = 'synapseml_tpu.models.text.DeepTextClassifier'
+
+    def setAttnImpl(self, value):
+        return self._set('attn_impl', value)
+
+    def getAttnImpl(self):
+        return self._get('attn_impl')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setCheckpoint(self, value):
+        return self._set('checkpoint', value)
+
+    def getCheckpoint(self):
+        return self._get('checkpoint')
+
+    def setGradAccum(self, value):
+        return self._set('grad_accum', value)
+
+    def getGradAccum(self):
+        return self._get('grad_accum')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setMaxSteps(self, value):
+        return self._set('max_steps', value)
+
+    def getMaxSteps(self):
+        return self._get('max_steps')
+
+    def setMaxTokenLen(self, value):
+        return self._set('max_token_len', value)
+
+    def getMaxTokenLen(self):
+        return self._get('max_token_len')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setNumClasses(self, value):
+        return self._set('num_classes', value)
+
+    def getNumClasses(self):
+        return self._get('num_classes')
+
+    def setNumTrainEpochs(self, value):
+        return self._set('num_train_epochs', value)
+
+    def getNumTrainEpochs(self):
+        return self._get('num_train_epochs')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setScoresCol(self, value):
+        return self._set('scores_col', value)
+
+    def getScoresCol(self):
+        return self._get('scores_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTokenizer(self, value):
+        return self._set('tokenizer', value)
+
+    def getTokenizer(self):
+        return self._get('tokenizer')
+
+    def setUnfreezeLayers(self, value):
+        return self._set('unfreeze_layers', value)
+
+    def getUnfreezeLayers(self):
+        return self._get('unfreeze_layers')
+
+    def setWeightDecay(self, value):
+        return self._set('weight_decay', value)
+
+    def getWeightDecay(self):
+        return self._get('weight_decay')
+
+
+class DeepTextModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.models.text.DeepTextModel``)."""
+
+    _target = 'synapseml_tpu.models.text.DeepTextModel'
+
+    def setArchConfig(self, value):
+        return self._set('arch_config', value)
+
+    def getArchConfig(self):
+        return self._get('arch_config')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setCheckpoint(self, value):
+        return self._set('checkpoint', value)
+
+    def getCheckpoint(self):
+        return self._get('checkpoint')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setMaxTokenLen(self, value):
+        return self._set('max_token_len', value)
+
+    def getMaxTokenLen(self):
+        return self._get('max_token_len')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setModelParams(self, value):
+        return self._set('model_params', value)
+
+    def getModelParams(self):
+        return self._get('model_params')
+
+    def setNumClasses(self, value):
+        return self._set('num_classes', value)
+
+    def getNumClasses(self):
+        return self._get('num_classes')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setScoresCol(self, value):
+        return self._set('scores_col', value)
+
+    def getScoresCol(self):
+        return self._get('scores_col')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTokenizerConfig(self, value):
+        return self._set('tokenizer_config', value)
+
+    def getTokenizerConfig(self):
+        return self._get('tokenizer_config')
+
+    def setTrainMetrics(self, value):
+        return self._set('train_metrics', value)
+
+    def getTrainMetrics(self):
+        return self._get('train_metrics')
+
+
+class DeepVisionClassifier(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.models.vision.DeepVisionClassifier``)."""
+
+    _target = 'synapseml_tpu.models.vision.DeepVisionClassifier'
+
+    def setBackbone(self, value):
+        return self._set('backbone', value)
+
+    def getBackbone(self):
+        return self._get('backbone')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setImageCol(self, value):
+        return self._set('image_col', value)
+
+    def getImageCol(self):
+        return self._get('image_col')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setMaxSteps(self, value):
+        return self._set('max_steps', value)
+
+    def getMaxSteps(self):
+        return self._get('max_steps')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setNumClasses(self, value):
+        return self._set('num_classes', value)
+
+    def getNumClasses(self):
+        return self._get('num_classes')
+
+    def setNumTrainEpochs(self, value):
+        return self._set('num_train_epochs', value)
+
+    def getNumTrainEpochs(self):
+        return self._get('num_train_epochs')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setScoresCol(self, value):
+        return self._set('scores_col', value)
+
+    def getScoresCol(self):
+        return self._get('scores_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+
+class DeepVisionModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.models.vision.DeepVisionModel``)."""
+
+    _target = 'synapseml_tpu.models.vision.DeepVisionModel'
+
+    def setArchSpec(self, value):
+        return self._set('arch_spec', value)
+
+    def getArchSpec(self):
+        return self._get('arch_spec')
+
+    def setBackbone(self, value):
+        return self._set('backbone', value)
+
+    def getBackbone(self):
+        return self._get('backbone')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setBatchStats(self, value):
+        return self._set('batch_stats', value)
+
+    def getBatchStats(self):
+        return self._get('batch_stats')
+
+    def setImageCol(self, value):
+        return self._set('image_col', value)
+
+    def getImageCol(self):
+        return self._get('image_col')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setModelParams(self, value):
+        return self._set('model_params', value)
+
+    def getModelParams(self):
+        return self._get('model_params')
+
+    def setNumClasses(self, value):
+        return self._set('num_classes', value)
+
+    def getNumClasses(self):
+        return self._get('num_classes')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setScoresCol(self, value):
+        return self._set('scores_col', value)
+
+    def getScoresCol(self):
+        return self._get('scores_col')
+
+    def setTrainMetrics(self, value):
+        return self._set('train_metrics', value)
+
+    def getTrainMetrics(self):
+        return self._get('train_metrics')
+

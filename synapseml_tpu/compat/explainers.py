@@ -1,0 +1,639 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class LocalExplainerBase(WrapperBase):
+    """Common params + the one-shot scoring path: ALL samples for a partition (wraps ``synapseml_tpu.explainers.base.LocalExplainerBase``)."""
+
+    _target = 'synapseml_tpu.explainers.base.LocalExplainerBase'
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setNumSamples(self, value):
+        return self._set('num_samples', value)
+
+    def getNumSamples(self):
+        return self._get('num_samples')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTargetClasses(self, value):
+        return self._set('target_classes', value)
+
+    def getTargetClasses(self):
+        return self._get('target_classes')
+
+    def setTargetCol(self, value):
+        return self._set('target_col', value)
+
+    def getTargetCol(self):
+        return self._get('target_col')
+
+
+class ICETransformer(WrapperBase):
+    """Common params + the one-shot scoring path: ALL samples for a partition (wraps ``synapseml_tpu.explainers.ice.ICETransformer``)."""
+
+    _target = 'synapseml_tpu.explainers.ice.ICETransformer'
+
+    def setCategoricalFeatures(self, value):
+        return self._set('categorical_features', value)
+
+    def getCategoricalFeatures(self):
+        return self._get('categorical_features')
+
+    def setKind(self, value):
+        return self._set('kind', value)
+
+    def getKind(self):
+        return self._get('kind')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setNumSamples(self, value):
+        return self._set('num_samples', value)
+
+    def getNumSamples(self):
+        return self._get('num_samples')
+
+    def setNumSplits(self, value):
+        return self._set('num_splits', value)
+
+    def getNumSplits(self):
+        return self._get('num_splits')
+
+    def setNumericFeatures(self, value):
+        return self._set('numeric_features', value)
+
+    def getNumericFeatures(self):
+        return self._get('numeric_features')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTargetClasses(self, value):
+        return self._set('target_classes', value)
+
+    def getTargetClasses(self):
+        return self._get('target_classes')
+
+    def setTargetCol(self, value):
+        return self._set('target_col', value)
+
+    def getTargetCol(self):
+        return self._get('target_col')
+
+
+class ImageLIME(WrapperBase):
+    """(ref ``ImageLIME.scala``) superpixel on/off perturbations; the binary (wraps ``synapseml_tpu.explainers.lime.ImageLIME``)."""
+
+    _target = 'synapseml_tpu.explainers.lime.ImageLIME'
+
+    def setCellSize(self, value):
+        return self._set('cell_size', value)
+
+    def getCellSize(self):
+        return self._get('cell_size')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setKernelWidth(self, value):
+        return self._set('kernel_width', value)
+
+    def getKernelWidth(self):
+        return self._get('kernel_width')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setModifier(self, value):
+        return self._set('modifier', value)
+
+    def getModifier(self):
+        return self._get('modifier')
+
+    def setNumSamples(self, value):
+        return self._set('num_samples', value)
+
+    def getNumSamples(self):
+        return self._get('num_samples')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setRegularization(self, value):
+        return self._set('regularization', value)
+
+    def getRegularization(self):
+        return self._get('regularization')
+
+    def setSamplingFraction(self, value):
+        return self._set('sampling_fraction', value)
+
+    def getSamplingFraction(self):
+        return self._get('sampling_fraction')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setSuperpixelCol(self, value):
+        return self._set('superpixel_col', value)
+
+    def getSuperpixelCol(self):
+        return self._get('superpixel_col')
+
+    def setTargetClasses(self, value):
+        return self._set('target_classes', value)
+
+    def getTargetClasses(self):
+        return self._get('target_classes')
+
+    def setTargetCol(self, value):
+        return self._set('target_col', value)
+
+    def getTargetCol(self):
+        return self._get('target_col')
+
+
+class TabularLIME(WrapperBase):
+    """(ref ``TabularLIME.scala``) like VectorLIME but over named numeric (wraps ``synapseml_tpu.explainers.lime.TabularLIME``)."""
+
+    _target = 'synapseml_tpu.explainers.lime.TabularLIME'
+
+    def setBackgroundData(self, value):
+        return self._set('background_data', value)
+
+    def getBackgroundData(self):
+        return self._get('background_data')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setInputCols(self, value):
+        return self._set('input_cols', value)
+
+    def getInputCols(self):
+        return self._get('input_cols')
+
+    def setKernelWidth(self, value):
+        return self._set('kernel_width', value)
+
+    def getKernelWidth(self):
+        return self._get('kernel_width')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setNumSamples(self, value):
+        return self._set('num_samples', value)
+
+    def getNumSamples(self):
+        return self._get('num_samples')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setRegularization(self, value):
+        return self._set('regularization', value)
+
+    def getRegularization(self):
+        return self._get('regularization')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTargetClasses(self, value):
+        return self._set('target_classes', value)
+
+    def getTargetClasses(self):
+        return self._get('target_classes')
+
+    def setTargetCol(self, value):
+        return self._set('target_col', value)
+
+    def getTargetCol(self):
+        return self._get('target_col')
+
+
+class TextLIME(WrapperBase):
+    """(ref ``TextLIME.scala``) token on/off perturbations. (wraps ``synapseml_tpu.explainers.lime.TextLIME``)."""
+
+    _target = 'synapseml_tpu.explainers.lime.TextLIME'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setKernelWidth(self, value):
+        return self._set('kernel_width', value)
+
+    def getKernelWidth(self):
+        return self._get('kernel_width')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setNumSamples(self, value):
+        return self._set('num_samples', value)
+
+    def getNumSamples(self):
+        return self._get('num_samples')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setRegularization(self, value):
+        return self._set('regularization', value)
+
+    def getRegularization(self):
+        return self._get('regularization')
+
+    def setSamplingFraction(self, value):
+        return self._set('sampling_fraction', value)
+
+    def getSamplingFraction(self):
+        return self._get('sampling_fraction')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTargetClasses(self, value):
+        return self._set('target_classes', value)
+
+    def getTargetClasses(self):
+        return self._get('target_classes')
+
+    def setTargetCol(self, value):
+        return self._set('target_col', value)
+
+    def getTargetCol(self):
+        return self._get('target_col')
+
+    def setTokenCol(self, value):
+        return self._set('token_col', value)
+
+    def getTokenCol(self):
+        return self._get('token_col')
+
+
+class VectorLIME(WrapperBase):
+    """(ref ``VectorLIME.scala``) rows hold fixed-length feature vectors; (wraps ``synapseml_tpu.explainers.lime.VectorLIME``)."""
+
+    _target = 'synapseml_tpu.explainers.lime.VectorLIME'
+
+    def setBackgroundData(self, value):
+        return self._set('background_data', value)
+
+    def getBackgroundData(self):
+        return self._get('background_data')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setKernelWidth(self, value):
+        return self._set('kernel_width', value)
+
+    def getKernelWidth(self):
+        return self._get('kernel_width')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setNumSamples(self, value):
+        return self._set('num_samples', value)
+
+    def getNumSamples(self):
+        return self._get('num_samples')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setRegularization(self, value):
+        return self._set('regularization', value)
+
+    def getRegularization(self):
+        return self._get('regularization')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTargetClasses(self, value):
+        return self._set('target_classes', value)
+
+    def getTargetClasses(self):
+        return self._get('target_classes')
+
+    def setTargetCol(self, value):
+        return self._set('target_col', value)
+
+    def getTargetCol(self):
+        return self._get('target_col')
+
+
+class ImageSHAP(WrapperBase):
+    """(ref ``ImageSHAP.scala``) superpixels as players; off superpixels (wraps ``synapseml_tpu.explainers.shap.ImageSHAP``)."""
+
+    _target = 'synapseml_tpu.explainers.shap.ImageSHAP'
+
+    def setCellSize(self, value):
+        return self._set('cell_size', value)
+
+    def getCellSize(self):
+        return self._get('cell_size')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setModifier(self, value):
+        return self._set('modifier', value)
+
+    def getModifier(self):
+        return self._get('modifier')
+
+    def setNumSamples(self, value):
+        return self._set('num_samples', value)
+
+    def getNumSamples(self):
+        return self._get('num_samples')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTargetClasses(self, value):
+        return self._set('target_classes', value)
+
+    def getTargetClasses(self):
+        return self._get('target_classes')
+
+    def setTargetCol(self, value):
+        return self._set('target_col', value)
+
+    def getTargetCol(self):
+        return self._get('target_col')
+
+
+class TabularSHAP(WrapperBase):
+    """(ref ``TabularSHAP.scala``) named numeric columns. (wraps ``synapseml_tpu.explainers.shap.TabularSHAP``)."""
+
+    _target = 'synapseml_tpu.explainers.shap.TabularSHAP'
+
+    def setBackgroundData(self, value):
+        return self._set('background_data', value)
+
+    def getBackgroundData(self):
+        return self._get('background_data')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setInputCols(self, value):
+        return self._set('input_cols', value)
+
+    def getInputCols(self):
+        return self._get('input_cols')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setNumSamples(self, value):
+        return self._set('num_samples', value)
+
+    def getNumSamples(self):
+        return self._get('num_samples')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTargetClasses(self, value):
+        return self._set('target_classes', value)
+
+    def getTargetClasses(self):
+        return self._get('target_classes')
+
+    def setTargetCol(self, value):
+        return self._set('target_col', value)
+
+    def getTargetCol(self):
+        return self._get('target_col')
+
+
+class TextSHAP(WrapperBase):
+    """(ref ``TextSHAP.scala``) tokens as players; off tokens dropped. (wraps ``synapseml_tpu.explainers.shap.TextSHAP``)."""
+
+    _target = 'synapseml_tpu.explainers.shap.TextSHAP'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setNumSamples(self, value):
+        return self._set('num_samples', value)
+
+    def getNumSamples(self):
+        return self._get('num_samples')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTargetClasses(self, value):
+        return self._set('target_classes', value)
+
+    def getTargetClasses(self):
+        return self._get('target_classes')
+
+    def setTargetCol(self, value):
+        return self._set('target_col', value)
+
+    def getTargetCol(self):
+        return self._get('target_col')
+
+    def setTokenCol(self, value):
+        return self._set('token_col', value)
+
+    def getTokenCol(self):
+        return self._get('token_col')
+
+
+class VectorSHAP(WrapperBase):
+    """(ref ``VectorSHAP.scala``) feature-vector rows; off features are (wraps ``synapseml_tpu.explainers.shap.VectorSHAP``)."""
+
+    _target = 'synapseml_tpu.explainers.shap.VectorSHAP'
+
+    def setBackgroundData(self, value):
+        return self._set('background_data', value)
+
+    def getBackgroundData(self):
+        return self._get('background_data')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setNumSamples(self, value):
+        return self._set('num_samples', value)
+
+    def getNumSamples(self):
+        return self._get('num_samples')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTargetClasses(self, value):
+        return self._set('target_classes', value)
+
+    def getTargetClasses(self):
+        return self._get('target_classes')
+
+    def setTargetCol(self, value):
+        return self._set('target_col', value)
+
+    def getTargetCol(self):
+        return self._get('target_col')
+

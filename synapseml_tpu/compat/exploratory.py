@@ -1,0 +1,57 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class AggregateBalanceMeasure(WrapperBase):
+    """(ref ``AggregateBalanceMeasure.scala``) — single row: inequality indices (wraps ``synapseml_tpu.exploratory.balance.AggregateBalanceMeasure``)."""
+
+    _target = 'synapseml_tpu.exploratory.balance.AggregateBalanceMeasure'
+
+    def setEpsilon(self, value):
+        return self._set('epsilon', value)
+
+    def getEpsilon(self):
+        return self._get('epsilon')
+
+    def setSensitiveCols(self, value):
+        return self._set('sensitive_cols', value)
+
+    def getSensitiveCols(self):
+        return self._get('sensitive_cols')
+
+
+class DistributionBalanceMeasure(WrapperBase):
+    """(ref ``DistributionBalanceMeasure.scala``) — one row per feature: (wraps ``synapseml_tpu.exploratory.balance.DistributionBalanceMeasure``)."""
+
+    _target = 'synapseml_tpu.exploratory.balance.DistributionBalanceMeasure'
+
+    def setSensitiveCols(self, value):
+        return self._set('sensitive_cols', value)
+
+    def getSensitiveCols(self):
+        return self._get('sensitive_cols')
+
+
+class FeatureBalanceMeasure(WrapperBase):
+    """(ref ``FeatureBalanceMeasure.scala:38``) — one row per (feature, (wraps ``synapseml_tpu.exploratory.balance.FeatureBalanceMeasure``)."""
+
+    _target = 'synapseml_tpu.exploratory.balance.FeatureBalanceMeasure'
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setSensitiveCols(self, value):
+        return self._set('sensitive_cols', value)
+
+    def getSensitiveCols(self):
+        return self._get('sensitive_cols')
+

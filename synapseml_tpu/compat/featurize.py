@@ -1,0 +1,441 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class CleanMissingData(WrapperBase):
+    """Impute NaNs with mean/median/custom (ref ``CleanMissingData.scala:51``). (wraps ``synapseml_tpu.featurize.clean.CleanMissingData``)."""
+
+    _target = 'synapseml_tpu.featurize.clean.CleanMissingData'
+
+    def setCleaningMode(self, value):
+        return self._set('cleaning_mode', value)
+
+    def getCleaningMode(self):
+        return self._get('cleaning_mode')
+
+    def setCustomValue(self, value):
+        return self._set('custom_value', value)
+
+    def getCustomValue(self):
+        return self._get('custom_value')
+
+    def setInputCols(self, value):
+        return self._set('input_cols', value)
+
+    def getInputCols(self):
+        return self._get('input_cols')
+
+    def setOutputCols(self, value):
+        return self._set('output_cols', value)
+
+    def getOutputCols(self):
+        return self._get('output_cols')
+
+
+class CleanMissingDataModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.featurize.clean.CleanMissingDataModel``)."""
+
+    _target = 'synapseml_tpu.featurize.clean.CleanMissingDataModel'
+
+    def setFillValues(self, value):
+        return self._set('fill_values', value)
+
+    def getFillValues(self):
+        return self._get('fill_values')
+
+    def setInputCols(self, value):
+        return self._set('input_cols', value)
+
+    def getInputCols(self):
+        return self._get('input_cols')
+
+    def setOutputCols(self, value):
+        return self._set('output_cols', value)
+
+    def getOutputCols(self):
+        return self._get('output_cols')
+
+
+class DataConversion(WrapperBase):
+    """Cast columns to a named type (ref ``featurize/DataConversion.scala``); (wraps ``synapseml_tpu.featurize.clean.DataConversion``)."""
+
+    _target = 'synapseml_tpu.featurize.clean.DataConversion'
+
+    def setCols(self, value):
+        return self._set('cols', value)
+
+    def getCols(self):
+        return self._get('cols')
+
+    def setConvertTo(self, value):
+        return self._set('convert_to', value)
+
+    def getConvertTo(self):
+        return self._get('convert_to')
+
+    def setDateTimeFormat(self, value):
+        return self._set('date_time_format', value)
+
+    def getDateTimeFormat(self):
+        return self._get('date_time_format')
+
+
+class Featurize(WrapperBase):
+    """Auto-featurization estimator (ref ``Featurize.scala:35``). (wraps ``synapseml_tpu.featurize.featurize.Featurize``)."""
+
+    _target = 'synapseml_tpu.featurize.featurize.Featurize'
+
+    def setImputeMissing(self, value):
+        return self._set('impute_missing', value)
+
+    def getImputeMissing(self):
+        return self._get('impute_missing')
+
+    def setInputCols(self, value):
+        return self._set('input_cols', value)
+
+    def getInputCols(self):
+        return self._get('input_cols')
+
+    def setMaxOneHotCardinality(self, value):
+        return self._set('max_one_hot_cardinality', value)
+
+    def getMaxOneHotCardinality(self):
+        return self._get('max_one_hot_cardinality')
+
+    def setNumFeatures(self, value):
+        return self._set('num_features', value)
+
+    def getNumFeatures(self):
+        return self._get('num_features')
+
+    def setOneHotEncodeCategoricals(self, value):
+        return self._set('one_hot_encode_categoricals', value)
+
+    def getOneHotEncodeCategoricals(self):
+        return self._get('one_hot_encode_categoricals')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class FeaturizeModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.featurize.featurize.FeaturizeModel``)."""
+
+    _target = 'synapseml_tpu.featurize.featurize.FeaturizeModel'
+
+    def setInputCols(self, value):
+        return self._set('input_cols', value)
+
+    def getInputCols(self):
+        return self._get('input_cols')
+
+    def setNumFeatures(self, value):
+        return self._set('num_features', value)
+
+    def getNumFeatures(self):
+        return self._get('num_features')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPlan(self, value):
+        return self._set('plan', value)
+
+    def getPlan(self):
+        return self._get('plan')
+
+
+class CountSelector(WrapperBase):
+    """Drop always-zero feature slots (ref ``featurize/CountSelector.scala`` — (wraps ``synapseml_tpu.featurize.indexers.CountSelector``)."""
+
+    _target = 'synapseml_tpu.featurize.indexers.CountSelector'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class CountSelectorModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.featurize.indexers.CountSelectorModel``)."""
+
+    _target = 'synapseml_tpu.featurize.indexers.CountSelectorModel'
+
+    def setIndices(self, value):
+        return self._set('indices', value)
+
+    def getIndices(self):
+        return self._get('indices')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class IndexToValue(WrapperBase):
+    """Inverse of ValueIndexerModel (ref ``featurize/IndexToValue.scala``): (wraps ``synapseml_tpu.featurize.indexers.IndexToValue``)."""
+
+    _target = 'synapseml_tpu.featurize.indexers.IndexToValue'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setLevels(self, value):
+        return self._set('levels', value)
+
+    def getLevels(self):
+        return self._get('levels')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class ValueIndexer(WrapperBase):
+    """Learn distinct levels -> contiguous indices (ref ``ValueIndexer.scala:57``). (wraps ``synapseml_tpu.featurize.indexers.ValueIndexer``)."""
+
+    _target = 'synapseml_tpu.featurize.indexers.ValueIndexer'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setUnknownIndex(self, value):
+        return self._set('unknown_index', value)
+
+    def getUnknownIndex(self):
+        return self._get('unknown_index')
+
+
+class ValueIndexerModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.featurize.indexers.ValueIndexerModel``)."""
+
+    _target = 'synapseml_tpu.featurize.indexers.ValueIndexerModel'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setLevels(self, value):
+        return self._set('levels', value)
+
+    def getLevels(self):
+        return self._get('levels')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setUnknownIndex(self, value):
+        return self._set('unknown_index', value)
+
+    def getUnknownIndex(self):
+        return self._get('unknown_index')
+
+
+class MultiNGram(WrapperBase):
+    """Token lists -> concatenated ngrams of several lengths (wraps ``synapseml_tpu.featurize.text.MultiNGram``)."""
+
+    _target = 'synapseml_tpu.featurize.text.MultiNGram'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setLengths(self, value):
+        return self._set('lengths', value)
+
+    def getLengths(self):
+        return self._get('lengths')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class PageSplitter(WrapperBase):
+    """Split text into page strings within [min,max] length, preferring word (wraps ``synapseml_tpu.featurize.text.PageSplitter``)."""
+
+    _target = 'synapseml_tpu.featurize.text.PageSplitter'
+
+    def setBoundaryRegex(self, value):
+        return self._set('boundary_regex', value)
+
+    def getBoundaryRegex(self):
+        return self._get('boundary_regex')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setMaximumPageLength(self, value):
+        return self._set('maximum_page_length', value)
+
+    def getMaximumPageLength(self):
+        return self._get('maximum_page_length')
+
+    def setMinimumPageLength(self, value):
+        return self._set('minimum_page_length', value)
+
+    def getMinimumPageLength(self):
+        return self._get('minimum_page_length')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class TextFeaturizer(WrapperBase):
+    """(ref ``TextFeaturizer.scala:193``) (wraps ``synapseml_tpu.featurize.text.TextFeaturizer``)."""
+
+    _target = 'synapseml_tpu.featurize.text.TextFeaturizer'
+
+    def setBinary(self, value):
+        return self._set('binary', value)
+
+    def getBinary(self):
+        return self._get('binary')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setMinDocFreq(self, value):
+        return self._set('min_doc_freq', value)
+
+    def getMinDocFreq(self):
+        return self._get('min_doc_freq')
+
+    def setNGramLength(self, value):
+        return self._set('n_gram_length', value)
+
+    def getNGramLength(self):
+        return self._get('n_gram_length')
+
+    def setNumFeatures(self, value):
+        return self._set('num_features', value)
+
+    def getNumFeatures(self):
+        return self._get('num_features')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setToLowerCase(self, value):
+        return self._set('to_lower_case', value)
+
+    def getToLowerCase(self):
+        return self._get('to_lower_case')
+
+    def setUseIdf(self, value):
+        return self._set('use_idf', value)
+
+    def getUseIdf(self):
+        return self._get('use_idf')
+
+
+class TextFeaturizerModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.featurize.text.TextFeaturizerModel``)."""
+
+    _target = 'synapseml_tpu.featurize.text.TextFeaturizerModel'
+
+    def setBinary(self, value):
+        return self._set('binary', value)
+
+    def getBinary(self):
+        return self._get('binary')
+
+    def setIdf(self, value):
+        return self._set('idf', value)
+
+    def getIdf(self):
+        return self._get('idf')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setNGramLength(self, value):
+        return self._set('n_gram_length', value)
+
+    def getNGramLength(self):
+        return self._get('n_gram_length')
+
+    def setNumFeatures(self, value):
+        return self._set('num_features', value)
+
+    def getNumFeatures(self):
+        return self._get('num_features')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setToLowerCase(self, value):
+        return self._set('to_lower_case', value)
+
+    def getToLowerCase(self):
+        return self._get('to_lower_case')
+

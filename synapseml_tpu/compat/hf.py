@@ -1,0 +1,177 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class HuggingFaceCausalLM(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.hf.causal_lm.HuggingFaceCausalLM``)."""
+
+    _target = 'synapseml_tpu.hf.causal_lm.HuggingFaceCausalLM'
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setDoSample(self, value):
+        return self._set('do_sample', value)
+
+    def getDoSample(self):
+        return self._get('do_sample')
+
+    def setEosId(self, value):
+        return self._set('eos_id', value)
+
+    def getEosId(self):
+        return self._get('eos_id')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setMaxNewTokens(self, value):
+        return self._set('max_new_tokens', value)
+
+    def getMaxNewTokens(self):
+        return self._get('max_new_tokens')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setMessagesCol(self, value):
+        return self._set('messages_col', value)
+
+    def getMessagesCol(self):
+        return self._get('messages_col')
+
+    def setModelName(self, value):
+        return self._set('model_name', value)
+
+    def getModelName(self):
+        return self._get('model_name')
+
+    def setModelParams(self, value):
+        return self._set('model_params', value)
+
+    def getModelParams(self):
+        return self._get('model_params')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPromptBucket(self, value):
+        return self._set('prompt_bucket', value)
+
+    def getPromptBucket(self):
+        return self._get('prompt_bucket')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTemperature(self, value):
+        return self._set('temperature', value)
+
+    def getTemperature(self):
+        return self._get('temperature')
+
+    def setTokenizer(self, value):
+        return self._set('tokenizer', value)
+
+    def getTokenizer(self):
+        return self._get('tokenizer')
+
+    def setTopK(self, value):
+        return self._set('top_k', value)
+
+    def getTopK(self):
+        return self._get('top_k')
+
+    def setTopP(self, value):
+        return self._set('top_p', value)
+
+    def getTopP(self):
+        return self._get('top_p')
+
+
+class HuggingFaceSentenceEmbedder(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.hf.embedder.HuggingFaceSentenceEmbedder``)."""
+
+    _target = 'synapseml_tpu.hf.embedder.HuggingFaceSentenceEmbedder'
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setMaxTokenLen(self, value):
+        return self._set('max_token_len', value)
+
+    def getMaxTokenLen(self):
+        return self._get('max_token_len')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setModelName(self, value):
+        return self._set('model_name', value)
+
+    def getModelName(self):
+        return self._get('model_name')
+
+    def setModelParams(self, value):
+        return self._set('model_params', value)
+
+    def getModelParams(self):
+        return self._get('model_params')
+
+    def setNormalize(self, value):
+        return self._set('normalize', value)
+
+    def getNormalize(self):
+        return self._get('normalize')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPooling(self, value):
+        return self._set('pooling', value)
+
+    def getPooling(self):
+        return self._get('pooling')
+
+    def setTokenizer(self, value):
+        return self._set('tokenizer', value)
+
+    def getTokenizer(self):
+        return self._get('tokenizer')
+

@@ -1,0 +1,99 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class HTTPTransformer(WrapperBase):
+    """request col (HTTPRequest or None) -> response col (wraps ``synapseml_tpu.io.http.HTTPTransformer``)."""
+
+    _target = 'synapseml_tpu.io.http.HTTPTransformer'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+
+class SimpleHTTPTransformer(WrapperBase):
+    """input parser -> HTTPTransformer -> output parser, with an errors column (wraps ``synapseml_tpu.io.http.SimpleHTTPTransformer``)."""
+
+    _target = 'synapseml_tpu.io.http.SimpleHTTPTransformer'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setInputParser(self, value):
+        return self._set('input_parser', value)
+
+    def getInputParser(self):
+        return self._get('input_parser')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setOutputParser(self, value):
+        return self._set('output_parser', value)
+
+    def getOutputParser(self):
+        return self._get('output_parser')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+

@@ -1,0 +1,111 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class IsolationForest(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.isolationforest.iforest.IsolationForest``)."""
+
+    _target = 'synapseml_tpu.isolationforest.iforest.IsolationForest'
+
+    def setBootstrap(self, value):
+        return self._set('bootstrap', value)
+
+    def getBootstrap(self):
+        return self._get('bootstrap')
+
+    def setContamination(self, value):
+        return self._set('contamination', value)
+
+    def getContamination(self):
+        return self._get('contamination')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setMaxFeatures(self, value):
+        return self._set('max_features', value)
+
+    def getMaxFeatures(self):
+        return self._get('max_features')
+
+    def setMaxSamples(self, value):
+        return self._set('max_samples', value)
+
+    def getMaxSamples(self):
+        return self._get('max_samples')
+
+    def setNumEstimators(self, value):
+        return self._set('num_estimators', value)
+
+    def getNumEstimators(self):
+        return self._get('num_estimators')
+
+    def setPredictedLabelCol(self, value):
+        return self._set('predicted_label_col', value)
+
+    def getPredictedLabelCol(self):
+        return self._get('predicted_label_col')
+
+    def setRandomSeed(self, value):
+        return self._set('random_seed', value)
+
+    def getRandomSeed(self):
+        return self._get('random_seed')
+
+    def setScoreCol(self, value):
+        return self._set('score_col', value)
+
+    def getScoreCol(self):
+        return self._get('score_col')
+
+
+class IsolationForestModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.isolationforest.iforest.IsolationForestModel``)."""
+
+    _target = 'synapseml_tpu.isolationforest.iforest.IsolationForestModel'
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setPredictedLabelCol(self, value):
+        return self._set('predicted_label_col', value)
+
+    def getPredictedLabelCol(self):
+        return self._get('predicted_label_col')
+
+    def setScoreCol(self, value):
+        return self._set('score_col', value)
+
+    def getScoreCol(self):
+        return self._get('score_col')
+
+    def setSubsampleSize(self, value):
+        return self._set('subsample_size', value)
+
+    def getSubsampleSize(self):
+        return self._get('subsample_size')
+
+    def setThreshold(self, value):
+        return self._set('threshold', value)
+
+    def getThreshold(self):
+        return self._get('threshold')
+
+    def setTrees(self, value):
+        return self._set('trees', value)
+
+    def getTrees(self):
+        return self._get('trees')
+

@@ -1,0 +1,1233 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class LightGBMClassificationModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.gbdt.estimators.LightGBMClassificationModel``)."""
+
+    _target = 'synapseml_tpu.gbdt.estimators.LightGBMClassificationModel'
+
+    def setBaggingFraction(self, value):
+        return self._set('bagging_fraction', value)
+
+    def getBaggingFraction(self):
+        return self._get('bagging_fraction')
+
+    def setBaggingFreq(self, value):
+        return self._set('bagging_freq', value)
+
+    def getBaggingFreq(self):
+        return self._get('bagging_freq')
+
+    def setBooster(self, value):
+        return self._set('booster', value)
+
+    def getBooster(self):
+        return self._get('booster')
+
+    def setBoostingType(self, value):
+        return self._set('boosting_type', value)
+
+    def getBoostingType(self):
+        return self._get('boosting_type')
+
+    def setClasses(self, value):
+        return self._set('classes', value)
+
+    def getClasses(self):
+        return self._get('classes')
+
+    def setDropRate(self, value):
+        return self._set('drop_rate', value)
+
+    def getDropRate(self):
+        return self._get('drop_rate')
+
+    def setEarlyStoppingRound(self, value):
+        return self._set('early_stopping_round', value)
+
+    def getEarlyStoppingRound(self):
+        return self._get('early_stopping_round')
+
+    def setFeatureCols(self, value):
+        return self._set('feature_cols', value)
+
+    def getFeatureCols(self):
+        return self._get('feature_cols')
+
+    def setFeatureFraction(self, value):
+        return self._set('feature_fraction', value)
+
+    def getFeatureFraction(self):
+        return self._get('feature_fraction')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setFeaturesShapCol(self, value):
+        return self._set('features_shap_col', value)
+
+    def getFeaturesShapCol(self):
+        return self._get('features_shap_col')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLambdaL1(self, value):
+        return self._set('lambda_l1', value)
+
+    def getLambdaL1(self):
+        return self._get('lambda_l1')
+
+    def setLambdaL2(self, value):
+        return self._set('lambda_l2', value)
+
+    def getLambdaL2(self):
+        return self._get('lambda_l2')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setMaxBin(self, value):
+        return self._set('max_bin', value)
+
+    def getMaxBin(self):
+        return self._get('max_bin')
+
+    def setMaxDepth(self, value):
+        return self._set('max_depth', value)
+
+    def getMaxDepth(self):
+        return self._get('max_depth')
+
+    def setMaxDrop(self, value):
+        return self._set('max_drop', value)
+
+    def getMaxDrop(self):
+        return self._get('max_drop')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setMinDataInLeaf(self, value):
+        return self._set('min_data_in_leaf', value)
+
+    def getMinDataInLeaf(self):
+        return self._get('min_data_in_leaf')
+
+    def setMinGainToSplit(self, value):
+        return self._set('min_gain_to_split', value)
+
+    def getMinGainToSplit(self):
+        return self._get('min_gain_to_split')
+
+    def setMinSumHessianInLeaf(self, value):
+        return self._set('min_sum_hessian_in_leaf', value)
+
+    def getMinSumHessianInLeaf(self):
+        return self._get('min_sum_hessian_in_leaf')
+
+    def setMonotoneConstraints(self, value):
+        return self._set('monotone_constraints', value)
+
+    def getMonotoneConstraints(self):
+        return self._get('monotone_constraints')
+
+    def setNumIterations(self, value):
+        return self._set('num_iterations', value)
+
+    def getNumIterations(self):
+        return self._get('num_iterations')
+
+    def setNumLeaves(self, value):
+        return self._set('num_leaves', value)
+
+    def getNumLeaves(self):
+        return self._get('num_leaves')
+
+    def setOtherRate(self, value):
+        return self._set('other_rate', value)
+
+    def getOtherRate(self):
+        return self._get('other_rate')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setProbabilityCol(self, value):
+        return self._set('probability_col', value)
+
+    def getProbabilityCol(self):
+        return self._get('probability_col')
+
+    def setRawPredictionCol(self, value):
+        return self._set('raw_prediction_col', value)
+
+    def getRawPredictionCol(self):
+        return self._get('raw_prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setSkipDrop(self, value):
+        return self._set('skip_drop', value)
+
+    def getSkipDrop(self):
+        return self._get('skip_drop')
+
+    def setTopRate(self, value):
+        return self._set('top_rate', value)
+
+    def getTopRate(self):
+        return self._get('top_rate')
+
+    def setValidationIndicatorCol(self, value):
+        return self._set('validation_indicator_col', value)
+
+    def getValidationIndicatorCol(self):
+        return self._get('validation_indicator_col')
+
+    def setVerbosity(self, value):
+        return self._set('verbosity', value)
+
+    def getVerbosity(self):
+        return self._get('verbosity')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class LightGBMClassifier(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.gbdt.estimators.LightGBMClassifier``)."""
+
+    _target = 'synapseml_tpu.gbdt.estimators.LightGBMClassifier'
+
+    def setBaggingFraction(self, value):
+        return self._set('bagging_fraction', value)
+
+    def getBaggingFraction(self):
+        return self._get('bagging_fraction')
+
+    def setBaggingFreq(self, value):
+        return self._set('bagging_freq', value)
+
+    def getBaggingFreq(self):
+        return self._get('bagging_freq')
+
+    def setBoostingType(self, value):
+        return self._set('boosting_type', value)
+
+    def getBoostingType(self):
+        return self._get('boosting_type')
+
+    def setDropRate(self, value):
+        return self._set('drop_rate', value)
+
+    def getDropRate(self):
+        return self._get('drop_rate')
+
+    def setEarlyStoppingRound(self, value):
+        return self._set('early_stopping_round', value)
+
+    def getEarlyStoppingRound(self):
+        return self._get('early_stopping_round')
+
+    def setFeatureCols(self, value):
+        return self._set('feature_cols', value)
+
+    def getFeatureCols(self):
+        return self._get('feature_cols')
+
+    def setFeatureFraction(self, value):
+        return self._set('feature_fraction', value)
+
+    def getFeatureFraction(self):
+        return self._get('feature_fraction')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setIsUnbalance(self, value):
+        return self._set('is_unbalance', value)
+
+    def getIsUnbalance(self):
+        return self._get('is_unbalance')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLambdaL1(self, value):
+        return self._set('lambda_l1', value)
+
+    def getLambdaL1(self):
+        return self._get('lambda_l1')
+
+    def setLambdaL2(self, value):
+        return self._set('lambda_l2', value)
+
+    def getLambdaL2(self):
+        return self._get('lambda_l2')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setMaxBin(self, value):
+        return self._set('max_bin', value)
+
+    def getMaxBin(self):
+        return self._get('max_bin')
+
+    def setMaxDepth(self, value):
+        return self._set('max_depth', value)
+
+    def getMaxDepth(self):
+        return self._get('max_depth')
+
+    def setMaxDrop(self, value):
+        return self._set('max_drop', value)
+
+    def getMaxDrop(self):
+        return self._get('max_drop')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setMinDataInLeaf(self, value):
+        return self._set('min_data_in_leaf', value)
+
+    def getMinDataInLeaf(self):
+        return self._get('min_data_in_leaf')
+
+    def setMinGainToSplit(self, value):
+        return self._set('min_gain_to_split', value)
+
+    def getMinGainToSplit(self):
+        return self._get('min_gain_to_split')
+
+    def setMinSumHessianInLeaf(self, value):
+        return self._set('min_sum_hessian_in_leaf', value)
+
+    def getMinSumHessianInLeaf(self):
+        return self._get('min_sum_hessian_in_leaf')
+
+    def setMonotoneConstraints(self, value):
+        return self._set('monotone_constraints', value)
+
+    def getMonotoneConstraints(self):
+        return self._get('monotone_constraints')
+
+    def setNumIterations(self, value):
+        return self._set('num_iterations', value)
+
+    def getNumIterations(self):
+        return self._get('num_iterations')
+
+    def setNumLeaves(self, value):
+        return self._set('num_leaves', value)
+
+    def getNumLeaves(self):
+        return self._get('num_leaves')
+
+    def setObjective(self, value):
+        return self._set('objective', value)
+
+    def getObjective(self):
+        return self._get('objective')
+
+    def setOtherRate(self, value):
+        return self._set('other_rate', value)
+
+    def getOtherRate(self):
+        return self._get('other_rate')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setProbabilityCol(self, value):
+        return self._set('probability_col', value)
+
+    def getProbabilityCol(self):
+        return self._get('probability_col')
+
+    def setRawPredictionCol(self, value):
+        return self._set('raw_prediction_col', value)
+
+    def getRawPredictionCol(self):
+        return self._get('raw_prediction_col')
+
+    def setScalePosWeight(self, value):
+        return self._set('scale_pos_weight', value)
+
+    def getScalePosWeight(self):
+        return self._get('scale_pos_weight')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setSkipDrop(self, value):
+        return self._set('skip_drop', value)
+
+    def getSkipDrop(self):
+        return self._get('skip_drop')
+
+    def setTopRate(self, value):
+        return self._set('top_rate', value)
+
+    def getTopRate(self):
+        return self._get('top_rate')
+
+    def setValidationIndicatorCol(self, value):
+        return self._set('validation_indicator_col', value)
+
+    def getValidationIndicatorCol(self):
+        return self._get('validation_indicator_col')
+
+    def setVerbosity(self, value):
+        return self._set('verbosity', value)
+
+    def getVerbosity(self):
+        return self._get('verbosity')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class LightGBMRanker(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.gbdt.estimators.LightGBMRanker``)."""
+
+    _target = 'synapseml_tpu.gbdt.estimators.LightGBMRanker'
+
+    def setBaggingFraction(self, value):
+        return self._set('bagging_fraction', value)
+
+    def getBaggingFraction(self):
+        return self._get('bagging_fraction')
+
+    def setBaggingFreq(self, value):
+        return self._set('bagging_freq', value)
+
+    def getBaggingFreq(self):
+        return self._get('bagging_freq')
+
+    def setBoostingType(self, value):
+        return self._set('boosting_type', value)
+
+    def getBoostingType(self):
+        return self._get('boosting_type')
+
+    def setDropRate(self, value):
+        return self._set('drop_rate', value)
+
+    def getDropRate(self):
+        return self._get('drop_rate')
+
+    def setEarlyStoppingRound(self, value):
+        return self._set('early_stopping_round', value)
+
+    def getEarlyStoppingRound(self):
+        return self._get('early_stopping_round')
+
+    def setEvalAt(self, value):
+        return self._set('eval_at', value)
+
+    def getEvalAt(self):
+        return self._get('eval_at')
+
+    def setFeatureCols(self, value):
+        return self._set('feature_cols', value)
+
+    def getFeatureCols(self):
+        return self._get('feature_cols')
+
+    def setFeatureFraction(self, value):
+        return self._set('feature_fraction', value)
+
+    def getFeatureFraction(self):
+        return self._get('feature_fraction')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setGroupCol(self, value):
+        return self._set('group_col', value)
+
+    def getGroupCol(self):
+        return self._get('group_col')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLambdaL1(self, value):
+        return self._set('lambda_l1', value)
+
+    def getLambdaL1(self):
+        return self._get('lambda_l1')
+
+    def setLambdaL2(self, value):
+        return self._set('lambda_l2', value)
+
+    def getLambdaL2(self):
+        return self._get('lambda_l2')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setMaxBin(self, value):
+        return self._set('max_bin', value)
+
+    def getMaxBin(self):
+        return self._get('max_bin')
+
+    def setMaxDepth(self, value):
+        return self._set('max_depth', value)
+
+    def getMaxDepth(self):
+        return self._get('max_depth')
+
+    def setMaxDrop(self, value):
+        return self._set('max_drop', value)
+
+    def getMaxDrop(self):
+        return self._get('max_drop')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setMinDataInLeaf(self, value):
+        return self._set('min_data_in_leaf', value)
+
+    def getMinDataInLeaf(self):
+        return self._get('min_data_in_leaf')
+
+    def setMinGainToSplit(self, value):
+        return self._set('min_gain_to_split', value)
+
+    def getMinGainToSplit(self):
+        return self._get('min_gain_to_split')
+
+    def setMinSumHessianInLeaf(self, value):
+        return self._set('min_sum_hessian_in_leaf', value)
+
+    def getMinSumHessianInLeaf(self):
+        return self._get('min_sum_hessian_in_leaf')
+
+    def setMonotoneConstraints(self, value):
+        return self._set('monotone_constraints', value)
+
+    def getMonotoneConstraints(self):
+        return self._get('monotone_constraints')
+
+    def setNumIterations(self, value):
+        return self._set('num_iterations', value)
+
+    def getNumIterations(self):
+        return self._get('num_iterations')
+
+    def setNumLeaves(self, value):
+        return self._set('num_leaves', value)
+
+    def getNumLeaves(self):
+        return self._get('num_leaves')
+
+    def setOtherRate(self, value):
+        return self._set('other_rate', value)
+
+    def getOtherRate(self):
+        return self._get('other_rate')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setSkipDrop(self, value):
+        return self._set('skip_drop', value)
+
+    def getSkipDrop(self):
+        return self._get('skip_drop')
+
+    def setTopRate(self, value):
+        return self._set('top_rate', value)
+
+    def getTopRate(self):
+        return self._get('top_rate')
+
+    def setValidationIndicatorCol(self, value):
+        return self._set('validation_indicator_col', value)
+
+    def getValidationIndicatorCol(self):
+        return self._get('validation_indicator_col')
+
+    def setVerbosity(self, value):
+        return self._set('verbosity', value)
+
+    def getVerbosity(self):
+        return self._get('verbosity')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class LightGBMRankerModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.gbdt.estimators.LightGBMRankerModel``)."""
+
+    _target = 'synapseml_tpu.gbdt.estimators.LightGBMRankerModel'
+
+    def setBaggingFraction(self, value):
+        return self._set('bagging_fraction', value)
+
+    def getBaggingFraction(self):
+        return self._get('bagging_fraction')
+
+    def setBaggingFreq(self, value):
+        return self._set('bagging_freq', value)
+
+    def getBaggingFreq(self):
+        return self._get('bagging_freq')
+
+    def setBooster(self, value):
+        return self._set('booster', value)
+
+    def getBooster(self):
+        return self._get('booster')
+
+    def setBoostingType(self, value):
+        return self._set('boosting_type', value)
+
+    def getBoostingType(self):
+        return self._get('boosting_type')
+
+    def setDropRate(self, value):
+        return self._set('drop_rate', value)
+
+    def getDropRate(self):
+        return self._get('drop_rate')
+
+    def setEarlyStoppingRound(self, value):
+        return self._set('early_stopping_round', value)
+
+    def getEarlyStoppingRound(self):
+        return self._get('early_stopping_round')
+
+    def setFeatureCols(self, value):
+        return self._set('feature_cols', value)
+
+    def getFeatureCols(self):
+        return self._get('feature_cols')
+
+    def setFeatureFraction(self, value):
+        return self._set('feature_fraction', value)
+
+    def getFeatureFraction(self):
+        return self._get('feature_fraction')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setFeaturesShapCol(self, value):
+        return self._set('features_shap_col', value)
+
+    def getFeaturesShapCol(self):
+        return self._get('features_shap_col')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLambdaL1(self, value):
+        return self._set('lambda_l1', value)
+
+    def getLambdaL1(self):
+        return self._get('lambda_l1')
+
+    def setLambdaL2(self, value):
+        return self._set('lambda_l2', value)
+
+    def getLambdaL2(self):
+        return self._get('lambda_l2')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setMaxBin(self, value):
+        return self._set('max_bin', value)
+
+    def getMaxBin(self):
+        return self._get('max_bin')
+
+    def setMaxDepth(self, value):
+        return self._set('max_depth', value)
+
+    def getMaxDepth(self):
+        return self._get('max_depth')
+
+    def setMaxDrop(self, value):
+        return self._set('max_drop', value)
+
+    def getMaxDrop(self):
+        return self._get('max_drop')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setMinDataInLeaf(self, value):
+        return self._set('min_data_in_leaf', value)
+
+    def getMinDataInLeaf(self):
+        return self._get('min_data_in_leaf')
+
+    def setMinGainToSplit(self, value):
+        return self._set('min_gain_to_split', value)
+
+    def getMinGainToSplit(self):
+        return self._get('min_gain_to_split')
+
+    def setMinSumHessianInLeaf(self, value):
+        return self._set('min_sum_hessian_in_leaf', value)
+
+    def getMinSumHessianInLeaf(self):
+        return self._get('min_sum_hessian_in_leaf')
+
+    def setMonotoneConstraints(self, value):
+        return self._set('monotone_constraints', value)
+
+    def getMonotoneConstraints(self):
+        return self._get('monotone_constraints')
+
+    def setNumIterations(self, value):
+        return self._set('num_iterations', value)
+
+    def getNumIterations(self):
+        return self._get('num_iterations')
+
+    def setNumLeaves(self, value):
+        return self._set('num_leaves', value)
+
+    def getNumLeaves(self):
+        return self._get('num_leaves')
+
+    def setOtherRate(self, value):
+        return self._set('other_rate', value)
+
+    def getOtherRate(self):
+        return self._get('other_rate')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setSkipDrop(self, value):
+        return self._set('skip_drop', value)
+
+    def getSkipDrop(self):
+        return self._get('skip_drop')
+
+    def setTopRate(self, value):
+        return self._set('top_rate', value)
+
+    def getTopRate(self):
+        return self._get('top_rate')
+
+    def setValidationIndicatorCol(self, value):
+        return self._set('validation_indicator_col', value)
+
+    def getValidationIndicatorCol(self):
+        return self._get('validation_indicator_col')
+
+    def setVerbosity(self, value):
+        return self._set('verbosity', value)
+
+    def getVerbosity(self):
+        return self._get('verbosity')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class LightGBMRegressionModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.gbdt.estimators.LightGBMRegressionModel``)."""
+
+    _target = 'synapseml_tpu.gbdt.estimators.LightGBMRegressionModel'
+
+    def setBaggingFraction(self, value):
+        return self._set('bagging_fraction', value)
+
+    def getBaggingFraction(self):
+        return self._get('bagging_fraction')
+
+    def setBaggingFreq(self, value):
+        return self._set('bagging_freq', value)
+
+    def getBaggingFreq(self):
+        return self._get('bagging_freq')
+
+    def setBooster(self, value):
+        return self._set('booster', value)
+
+    def getBooster(self):
+        return self._get('booster')
+
+    def setBoostingType(self, value):
+        return self._set('boosting_type', value)
+
+    def getBoostingType(self):
+        return self._get('boosting_type')
+
+    def setDropRate(self, value):
+        return self._set('drop_rate', value)
+
+    def getDropRate(self):
+        return self._get('drop_rate')
+
+    def setEarlyStoppingRound(self, value):
+        return self._set('early_stopping_round', value)
+
+    def getEarlyStoppingRound(self):
+        return self._get('early_stopping_round')
+
+    def setFeatureCols(self, value):
+        return self._set('feature_cols', value)
+
+    def getFeatureCols(self):
+        return self._get('feature_cols')
+
+    def setFeatureFraction(self, value):
+        return self._set('feature_fraction', value)
+
+    def getFeatureFraction(self):
+        return self._get('feature_fraction')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setFeaturesShapCol(self, value):
+        return self._set('features_shap_col', value)
+
+    def getFeaturesShapCol(self):
+        return self._get('features_shap_col')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLambdaL1(self, value):
+        return self._set('lambda_l1', value)
+
+    def getLambdaL1(self):
+        return self._get('lambda_l1')
+
+    def setLambdaL2(self, value):
+        return self._set('lambda_l2', value)
+
+    def getLambdaL2(self):
+        return self._get('lambda_l2')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setMaxBin(self, value):
+        return self._set('max_bin', value)
+
+    def getMaxBin(self):
+        return self._get('max_bin')
+
+    def setMaxDepth(self, value):
+        return self._set('max_depth', value)
+
+    def getMaxDepth(self):
+        return self._get('max_depth')
+
+    def setMaxDrop(self, value):
+        return self._set('max_drop', value)
+
+    def getMaxDrop(self):
+        return self._get('max_drop')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setMinDataInLeaf(self, value):
+        return self._set('min_data_in_leaf', value)
+
+    def getMinDataInLeaf(self):
+        return self._get('min_data_in_leaf')
+
+    def setMinGainToSplit(self, value):
+        return self._set('min_gain_to_split', value)
+
+    def getMinGainToSplit(self):
+        return self._get('min_gain_to_split')
+
+    def setMinSumHessianInLeaf(self, value):
+        return self._set('min_sum_hessian_in_leaf', value)
+
+    def getMinSumHessianInLeaf(self):
+        return self._get('min_sum_hessian_in_leaf')
+
+    def setMonotoneConstraints(self, value):
+        return self._set('monotone_constraints', value)
+
+    def getMonotoneConstraints(self):
+        return self._get('monotone_constraints')
+
+    def setNumIterations(self, value):
+        return self._set('num_iterations', value)
+
+    def getNumIterations(self):
+        return self._get('num_iterations')
+
+    def setNumLeaves(self, value):
+        return self._set('num_leaves', value)
+
+    def getNumLeaves(self):
+        return self._get('num_leaves')
+
+    def setOtherRate(self, value):
+        return self._set('other_rate', value)
+
+    def getOtherRate(self):
+        return self._get('other_rate')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setSkipDrop(self, value):
+        return self._set('skip_drop', value)
+
+    def getSkipDrop(self):
+        return self._get('skip_drop')
+
+    def setTopRate(self, value):
+        return self._set('top_rate', value)
+
+    def getTopRate(self):
+        return self._get('top_rate')
+
+    def setValidationIndicatorCol(self, value):
+        return self._set('validation_indicator_col', value)
+
+    def getValidationIndicatorCol(self):
+        return self._get('validation_indicator_col')
+
+    def setVerbosity(self, value):
+        return self._set('verbosity', value)
+
+    def getVerbosity(self):
+        return self._get('verbosity')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class LightGBMRegressor(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.gbdt.estimators.LightGBMRegressor``)."""
+
+    _target = 'synapseml_tpu.gbdt.estimators.LightGBMRegressor'
+
+    def setAlpha(self, value):
+        return self._set('alpha', value)
+
+    def getAlpha(self):
+        return self._get('alpha')
+
+    def setBaggingFraction(self, value):
+        return self._set('bagging_fraction', value)
+
+    def getBaggingFraction(self):
+        return self._get('bagging_fraction')
+
+    def setBaggingFreq(self, value):
+        return self._set('bagging_freq', value)
+
+    def getBaggingFreq(self):
+        return self._get('bagging_freq')
+
+    def setBoostingType(self, value):
+        return self._set('boosting_type', value)
+
+    def getBoostingType(self):
+        return self._get('boosting_type')
+
+    def setDropRate(self, value):
+        return self._set('drop_rate', value)
+
+    def getDropRate(self):
+        return self._get('drop_rate')
+
+    def setEarlyStoppingRound(self, value):
+        return self._set('early_stopping_round', value)
+
+    def getEarlyStoppingRound(self):
+        return self._get('early_stopping_round')
+
+    def setFeatureCols(self, value):
+        return self._set('feature_cols', value)
+
+    def getFeatureCols(self):
+        return self._get('feature_cols')
+
+    def setFeatureFraction(self, value):
+        return self._set('feature_fraction', value)
+
+    def getFeatureFraction(self):
+        return self._get('feature_fraction')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLambdaL1(self, value):
+        return self._set('lambda_l1', value)
+
+    def getLambdaL1(self):
+        return self._get('lambda_l1')
+
+    def setLambdaL2(self, value):
+        return self._set('lambda_l2', value)
+
+    def getLambdaL2(self):
+        return self._get('lambda_l2')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setMaxBin(self, value):
+        return self._set('max_bin', value)
+
+    def getMaxBin(self):
+        return self._get('max_bin')
+
+    def setMaxDepth(self, value):
+        return self._set('max_depth', value)
+
+    def getMaxDepth(self):
+        return self._get('max_depth')
+
+    def setMaxDrop(self, value):
+        return self._set('max_drop', value)
+
+    def getMaxDrop(self):
+        return self._get('max_drop')
+
+    def setMeshConfig(self, value):
+        return self._set('mesh_config', value)
+
+    def getMeshConfig(self):
+        return self._get('mesh_config')
+
+    def setMinDataInLeaf(self, value):
+        return self._set('min_data_in_leaf', value)
+
+    def getMinDataInLeaf(self):
+        return self._get('min_data_in_leaf')
+
+    def setMinGainToSplit(self, value):
+        return self._set('min_gain_to_split', value)
+
+    def getMinGainToSplit(self):
+        return self._get('min_gain_to_split')
+
+    def setMinSumHessianInLeaf(self, value):
+        return self._set('min_sum_hessian_in_leaf', value)
+
+    def getMinSumHessianInLeaf(self):
+        return self._get('min_sum_hessian_in_leaf')
+
+    def setMonotoneConstraints(self, value):
+        return self._set('monotone_constraints', value)
+
+    def getMonotoneConstraints(self):
+        return self._get('monotone_constraints')
+
+    def setNumIterations(self, value):
+        return self._set('num_iterations', value)
+
+    def getNumIterations(self):
+        return self._get('num_iterations')
+
+    def setNumLeaves(self, value):
+        return self._set('num_leaves', value)
+
+    def getNumLeaves(self):
+        return self._get('num_leaves')
+
+    def setObjective(self, value):
+        return self._set('objective', value)
+
+    def getObjective(self):
+        return self._get('objective')
+
+    def setOtherRate(self, value):
+        return self._set('other_rate', value)
+
+    def getOtherRate(self):
+        return self._get('other_rate')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setSkipDrop(self, value):
+        return self._set('skip_drop', value)
+
+    def getSkipDrop(self):
+        return self._get('skip_drop')
+
+    def setTopRate(self, value):
+        return self._set('top_rate', value)
+
+    def getTopRate(self):
+        return self._get('top_rate')
+
+    def setValidationIndicatorCol(self, value):
+        return self._set('validation_indicator_col', value)
+
+    def getValidationIndicatorCol(self):
+        return self._get('validation_indicator_col')
+
+    def setVerbosity(self, value):
+        return self._set('verbosity', value)
+
+    def getVerbosity(self):
+        return self._get('verbosity')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+

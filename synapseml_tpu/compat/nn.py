@@ -1,0 +1,201 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class ConditionalKNN(WrapperBase):
+    """(ref ``nn/ConditionalKNN.scala``) — neighbors restricted per query to (wraps ``synapseml_tpu.nn.knn.ConditionalKNN``)."""
+
+    _target = 'synapseml_tpu.nn.knn.ConditionalKNN'
+
+    def setConditionerCol(self, value):
+        return self._set('conditioner_col', value)
+
+    def getConditionerCol(self):
+        return self._get('conditioner_col')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setK(self, value):
+        return self._set('k', value)
+
+    def getK(self):
+        return self._get('k')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setQueryBatch(self, value):
+        return self._set('query_batch', value)
+
+    def getQueryBatch(self):
+        return self._get('query_batch')
+
+    def setValuesCol(self, value):
+        return self._set('values_col', value)
+
+    def getValuesCol(self):
+        return self._get('values_col')
+
+
+class ConditionalKNNModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.nn.knn.ConditionalKNNModel``)."""
+
+    _target = 'synapseml_tpu.nn.knn.ConditionalKNNModel'
+
+    def setConditionerCol(self, value):
+        return self._set('conditioner_col', value)
+
+    def getConditionerCol(self):
+        return self._get('conditioner_col')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setIndex(self, value):
+        return self._set('index', value)
+
+    def getIndex(self):
+        return self._get('index')
+
+    def setK(self, value):
+        return self._set('k', value)
+
+    def getK(self):
+        return self._get('k')
+
+    def setLabels(self, value):
+        return self._set('labels', value)
+
+    def getLabels(self):
+        return self._get('labels')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setQueryBatch(self, value):
+        return self._set('query_batch', value)
+
+    def getQueryBatch(self):
+        return self._get('query_batch')
+
+    def setValues(self, value):
+        return self._set('values', value)
+
+    def getValues(self):
+        return self._get('values')
+
+
+class KNN(WrapperBase):
+    """(ref ``nn/KNN.scala:49``) (wraps ``synapseml_tpu.nn.knn.KNN``)."""
+
+    _target = 'synapseml_tpu.nn.knn.KNN'
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setK(self, value):
+        return self._set('k', value)
+
+    def getK(self):
+        return self._get('k')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setQueryBatch(self, value):
+        return self._set('query_batch', value)
+
+    def getQueryBatch(self):
+        return self._get('query_batch')
+
+    def setValuesCol(self, value):
+        return self._set('values_col', value)
+
+    def getValuesCol(self):
+        return self._get('values_col')
+
+
+class KNNModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.nn.knn.KNNModel``)."""
+
+    _target = 'synapseml_tpu.nn.knn.KNNModel'
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setIndex(self, value):
+        return self._set('index', value)
+
+    def getIndex(self):
+        return self._get('index')
+
+    def setK(self, value):
+        return self._set('k', value)
+
+    def getK(self):
+        return self._get('k')
+
+    def setLabels(self, value):
+        return self._set('labels', value)
+
+    def getLabels(self):
+        return self._get('labels')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setQueryBatch(self, value):
+        return self._set('query_batch', value)
+
+    def getQueryBatch(self):
+        return self._get('query_batch')
+
+    def setValues(self, value):
+        return self._set('values', value)
+
+    def getValues(self):
+        return self._get('values')
+

@@ -1,0 +1,111 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class ImageFeaturizer(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.onnx.featurizer.ImageFeaturizer``)."""
+
+    _target = 'synapseml_tpu.onnx.featurizer.ImageFeaturizer'
+
+    def setCenterCrop(self, value):
+        return self._set('center_crop', value)
+
+    def getCenterCrop(self):
+        return self._get('center_crop')
+
+    def setFeatureTensorName(self, value):
+        return self._set('feature_tensor_name', value)
+
+    def getFeatureTensorName(self):
+        return self._get('feature_tensor_name')
+
+    def setHeadLess(self, value):
+        return self._set('head_less', value)
+
+    def getHeadLess(self):
+        return self._get('head_less')
+
+    def setImageHeight(self, value):
+        return self._set('image_height', value)
+
+    def getImageHeight(self):
+        return self._get('image_height')
+
+    def setImageWidth(self, value):
+        return self._set('image_width', value)
+
+    def getImageWidth(self):
+        return self._get('image_width')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setMiniBatchSize(self, value):
+        return self._set('mini_batch_size', value)
+
+    def getMiniBatchSize(self):
+        return self._get('mini_batch_size')
+
+    def setModelPayload(self, value):
+        return self._set('model_payload', value)
+
+    def getModelPayload(self):
+        return self._get('model_payload')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class ONNXModel(WrapperBase):
+    """(ref ``ONNXModel.scala:145``) (wraps ``synapseml_tpu.onnx.model.ONNXModel``)."""
+
+    _target = 'synapseml_tpu.onnx.model.ONNXModel'
+
+    def setArgmaxDict(self, value):
+        return self._set('argmax_dict', value)
+
+    def getArgmaxDict(self):
+        return self._get('argmax_dict')
+
+    def setFeedDict(self, value):
+        return self._set('feed_dict', value)
+
+    def getFeedDict(self):
+        return self._get('feed_dict')
+
+    def setFetchDict(self, value):
+        return self._set('fetch_dict', value)
+
+    def getFetchDict(self):
+        return self._get('fetch_dict')
+
+    def setMiniBatchSize(self, value):
+        return self._set('mini_batch_size', value)
+
+    def getMiniBatchSize(self):
+        return self._get('mini_batch_size')
+
+    def setModelPayload(self, value):
+        return self._set('model_payload', value)
+
+    def getModelPayload(self):
+        return self._get('model_payload')
+
+    def setSoftmaxDict(self, value):
+        return self._set('softmax_dict', value)
+
+    def getSoftmaxDict(self):
+        return self._get('softmax_dict')
+

@@ -1,0 +1,135 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class ImageSetAugmenter(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.image.augment.ImageSetAugmenter``)."""
+
+    _target = 'synapseml_tpu.image.augment.ImageSetAugmenter'
+
+    def setFlipLeftRight(self, value):
+        return self._set('flip_left_right', value)
+
+    def getFlipLeftRight(self):
+        return self._get('flip_left_right')
+
+    def setFlipUpDown(self, value):
+        return self._set('flip_up_down', value)
+
+    def getFlipUpDown(self):
+        return self._get('flip_up_down')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class SuperpixelTransformer(WrapperBase):
+    """(ref ``SuperpixelTransformer.scala``) emits, per image, the superpixel (wraps ``synapseml_tpu.image.superpixel.SuperpixelTransformer``)."""
+
+    _target = 'synapseml_tpu.image.superpixel.SuperpixelTransformer'
+
+    def setCellSize(self, value):
+        return self._set('cell_size', value)
+
+    def getCellSize(self):
+        return self._get('cell_size')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setModifier(self, value):
+        return self._set('modifier', value)
+
+    def getModifier(self):
+        return self._get('modifier')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class ImageTransformer(WrapperBase):
+    """Chain of image stages + normalization + optional tensor output (wraps ``synapseml_tpu.image.transforms.ImageTransformer``)."""
+
+    _target = 'synapseml_tpu.image.transforms.ImageTransformer'
+
+    def setColorScaleFactor(self, value):
+        return self._set('color_scale_factor', value)
+
+    def getColorScaleFactor(self):
+        return self._get('color_scale_factor')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setNormMeans(self, value):
+        return self._set('norm_means', value)
+
+    def getNormMeans(self):
+        return self._get('norm_means')
+
+    def setNormStds(self, value):
+        return self._set('norm_stds', value)
+
+    def getNormStds(self):
+        return self._get('norm_stds')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setStages(self, value):
+        return self._set('stages', value)
+
+    def getStages(self):
+        return self._get('stages')
+
+    def setToTensor(self, value):
+        return self._set('to_tensor', value)
+
+    def getToTensor(self):
+        return self._get('to_tensor')
+
+
+class UnrollImage(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.image.unroll.UnrollImage``)."""
+
+    _target = 'synapseml_tpu.image.unroll.UnrollImage'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+

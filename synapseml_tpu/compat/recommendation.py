@@ -1,0 +1,339 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class RankingAdapter(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.recommendation.adapter.RankingAdapter``)."""
+
+    _target = 'synapseml_tpu.recommendation.adapter.RankingAdapter'
+
+    def setItemCol(self, value):
+        return self._set('item_col', value)
+
+    def getItemCol(self):
+        return self._get('item_col')
+
+    def setK(self, value):
+        return self._set('k', value)
+
+    def getK(self):
+        return self._get('k')
+
+    def setRecommender(self, value):
+        return self._set('recommender', value)
+
+    def getRecommender(self):
+        return self._get('recommender')
+
+    def setUserCol(self, value):
+        return self._set('user_col', value)
+
+    def getUserCol(self):
+        return self._get('user_col')
+
+
+class RankingAdapterModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.recommendation.adapter.RankingAdapterModel``)."""
+
+    _target = 'synapseml_tpu.recommendation.adapter.RankingAdapterModel'
+
+    def setItemCol(self, value):
+        return self._set('item_col', value)
+
+    def getItemCol(self):
+        return self._get('item_col')
+
+    def setK(self, value):
+        return self._set('k', value)
+
+    def getK(self):
+        return self._get('k')
+
+    def setRecommenderModel(self, value):
+        return self._set('recommender_model', value)
+
+    def getRecommenderModel(self):
+        return self._get('recommender_model')
+
+    def setUserCol(self, value):
+        return self._set('user_col', value)
+
+    def getUserCol(self):
+        return self._get('user_col')
+
+
+class RankingTrainValidationSplit(WrapperBase):
+    """(ref ``RankingTrainValidationSplit.scala:25``) — per-user holdout split + (wraps ``synapseml_tpu.recommendation.adapter.RankingTrainValidationSplit``)."""
+
+    _target = 'synapseml_tpu.recommendation.adapter.RankingTrainValidationSplit'
+
+    def setEstimator(self, value):
+        return self._set('estimator', value)
+
+    def getEstimator(self):
+        return self._get('estimator')
+
+    def setEstimatorParamMaps(self, value):
+        return self._set('estimator_param_maps', value)
+
+    def getEstimatorParamMaps(self):
+        return self._get('estimator_param_maps')
+
+    def setEvaluator(self, value):
+        return self._set('evaluator', value)
+
+    def getEvaluator(self):
+        return self._get('evaluator')
+
+    def setItemCol(self, value):
+        return self._set('item_col', value)
+
+    def getItemCol(self):
+        return self._get('item_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTrainRatio(self, value):
+        return self._set('train_ratio', value)
+
+    def getTrainRatio(self):
+        return self._get('train_ratio')
+
+    def setUserCol(self, value):
+        return self._set('user_col', value)
+
+    def getUserCol(self):
+        return self._get('user_col')
+
+
+class RankingTrainValidationSplitModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.recommendation.adapter.RankingTrainValidationSplitModel``)."""
+
+    _target = 'synapseml_tpu.recommendation.adapter.RankingTrainValidationSplitModel'
+
+    def setBestModel(self, value):
+        return self._set('best_model', value)
+
+    def getBestModel(self):
+        return self._get('best_model')
+
+    def setValidationMetrics(self, value):
+        return self._set('validation_metrics', value)
+
+    def getValidationMetrics(self):
+        return self._get('validation_metrics')
+
+
+class RankingEvaluator(WrapperBase):
+    """Consumes a DataFrame with per-user prediction and ground-truth item (wraps ``synapseml_tpu.recommendation.evaluator.RankingEvaluator``)."""
+
+    _target = 'synapseml_tpu.recommendation.evaluator.RankingEvaluator'
+
+    def setK(self, value):
+        return self._set('k', value)
+
+    def getK(self):
+        return self._get('k')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setMetricName(self, value):
+        return self._set('metric_name', value)
+
+    def getMetricName(self):
+        return self._get('metric_name')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+
+class RecommendationIndexer(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.recommendation.indexer.RecommendationIndexer``)."""
+
+    _target = 'synapseml_tpu.recommendation.indexer.RecommendationIndexer'
+
+    def setItemInputCol(self, value):
+        return self._set('item_input_col', value)
+
+    def getItemInputCol(self):
+        return self._get('item_input_col')
+
+    def setItemOutputCol(self, value):
+        return self._set('item_output_col', value)
+
+    def getItemOutputCol(self):
+        return self._get('item_output_col')
+
+    def setUserInputCol(self, value):
+        return self._set('user_input_col', value)
+
+    def getUserInputCol(self):
+        return self._get('user_input_col')
+
+    def setUserOutputCol(self, value):
+        return self._set('user_output_col', value)
+
+    def getUserOutputCol(self):
+        return self._get('user_output_col')
+
+
+class RecommendationIndexerModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.recommendation.indexer.RecommendationIndexerModel``)."""
+
+    _target = 'synapseml_tpu.recommendation.indexer.RecommendationIndexerModel'
+
+    def setItemInputCol(self, value):
+        return self._set('item_input_col', value)
+
+    def getItemInputCol(self):
+        return self._get('item_input_col')
+
+    def setItemLevels(self, value):
+        return self._set('item_levels', value)
+
+    def getItemLevels(self):
+        return self._get('item_levels')
+
+    def setItemOutputCol(self, value):
+        return self._set('item_output_col', value)
+
+    def getItemOutputCol(self):
+        return self._get('item_output_col')
+
+    def setUserInputCol(self, value):
+        return self._set('user_input_col', value)
+
+    def getUserInputCol(self):
+        return self._get('user_input_col')
+
+    def setUserLevels(self, value):
+        return self._set('user_levels', value)
+
+    def getUserLevels(self):
+        return self._get('user_levels')
+
+    def setUserOutputCol(self, value):
+        return self._set('user_output_col', value)
+
+    def getUserOutputCol(self):
+        return self._get('user_output_col')
+
+
+class SAR(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.recommendation.sar.SAR``)."""
+
+    _target = 'synapseml_tpu.recommendation.sar.SAR'
+
+    def setItemCol(self, value):
+        return self._set('item_col', value)
+
+    def getItemCol(self):
+        return self._get('item_col')
+
+    def setRatingCol(self, value):
+        return self._set('rating_col', value)
+
+    def getRatingCol(self):
+        return self._get('rating_col')
+
+    def setSimilarityFunction(self, value):
+        return self._set('similarity_function', value)
+
+    def getSimilarityFunction(self):
+        return self._get('similarity_function')
+
+    def setSupportThreshold(self, value):
+        return self._set('support_threshold', value)
+
+    def getSupportThreshold(self):
+        return self._get('support_threshold')
+
+    def setTimeCol(self, value):
+        return self._set('time_col', value)
+
+    def getTimeCol(self):
+        return self._get('time_col')
+
+    def setTimeDecayCoeff(self, value):
+        return self._set('time_decay_coeff', value)
+
+    def getTimeDecayCoeff(self):
+        return self._get('time_decay_coeff')
+
+    def setUserCol(self, value):
+        return self._set('user_col', value)
+
+    def getUserCol(self):
+        return self._get('user_col')
+
+
+class SARModel(WrapperBase):
+    """(ref ``SARModel.scala:23``) — ``recommend_for_all_users(k)`` and (wraps ``synapseml_tpu.recommendation.sar.SARModel``)."""
+
+    _target = 'synapseml_tpu.recommendation.sar.SARModel'
+
+    def setItemCol(self, value):
+        return self._set('item_col', value)
+
+    def getItemCol(self):
+        return self._get('item_col')
+
+    def setItemDataFrame(self, value):
+        return self._set('item_data_frame', value)
+
+    def getItemDataFrame(self):
+        return self._get('item_data_frame')
+
+    def setK(self, value):
+        return self._set('k', value)
+
+    def getK(self):
+        return self._get('k')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setRemoveSeen(self, value):
+        return self._set('remove_seen', value)
+
+    def getRemoveSeen(self):
+        return self._get('remove_seen')
+
+    def setSeenItems(self, value):
+        return self._set('seen_items', value)
+
+    def getSeenItems(self):
+        return self._get('seen_items')
+
+    def setUserCol(self, value):
+        return self._set('user_col', value)
+
+    def getUserCol(self):
+        return self._get('user_col')
+
+    def setUserDataFrame(self, value):
+        return self._set('user_data_frame', value)
+
+    def getUserDataFrame(self):
+        return self._get('user_data_frame')
+

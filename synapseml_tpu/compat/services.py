@@ -1,0 +1,3429 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class AIFoundryChatCompletion(WrapperBase):
+    """Subclasses define ``build_request(row_params) -> HTTPRequest`` and (wraps ``synapseml_tpu.services.aifoundry.AIFoundryChatCompletion``)."""
+
+    _target = 'synapseml_tpu.services.aifoundry.AIFoundryChatCompletion'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setMaxTokens(self, value):
+        return self._set('max_tokens', value)
+
+    def getMaxTokens(self):
+        return self._get('max_tokens')
+
+    def setMessagesCol(self, value):
+        return self._set('messages_col', value)
+
+    def getMessagesCol(self):
+        return self._get('messages_col')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTemperature(self, value):
+        return self._set('temperature', value)
+
+    def getTemperature(self):
+        return self._get('temperature')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class DetectAnomalies(WrapperBase):
+    """(ref ``DetectAnomalies``) — whole-series batch detection. (wraps ``synapseml_tpu.services.anomaly.DetectAnomalies``)."""
+
+    _target = 'synapseml_tpu.services.anomaly.DetectAnomalies'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setGranularity(self, value):
+        return self._set('granularity', value)
+
+    def getGranularity(self):
+        return self._get('granularity')
+
+    def setMaxAnomalyRatio(self, value):
+        return self._set('max_anomaly_ratio', value)
+
+    def getMaxAnomalyRatio(self):
+        return self._get('max_anomaly_ratio')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSensitivity(self, value):
+        return self._set('sensitivity', value)
+
+    def getSensitivity(self):
+        return self._get('sensitivity')
+
+    def setSeriesCol(self, value):
+        return self._set('series_col', value)
+
+    def getSeriesCol(self):
+        return self._get('series_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class DetectLastAnomaly(WrapperBase):
+    """(ref ``DetectLastAnomaly``) — is the latest point of the series anomalous. (wraps ``synapseml_tpu.services.anomaly.DetectLastAnomaly``)."""
+
+    _target = 'synapseml_tpu.services.anomaly.DetectLastAnomaly'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setGranularity(self, value):
+        return self._set('granularity', value)
+
+    def getGranularity(self):
+        return self._get('granularity')
+
+    def setMaxAnomalyRatio(self, value):
+        return self._set('max_anomaly_ratio', value)
+
+    def getMaxAnomalyRatio(self):
+        return self._get('max_anomaly_ratio')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSensitivity(self, value):
+        return self._set('sensitivity', value)
+
+    def getSensitivity(self):
+        return self._get('sensitivity')
+
+    def setSeriesCol(self, value):
+        return self._set('series_col', value)
+
+    def getSeriesCol(self):
+        return self._get('series_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class DetectMultivariateAnomaly(WrapperBase):
+    """Inference side: POST detect job for a window, poll the result. (wraps ``synapseml_tpu.services.anomaly.DetectMultivariateAnomaly``)."""
+
+    _target = 'synapseml_tpu.services.anomaly.DetectMultivariateAnomaly'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setEndTimeCol(self, value):
+        return self._set('end_time_col', value)
+
+    def getEndTimeCol(self):
+        return self._get('end_time_col')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setModelId(self, value):
+        return self._set('model_id', value)
+
+    def getModelId(self):
+        return self._get('model_id')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSourceCol(self, value):
+        return self._set('source_col', value)
+
+    def getSourceCol(self):
+        return self._get('source_col')
+
+    def setStartTimeCol(self, value):
+        return self._set('start_time_col', value)
+
+    def getStartTimeCol(self):
+        return self._get('start_time_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class FitMultivariateAnomaly(WrapperBase):
+    """(ref ``MultivariateAnomalyDetection.scala:184-269`` FitMultivariate- (wraps ``synapseml_tpu.services.anomaly.FitMultivariateAnomaly``)."""
+
+    _target = 'synapseml_tpu.services.anomaly.FitMultivariateAnomaly'
+
+    def setAlignMode(self, value):
+        return self._set('align_mode', value)
+
+    def getAlignMode(self):
+        return self._get('align_mode')
+
+    def setEndTime(self, value):
+        return self._set('end_time', value)
+
+    def getEndTime(self):
+        return self._get('end_time')
+
+    def setFillNaMethod(self, value):
+        return self._set('fill_na_method', value)
+
+    def getFillNaMethod(self):
+        return self._get('fill_na_method')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSlidingWindow(self, value):
+        return self._set('sliding_window', value)
+
+    def getSlidingWindow(self):
+        return self._get('sliding_window')
+
+    def setSource(self, value):
+        return self._set('source', value)
+
+    def getSource(self):
+        return self._get('source')
+
+    def setStartTime(self, value):
+        return self._set('start_time', value)
+
+    def getStartTime(self):
+        return self._get('start_time')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class SimpleDetectAnomalies(WrapperBase):
+    """(ref ``SimpleDetectAnomalies``) — long-format rows (group, timestamp, (wraps ``synapseml_tpu.services.anomaly.SimpleDetectAnomalies``)."""
+
+    _target = 'synapseml_tpu.services.anomaly.SimpleDetectAnomalies'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setGranularity(self, value):
+        return self._set('granularity', value)
+
+    def getGranularity(self):
+        return self._get('granularity')
+
+    def setGroupCol(self, value):
+        return self._set('group_col', value)
+
+    def getGroupCol(self):
+        return self._get('group_col')
+
+    def setMaxAnomalyRatio(self, value):
+        return self._set('max_anomaly_ratio', value)
+
+    def getMaxAnomalyRatio(self):
+        return self._get('max_anomaly_ratio')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSensitivity(self, value):
+        return self._set('sensitivity', value)
+
+    def getSensitivity(self):
+        return self._get('sensitivity')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setTimestampCol(self, value):
+        return self._set('timestamp_col', value)
+
+    def getTimestampCol(self):
+        return self._get('timestamp_col')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+    def setValueCol(self, value):
+        return self._set('value_col', value)
+
+    def getValueCol(self):
+        return self._get('value_col')
+
+
+class CognitiveServiceBase(WrapperBase):
+    """Subclasses define ``build_request(row_params) -> HTTPRequest`` and (wraps ``synapseml_tpu.services.base.CognitiveServiceBase``)."""
+
+    _target = 'synapseml_tpu.services.base.CognitiveServiceBase'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class HasAsyncReply(WrapperBase):
+    """Long-running-operation support (reference ``HasAsyncReply`` / (wraps ``synapseml_tpu.services.base.HasAsyncReply``)."""
+
+    _target = 'synapseml_tpu.services.base.HasAsyncReply'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class DetectFace(WrapperBase):
+    """(ref ``DetectFace``) (wraps ``synapseml_tpu.services.face.DetectFace``)."""
+
+    _target = 'synapseml_tpu.services.face.DetectFace'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setReturnFaceAttributes(self, value):
+        return self._set('return_face_attributes', value)
+
+    def getReturnFaceAttributes(self):
+        return self._get('return_face_attributes')
+
+    def setReturnFaceId(self, value):
+        return self._set('return_face_id', value)
+
+    def getReturnFaceId(self):
+        return self._get('return_face_id')
+
+    def setReturnFaceLandmarks(self, value):
+        return self._set('return_face_landmarks', value)
+
+    def getReturnFaceLandmarks(self):
+        return self._get('return_face_landmarks')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class FindSimilarFace(WrapperBase):
+    """(ref ``FindSimilar``) (wraps ``synapseml_tpu.services.face.FindSimilarFace``)."""
+
+    _target = 'synapseml_tpu.services.face.FindSimilarFace'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setFaceIdCol(self, value):
+        return self._set('face_id_col', value)
+
+    def getFaceIdCol(self):
+        return self._get('face_id_col')
+
+    def setFaceIds(self, value):
+        return self._set('face_ids', value)
+
+    def getFaceIds(self):
+        return self._get('face_ids')
+
+    def setMaxCandidates(self, value):
+        return self._set('max_candidates', value)
+
+    def getMaxCandidates(self):
+        return self._get('max_candidates')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class GroupFaces(WrapperBase):
+    """(ref ``GroupFaces``) (wraps ``synapseml_tpu.services.face.GroupFaces``)."""
+
+    _target = 'synapseml_tpu.services.face.GroupFaces'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setFaceIdsCol(self, value):
+        return self._set('face_ids_col', value)
+
+    def getFaceIdsCol(self):
+        return self._get('face_ids_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class IdentifyFaces(WrapperBase):
+    """(ref ``IdentifyFaces``) (wraps ``synapseml_tpu.services.face.IdentifyFaces``)."""
+
+    _target = 'synapseml_tpu.services.face.IdentifyFaces'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setConfidenceThreshold(self, value):
+        return self._set('confidence_threshold', value)
+
+    def getConfidenceThreshold(self):
+        return self._get('confidence_threshold')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setFaceIdsCol(self, value):
+        return self._set('face_ids_col', value)
+
+    def getFaceIdsCol(self):
+        return self._get('face_ids_col')
+
+    def setMaxCandidates(self, value):
+        return self._set('max_candidates', value)
+
+    def getMaxCandidates(self):
+        return self._get('max_candidates')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPersonGroupId(self, value):
+        return self._set('person_group_id', value)
+
+    def getPersonGroupId(self):
+        return self._get('person_group_id')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class VerifyFaces(WrapperBase):
+    """(ref ``VerifyFaces``) — same-person check for two face ids. (wraps ``synapseml_tpu.services.face.VerifyFaces``)."""
+
+    _target = 'synapseml_tpu.services.face.VerifyFaces'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setFaceId1Col(self, value):
+        return self._set('face_id1_col', value)
+
+    def getFaceId1Col(self):
+        return self._get('face_id1_col')
+
+    def setFaceId2Col(self, value):
+        return self._set('face_id2_col', value)
+
+    def getFaceId2Col(self):
+        return self._get('face_id2_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class AnalyzeBusinessCards(WrapperBase):
+    """(ref ``FormRecognizer.scala`` AnalyzeDocument) — POST a document (URL (wraps ``synapseml_tpu.services.form.AnalyzeBusinessCards``)."""
+
+    _target = 'synapseml_tpu.services.form.AnalyzeBusinessCards'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setLocale(self, value):
+        return self._set('locale', value)
+
+    def getLocale(self):
+        return self._get('locale')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setModelId(self, value):
+        return self._set('model_id', value)
+
+    def getModelId(self):
+        return self._get('model_id')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPages(self, value):
+        return self._set('pages', value)
+
+    def getPages(self):
+        return self._get('pages')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class AnalyzeDocument(WrapperBase):
+    """(ref ``FormRecognizer.scala`` AnalyzeDocument) — POST a document (URL (wraps ``synapseml_tpu.services.form.AnalyzeDocument``)."""
+
+    _target = 'synapseml_tpu.services.form.AnalyzeDocument'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setLocale(self, value):
+        return self._set('locale', value)
+
+    def getLocale(self):
+        return self._get('locale')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setModelId(self, value):
+        return self._set('model_id', value)
+
+    def getModelId(self):
+        return self._get('model_id')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPages(self, value):
+        return self._set('pages', value)
+
+    def getPages(self):
+        return self._get('pages')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class AnalyzeIDDocuments(WrapperBase):
+    """(ref ``FormRecognizer.scala`` AnalyzeDocument) — POST a document (URL (wraps ``synapseml_tpu.services.form.AnalyzeIDDocuments``)."""
+
+    _target = 'synapseml_tpu.services.form.AnalyzeIDDocuments'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setLocale(self, value):
+        return self._set('locale', value)
+
+    def getLocale(self):
+        return self._get('locale')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setModelId(self, value):
+        return self._set('model_id', value)
+
+    def getModelId(self):
+        return self._get('model_id')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPages(self, value):
+        return self._set('pages', value)
+
+    def getPages(self):
+        return self._get('pages')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class AnalyzeInvoices(WrapperBase):
+    """(ref ``FormRecognizer.scala`` AnalyzeDocument) — POST a document (URL (wraps ``synapseml_tpu.services.form.AnalyzeInvoices``)."""
+
+    _target = 'synapseml_tpu.services.form.AnalyzeInvoices'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setLocale(self, value):
+        return self._set('locale', value)
+
+    def getLocale(self):
+        return self._get('locale')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setModelId(self, value):
+        return self._set('model_id', value)
+
+    def getModelId(self):
+        return self._get('model_id')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPages(self, value):
+        return self._set('pages', value)
+
+    def getPages(self):
+        return self._get('pages')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class AnalyzeLayout(WrapperBase):
+    """(ref ``FormRecognizer.scala`` AnalyzeDocument) — POST a document (URL (wraps ``synapseml_tpu.services.form.AnalyzeLayout``)."""
+
+    _target = 'synapseml_tpu.services.form.AnalyzeLayout'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setLocale(self, value):
+        return self._set('locale', value)
+
+    def getLocale(self):
+        return self._get('locale')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setModelId(self, value):
+        return self._set('model_id', value)
+
+    def getModelId(self):
+        return self._get('model_id')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPages(self, value):
+        return self._set('pages', value)
+
+    def getPages(self):
+        return self._get('pages')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class AnalyzeReceipts(WrapperBase):
+    """(ref ``FormRecognizer.scala`` AnalyzeDocument) — POST a document (URL (wraps ``synapseml_tpu.services.form.AnalyzeReceipts``)."""
+
+    _target = 'synapseml_tpu.services.form.AnalyzeReceipts'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setLocale(self, value):
+        return self._set('locale', value)
+
+    def getLocale(self):
+        return self._get('locale')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setModelId(self, value):
+        return self._set('model_id', value)
+
+    def getModelId(self):
+        return self._get('model_id')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPages(self, value):
+        return self._set('pages', value)
+
+    def getPages(self):
+        return self._get('pages')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class FormOntologyLearner(WrapperBase):
+    """(ref ``FormOntologyLearner.scala``) — unions the field schemas seen in (wraps ``synapseml_tpu.services.form.FormOntologyLearner``)."""
+
+    _target = 'synapseml_tpu.services.form.FormOntologyLearner'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setMinFrequency(self, value):
+        return self._set('min_frequency', value)
+
+    def getMinFrequency(self):
+        return self._get('min_frequency')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class FormOntologyTransformer(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.services.form.FormOntologyTransformer``)."""
+
+    _target = 'synapseml_tpu.services.form.FormOntologyTransformer'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOntology(self, value):
+        return self._set('ontology', value)
+
+    def getOntology(self):
+        return self._get('ontology')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class AddressGeocoder(WrapperBase):
+    """(ref ``AzureMapsGeocode``) — address string -> lat/lon candidates. (wraps ``synapseml_tpu.services.geospatial.AddressGeocoder``)."""
+
+    _target = 'synapseml_tpu.services.geospatial.AddressGeocoder'
+
+    def setAddressCol(self, value):
+        return self._set('address_col', value)
+
+    def getAddressCol(self):
+        return self._get('address_col')
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setLimit(self, value):
+        return self._set('limit', value)
+
+    def getLimit(self):
+        return self._get('limit')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class CheckPointInPolygon(WrapperBase):
+    """(ref ``CheckPointInPolygon``) — is (lat, lon) inside a stored geofence (wraps ``synapseml_tpu.services.geospatial.CheckPointInPolygon``)."""
+
+    _target = 'synapseml_tpu.services.geospatial.CheckPointInPolygon'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setLatCol(self, value):
+        return self._set('lat_col', value)
+
+    def getLatCol(self):
+        return self._get('lat_col')
+
+    def setLonCol(self, value):
+        return self._set('lon_col', value)
+
+    def getLonCol(self):
+        return self._get('lon_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+    def setUserDataId(self, value):
+        return self._set('user_data_id', value)
+
+    def getUserDataId(self):
+        return self._get('user_data_id')
+
+
+class ReverseAddressGeocoder(WrapperBase):
+    """(ref reverse geocode) — (lat, lon) -> nearest address. (wraps ``synapseml_tpu.services.geospatial.ReverseAddressGeocoder``)."""
+
+    _target = 'synapseml_tpu.services.geospatial.ReverseAddressGeocoder'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setLatCol(self, value):
+        return self._set('lat_col', value)
+
+    def getLatCol(self):
+        return self._get('lat_col')
+
+    def setLonCol(self, value):
+        return self._set('lon_col', value)
+
+    def getLonCol(self):
+        return self._get('lon_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class LangChainTransformer(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.services.langchain.LangChainTransformer``)."""
+
+    _target = 'synapseml_tpu.services.langchain.LangChainTransformer'
+
+    def setChain(self, value):
+        return self._set('chain', value)
+
+    def getChain(self):
+        return self._get('chain')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class OpenAIChatCompletion(WrapperBase):
+    """(ref ``OpenAIChatCompletion.scala:98``) — messages col holds a list of (wraps ``synapseml_tpu.services.openai.OpenAIChatCompletion``)."""
+
+    _target = 'synapseml_tpu.services.openai.OpenAIChatCompletion'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setDeploymentName(self, value):
+        return self._set('deployment_name', value)
+
+    def getDeploymentName(self):
+        return self._get('deployment_name')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setMaxTokens(self, value):
+        return self._set('max_tokens', value)
+
+    def getMaxTokens(self):
+        return self._get('max_tokens')
+
+    def setMessagesCol(self, value):
+        return self._set('messages_col', value)
+
+    def getMessagesCol(self):
+        return self._get('messages_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTemperature(self, value):
+        return self._set('temperature', value)
+
+    def getTemperature(self):
+        return self._get('temperature')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class OpenAICompletion(WrapperBase):
+    """(ref ``OpenAICompletion.scala``) (wraps ``synapseml_tpu.services.openai.OpenAICompletion``)."""
+
+    _target = 'synapseml_tpu.services.openai.OpenAICompletion'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setDeploymentName(self, value):
+        return self._set('deployment_name', value)
+
+    def getDeploymentName(self):
+        return self._get('deployment_name')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setMaxTokens(self, value):
+        return self._set('max_tokens', value)
+
+    def getMaxTokens(self):
+        return self._get('max_tokens')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPromptCol(self, value):
+        return self._set('prompt_col', value)
+
+    def getPromptCol(self):
+        return self._get('prompt_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTemperature(self, value):
+        return self._set('temperature', value)
+
+    def getTemperature(self):
+        return self._get('temperature')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class OpenAIEmbedding(WrapperBase):
+    """(ref ``OpenAIEmbedding.scala:27``) — emits the embedding vector (wraps ``synapseml_tpu.services.openai.OpenAIEmbedding``)."""
+
+    _target = 'synapseml_tpu.services.openai.OpenAIEmbedding'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setDeploymentName(self, value):
+        return self._set('deployment_name', value)
+
+    def getDeploymentName(self):
+        return self._get('deployment_name')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setMaxTokens(self, value):
+        return self._set('max_tokens', value)
+
+    def getMaxTokens(self):
+        return self._get('max_tokens')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTemperature(self, value):
+        return self._set('temperature', value)
+
+    def getTemperature(self):
+        return self._get('temperature')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class OpenAIPrompt(WrapperBase):
+    """(ref ``OpenAIPrompt.scala:40-767``) — prompt template interpolated from (wraps ``synapseml_tpu.services.openai.OpenAIPrompt``)."""
+
+    _target = 'synapseml_tpu.services.openai.OpenAIPrompt'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setDeploymentName(self, value):
+        return self._set('deployment_name', value)
+
+    def getDeploymentName(self):
+        return self._get('deployment_name')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setMaxTokens(self, value):
+        return self._set('max_tokens', value)
+
+    def getMaxTokens(self):
+        return self._get('max_tokens')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPostProcessing(self, value):
+        return self._set('post_processing', value)
+
+    def getPostProcessing(self):
+        return self._get('post_processing')
+
+    def setPostProcessingOptions(self, value):
+        return self._set('post_processing_options', value)
+
+    def getPostProcessingOptions(self):
+        return self._get('post_processing_options')
+
+    def setPromptTemplate(self, value):
+        return self._set('prompt_template', value)
+
+    def getPromptTemplate(self):
+        return self._get('prompt_template')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setSystemPrompt(self, value):
+        return self._set('system_prompt', value)
+
+    def getSystemPrompt(self):
+        return self._get('system_prompt')
+
+    def setTemperature(self, value):
+        return self._set('temperature', value)
+
+    def getTemperature(self):
+        return self._get('temperature')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class OpenAIResponses(WrapperBase):
+    """(ref ``OpenAIResponses.scala``) — the /responses API: ``input`` is a (wraps ``synapseml_tpu.services.openai.OpenAIResponses``)."""
+
+    _target = 'synapseml_tpu.services.openai.OpenAIResponses'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setDeploymentName(self, value):
+        return self._set('deployment_name', value)
+
+    def getDeploymentName(self):
+        return self._get('deployment_name')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setMaxTokens(self, value):
+        return self._set('max_tokens', value)
+
+    def getMaxTokens(self):
+        return self._get('max_tokens')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTemperature(self, value):
+        return self._set('temperature', value)
+
+    def getTemperature(self):
+        return self._get('temperature')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class AzureSearchWriter(WrapperBase):
+    """Subclasses define ``build_request(row_params) -> HTTPRequest`` and (wraps ``synapseml_tpu.services.search.AzureSearchWriter``)."""
+
+    _target = 'synapseml_tpu.services.search.AzureSearchWriter'
+
+    def setActionCol(self, value):
+        return self._set('action_col', value)
+
+    def getActionCol(self):
+        return self._get('action_col')
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setCreateIndexIfNotExists(self, value):
+        return self._set('create_index_if_not_exists', value)
+
+    def getCreateIndexIfNotExists(self):
+        return self._get('create_index_if_not_exists')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setIndexJson(self, value):
+        return self._set('index_json', value)
+
+    def getIndexJson(self):
+        return self._get('index_json')
+
+    def setIndexName(self, value):
+        return self._set('index_name', value)
+
+    def getIndexName(self):
+        return self._get('index_name')
+
+    def setKeyCol(self, value):
+        return self._set('key_col', value)
+
+    def getKeyCol(self):
+        return self._get('key_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class SpeechToText(WrapperBase):
+    """Audio bytes -> recognition JSON (DisplayText, offsets). (wraps ``synapseml_tpu.services.speech.SpeechToText``)."""
+
+    _target = 'synapseml_tpu.services.speech.SpeechToText'
+
+    def setAudioCol(self, value):
+        return self._set('audio_col', value)
+
+    def getAudioCol(self):
+        return self._get('audio_col')
+
+    def setAudioFormat(self, value):
+        return self._set('audio_format', value)
+
+    def getAudioFormat(self):
+        return self._get('audio_format')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setFormat(self, value):
+        return self._set('format', value)
+
+    def getFormat(self):
+        return self._get('format')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setProfanity(self, value):
+        return self._set('profanity', value)
+
+    def getProfanity(self):
+        return self._get('profanity')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class TextToSpeech(WrapperBase):
+    """Text -> synthesized audio bytes (SSML POST). (wraps ``synapseml_tpu.services.speech.TextToSpeech``)."""
+
+    _target = 'synapseml_tpu.services.speech.TextToSpeech'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setOutputFormat(self, value):
+        return self._set('output_format', value)
+
+    def getOutputFormat(self):
+        return self._get('output_format')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+    def setVoice(self, value):
+        return self._set('voice', value)
+
+    def getVoice(self):
+        return self._get('voice')
+
+
+class AnalyzeText(WrapperBase):
+    """(ref ``AnalyzeText.scala``) generic analyze-text task. (wraps ``synapseml_tpu.services.text.AnalyzeText``)."""
+
+    _target = 'synapseml_tpu.services.text.AnalyzeText'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setKind(self, value):
+        return self._set('kind', value)
+
+    def getKind(self):
+        return self._get('kind')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class AnalyzeTextLRO(WrapperBase):
+    """Long-running analyze-text jobs (reference (wraps ``synapseml_tpu.services.text.AnalyzeTextLRO``)."""
+
+    _target = 'synapseml_tpu.services.text.AnalyzeTextLRO'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setKind(self, value):
+        return self._set('kind', value)
+
+    def getKind(self):
+        return self._get('kind')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTaskParameters(self, value):
+        return self._set('task_parameters', value)
+
+    def getTaskParameters(self):
+        return self._get('task_parameters')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class EntityRecognizer(WrapperBase):
+    """(ref ``AnalyzeText.scala``) generic analyze-text task. (wraps ``synapseml_tpu.services.text.EntityRecognizer``)."""
+
+    _target = 'synapseml_tpu.services.text.EntityRecognizer'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setKind(self, value):
+        return self._set('kind', value)
+
+    def getKind(self):
+        return self._get('kind')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class KeyPhraseExtractor(WrapperBase):
+    """(ref ``AnalyzeText.scala``) generic analyze-text task. (wraps ``synapseml_tpu.services.text.KeyPhraseExtractor``)."""
+
+    _target = 'synapseml_tpu.services.text.KeyPhraseExtractor'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setKind(self, value):
+        return self._set('kind', value)
+
+    def getKind(self):
+        return self._get('kind')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class LanguageDetector(WrapperBase):
+    """(ref ``AnalyzeText.scala``) generic analyze-text task. (wraps ``synapseml_tpu.services.text.LanguageDetector``)."""
+
+    _target = 'synapseml_tpu.services.text.LanguageDetector'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setKind(self, value):
+        return self._set('kind', value)
+
+    def getKind(self):
+        return self._get('kind')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class TextSentiment(WrapperBase):
+    """(ref ``TextSentiment``) (wraps ``synapseml_tpu.services.text.TextSentiment``)."""
+
+    _target = 'synapseml_tpu.services.text.TextSentiment'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setKind(self, value):
+        return self._set('kind', value)
+
+    def getKind(self):
+        return self._get('kind')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class BreakSentence(WrapperBase):
+    """Sentence boundary lengths (reference ``BreakSentence``): (wraps ``synapseml_tpu.services.translate.BreakSentence``)."""
+
+    _target = 'synapseml_tpu.services.translate.BreakSentence'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class DictionaryExamples(WrapperBase):
+    """Usage examples for a (text, translation) pair (reference (wraps ``synapseml_tpu.services.translate.DictionaryExamples``)."""
+
+    _target = 'synapseml_tpu.services.translate.DictionaryExamples'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setFromLanguage(self, value):
+        return self._set('from_language', value)
+
+    def getFromLanguage(self):
+        return self._get('from_language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setToLanguage(self, value):
+        return self._set('to_language', value)
+
+    def getToLanguage(self):
+        return self._get('to_language')
+
+    def setTranslationCol(self, value):
+        return self._set('translation_col', value)
+
+    def getTranslationCol(self):
+        return self._get('translation_col')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class DictionaryLookup(WrapperBase):
+    """Alternative translations for a word/phrase (reference (wraps ``synapseml_tpu.services.translate.DictionaryLookup``)."""
+
+    _target = 'synapseml_tpu.services.translate.DictionaryLookup'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setFromLanguage(self, value):
+        return self._set('from_language', value)
+
+    def getFromLanguage(self):
+        return self._get('from_language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setToLanguage(self, value):
+        return self._set('to_language', value)
+
+    def getToLanguage(self):
+        return self._get('to_language')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class Translate(WrapperBase):
+    """Subclasses define ``build_request(row_params) -> HTTPRequest`` and (wraps ``synapseml_tpu.services.translate.Translate``)."""
+
+    _target = 'synapseml_tpu.services.translate.Translate'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setFromLanguage(self, value):
+        return self._set('from_language', value)
+
+    def getFromLanguage(self):
+        return self._get('from_language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setToLanguage(self, value):
+        return self._set('to_language', value)
+
+    def getToLanguage(self):
+        return self._get('to_language')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class Transliterate(WrapperBase):
+    """Convert text between scripts (reference ``Transliterate``): (wraps ``synapseml_tpu.services.translate.Transliterate``)."""
+
+    _target = 'synapseml_tpu.services.translate.Transliterate'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setFromScript(self, value):
+        return self._set('from_script', value)
+
+    def getFromScript(self):
+        return self._get('from_script')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setToScript(self, value):
+        return self._set('to_script', value)
+
+    def getToScript(self):
+        return self._get('to_script')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class AnalyzeImage(WrapperBase):
+    """(ref ``AnalyzeImage``) (wraps ``synapseml_tpu.services.vision.AnalyzeImage``)."""
+
+    _target = 'synapseml_tpu.services.vision.AnalyzeImage'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setDetails(self, value):
+        return self._set('details', value)
+
+    def getDetails(self):
+        return self._get('details')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+    def setVisualFeatures(self, value):
+        return self._set('visual_features', value)
+
+    def getVisualFeatures(self):
+        return self._get('visual_features')
+
+
+class DescribeImage(WrapperBase):
+    """Shared image-url-or-bytes input handling (ref ``HasImageInput``). (wraps ``synapseml_tpu.services.vision.DescribeImage``)."""
+
+    _target = 'synapseml_tpu.services.vision.DescribeImage'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setMaxCandidates(self, value):
+        return self._set('max_candidates', value)
+
+    def getMaxCandidates(self):
+        return self._get('max_candidates')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class GenerateThumbnails(WrapperBase):
+    """Shared image-url-or-bytes input handling (ref ``HasImageInput``). (wraps ``synapseml_tpu.services.vision.GenerateThumbnails``)."""
+
+    _target = 'synapseml_tpu.services.vision.GenerateThumbnails'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setHeight(self, value):
+        return self._set('height', value)
+
+    def getHeight(self):
+        return self._get('height')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSmartCropping(self, value):
+        return self._set('smart_cropping', value)
+
+    def getSmartCropping(self):
+        return self._get('smart_cropping')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+    def setWidth(self, value):
+        return self._set('width', value)
+
+    def getWidth(self):
+        return self._get('width')
+
+
+class OCR(WrapperBase):
+    """(ref ``OCR``) — synchronous printed-text recognition. (wraps ``synapseml_tpu.services.vision.OCR``)."""
+
+    _target = 'synapseml_tpu.services.vision.OCR'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setDetectOrientation(self, value):
+        return self._set('detect_orientation', value)
+
+    def getDetectOrientation(self):
+        return self._get('detect_orientation')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class ReadImage(WrapperBase):
+    """(ref ``ReadImage``) — the async Read API: 202 + Operation-Location. (wraps ``synapseml_tpu.services.vision.ReadImage``)."""
+
+    _target = 'synapseml_tpu.services.vision.ReadImage'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class RecognizeDomainSpecificContent(WrapperBase):
+    """Shared image-url-or-bytes input handling (ref ``HasImageInput``). (wraps ``synapseml_tpu.services.vision.RecognizeDomainSpecificContent``)."""
+
+    _target = 'synapseml_tpu.services.vision.RecognizeDomainSpecificContent'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
+class TagImage(WrapperBase):
+    """Shared image-url-or-bytes input handling (ref ``HasImageInput``). (wraps ``synapseml_tpu.services.vision.TagImage``)."""
+
+    _target = 'synapseml_tpu.services.vision.TagImage'
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setImageBytesCol(self, value):
+        return self._set('image_bytes_col', value)
+
+    def getImageBytesCol(self):
+        return self._get('image_bytes_col')
+
+    def setImageUrlCol(self, value):
+        return self._set('image_url_col', value)
+
+    def getImageUrlCol(self):
+        return self._get('image_url_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+

@@ -1,0 +1,483 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class Cacher(WrapperBase):
+    """(ref ``stages/Cacher.scala``) — the eager data plane is always (wraps ``synapseml_tpu.stages.basic.Cacher``)."""
+
+    _target = 'synapseml_tpu.stages.basic.Cacher'
+
+    def setDisable(self, value):
+        return self._set('disable', value)
+
+    def getDisable(self):
+        return self._get('disable')
+
+
+class ClassBalancer(WrapperBase):
+    """Weight column = max_class_count / class_count (wraps ``synapseml_tpu.stages.basic.ClassBalancer``)."""
+
+    _target = 'synapseml_tpu.stages.basic.ClassBalancer'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class ClassBalancerModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.stages.basic.ClassBalancerModel``)."""
+
+    _target = 'synapseml_tpu.stages.basic.ClassBalancerModel'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setWeights(self, value):
+        return self._set('weights', value)
+
+    def getWeights(self):
+        return self._get('weights')
+
+
+class DropColumns(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.stages.basic.DropColumns``)."""
+
+    _target = 'synapseml_tpu.stages.basic.DropColumns'
+
+    def setCols(self, value):
+        return self._set('cols', value)
+
+    def getCols(self):
+        return self._get('cols')
+
+
+class EnsembleByKey(WrapperBase):
+    """Group rows by key column(s) and aggregate value column(s) (wraps ``synapseml_tpu.stages.basic.EnsembleByKey``)."""
+
+    _target = 'synapseml_tpu.stages.basic.EnsembleByKey'
+
+    def setColNames(self, value):
+        return self._set('col_names', value)
+
+    def getColNames(self):
+        return self._get('col_names')
+
+    def setCollapseGroup(self, value):
+        return self._set('collapse_group', value)
+
+    def getCollapseGroup(self):
+        return self._get('collapse_group')
+
+    def setCols(self, value):
+        return self._set('cols', value)
+
+    def getCols(self):
+        return self._get('cols')
+
+    def setKeys(self, value):
+        return self._set('keys', value)
+
+    def getKeys(self):
+        return self._get('keys')
+
+    def setStrategy(self, value):
+        return self._set('strategy', value)
+
+    def getStrategy(self):
+        return self._get('strategy')
+
+
+class Explode(WrapperBase):
+    """Explode an array column into rows (ref ``stages/Explode.scala``). (wraps ``synapseml_tpu.stages.basic.Explode``)."""
+
+    _target = 'synapseml_tpu.stages.basic.Explode'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class Lambda(WrapperBase):
+    """Arbitrary DataFrame->DataFrame function as a stage (wraps ``synapseml_tpu.stages.basic.Lambda``)."""
+
+    _target = 'synapseml_tpu.stages.basic.Lambda'
+
+    def setTransformFn(self, value):
+        return self._set('transform_fn', value)
+
+    def getTransformFn(self):
+        return self._get('transform_fn')
+
+    def setTransformSchemaFn(self, value):
+        return self._set('transform_schema_fn', value)
+
+    def getTransformSchemaFn(self):
+        return self._get('transform_schema_fn')
+
+
+class MultiColumnAdapter(WrapperBase):
+    """Apply a 1-col stage independently to many columns (wraps ``synapseml_tpu.stages.basic.MultiColumnAdapter``)."""
+
+    _target = 'synapseml_tpu.stages.basic.MultiColumnAdapter'
+
+    def setBaseStage(self, value):
+        return self._set('base_stage', value)
+
+    def getBaseStage(self):
+        return self._get('base_stage')
+
+    def setInputCols(self, value):
+        return self._set('input_cols', value)
+
+    def getInputCols(self):
+        return self._get('input_cols')
+
+    def setOutputCols(self, value):
+        return self._set('output_cols', value)
+
+    def getOutputCols(self):
+        return self._get('output_cols')
+
+
+class PartitionConsolidator(WrapperBase):
+    """Funnel data to one partition per host (ref (wraps ``synapseml_tpu.stages.basic.PartitionConsolidator``)."""
+
+    _target = 'synapseml_tpu.stages.basic.PartitionConsolidator'
+
+    def setNumHosts(self, value):
+        return self._set('num_hosts', value)
+
+    def getNumHosts(self):
+        return self._get('num_hosts')
+
+
+class RenameColumn(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.stages.basic.RenameColumn``)."""
+
+    _target = 'synapseml_tpu.stages.basic.RenameColumn'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class Repartition(WrapperBase):
+    """(ref ``stages/Repartition.scala``) — partitions map 1:1 to host feeding (wraps ``synapseml_tpu.stages.basic.Repartition``)."""
+
+    _target = 'synapseml_tpu.stages.basic.Repartition'
+
+    def setDisable(self, value):
+        return self._set('disable', value)
+
+    def getDisable(self):
+        return self._get('disable')
+
+    def setN(self, value):
+        return self._set('n', value)
+
+    def getN(self):
+        return self._get('n')
+
+
+class SelectColumns(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.stages.basic.SelectColumns``)."""
+
+    _target = 'synapseml_tpu.stages.basic.SelectColumns'
+
+    def setCols(self, value):
+        return self._set('cols', value)
+
+    def getCols(self):
+        return self._get('cols')
+
+
+class StratifiedRepartition(WrapperBase):
+    """Repartition so every partition sees every label value (wraps ``synapseml_tpu.stages.basic.StratifiedRepartition``)."""
+
+    _target = 'synapseml_tpu.stages.basic.StratifiedRepartition'
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setMode(self, value):
+        return self._set('mode', value)
+
+    def getMode(self):
+        return self._get('mode')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+
+class Timer(WrapperBase):
+    """Time a wrapped stage's fit/transform (ref ``stages/Timer.scala:56``). (wraps ``synapseml_tpu.stages.basic.Timer``)."""
+
+    _target = 'synapseml_tpu.stages.basic.Timer'
+
+    def setLogToScala(self, value):
+        return self._set('log_to_scala', value)
+
+    def getLogToScala(self):
+        return self._get('log_to_scala')
+
+    def setStage(self, value):
+        return self._set('stage', value)
+
+    def getStage(self):
+        return self._get('stage')
+
+
+class TimerModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.stages.basic.TimerModel``)."""
+
+    _target = 'synapseml_tpu.stages.basic.TimerModel'
+
+    def setLogToScala(self, value):
+        return self._set('log_to_scala', value)
+
+    def getLogToScala(self):
+        return self._get('log_to_scala')
+
+    def setStage(self, value):
+        return self._set('stage', value)
+
+    def getStage(self):
+        return self._get('stage')
+
+
+class UDFTransformer(WrapperBase):
+    """Apply a user function to input column(s) producing an output column (wraps ``synapseml_tpu.stages.basic.UDFTransformer``)."""
+
+    _target = 'synapseml_tpu.stages.basic.UDFTransformer'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setInputCols(self, value):
+        return self._set('input_cols', value)
+
+    def getInputCols(self):
+        return self._get('input_cols')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setUdf(self, value):
+        return self._set('udf', value)
+
+    def getUdf(self):
+        return self._get('udf')
+
+    def setVectorized(self, value):
+        return self._set('vectorized', value)
+
+    def getVectorized(self):
+        return self._get('vectorized')
+
+
+class DynamicMiniBatchTransformer(WrapperBase):
+    """Batch whatever is available, capped (ref ``MiniBatchTransformer.scala:55``). (wraps ``synapseml_tpu.stages.minibatch.DynamicMiniBatchTransformer``)."""
+
+    _target = 'synapseml_tpu.stages.minibatch.DynamicMiniBatchTransformer'
+
+    def setMaxBatchSize(self, value):
+        return self._set('max_batch_size', value)
+
+    def getMaxBatchSize(self):
+        return self._get('max_batch_size')
+
+
+class FixedMiniBatchTransformer(WrapperBase):
+    """Group rows into fixed-size batches (ref ``MiniBatchTransformer.scala:153``). (wraps ``synapseml_tpu.stages.minibatch.FixedMiniBatchTransformer``)."""
+
+    _target = 'synapseml_tpu.stages.minibatch.FixedMiniBatchTransformer'
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setBuffered(self, value):
+        return self._set('buffered', value)
+
+    def getBuffered(self):
+        return self._get('buffered')
+
+    def setMaxBufferSize(self, value):
+        return self._set('max_buffer_size', value)
+
+    def getMaxBufferSize(self):
+        return self._get('max_buffer_size')
+
+
+class FlattenBatch(WrapperBase):
+    """Explode batched array-columns back into per-element rows (wraps ``synapseml_tpu.stages.minibatch.FlattenBatch``)."""
+
+    _target = 'synapseml_tpu.stages.minibatch.FlattenBatch'
+
+
+class TimeIntervalMiniBatchTransformer(WrapperBase):
+    """Batch by wall-clock interval (ref ``MiniBatchTransformer.scala:79``). (wraps ``synapseml_tpu.stages.minibatch.TimeIntervalMiniBatchTransformer``)."""
+
+    _target = 'synapseml_tpu.stages.minibatch.TimeIntervalMiniBatchTransformer'
+
+    def setMaxBatchSize(self, value):
+        return self._set('max_batch_size', value)
+
+    def getMaxBatchSize(self):
+        return self._get('max_batch_size')
+
+    def setMillisToWait(self, value):
+        return self._set('millis_to_wait', value)
+
+    def getMillisToWait(self):
+        return self._get('millis_to_wait')
+
+
+class SummarizeData(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.stages.summarize.SummarizeData``)."""
+
+    _target = 'synapseml_tpu.stages.summarize.SummarizeData'
+
+    def setBasic(self, value):
+        return self._set('basic', value)
+
+    def getBasic(self):
+        return self._get('basic')
+
+    def setCounts(self, value):
+        return self._set('counts', value)
+
+    def getCounts(self):
+        return self._get('counts')
+
+    def setErrorThreshold(self, value):
+        return self._set('error_threshold', value)
+
+    def getErrorThreshold(self):
+        return self._get('error_threshold')
+
+    def setPercentiles(self, value):
+        return self._set('percentiles', value)
+
+    def getPercentiles(self):
+        return self._get('percentiles')
+
+    def setSample(self, value):
+        return self._set('sample', value)
+
+    def getSample(self):
+        return self._get('sample')
+
+
+class TextPreprocessor(WrapperBase):
+    """Longest-match substring replacement over a map (the reference builds a (wraps ``synapseml_tpu.stages.text.TextPreprocessor``)."""
+
+    _target = 'synapseml_tpu.stages.text.TextPreprocessor'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setMap(self, value):
+        return self._set('map', value)
+
+    def getMap(self):
+        return self._get('map')
+
+    def setNormalizeCase(self, value):
+        return self._set('normalize_case', value)
+
+    def getNormalizeCase(self):
+        return self._get('normalize_case')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
+class UnicodeNormalize(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.stages.text.UnicodeNormalize``)."""
+
+    _target = 'synapseml_tpu.stages.text.UnicodeNormalize'
+
+    def setForm(self, value):
+        return self._set('form', value)
+
+    def getForm(self):
+        return self._get('form')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setLower(self, value):
+        return self._set('lower', value)
+
+    def getLower(self):
+        return self._get('lower')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+

@@ -1,0 +1,195 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class ComputeModelStatistics(WrapperBase):
+    """(ref ``ComputeModelStatistics.scala:58``) — returns a one-row metrics (wraps ``synapseml_tpu.train.statistics.ComputeModelStatistics``)."""
+
+    _target = 'synapseml_tpu.train.statistics.ComputeModelStatistics'
+
+    def setEvaluationMetric(self, value):
+        return self._set('evaluation_metric', value)
+
+    def getEvaluationMetric(self):
+        return self._get('evaluation_metric')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setScoredProbabilitiesCol(self, value):
+        return self._set('scored_probabilities_col', value)
+
+    def getScoredProbabilitiesCol(self):
+        return self._get('scored_probabilities_col')
+
+    def setScoresCol(self, value):
+        return self._set('scores_col', value)
+
+    def getScoresCol(self):
+        return self._get('scores_col')
+
+
+class ComputePerInstanceStatistics(WrapperBase):
+    """Per-row loss/correctness (ref ``ComputePerInstanceStatistics.scala``). (wraps ``synapseml_tpu.train.statistics.ComputePerInstanceStatistics``)."""
+
+    _target = 'synapseml_tpu.train.statistics.ComputePerInstanceStatistics'
+
+    def setEvaluationMetric(self, value):
+        return self._set('evaluation_metric', value)
+
+    def getEvaluationMetric(self):
+        return self._get('evaluation_metric')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setScoredProbabilitiesCol(self, value):
+        return self._set('scored_probabilities_col', value)
+
+    def getScoredProbabilitiesCol(self):
+        return self._get('scored_probabilities_col')
+
+    def setScoresCol(self, value):
+        return self._set('scores_col', value)
+
+    def getScoresCol(self):
+        return self._get('scores_col')
+
+
+class TrainClassifier(WrapperBase):
+    """(ref ``TrainClassifier.scala:52``) (wraps ``synapseml_tpu.train.train.TrainClassifier``)."""
+
+    _target = 'synapseml_tpu.train.train.TrainClassifier'
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setNumFeatures(self, value):
+        return self._set('num_features', value)
+
+    def getNumFeatures(self):
+        return self._get('num_features')
+
+
+class TrainRegressor(WrapperBase):
+    """(ref ``train/TrainRegressor.scala``) (wraps ``synapseml_tpu.train.train.TrainRegressor``)."""
+
+    _target = 'synapseml_tpu.train.train.TrainRegressor'
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setModel(self, value):
+        return self._set('model', value)
+
+    def getModel(self):
+        return self._get('model')
+
+    def setNumFeatures(self, value):
+        return self._set('num_features', value)
+
+    def getNumFeatures(self):
+        return self._get('num_features')
+
+
+class TrainedClassifierModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.train.train.TrainedClassifierModel``)."""
+
+    _target = 'synapseml_tpu.train.train.TrainedClassifierModel'
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setFeaturizer(self, value):
+        return self._set('featurizer', value)
+
+    def getFeaturizer(self):
+        return self._get('featurizer')
+
+    def setInnerModel(self, value):
+        return self._set('inner_model', value)
+
+    def getInnerModel(self):
+        return self._get('inner_model')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLabelIndexer(self, value):
+        return self._set('label_indexer', value)
+
+    def getLabelIndexer(self):
+        return self._get('label_indexer')
+
+
+class TrainedRegressorModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.train.train.TrainedRegressorModel``)."""
+
+    _target = 'synapseml_tpu.train.train.TrainedRegressorModel'
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setFeaturizer(self, value):
+        return self._set('featurizer', value)
+
+    def getFeaturizer(self):
+        return self._get('featurizer')
+
+    def setInnerModel(self, value):
+        return self._set('inner_model', value)
+
+    def getInnerModel(self):
+        return self._get('inner_model')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+

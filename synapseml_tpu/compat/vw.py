@@ -1,0 +1,1011 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class VowpalWabbitContextualBandit(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.vw.contextual_bandit.VowpalWabbitContextualBandit``)."""
+
+    _target = 'synapseml_tpu.vw.contextual_bandit.VowpalWabbitContextualBandit'
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setChosenActionCol(self, value):
+        return self._set('chosen_action_col', value)
+
+    def getChosenActionCol(self):
+        return self._get('chosen_action_col')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setInteractions(self, value):
+        return self._set('interactions', value)
+
+    def getInteractions(self):
+        return self._get('interactions')
+
+    def setL1(self, value):
+        return self._set('l1', value)
+
+    def getL1(self):
+        return self._get('l1')
+
+    def setL2(self, value):
+        return self._set('l2', value)
+
+    def getL2(self):
+        return self._get('l2')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setNumBits(self, value):
+        return self._set('num_bits', value)
+
+    def getNumBits(self):
+        return self._get('num_bits')
+
+    def setNumPasses(self, value):
+        return self._set('num_passes', value)
+
+    def getNumPasses(self):
+        return self._get('num_passes')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setProbabilityCol(self, value):
+        return self._set('probability_col', value)
+
+    def getProbabilityCol(self):
+        return self._get('probability_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setSharedCol(self, value):
+        return self._set('shared_col', value)
+
+    def getSharedCol(self):
+        return self._get('shared_col')
+
+
+class VowpalWabbitContextualBanditModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.vw.contextual_bandit.VowpalWabbitContextualBanditModel``)."""
+
+    _target = 'synapseml_tpu.vw.contextual_bandit.VowpalWabbitContextualBanditModel'
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setChosenActionCol(self, value):
+        return self._set('chosen_action_col', value)
+
+    def getChosenActionCol(self):
+        return self._get('chosen_action_col')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setInteractions(self, value):
+        return self._set('interactions', value)
+
+    def getInteractions(self):
+        return self._get('interactions')
+
+    def setL1(self, value):
+        return self._set('l1', value)
+
+    def getL1(self):
+        return self._get('l1')
+
+    def setL2(self, value):
+        return self._set('l2', value)
+
+    def getL2(self):
+        return self._get('l2')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setModelWeights(self, value):
+        return self._set('model_weights', value)
+
+    def getModelWeights(self):
+        return self._get('model_weights')
+
+    def setNumBits(self, value):
+        return self._set('num_bits', value)
+
+    def getNumBits(self):
+        return self._get('num_bits')
+
+    def setNumPasses(self, value):
+        return self._set('num_passes', value)
+
+    def getNumPasses(self):
+        return self._get('num_passes')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setProbabilityCol(self, value):
+        return self._set('probability_col', value)
+
+    def getProbabilityCol(self):
+        return self._get('probability_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setSharedCol(self, value):
+        return self._set('shared_col', value)
+
+    def getSharedCol(self):
+        return self._get('shared_col')
+
+
+class VowpalWabbitDSJsonTransformer(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.vw.dsjson.VowpalWabbitDSJsonTransformer``)."""
+
+    _target = 'synapseml_tpu.vw.dsjson.VowpalWabbitDSJsonTransformer'
+
+    def setDsjsonCol(self, value):
+        return self._set('dsjson_col', value)
+
+    def getDsjsonCol(self):
+        return self._get('dsjson_col')
+
+
+class VowpalWabbitClassificationModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.vw.estimators.VowpalWabbitClassificationModel``)."""
+
+    _target = 'synapseml_tpu.vw.estimators.VowpalWabbitClassificationModel'
+
+    def setAdaptive(self, value):
+        return self._set('adaptive', value)
+
+    def getAdaptive(self):
+        return self._get('adaptive')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setClasses(self, value):
+        return self._set('classes', value)
+
+    def getClasses(self):
+        return self._get('classes')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setInitialModel(self, value):
+        return self._set('initial_model', value)
+
+    def getInitialModel(self):
+        return self._get('initial_model')
+
+    def setL1(self, value):
+        return self._set('l1', value)
+
+    def getL1(self):
+        return self._get('l1')
+
+    def setL2(self, value):
+        return self._set('l2', value)
+
+    def getL2(self):
+        return self._get('l2')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setModelWeights(self, value):
+        return self._set('model_weights', value)
+
+    def getModelWeights(self):
+        return self._get('model_weights')
+
+    def setNumBits(self, value):
+        return self._set('num_bits', value)
+
+    def getNumBits(self):
+        return self._get('num_bits')
+
+    def setNumPasses(self, value):
+        return self._set('num_passes', value)
+
+    def getNumPasses(self):
+        return self._get('num_passes')
+
+    def setPowerT(self, value):
+        return self._set('power_t', value)
+
+    def getPowerT(self):
+        return self._get('power_t')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setProbabilityCol(self, value):
+        return self._set('probability_col', value)
+
+    def getProbabilityCol(self):
+        return self._get('probability_col')
+
+    def setRawPredictionCol(self, value):
+        return self._set('raw_prediction_col', value)
+
+    def getRawPredictionCol(self):
+        return self._get('raw_prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class VowpalWabbitClassifier(WrapperBase):
+    """Binary classifier, logistic loss by default (reference (wraps ``synapseml_tpu.vw.estimators.VowpalWabbitClassifier``)."""
+
+    _target = 'synapseml_tpu.vw.estimators.VowpalWabbitClassifier'
+
+    def setAdaptive(self, value):
+        return self._set('adaptive', value)
+
+    def getAdaptive(self):
+        return self._get('adaptive')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setInitialModel(self, value):
+        return self._set('initial_model', value)
+
+    def getInitialModel(self):
+        return self._get('initial_model')
+
+    def setL1(self, value):
+        return self._set('l1', value)
+
+    def getL1(self):
+        return self._get('l1')
+
+    def setL2(self, value):
+        return self._set('l2', value)
+
+    def getL2(self):
+        return self._get('l2')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setLossFunction(self, value):
+        return self._set('loss_function', value)
+
+    def getLossFunction(self):
+        return self._get('loss_function')
+
+    def setNumBits(self, value):
+        return self._set('num_bits', value)
+
+    def getNumBits(self):
+        return self._get('num_bits')
+
+    def setNumPasses(self, value):
+        return self._set('num_passes', value)
+
+    def getNumPasses(self):
+        return self._get('num_passes')
+
+    def setPowerT(self, value):
+        return self._set('power_t', value)
+
+    def getPowerT(self):
+        return self._get('power_t')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setProbabilityCol(self, value):
+        return self._set('probability_col', value)
+
+    def getProbabilityCol(self):
+        return self._get('probability_col')
+
+    def setRawPredictionCol(self, value):
+        return self._set('raw_prediction_col', value)
+
+    def getRawPredictionCol(self):
+        return self._get('raw_prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class VowpalWabbitGeneric(WrapperBase):
+    """Raw VW-text-line input mode (reference ``VowpalWabbitGeneric``). (wraps ``synapseml_tpu.vw.estimators.VowpalWabbitGeneric``)."""
+
+    _target = 'synapseml_tpu.vw.estimators.VowpalWabbitGeneric'
+
+    def setAdaptive(self, value):
+        return self._set('adaptive', value)
+
+    def getAdaptive(self):
+        return self._get('adaptive')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setInitialModel(self, value):
+        return self._set('initial_model', value)
+
+    def getInitialModel(self):
+        return self._get('initial_model')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setL1(self, value):
+        return self._set('l1', value)
+
+    def getL1(self):
+        return self._get('l1')
+
+    def setL2(self, value):
+        return self._set('l2', value)
+
+    def getL2(self):
+        return self._get('l2')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setLossFunction(self, value):
+        return self._set('loss_function', value)
+
+    def getLossFunction(self):
+        return self._get('loss_function')
+
+    def setNumBits(self, value):
+        return self._set('num_bits', value)
+
+    def getNumBits(self):
+        return self._get('num_bits')
+
+    def setNumPasses(self, value):
+        return self._set('num_passes', value)
+
+    def getNumPasses(self):
+        return self._get('num_passes')
+
+    def setPowerT(self, value):
+        return self._set('power_t', value)
+
+    def getPowerT(self):
+        return self._get('power_t')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class VowpalWabbitGenericModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.vw.estimators.VowpalWabbitGenericModel``)."""
+
+    _target = 'synapseml_tpu.vw.estimators.VowpalWabbitGenericModel'
+
+    def setAdaptive(self, value):
+        return self._set('adaptive', value)
+
+    def getAdaptive(self):
+        return self._get('adaptive')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setInitialModel(self, value):
+        return self._set('initial_model', value)
+
+    def getInitialModel(self):
+        return self._get('initial_model')
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setL1(self, value):
+        return self._set('l1', value)
+
+    def getL1(self):
+        return self._get('l1')
+
+    def setL2(self, value):
+        return self._set('l2', value)
+
+    def getL2(self):
+        return self._get('l2')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setLossFunction(self, value):
+        return self._set('loss_function', value)
+
+    def getLossFunction(self):
+        return self._get('loss_function')
+
+    def setModelWeights(self, value):
+        return self._set('model_weights', value)
+
+    def getModelWeights(self):
+        return self._get('model_weights')
+
+    def setNumBits(self, value):
+        return self._set('num_bits', value)
+
+    def getNumBits(self):
+        return self._get('num_bits')
+
+    def setNumPasses(self, value):
+        return self._set('num_passes', value)
+
+    def getNumPasses(self):
+        return self._get('num_passes')
+
+    def setPowerT(self, value):
+        return self._set('power_t', value)
+
+    def getPowerT(self):
+        return self._get('power_t')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class VowpalWabbitProgressive(WrapperBase):
+    """Progressive (streaming-eval) mode: fit() consumes rows IN ORDER, and (wraps ``synapseml_tpu.vw.estimators.VowpalWabbitProgressive``)."""
+
+    _target = 'synapseml_tpu.vw.estimators.VowpalWabbitProgressive'
+
+    def setAdaptive(self, value):
+        return self._set('adaptive', value)
+
+    def getAdaptive(self):
+        return self._get('adaptive')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setInitialModel(self, value):
+        return self._set('initial_model', value)
+
+    def getInitialModel(self):
+        return self._get('initial_model')
+
+    def setL1(self, value):
+        return self._set('l1', value)
+
+    def getL1(self):
+        return self._get('l1')
+
+    def setL2(self, value):
+        return self._set('l2', value)
+
+    def getL2(self):
+        return self._get('l2')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setLossFunction(self, value):
+        return self._set('loss_function', value)
+
+    def getLossFunction(self):
+        return self._get('loss_function')
+
+    def setNumBits(self, value):
+        return self._set('num_bits', value)
+
+    def getNumBits(self):
+        return self._get('num_bits')
+
+    def setNumPasses(self, value):
+        return self._set('num_passes', value)
+
+    def getNumPasses(self):
+        return self._get('num_passes')
+
+    def setPowerT(self, value):
+        return self._set('power_t', value)
+
+    def getPowerT(self):
+        return self._get('power_t')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setProgressiveCol(self, value):
+        return self._set('progressive_col', value)
+
+    def getProgressiveCol(self):
+        return self._get('progressive_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class VowpalWabbitRegressionModel(WrapperBase):
+    """A fitted Transformer (SparkML Model[M]). (wraps ``synapseml_tpu.vw.estimators.VowpalWabbitRegressionModel``)."""
+
+    _target = 'synapseml_tpu.vw.estimators.VowpalWabbitRegressionModel'
+
+    def setAdaptive(self, value):
+        return self._set('adaptive', value)
+
+    def getAdaptive(self):
+        return self._get('adaptive')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setInitialModel(self, value):
+        return self._set('initial_model', value)
+
+    def getInitialModel(self):
+        return self._get('initial_model')
+
+    def setL1(self, value):
+        return self._set('l1', value)
+
+    def getL1(self):
+        return self._get('l1')
+
+    def setL2(self, value):
+        return self._set('l2', value)
+
+    def getL2(self):
+        return self._get('l2')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setModelWeights(self, value):
+        return self._set('model_weights', value)
+
+    def getModelWeights(self):
+        return self._get('model_weights')
+
+    def setNumBits(self, value):
+        return self._set('num_bits', value)
+
+    def getNumBits(self):
+        return self._get('num_bits')
+
+    def setNumPasses(self, value):
+        return self._set('num_passes', value)
+
+    def getNumPasses(self):
+        return self._get('num_passes')
+
+    def setPowerT(self, value):
+        return self._set('power_t', value)
+
+    def getPowerT(self):
+        return self._get('power_t')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class VowpalWabbitRegressor(WrapperBase):
+    """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.vw.estimators.VowpalWabbitRegressor``)."""
+
+    _target = 'synapseml_tpu.vw.estimators.VowpalWabbitRegressor'
+
+    def setAdaptive(self, value):
+        return self._set('adaptive', value)
+
+    def getAdaptive(self):
+        return self._get('adaptive')
+
+    def setBatchSize(self, value):
+        return self._set('batch_size', value)
+
+    def getBatchSize(self):
+        return self._get('batch_size')
+
+    def setFeaturesCol(self, value):
+        return self._set('features_col', value)
+
+    def getFeaturesCol(self):
+        return self._get('features_col')
+
+    def setInitialModel(self, value):
+        return self._set('initial_model', value)
+
+    def getInitialModel(self):
+        return self._get('initial_model')
+
+    def setL1(self, value):
+        return self._set('l1', value)
+
+    def getL1(self):
+        return self._get('l1')
+
+    def setL2(self, value):
+        return self._set('l2', value)
+
+    def getL2(self):
+        return self._get('l2')
+
+    def setLabelCol(self, value):
+        return self._set('label_col', value)
+
+    def getLabelCol(self):
+        return self._get('label_col')
+
+    def setLearningRate(self, value):
+        return self._set('learning_rate', value)
+
+    def getLearningRate(self):
+        return self._get('learning_rate')
+
+    def setLossFunction(self, value):
+        return self._set('loss_function', value)
+
+    def getLossFunction(self):
+        return self._get('loss_function')
+
+    def setNumBits(self, value):
+        return self._set('num_bits', value)
+
+    def getNumBits(self):
+        return self._get('num_bits')
+
+    def setNumPasses(self, value):
+        return self._set('num_passes', value)
+
+    def getNumPasses(self):
+        return self._get('num_passes')
+
+    def setPowerT(self, value):
+        return self._set('power_t', value)
+
+    def getPowerT(self):
+        return self._get('power_t')
+
+    def setPredictionCol(self, value):
+        return self._set('prediction_col', value)
+
+    def getPredictionCol(self):
+        return self._get('prediction_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setWeightCol(self, value):
+        return self._set('weight_col', value)
+
+    def getWeightCol(self):
+        return self._get('weight_col')
+
+
+class VowpalWabbitFeaturizer(WrapperBase):
+    """Hash input columns into one padded-sparse feature column. (wraps ``synapseml_tpu.vw.featurizer.VowpalWabbitFeaturizer``)."""
+
+    _target = 'synapseml_tpu.vw.featurizer.VowpalWabbitFeaturizer'
+
+    def setInputCols(self, value):
+        return self._set('input_cols', value)
+
+    def getInputCols(self):
+        return self._get('input_cols')
+
+    def setMaxNnz(self, value):
+        return self._set('max_nnz', value)
+
+    def getMaxNnz(self):
+        return self._get('max_nnz')
+
+    def setNumBits(self, value):
+        return self._set('num_bits', value)
+
+    def getNumBits(self):
+        return self._get('num_bits')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setStringSplitCols(self, value):
+        return self._set('string_split_cols', value)
+
+    def getStringSplitCols(self):
+        return self._get('string_split_cols')
+
+    def setSumCollisions(self, value):
+        return self._set('sum_collisions', value)
+
+    def getSumCollisions(self):
+        return self._get('sum_collisions')
+
+
+class VowpalWabbitCSETransformer(WrapperBase):
+    """Counterfactual selection evaluation: aggregates logged bandit rows into (wraps ``synapseml_tpu.vw.policyeval.VowpalWabbitCSETransformer``)."""
+
+    _target = 'synapseml_tpu.vw.policyeval.VowpalWabbitCSETransformer'
+
+    def setLoggedProbabilityCol(self, value):
+        return self._set('logged_probability_col', value)
+
+    def getLoggedProbabilityCol(self):
+        return self._get('logged_probability_col')
+
+    def setMaxImportanceWeight(self, value):
+        return self._set('max_importance_weight', value)
+
+    def getMaxImportanceWeight(self):
+        return self._get('max_importance_weight')
+
+    def setMinImportanceWeight(self, value):
+        return self._set('min_importance_weight', value)
+
+    def getMinImportanceWeight(self):
+        return self._get('min_importance_weight')
+
+    def setRewardCol(self, value):
+        return self._set('reward_col', value)
+
+    def getRewardCol(self):
+        return self._get('reward_col')
+
+    def setTargetProbabilityCol(self, value):
+        return self._set('target_probability_col', value)
+
+    def getTargetProbabilityCol(self):
+        return self._get('target_probability_col')
+
