@@ -7,17 +7,18 @@ import os
 
 from . import emit_wrappers, write_docs
 
-parser = argparse.ArgumentParser(prog="python -m synapseml_tpu.codegen",
-                                 description=__doc__)
-parser.add_argument("--docs-dir", default=os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "docs", "api"), help="API-docs output directory (default: docs/api)")
-parser.add_argument("--compat-dir", default=None,
-                    help="wrapper output directory (default: the in-tree "
-                         "synapseml_tpu/compat package)")
-args = parser.parse_args()
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(prog="python -m synapseml_tpu.codegen",
+                                     description=__doc__)
+    parser.add_argument("--docs-dir", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "docs", "api"), help="API-docs output directory (default: docs/api)")
+    parser.add_argument("--compat-dir", default=None,
+                        help="wrapper output directory (default: the in-tree "
+                             "synapseml_tpu/compat package)")
+    args = parser.parse_args()
 
-for p in emit_wrappers(args.compat_dir):
-    print(p)
-for p in write_docs(args.docs_dir):
-    print(p)
+    for p in emit_wrappers(args.compat_dir):
+        print(p)
+    for p in write_docs(args.docs_dir):
+        print(p)

@@ -284,18 +284,127 @@ class Trainer:
         return 6 * n_params  # fwd + bwd matmul FLOPs per token estimate
 
     def fit(self, state: TrainState, batch_iter: Iterator[dict], max_steps: int,
-            log_every: int = 50, callback: Callable[[int, dict], None] | None = None
-            ) -> TrainState:
+            log_every: int = 50, callback: Callable[[int, dict], None] | None = None,
+            scan_chunk: int = 8) -> TrainState:
+        """Streaming fit over ANY batch iterator.
+
+        Default path: ``scan_chunk`` same-shape batches are stacked into ONE
+        ``lax.scan`` dispatch while a background thread prefetches the next
+        chunk (double buffering) — the DataFrame/streaming plane gets the same
+        dispatch amortization as array training. Odd-shaped or leftover
+        batches run per-step automatically, so iterators with varying batch
+        shapes stay correct (each shape still compiles once). A per-step
+        ``callback`` (or ``scan_chunk<=1``) forces the per-step loop.
+        """
+        it = iter(batch_iter)
+        if callback is not None or scan_chunk <= 1 or max_steps <= 1:
+            meter = _ThroughputMeter(self, state.params)
+            for i in range(max_steps):
+                try:
+                    batch = next(it)  # never pull past max_steps batches
+                except StopIteration:
+                    break
+                state, metrics = self.train_step(state, batch)
+                meter.observe(batch, steps=1)
+                if callback is not None:
+                    callback(i, metrics)
+                if (i + 1) % log_every == 0:
+                    self._metrics.append(meter.entry(float(metrics["loss"])))
+            return state
+        return self._fit_chunked(state, it, max_steps, scan_chunk, log_every)
+
+    def _fit_chunked(self, state: TrainState, it: Iterator[dict],
+                     max_steps: int, scan_chunk: int,
+                     log_every: int = 50) -> TrainState:
+        import queue
+        import threading
+
+        END = object()
+        q: "queue.Queue" = queue.Queue(maxsize=2)  # double buffer
+        stop = threading.Event()  # consumer died: unblock the producer
+
+        def shape_key(b: dict):
+            # dtype via attribute lookup: np.asarray on a jax.Array would
+            # force a device-to-host copy per batch just to read the dtype
+            return tuple(sorted(
+                (k, np.shape(v), str(getattr(v, "dtype", None)
+                                     or np.asarray(v).dtype))
+                for k, v in b.items()))
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.5)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                pending: list[dict] = []
+                pkey = None
+                taken = 0
+
+                def flush() -> bool:
+                    nonlocal pending, pkey
+                    if not pending:
+                        return True
+                    if len(pending) == scan_chunk:
+                        item = ("chunk", {k: np.stack([b[k] for b in pending])
+                                          for k in pending[0]})
+                    else:  # short/odd tail: per-step, no extra scan compile
+                        item = ("steps", pending)
+                    pending, pkey = [], None
+                    return put(item)
+
+                while taken < max_steps:
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        break
+                    key = shape_key(b)
+                    if pending and key != pkey:
+                        if not flush():
+                            return
+                    pending.append(b)
+                    pkey = key
+                    taken += 1
+                    if len(pending) == scan_chunk:
+                        if not flush():
+                            return
+                if flush():
+                    put(END)
+            except BaseException as e:  # surface producer errors
+                put(e)
+
+        threading.Thread(target=producer, daemon=True).start()
         meter = _ThroughputMeter(self, state.params)
-        for i, batch in enumerate(batch_iter):
-            if i >= max_steps:
-                break
-            state, metrics = self.train_step(state, batch)
-            meter.observe(batch, steps=1)
-            if callback is not None:
-                callback(i, metrics)
-            if (i + 1) % log_every == 0:
-                self._metrics.append(meter.entry(float(metrics["loss"])))
+        steps_done = logged_at = 0
+        try:
+            while True:
+                item = q.get()
+                if item is END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                kind, payload = item
+                if kind == "chunk":
+                    state, metrics = self.train_steps_scan(state, payload)
+                    meter.observe(payload, steps=scan_chunk)
+                    steps_done += scan_chunk
+                    loss = float(np.asarray(metrics["loss"])[-1])
+                else:
+                    for b in payload:
+                        state, metrics = self.train_step(state, b)
+                        meter.observe(b, steps=1)
+                    steps_done += len(payload)
+                    loss = float(metrics["loss"])
+                if steps_done - logged_at >= log_every or steps_done >= max_steps:
+                    self._metrics.append(meter.entry(loss))
+                    logged_at = steps_done
+        finally:
+            stop.set()
         return state
 
     @property
@@ -368,9 +477,6 @@ def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: 
     the current one (double buffering) — host batch prep and device compute
     overlap instead of alternating. ``scan_chunk=1`` falls back to the
     per-step loop (needed for per-step callbacks)."""
-    import queue
-    import threading
-
     from ..parallel.batching import batches
 
     n = next(iter(data.values())).shape[0]
@@ -390,69 +496,14 @@ def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: 
     state = trainer.init_state(first, jax.random.PRNGKey(seed),
                                init_params=init_params,
                                init_batch_stats=init_batch_stats)
-    if scan_chunk <= 1 or total_steps <= 1:
-        def chain():
-            yield first
-            yield from it
 
-        return trainer.fit(state, chain(), max_steps=total_steps)
+    def chain():
+        yield first
+        yield from it
 
-    # ---- chunked + prefetched path ----
-    def stack_chunk(bs: list[dict]) -> dict:
-        return {k: np.stack([b[k] for b in bs]) for k in bs[0]}
-
-    chunks: "queue.Queue" = queue.Queue(maxsize=2)  # double buffer
-    # only FULL chunks go through the scan program (one compile); the
-    # remainder runs per-step to avoid recompiling the whole scan for a
-    # one-off short leading dimension
-    n_full = total_steps // scan_chunk
-    remainder = total_steps - n_full * scan_chunk
-    stop = threading.Event()  # consumer died: unblock the producer
-
-    def producer():
-        try:
-            pending = [first]
-            for _ in range(n_full):
-                while len(pending) < scan_chunk:
-                    pending.append(next(it))
-                item = stack_chunk(pending[:scan_chunk])
-                pending = pending[scan_chunk:]
-                while not stop.is_set():
-                    try:
-                        chunks.put(item, timeout=0.5)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
-                    return
-            tail = list(pending)
-            while len(tail) < remainder:
-                tail.append(next(it))
-            chunks.put(("tail", tail[:remainder]))
-        except BaseException as e:  # surface producer errors to the consumer
-            chunks.put(e)
-
-    threading.Thread(target=producer, daemon=True).start()
-
-    meter = _ThroughputMeter(trainer, state.params)
-    try:
-        for _ in range(n_full):
-            chunk = chunks.get()
-            if isinstance(chunk, BaseException):
-                raise chunk
-            state, metrics = trainer.train_steps_scan(state, chunk)
-            meter.observe(chunk, steps=scan_chunk)
-            trainer._metrics.append(
-                meter.entry(float(np.asarray(metrics["loss"])[-1])))
-        if remainder:
-            tail = chunks.get()
-            if isinstance(tail, BaseException):
-                raise tail
-            _, tail_batches = tail
-            for b in tail_batches:
-                state, metrics = trainer.train_step(state, b)
-                meter.observe(b, steps=1)
-            trainer._metrics.append(meter.entry(float(metrics["loss"])))
-    finally:
-        stop.set()
-    return state
+    # Trainer.fit carries the chunked + double-buffered scan loop for ANY
+    # iterator (same-shape batches stack into one lax.scan dispatch; the
+    # short tail runs per-step) — this wrapper only adds shuffling epochs,
+    # mesh-padded batches, and state init.
+    return trainer.fit(state, chain(), max_steps=total_steps,
+                       scan_chunk=scan_chunk)
