@@ -1,0 +1,70 @@
+# %% [markdown]
+# # Cognitive services as pipeline stages (offline demo)
+# Service transformers build authenticated requests per row, send them
+# through the shared async HTTP client, and parse replies into columns —
+# including long-running operations (202 + poll). This demo serves a tiny
+# in-process mock so it runs with zero network egress; point `url=` at the
+# real Azure endpoint in production.
+
+# %%
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Mock(BaseHTTPRequestHandler):
+    polls = {}
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, payload, status=200, headers=None):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        p = self.path.split("?")[0]
+        if p == "/language/analyze-text/jobs":  # LRO: accept, hand back a poll URL
+            return self._json({}, 202, {"Operation-Location":
+                f"http://{self.headers['Host']}/language/analyze-text/jobs/j1"})
+        if p == "/translate":
+            return self._json([{"translations": [{"text": "hola mundo"}]}])
+        return self._json({}, 404)
+
+    def do_GET(self):
+        n = Mock.polls.get("j1", 0)
+        Mock.polls["j1"] = n + 1
+        if n < 1:  # first poll: still running
+            return self._json({"status": "running"})
+        return self._json({"status": "succeeded", "tasks": {"items": [{
+            "results": {"documents": [{"id": "0",
+                                       "redactedText": "call me at ****"}]}}]}})
+
+
+srv = ThreadingHTTPServer(("127.0.0.1", 0), Mock)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+URL = f"http://127.0.0.1:{srv.server_address[1]}"
+
+# %% [markdown]
+# PII redaction is a long-running job: the transformer POSTs the document,
+# polls the operation, and lands the redacted text in a column.
+
+# %%
+import synapseml_tpu as st
+from synapseml_tpu.services import AnalyzeTextLRO, Translate
+
+df = st.DataFrame.from_dict({"text": ["call me at 555-0100"]})
+pii = AnalyzeTextLRO(url=URL, subscription_key="demo-key",
+                     kind="PiiEntityRecognition", polling_interval_s=0.01)
+out = pii.transform(df)
+print("redacted:", out.collect_column("analysis")[0]["redactedText"])
+
+# %%
+tr = Translate(url=URL, subscription_key="demo-key", to_language="es")
+print("translated:", tr.transform(df).collect_column("translation")[0])
+srv.shutdown()

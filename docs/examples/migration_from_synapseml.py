@@ -1,0 +1,46 @@
+# %% [markdown]
+# # Migrating from SynapseML: the generated compat namespace
+# Reference users write pyspark-style code — camelCase setters, chaining,
+# `fit`/`transform`. `synapseml_tpu.compat.<ns>` mirrors the reference's
+# `synapse.ml.<ns>` modules with GENERATED wrappers over the native stages
+# (`python -m synapseml_tpu.codegen` regenerates them; see
+# docs/api/CODEGEN.md). The same estimator, both styles:
+
+# %%
+import numpy as np
+
+import synapseml_tpu as st
+
+rs = np.random.default_rng(11)
+X = rs.normal(size=(200, 5))
+y = (X[:, 0] + X[:, 1] > 0).astype(int)
+df = st.DataFrame.from_rows([{"features": X[i], "label": int(y[i])}
+                             for i in range(200)])
+
+# reference style (synapse.ml.lightgbm.LightGBMClassifier):
+from synapseml_tpu.compat.lightgbm import LightGBMClassifier as RefStyle
+
+model_a = (RefStyle()
+           .setNumIterations(10)
+           .setLearningRate(0.3)
+           .setNumLeaves(15)
+           .fit(df))
+
+# native style:
+from synapseml_tpu.gbdt import LightGBMClassifier as NativeStyle
+
+model_b = NativeStyle(num_iterations=10, learning_rate=0.3,
+                      num_leaves=15).fit(df)
+
+pa = model_a.transform(df).collect_column("prediction")
+pb = model_b.transform(df).collect_column("prediction")
+np.testing.assert_array_equal(pa, pb)
+print("compat wrapper == native estimator:", True)
+
+# %% [markdown]
+# Wrapped models expose the same surface (`transform`, camelCase accessors)
+# and `unwrap()` returns the native stage for anything beyond it.
+
+# %%
+booster = model_a.unwrap().get_booster()
+print("feature importances:", booster.feature_importance("split"))

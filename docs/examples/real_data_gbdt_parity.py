@@ -1,0 +1,60 @@
+# %% [markdown]
+# # LightGBM on real data: held-out AUC + cross-engine parity + interop
+# Trains the histogram GBDT on real clinical data (sklearn's bundled
+# breast-cancer corpus), evaluates on a held-out split, compares against an
+# independent engine (sklearn HistGradientBoosting), and round-trips the
+# model through LightGBM's own `model.txt` text format (the reference's
+# `saveNativeModel`, `booster/LightGBMBooster.scala:458`).
+
+# %%
+import numpy as np
+from sklearn.datasets import load_breast_cancer
+
+from synapseml_tpu.gbdt import parse_lightgbm_string, to_lightgbm_string
+from synapseml_tpu.gbdt.booster import train_booster
+
+d = load_breast_cancer()
+X, y = d.data.astype(np.float32), d.target.astype(np.float32)
+rs = np.random.default_rng(7)
+idx = rs.permutation(len(y))
+k = int(len(y) * 0.75)
+Xtr, ytr, Xte, yte = X[idx[:k]], y[idx[:k]], X[idx[k:]], y[idx[k:]]
+
+booster = train_booster(Xtr, ytr, objective="binary", num_iterations=60,
+                        learning_rate=0.1, num_leaves=15, seed=0)
+
+from scipy.stats import rankdata
+
+
+def auc(scores, labels):
+    ranks = rankdata(scores)
+    pos = labels == 1
+    n1, n0 = pos.sum(), (~pos).sum()
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+ours = auc(booster.predict(Xte).ravel(), yte)
+print("held-out AUC:", round(ours, 4))
+assert ours > 0.96
+
+# %% [markdown]
+# Cross-engine parity: an independent histogram-GBDT implementation with the
+# same capacity reaches the same AUC on the same split.
+
+# %%
+from sklearn.ensemble import HistGradientBoostingClassifier
+
+h = HistGradientBoostingClassifier(max_iter=60, learning_rate=0.1,
+                                   max_leaf_nodes=15, random_state=0).fit(Xtr, ytr)
+theirs = auc(h.predict_proba(Xte)[:, 1], yte)
+print("sklearn HGB AUC:", round(theirs, 4))
+assert ours >= theirs - 0.02
+
+# %% [markdown]
+# Interop: export to LightGBM's text format, re-import, identical scores.
+
+# %%
+imported = parse_lightgbm_string(to_lightgbm_string(booster))
+np.testing.assert_allclose(imported.raw_score(Xte[:50]),
+                           booster.raw_score(Xte[:50]), rtol=1e-5, atol=1e-5)
+print("model.txt round-trip: scores identical")
