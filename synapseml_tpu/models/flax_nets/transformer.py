@@ -150,9 +150,10 @@ class Attention(nn.Module):
     a linen cache collection for autoregressive decode.
 
     The score/softmax/value core dispatches on ``cfg.attn_impl``:
-    'einsum' (XLA), 'flash' (Pallas blockwise kernel), or 'ring'
-    (sequence-parallel over ``cfg.seq_axis`` — the long-context path the
-    reference lacks, SURVEY.md §5)."""
+    'einsum' (XLA), 'flash' (Pallas blockwise kernel), 'ring' (K/V rotation
+    over ``cfg.seq_axis``), or 'ulysses' (all-to-all head/token swap over
+    ``cfg.seq_axis``) — the long-context paths the reference lacks,
+    SURVEY.md §5."""
 
     cfg: TransformerConfig
     decode: bool = False
@@ -174,10 +175,16 @@ class Attention(nn.Module):
         # to einsum here would silently reintroduce the O(T^2) score matrix.
         eligible = not self.decode and (mask is None or mask_is_kv_shaped)
 
-        if impl == "ring" and eligible:
+        if impl in ("ring", "ulysses") and eligible:
             mesh = _current_mesh()
             if mesh is not None and dict(zip(mesh.axis_names, mesh.axis_sizes)
                                          ).get(cfg.seq_axis, 1) > 1:
+                if impl == "ulysses":
+                    from ...ops import ulysses_attention_sharded
+
+                    return ulysses_attention_sharded(
+                        mesh, q, k, v, kv_mask=kv_mask, causal=cfg.causal,
+                        seq_axis=cfg.seq_axis)
                 from ...ops import ring_attention_sharded
 
                 return ring_attention_sharded(mesh, q, k, v, kv_mask=kv_mask,
@@ -186,7 +193,7 @@ class Attention(nn.Module):
             import warnings
 
             warnings.warn(
-                f"attn_impl='ring' requested but no mesh with a "
+                f"attn_impl={impl!r} requested but no mesh with a "
                 f"'{cfg.seq_axis}' axis (size>1) is in scope; using the local "
                 f"flash kernel instead", stacklevel=2)
             impl = "flash"

@@ -74,9 +74,11 @@ class DeepTextClassifier(Estimator, _TextParams):
                        converter=TypeConverters.to_int)
     seed = Param("seed", "init seed", default=0, converter=TypeConverters.to_int)
     attn_impl = Param("attn_impl", "attention backend: einsum | flash | ring "
-                      "(None = architecture default; 'ring' needs a mesh with "
-                      "a seq axis > 1)", default=None,
-                      validator=lambda v: v in (None, "einsum", "flash", "ring"))
+                      "| ulysses (None = architecture default; ring/ulysses "
+                      "need a mesh with a seq axis > 1; ulysses also needs "
+                      "n_heads divisible by the seq-axis size)", default=None,
+                      validator=lambda v: v in (None, "einsum", "flash",
+                                                "ring", "ulysses"))
     tokenizer = ComplexParam("tokenizer", "tokenizer object/config/name", default=None)
     mesh_config = ComplexParam("mesh_config", "MeshConfig override", default=None)
     weight_decay = Param("weight_decay", "adamw weight decay", default=0.01,

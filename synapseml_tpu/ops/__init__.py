@@ -8,15 +8,20 @@ Here the hot ops are first-class TPU kernels:
     XLA blockwise fallback) for the on-chip attention hot path;
   * :mod:`ring_attention` — cross-chip sequence parallelism over a named
     mesh axis via ``ppermute`` (net-new capability, SURVEY.md §5
-    "long-context"; the reference has none).
+    "long-context"; the reference has none);
+  * :mod:`ulysses_attention` — the all-to-all sequence-parallel strategy
+    (heads scatter, tokens gather, local full-context attention).
 """
 
 from .attention import flash_attention, reference_attention
 from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses_attention import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
     "flash_attention",
     "reference_attention",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
 ]

@@ -1,0 +1,107 @@
+"""All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+The second long-context strategy next to :mod:`ring_attention` (SURVEY.md §5
+"ring attention or all-to-all sequence/context parallelism"; the reference
+has neither). Where the ring keeps tokens resident and rotates K/V shards
+around the mesh (n-1 ``ppermute`` hops, O(T/n) memory, arbitrary lengths),
+Ulysses swaps WHICH dimension is sharded for the attention op itself:
+
+  [B, T/n, H, D]  --all_to_all-->  [B, T, H/n, D]
+
+Each device then runs full-context attention for its head subset — by
+default through the blockwise flash path (O(T) memory; a full-context
+einsum would materialize the [T, T] scores the long-context path exists to
+avoid) — and a second all-to-all restores sequence sharding. Communication
+is 4 all-to-alls
+per layer (q/k/v in, out back; their VJPs are all-to-alls too), each moving
+activations once, vs the ring's (n-1) K/V rotations: cheaper on
+all-to-all-friendly interconnects (ICI torus) when n divides the head count;
+the ring remains the choice when heads are too few or T/n is still too big
+to attend locally.
+
+Requires ``n_heads % axis_size == 0``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .attention import flash_attention, reference_attention
+from .ring_attention import _mesh_of
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q, k, v, kv_mask=None, *, axis_name: str,
+                      axis_size: int, causal: bool = False,
+                      local_impl: str = "flash"):
+    """Per-shard body (use under ``shard_map``).
+
+    q, k, v: ``[B, T/n, H, D]`` local shards in global token order;
+    kv_mask: ``[B, T/n]`` local validity. Returns ``[B, T/n, H, D]``.
+    ``local_impl``: 'flash' (bounded memory, the long-context default) or
+    'einsum' (materializes [T, T] scores — only for short sequences).
+    """
+    H = q.shape[2]
+    if H % axis_size:
+        raise ValueError(f"ulysses needs n_heads ({H}) divisible by the "
+                         f"'{axis_name}' axis size ({axis_size})")
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            tiled=True)
+    # heads scatter across the axis, tokens gather: [B, T, H/n, D]
+    qh = a2a(q, split_axis=2, concat_axis=1)
+    kh = a2a(k, split_axis=2, concat_axis=1)
+    vh = a2a(v, split_axis=2, concat_axis=1)
+    full_mask = None
+    if kv_mask is not None:
+        full_mask = jax.lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+    if local_impl == "flash":
+        out = flash_attention(qh, kh, vh, kv_mask=full_mask, causal=causal)
+    else:
+        out = reference_attention(qh, kh, vh, kv_mask=full_mask, causal=causal)
+    # tokens scatter back, heads gather: [B, T/n, H, D]
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def ulysses_attention_sharded(mesh_ctx, q, k, v, kv_mask=None,
+                              causal: bool = False, seq_axis: str = "seq",
+                              batch_axes=("data", "fsdp"),
+                              head_axis: str | None = "tensor",
+                              local_impl: str = "flash"):
+    """Full-array entry point: ``shard_map`` :func:`ulysses_attention` over
+    the mesh (mirror of ``ring_attention_sharded``).
+
+    q, k, v: ``[B, T, H, D]`` global arrays (T divisible by the seq-axis
+    size, H divisible by seq-axis x any head-axis sharding).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    mesh, sizes = _mesh_of(mesh_ctx)
+    n = sizes.get(seq_axis, 1)
+    H = q.shape[2]
+    batch_axes = tuple(a for a in batch_axes if a in sizes)
+    n_head_shard = sizes.get(head_axis, 1) if head_axis in sizes else 1
+    head = (head_axis if head_axis and head_axis in sizes
+            and H % max(n_head_shard * n, 1) == 0 else None)
+    if n <= 1:
+        return reference_attention(q, k, v, kv_mask=kv_mask, causal=causal)
+    qkv_spec = P(batch_axes or None, seq_axis, head, None)
+    mask_spec = P(batch_axes or None, seq_axis)
+    fn = functools.partial(ulysses_attention, axis_name=seq_axis,
+                           axis_size=n, causal=causal, local_impl=local_impl)
+    mapped = jax.shard_map(
+        lambda q_, k_, v_, m_: fn(q_, k_, v_, kv_mask=m_),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        # the flash local step is a pallas_call whose out_shape carries no
+        # varying-mesh-axes annotation; skip the vma check (the specs above
+        # already pin the sharding contract)
+        check_vma=False,
+    )
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:2], bool)
+    return mapped(q, k, v, kv_mask)

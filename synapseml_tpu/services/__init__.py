@@ -52,7 +52,7 @@ from .anomaly import (
     SimpleDetectAnomalies,
 )
 from .geospatial import AddressGeocoder, CheckPointInPolygon, ReverseAddressGeocoder
-from .speech import SpeechToText, TextToSpeech
+from .speech import ConversationTranscriber, SpeechToText, TextToSpeech
 from .aifoundry import AIFoundryChatCompletion
 from .langchain import LangChainTransformer
 
@@ -73,6 +73,6 @@ __all__ = [
     "DetectLastAnomaly", "DetectAnomalies", "SimpleDetectAnomalies",
     "FitMultivariateAnomaly", "DetectMultivariateAnomaly",
     "AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon",
-    "SpeechToText", "TextToSpeech", "AIFoundryChatCompletion",
+    "SpeechToText", "TextToSpeech", "ConversationTranscriber", "AIFoundryChatCompletion",
     "LangChainTransformer",
 ]

@@ -9,11 +9,13 @@ transcription column) without a native dependency, plus TextToSpeech
 
 from __future__ import annotations
 
-from ..core.params import Param, ServiceParam
-from ..io.http import HTTPRequest
-from .base import CognitiveServiceBase
+import json
 
-__all__ = ["SpeechToText", "TextToSpeech"]
+from ..core.params import Param, ServiceParam, TypeConverters
+from ..io.http import HTTPRequest
+from .base import CognitiveServiceBase, HasAsyncReply
+
+__all__ = ["SpeechToText", "TextToSpeech", "ConversationTranscriber"]
 
 
 class SpeechToText(CognitiveServiceBase):
@@ -89,3 +91,140 @@ class TextToSpeech(CognitiveServiceBase):
         if resp.error or resp.status_code // 100 != 2:
             return None, resp.error or f"HTTP {resp.status_code}: {resp.reason}"
         return resp.entity, None
+
+
+class ConversationTranscriber(HasAsyncReply):
+    """Long-audio transcription with speaker diarization (reference
+    ``SpeechToTextSDK.scala:564`` ``ConversationTranscription`` — the native
+    SDK's in-room/online transcriber; rebuilt on the batch-transcription REST
+    flow, the service's supported non-SDK path for diarized long audio).
+
+    Per row: create a transcription job for the row's audio URL (the batch
+    API takes content URLs, not inline bytes), poll until it completes, fetch
+    the result file, and land the diarized phrase list — one entry per
+    utterance with ``speaker``, ``offset``, and text — in ``output_col``.
+
+    ``url`` is the region management endpoint, e.g.
+    ``https://<region>.api.cognitive.microsoft.com``.
+    """
+
+    audio_url_col = Param("audio_url_col", "column of audio content URLs",
+                          default="audio_url")
+    language = ServiceParam("language", "transcription locale", default="en-US")
+    max_speakers = Param("max_speakers", "diarization: maximum speaker count",
+                         default=2, converter=TypeConverters.to_int)
+    display_name = Param("display_name", "job display name",
+                         default="synapseml_tpu transcription")
+    api_version = Param("api_version", "API version", default="v3.2")
+    output_col = Param("output_col", "diarized phrases column",
+                       default="transcription")
+
+    def input_bindings(self):
+        return {"_audio_url": "audio_url_col"}
+
+    def build_request(self, rp):
+        if rp.get("_audio_url") is None:
+            return None
+        body = {
+            "displayName": self.get("display_name"),
+            "locale": rp.get("language") or "en-US",
+            "contentUrls": [str(rp["_audio_url"])],
+            "properties": {
+                "diarizationEnabled": True,
+                "diarization": {"speakers": {"minCount": 1,
+                                             "maxCount": self.get("max_speakers")}},
+                "punctuationMode": "DictatedAndAutomatic",
+                "profanityFilterMode": "Masked",
+            },
+        }
+        url = (f"{(self.get('url') or '').rstrip('/')}/speechtotext/"
+               f"{self.get('api_version')}/transcriptions")
+        return self.json_request(rp, url, body)
+
+    def is_done(self, payload) -> bool:
+        status = str(payload.get("status", "")).lower() \
+            if isinstance(payload, dict) else ""
+        return status in ("succeeded", "failed")
+
+    def poll_location(self, resp):
+        # the create reply carries its own URL in "self"; poll that
+        loc = super().poll_location(resp)
+        if loc:
+            return loc
+        try:
+            return resp.json().get("self")
+        except Exception:
+            return None
+
+    def post_process_responses(self, requests, responses, client):
+        """LRO poll (base), then fetch each finished job's result file."""
+        polled = super().post_process_responses(requests, responses, client)
+        out = list(polled)
+        fetchable = {}
+        for i, resp in enumerate(out):
+            if resp is None or resp.status_code // 100 != 2:
+                continue
+            try:
+                payload = resp.json()
+            except Exception:
+                continue
+            if str(payload.get("status", "")).lower() != "succeeded":
+                continue
+            files_url = (payload.get("links") or {}).get("files")
+            if files_url:
+                fetchable[i] = files_url
+        if not fetchable:
+            return out
+        idxs = list(fetchable)
+        files_lists = client.send_all(
+            [HTTPRequest(url=fetchable[i], method="GET",
+                         headers=self.poll_headers(requests[i]))
+             for i in idxs])
+        content = {}
+        for i, resp in zip(idxs, files_lists):
+            try:
+                values = resp.json().get("values", [])
+            except Exception:
+                continue
+            urls = [v["links"]["contentUrl"] for v in values
+                    if v.get("kind") == "Transcription"]
+            if urls:
+                content[i] = urls[0]
+        if content:
+            idxs = list(content)
+            results = client.send_all(
+                [HTTPRequest(url=content[i], method="GET",
+                             headers=self.poll_headers(requests[i]))
+                 for i in idxs])
+            for i, resp in zip(idxs, results):
+                out[i] = resp
+        return out
+
+    def handle_response(self, resp):
+        parsed, err = super().handle_response(resp)
+        if err is None and parsed is not None:
+            payload = resp.json()
+            if isinstance(payload, dict):
+                status = str(payload.get("status", "")).lower()
+                if status == "failed":
+                    props = payload.get("properties") or {}
+                    return None, ("transcription job failed: "
+                                  f"{json.dumps(props.get('error', props))[:500]}")
+                if status == "succeeded":
+                    # job state never replaced by a result file: the files
+                    # listing had no Transcription entry (or the fetch failed)
+                    return None, "transcription succeeded but no result file"
+        return parsed, err
+
+    def parse_response(self, payload):
+        try:
+            phrases = payload["recognizedPhrases"]
+        except (KeyError, TypeError):
+            return payload
+        out = []
+        for p in phrases:
+            best = p.get("nBest") or []  # silence segments can have no nBest
+            out.append({"speaker": p.get("speaker"),
+                        "offset": p.get("offset"),
+                        "text": best[0].get("display", "") if best else ""})
+        return out

@@ -97,7 +97,8 @@ def test_ring_attention_differentiable():
 
 def test_encoder_attn_impls_agree():
     """The same Encoder weights produce the same output under einsum, flash,
-    and ring (on a seq mesh) attention backends (valid positions only)."""
+    ring, and ulysses (on a seq mesh) attention backends (valid positions
+    only)."""
     import dataclasses
 
     from synapseml_tpu.models.flax_nets.transformer import Encoder, TransformerConfig
@@ -125,6 +126,11 @@ def test_encoder_attn_impls_agree():
         out_ring = Encoder(dataclasses.replace(base, attn_impl="ring")).apply(variables, x, mask)
     np.testing.assert_allclose(np.asarray(out_einsum)[valid],
                                np.asarray(out_ring)[valid], atol=2e-4)
+
+    with mesh.mesh:  # n_heads=4 divides seq=4: ulysses eligible
+        out_uly = Encoder(dataclasses.replace(base, attn_impl="ulysses")).apply(variables, x, mask)
+    np.testing.assert_allclose(np.asarray(out_einsum)[valid],
+                               np.asarray(out_uly)[valid], atol=2e-4)
 
 
 def test_ring_attention_grad_matches_reference_with_mask():
@@ -177,3 +183,45 @@ def test_ring_attention_long_context_32k():
         p /= p.sum()
         np.testing.assert_allclose(out[0, t, 0], p @ vf[0, : t + 1, 0],
                                    atol=3e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(causal):
+    from synapseml_tpu.ops import ulysses_attention_sharded
+
+    q, k, v, mask = make_qkv(H=8)  # ulysses: heads divisible by seq size
+    mesh = create_mesh(MeshConfig(data=1, seq=8))
+    ref = reference_attention(q, k, v, kv_mask=mask, causal=causal)
+    out = ulysses_attention_sharded(mesh, q, k, v, kv_mask=mask, causal=causal)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_ulysses_attention_mixed_mesh_and_grad():
+    """data x seq mesh; gradients flow through both all-to-alls correctly."""
+    from synapseml_tpu.ops import ulysses_attention_sharded
+
+    q, k, v, mask = make_qkv(B=4, T=32)
+    mesh = create_mesh(MeshConfig(data=2, seq=4))
+    ref = reference_attention(q, k, v, kv_mask=mask, causal=True)
+    out = ulysses_attention_sharded(mesh, q, k, v, kv_mask=mask, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+    def loss_u(q, k, v):
+        return jnp.sum(ulysses_attention_sharded(mesh, q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_u = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_u):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from synapseml_tpu.ops.ulysses_attention import ulysses_attention
+
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(jnp.zeros((1, 4, 6, 8)), jnp.zeros((1, 4, 6, 8)),
+                          jnp.zeros((1, 4, 6, 8)), axis_name="seq",
+                          axis_size=4)

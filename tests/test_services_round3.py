@@ -30,6 +30,7 @@ class Handler(BaseHTTPRequestHandler):
     indexes: set = set()
     created_schemas: list = []
     job_bodies: list = []
+    transcription_jobs: list = []
 
     def log_message(self, *a):
         pass
@@ -70,12 +71,37 @@ class Handler(BaseHTTPRequestHandler):
                 {"kind": kind, "results": {"documents": [docs[kind]]}}]}})
         if p == "/indexes":
             return self._json({"value": [{"name": n} for n in Handler.indexes]})
+        host = f"http://{self.headers.get('Host')}"
+        if p.startswith("/speechtotext/") and p.endswith("/tx1"):
+            n = Handler.lro.get("tx1", 0)
+            Handler.lro["tx1"] = n + 1
+            if n < 1:
+                return self._json({"status": "Running"})
+            return self._json({"status": "Succeeded", "links": {
+                "files": f"{host}/speechtotext/v3.2/transcriptions/tx1/files"}})
+        if p.endswith("/tx1/files"):
+            return self._json({"values": [
+                {"kind": "TranscriptionReport", "links": {"contentUrl": f"{host}/report"}},
+                {"kind": "Transcription",
+                 "links": {"contentUrl": f"{host}/result.json"}}]})
+        if p == "/result.json":
+            return self._json({"recognizedPhrases": [
+                {"speaker": 1, "offset": "PT0S",
+                 "nBest": [{"display": "hello there"}]},
+                {"speaker": 2, "offset": "PT2S",
+                 "nBest": [{"display": "hi"}]}]})
         return self._json({"error": f"unknown GET {p}"}, 404)
 
     def do_POST(self):  # noqa: N802
         p = self.path.split("?")[0]
         body = self._body()
         host = f"http://{self.headers.get('Host')}"
+        if p.startswith("/speechtotext/") and p.endswith("/transcriptions"):
+            Handler.transcription_jobs.append(body)
+            Handler.lro.setdefault("tx1", 0)
+            return self._json(
+                {"self": f"{host}/speechtotext/v3.2/transcriptions/tx1",
+                 "status": "NotStarted"}, 201)
         if p == "/language/analyze-text/jobs":
             kind = body["tasks"][0]["kind"]
             Handler.job_bodies.append(body)
@@ -247,3 +273,72 @@ def test_infer_index_schema_skips_leading_nones():
     schema = infer_index_schema(df, "idx", key_col="id")
     by_name = {f["name"]: f for f in schema["fields"]}
     assert by_name["score"]["type"] == "Edm.Double"
+
+
+def test_conversation_transcriber_diarization(server):
+    """Batch-transcription flow (reference ConversationTranscription,
+    SpeechToTextSDK.scala:564): create job -> poll -> files -> diarized
+    phrases with speaker ids."""
+    from synapseml_tpu.services import ConversationTranscriber
+
+    df = DataFrame.from_dict({"audio_url": ["https://example.com/a.wav"]})
+    t = ConversationTranscriber(url=server, subscription_key="k",
+                                max_speakers=3, polling_interval_s=0.01)
+    out = t.transform(df).collect_column("transcription")[0]
+    assert [p["speaker"] for p in out] == [1, 2]
+    assert out[0]["text"] == "hello there"
+    sent = Handler.transcription_jobs[-1]
+    assert sent["properties"]["diarizationEnabled"] is True
+    assert sent["properties"]["diarization"]["speakers"]["maxCount"] == 3
+    assert sent["contentUrls"] == ["https://example.com/a.wav"]
+
+
+def test_conversation_transcriber_failed_job_is_an_error(server):
+    from synapseml_tpu.services import ConversationTranscriber
+
+    orig_get = Handler.do_GET
+
+    def failing_get(self):
+        p = self.path.split("?")[0]
+        if p.startswith("/speechtotext/") and p.endswith("/tx1"):
+            return self._json({"status": "Failed", "properties": {
+                "error": {"code": "InvalidUri", "message": "no such blob"}}})
+        return orig_get(self)
+
+    Handler.do_GET = failing_get
+    try:
+        df = DataFrame.from_dict({"audio_url": ["https://example.com/x.wav"]})
+        t = ConversationTranscriber(url=server, subscription_key="k",
+                                    polling_interval_s=0.01)
+        out = t.transform(df)
+        assert out.collect_column("transcription")[0] is None
+        assert "job failed" in out.collect_column("errors")[0]
+    finally:
+        Handler.do_GET = orig_get
+
+
+def test_conversation_transcriber_empty_nbest_segment(server):
+    """A silence segment with nBest=[] must not discard the good phrases."""
+    from synapseml_tpu.services import ConversationTranscriber
+
+    orig_get = Handler.do_GET
+
+    def silence_get(self):
+        p = self.path.split("?")[0]
+        if p == "/result.json":
+            return self._json({"recognizedPhrases": [
+                {"speaker": 1, "offset": "PT0S",
+                 "nBest": [{"display": "hello"}]},
+                {"speaker": 2, "offset": "PT1S", "nBest": []}]})
+        return orig_get(self)
+
+    Handler.do_GET = silence_get
+    Handler.lro.pop("tx1", None)
+    try:
+        df = DataFrame.from_dict({"audio_url": ["https://example.com/y.wav"]})
+        t = ConversationTranscriber(url=server, subscription_key="k",
+                                    polling_interval_s=0.01)
+        out = t.transform(df).collect_column("transcription")[0]
+        assert [p["text"] for p in out] == ["hello", ""]
+    finally:
+        Handler.do_GET = orig_get
