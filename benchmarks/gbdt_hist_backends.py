@@ -1,0 +1,51 @@
+"""GBDT histogram backend comparison: segment_sum (scatter) vs one-hot
+matmul (MXU) on the Higgs-1M shape. The measurement this exists for is the
+TPU one — scatter-adds serialize on TPU while the one-hot form is matmul
+FLOPs — but it runs anywhere (CPU mode uses a smaller shape). Prints one
+JSON line with per-backend train seconds; the winner should become
+``histogram_impl``'s default on that platform."""
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    on_tpu = platform == "tpu"
+    # CPU smoke must stay tiny: the one-hot form is matmul FLOPs, which one
+    # CPU core grinds through slowly (the MXU is the point)
+    N, F = (1_000_000, 28) if on_tpu else (10_000, 28)
+    n_iter = 50 if on_tpu else 5
+    max_bin = 255 if on_tpu else 63
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N, F)).astype(np.float32)
+    w = rng.normal(size=F)
+    y = ((X @ w + rng.normal(size=N)) > 0).astype(np.float32)
+
+    times = {}
+    for impl in ("segment", "onehot"):
+        t0 = time.perf_counter()
+        train_booster(X, y, objective="binary", num_iterations=n_iter,
+                      learning_rate=0.1, num_leaves=31, max_bin=max_bin,
+                      histogram_impl=impl)
+        times[impl] = round(time.perf_counter() - t0, 2)
+
+    print(json.dumps({
+        "metric": "GBDT histogram backend train time"
+                  + ("" if on_tpu else " (CPU smoke)"),
+        "unit": "s", "platform": platform, "rows": N, "iters": n_iter,
+        "segment_s": times["segment"], "onehot_s": times["onehot"],
+        "speedup_onehot": round(times["segment"] / times["onehot"], 2)}))
+
+
+main()

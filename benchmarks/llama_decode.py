@@ -1,0 +1,64 @@
+"""Llama decode throughput (BASELINE.md: Llama-2-7B batch inference,
+tokens/sec). On the single v5e chip a 7B model doesn't fit (weights alone
+~13.5 GB bf16 vs 16 GB HBM with no KV/activation headroom at max_len), so
+the TPU mode runs the largest single-chip Llama-shaped config (all the 7B
+structure at ~1.1B params) and reports tokens/sec/chip; the 7B multi-chip
+path itself is exercised (reduced width, tensor x fsdp mesh) in
+tests/test_hf_cyber.py::test_llama2_7b_code_path_reduced_width."""
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.flax_nets.llama import (LlamaLM, generate,
+                                                      llama2_7b, llama_tiny)
+
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        # 7B structure, single-chip width: 32 layers, GQA-free MHA, RoPE,
+        # SwiGLU; ~1.1B params bf16
+        cfg = llama2_7b(hidden=1536, mlp_dim=4128, n_layers=32, n_heads=24,
+                        n_kv_heads=24, max_len=2048)
+        B, P, new = 8, 128, 128
+    else:
+        cfg = llama_tiny()
+        B, P, new = 4, 16, 16
+
+    model = LlamaLM(cfg, decode=True)
+    params = LlamaLM(cfg).init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params = sum(int(np.prod(np.shape(x))) for x in jax.tree.leaves(params))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, P)), jnp.int32)
+
+    fn = jax.jit(lambda i: generate(model, params, i, new))
+    np.asarray(fn(ids))  # compile + warm
+    trials = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(fn(ids))
+        trials.append(time.perf_counter() - t0)
+    dt = min(trials)
+    toks = B * new
+    print(json.dumps({
+        "metric": "Llama decode throughput" if on_tpu
+                  else "Llama decode (CPU smoke)",
+        "value": round(toks / dt, 1), "unit": "tokens/sec/chip",
+        "platform": platform, "n_params": n_params, "batch": B,
+        "prompt_len": P, "new_tokens": new,
+        "decode_ms_per_token": round(dt / new * 1e3, 2)}))
+
+
+main()

@@ -79,6 +79,12 @@ class LightGBMClassificationModel(WrapperBase):
     def getFeaturesShapCol(self):
         return self._get('features_shap_col')
 
+    def setHistogramImpl(self, value):
+        return self._set('histogram_impl', value)
+
+    def getHistogramImpl(self):
+        return self._get('histogram_impl')
+
     def setLabelCol(self, value):
         return self._set('label_col', value)
 
@@ -276,6 +282,12 @@ class LightGBMClassifier(WrapperBase):
 
     def getFeaturesCol(self):
         return self._get('features_col')
+
+    def setHistogramImpl(self, value):
+        return self._set('histogram_impl', value)
+
+    def getHistogramImpl(self):
+        return self._get('histogram_impl')
 
     def setIsUnbalance(self, value):
         return self._set('is_unbalance', value)
@@ -505,6 +517,12 @@ class LightGBMRanker(WrapperBase):
     def getGroupCol(self):
         return self._get('group_col')
 
+    def setHistogramImpl(self, value):
+        return self._set('histogram_impl', value)
+
+    def getHistogramImpl(self):
+        return self._get('histogram_impl')
+
     def setLabelCol(self, value):
         return self._set('label_col', value)
 
@@ -702,6 +720,12 @@ class LightGBMRankerModel(WrapperBase):
 
     def getFeaturesShapCol(self):
         return self._get('features_shap_col')
+
+    def setHistogramImpl(self, value):
+        return self._set('histogram_impl', value)
+
+    def getHistogramImpl(self):
+        return self._get('histogram_impl')
 
     def setLabelCol(self, value):
         return self._set('label_col', value)
@@ -901,6 +925,12 @@ class LightGBMRegressionModel(WrapperBase):
     def getFeaturesShapCol(self):
         return self._get('features_shap_col')
 
+    def setHistogramImpl(self, value):
+        return self._set('histogram_impl', value)
+
+    def getHistogramImpl(self):
+        return self._get('histogram_impl')
+
     def setLabelCol(self, value):
         return self._set('label_col', value)
 
@@ -1092,6 +1122,12 @@ class LightGBMRegressor(WrapperBase):
 
     def getFeaturesCol(self):
         return self._get('features_col')
+
+    def setHistogramImpl(self, value):
+        return self._set('histogram_impl', value)
+
+    def getHistogramImpl(self):
+        return self._get('histogram_impl')
 
     def setLabelCol(self, value):
         return self._set('label_col', value)

@@ -2096,6 +2096,90 @@ class AzureSearchWriter(WrapperBase):
         return self._get('url')
 
 
+class ConversationTranscriber(WrapperBase):
+    """Long-audio transcription with per-utterance speaker diarization. (wraps ``synapseml_tpu.services.speech.ConversationTranscriber``)."""
+
+    _target = 'synapseml_tpu.services.speech.ConversationTranscriber'
+
+    def setApiVersion(self, value):
+        return self._set('api_version', value)
+
+    def getApiVersion(self):
+        return self._get('api_version')
+
+    def setAudioUrlCol(self, value):
+        return self._set('audio_url_col', value)
+
+    def getAudioUrlCol(self):
+        return self._get('audio_url_col')
+
+    def setConcurrency(self, value):
+        return self._set('concurrency', value)
+
+    def getConcurrency(self):
+        return self._get('concurrency')
+
+    def setDisplayName(self, value):
+        return self._set('display_name', value)
+
+    def getDisplayName(self):
+        return self._get('display_name')
+
+    def setErrorCol(self, value):
+        return self._set('error_col', value)
+
+    def getErrorCol(self):
+        return self._get('error_col')
+
+    def setLanguage(self, value):
+        return self._set('language', value)
+
+    def getLanguage(self):
+        return self._get('language')
+
+    def setMaxPollAttempts(self, value):
+        return self._set('max_poll_attempts', value)
+
+    def getMaxPollAttempts(self):
+        return self._get('max_poll_attempts')
+
+    def setMaxSpeakers(self, value):
+        return self._set('max_speakers', value)
+
+    def getMaxSpeakers(self):
+        return self._get('max_speakers')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setPollingIntervalS(self, value):
+        return self._set('polling_interval_s', value)
+
+    def getPollingIntervalS(self):
+        return self._get('polling_interval_s')
+
+    def setSubscriptionKey(self, value):
+        return self._set('subscription_key', value)
+
+    def getSubscriptionKey(self):
+        return self._get('subscription_key')
+
+    def setTimeoutS(self, value):
+        return self._set('timeout_s', value)
+
+    def getTimeoutS(self):
+        return self._get('timeout_s')
+
+    def setUrl(self, value):
+        return self._set('url', value)
+
+    def getUrl(self):
+        return self._get('url')
+
+
 class SpeechToText(WrapperBase):
     """Audio bytes -> recognition JSON (DisplayText, offsets). (wraps ``synapseml_tpu.services.speech.SpeechToText``)."""
 

@@ -247,7 +247,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                   other_rate: float = 0.1, drop_rate: float = 0.1,
                   max_drop: int = 50, skip_drop: float = 0.5,
                   monotone_constraints=None, scale_pos_weight: float = 1.0,
-                  is_unbalance: bool = False,
+                  is_unbalance: bool = False, histogram_impl: str = "segment",
                   measures=None, verbose: bool = False) -> TpuBooster:
     """Grow a forest. The full binned matrix + running scores stay on device
     for the whole run; pass ``mesh`` to shard rows over its ``data`` axis
@@ -360,7 +360,8 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                          learning_rate=1.0 if boosting_type == "rf" else learning_rate,
                          min_data_in_leaf=min_data_in_leaf,
                          min_sum_hessian=min_sum_hessian,
-                         min_gain_to_split=min_gain_to_split)
+                         min_gain_to_split=min_gain_to_split,
+                         hist_impl=histogram_impl)
 
     # validation state (kept binned; scores updated incrementally)
     has_valid = valid_features is not None and valid_labels is not None

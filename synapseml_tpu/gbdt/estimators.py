@@ -86,6 +86,12 @@ class _LightGBMParams:
                                  "validation improvement (0=off)", default=0,
                                  converter=TypeConverters.to_int)
     seed = Param("seed", "random seed", default=0, converter=TypeConverters.to_int)
+    histogram_impl = Param("histogram_impl", "histogram backend: segment "
+                           "(scatter-add) | onehot (MXU matmul); equivalent "
+                           "results, pick by measurement "
+                           "(benchmarks/gbdt_hist_backends.py)",
+                           default="segment",
+                           validator=lambda v: v in ("segment", "onehot"))
     verbosity = Param("verbosity", "print eval metrics when > 0", default=-1,
                       converter=TypeConverters.to_int)
     mesh_config = ComplexParam("mesh_config", "MeshConfig to shard rows over the "
@@ -145,6 +151,7 @@ class _LightGBMParams:
             drop_rate=self.get("drop_rate"), max_drop=self.get("max_drop"),
             skip_drop=self.get("skip_drop"),
             seed=self.get("seed"),
+            histogram_impl=self.get("histogram_impl"),
             verbose=self.get("verbosity") > 0,
             mesh=self._mesh(),
         )

@@ -51,6 +51,10 @@ class GrowthConfig(NamedTuple):
     # (reference params/LightGBMParams.scala monotoneConstraints; the 'basic'
     # method: split-direction gating + child-value midpoint bounds)
     monotone_constraints: tuple = ()
+    # histogram backend: 'segment' (segment_sum -> scatter-add) or 'onehot'
+    # (row-chunked one-hot matmul — MXU-shaped; scatter serializes on TPU).
+    # Equivalent results; pick by measurement (benchmarks/gbdt_hist_backends.py)
+    hist_impl: str = "segment"
 
 
 class TreeArrays(NamedTuple):
@@ -81,24 +85,63 @@ def _split_score(g: jax.Array, h: jax.Array, cfg: GrowthConfig) -> jax.Array:
 
 
 def _level_histogram(bins: jax.Array, g: jax.Array, h: jax.Array, presence: jax.Array,
-                     node_of_row: jax.Array, base: int, width: int, num_bins: int) -> jax.Array:
+                     node_of_row: jax.Array, base: int, width: int, num_bins: int,
+                     hist_impl: str = "segment") -> jax.Array:
     """(width, F, B, 3) histograms for the ``width`` nodes of one level.
 
-    Scans over features so peak memory stays O(N) regardless of F; each
-    feature is a single segment-sum of (N, 3) into (width*B, 3). Rows whose
+    Scans over features so peak memory stays O(N) regardless of F. Rows whose
     node is outside [base, base+width) (rows resting in already-final leaves)
-    are zero-weighted out.
+    are zero-weighted out. Two backends per feature:
+
+    * 'segment': one segment-sum of (N, 3) into (width*B, 3) — lowers to a
+      scatter-add, which TPUs serialize;
+    * 'onehot': row-chunked one-hot matmul — the same reduction phrased as
+      [C, width*B]^T @ [C, 3] MXU matmuls accumulated over chunks (the
+      scaling-book recipe for TPU histograms). One-hot 0/1 values are exact
+      in any dtype and the dot accumulates in f32, so results match
+      'segment' to float rounding.
     """
     valid = (node_of_row >= base) & (node_of_row < base + width)
     rel = jnp.where(valid, node_of_row - base, 0)
     zero = jnp.zeros_like(g)
     data = jnp.stack([jnp.where(valid, g, zero), jnp.where(valid, h, zero),
                       jnp.where(valid, presence, zero)], axis=-1)  # (N, 3)
+    WB = width * num_bins
 
-    def one_feature(carry, f_bins):
-        seg = rel * num_bins + f_bins.astype(jnp.int32)
-        hist = jax.ops.segment_sum(data, seg, num_segments=width * num_bins)
-        return carry, hist.reshape(width, num_bins, 3)
+    if hist_impl == "onehot":
+        row_chunk = 4096
+        n = data.shape[0]
+        pad = (-n) % row_chunk
+        if pad:
+            data = jnp.pad(data, ((0, pad), (0, 0)))  # zero rows: no effect
+            rel = jnp.pad(rel, (0, pad))
+        data_r = data.reshape(-1, row_chunk, 3)
+
+        def one_feature(carry, f_bins):
+            if pad:
+                f_bins = jnp.pad(f_bins, (0, pad))
+            seg_r = (rel * num_bins + f_bins.astype(jnp.int32)
+                     ).reshape(-1, row_chunk)
+
+            def chunk_step(acc, xs):
+                seg_c, data_c = xs
+                oh = jax.nn.one_hot(seg_c, WB, dtype=data_c.dtype)  # (C, WB)
+                return acc + jax.lax.dot_general(
+                    oh, data_c, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32), None
+
+            hist, _ = jax.lax.scan(chunk_step,
+                                   jnp.zeros((WB, 3), jnp.float32),
+                                   (seg_r, data_r))
+            return carry, hist.reshape(width, num_bins, 3)
+    elif hist_impl == "segment":
+        def one_feature(carry, f_bins):
+            seg = rel * num_bins + f_bins.astype(jnp.int32)
+            hist = jax.ops.segment_sum(data, seg, num_segments=WB)
+            return carry, hist.reshape(width, num_bins, 3)
+    else:
+        raise ValueError(f"hist_impl must be 'segment' or 'onehot', "
+                         f"got {hist_impl!r}")
 
     _, hists = jax.lax.scan(one_feature, 0, jnp.swapaxes(bins, 0, 1))  # (F, W, B, 3)
     return jnp.swapaxes(hists, 0, 1)  # (W, F, B, 3)
@@ -118,7 +161,8 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
     def step(bins, grad, hess, presence, node_of_row, feature, threshold_bin,
              leaf_value, node_gain, node_cover, feat_mask, leaf_count,
              node_lo, node_hi):
-        hist = _level_histogram(bins, grad, hess, presence, node_of_row, base, width, B)
+        hist = _level_histogram(bins, grad, hess, presence, node_of_row, base,
+                                width, B, hist_impl=cfg.hist_impl)
         cum = jnp.cumsum(hist, axis=2)  # (W, F, B, 3)
         total = cum[:, 0, -1, :]  # (W, 3) — feature 0's full sum == node totals
         g_tot, h_tot, c_tot = total[:, 0], total[:, 1], total[:, 2]

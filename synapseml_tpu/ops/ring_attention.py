@@ -245,6 +245,46 @@ def _mesh_of(mesh_like):
     return mesh, sizes
 
 
+def seq_parallel_shard_map(mesh_ctx, q, k, v, kv_mask, causal, seq_axis,
+                           batch_axes, head_axis, fn_factory,
+                           head_needs_seq_factor: bool = False,
+                           check_vma: bool = True):
+    """Shared full-array wrapper for the sequence-parallel strategies.
+
+    Resolves the mesh, falls back to plain attention when the seq axis is
+    absent/size-1, builds the batch/seq/head PartitionSpecs (the head axis is
+    used only when the head count divides its sharding — times the seq size
+    too when ``head_needs_seq_factor``, as Ulysses splits heads across the
+    seq axis as well), and shard_maps ``fn_factory(axis_size)`` which must
+    return a per-shard ``fn(q, k, v, kv_mask)``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    mesh, sizes = _mesh_of(mesh_ctx)
+    n = sizes.get(seq_axis, 1)
+    H = q.shape[2]
+    batch_axes = tuple(a for a in batch_axes if a in sizes)
+    divisor = max(sizes.get(head_axis, 1), 1) * (n if head_needs_seq_factor else 1)
+    head = (head_axis if head_axis and head_axis in sizes
+            and H % divisor == 0 else None)
+    if n <= 1:
+        from .attention import reference_attention
+        return reference_attention(q, k, v, kv_mask=kv_mask, causal=causal)
+    qkv_spec = P(batch_axes or None, seq_axis, head, None)
+    mask_spec = P(batch_axes or None, seq_axis)
+    fn = fn_factory(n)
+    mapped = jax.shard_map(
+        lambda q_, k_, v_, m_: fn(q_, k_, v_, kv_mask=m_),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_vma=check_vma,
+    )
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:2], bool)
+    return mapped(q, k, v, kv_mask)
+
+
 def ring_attention_sharded(mesh_ctx, q, k, v, kv_mask=None, causal: bool = False,
                            seq_axis: str = "seq", batch_axes=("data", "fsdp"),
                            head_axis: str | None = "tensor", chunk: int = 512):
@@ -254,27 +294,7 @@ def ring_attention_sharded(mesh_ctx, q, k, v, kv_mask=None, causal: bool = False
     ``mesh_ctx`` may be a :class:`~synapseml_tpu.parallel.MeshContext`, a
     ``jax.sharding.Mesh``, or an ``AbstractMesh``.
     """
-    from jax.sharding import PartitionSpec as P
-
-    mesh, sizes = _mesh_of(mesh_ctx)
-    n = sizes.get(seq_axis, 1)
-    H = q.shape[2]
-    batch_axes = tuple(a for a in batch_axes if a in sizes)
-    head = (head_axis if head_axis and head_axis in sizes
-            and H % max(sizes.get(head_axis, 1), 1) == 0 else None)
-    if n <= 1:
-        from .attention import reference_attention
-        return reference_attention(q, k, v, kv_mask=kv_mask, causal=causal)
-    qkv_spec = P(batch_axes or None, seq_axis, head, None)
-    mask_spec = P(batch_axes or None, seq_axis)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, axis_size=n,
-                           causal=causal, chunk=chunk)
-    mapped = jax.shard_map(
-        lambda q_, k_, v_, m_: fn(q_, k_, v_, kv_mask=m_),
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec,
-    )
-    if kv_mask is None:
-        kv_mask = jnp.ones(q.shape[:2], bool)
-    return mapped(q, k, v, kv_mask)
+    return seq_parallel_shard_map(
+        mesh_ctx, q, k, v, kv_mask, causal, seq_axis, batch_axes, head_axis,
+        lambda n: functools.partial(ring_attention, axis_name=seq_axis,
+                                    axis_size=n, causal=causal, chunk=chunk))

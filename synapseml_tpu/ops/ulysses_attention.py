@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from .attention import flash_attention, reference_attention
-from .ring_attention import _mesh_of
+from .ring_attention import seq_parallel_shard_map
 
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
@@ -77,31 +77,13 @@ def ulysses_attention_sharded(mesh_ctx, q, k, v, kv_mask=None,
     q, k, v: ``[B, T, H, D]`` global arrays (T divisible by the seq-axis
     size, H divisible by seq-axis x any head-axis sharding).
     """
-    from jax.sharding import PartitionSpec as P
-
-    mesh, sizes = _mesh_of(mesh_ctx)
-    n = sizes.get(seq_axis, 1)
-    H = q.shape[2]
-    batch_axes = tuple(a for a in batch_axes if a in sizes)
-    n_head_shard = sizes.get(head_axis, 1) if head_axis in sizes else 1
-    head = (head_axis if head_axis and head_axis in sizes
-            and H % max(n_head_shard * n, 1) == 0 else None)
-    if n <= 1:
-        return reference_attention(q, k, v, kv_mask=kv_mask, causal=causal)
-    qkv_spec = P(batch_axes or None, seq_axis, head, None)
-    mask_spec = P(batch_axes or None, seq_axis)
-    fn = functools.partial(ulysses_attention, axis_name=seq_axis,
-                           axis_size=n, causal=causal, local_impl=local_impl)
-    mapped = jax.shard_map(
-        lambda q_, k_, v_, m_: fn(q_, k_, v_, kv_mask=m_),
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec,
+    return seq_parallel_shard_map(
+        mesh_ctx, q, k, v, kv_mask, causal, seq_axis, batch_axes, head_axis,
+        lambda n: functools.partial(ulysses_attention, axis_name=seq_axis,
+                                    axis_size=n, causal=causal,
+                                    local_impl=local_impl),
+        head_needs_seq_factor=True,  # ulysses splits heads across seq too
         # the flash local step is a pallas_call whose out_shape carries no
-        # varying-mesh-axes annotation; skip the vma check (the specs above
-        # already pin the sharding contract)
-        check_vma=False,
-    )
-    if kv_mask is None:
-        kv_mask = jnp.ones(q.shape[:2], bool)
-    return mapped(q, k, v, kv_mask)
+        # varying-mesh-axes annotation; skip the vma check (the specs pin
+        # the sharding contract)
+        check_vma=False)

@@ -94,10 +94,12 @@ class TextToSpeech(CognitiveServiceBase):
 
 
 class ConversationTranscriber(HasAsyncReply):
-    """Long-audio transcription with speaker diarization (reference
-    ``SpeechToTextSDK.scala:564`` ``ConversationTranscription`` — the native
-    SDK's in-room/online transcriber; rebuilt on the batch-transcription REST
-    flow, the service's supported non-SDK path for diarized long audio).
+    """Long-audio transcription with per-utterance speaker diarization.
+
+    Reference ``SpeechToTextSDK.scala:564`` ``ConversationTranscription`` —
+    the native SDK's in-room/online transcriber; rebuilt on the
+    batch-transcription REST flow, the service's supported non-SDK path for
+    diarized long audio.
 
     Per row: create a transcription job for the row's audio URL (the batch
     API takes content URLs, not inline bytes), poll until it completes, fetch

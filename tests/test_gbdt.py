@@ -779,3 +779,21 @@ def test_imported_booster_shap_raises_clearly():
         m2.transform(df)
     with pytest.raises(NotImplementedError, match="cover statistics"):
         m2.predict_contrib(X)
+
+
+def test_histogram_backends_equivalent():
+    """'onehot' (MXU matmul) and 'segment' (scatter) histogram backends grow
+    identical forests and score identically (one-hot 0/1 values are exact, so
+    only float summation order differs)."""
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    X, y = _mode_dataset(seed=41, n=400)
+    kw = dict(objective="binary", num_iterations=8, learning_rate=0.2,
+              num_leaves=15, seed=0)
+    b_seg = train_booster(X, y, histogram_impl="segment", **kw)
+    b_oh = train_booster(X, y, histogram_impl="onehot", **kw)
+    np.testing.assert_array_equal(b_seg.feature, b_oh.feature)
+    np.testing.assert_allclose(b_seg.threshold_value, b_oh.threshold_value,
+                               rtol=1e-6)
+    np.testing.assert_allclose(b_seg.raw_score(X[:60]), b_oh.raw_score(X[:60]),
+                               rtol=1e-4, atol=1e-5)
