@@ -163,10 +163,35 @@ class Trainer:
     def resume_state(self, params, opt_state=None, step: int = 0,
                      batch_stats=None) -> TrainState:
         """Build a TrainState from restored host/device pytrees (see
-        parallel.checkpoint.restore_checkpoint) without re-initializing."""
+        parallel.checkpoint.restore_checkpoint) without re-initializing.
+
+        A serialized ``opt_state`` comes back as plain tuples/dicts (the
+        npz round-trip keeps order but not optax's NamedTuple node types);
+        its leaves are poured back into a freshly initialized optimizer
+        structure, with shape validation, so optax transforms see their own
+        state classes again."""
         self.ensure_optimizer(params)
         if opt_state is None:
             opt_state = self._tx.init(params)
+        else:
+            # eval_shape: the reference structure/shapes with ZERO allocation
+            # (a real init would materialize ~2x-param Adam moments just to
+            # throw them away — an OOM risk on 7B-class resumes)
+            fresh = jax.eval_shape(self._tx.init, params)
+            fresh_leaves, treedef = jax.tree.flatten(fresh)
+            leaves = jax.tree.leaves(opt_state)
+            if len(leaves) != len(fresh_leaves):
+                raise ValueError(
+                    f"restored opt_state has {len(leaves)} leaves but this "
+                    f"optimizer expects {len(fresh_leaves)} — optimizer "
+                    "config changed since the checkpoint was written")
+            for got, want in zip(leaves, fresh_leaves):
+                if tuple(np.shape(got)) != tuple(want.shape):
+                    raise ValueError(
+                        f"restored opt_state leaf shape {np.shape(got)} != "
+                        f"expected {want.shape} — params/optimizer "
+                        "mismatch with the checkpoint")
+            opt_state = jax.tree.unflatten(treedef, leaves)
         return TrainState(params=params, opt_state=opt_state,
                           step=jnp.asarray(step, jnp.int32), batch_stats=batch_stats)
 
@@ -285,7 +310,8 @@ class Trainer:
 
     def fit(self, state: TrainState, batch_iter: Iterator[dict], max_steps: int,
             log_every: int = 50, callback: Callable[[int, dict], None] | None = None,
-            scan_chunk: int = 8) -> TrainState:
+            scan_chunk: int = 8, checkpointer=None,
+            checkpoint_every: int = 0) -> TrainState:
         """Streaming fit over ANY batch iterator.
 
         Default path: ``scan_chunk`` same-shape batches are stacked into ONE
@@ -295,14 +321,24 @@ class Trainer:
         batches run per-step automatically, so iterators with varying batch
         shapes stay correct (each shape still compiles once). A per-step
         ``callback`` (or ``scan_chunk<=1``) forces the per-step loop.
+
+        ``checkpointer`` (a ``parallel.AsyncCheckpointer``) +
+        ``checkpoint_every``: full train state (params/opt_state/step/
+        batch_stats) is snapshotted every N steps and written in the
+        checkpointer's background thread — training never stalls on disk.
+        The final state is always saved; resume via
+        ``restore_checkpoint`` + ``Trainer.resume_state``.
         """
         it = iter(batch_iter)
+        ckpt_due = self._ckpt_writer(checkpointer, checkpoint_every)
         if callback is not None or scan_chunk <= 1 or max_steps <= 1:
             meter = _ThroughputMeter(self, state.params)
+            i = -1
             for i in range(max_steps):
                 try:
                     batch = next(it)  # never pull past max_steps batches
                 except StopIteration:
+                    i -= 1
                     break
                 state, metrics = self.train_step(state, batch)
                 meter.observe(batch, steps=1)
@@ -310,12 +346,30 @@ class Trainer:
                     callback(i, metrics)
                 if (i + 1) % log_every == 0:
                     self._metrics.append(meter.entry(float(metrics["loss"])))
+                ckpt_due(state, i + 1)
+            ckpt_due(state, i + 1, final=True)
             return state
-        return self._fit_chunked(state, it, max_steps, scan_chunk, log_every)
+        return self._fit_chunked(state, it, max_steps, scan_chunk, log_every,
+                                 ckpt_due)
+
+    def _ckpt_writer(self, checkpointer, every: int):
+        """Periodic full-state async snapshots (no-op without a checkpointer)."""
+        last = [0]
+
+        def due(state: TrainState, steps_done: int, final: bool = False):
+            if checkpointer is None or steps_done <= 0:
+                return
+            if final or (every > 0 and steps_done - last[0] >= every):
+                if final and last[0] == steps_done:
+                    return  # already saved at exactly this step
+                checkpointer.save(state.as_dict(), step=int(state.step))
+                last[0] = steps_done
+
+        return due
 
     def _fit_chunked(self, state: TrainState, it: Iterator[dict],
                      max_steps: int, scan_chunk: int,
-                     log_every: int = 50) -> TrainState:
+                     log_every: int = 50, ckpt_due=None) -> TrainState:
         import queue
         import threading
 
@@ -403,6 +457,10 @@ class Trainer:
                 if steps_done - logged_at >= log_every or steps_done >= max_steps:
                     self._metrics.append(meter.entry(loss))
                     logged_at = steps_done
+                if ckpt_due is not None:
+                    ckpt_due(state, steps_done)
+            if ckpt_due is not None:
+                ckpt_due(state, steps_done, final=True)
         finally:
             stop.set()
         return state

@@ -15,7 +15,8 @@ from .batching import (
     round_up_to_multiple,
     unpad,
 )
-from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from .checkpoint import (AsyncCheckpointer, latest_step,
+                         restore_checkpoint, save_checkpoint)
 from .mesh import MeshConfig, MeshContext, P, create_mesh, logical_axis_rules, shard_params
 
 __all__ = [
@@ -23,6 +24,6 @@ __all__ = [
     "worker_rendezvous",
     "DoubleBufferedFeeder", "PaddedBatch", "batches", "bucket_size", "pad_batch",
     "pad_sequences", "round_up_to_multiple", "unpad",
-    "latest_step", "restore_checkpoint", "save_checkpoint",
+    "AsyncCheckpointer", "latest_step", "restore_checkpoint", "save_checkpoint",
     "MeshConfig", "MeshContext", "P", "create_mesh", "logical_axis_rules", "shard_params",
 ]

