@@ -31,6 +31,24 @@ class DeepTextClassifier(WrapperBase):
     def getCheckpoint(self):
         return self._get('checkpoint')
 
+    def setCheckpointDir(self, value):
+        return self._set('checkpoint_dir', value)
+
+    def getCheckpointDir(self):
+        return self._get('checkpoint_dir')
+
+    def setCheckpointEvery(self, value):
+        return self._set('checkpoint_every', value)
+
+    def getCheckpointEvery(self):
+        return self._get('checkpoint_every')
+
+    def setCheckpointKeep(self, value):
+        return self._set('checkpoint_keep', value)
+
+    def getCheckpointKeep(self):
+        return self._get('checkpoint_keep')
+
     def setGradAccum(self, value):
         return self._set('grad_accum', value)
 
@@ -222,6 +240,24 @@ class DeepVisionClassifier(WrapperBase):
 
     def getBatchSize(self):
         return self._get('batch_size')
+
+    def setCheckpointDir(self, value):
+        return self._set('checkpoint_dir', value)
+
+    def getCheckpointDir(self):
+        return self._get('checkpoint_dir')
+
+    def setCheckpointEvery(self, value):
+        return self._set('checkpoint_every', value)
+
+    def getCheckpointEvery(self):
+        return self._get('checkpoint_every')
+
+    def setCheckpointKeep(self, value):
+        return self._set('checkpoint_keep', value)
+
+    def getCheckpointKeep(self):
+        return self._get('checkpoint_keep')
 
     def setImageCol(self, value):
         return self._set('image_col', value)

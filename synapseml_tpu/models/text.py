@@ -21,7 +21,8 @@ from ..parallel.batching import batches
 from ..parallel.mesh import MeshConfig, MeshContext, create_mesh
 from .flax_nets.bert import BertClassifier, bert_base, bert_tiny
 from .tokenizer import resolve_tokenizer
-from .trainer import Trainer, TrainerConfig, TrainState, fit_arrays, plan_fit
+from .trainer import (Trainer, TrainerConfig, TrainState,
+                      _fit_with_optional_checkpointing, fit_arrays, plan_fit)
 
 __all__ = ["DeepTextClassifier", "DeepTextModel"]
 
@@ -73,6 +74,18 @@ class DeepTextClassifier(Estimator, _TextParams):
                        "(horovod backward_passes_per_step analog)", default=1,
                        converter=TypeConverters.to_int)
     seed = Param("seed", "init seed", default=0, converter=TypeConverters.to_int)
+    checkpoint_dir = Param("checkpoint_dir", "when set, write async training "
+                           "checkpoints here (reference pytorch-lightning "
+                           "ModelCheckpoint role); resume via "
+                           "parallel.restore_checkpoint + Trainer.resume_state",
+                           default=None)
+    checkpoint_every = Param("checkpoint_every", "checkpoint every N optimizer "
+                             "steps — the fused scan chunk shrinks to N "
+                             "when smaller (0 = only the final state)", default=0,
+                             converter=TypeConverters.to_int)
+    checkpoint_keep = Param("checkpoint_keep", "retain the most recent K "
+                            "checkpoints", default=3,
+                            converter=TypeConverters.to_int)
     attn_impl = Param("attn_impl", "attention backend: einsum | flash | ring "
                       "| ulysses (None = architecture default; ring/ulysses "
                       "need a mesh with a seq axis > 1; ulysses also needs "
@@ -140,8 +153,11 @@ class DeepTextClassifier(Estimator, _TextParams):
             freeze_predicate=self._freeze_predicate(cfg.n_layers),
         )
         trainer = Trainer(module, mesh, tcfg)
-        state = fit_arrays(trainer, data, batch_size=bs, total_steps=total,
-                           seed=self.get("seed"), init_params=init_params)
+        state = _fit_with_optional_checkpointing(
+            self, lambda ck, every: fit_arrays(
+                trainer, data, batch_size=bs, total_steps=total,
+                seed=self.get("seed"), init_params=init_params,
+                checkpointer=ck, checkpoint_every=every))
 
         host_params = jax.tree.map(np.asarray, state.params)
         # always persist the arch: a preset's meaning may evolve (e.g. the

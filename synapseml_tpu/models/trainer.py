@@ -330,6 +330,10 @@ class Trainer:
         ``restore_checkpoint`` + ``Trainer.resume_state``.
         """
         it = iter(batch_iter)
+        if checkpointer is not None and 0 < checkpoint_every < scan_chunk:
+            # checkpoints can only happen between dispatches; honor the
+            # requested durability by shrinking the fused chunk
+            scan_chunk = checkpoint_every
         ckpt_due = self._ckpt_writer(checkpointer, checkpoint_every)
         if callback is not None or scan_chunk <= 1 or max_steps <= 1:
             meter = _ThroughputMeter(self, state.params)
@@ -521,9 +525,22 @@ def plan_fit(n: int, batch_size: int, epochs: int, max_steps: int) -> tuple[int,
     return bs, total
 
 
+def _fit_with_optional_checkpointing(stage, fit_fn):
+    """Run a fit under an AsyncCheckpointer when checkpoint_dir is set
+    (reference pytorch-lightning ModelCheckpoint role); fit_fn(ck, every)."""
+    ckpt_dir = stage.get("checkpoint_dir")
+    if not ckpt_dir:
+        return fit_fn(None, 0)
+    from ..parallel.checkpoint import AsyncCheckpointer
+
+    with AsyncCheckpointer(ckpt_dir, keep=stage.get("checkpoint_keep")) as ck:
+        return fit_fn(ck, stage.get("checkpoint_every"))
+
+
 def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: int,
                seed: int, init_params=None, init_batch_stats=None,
-               scan_chunk: int = 8) -> "TrainState":
+               scan_chunk: int = 8, checkpointer=None,
+               checkpoint_every: int = 0) -> "TrainState":
     """Shared estimator fit loop: shuffling epochs over host arrays with
     mesh-aligned padded batches (one place for batch alignment, so any
     (batch_size, n, #devices) combination shards — batches are padded to a
@@ -564,4 +581,5 @@ def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: 
     # short tail runs per-step) — this wrapper only adds shuffling epochs,
     # mesh-padded batches, and state init.
     return trainer.fit(state, chain(), max_steps=total_steps,
-                       scan_chunk=scan_chunk)
+                       scan_chunk=scan_chunk, checkpointer=checkpointer,
+                       checkpoint_every=checkpoint_every)

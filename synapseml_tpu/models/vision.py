@@ -19,7 +19,8 @@ from ..parallel.batching import batches
 from ..parallel.mesh import MeshConfig, create_mesh
 from .flax_nets.resnet import resnet18, resnet50, resnet_tiny
 from .flax_nets.vit import ViTClassifier, vit_b16, vit_tiny
-from .trainer import Trainer, TrainerConfig, fit_arrays, plan_fit
+from .trainer import (Trainer, TrainerConfig,
+                      _fit_with_optional_checkpointing, fit_arrays, plan_fit)
 
 __all__ = ["DeepVisionClassifier", "DeepVisionModel"]
 
@@ -75,6 +76,18 @@ class DeepVisionClassifier(Estimator, _VisionParams):
     max_steps = Param("max_steps", "hard step cap (-1 = epochs)", default=-1,
                       converter=TypeConverters.to_int)
     seed = Param("seed", "init seed", default=0, converter=TypeConverters.to_int)
+    checkpoint_dir = Param("checkpoint_dir", "when set, write async training "
+                           "checkpoints here (reference pytorch-lightning "
+                           "ModelCheckpoint role); resume via "
+                           "parallel.restore_checkpoint + Trainer.resume_state",
+                           default=None)
+    checkpoint_every = Param("checkpoint_every", "checkpoint every N optimizer "
+                             "steps — the fused scan chunk shrinks to N "
+                             "when smaller (0 = only the final state)", default=0,
+                             converter=TypeConverters.to_int)
+    checkpoint_keep = Param("checkpoint_keep", "retain the most recent K "
+                            "checkpoints", default=3,
+                            converter=TypeConverters.to_int)
     mesh_config = ComplexParam("mesh_config", "MeshConfig override", default=None)
 
     def _fit(self, df: DataFrame) -> "DeepVisionModel":
@@ -107,9 +120,12 @@ class DeepVisionClassifier(Estimator, _VisionParams):
                                         total_steps=total, lr_schedule="cosine",
                                         warmup_steps=max(total // 10, 1)),
                           has_batch_stats=has_bn)
-        state = fit_arrays(trainer, {"x": images, "labels": labels},
-                           batch_size=bs, total_steps=total, seed=self.get("seed"),
-                           init_params=init_params, init_batch_stats=init_stats)
+        state = _fit_with_optional_checkpointing(
+            self, lambda ck, every: fit_arrays(
+                trainer, {"x": images, "labels": labels},
+                batch_size=bs, total_steps=total, seed=self.get("seed"),
+                init_params=init_params, init_batch_stats=init_stats,
+                checkpointer=ck, checkpoint_every=every))
 
         return DeepVisionModel(
             model_params=jax.tree.map(np.asarray, state.params),

@@ -214,3 +214,22 @@ def test_deep_vision_classifier_vit_backbone():
     out = model.transform(df)
     probs = np.asarray(list(out.collect_column("scores")))
     assert probs.shape == (16, 2) and np.all(np.isfinite(probs))
+
+
+def test_deep_text_classifier_checkpoint_dir(tmp_path):
+    """checkpoint_dir on the estimator writes async training checkpoints
+    (reference ModelCheckpoint role) with the final state always saved."""
+    from synapseml_tpu.core import DataFrame
+    from synapseml_tpu.models import DeepTextClassifier
+    from synapseml_tpu.parallel import latest_step, restore_checkpoint
+
+    rows = [{"text": "good fine", "label": 1},
+            {"text": "bad poor", "label": 0}] * 12
+    df = DataFrame.from_rows(rows)
+    DeepTextClassifier(checkpoint="bert-tiny", num_classes=2, batch_size=8,
+                       max_token_len=8, max_steps=6, learning_rate=3e-3,
+                       checkpoint_dir=str(tmp_path / "ck"),
+                       checkpoint_every=2).fit(df)
+    assert latest_step(str(tmp_path / "ck")) == 6
+    restored = restore_checkpoint(str(tmp_path / "ck"))
+    assert int(np.asarray(restored["step"])) == 6 and "opt_state" in restored
