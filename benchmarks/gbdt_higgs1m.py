@@ -8,12 +8,8 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).parent))
 sys.path.insert(0, str(Path(__file__).parent.parent))
 
-def main():
-    from _common import init_jax
-
-    jax, platform, n_chips = init_jax()
+def run(jax, platform, n_chips):
     from synapseml_tpu.gbdt.booster import train_booster
-    print("platform:", platform, flush=True)
     rng = np.random.default_rng(0)
     # full Higgs-1M shape on the chip; smoke scale elsewhere. AUC is computed
     # on a HELD-OUT tail (never passed to train_booster), not training rows.
@@ -38,10 +34,20 @@ def main():
     ranks = rankdata(auc_p)  # average tied ranks (exact Mann-Whitney)
     n1 = auc_y.sum(); n0 = len(auc_y) - n1
     auc = (ranks[auc_y == 1].sum() - n1*(n1+1)/2) / (n1*n0)
-    print(json.dumps({"metric": "LightGBM Higgs-1M train" if platform == "tpu"
-                      else "LightGBM 50k (CPU smoke)",
-                      "train_s": round(train_s, 2),
-                      "pred_rows": n_pred, "pred_s": round(pred_s, 3),
-                      "auc": round(float(auc), 4),
-                      "row_iters_per_sec": round(N * n_iter / train_s)}))
-main()
+    return {"metric": "LightGBM Higgs-1M train" if platform == "tpu"
+            else "LightGBM 50k (CPU smoke)",
+            "value": round(N * n_iter / train_s), "unit": "row-iters/sec",
+            "platform": platform, "train_s": round(train_s, 2),
+            "pred_rows": n_pred, "pred_s": round(pred_s, 3),
+            "auc": round(float(auc), 4)}
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    print(json.dumps(run(jax, platform, n_chips)))
+
+
+if __name__ == "__main__":
+    main()

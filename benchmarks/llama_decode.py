@@ -16,10 +16,7 @@ sys.path.insert(0, str(Path(__file__).parent))
 sys.path.insert(0, str(Path(__file__).parent.parent))
 
 
-def main():
-    from _common import init_jax
-
-    jax, platform, n_chips = init_jax()
+def run(jax, platform, n_chips):
     import jax.numpy as jnp
 
     from synapseml_tpu.models.flax_nets.llama import (LlamaLM, generate,
@@ -52,13 +49,21 @@ def main():
         trials.append(time.perf_counter() - t0)
     dt = min(trials)
     toks = B * new
-    print(json.dumps({
+    return {
         "metric": "Llama decode throughput" if on_tpu
                   else "Llama decode (CPU smoke)",
         "value": round(toks / dt, 1), "unit": "tokens/sec/chip",
         "platform": platform, "n_params": n_params, "batch": B,
         "prompt_len": P, "new_tokens": new,
-        "decode_ms_per_token": round(dt / new * 1e3, 2)}))
+        "decode_ms_per_token": round(dt / new * 1e3, 2)}
 
 
-main()
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    print(json.dumps(run(jax, platform, n_chips)))
+
+
+if __name__ == "__main__":
+    main()

@@ -10,10 +10,7 @@ sys.path.insert(0, str(Path(__file__).parent.parent))
 sys.path.insert(0, str(Path(__file__).parent.parent / "tests"))
 
 
-def main():
-    from _common import init_jax
-
-    jax, platform, n_chips = init_jax()
+def run(jax, platform, n_chips):
     import torch
     from _torch_resnet import export_onnx_bytes, resnet50, resnet_small
     from synapseml_tpu.onnx import convert_graph
@@ -33,9 +30,18 @@ def main():
         t0 = time.perf_counter()
         np.asarray(fn(x))
         best = min(best, time.perf_counter() - t0)
-    print(json.dumps({"metric": "ONNX ResNet-50 inference" if on_tpu
-                      else "ONNX resnet-small (CPU smoke)",
-                      "value": round(B / best, 1), "unit": "imgs/sec",
-                      "batch": B, "image": S}))
+    return {"metric": "ONNX ResNet-50 inference" if on_tpu
+            else "ONNX resnet-small (CPU smoke)",
+            "value": round(B / best, 1), "unit": "imgs/sec",
+            "platform": platform, "batch": B, "image": S}
 
-main()
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    print(json.dumps(run(jax, platform, n_chips)))
+
+
+if __name__ == "__main__":
+    main()

@@ -8,10 +8,7 @@ sys.path.insert(0, str(Path(__file__).parent))
 sys.path.insert(0, str(Path(__file__).parent.parent))
 
 
-def main():
-    from _common import init_jax
-
-    jax, platform, n_chips = init_jax()
+def run(jax, platform, n_chips):
     from synapseml_tpu.models.flax_nets.vit import ViTClassifier, vit_b16, vit_tiny
     from synapseml_tpu.models.trainer import Trainer, TrainerConfig
     from synapseml_tpu.parallel.mesh import MeshConfig, create_mesh
@@ -37,9 +34,18 @@ def main():
         st, m = tr.train_steps_scan(st, stacked)
         np.asarray(m["loss"])
         best = min(best, time.perf_counter() - t0)
-    print(json.dumps({"metric": "ViT-B/16 fine-tune" if on_tpu else "vit-tiny (CPU smoke)",
-                      "value": round(B * k / best / n_chips, 2),
-                      "unit": "samples/sec/chip", "n_chips": n_chips,
-                      "step_ms": round(best / k * 1e3, 2)}))
+    return {"metric": "ViT-B/16 fine-tune" if on_tpu else "vit-tiny (CPU smoke)",
+            "value": round(B * k / best / n_chips, 2),
+            "unit": "samples/sec/chip", "platform": platform,
+            "n_chips": n_chips, "step_ms": round(best / k * 1e3, 2)}
 
-main()
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    print(json.dumps(run(jax, platform, n_chips)))
+
+
+if __name__ == "__main__":
+    main()
