@@ -114,6 +114,50 @@ def _make_schedule(cfg: TrainerConfig):
     return cfg.learning_rate
 
 
+def _align_restored(fresh, got, path: str):
+    """Yield restored leaves in ``fresh``'s flatten order (jax sorts dict
+    keys; sequences are positional), matching dict children BY KEY so a
+    serialized container whose iteration order differs from the live
+    state's flatten order cannot silently swap same-shaped leaves.
+    Validates container kinds and leaf shapes, with the failing path in
+    every error."""
+    if isinstance(fresh, dict):
+        if not isinstance(got, dict):
+            raise ValueError(f"{path}: expected a dict, restored "
+                             f"{type(got).__name__}")
+        if set(got) != set(fresh):
+            missing = sorted(set(fresh) - set(got))
+            extra = sorted(set(got) - set(fresh))
+            raise ValueError(f"{path}: restored dict keys differ "
+                             f"(missing {missing}, extra {extra})")
+        for k in sorted(fresh):  # jax.tree flatten order for dicts
+            yield from _align_restored(fresh[k], got[k], f"{path}[{k!r}]")
+    elif isinstance(fresh, (list, tuple)):  # incl. optax NamedTuple states
+        if not isinstance(got, (list, tuple)):
+            raise ValueError(f"{path}: expected a sequence, restored "
+                             f"{type(got).__name__}")
+        if len(got) != len(fresh):
+            raise ValueError(
+                f"{path}: restored sequence has {len(got)} children but "
+                f"this optimizer expects {len(fresh)} — optimizer config "
+                "changed since the checkpoint was written")
+        names = getattr(type(fresh), "_fields", None)
+        for i, (f, g) in enumerate(zip(fresh, got)):
+            label = names[i] if names else i
+            yield from _align_restored(f, g, f"{path}.{label}")
+    elif fresh is None:
+        if got is not None:
+            raise ValueError(f"{path}: expected an empty node, restored "
+                             f"{type(got).__name__}")
+    else:  # leaf: ShapeDtypeStruct from eval_shape
+        if tuple(np.shape(got)) != tuple(fresh.shape):
+            raise ValueError(
+                f"{path}: restored leaf shape {np.shape(got)} != expected "
+                f"{tuple(fresh.shape)} — params/optimizer mismatch with "
+                "the checkpoint")
+        yield got
+
+
 def _make_optimizer(cfg: TrainerConfig, params) -> optax.GradientTransformation:
     tx = optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip),
@@ -167,9 +211,12 @@ class Trainer:
 
         A serialized ``opt_state`` comes back as plain tuples/dicts (the
         npz round-trip keeps order but not optax's NamedTuple node types);
-        its leaves are poured back into a freshly initialized optimizer
-        structure, with shape validation, so optax transforms see their own
-        state classes again."""
+        its leaves are matched STRUCTURALLY against a freshly initialized
+        optimizer skeleton — dict children by key (order-insensitive, so a
+        dict whose serialized order differs from jax's sorted flatten order
+        cannot silently swap same-shaped leaves like Adam's mu/nu),
+        sequence children by position — then poured into the skeleton so
+        optax transforms see their own state classes again."""
         self.ensure_optimizer(params)
         if opt_state is None:
             opt_state = self._tx.init(params)
@@ -178,20 +225,9 @@ class Trainer:
             # (a real init would materialize ~2x-param Adam moments just to
             # throw them away — an OOM risk on 7B-class resumes)
             fresh = jax.eval_shape(self._tx.init, params)
-            fresh_leaves, treedef = jax.tree.flatten(fresh)
-            leaves = jax.tree.leaves(opt_state)
-            if len(leaves) != len(fresh_leaves):
-                raise ValueError(
-                    f"restored opt_state has {len(leaves)} leaves but this "
-                    f"optimizer expects {len(fresh_leaves)} — optimizer "
-                    "config changed since the checkpoint was written")
-            for got, want in zip(leaves, fresh_leaves):
-                if tuple(np.shape(got)) != tuple(want.shape):
-                    raise ValueError(
-                        f"restored opt_state leaf shape {np.shape(got)} != "
-                        f"expected {want.shape} — params/optimizer "
-                        "mismatch with the checkpoint")
-            opt_state = jax.tree.unflatten(treedef, leaves)
+            _, treedef = jax.tree.flatten(fresh)
+            opt_state = jax.tree.unflatten(
+                treedef, list(_align_restored(fresh, opt_state, "opt_state")))
         return TrainState(params=params, opt_state=opt_state,
                           step=jnp.asarray(step, jnp.int32), batch_stats=batch_stats)
 

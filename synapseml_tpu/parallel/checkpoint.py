@@ -80,17 +80,22 @@ def restore_checkpoint(path: str, step: int | None = None, sharding_fn=None) -> 
 class AsyncCheckpointer:
     """Checkpoint writes that overlap with training.
 
-    ``save`` snapshots the pytree to host memory synchronously (device
-    buffers may be donated/mutated by the very next step, so the copy cannot
-    be deferred) and hands serialization + fsync to a single background
-    thread — the train loop resumes while the disk write runs, the
-    TPU-idiomatic replacement for the reference's synchronous
-    pytorch-lightning ModelCheckpoint. One worker thread keeps saves ordered;
-    ``keep`` retains only the most recent completed checkpoints (top-k
-    retention, like the reference's ``save_top_k``).
+    ``save`` is non-blocking on the device→host transfer: device leaves get
+    an async on-device copy (``jnp.copy`` — an enqueued dispatch, so the
+    caller may donate or mutate its own state the moment ``save`` returns)
+    with ``copy_to_host_async`` started immediately; the blocking
+    ``np.asarray`` fetch AND serialization + fsync run on a single
+    background thread. This is the TPU-idiomatic replacement for the
+    reference's synchronous pytorch-lightning ModelCheckpoint. Backpressure
+    mirrors orbax's AsyncCheckpointer: at most ONE write is in flight — a
+    ``save`` while the previous write is still running blocks until it
+    completes (surfacing its error), so snapshots can never queue
+    unboundedly and OOM the host on 7B-class states. One worker thread
+    keeps saves ordered; ``keep`` retains only the most recent completed
+    checkpoints (top-k retention, like the reference's ``save_top_k``).
 
     Call ``wait()`` (or use as a context manager) before reading checkpoints
-    or exiting — write errors surface there, not at ``save`` time.
+    or exiting — the last write's errors surface there.
     """
 
     def __init__(self, path: str, keep: int = 3, use_orbax: bool = False):
@@ -100,18 +105,35 @@ class AsyncCheckpointer:
         self.keep = keep
         self.use_orbax = use_orbax
         self._exec = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-        self._pending: list = []
+        self._inflight: concurrent.futures.Future | None = None
 
     def save(self, tree: Any, step: int):
-        """Snapshot now, write in the background; returns the Future."""
-        # np.array (not asarray) forces a copy even for host-numpy leaves, so
-        # callers may mutate their buffers the moment save() returns
-        host_tree = jax.tree.map(lambda x: np.array(x), tree)
-        fut = self._exec.submit(self._write, host_tree, step)
-        self._pending.append(fut)
-        return fut
+        """Snapshot (async dispatches only), write in the background;
+        returns the Future. Blocks first iff the previous write is still
+        running (single-pending backpressure)."""
+        self.wait()  # at most one write in flight; surfaces prior errors
 
-    def _write(self, host_tree: Any, step: int) -> str:
+        import jax.numpy as jnp
+
+        def snap(x):
+            if isinstance(x, jax.Array):
+                c = jnp.copy(x)  # async device-side copy; donation-safe
+                try:
+                    c.copy_to_host_async()  # start DMA; worker blocks on it
+                except Exception:
+                    pass  # some backends/shardings lack the fast path
+                return c
+            # np.array (not asarray) forces a copy for host-numpy leaves, so
+            # callers may mutate their buffers the moment save() returns
+            return np.array(x)
+
+        snapshot = jax.tree.map(snap, tree)
+        self._inflight = self._exec.submit(self._write, snapshot, step)
+        return self._inflight
+
+    def _write(self, snapshot: Any, step: int) -> str:
+        # the blocking device→host fetch happens HERE, off the train loop
+        host_tree = jax.tree.map(lambda x: np.asarray(x), snapshot)
         target = save_checkpoint(self.path, host_tree, step,
                                  use_orbax=self.use_orbax)
         self._gc()
@@ -126,18 +148,11 @@ class AsyncCheckpointer:
             shutil.rmtree(_step_dir(self.path, step), ignore_errors=True)
 
     def wait(self) -> None:
-        """Block until ALL queued writes finish; then re-raise the first
-        error (later writes are never left running or silently dropped)."""
-        pending, self._pending = self._pending, []
-        first_err = None
-        for fut in pending:
-            try:
-                fut.result()
-            except BaseException as e:
-                if first_err is None:
-                    first_err = e
-        if first_err is not None:
-            raise first_err
+        """Block until the in-flight write (if any) finishes; re-raises its
+        error. With single-pending backpressure there is at most one."""
+        fut, self._inflight = self._inflight, None
+        if fut is not None:
+            fut.result()
 
     def close(self) -> None:
         self.wait()

@@ -206,3 +206,54 @@ def test_async_checkpointer_overlap_retention_and_errors(tmp_path):
     bad.save({"x": np.zeros(2)}, 0)
     with pytest.raises(Exception):
         bad.wait()
+
+
+def test_async_checkpointer_nonblocking_save_and_backpressure(tmp_path, monkeypatch):
+    """save() must return without waiting for the disk write (the device→host
+    fetch + serialization run on the worker), and a second save() while a
+    write is in flight must BLOCK until it completes — never queue a second
+    host snapshot (the OOM mode on 7B-class states)."""
+    import time
+
+    import jax.numpy as jnp
+
+    from synapseml_tpu.parallel import checkpoint as cp
+
+    real_save = cp.save_checkpoint
+    delay = 0.4
+
+    def slow_save(path, tree, step=0, use_orbax=None):
+        time.sleep(delay)
+        return real_save(path, tree, step, use_orbax=use_orbax)
+
+    monkeypatch.setattr(cp, "save_checkpoint", slow_save)
+
+    tree = {"w": jnp.zeros((64, 64), jnp.float32), "b": np.float32(1.0)}
+    with cp.AsyncCheckpointer(str(tmp_path / "bp"), keep=10) as ck:
+        t0 = time.perf_counter()
+        fut0 = ck.save(tree, 0)
+        t_first = time.perf_counter() - t0
+        assert t_first < delay / 2, f"save() blocked {t_first:.3f}s on the write"
+
+        t0 = time.perf_counter()
+        ck.save(tree, 1)
+        t_second = time.perf_counter() - t0
+        # backpressure: the second save waited out write 0 before snapshotting
+        assert t_second >= delay * 0.6, f"second save returned in {t_second:.3f}s"
+        assert fut0.done(), "write 0 still pending after save(1) returned"
+    assert cp.latest_step(str(tmp_path / "bp")) == 1
+    restored = cp.restore_checkpoint(str(tmp_path / "bp"))
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.zeros((64, 64)))
+
+
+def test_async_checkpointer_error_surfaces_at_next_save():
+    """With single-pending backpressure, a failed write's error is raised by
+    the NEXT save (not silently dropped until close)."""
+    import pytest
+
+    from synapseml_tpu.parallel import AsyncCheckpointer
+
+    ck = AsyncCheckpointer("/proc/definitely/not/writable", keep=1)
+    ck.save({"x": np.zeros(2)}, 0)
+    with pytest.raises(Exception):
+        ck.save({"x": np.zeros(2)}, 1)

@@ -159,3 +159,38 @@ def test_fit_periodic_async_checkpointing(tmp_path, mesh_dp8):
         tr3.fit(s3, iter(batches[:3]), max_steps=5, callback=lambda i, m: None,
                 checkpointer=ck, checkpoint_every=2)
     assert latest_step(str(tmp_path / "stepwise")) == 3  # finite iter: final save
+
+
+def test_align_restored_matches_dicts_by_key_not_position():
+    """resume_state's structural pour: dict children match BY KEY (so a
+    serialized iteration order differing from jax's sorted flatten order
+    cannot swap same-shaped leaves), kinds/shapes/keys are validated with
+    the failing path in the error."""
+    import pytest
+
+    from synapseml_tpu.models.trainer import _align_restored
+
+    class Pair(tuple):
+        pass  # stand-in: plain tuples model deserialized NamedTuples
+
+    fresh = (
+        {"mu": jax.ShapeDtypeStruct((2,), np.float32),
+         "nu": jax.ShapeDtypeStruct((2,), np.float32)},
+        jax.ShapeDtypeStruct((), np.int32),
+    )
+    # restored dict built in REVERSED insertion order: nu first, then mu
+    got = ({"nu": np.array([3.0, 4.0], np.float32),
+            "mu": np.array([1.0, 2.0], np.float32)}, np.int32(7))
+    leaves = list(_align_restored(fresh, got, "opt"))
+    # flatten order is sorted keys: mu then nu — values must follow keys
+    np.testing.assert_array_equal(leaves[0], [1.0, 2.0])
+    np.testing.assert_array_equal(leaves[1], [3.0, 4.0])
+    assert leaves[2] == 7
+
+    with pytest.raises(ValueError, match=r"missing \['mu'\]"):
+        list(_align_restored(fresh, ({"nu": got[0]["nu"]}, got[1]), "opt"))
+    with pytest.raises(ValueError, match="expects 2"):
+        list(_align_restored(fresh, (got[0],), "opt"))
+    bad_shape = ({"mu": np.zeros(3, np.float32), "nu": got[0]["nu"]}, got[1])
+    with pytest.raises(ValueError, match=r"opt\.0\['mu'\]"):
+        list(_align_restored(fresh, bad_shape, "opt"))
