@@ -180,6 +180,16 @@ def _matmul(ins, attrs):
     return jnp.matmul(ins[0], ins[1])
 
 
+@op("Einsum")
+def _einsum(ins, attrs):
+    # torch exports einsum attention (bthd,bshd->bhts) as one Einsum node;
+    # XLA maps it straight onto MXU dot_generals
+    eq = attrs["equation"]
+    if isinstance(eq, bytes):
+        eq = eq.decode("utf-8")
+    return jnp.einsum(eq, *ins)
+
+
 @op("Gemm")
 def _gemm(ins, attrs):
     a, b = ins[0], ins[1]
@@ -412,6 +422,13 @@ def _slice(ins, attrs):
 
 @op("Gather")
 def _gather(ins, attrs):
+    if _host_i64([ins[0]]):
+        # shape-math chain (Shape -> Gather -> Range/Reshape): stay host
+        # numpy so consumers see static ints, not traced scalars (asarray:
+        # np.take with a 0-d index yields a np scalar, which would fail the
+        # downstream _host_i64 ndarray check)
+        return np.asarray(np.take(ins[0], np.asarray(ins[1]).astype(np.int64),
+                                  axis=attrs.get("axis", 0)))
     return jnp.take(ins[0], jnp.asarray(ins[1]).astype(jnp.int32),
                     axis=attrs.get("axis", 0))
 
@@ -484,7 +501,13 @@ def _dropout(ins, attrs):
 def _constant(ins, attrs):
     for key in ("value", "value_float", "value_int", "value_floats", "value_ints"):
         if key in attrs and attrs[key] is not None:
-            return jnp.asarray(attrs[key])
+            v = np.asarray(attrs[key])
+            if v.dtype in (np.int64, np.uint64):
+                # host numpy, like int64 initializers: these are shape/index
+                # constants; jnp.asarray would stage an int64->int32 convert
+                # under jit (a tracer), breaking static shape-math consumers
+                return v
+            return jnp.asarray(v)
     raise ValueError("Constant node without value attribute")
 
 
