@@ -20,13 +20,27 @@ import json
 import queue
 import threading
 import uuid
+import socket
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from ..core.dataframe import DataFrame
 
-__all__ = ["ServingServer", "serve_pipeline"]
+__all__ = ["ServingServer", "serve_pipeline", "NoDelayHTTPServer"]
+
+
+class NoDelayHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that sets TCP_NODELAY on every accepted socket.
+    With HTTP/1.1 keep-alive, Nagle + the peer's delayed ACK turns each
+    small-write response into a ~40 ms stall; sub-millisecond serving (the
+    reference's claim) requires segments to go out immediately. Enforced
+    here at accept time so no Handler class can forget it."""
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock, addr
 
 
 class _Exchange:
@@ -68,6 +82,12 @@ class ServingServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: connections persist across requests — the per-request
+            # TCP handshake is most of the loopback round-trip (the
+            # reference's JVMSharedServer keeps executor sockets open too);
+            # the server sets TCP_NODELAY at accept (NoDelayHTTPServer)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet
                 pass
 
@@ -84,6 +104,7 @@ class ServingServer:
                     with outer._lock:
                         outer._pending.pop(ex.request_id, None)
                     self.send_response(503)  # shed load under backpressure
+                    self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
                 ok = ex.reply_event.wait(outer.reply_timeout_s)
@@ -91,11 +112,13 @@ class ServingServer:
                     outer._pending.pop(ex.request_id, None)
                 if not ok:
                     self.send_response(504)
+                    self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
                 self.send_response(ex.reply_status)
                 for k, v in ex.reply_headers.items():
-                    self.send_header(k, v)
+                    if k.lower() != "content-length":  # we set the real one
+                        self.send_header(k, v)
                 self.send_header("Content-Length", str(len(ex.reply_body)))
                 self.end_headers()
                 self.wfile.write(ex.reply_body)
@@ -106,7 +129,7 @@ class ServingServer:
             def do_POST(self):
                 self._handle("POST")
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server = NoDelayHTTPServer((host, port), Handler)
         self.host, self.port = self._server.server_address[:2]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._running = False

@@ -198,3 +198,61 @@ def test_distributed_serving_chaos_worker_killed_and_rejoins():
         assert victim.pid not in seen
     finally:
         handle.stop()
+
+
+def test_keepalive_routes_and_routing_client():
+    """Round-4 serving upgrades: HTTP/1.1 keep-alive end-to-end (one client
+    connection serves many requests through the front's pooled worker
+    connections), GET /routes exposes the live table, and RoutingClient
+    serves where-it-lands (direct worker hits, zero proxy hops) with
+    failover when a worker dies."""
+    import http.client
+
+    from synapseml_tpu.io.distributed_serving import RoutingClient
+
+    handle = serve_pipeline_distributed(EchoPid(), num_workers=2,
+                                        batch_interval_ms=0)
+    try:
+        host, port = handle.address.split("//")[1].split(":")
+        # one persistent connection, many requests (keep-alive front)
+        conn = http.client.HTTPConnection(host, int(port), timeout=30)
+        pids = set()
+        for i in range(6):
+            conn.request("POST", "/", body=json.dumps({"i": i}).encode())
+            r = conn.getresponse()
+            assert r.status == 200
+            pids.add(json.loads(r.read())["pid"])
+        conn.close()
+        assert len(pids) >= 2  # still round-robins across workers
+
+        # /routes: the live table, served by the front itself
+        with urllib.request.urlopen(handle.address + "/routes",
+                                    timeout=30) as r:
+            table = json.loads(r.read())
+        assert len(table) == 2 and all("port" in w for w in table)
+
+        # client-side routing straight to workers
+        client = RoutingClient(front_address=handle.address)
+        seen = set()
+        for i in range(6):
+            status, payload = client.request(
+                "/", body=json.dumps({"i": i}).encode())
+            assert status == 200
+            seen.add(json.loads(payload)["pid"])
+        assert len(seen) >= 2
+
+        # failover: kill one worker; the client keeps serving via the other
+        handle.procs[0].kill()
+        handle.procs[0].wait()
+        ok = 0
+        for i in range(8):
+            try:
+                status, _ = client.request(
+                    "/", body=json.dumps({"i": i}).encode())
+                ok += int(status == 200)
+            except ConnectionError:
+                pass
+        assert ok >= 6  # at most the in-flight rotation misses
+        client.close()
+    finally:
+        handle.stop()
