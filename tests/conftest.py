@@ -75,6 +75,14 @@ def pytest_addoption(parser):
                      help="also run tests marked slow (the full lane; the "
                           "default lane skips them — reference analog: the "
                           "lightgbm split1-6 CI sharding)")
+    parser.addoption("--check-slow-manifest", action="store_true", default=False,
+                     help="with --runslow: measure per-test durations, "
+                          "regenerate resources/slow_tests.txt, and FAIL the "
+                          "session on drift (a newly-slow test missing from "
+                          "the manifest, or a stale nodeid)")
+    parser.addoption("--lane-budget", type=float, default=0.0, metavar="SECONDS",
+                     help="fail the session if total test wall time exceeds "
+                          "this budget (default-lane target: 480)")
 
 
 def pytest_configure(config):
@@ -103,3 +111,78 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords or item.nodeid in manifest:
             item.add_marker(skip)
+
+
+# ---- slow-manifest drift check + lane budget (VERDICT r3 next-#5) --------
+# The manifest is regenerated from MEASURED durations, not hand-maintained:
+#   pytest tests/ --runslow --check-slow-manifest -q
+# fails (exit 1) and rewrites resources/slow_tests.txt whenever a test
+# crossed the slow threshold without being listed or a listed nodeid no
+# longer exists — so the default lane cannot drift upward silently.
+
+SLOW_THRESHOLD_S = 8.0
+_durations: dict = {}
+_session_t0: list = []
+
+
+def pytest_runtest_logreport(report):
+    _durations[report.nodeid] = _durations.get(report.nodeid, 0.0) + report.duration
+
+
+def pytest_sessionstart(session):
+    import time
+
+    _session_t0.append(time.monotonic())
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import time
+
+    config = session.config
+    notes = []
+    full_run = False
+    if config.getoption("--check-slow-manifest"):
+        # only a FULL unfiltered --runslow run may regenerate the manifest:
+        # a partial run (test file args, -k, -m) would see un-run tests as
+        # "stale" and gut the manifest
+        tests_dir = os.path.dirname(os.path.abspath(__file__))
+        full_run = (config.getoption("--runslow")
+                    and not config.getoption("keyword")
+                    and not config.getoption("markexpr")
+                    and all(os.path.isdir(a.split("::")[0])
+                            for a in (config.args or [tests_dir])))
+        if not full_run:
+            notes.append("--check-slow-manifest ignored: not a full "
+                         "unfiltered --runslow run over the tests directory")
+    if full_run:
+        path = os.path.join(os.path.dirname(__file__), "resources",
+                            "slow_tests.txt")
+        measured_slow = {n for n, d in _durations.items()
+                         if d >= SLOW_THRESHOLD_S}
+        collected = set(_durations)
+        old = _slow_manifest()
+        stale = old - collected          # renamed/removed tests
+        missing = measured_slow - old    # newly-slow, unlisted
+        # hysteresis: keep listed tests that still take >= half the
+        # threshold, so borderline tests don't flap in and out
+        keep = {n for n in (old & collected)
+                if _durations.get(n, 0.0) >= SLOW_THRESHOLD_S / 2}
+        new = sorted(measured_slow | keep)
+        if missing or stale:
+            with open(path, "w") as f:
+                f.write("\n".join(new) + "\n")
+            notes.append(
+                f"slow-manifest DRIFT: {len(missing)} newly-slow unlisted "
+                f"{sorted(missing)}, {len(stale)} stale {sorted(stale)}; "
+                f"manifest regenerated — commit it")
+            session.exitstatus = 1
+    budget = config.getoption("--lane-budget")
+    if budget and _session_t0:
+        elapsed = time.monotonic() - _session_t0[0]
+        if elapsed > budget:
+            notes.append(f"lane budget EXCEEDED: {elapsed:.0f}s > {budget:.0f}s "
+                         "— move the offenders (pytest --durations=20) into "
+                         "resources/slow_tests.txt")
+            session.exitstatus = 1
+    for n in notes:
+        print(f"\n[conftest] {n}")

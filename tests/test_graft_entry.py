@@ -60,7 +60,10 @@ def test_entry_single_chip_compiles():
 def test_bench_cpu_smoke_emits_json():
     import json
 
-    r = _run("import bench; bench.main()", extra_env={"JAX_PLATFORMS": "cpu"},
+    # flagship only: the full rotation (5 CPU-smoke configs) belongs to the
+    # driver's bench run, not the test lane
+    r = _run("import bench; bench.main()",
+             extra_env={"JAX_PLATFORMS": "cpu", "BENCH_CONFIGS": "flagship"},
              timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
