@@ -31,6 +31,12 @@ class HuggingFaceCausalLM(WrapperBase):
     def getEosId(self):
         return self._get('eos_id')
 
+    def setGenerationParamsCol(self, value):
+        return self._set('generation_params_col', value)
+
+    def getGenerationParamsCol(self):
+        return self._get('generation_params_col')
+
     def setInputCol(self, value):
         return self._set('input_col', value)
 

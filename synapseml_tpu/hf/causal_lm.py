@@ -68,6 +68,14 @@ class HuggingFaceCausalLM(Transformer):
         "mesh_config", "MeshConfig for sharded inference: params shard over "
         "tensor/fsdp axes per the logical rules (the Llama-2-7B "
         "sharded-batch-inference BASELINE config)", default=None)
+    generation_params_col = Param(
+        "generation_params_col", "optional column of per-row dicts of "
+        "generate kwargs (max_new_tokens/do_sample/temperature/top_k/top_p/"
+        "seed/eos_id) overriding the transformer-level params — the "
+        "reference forwards per-call HF generate kwargs "
+        "(HuggingFaceCausalLMTransform.py:284-331). Rows are BUCKETED by "
+        "identical config so the jit cache stays bounded by the number of "
+        "distinct configs, not rows", default=None)
 
     _CACHE_KEYS = frozenset({"model_name", "model_params", "tokenizer",
                              "mesh_config", "max_new_tokens", "eos_id",
@@ -125,19 +133,36 @@ class HuggingFaceCausalLM(Transformer):
             self.__dict__["_cache_model"] = (model, params, tok, mesh)
         return self.__dict__["_cache_model"]
 
-    def _generate_fn(self, B: int, P: int):
+    _GEN_KEYS = ("max_new_tokens", "eos_id", "do_sample", "temperature",
+                 "top_k", "top_p", "seed")
+
+    def _effective_gen_cfg(self, overrides=None) -> dict:
+        """Transformer-level generation params overlaid with a per-row
+        override dict (the per-call generate-kwargs surface)."""
+        eff = {k: self.get(k) for k in self._GEN_KEYS}
+        if overrides:
+            unknown = sorted(set(overrides) - set(self._GEN_KEYS))
+            if unknown:
+                raise ValueError(
+                    f"unsupported generation params {unknown}; "
+                    f"supported: {list(self._GEN_KEYS)}")
+            eff.update(overrides)
+        eff["max_new_tokens"] = int(eff["max_new_tokens"])
+        return eff
+
+    def _generate_fn(self, B: int, P: int, eff: dict):
         import jax
 
-        key = ("gen", B, P, self.get("max_new_tokens"))
+        key = ("gen", B, P) + tuple(eff[k] for k in self._GEN_KEYS)
         cache = self.__dict__.setdefault("_cache_gen", {})
         if key not in cache:
             model, params, _, mesh = self._model_and_params()
 
-            sampling = self.get("do_sample")
-            temperature = float(self.get("temperature")) if sampling else 0.0
-            top_k = self.get("top_k")
-            top_p = self.get("top_p")
-            rng = jax.random.PRNGKey(self.get("seed")) if sampling else None
+            sampling = eff["do_sample"]
+            temperature = float(eff["temperature"]) if sampling else 0.0
+            top_k = eff["top_k"]
+            top_p = eff["top_p"]
+            rng = jax.random.PRNGKey(int(eff["seed"])) if sampling else None
 
             def fn(ids, mask, offset):
                 # fold the batch's global row offset into the stream so
@@ -145,8 +170,8 @@ class HuggingFaceCausalLM(Transformer):
                 # samples (same seed + same data stays reproducible)
                 r = None if rng is None else jax.random.fold_in(rng, offset)
                 return generate(model, params, ids,
-                                self.get("max_new_tokens"),
-                                eos_id=self.get("eos_id"),
+                                eff["max_new_tokens"],
+                                eos_id=eff["eos_id"],
                                 prompt_mask=mask,
                                 temperature=temperature,
                                 top_k=None if top_k is None else int(top_k),
@@ -181,39 +206,62 @@ class HuggingFaceCausalLM(Transformer):
     def _transform(self, df: DataFrame) -> DataFrame:
         mc = self.get("messages_col")
         self.require_columns(df, mc if mc else self.get("input_col"))
+        if self.get("generation_params_col"):
+            self.require_columns(df, self.get("generation_params_col"))
         model, params, tok, _mesh = self._model_and_params()
         B = self.get("batch_size")
         bucket = self.get("prompt_bucket")
+
+        pcol = self.get("generation_params_col")
+
+        def row_groups(p, n):
+            """[(override-dict-or-None, row indices)] — rows bucketed by
+            identical per-row config so each distinct config compiles once."""
+            if pcol is None:
+                return [(None, np.arange(n))]
+            buckets: dict = {}
+            for i, d in enumerate(p[pcol]):
+                d = dict(d) if d else {}
+                key = tuple(sorted(
+                    (k, tuple(v) if isinstance(v, list) else v)
+                    for k, v in d.items()))
+                buckets.setdefault(key, (d, []))[1].append(i)
+            return [(d, np.asarray(ix)) for d, ix in buckets.values()]
 
         def per_part(p, part_offset):
             n = len(next(iter(p.values()))) if p else 0
             if n == 0:
                 return None
             texts = self._texts_of(p)
-            enc = tok(texts, max_len=model.cfg.max_len -
-                      self.get("max_new_tokens"), multiple_of=bucket)
-            ids = np.asarray(enc["input_ids"], np.int32)
-            mask = np.asarray(enc["attention_mask"], np.int32)
-            P = ids.shape[1]
-            fn = self._generate_fn(B, P)
-            outs = []
-            for s in range(0, n, B):
-                e = min(s + B, n)
-                pad = B - (e - s)
-                ib = np.pad(ids[s:e], ((0, pad), (0, 0)))
-                mb = np.pad(mask[s:e], ((0, pad), (0, 0)), constant_values=1)
-                gen = np.asarray(fn(ib, mb, np.int32(part_offset + s)))[: e - s]
-                outs.append(gen[:, P:])                     # generated ids only
-            gen_ids = np.concatenate(outs, axis=0)
             col = np.empty(n, dtype=object)
             decode = getattr(tok, "decode", None)
-            for i in range(n):
-                toks = gen_ids[i]
-                if self.get("eos_id") is not None:
-                    stop = np.nonzero(toks == self.get("eos_id"))[0]
-                    if len(stop):
-                        toks = toks[: stop[0]]
-                col[i] = decode(toks.tolist()) if decode else toks
+            for overrides, ix in row_groups(p, n):
+                eff = self._effective_gen_cfg(overrides)
+                enc = tok([texts[i] for i in ix],
+                          max_len=model.cfg.max_len - eff["max_new_tokens"],
+                          multiple_of=bucket)
+                ids = np.asarray(enc["input_ids"], np.int32)
+                mask = np.asarray(enc["attention_mask"], np.int32)
+                P = ids.shape[1]
+                fn = self._generate_fn(B, P, eff)
+                outs = []
+                m = len(ix)
+                for s in range(0, m, B):
+                    e = min(s + B, m)
+                    pad = B - (e - s)
+                    ib = np.pad(ids[s:e], ((0, pad), (0, 0)))
+                    mb = np.pad(mask[s:e], ((0, pad), (0, 0)), constant_values=1)
+                    gen = np.asarray(fn(ib, mb,
+                                        np.int32(part_offset + int(ix[s]))))[: e - s]
+                    outs.append(gen[:, P:])                 # generated ids only
+                gen_ids = np.concatenate(outs, axis=0)
+                for j, i in enumerate(ix):
+                    toks = gen_ids[j]
+                    if eff["eos_id"] is not None:
+                        stop = np.nonzero(toks == eff["eos_id"])[0]
+                        if len(stop):
+                            toks = toks[: stop[0]]
+                    col[i] = decode(toks.tolist()) if decode else toks
             q = dict(p)
             q[self.get("output_col")] = col
             return q

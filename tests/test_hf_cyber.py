@@ -313,3 +313,39 @@ def test_llama2_7b_code_path_reduced_width():
     out = np.asarray(out)
     assert out.shape == (B, P + 4)
     assert np.all((out >= 0) & (out < 512))
+
+
+def test_per_row_generation_params_two_configs():
+    """Per-row generate kwargs (reference forwards per-call HF generate
+    kwargs, HuggingFaceCausalLMTransform.py:284-331): one DataFrame carrying
+    TWO distinct configs — different max_new_tokens, one sampled with its
+    own seed — buckets by config, generates each with its own settings, and
+    keeps row order."""
+    cfgs = np.empty(4, dtype=object)
+    cfgs[0] = {"max_new_tokens": 3}
+    cfgs[1] = {"max_new_tokens": 6, "do_sample": True, "temperature": 0.8,
+               "seed": 7}
+    cfgs[2] = {"max_new_tokens": 3}
+    cfgs[3] = None  # falls back to the transformer-level params
+    df = DataFrame.from_dict({
+        "prompt": ["hello world", "the quick brown fox", "lazy dog", "a"],
+        "gen": cfgs}, num_partitions=1)
+    lm = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=5,
+                             prompt_bucket=8, batch_size=2,
+                             generation_params_col="gen")
+    out = lm.transform(df).collect_column("completions")
+    lengths = [len(np.asarray(g)) for g in out]
+    assert lengths == [3, 6, 3, 5]
+    # two distinct configs + default -> exactly 3 compiled variants
+    assert len(lm.__dict__["_cache_gen"]) == 3
+    # deterministic under the per-row seed
+    out2 = lm.transform(df).collect_column("completions")
+    for a, b in zip(out, out2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # unknown kwargs are rejected, not silently ignored
+    bad = np.empty(1, dtype=object)
+    bad[0] = {"num_beams": 4}
+    bad_df = DataFrame.from_dict({"prompt": ["x"], "gen": bad})
+    import pytest
+    with pytest.raises(ValueError, match="num_beams"):
+        lm.transform(bad_df)
