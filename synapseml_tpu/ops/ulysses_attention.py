@@ -83,7 +83,7 @@ def ulysses_attention_sharded(mesh_ctx, q, k, v, kv_mask=None,
                                     axis_size=n, causal=causal,
                                     local_impl=local_impl),
         head_needs_seq_factor=True,  # ulysses splits heads across seq too
-        # the flash local step is a pallas_call whose out_shape carries no
-        # varying-mesh-axes annotation; skip the vma check (the specs pin
-        # the sharding contract)
-        check_vma=False)
+        # only the flash local step needs the vma check off: its pallas_call
+        # out_shape carries no varying-mesh-axes annotation (the specs pin
+        # the sharding contract); einsum bodies keep full validation
+        check_vma=(local_impl != "flash"))
