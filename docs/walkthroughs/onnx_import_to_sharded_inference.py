@@ -1,0 +1,93 @@
+# %% [markdown]
+# # Walkthrough: import an ONNX model, compile it for TPU, shard it
+#
+# The reference runs ONNX graphs through a per-partition ONNX Runtime
+# session (`onnx/ONNXModel.scala:145-423`). Here the graph converts ONCE to
+# a jittable JAX function; XLA compiles it for the device, and the same
+# function scales out by sharding the batch over a device mesh — no
+# runtime, no per-executor session state.
+
+# %%  Stage 1 — a real torch export (transformer-shaped ops incl. Einsum)
+import numpy as np
+import torch
+
+import synapseml_tpu as st
+from synapseml_tpu.onnx import ONNXModel, convert_graph
+
+
+class TinyNet(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(16, 32)
+        self.fc2 = torch.nn.Linear(32, 4)
+
+    def forward(self, x):
+        h = torch.einsum("nd,dk->nk", x, self.fc1.weight.T) + self.fc1.bias
+        return self.fc2(torch.relu(h))
+
+
+torch.manual_seed(0)
+net = TinyNet().eval()
+
+# torch's exporter imports an `onnx` package only to scan for custom
+# onnxscript functions; our proto codec stands in for it (the
+# tests/_torch_resnet.py pattern)
+import io
+import sys
+import types
+
+from synapseml_tpu.onnx.proto import parse_model
+
+if "onnx" not in sys.modules:
+    class _Model:
+        def __init__(self, parsed):
+            self.graph = parsed.graph
+            self.functions = []
+
+    shim = types.ModuleType("onnx")
+    shim.load_model_from_string = lambda b: _Model(parse_model(b))
+    sys.modules["onnx"] = shim
+
+buf = io.BytesIO()
+torch.onnx.export(net, torch.zeros(1, 16), buf, dynamo=False,
+                  input_names=["x"], output_names=["logits"],
+                  dynamic_axes={"x": {0: "N"}, "logits": {0: "N"}})
+model_bytes = buf.getvalue()
+
+# %%  Stage 2 — convert + parity check against torch
+conv = convert_graph(model_bytes)
+x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+with torch.no_grad():
+    want = net(torch.from_numpy(x)).numpy()
+got = np.asarray(conv(x=x)["logits"])
+np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+print("torch parity ok; ops:", end=" ")
+from synapseml_tpu.onnx.proto import ModelProto
+print(sorted({n.op_type for n in ModelProto.parse(model_bytes).graph.node}))
+
+# %%  Stage 3 — the DataFrame estimator surface (ONNXModel)
+df = st.DataFrame.from_dict({"feat": x})
+om = ONNXModel(model_bytes=model_bytes, mini_batch_size=4,
+               feed_dict={"x": "feat"}, fetch_dict={"logits": "logits"},
+               argmax_dict={"logits": "pred"})
+out = om.transform(df)
+print("predictions:", out.collect_column("pred").tolist())
+
+# %%  Stage 4 — scale out: shard the batch over a device mesh
+# The SAME converted function runs SPMD: place the batch with a
+# NamedSharding and jit — XLA partitions the matmuls and inserts any
+# collectives (here: none needed, pure data parallel).
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+devs = np.array(jax.devices()[: min(8, jax.device_count())])
+mesh = Mesh(devs, ("data",))
+fn = jax.jit(lambda t: conv(x=t)["logits"],
+             in_shardings=NamedSharding(mesh, P("data")),
+             out_shardings=NamedSharding(mesh, P("data")))
+big = np.random.default_rng(1).normal(size=(64, 16)).astype(np.float32)
+sharded_out = np.asarray(fn(big))
+np.testing.assert_allclose(
+    sharded_out, np.asarray(conv(x=big)["logits"]), rtol=1e-4, atol=1e-5)
+print(f"sharded over {len(devs)} devices:", sharded_out.shape)
+print("walkthrough complete: export -> convert -> estimator -> shard")
