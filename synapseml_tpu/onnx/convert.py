@@ -73,7 +73,19 @@ def _mul(ins, attrs):
 
 @op("Div")
 def _div(ins, attrs):
-    return ins[0] / ins[1]
+    a, b = ins[0], ins[1]
+    a_int = jnp.issubdtype(getattr(a, "dtype", None) or np.asarray(a).dtype,
+                           np.integer)
+    b_int = jnp.issubdtype(getattr(b, "dtype", None) or np.asarray(b).dtype,
+                           np.integer)
+    if a_int and b_int:
+        # ONNX integer Div truncates toward zero (C semantics) — torch's
+        # chunk/split exports rely on it for Slice bounds; Python floor
+        # division (or float division) would shift every boundary
+        q = a // b
+        r = a - q * b
+        return q + ((r != 0) & ((a < 0) != (b < 0)))
+    return a / b
 
 
 @op("Pow")
@@ -155,6 +167,12 @@ def _clip(ins, attrs):
 
 @op("Where")
 def _where(ins, attrs):
+    present = [x for x in ins if x is not None]
+    if all(isinstance(x, (np.ndarray, np.generic)) for x in present):
+        # shape-math select (torch's expand exports Where(shape==-1, ...)):
+        # stay host numpy — under jit a jnp.where would stage to a tracer
+        # and break static-shape consumers like Expand/Reshape
+        return np.where(ins[0], ins[1], ins[2])
     return jnp.where(ins[0], ins[1], ins[2])
 
 
@@ -322,6 +340,10 @@ def _reshape(ins, attrs):
     shape = [int(s) for s in np.asarray(shape)]
     # ONNX semantics: 0 = copy input dim; -1 = infer
     shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    if _host_i64(ins[:1]):
+        # shape-math flowing AS data (torch expand/reshape chains): stay
+        # host so downstream Expand/Reshape see static ints, not tracers
+        return np.reshape(x, shape)
     return jnp.reshape(x, shape)
 
 
@@ -420,6 +442,25 @@ def _slice(ins, attrs):
     return x[tuple(idx)]
 
 
+@op("Not")
+def _not(ins, attrs):
+    return jnp.logical_not(ins[0])
+
+
+@op("Trilu")
+def _trilu(ins, attrs):
+    # causal masks: torch.tril/triu export (GPT-style decoders)
+    k = int(np.asarray(ins[1])) if len(ins) > 1 and ins[1] is not None else 0
+    return jnp.triu(ins[0], k) if attrs.get("upper", 1) else jnp.tril(ins[0], k)
+
+
+@op("GatherElements")
+def _gather_elements(ins, attrs):
+    # torch.gather: per-element indexed pick along an axis
+    return jnp.take_along_axis(ins[0], jnp.asarray(ins[1]).astype(jnp.int32),
+                               axis=attrs.get("axis", 0))
+
+
 @op("Gather")
 def _gather(ins, attrs):
     if _host_i64([ins[0]]):
@@ -478,6 +519,11 @@ def _constant_of_shape(ins, attrs):
     val = attrs.get("value")
     v = np.asarray(val).ravel()[0] if val is not None else 0.0
     dt = np.asarray(val).dtype if val is not None else np.float32
+    if np.issubdtype(dt, np.integer) and np.dtype(dt).itemsize == 8:
+        # int64 fills are shape/index constants (torch expand chains compare
+        # them to -1): stay host, like int64 initializers/Constants — under
+        # jit, jnp.full would stage to a tracer and poison shape consumers
+        return np.full(shape, v, dtype=dt)
     return jnp.full(shape, v, dtype=dt)
 
 
@@ -552,6 +598,63 @@ def _argmax(ins, attrs):
 # graph executor
 # ---------------------------------------------------------------------------
 
+def _load_initializers(graph) -> dict:
+    """Initializers as env entries; int64 stays host numpy (sentinel-safe).
+    Used for If subgraphs (typically a handful of scalars); the top-level
+    graph's initializers are decoded once in ConvertedModel.__init__."""
+    out = {}
+    for t in graph.initializer:
+        v = tensor_to_numpy(t)
+        out[t.name] = v if v.dtype in (np.int64, np.uint64) else jnp.asarray(v)
+    return out
+
+
+def _exec_nodes(graph, env: dict) -> None:
+    """Run a graph's nodes over ``env`` in place (shared by the top-level
+    model and If subgraphs, which read outer-scope names per ONNX scoping)."""
+    for node in graph.node:
+        ins = [env[i] if i else None for i in node.input]
+        if node.op_type == "If":
+            out = _exec_if(node, ins, env)
+        else:
+            out = OP_REGISTRY[node.op_type](ins, node.attrs())
+        outs = out if isinstance(out, tuple) else (out,)
+        for name, val in zip(node.output, outs):
+            if name:
+                env[name] = val
+
+
+def _exec_if(node, ins, env: dict):
+    """ONNX If with a STATICALLY-resolved condition (the form torch's
+    exporter emits for shape guards — the cond is host/concrete at trace
+    time, so exactly one branch is traced, staying XLA-compatible). A
+    traced (data-dependent) condition is rejected explicitly."""
+    import jax.core
+
+    cond = ins[0]
+    if isinstance(cond, jax.core.Tracer):
+        raise NotImplementedError(
+            "ONNX If with a data-dependent condition cannot be lowered "
+            "statically; only shape-guard Ifs (torch export) are supported")
+    attrs = {a.name: a.g for a in node.attribute}
+    branch = attrs["then_branch"] if bool(np.asarray(cond)) else attrs["else_branch"]
+    sub_env = dict(env)  # outer scope is readable, never written back
+    sub_env.update(_load_initializers(branch))
+    _exec_nodes(branch, sub_env)
+    return tuple(sub_env[vi.name] for vi in branch.output)
+
+
+def _all_op_types(graph) -> set:
+    """Op types in a graph INCLUDING If subgraphs (registry validation)."""
+    ops = set()
+    for node in graph.node:
+        ops.add(node.op_type)
+        for a in node.attribute:
+            if a.g is not None:
+                ops |= _all_op_types(a.g)
+    return ops
+
+
 class ConvertedModel:
     """A parsed + converted ONNX model: ``fn(**inputs) -> dict[name, array]``.
 
@@ -569,7 +672,8 @@ class ConvertedModel:
                              if vi.name not in init_names}
         self.input_types = {vi.name: vi.elem_type for vi in g.input
                             if vi.name not in init_names}
-        unsupported = sorted({n.op_type for n in g.node if n.op_type not in OP_REGISTRY})
+        unsupported = sorted(o for o in _all_op_types(g)
+                             if o != "If" and o not in OP_REGISTRY)
         if unsupported:
             raise NotImplementedError(
                 f"ONNX ops not supported by the TPU converter: {unsupported} "
@@ -577,23 +681,19 @@ class ConvertedModel:
 
     def __call__(self, **inputs):
         g = self.model.graph
-        env: dict[str, object] = {}
         # int64 initializers (Slice ends, Reshape shapes, axes...) stay numpy:
         # jnp.asarray under disabled-x64 wraps them to int32 (INT64_MAX -> -1),
-        # corrupting "to end" sentinels before the op ever sees them
-        env.update({k: v if v.dtype in (np.int64, np.uint64) else jnp.asarray(v)
-                    for k, v in self.weights.items()})
+        # corrupting "to end" sentinels before the op ever sees them.
+        # self.weights is decoded ONCE at construction — re-decoding proto
+        # per call costs ~100MB of parsing for ResNet-50-class graphs
+        env: dict[str, object] = {
+            k: v if v.dtype in (np.int64, np.uint64) else jnp.asarray(v)
+            for k, v in self.weights.items()}
         for name in self.input_names:
             if name not in inputs:
                 raise KeyError(f"missing input {name!r}; expects {self.input_names}")
             env[name] = inputs[name]
-        for node in g.node:
-            ins = [env[i] if i else None for i in node.input]
-            out = OP_REGISTRY[node.op_type](ins, node.attrs())
-            outs = out if isinstance(out, tuple) else (out,)
-            for name, val in zip(node.output, outs):
-                if name:
-                    env[name] = val
+        _exec_nodes(g, env)
         missing = [o for o in self.output_names if o not in env]
         if missing:
             raise ValueError(f"graph did not produce outputs {missing}")

@@ -17,7 +17,9 @@ WALKTHROUGHS = sorted((pathlib.Path(__file__).parent.parent / "docs"
 @pytest.mark.slow  # multi-stage: each trains + serves; full lane only
 @pytest.mark.parametrize("walkthrough", WALKTHROUGHS, ids=lambda p: p.name)
 def test_walkthrough_runs(walkthrough):
-    env = {**os.environ,
+    # clean env like test_examples: no inherited PALLAS_AXON_POOL_IPS means
+    # the axon relay backend cannot be selected in the child at all
+    env = {"PATH": "/usr/bin:/bin", "HOME": "/root",
            "JAX_PLATFORMS": "cpu",
            "PYTHONPATH": str(walkthrough.parent.parent.parent),
            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
