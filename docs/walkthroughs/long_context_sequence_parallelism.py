@@ -1,0 +1,56 @@
+# %% [markdown]
+# # Walkthrough: long context via sequence parallelism
+#
+# Long sequences don't fit one device's attention: the framework ships TWO
+# sequence-parallel strategies over the mesh `seq` axis — **ring attention**
+# (ppermute ring, bounded memory, exact) and **Ulysses** (all-to-all head
+# scatter) — behind one switch. This runs both on an 8-device mesh and
+# checks they agree with plain attention, then trains through ring.
+
+# %%  Stage 1 — a seq-sharded mesh
+import numpy as np
+
+from synapseml_tpu.ops.attention import reference_attention
+from synapseml_tpu.ops.ring_attention import ring_attention_sharded
+from synapseml_tpu.ops.ulysses_attention import ulysses_attention_sharded
+from synapseml_tpu.parallel import MeshConfig, create_mesh
+
+mesh = create_mesh(MeshConfig(data=2, seq=4))
+print("mesh axes:", {k: v for k, v in mesh.axis_sizes.items() if v > 1})
+
+# %%  Stage 2 — both strategies match plain attention (causal + masked)
+B, T, H, D = 2, 512, 8, 32
+rs = np.random.default_rng(0)
+q, k, v = (rs.normal(size=(B, T, H, D)).astype(np.float32) for _ in range(3))
+mask = np.ones((B, T), bool)
+mask[1, T // 2:] = False  # padded tail on one row
+
+want = np.asarray(reference_attention(q, k, v, kv_mask=mask, causal=True))
+ring = np.asarray(ring_attention_sharded(mesh, q, k, v, kv_mask=mask,
+                                         causal=True))
+ulys = np.asarray(ulysses_attention_sharded(mesh, q, k, v, kv_mask=mask,
+                                            causal=True, local_impl="einsum"))
+np.testing.assert_allclose(ring, want, rtol=2e-4, atol=2e-5)
+np.testing.assert_allclose(ulys, want, rtol=2e-4, atol=2e-5)
+print("ring + ulysses agree with reference attention at T =", T)
+
+# %%  Stage 3 — train THROUGH ring attention (the attn_impl switch)
+from synapseml_tpu.models.flax_nets.bert import BertClassifier, bert_tiny
+from synapseml_tpu.models.trainer import Trainer, TrainerConfig
+
+cfg = bert_tiny(n_layers=2, attn_impl="ring")
+model = BertClassifier(cfg, num_classes=2)
+batch = {
+    "input_ids": rs.integers(0, cfg.vocab_size, (8, 128)).astype(np.int32),
+    "attention_mask": np.ones((8, 128), np.int32),
+    "labels": rs.integers(0, 2, (8,)).astype(np.int32),
+}
+tr = Trainer(model, mesh, TrainerConfig(learning_rate=1e-3, total_steps=4))
+state = tr.init_state(batch)
+losses = []
+for _ in range(4):
+    state, m = tr.train_step(state, batch)
+    losses.append(float(m["loss"]))
+print("losses through ring attention:", [round(l, 4) for l in losses])
+assert losses[-1] < losses[0]
+print("walkthrough complete: two strategies, one switch, training works")

@@ -165,6 +165,28 @@ def _clip(ins, attrs):
     return jnp.clip(ins[0], lo, hi)
 
 
+@op("Sin")
+def _sin(ins, attrs):
+    return jnp.sin(ins[0])
+
+
+@op("Cos")
+def _cos(ins, attrs):
+    return jnp.cos(ins[0])
+
+
+@op("HardSwish")
+def _hardswish(ins, attrs):
+    return jax.nn.hard_swish(ins[0])
+
+
+@op("HardSigmoid")
+def _hardsigmoid(ins, attrs):
+    alpha = attrs.get("alpha", 0.2)
+    beta = attrs.get("beta", 0.5)
+    return jnp.clip(alpha * ins[0] + beta, 0.0, 1.0)
+
+
 @op("Where")
 def _where(ins, attrs):
     present = [x for x in ins if x is not None]
@@ -332,6 +354,87 @@ def _gmp(ins, attrs):
     return jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
 
 
+@op("InstanceNormalization")
+def _instance_norm(ins, attrs):
+    # also the lowering torch emits for GroupNorm (reshape -> IN -> reshape)
+    x, scale, bias = ins[0], ins[1], ins[2]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean) / jnp.sqrt(var + eps) * scale.reshape(shape) \
+        + bias.reshape(shape)
+
+
+def _resize_coords(out_len: int, in_len: int, scale: float, ct: str):
+    i = np.arange(out_len, dtype=np.float64)
+    if ct == "asymmetric":
+        return i / scale
+    if ct in ("half_pixel", "pytorch_half_pixel"):
+        x = (i + 0.5) / scale - 0.5
+        if ct == "pytorch_half_pixel" and out_len == 1:
+            x = np.zeros_like(x)
+        return x
+    if ct == "align_corners":
+        return i * ((in_len - 1) / max(out_len - 1, 1))
+    raise NotImplementedError(f"Resize coordinate mode {ct!r}")
+
+
+@op("Resize")
+def _resize(ins, attrs):
+    """Nearest / linear resize (torch F.interpolate exports). Coordinates
+    are computed host-side per ONNX's coordinate_transformation_mode, so
+    the op lowers to static gathers + lerps XLA can fuse."""
+    x = ins[0]
+    if len(ins) == 2:  # opset-10 form: Resize(X, scales)
+        scales, sizes = np.asarray(ins[1]), None
+    else:              # opset-11+ form: Resize(X, roi, scales, sizes)
+        scales = (np.asarray(ins[2]) if len(ins) > 2 and ins[2] is not None
+                  and np.asarray(ins[2]).size else None)
+        sizes = (np.asarray(ins[3]) if len(ins) > 3 and ins[3] is not None
+                 and np.asarray(ins[3]).size else None)
+    if scales is None and sizes is None:
+        raise NotImplementedError("Resize needs scales or sizes")
+    if attrs.get("antialias", 0):
+        raise NotImplementedError("Resize antialias=1 is not supported")
+    mode = attrs.get("mode", "nearest")
+    ct = attrs.get("coordinate_transformation_mode", "half_pixel")
+    nearest_mode = attrs.get("nearest_mode", "round_prefer_floor")
+    if sizes is not None:
+        out_shape = [int(s) for s in sizes]
+        scale_list = [o / i for o, i in zip(out_shape, x.shape)]
+    else:
+        scale_list = [float(s) for s in scales]
+        out_shape = [int(np.floor(i * s)) for i, s in zip(x.shape, scale_list)]
+    out = x
+    for ax, (o, n, sc) in enumerate(zip(out_shape, x.shape, scale_list)):
+        if o == n:
+            continue
+        coords = _resize_coords(o, n, sc, ct)
+        if mode == "nearest":
+            if nearest_mode == "floor":
+                idx = np.floor(coords)
+            elif nearest_mode == "ceil":
+                idx = np.ceil(coords)
+            elif nearest_mode == "round_prefer_ceil":
+                idx = np.floor(coords + 0.5)
+            else:  # round_prefer_floor
+                idx = np.ceil(coords - 0.5)
+            out = jnp.take(out, np.clip(idx, 0, n - 1).astype(np.int32),
+                           axis=ax)
+        elif mode == "linear":
+            lo = np.clip(np.floor(coords), 0, n - 1).astype(np.int32)
+            hi = np.clip(lo + 1, 0, n - 1).astype(np.int32)
+            w = np.clip(coords - lo, 0.0, 1.0).astype(np.float32)
+            w = w.reshape([o if a == ax else 1 for a in range(out.ndim)])
+            out = (jnp.take(out, lo, axis=ax) * (1.0 - w)
+                   + jnp.take(out, hi, axis=ax) * w)
+        else:
+            raise NotImplementedError(f"Resize mode {mode!r}")
+    return out
+
+
 # ---------------- shape / structure ----------------
 
 @op("Reshape")
@@ -456,9 +559,13 @@ def _trilu(ins, attrs):
 
 @op("GatherElements")
 def _gather_elements(ins, attrs):
-    # torch.gather: per-element indexed pick along an axis
-    return jnp.take_along_axis(ins[0], jnp.asarray(ins[1]).astype(jnp.int32),
-                               axis=attrs.get("axis", 0))
+    # torch.gather: per-element indexed pick along an axis; ONNX permits
+    # negative indices (wrap from the end), which jnp's OOB clamping would
+    # otherwise silently send to index 0
+    axis = attrs.get("axis", 0)
+    idx = jnp.asarray(ins[1]).astype(jnp.int32)
+    idx = jnp.where(idx < 0, idx + ins[0].shape[axis], idx)
+    return jnp.take_along_axis(ins[0], idx, axis=axis)
 
 
 @op("Gather")
@@ -545,15 +652,12 @@ def _dropout(ins, attrs):
 
 @op("Constant")
 def _constant(ins, attrs):
+    # ALWAYS host numpy: under jit, jnp.asarray stages even a literal into
+    # a tracer, poisoning static consumers (Reshape/Expand/Resize scales,
+    # int64 index sentinels). Device ops promote host literals on demand.
     for key in ("value", "value_float", "value_int", "value_floats", "value_ints"):
         if key in attrs and attrs[key] is not None:
-            v = np.asarray(attrs[key])
-            if v.dtype in (np.int64, np.uint64):
-                # host numpy, like int64 initializers: these are shape/index
-                # constants; jnp.asarray would stage an int64->int32 convert
-                # under jit (a tracer), breaking static shape-math consumers
-                return v
-            return jnp.asarray(v)
+            return np.asarray(attrs[key])
     raise ValueError("Constant node without value attribute")
 
 
@@ -584,6 +688,25 @@ def _reduce_max(ins, attrs):
 @op("ReduceMin")
 def _reduce_min(ins, attrs):
     return _reduce(jnp.min, ins, attrs)
+
+
+@op("TopK")
+def _topk(ins, attrs):
+    x = ins[0]
+    k = int(np.asarray(ins[1]).ravel()[0])
+    axis = attrs.get("axis", -1)
+    if axis < 0:
+        axis += x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    if attrs.get("largest", 1):
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        # smallest-k via ascending argsort — a negation trick would break
+        # unsigned dtypes (wraparound) and signed INT_MIN (its own negation)
+        idx = jnp.argsort(moved, axis=-1)[..., :k]
+        vals = jnp.take_along_axis(moved, idx, axis=-1)
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx.astype(jnp.int32), -1, axis))
 
 
 @op("ArgMax")

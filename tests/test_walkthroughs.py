@@ -3,7 +3,6 @@ multi-stage walkthrough under docs/walkthroughs runs end to end — the
 reference's executed-notebook tier (``docs/Explore Algorithms/`` +
 ``nbtest/DatabricksUtilities.scala``) as plain runnable scripts."""
 
-import os
 import pathlib
 import subprocess
 import sys
