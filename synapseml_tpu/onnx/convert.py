@@ -7,9 +7,16 @@ compiles (and fuses) for the device. Weights become closure constants so XLA
 can constant-fold/bake them into the executable, mirroring a session's
 "model resident in device memory".
 
-Covers the op set of the reference's benchmark models (ResNet-family convnets,
-MLP heads) plus the common tensor utilities. Unsupported ops raise with the
-op name at conversion time, not run time.
+The 68-op registry is proven through REAL torch.onnx exports, one per model
+family: convnets (ResNet-50, ``tests/test_onnx_resnet.py``), transformer
+encoders with einsum attention and dynamic shapes (``tests/test_onnx_bert.py``),
+causal decoders with Trilu masks, GatherElements and shape-guard If nodes
+(``tests/test_onnx_gpt.py``), modern-vision ops — Resize, GroupNorm-as-
+InstanceNorm, Hardswish, TopK (``tests/test_onnx_mixed.py``) — and recurrent
+LSTM/GRU lowered to ``lax.scan`` (``tests/test_onnx_rnn.py``). Host-side
+int64 shape math stays numpy end-to-end so dynamic-shape chains never stage
+tracers. Unsupported ops raise with the op name at conversion time, not run
+time.
 """
 
 from __future__ import annotations
@@ -433,6 +440,150 @@ def _resize(ins, attrs):
         else:
             raise NotImplementedError(f"Resize mode {mode!r}")
     return out
+
+
+# ---------------- recurrent (lax.scan lowering) ----------------
+
+def _seq_mask(seq_lens, T: int, B: int):
+    """[T, B, 1] validity mask from ONNX sequence_lens (None = all valid)."""
+    if seq_lens is None:
+        return None
+    t = jnp.arange(T)[:, None]
+    return (t < jnp.asarray(seq_lens)[None, :]).astype(jnp.float32)[..., None]
+
+
+def _lstm_direction(x, w, r, b, h0, c0, seq_lens, reverse: bool):
+    """One LSTM direction. ONNX gate order i,o,f,c; default activations
+    sigmoid/tanh/tanh. x: [T,B,I]; w: [4H,I]; r: [4H,H]; b: [8H]."""
+    T, B, _ = x.shape
+    H = r.shape[1]
+    wb, rb = (b[: 4 * H], b[4 * H:]) if b is not None else (0.0, 0.0)
+    xw = jnp.einsum("tbi,gi->tbg", x, w) + wb + rb  # input proj, both biases
+    mask = _seq_mask(seq_lens, T, B)
+
+    def step(carry, inp):
+        h, c = carry
+        gates = inp[0] + h @ r.T
+        i, o, f, g = (gates[:, k * H:(k + 1) * H] for k in range(4))
+        i, o, f = jax.nn.sigmoid(i), jax.nn.sigmoid(o), jax.nn.sigmoid(f)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        if inp[1] is not None:
+            m = inp[1]
+            h_new = m * h_new + (1 - m) * h  # frozen past seq end
+            c_new = m * c_new + (1 - m) * c
+            y = m * h_new                    # ONNX: padded steps output 0
+        else:
+            y = h_new
+        return (h_new, c_new), y
+
+    if mask is None:
+        (h, c), ys = jax.lax.scan(lambda cr, xt: step(cr, (xt, None)),
+                                  (h0, c0), xw, reverse=reverse)
+    else:
+        (h, c), ys = jax.lax.scan(step, (h0, c0), (xw, mask),
+                                  reverse=reverse)
+    return ys, h, c
+
+
+@op("LSTM")
+def _lstm(ins, attrs):
+    x = ins[0]                       # [T, B, I]
+    W, R = ins[1], ins[2]            # [D, 4H, I], [D, 4H, H]
+    B_ = ins[3] if len(ins) > 3 else None
+    seq_lens = ins[4] if len(ins) > 4 else None
+    H = R.shape[2]
+    T, Bsz, _ = x.shape
+    n_dir = W.shape[0]
+    h0 = ins[5] if len(ins) > 5 and ins[5] is not None else jnp.zeros((n_dir, Bsz, H), x.dtype)
+    c0 = ins[6] if len(ins) > 6 and ins[6] is not None else jnp.zeros((n_dir, Bsz, H), x.dtype)
+    if attrs.get("activations"):
+        raise NotImplementedError("LSTM custom activations")
+    if attrs.get("layout", 0):
+        raise NotImplementedError("LSTM layout=1 (batch-first)")
+    if attrs.get("clip") is not None:
+        raise NotImplementedError("LSTM cell clipping")
+    if len(ins) > 7 and ins[7] is not None:
+        raise NotImplementedError("LSTM peephole connections (input P)")
+    ys, hs, cs = [], [], []
+    for d in range(n_dir):
+        y, h, c = _lstm_direction(
+            x, jnp.asarray(W[d]), jnp.asarray(R[d]),
+            jnp.asarray(B_[d]) if B_ is not None else None,
+            jnp.asarray(h0[d]), jnp.asarray(c0[d]), seq_lens,
+            reverse=(d == 1 or attrs.get("direction") == "reverse"))
+        ys.append(y)
+        hs.append(h)
+        cs.append(c)
+    return (jnp.stack(ys, axis=1),   # Y: [T, D, B, H]
+            jnp.stack(hs, axis=0),   # Y_h: [D, B, H]
+            jnp.stack(cs, axis=0))   # Y_c: [D, B, H]
+
+
+def _gru_direction(x, w, r, b, h0, seq_lens, linear_before_reset, reverse):
+    """One GRU direction. ONNX gate order z,r,h. x: [T,B,I]; w: [3H,I];
+    r: [3H,H]; b: [6H] (Wb zrh + Rb zrh)."""
+    T, B, _ = x.shape
+    H = r.shape[1]
+    wb = b[: 3 * H] if b is not None else jnp.zeros((3 * H,), x.dtype)
+    rb = b[3 * H:] if b is not None else jnp.zeros((3 * H,), x.dtype)
+    xw = jnp.einsum("tbi,gi->tbg", x, w) + wb
+    mask = _seq_mask(seq_lens, T, B)
+
+    def step(h, inp):
+        xt, m = inp
+        hr = h @ r.T
+        z = jax.nn.sigmoid(xt[:, :H] + hr[:, :H] + rb[:H])
+        rt = jax.nn.sigmoid(xt[:, H:2 * H] + hr[:, H:2 * H] + rb[H:2 * H])
+        if linear_before_reset:
+            hh = jnp.tanh(xt[:, 2 * H:] + rt * (hr[:, 2 * H:] + rb[2 * H:]))
+        else:
+            hh = jnp.tanh(xt[:, 2 * H:] + (rt * h) @ r.T[:, 2 * H:]
+                          + rb[2 * H:])
+        h_new = (1 - z) * hh + z * h
+        if m is not None:
+            h_new = m * h_new + (1 - m) * h
+            y = m * h_new
+        else:
+            y = h_new
+        return h_new, y
+
+    if mask is None:
+        h, ys = jax.lax.scan(lambda hh, xt: step(hh, (xt, None)), h0, xw,
+                             reverse=reverse)
+    else:
+        h, ys = jax.lax.scan(step, h0, (xw, mask), reverse=reverse)
+    return ys, h
+
+
+@op("GRU")
+def _gru(ins, attrs):
+    x = ins[0]
+    W, R = ins[1], ins[2]
+    B_ = ins[3] if len(ins) > 3 else None
+    seq_lens = ins[4] if len(ins) > 4 else None
+    H = R.shape[2]
+    T, Bsz, _ = x.shape
+    n_dir = W.shape[0]
+    h0 = (ins[5] if len(ins) > 5 and ins[5] is not None
+          else jnp.zeros((n_dir, Bsz, H), x.dtype))
+    if attrs.get("activations"):
+        raise NotImplementedError("GRU custom activations")
+    if attrs.get("layout", 0):
+        raise NotImplementedError("GRU layout=1 (batch-first)")
+    if attrs.get("clip") is not None:
+        raise NotImplementedError("GRU cell clipping")
+    lbr = attrs.get("linear_before_reset", 0)
+    ys, hs = [], []
+    for d in range(n_dir):
+        y, h = _gru_direction(
+            x, jnp.asarray(W[d]), jnp.asarray(R[d]),
+            jnp.asarray(B_[d]) if B_ is not None else None,
+            jnp.asarray(h0[d]), seq_lens, lbr,
+            reverse=(d == 1 or attrs.get("direction") == "reverse"))
+        ys.append(y)
+        hs.append(h)
+    return jnp.stack(ys, axis=1), jnp.stack(hs, axis=0)
 
 
 # ---------------- shape / structure ----------------
