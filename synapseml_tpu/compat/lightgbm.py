@@ -37,6 +37,12 @@ class LightGBMClassificationModel(WrapperBase):
     def getBoostingType(self):
         return self._get('boosting_type')
 
+    def setCategoricalSlotIndexes(self, value):
+        return self._set('categorical_slot_indexes', value)
+
+    def getCategoricalSlotIndexes(self):
+        return self._get('categorical_slot_indexes')
+
     def setClasses(self, value):
         return self._set('classes', value)
 
@@ -252,6 +258,12 @@ class LightGBMClassifier(WrapperBase):
 
     def getBoostingType(self):
         return self._get('boosting_type')
+
+    def setCategoricalSlotIndexes(self, value):
+        return self._set('categorical_slot_indexes', value)
+
+    def getCategoricalSlotIndexes(self):
+        return self._get('categorical_slot_indexes')
 
     def setDropRate(self, value):
         return self._set('drop_rate', value)
@@ -475,6 +487,12 @@ class LightGBMRanker(WrapperBase):
     def getBoostingType(self):
         return self._get('boosting_type')
 
+    def setCategoricalSlotIndexes(self, value):
+        return self._set('categorical_slot_indexes', value)
+
+    def getCategoricalSlotIndexes(self):
+        return self._get('categorical_slot_indexes')
+
     def setDropRate(self, value):
         return self._set('drop_rate', value)
 
@@ -685,6 +703,12 @@ class LightGBMRankerModel(WrapperBase):
     def getBoostingType(self):
         return self._get('boosting_type')
 
+    def setCategoricalSlotIndexes(self, value):
+        return self._set('categorical_slot_indexes', value)
+
+    def getCategoricalSlotIndexes(self):
+        return self._get('categorical_slot_indexes')
+
     def setDropRate(self, value):
         return self._set('drop_rate', value)
 
@@ -889,6 +913,12 @@ class LightGBMRegressionModel(WrapperBase):
     def getBoostingType(self):
         return self._get('boosting_type')
 
+    def setCategoricalSlotIndexes(self, value):
+        return self._set('categorical_slot_indexes', value)
+
+    def getCategoricalSlotIndexes(self):
+        return self._get('categorical_slot_indexes')
+
     def setDropRate(self, value):
         return self._set('drop_rate', value)
 
@@ -1092,6 +1122,12 @@ class LightGBMRegressor(WrapperBase):
 
     def getBoostingType(self):
         return self._get('boosting_type')
+
+    def setCategoricalSlotIndexes(self, value):
+        return self._set('categorical_slot_indexes', value)
+
+    def getCategoricalSlotIndexes(self):
+        return self._get('categorical_slot_indexes')
 
     def setDropRate(self, value):
         return self._set('drop_rate', value)

@@ -37,7 +37,9 @@ class TpuBooster:
                  num_features: int, params: dict | None = None,
                  best_iteration: int | None = None,
                  cover: np.ndarray | None = None,
-                 average_output: bool = False):
+                 average_output: bool = False,
+                 cat_mask: np.ndarray | None = None,
+                 categorical_features: tuple = ()):
         # stacked (num_iters, K, M)
         self.feature = feature
         self.threshold_value = threshold_value
@@ -52,6 +54,9 @@ class TpuBooster:
         self.params = dict(params or {})
         self.best_iteration = best_iteration
         self.average_output = bool(average_output)  # rf mode: mean over trees
+        # (T, K, M, B) uint8 left-membership of categorical splits, or None
+        self.cat_mask = cat_mask
+        self.categorical_features = tuple(categorical_features or ())
         self._predict_cache: dict[Any, Callable] = {}
 
     @property
@@ -74,6 +79,8 @@ class TpuBooster:
             feat = jnp.asarray(self.feature[:num_iters])
             thr = jnp.asarray(self.threshold_value[:num_iters])
             val = jnp.asarray(self.leaf_value[:num_iters])
+            cm = (None if self.cat_mask is None
+                  else jnp.asarray(self.cat_mask[:num_iters]))
             init = jnp.asarray(self.init_score)
             depth = self.max_depth
             K = self.num_model_out
@@ -82,7 +89,9 @@ class TpuBooster:
 
             @jax.jit
             def raw(x):
-                outs = [T.predict_raw_forest(x, feat[:, k], thr[:, k], val[:, k], depth)
+                outs = [T.predict_raw_forest(
+                    x, feat[:, k], thr[:, k], val[:, k], depth,
+                    cat_masks=None if cm is None else cm[:, k])
                         for k in range(K)]
                 return jnp.stack(outs, axis=1) * avg + init[None, :]
 
@@ -117,7 +126,9 @@ class TpuBooster:
         contrib = forest_shap(self.feature[:n_it], self.threshold_value[:n_it],
                               self.leaf_value[:n_it], self.cover[:n_it],
                               np.zeros_like(self.init_score),
-                              np.asarray(features, np.float64))
+                              np.asarray(features, np.float64),
+                              cat_mask=None if self.cat_mask is None
+                              else self.cat_mask[:n_it])
         if self.average_output:  # rf: raw = init + mean(trees)
             contrib = contrib / n_it
         contrib[:, :, -1] += np.asarray(self.init_score, np.float64)
@@ -134,7 +145,11 @@ class TpuBooster:
         t, k, m = self.feature[:n_it].shape
         feat = jnp.asarray(self.feature[:n_it].reshape(t * k, m))
         thr = jnp.asarray(self.threshold_value[:n_it].reshape(t * k, m))
-        return np.asarray(T.leaf_index_forest(x, feat, thr, self.max_depth))
+        cm = None
+        if self.cat_mask is not None:
+            cm = jnp.asarray(self.cat_mask[:n_it].reshape(t * k, m, -1))
+        return np.asarray(T.leaf_index_forest(x, feat, thr, self.max_depth,
+                                              cat_masks=cm))
 
     # ---------------- introspection ----------------
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
@@ -161,12 +176,15 @@ class TpuBooster:
                       init_score=self.init_score)
         if self.cover is not None:
             arrays["cover"] = self.cover
+        if self.cat_mask is not None:
+            arrays["cat_mask"] = self.cat_mask
         np.savez_compressed(os.path.join(path, "trees.npz"), **arrays)
         meta = {
             "max_depth": self.max_depth, "num_model_out": self.num_model_out,
             "objective": self.objective, "num_features": self.num_features,
             "params": self.params, "best_iteration": self.best_iteration,
             "average_output": self.average_output,
+            "categorical_features": list(self.categorical_features),
         }
         with open(os.path.join(path, "booster.json"), "w") as f:
             json.dump(meta, f, indent=2)
@@ -180,6 +198,9 @@ class TpuBooster:
                    init_score=z["init_score"],
                    cover=z["cover"] if "cover" in z.files else None,
                    average_output=meta.get("average_output", False),
+                   cat_mask=z["cat_mask"] if "cat_mask" in z.files else None,
+                   categorical_features=tuple(
+                       meta.get("categorical_features", ())),
                    **{k: meta[k] for k in
                    ("max_depth", "num_model_out", "objective", "num_features",
                     "params", "best_iteration")})
@@ -195,7 +216,13 @@ class TpuBooster:
                 lines.append(f"tree {t}.{k}:")
                 for i in range(self.feature.shape[2]):
                     f_ = int(self.feature[t, k, i])
-                    if f_ >= 0:
+                    if f_ >= 0 and f_ in self.categorical_features \
+                            and self.cat_mask is not None \
+                            and self.cat_mask[t, k, i].any():
+                        cats = np.nonzero(self.cat_mask[t, k, i])[0].tolist()
+                        lines.append(f"  node {i}: f{f_} in {cats} "
+                                     f"-> {2*i+1},{2*i+2}")
+                    elif f_ >= 0:
                         lines.append(f"  node {i}: f{f_} <= "
                                      f"{float(self.threshold_value[t, k, i]):.6g} "
                                      f"-> {2*i+1},{2*i+2}")
@@ -248,6 +275,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                   max_drop: int = 50, skip_drop: float = 0.5,
                   monotone_constraints=None, scale_pos_weight: float = 1.0,
                   is_unbalance: bool = False, histogram_impl: str = "segment",
+                  categorical_features=None,
                   measures=None, verbose: bool = False) -> TpuBooster:
     """Grow a forest. The full binned matrix + running scores stay on device
     for the whole run; pass ``mesh`` to shard rows over its ``data`` axis
@@ -273,7 +301,10 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         max_depth = max(int(np.ceil(np.log2(max(num_leaves, 2)))) + 1, 3)
     max_depth = min(max_depth, 12)  # heap arrays are 2^(d+1); bound memory
 
-    mapper = BinMapper(max_bin=max_bin, seed=seed)
+    cat_feats = tuple(sorted(int(i) for i in (categorical_features or ())))
+    if cat_feats and not all(0 <= i < f for i in cat_feats):
+        raise ValueError(f"categorical_features out of range [0, {f}): {cat_feats}")
+    mapper = BinMapper(max_bin=max_bin, seed=seed, categorical=cat_feats)
     with measures.measure("binning"):  # the reference's dataset-prep window
         bins_np = mapper.fit_transform(x).astype(np.int32)
 
@@ -361,7 +392,8 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                          min_data_in_leaf=min_data_in_leaf,
                          min_sum_hessian=min_sum_hessian,
                          min_gain_to_split=min_gain_to_split,
-                         hist_impl=histogram_impl)
+                         hist_impl=histogram_impl,
+                         categorical_features=cat_feats)
 
     # validation state (kept binned; scores updated incrementally)
     has_valid = valid_features is not None and valid_labels is not None
@@ -498,21 +530,21 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                 return True
         return False
 
-    def forest_delta(feat_s, thr_s, val_s, data_bins):
+    def forest_delta(feat_s, thr_s, val_s, cm_s, data_bins):
         """Summed per-class outputs of a stack of trees: (D, K, M) -> (N, K)."""
         def one(acc, tkm):
-            fe, th, va = tkm
+            fe, th, va, cm = tkm
 
             def per_k(c, fkv):
-                f1, t1, v1 = fkv
-                tree = T.TreeArrays(f1, t1, v1, v1, v1)  # gain/cover unused
+                f1, t1, v1, c1 = fkv
+                tree = T.TreeArrays(f1, t1, v1, v1, v1, c1)  # gain/cover unused
                 return c, T.traverse_binned(data_bins, tree, max_depth)
 
-            _, deltas = jax.lax.scan(per_k, 0, (fe, th, va))  # (K, N)
+            _, deltas = jax.lax.scan(per_k, 0, (fe, th, va, cm))  # (K, N)
             return acc + jnp.swapaxes(deltas, 0, 1), None
 
         out0 = jnp.zeros((data_bins.shape[0], K), jnp.float32)
-        out, _ = jax.lax.scan(one, out0, (feat_s, thr_s, val_s))
+        out, _ = jax.lax.scan(one, out0, (feat_s, thr_s, val_s, cm_s))
         return out
 
     if use_full_scan:
@@ -529,6 +561,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         measures.count("iterations", num_iterations)
         feat_dev, thr_dev = trees.feature, trees.threshold_bin   # (T, K, M)
         val_dev, gain_dev, cover_dev = trees.leaf_value, trees.gain, trees.cover
+        cat_dev = trees.cat_mask
     elif boosting_type == "dart":
         # DART (tree dropout): per iteration, drop a random subset of grown
         # trees, fit against the reduced scores, then renormalize — new tree
@@ -537,7 +570,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         forest_delta_j = jax.jit(forest_delta)
         dart_iter = jax.jit(make_iteration(update_train=False, update_valid=False))
         drop_rng = np.random.default_rng(seed + 17)
-        acc_f, acc_t, acc_v, acc_g, acc_c = [], [], [], [], []
+        acc_f, acc_t, acc_v, acc_g, acc_c, acc_cm = [], [], [], [], [], []
         # later drops rescale EARLIER trees' leaf values in place, so the
         # model measured at best_iter is only reproducible from a snapshot
         best_v = None
@@ -561,10 +594,11 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                 fs = jnp.stack([acc_f[i] for i in dropped])
                 ts = jnp.stack([acc_t[i] for i in dropped])
                 vs = jnp.stack([acc_v[i] for i in dropped])
-                delta_drop = forest_delta_j(fs, ts, vs, bins)
+                cs = jnp.stack([acc_cm[i] for i in dropped])
+                delta_drop = forest_delta_j(fs, ts, vs, cs, bins)
                 scores_red = scores - delta_drop
                 if has_valid:
-                    vdelta_drop = forest_delta_j(fs, ts, vs, vbins)
+                    vdelta_drop = forest_delta_j(fs, ts, vs, cs, vbins)
                     vscores = vscores - vdelta_drop
             else:
                 scores_red = scores
@@ -573,12 +607,14 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
             kd = len(dropped)
             norm_new = 1.0 / (kd + 1)
             delta_new = forest_delta_j(trees.feature[None], trees.threshold_bin[None],
-                                       trees.leaf_value[None], bins)
+                                       trees.leaf_value[None],
+                                       trees.cat_mask[None], bins)
             scores = scores_red + delta_new * norm_new
             if has_valid:
                 vdelta_new = forest_delta_j(trees.feature[None],
                                             trees.threshold_bin[None],
-                                            trees.leaf_value[None], vbins)
+                                            trees.leaf_value[None],
+                                            trees.cat_mask[None], vbins)
                 vscores = vscores + vdelta_new * norm_new
             if dropped:
                 norm_drop = kd / (kd + 1.0)
@@ -592,6 +628,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
             acc_v.append(trees.leaf_value * norm_new)
             acc_g.append(trees.gain)
             acc_c.append(trees.cover)
+            acc_cm.append(trees.cat_mask)
             if callbacks:
                 for cb in callbacks:
                     cb(iteration=it, scores=scores)
@@ -602,15 +639,17 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
             # their scales AS OF that iteration
             acc_f, acc_t = acc_f[:best_iter], acc_t[:best_iter]
             acc_g, acc_c = acc_g[:best_iter], acc_c[:best_iter]
+            acc_cm = acc_cm[:best_iter]
             acc_v = best_v[:best_iter]
         feat_dev = jnp.stack(acc_f)
         thr_dev = jnp.stack(acc_t)
         val_dev = jnp.stack(acc_v)
         gain_dev = jnp.stack(acc_g)
         cover_dev = jnp.stack(acc_c)
+        cat_dev = jnp.stack(acc_cm)
     else:
         iter_jit = jax.jit(one_iteration)
-        acc_f, acc_t, acc_v, acc_g, acc_c = [], [], [], [], []
+        acc_f, acc_t, acc_v, acc_g, acc_c, acc_cm = [], [], [], [], [], []
         for it in range(num_iterations):
             measures.count("iterations")
             with measures.measure("training"):
@@ -622,6 +661,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
             acc_v.append(trees.leaf_value)
             acc_g.append(trees.gain)
             acc_c.append(trees.cover)
+            acc_cm.append(trees.cat_mask)
             if callbacks:
                 for cb in callbacks:
                     cb(iteration=it, scores=scores)
@@ -634,6 +674,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         val_dev = jnp.stack(acc_v)
         gain_dev = jnp.stack(acc_g)
         cover_dev = jnp.stack(acc_c)
+        cat_dev = jnp.stack(acc_cm)
 
     # ONE host transfer for the whole forest; bin->value thresholds on host
     measures.mark("train_done")
@@ -642,6 +683,14 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     thr_bin_h = np.asarray(thr_dev)
     thr_val_h = np.where(feat_h >= 0,
                          ub[np.maximum(feat_h, 0), thr_bin_h], 0.0).astype(np.float32)
+    cat_mask_h = None
+    if cat_feats:
+        cat_mask_h = np.asarray(cat_dev, np.uint8)  # (T, K, M, B)
+        is_cat_lut = np.zeros(f + 1, bool)
+        is_cat_lut[list(cat_feats)] = True
+        # categorical nodes carry the left SET, not a threshold value
+        thr_val_h = np.where(is_cat_lut[np.maximum(feat_h, 0)] & (feat_h >= 0),
+                             0.0, thr_val_h).astype(np.float32)
 
     booster = TpuBooster(
         feat_h, thr_val_h, np.asarray(val_dev), np.asarray(gain_dev),
@@ -649,6 +698,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         max_depth=max_depth, num_model_out=K, objective=o.name, init_score=init,
         num_features=f, best_iteration=best_iter,
         average_output=boosting_type == "rf",
+        cat_mask=cat_mask_h, categorical_features=cat_feats,
         params={"num_iterations": num_iterations, "learning_rate": learning_rate,
                 "num_leaves": num_leaves, "max_bin": max_bin,
                 "boosting_type": boosting_type})

@@ -82,6 +82,11 @@ class _LightGBMParams:
     monotone_constraints = ComplexParam(
         "monotone_constraints", "per-feature +1/-1/0 monotonicity "
         "(reference monotoneConstraints; 'basic' method)", default=None)
+    categorical_slot_indexes = ComplexParam(
+        "categorical_slot_indexes", "feature indices treated as categorical "
+        "codes: LightGBM many-vs-many splits on sorted-gradient prefixes "
+        "(reference categoricalSlotIndexes, params/LightGBMParams.scala)",
+        default=None)
     early_stopping_round = Param("early_stopping_round", "stop after k rounds without "
                                  "validation improvement (0=off)", default=0,
                                  converter=TypeConverters.to_int)
@@ -147,6 +152,7 @@ class _LightGBMParams:
             early_stopping_round=self.get("early_stopping_round"),
             boosting_type=self.get("boosting_type"),
             monotone_constraints=self.get("monotone_constraints"),
+            categorical_features=self.get("categorical_slot_indexes"),
             top_rate=self.get("top_rate"), other_rate=self.get("other_rate"),
             drop_rate=self.get("drop_rate"), max_drop=self.get("max_drop"),
             skip_drop=self.get("skip_drop"),

@@ -15,8 +15,11 @@ training and rehydrates models). Here:
 LightGBM node encoding recap: per tree, arrays index INTERNAL nodes
 (``num_leaves - 1`` of them); ``left_child``/``right_child`` entries >= 0 are
 internal node ids, negative entries are leaves encoded as ``~leaf_idx``
-(= ``-leaf-1``). ``decision_type`` bit 1 = categorical (unsupported here),
-bit 2 = default-left (missing values go left).
+(= ``-leaf-1``). ``decision_type`` bit 1 = categorical (the node's
+``threshold`` is then an ordinal into ``cat_boundaries``, and
+``cat_threshold`` holds 32-bit bitset words of member categories — members
+route left, NaN/out-of-range/non-members right), bit 2 = default-left
+(missing values go left).
 """
 
 from __future__ import annotations
@@ -41,10 +44,23 @@ _ZERO_THRESHOLD = 1e-35
 # export: heap trees -> LightGBM child arrays
 # ---------------------------------------------------------------------------
 
+def _mask_to_words(mask: np.ndarray) -> list[int]:
+    """Bin-membership mask -> LightGBM 32-bit bitset words."""
+    cats = np.nonzero(mask)[0]
+    n_words = int(cats.max()) // 32 + 1 if cats.size else 1
+    words = [0] * n_words
+    for c in cats:
+        words[int(c) // 32] |= 1 << (int(c) % 32)
+    return words
+
+
 def _heap_to_children(feature: np.ndarray, threshold: np.ndarray,
-                      leaf_value: np.ndarray, gain: np.ndarray):
+                      leaf_value: np.ndarray, gain: np.ndarray,
+                      cat_mask: np.ndarray | None = None):
     """One heap tree -> (split_feature, split_gain, threshold, left, right,
-    leaf_values) in LightGBM encoding."""
+    leaf_values, decision_type, cat_boundaries, cat_threshold) in LightGBM
+    encoding. Categorical nodes (nonempty cat_mask row) get decision_type
+    bit 1 and a threshold that is their ordinal into cat_boundaries."""
     internal: list[int] = []          # heap idx of internal nodes, BFS order
     leaves: list[int] = []            # heap idx of leaf nodes, BFS order
     index_of: dict[int, int] = {}
@@ -61,15 +77,32 @@ def _heap_to_children(feature: np.ndarray, threshold: np.ndarray,
             index_of[h] = ~len(leaves)
             leaves.append(h)
     if not internal:  # single-leaf tree
-        return ([], [], [], [], [], [float(leaf_value[0])])
+        return ([], [], [], [], [], [float(leaf_value[0])], [], [0], [])
 
     left = [index_of[2 * h + 1] for h in internal]
     right = [index_of[2 * h + 2] for h in internal]
+    thr_out, dt_out = [], []
+    cat_boundaries, cat_words = [0], []
+    for h in internal:
+        is_cat = cat_mask is not None and bool(cat_mask[h].any())
+        if is_cat:
+            words = _mask_to_words(cat_mask[h])
+            thr_out.append(float(len(cat_boundaries) - 1))  # ordinal
+            # keep missing_type=NaN bits alongside the categorical bit so
+            # stock LightGBM treats NaN as missing (-> right), matching our
+            # routing, instead of coercing it to category 0
+            dt_out.append(_CAT_MASK | (_MISSING_NAN << 2))
+            cat_words.extend(words)
+            cat_boundaries.append(len(cat_words))
+        else:
+            thr_out.append(float(threshold[h]))
+            # NaN routes right: missing_type=NaN (bits 2-3 = 2), default_left=0
+            dt_out.append(_MISSING_NAN << 2)
     return ([int(feature[h]) for h in internal],
             [float(gain[h]) for h in internal],
-            [float(threshold[h]) for h in internal],
-            left, right,
-            [float(leaf_value[h]) for h in leaves])
+            thr_out, left, right,
+            [float(leaf_value[h]) for h in leaves],
+            dt_out, cat_boundaries, cat_words)
 
 
 def to_lightgbm_string(booster) -> str:
@@ -103,9 +136,12 @@ def to_lightgbm_string(booster) -> str:
     out.append("")
     for t in range(T):
         for k in range(K):
-            feat, gain, thr, left, right, leaf_vals = _heap_to_children(
+            cm = (None if getattr(booster, "cat_mask", None) is None
+                  else booster.cat_mask[t, k])
+            (feat, gain, thr, left, right, leaf_vals, dt, cat_b,
+             cat_w) = _heap_to_children(
                 booster.feature[t, k], booster.threshold_value[t, k],
-                booster.leaf_value[t, k], booster.gain[t, k])
+                booster.leaf_value[t, k], booster.gain[t, k], cat_mask=cm)
             if t == 0:
                 adj = float(booster.init_score[k])
                 if getattr(booster, "average_output", False):
@@ -114,18 +150,21 @@ def to_lightgbm_string(booster) -> str:
                     adj *= T
                 leaf_vals = [v + adj for v in leaf_vals]
             n_leaves = len(leaf_vals)
-            blk = [f"Tree={t * K + k}", f"num_leaves={n_leaves}", "num_cat=0"]
+            n_cat = len(cat_b) - 1 if cat_w else 0
+            blk = [f"Tree={t * K + k}", f"num_leaves={n_leaves}",
+                   f"num_cat={n_cat}"]
             if feat:
                 blk += [
                     "split_feature=" + " ".join(map(str, feat)),
                     "split_gain=" + " ".join(f"{g:.17g}" for g in gain),
                     "threshold=" + " ".join(f"{v:.17g}" for v in thr),
-                    # our trees route NaN right: missing_type=NaN (bits 2-3
-                    # = 2 -> value 8), default_left=0
-                    "decision_type=" + " ".join(["8"] * len(feat)),
+                    "decision_type=" + " ".join(map(str, dt)),
                     "left_child=" + " ".join(map(str, left)),
                     "right_child=" + " ".join(map(str, right)),
                 ]
+                if n_cat:
+                    blk += ["cat_boundaries=" + " ".join(map(str, cat_b)),
+                            "cat_threshold=" + " ".join(map(str, cat_w))]
             blk += ["leaf_value=" + " ".join(f"{v:.17g}" for v in leaf_vals),
                     "shrinkage=1", ""]
             out += blk
@@ -146,6 +185,8 @@ class _Tree:
     leaf_value: np.ndarray
     default_left: np.ndarray
     missing_type: np.ndarray
+    # (n_internal, B) uint8 member-category mask; all-zero rows = numerical
+    cat_member: np.ndarray | None = None
 
 
 @dataclass
@@ -177,18 +218,28 @@ class ImportedBooster:
                 return np.concatenate([a, np.full(n - len(a), fill, a.dtype)]) \
                     if len(a) < n else a
 
-            self._packed_cache = tuple(
+            packed = tuple(
                 np.stack([pad(getattr(t, name), m if name != "leaf_value" else L,
                               fill) for t in self.trees])
                 for name, fill in (("split_feature", 0), ("threshold", 0.0),
                                    ("left", -1), ("right", -1),
                                    ("leaf_value", 0.0), ("default_left", 0),
                                    ("missing_type", 0)))
+            B = max((t.cat_member.shape[1] for t in self.trees
+                     if t.cat_member is not None), default=0)
+            cmem = None
+            if B:
+                cmem = np.zeros((len(self.trees), m, B), np.uint8)
+                for i, t in enumerate(self.trees):
+                    if t.cat_member is not None and t.cat_member.size:
+                        ni, bi = t.cat_member.shape
+                        cmem[i, :ni, :bi] = t.cat_member
+            self._packed_cache = packed + (cmem,)
         return self._packed_cache
 
     def raw_score(self, features: np.ndarray,
                   num_iterations: int | None = None) -> np.ndarray:
-        feat, thr, left, right, leafv, dleft, mtype = self._packed()
+        feat, thr, left, right, leafv, dleft, mtype, cmem = self._packed()
         K = self.num_model_out
         n_it = num_iterations or self.best_iteration or self.num_iterations
         n_it = min(n_it, self.num_iterations)
@@ -196,7 +247,9 @@ class ImportedBooster:
         total = _walk_forest(x, jnp.asarray(feat), jnp.asarray(thr, jnp.float32),
                              jnp.asarray(left), jnp.asarray(right),
                              jnp.asarray(leafv, jnp.float32),
-                             jnp.asarray(dleft), jnp.asarray(mtype), K, n_it,
+                             jnp.asarray(dleft), jnp.asarray(mtype),
+                             None if cmem is None else jnp.asarray(cmem),
+                             K, n_it,
                              int(np.ceil(np.log2(leafv.shape[1] + 1))) + 2)
         out = np.asarray(total)
         if self.average_output:
@@ -216,8 +269,8 @@ class ImportedBooster:
         return np.asarray(o.transform(jnp.asarray(s)))
 
 
-@functools.partial(jax.jit, static_argnums=(8, 9, 10))
-def _walk_forest(x, feat, thr, left, right, leafv, dleft, mtype, K: int,
+@functools.partial(jax.jit, static_argnums=(9, 10, 11))
+def _walk_forest(x, feat, thr, left, right, leafv, dleft, mtype, cmem, K: int,
                  n_it: int, max_depth: int):
     """Sum leaf values over trees [0, n_it*K), per class K. Node state is the
     LightGBM encoding itself: >=0 internal, negative = settled leaf."""
@@ -226,6 +279,7 @@ def _walk_forest(x, feat, thr, left, right, leafv, dleft, mtype, K: int,
     def one_tree(t_idx):
         tf, tt = feat[t_idx], thr[t_idx]
         tl, tr, dv, mt = left[t_idx], right[t_idx], dleft[t_idx], mtype[t_idx]
+        cm = None if cmem is None else cmem[t_idx]
 
         def body(_, node):
             live = node >= 0
@@ -240,6 +294,10 @@ def _walk_forest(x, feat, thr, left, right, leafv, dleft, mtype, K: int,
                                    is_nan | (jnp.abs(v) <= _ZERO_THRESHOLD),
                                    is_nan)
             go_left = jnp.where(is_missing, dv[idx] > 0, v <= tt[idx])
+            if cm is not None:
+                from .trees import cat_route_left
+
+                go_left = cat_route_left(v, go_left, cm[idx])
             nxt = jnp.where(go_left, tl[idx], tr[idx])
             return jnp.where(live, nxt, node)
 
@@ -294,20 +352,35 @@ def parse_lightgbm_string(text: str) -> ImportedBooster:
     for blk in tree_blocks:
         d = _parse_block(blk)
         n_leaves = int(d.get("num_leaves", 1))
-        if int(d.get("num_cat", 0)) > 0 or any(
-                int(t) & _CAT_MASK for t in d.get("decision_type", "").split()):
-            raise NotImplementedError("categorical splits are not supported")
         if "split_feature" in d and n_leaves > 1:
             dt = [int(t) for t in d["decision_type"].split()]
+            thresholds = np.asarray(d["threshold"].split(), np.float64)
+            cat_member = None
+            n_cat = int(d.get("num_cat", 0))
+            if n_cat > 0:
+                bounds = [int(v) for v in d["cat_boundaries"].split()]
+                words = [int(v) for v in d["cat_threshold"].split()]
+                max_words = max(bounds[i + 1] - bounds[i] for i in range(n_cat))
+                B = 32 * max_words
+                cat_member = np.zeros((len(dt), B), np.uint8)
+                for j, t_dt in enumerate(dt):
+                    if t_dt & _CAT_MASK:
+                        o = int(thresholds[j])
+                        for wi, w in enumerate(words[bounds[o]:bounds[o + 1]]):
+                            w &= 0xFFFFFFFF
+                            for b in range(32):
+                                if (w >> b) & 1:
+                                    cat_member[j, wi * 32 + b] = 1
             trees.append(_Tree(
                 split_feature=np.asarray(d["split_feature"].split(), np.int32),
-                threshold=np.asarray(d["threshold"].split(), np.float64),
+                threshold=thresholds,
                 left=np.asarray(d["left_child"].split(), np.int32),
                 right=np.asarray(d["right_child"].split(), np.int32),
                 leaf_value=np.asarray(d["leaf_value"].split(), np.float64),
                 default_left=np.asarray(
                     [(t & _DEFAULT_LEFT_MASK) > 0 for t in dt], np.int32),
-                missing_type=np.asarray([(t >> 2) & 3 for t in dt], np.int32)))
+                missing_type=np.asarray([(t >> 2) & 3 for t in dt], np.int32),
+                cat_member=cat_member))
         else:
             trees.append(_Tree(
                 split_feature=np.zeros(0, np.int32),
@@ -353,16 +426,35 @@ def _imported_to_string(b: "ImportedBooster") -> str:
         out.append("average_output")
     out.append("")
     for i, t in enumerate(b.trees):
-        blk = [f"Tree={i}", f"num_leaves={len(t.leaf_value)}", "num_cat=0"]
+        cat_b, cat_w, dts, thr_out = [0], [], [], []
+        for j in range(len(t.split_feature)):
+            is_cat = (t.cat_member is not None and j < len(t.cat_member)
+                      and bool(t.cat_member[j].any()))
+            if is_cat:
+                words = _mask_to_words(t.cat_member[j])
+                thr_out.append(float(len(cat_b) - 1))
+                dts.append(_CAT_MASK
+                           | int(_DEFAULT_LEFT_MASK * bool(t.default_left[j]))
+                           | (int(t.missing_type[j]) << 2))
+                cat_w.extend(words)
+                cat_b.append(len(cat_w))
+            else:
+                thr_out.append(float(t.threshold[j]))
+                dts.append(int(_DEFAULT_LEFT_MASK * bool(t.default_left[j]))
+                           | (int(t.missing_type[j]) << 2))
+        n_cat = len(cat_b) - 1 if cat_w else 0
+        blk = [f"Tree={i}", f"num_leaves={len(t.leaf_value)}",
+               f"num_cat={n_cat}"]
         if len(t.split_feature):
-            dts = [int(_DEFAULT_LEFT_MASK * bool(dl)) | (int(mt) << 2)
-                   for dl, mt in zip(t.default_left, t.missing_type)]
             blk += ["split_feature=" + " ".join(map(str, t.split_feature)),
                     "split_gain=" + " ".join(["0"] * len(t.split_feature)),
-                    "threshold=" + " ".join(f"{v:.17g}" for v in t.threshold),
+                    "threshold=" + " ".join(f"{v:.17g}" for v in thr_out),
                     "decision_type=" + " ".join(map(str, dts)),
                     "left_child=" + " ".join(map(str, t.left)),
                     "right_child=" + " ".join(map(str, t.right))]
+            if n_cat:
+                blk += ["cat_boundaries=" + " ".join(map(str, cat_b)),
+                        "cat_threshold=" + " ".join(map(str, cat_w))]
         blk += ["leaf_value=" + " ".join(f"{v:.17g}" for v in t.leaf_value),
                 "shrinkage=1", ""]
         out += blk

@@ -83,8 +83,9 @@ def _unwind(m: _Path, i: int) -> _Path:
     return out
 
 
-def _tree_shap(feature, threshold, value, cover, X, phi):
-    """Accumulate one tree's contributions into phi (N, F+1)."""
+def _tree_shap(feature, threshold, value, cover, X, phi, cat_mask=None):
+    """Accumulate one tree's contributions into phi (N, F+1); ``cat_mask``
+    (M, B) uint8 routes categorical nodes by left-set membership."""
     N = X.shape[0]
 
     def recurse(node: int, m: _Path, pz: float, po: np.ndarray, pi: int):
@@ -108,7 +109,15 @@ def _tree_shap(feature, threshold, value, cover, X, phi):
                     phi[:, m.f[i]] += w * (m.o[i] - m.z[i]) * v
             return
         left, right = 2 * node + 1, 2 * node + 2
-        go_left = (X[:, f] <= threshold[node]).astype(np.float64)
+        if cat_mask is not None and cat_mask[node].any():
+            B = cat_mask.shape[1]
+            col = X[:, f]
+            code = np.floor(col)
+            valid = np.isfinite(col) & (code >= 0) & (code < B)
+            idx = np.where(valid, code, 0).astype(np.int64)
+            go_left = (valid & (cat_mask[node][idx] > 0)).astype(np.float64)
+        else:
+            go_left = (X[:, f] <= threshold[node]).astype(np.float64)
         c = max(float(cover[node]), 1e-12)
         zl = float(cover[left]) / c
         zr = float(cover[right]) / c
@@ -128,7 +137,8 @@ def _tree_shap(feature, threshold, value, cover, X, phi):
 
 def forest_shap(feature: np.ndarray, threshold_value: np.ndarray,
                 leaf_value: np.ndarray, cover: np.ndarray,
-                init_score: np.ndarray, X: np.ndarray) -> np.ndarray:
+                init_score: np.ndarray, X: np.ndarray,
+                cat_mask: np.ndarray | None = None) -> np.ndarray:
     """(N, K, F+1) SHAP contributions for a stacked forest.
 
     feature/threshold_value/leaf_value/cover: (T, K, M); init_score: (K,).
@@ -144,5 +154,6 @@ def forest_shap(feature: np.ndarray, threshold_value: np.ndarray,
         phi[:, -1] += float(init_score[k])
         for t in range(T):
             _tree_shap(feature[t, k], threshold_value[t, k], leaf_value[t, k],
-                       cover[t, k], X, phi)
+                       cover[t, k], X, phi,
+                       cat_mask=None if cat_mask is None else cat_mask[t, k])
     return out

@@ -55,6 +55,15 @@ class GrowthConfig(NamedTuple):
     # (row-chunked one-hot matmul — MXU-shaped; scatter serializes on TPU).
     # Equivalent results; pick by measurement (benchmarks/gbdt_hist_backends.py)
     hist_impl: str = "segment"
+    # categorical features (sorted feature indices; their bins ARE the raw
+    # category codes). Split finding is LightGBM's many-vs-many: bins sorted
+    # per node by grad/(hess+cat_smooth), prefixes of the sorted order are
+    # the candidate left sets — the SAME cumulative-histogram scan as
+    # numerical thresholds, just through a per-node permutation (reference
+    # params categoricalSlotIndexes, BaseTrainParams.scala)
+    categorical_features: tuple = ()
+    max_cat_threshold: int = 32
+    cat_smooth: float = 10.0
 
 
 class TreeArrays(NamedTuple):
@@ -65,6 +74,11 @@ class TreeArrays(NamedTuple):
     leaf_value: jax.Array  # (M,) float32
     gain: jax.Array  # (M,) float32, split gain (0 at leaves) — feeds importance
     cover: jax.Array  # (M,) float32, rows reaching the node — feeds TreeSHAP
+    # (M, B) uint8 left-membership per bin for categorical splits; (M, 1)
+    # zeros when the config has no categorical features. A node is
+    # categorical iff its row has any nonzero (valid cat splits always
+    # have a nonempty left set)
+    cat_mask: jax.Array = None
 
 
 def max_nodes(max_depth: int) -> int:
@@ -160,7 +174,7 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
     @jax.jit
     def step(bins, grad, hess, presence, node_of_row, feature, threshold_bin,
              leaf_value, node_gain, node_cover, feat_mask, leaf_count,
-             node_lo, node_hi):
+             node_lo, node_hi, cat_mask_tree):
         hist = _level_histogram(bins, grad, hess, presence, node_of_row, base,
                                 width, B, hist_impl=cfg.hist_impl)
         cum = jnp.cumsum(hist, axis=2)  # (W, F, B, 3)
@@ -169,6 +183,40 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
 
         left = cum[:, :, :num_thresholds, :]  # (W, F, B-1, 3)
         gl, hl, cl = left[..., 0], left[..., 1], left[..., 2]
+
+        cat_order = None
+        if cfg.categorical_features:
+            F = bins.shape[1]
+            cat_idx = np.asarray(cfg.categorical_features, np.int32)
+            is_cat_f = np.zeros(F, bool)
+            is_cat_f[cat_idx] = True
+            # candidate left sets = prefixes of bins sorted by g/(h+smooth);
+            # zero-count bins and the NaN bin (last) are never members, so
+            # unseen/missing categories route right at predict time. Only
+            # the CATEGORICAL columns pay the argsort/cumsum (static gather
+            # + scatter-back keeps numerical columns untouched).
+            hist_c = hist[:, cat_idx]  # (W, Fc, B, 3)
+            gb, hb, cb = hist_c[..., 0], hist_c[..., 1], hist_c[..., 2]
+            eligible = (cb > 0) & (jnp.arange(B) != B - 1)[None, None, :]
+            ratio = jnp.where(eligible, gb / (hb + cfg.cat_smooth), jnp.inf)
+            cat_order = jnp.argsort(ratio, axis=2)  # (W, Fc, B)
+            sg = jnp.take_along_axis(jnp.where(eligible, gb, 0.0), cat_order, 2)
+            sh = jnp.take_along_axis(jnp.where(eligible, hb, 0.0), cat_order, 2)
+            sc = jnp.take_along_axis(jnp.where(eligible, cb, 0.0), cat_order, 2)
+            s_ok = jnp.take_along_axis(eligible, cat_order, 2)
+            gl = gl.at[:, cat_idx].set(jnp.cumsum(sg, axis=2)[:, :, :num_thresholds])
+            hl = hl.at[:, cat_idx].set(jnp.cumsum(sh, axis=2)[:, :, :num_thresholds])
+            cl = cl.at[:, cat_idx].set(jnp.cumsum(sc, axis=2)[:, :, :num_thresholds])
+            # prefix k (index k-1) valid iff its last bin is eligible and the
+            # left set stays within max_cat_threshold categories
+            valid_k = (s_ok[:, :, :num_thresholds]
+                       & (jnp.arange(num_thresholds) < cfg.max_cat_threshold
+                          )[None, None, :])
+            # position of each cat feature within cat_idx (for the winning
+            # node's order lookup below)
+            cat_pos = np.zeros(F, np.int32)
+            cat_pos[cat_idx] = np.arange(len(cat_idx), dtype=np.int32)
+
         gr = g_tot[:, None, None] - gl
         hr = h_tot[:, None, None] - hl
         cr = c_tot[:, None, None] - cl
@@ -178,6 +226,8 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
         ok = ((cl >= cfg.min_data_in_leaf) & (cr >= cfg.min_data_in_leaf)
               & (hl >= cfg.min_sum_hessian) & (hr >= cfg.min_sum_hessian)
               & feat_mask[None, :, None])
+        if cfg.categorical_features:
+            ok = ok.at[:, cat_idx].set(ok[:, cat_idx] & valid_k)
         if mono is not None:
             # monotone gating: a split on a constrained feature is only valid
             # if the would-be child values respect the direction
@@ -206,6 +256,20 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
         node_ids = base + jnp.arange(width, dtype=jnp.int32)
         feature = feature.at[node_ids].set(jnp.where(do_split, best_feat, -1))
         threshold_bin = threshold_bin.at[node_ids].set(jnp.where(do_split, best_thr, 0))
+
+        member = None
+        if cfg.categorical_features:
+            # materialize the winning left set: bins whose rank in the
+            # node's sorted order falls inside the chosen prefix
+            best_cat_pos = jnp.asarray(cat_pos)[best_feat]
+            best_order = jnp.take_along_axis(
+                cat_order, best_cat_pos[:, None, None], axis=1)[:, 0]  # (W, B)
+            inv_rank = jnp.argsort(best_order, axis=-1)  # inverse permutation
+            is_cat_best = jnp.asarray(is_cat_f)[best_feat]
+            member = ((inv_rank <= best_thr[:, None])
+                      & (is_cat_best & do_split)[:, None])  # (W, B)
+            cat_mask_tree = cat_mask_tree.at[node_ids].set(
+                member.astype(jnp.uint8))
         lo = node_lo[node_ids]
         hi = node_hi[node_ids]
         # active nodes that do not split become final leaves now (clamped to
@@ -246,10 +310,15 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
         f_of_row = best_feat[rel]
         row_bin = jnp.take_along_axis(bins, f_of_row[:, None].astype(jnp.int32), axis=1)[:, 0]
         go_left = row_bin.astype(jnp.int32) <= best_thr[rel]
+        if cfg.categorical_features:
+            in_set = jnp.take_along_axis(
+                member[rel], row_bin[:, None].astype(jnp.int32), axis=1)[:, 0]
+            go_left = jnp.where(jnp.asarray(is_cat_f)[f_of_row], in_set,
+                                go_left)
         child = 2 * node_of_row + jnp.where(go_left, 1, 2)
         node_of_row = jnp.where(row_split, child, node_of_row)
         return (node_of_row, feature, threshold_bin, leaf_value, node_gain,
-                node_cover, leaf_count, node_lo, node_hi)
+                node_cover, leaf_count, node_lo, node_hi, cat_mask_tree)
 
     return step
 
@@ -300,27 +369,39 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array, presence: jax.A
     node_hi = jnp.full(m, jnp.inf, jnp.float32)
     node_of_row = jnp.zeros(bins.shape[0], jnp.int32)
     leaf_count = jnp.asarray(1, jnp.int32)
+    cat_width = cfg.num_bins if cfg.categorical_features else 1
+    cat_mask = jnp.zeros((m, cat_width), jnp.uint8)
 
     steps, final = _level_steps(cfg)
     for step in steps:
         (node_of_row, feature, threshold_bin, leaf_value, node_gain, node_cover,
-         leaf_count, node_lo, node_hi) = step(
+         leaf_count, node_lo, node_hi, cat_mask) = step(
             bins, grad, hess, presence, node_of_row, feature, threshold_bin,
             leaf_value, node_gain, node_cover, feat_mask, leaf_count,
-            node_lo, node_hi)
+            node_lo, node_hi, cat_mask)
     leaf_value, node_cover = final(grad, hess, presence, node_of_row,
                                    leaf_value, node_cover, node_lo, node_hi)
-    return TreeArrays(feature, threshold_bin, leaf_value, node_gain, node_cover)
+    return TreeArrays(feature, threshold_bin, leaf_value, node_gain, node_cover,
+                      cat_mask)
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
 def traverse_binned(bins: jax.Array, tree: TreeArrays, max_depth: int) -> jax.Array:
-    """Leaf values for binned rows (used to update train scores incrementally)."""
+    """Leaf values for binned rows (used to update train scores incrementally).
+    A node routes categorically iff its cat_mask row is nonempty (valid
+    categorical splits always have a nonempty left set)."""
+    has_cat = tree.cat_mask is not None and tree.cat_mask.shape[1] > 1
 
     def body(_, node):
         f = tree.feature[node]
         b = jnp.take_along_axis(bins, jnp.maximum(f, 0)[:, None].astype(jnp.int32), axis=1)[:, 0]
         go_left = b.astype(jnp.int32) <= tree.threshold_bin[node]
+        if has_cat:
+            mask_row = tree.cat_mask[node]  # (N, B)
+            is_cat = mask_row.sum(axis=1) > 0
+            in_set = jnp.take_along_axis(
+                mask_row, b[:, None].astype(jnp.int32), axis=1)[:, 0] > 0
+            go_left = jnp.where(is_cat, in_set, go_left)
         child = 2 * node + jnp.where(go_left, 1, 2)
         return jnp.where(f < 0, node, child)
 
@@ -329,22 +410,46 @@ def traverse_binned(bins: jax.Array, tree: TreeArrays, max_depth: int) -> jax.Ar
     return tree.leaf_value[node]
 
 
+def cat_route_left(fv: jax.Array, go_left: jax.Array,
+                   mask_node: jax.Array | None) -> jax.Array:
+    """Overlay categorical routing on a numerical go-left decision: nodes
+    whose mask row is nonempty route by left-set membership of the raw
+    category code; NaN / out-of-range / non-members route right. THE single
+    routing rule — shared by raw prediction, leaf indexing, and the
+    imported-model walker so they cannot diverge."""
+    if mask_node is None:
+        return go_left
+    B = mask_node.shape[-1]
+    is_cat = mask_node.sum(axis=-1) > 0
+    idx = jnp.clip(fv.astype(jnp.int32), 0, B - 1)
+    in_set = (jnp.take_along_axis(mask_node, idx[:, None], axis=1)[:, 0] > 0) \
+        & (fv >= 0) & (fv < B)
+    return jnp.where(is_cat, in_set, go_left)
+
+
 def predict_raw_forest(x: jax.Array, feature: jax.Array, threshold_value: jax.Array,
-                       leaf_value: jax.Array, max_depth: int) -> jax.Array:
+                       leaf_value: jax.Array, max_depth: int,
+                       cat_masks: jax.Array | None = None) -> jax.Array:
     """Raw-feature forest prediction (standalone model, no BinMapper needed).
 
-    ``feature``/``threshold_value``/``leaf_value``: (T, M) stacked trees.
-    Returns per-tree leaf sums (N,). NaN features route right (comparisons
-    with NaN are False), matching training's NaN-bin-goes-right rule.
+    ``feature``/``threshold_value``/``leaf_value``: (T, M) stacked trees;
+    ``cat_masks``: optional (T, M, B) uint8 — for categorical nodes the raw
+    value IS the category code, membership routes left. Returns per-tree
+    leaf sums (N,). NaN/out-of-range features route right (comparisons with
+    NaN are False; non-members route right), matching training's
+    NaN-bin-goes-right rule.
     """
 
+    def _go_left(fv, thr_node, mask_node):
+        return cat_route_left(fv, fv <= thr_node, mask_node)
+
     def one_tree(carry, tree):
-        feat, thr, val = tree
+        feat, thr, val, cm = tree
 
         def body(_, node):
             f = feat[node]
             fv = jnp.take_along_axis(x, jnp.maximum(f, 0)[:, None].astype(jnp.int32), axis=1)[:, 0]
-            go_left = fv <= thr[node]
+            go_left = _go_left(fv, thr[node], None if cm is None else cm[node])
             child = 2 * node + jnp.where(go_left, 1, 2)
             return jnp.where(f < 0, node, child)
 
@@ -352,26 +457,29 @@ def predict_raw_forest(x: jax.Array, feature: jax.Array, threshold_value: jax.Ar
         return carry + val[node], None
 
     out, _ = jax.lax.scan(one_tree, jnp.zeros(x.shape[0], jnp.float32),
-                          (feature, threshold_value, leaf_value))
+                          (feature, threshold_value, leaf_value, cat_masks))
     return out
 
 
 def leaf_index_forest(x: jax.Array, feature: jax.Array, threshold_value: jax.Array,
-                      max_depth: int) -> jax.Array:
+                      max_depth: int,
+                      cat_masks: jax.Array | None = None) -> jax.Array:
     """Per-tree leaf index for each row, shape (N, T) — the reference's
     ``predictLeaf`` output (``LightGBMBooster.scala:394`` area)."""
 
     def one_tree(carry, tree):
-        feat, thr = tree
+        feat, thr, cm = tree
 
         def body(_, node):
             f = feat[node]
             fv = jnp.take_along_axis(x, jnp.maximum(f, 0)[:, None].astype(jnp.int32), axis=1)[:, 0]
-            child = 2 * node + jnp.where(fv <= thr[node], 1, 2)
+            go_left = cat_route_left(fv, fv <= thr[node],
+                                     None if cm is None else cm[node])
+            child = 2 * node + jnp.where(go_left, 1, 2)
             return jnp.where(f < 0, node, child)
 
         node = jax.lax.fori_loop(0, max_depth, body, jnp.zeros(x.shape[0], jnp.int32))
         return carry, node
 
-    _, nodes = jax.lax.scan(one_tree, 0, (feature, threshold_value))
+    _, nodes = jax.lax.scan(one_tree, 0, (feature, threshold_value, cat_masks))
     return jnp.swapaxes(nodes, 0, 1)
