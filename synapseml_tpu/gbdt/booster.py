@@ -453,7 +453,10 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         return bag, fmask
 
     def make_iteration(update_train: bool = True, update_valid: bool = True):
-        def one_iteration(carry, it):
+        def one_iteration(data, carry, it):
+            # explicit data args, NOT closures: multi-process sharded arrays
+            # may not be closed over by jitted functions
+            bins, yd, base_presence, wd, vbins = data
             scores, vscores = carry
             bag, fmask = _masks(it)
             presence = base_presence * bag
@@ -499,6 +502,8 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         return one_iteration
 
     one_iteration = make_iteration(update_train=boosting_type != "rf")
+    # the validation bins ride in the bundle only when they exist
+    data = (bins, yd, base_presence, wd, vbins if has_valid else bins[:1])
 
     if not has_valid:
         vscores = jnp.zeros((1, K), jnp.float32)  # placeholder carry leaf
@@ -551,12 +556,13 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         # no per-iteration host decision needed: the ENTIRE training run is
         # one compiled program
         @jax.jit
-        def run_all(scores, vscores):
-            return jax.lax.scan(one_iteration, (scores, vscores),
+        def run_all(data, scores, vscores):
+            return jax.lax.scan(lambda c, i: one_iteration(data, c, i),
+                                (scores, vscores),
                                 jnp.arange(num_iterations, dtype=jnp.int32))
 
         with measures.measure("training"):
-            (scores, vscores), trees = run_all(scores, vscores)
+            (scores, vscores), trees = run_all(data, scores, vscores)
             jax.block_until_ready(trees.feature)
         measures.count("iterations", num_iterations)
         feat_dev, thr_dev = trees.feature, trees.threshold_bin   # (T, K, M)
@@ -602,7 +608,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                     vscores = vscores - vdelta_drop
             else:
                 scores_red = scores
-            _, trees = dart_iter((scores_red, vscores),
+            _, trees = dart_iter(data, (scores_red, vscores),
                                  jnp.asarray(it, jnp.int32))
             kd = len(dropped)
             norm_new = 1.0 / (kd + 1)
@@ -654,7 +660,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
             measures.count("iterations")
             with measures.measure("training"):
                 (scores, vscores), trees = iter_jit(
-                    (scores, vscores), jnp.asarray(it, jnp.int32))
+                    data, (scores, vscores), jnp.asarray(it, jnp.int32))
             # device arrays accumulate WITHOUT host sync; fetched once at the end
             acc_f.append(trees.feature)
             acc_t.append(trees.threshold_bin)

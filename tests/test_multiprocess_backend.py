@@ -51,32 +51,98 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_rendezvous_and_psum(tmp_path):
+def _run_two_workers(script_text: str, tmp_path, partition_order,
+                     timeout_s: float = 420.0) -> list[str]:
+    """Launch the worker script in 2 OS processes through a
+    DriverRendezvous; return each worker's combined output (asserting
+    rc=0). Shared by every multi-process test in this file."""
+    import pathlib
+
     script = tmp_path / "worker.py"
-    script.write_text(WORKER)
+    script.write_text(script_text)
+    repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
 
     driver = DriverRendezvous(world_size=2, coordinator_port=_free_port())
     driver.start()
     addr = f"127.0.0.1:{driver.port}"
-
     env = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
-           "PYTHONPATH": "/root/repo", "HOME": "/root",
+           "PYTHONPATH": repo_root, "HOME": "/root",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
-    # launch in partition order 1, 0: rank assignment must follow partition id,
-    # not arrival order (NetworkManager's min-partition ordering)
-    procs = [subprocess.Popen([sys.executable, str(script), addr, f"exec-{p}", str(p)],
-                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                              text=True, env=env)
-             for p in (1, 0)]
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), addr, f"exec-{p}", str(p)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for p in partition_order]
     driver.join(timeout_s=120)
     outs = []
     for proc in procs:
-        out, _ = proc.communicate(timeout=150)
+        out, _ = proc.communicate(timeout=timeout_s)
         outs.append(out)
         assert proc.returncode == 0, f"worker failed:\n{out}"
+    return outs
+
+
+def test_two_process_rendezvous_and_psum(tmp_path):
+    # launch in partition order 1, 0: rank assignment must follow partition id,
+    # not arrival order (NetworkManager's min-partition ordering)
+    outs = _run_two_workers(WORKER, tmp_path, partition_order=(1, 0),
+                            timeout_s=150)
 
     # partition 1 -> rank 1, partition 0 -> rank 0
     assert "RANK 1" in outs[0] and "RANK 0" in outs[1], outs
     for out in outs:
         assert "procs 2" in out and "devices 2" in out
         assert "PSUM 3.0" in out  # 1 + 2 across the two processes
+
+
+GBDT_WORKER = textwrap.dedent("""
+    import sys
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from synapseml_tpu.parallel.backend import initialize_backend
+
+    driver_addr, executor_id, partition_id = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    backend = initialize_backend(driver_addr, executor_id=executor_id,
+                                 partition_id=partition_id)
+    assert backend.initialized and backend.world == 2
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    # both processes hold the same global table; device_put scatters each
+    # process's addressable row shard over the cross-process data axis
+    rs = np.random.default_rng(0)
+    N, F = 2000, 8
+    X = rs.normal(size=(N, F)).astype(np.float32)
+    w = rs.normal(size=F)
+    y = ((X @ w) > 0).astype(np.float32)
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    b = train_booster(X, y, objective="binary", num_iterations=5,
+                      learning_rate=0.3, num_leaves=7, max_depth=3,
+                      min_data_in_leaf=5, seed=0, mesh=mesh)
+    # forest arrays come back replicated: both ranks must hold the SAME model
+    print("FEATSUM", int(np.sum(b.feature[b.feature >= 0])), flush=True)
+    acc = float(((np.asarray(b.predict(X)).ravel() > 0.5) == (y > 0.5)).mean())
+    print(f"ACC {acc:.3f}", flush=True)
+    assert acc > 0.85, acc
+""")
+
+
+@pytest.mark.slow
+def test_two_process_distributed_gbdt_training(tmp_path):
+    """FULL GBDT training across 2 OS processes: rows shard over a
+    cross-process data axis, so every level's histogram reduction IS a
+    cross-process collective (the reference's NetworkManager socket-ring
+    allreduce during LGBM_BoosterUpdateOneIter, ``TrainUtils.scala:98``) —
+    and both ranks must finish holding the identical forest."""
+    outs = _run_two_workers(GBDT_WORKER, tmp_path, partition_order=(0, 1))
+    featsums = {ln for o in outs for ln in o.splitlines()
+                if ln.startswith("FEATSUM")}
+    assert len(featsums) == 1, featsums  # identical forest on both ranks
+    for out in outs:
+        assert "ACC " in out
