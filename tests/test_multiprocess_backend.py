@@ -72,13 +72,26 @@ def _run_two_workers(script_text: str, tmp_path, partition_order,
         [sys.executable, str(script), addr, f"exec-{p}", str(p)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
         for p in partition_order]
-    driver.join(timeout_s=120)
-    outs = []
-    for proc in procs:
-        out, _ = proc.communicate(timeout=timeout_s)
-        outs.append(out)
-        assert proc.returncode == 0, f"worker failed:\n{out}"
-    return outs
+    try:
+        driver.join(timeout_s=120)
+        # Thread.join returns silently on timeout: a live thread here means
+        # the rendezvous never completed — fail NOW with worker output
+        # instead of burning the communicate timeout on each worker
+        if driver._thread.is_alive():
+            tails = [p.stdout.read() if p.poll() is not None else "<running>"
+                     for p in procs]
+            raise TimeoutError(f"rendezvous incomplete after 120s: {tails}")
+        outs = []
+        for proc in procs:
+            out, _ = proc.communicate(timeout=timeout_s)
+            outs.append(out)
+            assert proc.returncode == 0, f"worker failed:\n{out}"
+        return outs
+    finally:
+        for proc in procs:  # never leave an orphaned worker pinning the CPU
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
 
 
 def test_two_process_rendezvous_and_psum(tmp_path):
@@ -146,3 +159,57 @@ def test_two_process_distributed_gbdt_training(tmp_path):
     assert len(featsums) == 1, featsums  # identical forest on both ranks
     for out in outs:
         assert "ACC " in out
+
+
+DL_WORKER = textwrap.dedent("""
+    import sys
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from synapseml_tpu.parallel.backend import initialize_backend
+
+    driver_addr, executor_id, partition_id = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    backend = initialize_backend(driver_addr, executor_id=executor_id,
+                                 partition_id=partition_id)
+    assert backend.initialized and backend.world == 2
+
+    import numpy as np
+
+    from synapseml_tpu.models.flax_nets.bert import BertClassifier, bert_tiny
+    from synapseml_tpu.models.trainer import Trainer, TrainerConfig
+    from synapseml_tpu.parallel import MeshConfig
+    from synapseml_tpu.parallel.mesh import create_mesh
+
+    cfg = bert_tiny(n_layers=2)
+    model = BertClassifier(cfg, num_classes=2)
+    rs = np.random.default_rng(0)
+    batch = {
+        "input_ids": rs.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32),
+        "attention_mask": np.ones((8, 16), np.int32),
+        "labels": rs.integers(0, 2, (8,)).astype(np.int32),
+    }
+    mesh = create_mesh(MeshConfig(data=-1))  # data axis spans both processes
+    tr = Trainer(model, mesh, TrainerConfig(learning_rate=1e-3, total_steps=3))
+    state = tr.init_state(batch)
+    losses = []
+    for _ in range(3):
+        state, m = tr.train_step(state, batch)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+    print("LOSSES " + " ".join(f"{l:.6f}" for l in losses), flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_data_parallel_train_step(tmp_path):
+    """The deep-learning trainer's data-parallel step across 2 OS
+    processes: the gradient psum rides the cross-process mesh axis (the
+    reference's horovod.spark allreduce role), losses decrease, and both
+    ranks must observe the IDENTICAL loss curve (same replicated params)."""
+    outs = _run_two_workers(DL_WORKER, tmp_path, partition_order=(0, 1))
+    curves = {ln for o in outs for ln in o.splitlines()
+              if ln.startswith("LOSSES")}
+    assert len(curves) == 1, curves  # identical replicated training on both
