@@ -19,27 +19,66 @@ def run(jax, platform, n_chips):
     w = rng.normal(size=F); w[F//2:] = 0
     logits = X @ w * 0.5 + rng.normal(size=N + n_test) * 0.5
     y = (logits > 0).astype(np.float32)
-    t0 = time.perf_counter()
-    n_iter = 100 if platform == "tpu" else 20
-    booster = train_booster(X[:N], y[:N], objective="binary",
-                            num_iterations=n_iter, learning_rate=0.1,
-                            num_leaves=31, max_bin=255)
-    train_s = time.perf_counter() - t0
+    degraded = None
+    if platform == "tpu":
+        # The 2026-07-31 window died inside this child with "UNAVAILABLE: TPU
+        # device error" at full scale, then the relay hung — which leaves
+        # "our kernel faults anywhere" vs "scale-dependent" vs "relay infra"
+        # undistinguished. A 20k-row canary first makes the failure mode
+        # informative: canary fails => universal/infra; canary passes but
+        # 1M fails => scale. On a scale failure, retry at smaller N so a
+        # partial chip number still lands in the driver artifact.
+        t0 = time.perf_counter()
+        train_booster(X[:20_000], y[:20_000], objective="binary",
+                      num_iterations=5, learning_rate=0.1,
+                      num_leaves=31, max_bin=255)
+        print(f"# gbdt canary 20k ok in {time.perf_counter() - t0:.1f}s",
+              flush=True)
+    scales = [N, 250_000, 100_000] if platform == "tpu" else [N]
+    for attempt_n in scales:
+        n_iter = 100 if platform == "tpu" else 20
+        try:
+            t0 = time.perf_counter()
+            booster = train_booster(X[:attempt_n], y[:attempt_n],
+                                    objective="binary",
+                                    num_iterations=n_iter, learning_rate=0.1,
+                                    num_leaves=31, max_bin=255)
+            train_s = time.perf_counter() - t0
+            if attempt_n != N:
+                degraded = f"device error at {N} rows; measured at {attempt_n}"
+            N = attempt_n
+            break
+        except Exception as e:  # noqa: BLE001 — device errors surface as JaxRuntimeError
+            print(f"# gbdt {attempt_n}-row train failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+            if attempt_n == scales[-1]:
+                raise
     n_pred = n_test
     t0 = time.perf_counter()
-    p = booster.predict(X[N:])
+    p = booster.predict(X[-n_test:])  # last n_test rows: held out at every fallback scale
     pred_s = time.perf_counter() - t0
-    auc_y, auc_p = y[N:], np.asarray(p).ravel()
+    auc_y, auc_p = y[-n_test:], np.asarray(p).ravel()
     from scipy.stats import rankdata
     ranks = rankdata(auc_p)  # average tied ranks (exact Mann-Whitney)
     n1 = auc_y.sum(); n0 = len(auc_y) - n1
     auc = (ranks[auc_y == 1].sum() - n1*(n1+1)/2) / (n1*n0)
-    return {"metric": "LightGBM Higgs-1M train" if platform == "tpu"
-            else "LightGBM 50k (CPU smoke)",
-            "value": round(N * n_iter / train_s), "unit": "row-iters/sec",
-            "platform": platform, "train_s": round(train_s, 2),
-            "pred_rows": n_pred, "pred_s": round(pred_s, 3),
-            "auc": round(float(auc), 4)}
+    # a degraded-scale run gets its own metric key: row-iters/sec at 100k
+    # rows is not comparable to 1M rows, and keep-best seeding must never
+    # pin a small-scale number as the Higgs-1M baseline
+    if platform != "tpu":
+        metric = "LightGBM 50k (CPU smoke)"
+    elif degraded:
+        metric = f"LightGBM GBDT {N // 1000}k train (degraded fallback)"
+    else:
+        metric = "LightGBM Higgs-1M train"
+    result = {"metric": metric,
+              "value": round(N * n_iter / train_s), "unit": "row-iters/sec",
+              "platform": platform, "train_s": round(train_s, 2),
+              "pred_rows": n_pred, "pred_s": round(pred_s, 3),
+              "auc": round(float(auc), 4)}
+    if degraded:
+        result["degraded"] = degraded
+    return result
 
 
 def main():

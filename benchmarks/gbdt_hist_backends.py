@@ -40,7 +40,8 @@ def run(jax, platform, n_chips):
     return {
         "metric": "GBDT histogram backend train time"
                   + ("" if on_tpu else " (CPU smoke)"),
-        "value": min(times.values()), "unit": "s", "platform": platform,
+        "value": min(times.values()), "unit": "s", "lower_is_better": True,
+        "platform": platform,
         "rows": N, "iters": n_iter,
         "segment_s": times["segment"], "onehot_s": times["onehot"],
         "speedup_onehot": round(times["segment"] / times["onehot"], 2),

@@ -1,0 +1,90 @@
+"""Relay-window watcher: capture the missing BASELINE TPU numbers.
+
+The axon relay serves brief, unpredictable windows (round 2: one window;
+rounds 3: none; round 4: one window that captured the flagship then
+degraded). This watcher loops for as long as it is left running: it
+attempts the still-missing TPU configs via bench.py's staged-deadline
+child machinery, seeds every success into PERF_BASELINE.json (keep-best),
+and backs off while the relay is hung. Run it in the background during a
+build session:
+
+    python benchmarks/relay_watch.py >> /tmp/relay_watch.log 2>&1 &
+
+It exits when every queued config has a captured chip number (or has
+failed MAX_ATTEMPTS times with the backend up, which means the config
+itself — not the relay — is broken).
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location("bench", os.path.join(REPO, "bench.py"))
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+# (config, total child deadline seconds) — generous: this path has no
+# driver kill-timeout to stay under, only the session's lifetime.
+QUEUE = [
+    ("gbdt-higgs", 900),
+    ("vit", 900),
+    ("onnx-resnet", 600),
+    ("llama-decode", 600),
+    ("gbdt-hist-backends", 900),
+    ("flagship", 480),   # recapture: the 2026-07-31 window number was contended
+]
+MAX_ATTEMPTS = 4         # per config, counting only backend-up failures
+HANG_BACKOFF_S = 480
+FAIL_BACKOFF_S = 90
+
+
+def _note(msg: str) -> None:
+    print(f"[{time.strftime('%Y-%m-%d %H:%M:%S')}] {msg}", flush=True)
+
+
+RESULTS_JSONL = "/tmp/relay_watch_results.jsonl"
+
+
+def main() -> None:
+    queue = list(QUEUE)
+    attempts: dict = {}
+    while queue:
+        name, budget = queue[0]
+        result, err, elapsed, hang, backend_up = bench._run_child(
+            "tpu", name, 75, budget)
+        if result is not None and result.get("platform") == "tpu":
+            with open(RESULTS_JSONL, "a") as f:   # belt-and-braces record
+                f.write(json.dumps({"config": name, **result}) + "\n")
+            if bench._seed_baseline(result, bench._load_recorded()):
+                _note(f"CAPTURED {name} in {elapsed:.0f}s: {json.dumps(result)}")
+            else:
+                _note(f"CAPTURED {name} but PERF_BASELINE.json seed FAILED — "
+                      f"result only in {RESULTS_JSONL}: {json.dumps(result)}")
+            queue.pop(0)
+            continue
+        if hang or not backend_up:
+            # killed before BENCH_UP (hang) or died before announcing the
+            # backend (the relay raising UNAVAILABLE during init): both are
+            # relay trouble, not a config failure — wait for the next window
+            _note(f"{name}: relay down (hang={hang}, {elapsed:.0f}s, {err}); "
+                  f"backing off {HANG_BACKOFF_S}s")
+            time.sleep(HANG_BACKOFF_S)
+            continue
+        attempts[name] = attempts.get(name, 0) + 1
+        _note(f"{name}: backend up but failed (attempt {attempts[name]}, "
+              f"{elapsed:.0f}s): {err}")
+        queue.pop(0)
+        if attempts[name] < MAX_ATTEMPTS:
+            queue.append((name, budget))   # rotate to the back, try others first
+        else:
+            _note(f"{name}: giving up after {MAX_ATTEMPTS} backend-up failures")
+        time.sleep(FAIL_BACKOFF_S)
+    _note("queue drained; exiting")
+
+
+if __name__ == "__main__":
+    main()
