@@ -1,0 +1,126 @@
+# %% [markdown]
+# # Walkthrough: Responsible AI — explain a trained model, audit the data
+#
+# The reference's responsible-AI tier (`docs/Explore Algorithms/Responsible AI/`)
+# pairs model-agnostic explainers (`core/.../explainers/`) with data-balance
+# measures (`core/.../exploratory/`). Same arc here: train a GBDT on real
+# clinical data, explain individual predictions with KernelSHAP and LIME,
+# chart a feature's marginal effect with ICE/PDP, then audit a dataset for
+# representation imbalance before anyone trains on it.
+
+# %%  Stage 1 — train the model to be explained (real data, held-out split)
+import numpy as np
+from sklearn.datasets import load_breast_cancer
+
+import synapseml_tpu as st
+from synapseml_tpu.gbdt import LightGBMClassifier
+
+data = load_breast_cancer()
+rs = np.random.default_rng(0)
+order = rs.permutation(len(data.target))
+tr, te = order[:400], order[400:]
+train_df = st.DataFrame.from_rows(
+    [{"features": data.data[i].astype(np.float32), "label": int(data.target[i])}
+     for i in tr])
+test_df = st.DataFrame.from_rows(
+    [{"features": data.data[i].astype(np.float32), "label": int(data.target[i])}
+     for i in te])
+model = LightGBMClassifier(num_iterations=40, learning_rate=0.1,
+                           num_leaves=15).fit(train_df)
+acc = float(np.mean(
+    model.transform(test_df).collect_column("prediction")
+    == test_df.collect_column("label")))
+print("held-out accuracy:", round(acc, 3))
+assert acc > 0.9
+
+# %%  Stage 2 — KernelSHAP: per-feature attribution for single predictions
+# VectorSHAP perturbs the features vector against a background sample and
+# fits the Shapley kernel regression; `explanation` is [targets, K+1] with
+# phi0 (the background expectation) last. target_classes=[1] explains the
+# malignant-class probability.
+from synapseml_tpu.explainers import VectorSHAP
+
+shap = VectorSHAP(model=model, target_col="probability", target_classes=[1],
+                  num_samples=64, seed=0, background_data=train_df)
+explained = shap.transform(test_df.limit(4))
+probs = np.stack(list(model.transform(test_df.limit(4))
+                      .collect_column("probability")))[:, 1]
+for i, phi in enumerate(explained.collect_column("explanation")):
+    phi = np.asarray(phi)[0]
+    # efficiency axiom: contributions + base value reconstruct the output
+    np.testing.assert_allclose(phi.sum(), probs[i], atol=0.05)
+print("SHAP efficiency holds on", explained.count(), "explained rows")
+
+# %%  Stage 3 — LIME: local surrogate coefficients
+from synapseml_tpu.explainers import VectorLIME
+
+lime = VectorLIME(model=model, target_col="probability", target_classes=[1],
+                  num_samples=200, seed=0, regularization=1e-4,
+                  background_data=train_df)
+coefs = np.asarray(list(lime.transform(test_df.limit(2))
+                        .collect_column("explanation"))[0])[0]
+assert coefs.shape == (data.data.shape[1],)
+print("LIME top features:",
+      [data.feature_names[j] for j in np.argsort(-np.abs(coefs))[:3]])
+
+# %%  Stage 4 — ICE / PDP: marginal effect of one feature
+# ICETransformer sweeps named columns over a grid per instance (ICE) or
+# averaged (PDP), routing every swept batch through the model exactly like
+# the reference's ICETransformer (`core/.../explainers/ICETransformer.scala:126`).
+# The GBDT model consumes an assembled `features` vector, so the scorer
+# wrapped here assembles the per-feature columns first — the same
+# columns-to-vector step `Featurize` does inside `TrainClassifier`.
+from synapseml_tpu.core.pipeline import Transformer
+from synapseml_tpu.explainers import ICETransformer
+
+feat_cols = [str(n) for n in data.feature_names]
+
+
+class AssembleAndScore(Transformer):
+    def _transform(self, sdf):
+        X = np.stack([np.asarray(sdf.collect_column(c), np.float32)
+                      for c in feat_cols], axis=1)
+        scored = model.transform(st.DataFrame.from_dict({"features": X}))
+        return sdf.with_column(
+            "probability", np.stack(list(scored.collect_column("probability"))))
+
+
+test_cols = st.DataFrame.from_dict(
+    {c: data.data[te[:20], j].astype(np.float32)
+     for j, c in enumerate(feat_cols)})
+top_feature = feat_cols[int(np.argmax(np.abs(coefs)))]
+pdp = ICETransformer(model=AssembleAndScore(), target_col="probability",
+                     target_classes=[1], numeric_features=[top_feature],
+                     num_splits=8, kind="average").transform(test_cols)
+curve = pdp.collect_rows()[0][f"{top_feature}_dependence"]
+ys = [v[0] for v in curve.values()]          # class-1 probability per grid point
+assert len(ys) >= 2
+print(f"PDP range of '{top_feature}':", round(max(ys) - min(ys), 4))
+
+# %%  Stage 5 — data balance: audit BEFORE training
+# FeatureBalanceMeasure compares label rates across sensitive groups
+# (parity gaps); DistributionBalanceMeasure compares the observed group
+# distribution to uniform; AggregateBalanceMeasure summarizes into one
+# number — the reference's exploratory tier (`exploratory/DataBalanceAnalysis`).
+from synapseml_tpu.exploratory import (
+    AggregateBalanceMeasure,
+    DistributionBalanceMeasure,
+    FeatureBalanceMeasure,
+)
+
+n = 2000
+gender = rs.choice(["F", "M"], n, p=[0.3, 0.7])
+label = (rs.random(n) < np.where(gender == "F", 0.35, 0.65)).astype(np.int64)
+hiring = st.DataFrame.from_dict({"gender": gender.astype(object), "label": label})
+
+fb = FeatureBalanceMeasure(sensitive_cols=["gender"]).transform(hiring)
+gap = fb.collect_rows()[0]
+print("statistical parity gap F vs M:", round(gap["dp"], 3))
+assert abs(gap["dp"]) > 0.2          # the injected bias is detected
+
+db = DistributionBalanceMeasure(sensitive_cols=["gender"]).transform(hiring)
+print("KL from uniform:", round(db.collect_rows()[0]["kl_divergence"], 4))
+
+ab = AggregateBalanceMeasure(sensitive_cols=["gender"]).transform(hiring)
+print("aggregate (atkinson):", round(ab.collect_rows()[0]["atkinson_index"], 4))
+print("walkthrough complete")
