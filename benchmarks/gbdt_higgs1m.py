@@ -20,20 +20,32 @@ def run(jax, platform, n_chips):
     logits = X @ w * 0.5 + rng.normal(size=N + n_test) * 0.5
     y = (logits > 0).astype(np.float32)
     degraded = None
+    hist_impl = "segment"
     if platform == "tpu":
         # The 2026-07-31 window died inside this child with "UNAVAILABLE: TPU
         # device error" at full scale, then the relay hung — which leaves
         # "our kernel faults anywhere" vs "scale-dependent" vs "relay infra"
         # undistinguished. A 20k-row canary first makes the failure mode
         # informative: canary fails => universal/infra; canary passes but
-        # 1M fails => scale. On a scale failure, retry at smaller N so a
-        # partial chip number still lands in the driver artifact.
-        t0 = time.perf_counter()
-        train_booster(X[:20_000], y[:20_000], objective="binary",
-                      num_iterations=5, learning_rate=0.1,
-                      num_leaves=31, max_bin=255)
-        print(f"# gbdt canary 20k ok in {time.perf_counter() - t0:.1f}s",
-              flush=True)
+        # 1M fails => scale. If the default segment (scatter-add) backend is
+        # what faults, the one-hot MXU backend is a different lowering —
+        # switch to it and still capture a chip number. On a scale failure,
+        # retry at smaller N so a partial number still lands.
+        for impl in ("segment", "onehot"):
+            try:
+                t0 = time.perf_counter()
+                train_booster(X[:20_000], y[:20_000], objective="binary",
+                              num_iterations=5, learning_rate=0.1,
+                              num_leaves=31, max_bin=255, histogram_impl=impl)
+                hist_impl = impl
+                print(f"# gbdt canary 20k ok ({impl}) in "
+                      f"{time.perf_counter() - t0:.1f}s", flush=True)
+                break
+            except Exception as e:  # noqa: BLE001
+                print(f"# gbdt canary ({impl}) failed: {type(e).__name__}: "
+                      f"{str(e)[:200]}", flush=True)
+                if impl == "onehot":
+                    raise
     scales = [N, 250_000, 100_000] if platform == "tpu" else [N]
     for attempt_n in scales:
         n_iter = 100 if platform == "tpu" else 20
@@ -42,7 +54,8 @@ def run(jax, platform, n_chips):
             booster = train_booster(X[:attempt_n], y[:attempt_n],
                                     objective="binary",
                                     num_iterations=n_iter, learning_rate=0.1,
-                                    num_leaves=31, max_bin=255)
+                                    num_leaves=31, max_bin=255,
+                                    histogram_impl=hist_impl)
             train_s = time.perf_counter() - t0
             if attempt_n != N:
                 degraded = f"device error at {N} rows; measured at {attempt_n}"
@@ -74,10 +87,17 @@ def run(jax, platform, n_chips):
     result = {"metric": metric,
               "value": round(N * n_iter / train_s), "unit": "row-iters/sec",
               "platform": platform, "train_s": round(train_s, 2),
+              "hist_impl": hist_impl,
               "pred_rows": n_pred, "pred_s": round(pred_s, 3),
               "auc": round(float(auc), 4)}
     if degraded:
         result["degraded"] = degraded
+    if hist_impl != "segment":
+        # fault-forced backend switch: the metric key stays (BASELINE.md's
+        # target is Higgs-1M train time, whichever lowering wins), but the
+        # provenance must ride along into PERF_BASELINE.json so a
+        # cross-backend keep-best comparison is visible, not silent
+        result["note"] = "segment backend faulted on-chip; measured with onehot"
     return result
 
 
