@@ -868,6 +868,277 @@ def _argmax(ins, attrs):
     return out
 
 
+@op("ReduceProd")
+def _reduce_prod(ins, attrs):
+    return _reduce(jnp.prod, ins, attrs)
+
+
+@op("Tile")
+def _tile(ins, attrs):
+    return jnp.tile(ins[0], tuple(int(r) for r in np.asarray(ins[1])))
+
+
+# ---------------- quantization family ----------------
+# The reference's ONNXModel runs QDQ-quantized exports through ONNX Runtime
+# (ONNXRuntime.scala:25). TPU-native lowering: the integer matmul stays
+# integer (int32 accumulation — exact per spec), rounding is
+# round-half-to-even (jnp.round), saturation to the zero-point dtype.
+
+def _per_axis(scale, zp, ndim, axis):
+    """Broadcast per-axis scale/zero_point to the tensor rank."""
+    scale = jnp.asarray(scale, jnp.float32)
+    if zp is not None:
+        zp = jnp.asarray(zp)
+    if scale.ndim == 1 and scale.size > 1:
+        shape = [1] * ndim
+        shape[axis] = scale.size
+        scale = scale.reshape(shape)
+        if zp is not None and zp.ndim == 1:
+            zp = zp.reshape(shape)
+    return scale, zp
+
+
+def _saturate(x, dtype):
+    info = jnp.iinfo(dtype)
+    return jnp.clip(x, info.min, info.max).astype(dtype)
+
+
+@op("QuantizeLinear")
+def _quantize_linear(ins, attrs):
+    x, scale = ins[0], ins[1]
+    zp = ins[2] if len(ins) > 2 and ins[2] is not None else None
+    dtype = zp.dtype if zp is not None else jnp.uint8
+    scale, zp_b = _per_axis(scale, zp, x.ndim, attrs.get("axis", 1))
+    q = jnp.round(x.astype(jnp.float32) / scale)
+    if zp_b is not None:
+        q = q + zp_b.astype(jnp.float32)
+    return _saturate(q, dtype)
+
+
+@op("DequantizeLinear")
+def _dequantize_linear(ins, attrs):
+    x, scale = ins[0], ins[1]
+    zp = ins[2] if len(ins) > 2 and ins[2] is not None else None
+    scale, zp_b = _per_axis(scale, zp, x.ndim, attrs.get("axis", 1))
+    xf = x.astype(jnp.float32)
+    if zp_b is not None:
+        xf = xf - zp_b.astype(jnp.float32)
+    return xf * scale
+
+
+@op("DynamicQuantizeLinear")
+def _dynamic_quantize_linear(ins, attrs):
+    x = ins[0].astype(jnp.float32)
+    # spec: range must include 0 so the zero point is representable
+    lo = jnp.minimum(jnp.min(x), 0.0)
+    hi = jnp.maximum(jnp.max(x), 0.0)
+    scale = (hi - lo) / 255.0
+    scale = jnp.where(scale == 0, 1.0, scale)  # all-zero input
+    zp = _saturate(jnp.round(-lo / scale), jnp.uint8)
+    y = _saturate(jnp.round(x / scale) + zp.astype(jnp.float32), jnp.uint8)
+    return y, scale, zp
+
+
+def _int_matmul(a, a_zp, b, b_zp):
+    """(a - a_zp) @ (b - b_zp) in int32 — exact integer accumulation.
+
+    Per-row ``a_zp`` (shape [M]) applies along a's second-to-last axis;
+    per-column ``b_zp`` (shape [N]) broadcasts along b's last axis as-is.
+    """
+    a32, b32 = a.astype(jnp.int32), b.astype(jnp.int32)
+    if a_zp is not None:
+        z = a_zp.astype(jnp.int32)
+        a32 = a32 - (z[..., :, None] if z.ndim >= 1 and z.size > 1 else z)
+    if b_zp is not None:
+        b32 = b32 - b_zp.astype(jnp.int32)
+    return jnp.matmul(a32, b32, preferred_element_type=jnp.int32)
+
+
+@op("MatMulInteger")
+def _matmul_integer(ins, attrs):
+    a, b = ins[0], ins[1]
+    a_zp = ins[2] if len(ins) > 2 and ins[2] is not None else None
+    b_zp = ins[3] if len(ins) > 3 and ins[3] is not None else None
+    return _int_matmul(a, a_zp, b, b_zp)
+
+
+@op("QLinearMatMul")
+def _qlinear_matmul(ins, attrs):
+    # accumulation is exact int32; the requantize multiply happens in f32,
+    # so outputs can differ from ORT's by one quantization step once
+    # |acc| > 2^24 (K ~ 1024 at full-range int8 inputs) — same bound as
+    # QLinearConv, inherent to f32-only TPU arithmetic
+    a, a_scale, a_zp, b, b_scale, b_zp, y_scale, y_zp = ins[:8]
+    acc = _int_matmul(a, a_zp, b, b_zp).astype(jnp.float32)
+    a_s = jnp.asarray(a_scale, jnp.float32)
+    if a_s.ndim >= 1 and a_s.size > 1:          # per-row: align to M axis
+        a_s = a_s[..., :, None]
+    mult = a_s * jnp.asarray(b_scale, jnp.float32) \
+        / jnp.asarray(y_scale, jnp.float32)
+    y = jnp.round(acc * mult) + y_zp.astype(jnp.float32)
+    return _saturate(y, y_zp.dtype)
+
+
+@op("QLinearConv")
+def _qlinear_conv(ins, attrs):
+    x, x_scale, x_zp, w, w_scale, w_zp, y_scale, y_zp = ins[:8]
+    bias = ins[8] if len(ins) > 8 else None
+    # integer-valued float conv: products |x-zp|*|w-zp| <= 2^14 summed over
+    # the receptive field stay exact in f32 up to 2^24 — exact for any
+    # realistic kernel volume (3x3x64*16k = 2^23)
+    xf = x.astype(jnp.float32) - x_zp.astype(jnp.float32)
+    w_scale_arr = jnp.asarray(w_scale, jnp.float32)
+    wzf = w_zp.astype(jnp.float32)
+    if wzf.ndim == 1 and wzf.size > 1:          # per-output-channel zp
+        wzf = wzf.reshape((-1,) + (1,) * (w.ndim - 1))
+    wf = w.astype(jnp.float32) - wzf
+    acc = OP_REGISTRY["Conv"]([xf, wf], attrs)   # [N, M, *spatial]
+    if bias is not None:                          # int32, scale = x_scale*w_scale
+        acc = acc + bias.astype(jnp.float32).reshape(
+            (1, -1) + (1,) * (acc.ndim - 2))
+    mult = jnp.asarray(x_scale, jnp.float32) * w_scale_arr \
+        / jnp.asarray(y_scale, jnp.float32)
+    if mult.ndim == 1 and mult.size > 1:          # per-output-channel scale
+        mult = mult.reshape((1, -1) + (1,) * (acc.ndim - 2))
+    y = jnp.round(acc * mult) + y_zp.astype(jnp.float32)
+    return _saturate(y, y_zp.dtype)
+
+
+# ---------------- advanced indexing / detection ----------------
+
+@op("GatherND")
+def _gather_nd(ins, attrs):
+    # jit-safe: indices may be runtime tensors (NMS/TopK outputs), never
+    # force them to host numpy
+    x, indices = jnp.asarray(ins[0]), jnp.asarray(ins[1]).astype(jnp.int32)
+    b = attrs.get("batch_dims", 0)
+
+    def gather(data, idx):
+        return data[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    fn = gather
+    for _ in range(b):
+        fn = jax.vmap(fn)
+    return fn(x, indices)
+
+
+def _axis_index_grids(x, indices, axis):
+    """Full index tuple for scatter/gather-elements: iota grids everywhere
+    except ``axis``, where ``indices`` (negative values wrapped) is used."""
+    idx = jnp.where(indices < 0, indices + x.shape[axis], indices)
+    grids = jnp.indices(indices.shape, sparse=True)
+    return tuple(idx if d == axis else grids[d] for d in range(x.ndim))
+
+
+def _apply_reduction(at, updates, red):
+    if red == "add":
+        return at.add(updates)
+    if red == "mul":
+        return at.multiply(updates)
+    if red == "min":
+        return at.min(updates)
+    if red == "max":
+        return at.max(updates)
+    if red in ("none", None, ""):
+        return at.set(updates)
+    raise NotImplementedError(f"scatter reduction {red!r}")
+
+
+@op("ScatterElements")
+def _scatter_elements(ins, attrs):
+    x, indices, updates = jnp.asarray(ins[0]), jnp.asarray(ins[1]), ins[2]
+    axis = attrs.get("axis", 0)
+    if axis < 0:
+        axis += x.ndim
+    at = x.at[_axis_index_grids(x, indices, axis)]
+    return _apply_reduction(at, updates, attrs.get("reduction", "none"))
+
+
+@op("ScatterND")
+def _scatter_nd(ins, attrs):
+    x = jnp.asarray(ins[0])
+    indices, updates = jnp.asarray(ins[1]).astype(jnp.int32), ins[2]
+    at = x.at[tuple(jnp.moveaxis(indices, -1, 0))]
+    return _apply_reduction(at, updates, attrs.get("reduction", "none"))
+
+
+@op("NonMaxSuppression")
+def _non_max_suppression(ins, attrs):
+    """Greedy per-(batch, class) NMS (the ONNX RT detection-head tail op).
+
+    ONNX declares a dynamic [num_selected, 3] output; XLA needs static
+    shapes, so the output has ``B * C * min(max_output_boxes_per_class, N)``
+    rows, laid out as consecutive per-(batch, class) blocks with unused
+    slots inside EACH block padded as [-1, -1, -1] rows (padding is
+    interleaved per block, not gathered at the tail). Downstream consumers
+    filter ``row[0] >= 0``.
+    """
+    boxes, scores = jnp.asarray(ins[0]), jnp.asarray(ins[1])  # [B,N,4], [B,C,N]
+    if len(ins) > 2 and ins[2] is not None:
+        if isinstance(ins[2], jax.core.Tracer):
+            raise NotImplementedError(
+                "NonMaxSuppression: max_output_boxes_per_class must be a "
+                "constant/initializer (it fixes the static output shape)")
+        max_out = int(np.asarray(ins[2]).ravel()[0])
+    else:
+        max_out = 0
+    # thresholds may be runtime tensors — keep them traced
+    iou_thr = (jnp.asarray(ins[3], jnp.float32).reshape(())
+               if len(ins) > 3 and ins[3] is not None else jnp.float32(0.0))
+    score_thr = (jnp.asarray(ins[4], jnp.float32).reshape(())
+                 if len(ins) > 4 and ins[4] is not None else jnp.float32(-np.inf))
+    B, N = boxes.shape[0], boxes.shape[1]
+    C = scores.shape[1]
+    if max_out <= 0 or N == 0:
+        return jnp.zeros((0, 3), jnp.int32)
+    max_out = min(max_out, N)
+
+    if attrs.get("center_point_box", 0):
+        xc, yc, w, h = (boxes[..., i] for i in range(4))
+        y1, x1 = yc - h / 2, xc - w / 2
+        y2, x2 = yc + h / 2, xc + w / 2
+    else:
+        # corners in either order per spec
+        y1 = jnp.minimum(boxes[..., 0], boxes[..., 2])
+        y2 = jnp.maximum(boxes[..., 0], boxes[..., 2])
+        x1 = jnp.minimum(boxes[..., 1], boxes[..., 3])
+        x2 = jnp.maximum(boxes[..., 1], boxes[..., 3])
+    area = (y2 - y1) * (x2 - x1)                          # [B, N]
+
+    def iou(b, i):                                        # [N] IoU vs box i
+        yy1 = jnp.maximum(y1[b], y1[b, i])
+        yy2 = jnp.minimum(y2[b], y2[b, i])
+        xx1 = jnp.maximum(x1[b], x1[b, i])
+        xx2 = jnp.minimum(x2[b], x2[b, i])
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        return inter / jnp.maximum(area[b] + area[b, i] - inter, 1e-12)
+
+    def one_class(b, sc):                                 # sc: [N] scores
+        def step(carry, k):
+            alive, out_idx = carry
+            masked = jnp.where(alive, sc, -jnp.inf)
+            i = jnp.argmax(masked)
+            ok = masked[i] > score_thr
+            suppress = iou(b, i) > iou_thr
+            alive = alive & ~suppress & (jnp.arange(N) != i) & ok
+            out_idx = out_idx.at[k].set(jnp.where(ok, i, -1))
+            return (alive, out_idx), None
+
+        init = (jnp.ones(N, bool), jnp.full((max_out,), -1, jnp.int32))
+        (_, out_idx), _ = jax.lax.scan(step, init, jnp.arange(max_out))
+        return out_idx                                    # [max_out]
+
+    rows = []
+    for b in range(B):                                    # B, C are static
+        sel = jax.vmap(lambda sc, b=b: one_class(b, sc))(scores[b])  # [C, max_out]
+        for c in range(C):
+            bc = jnp.stack([jnp.where(sel[c] >= 0, b, -1),
+                            jnp.where(sel[c] >= 0, c, -1),
+                            sel[c]], axis=-1)             # [max_out, 3]
+            rows.append(bc)
+    return jnp.concatenate(rows, axis=0).astype(jnp.int32)
+
+
 # ---------------------------------------------------------------------------
 # graph executor
 # ---------------------------------------------------------------------------

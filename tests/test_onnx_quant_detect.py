@@ -1,0 +1,405 @@
+"""Quantized (QDQ / QLinear) and detection-tail ONNX ops against spec
+oracles — the opset families behind ONNX Runtime's quantized-model and
+detection-head support (reference `ONNXRuntime.scala:25` runs the full ORT
+opset; these are the remaining high-traffic groups after the conv / einsum
+/ decoder / recurrent families proven on real torch exports)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.onnx import (
+    AttributeProto,
+    GraphProto,
+    ModelProto,
+    NodeProto,
+    ONNXModel,
+    ValueInfoProto,
+    convert_graph,
+    numpy_to_tensor,
+)
+from synapseml_tpu.onnx import proto as P
+from synapseml_tpu.onnx.convert import OP_REGISTRY
+
+
+def node(op, inputs, outputs, **attrs):
+    return NodeProto(input=list(inputs), output=list(outputs), op_type=op,
+                     attribute=[AttributeProto.make(k, v) for k, v in attrs.items()])
+
+
+def run_op(op, ins, **attrs):
+    return OP_REGISTRY[op]([None if x is None else np.asarray(x) for x in ins],
+                           attrs)
+
+
+# ---------------- quantization family ----------------
+
+def quant_ref(x, scale, zp, dtype):
+    info = np.iinfo(dtype)
+    q = np.rint(x / scale) + zp          # rint = round-half-even, per spec
+    return np.clip(q, info.min, info.max).astype(dtype)
+
+
+def test_quantize_dequantize_per_tensor():
+    rs = np.random.default_rng(0)
+    x = (rs.normal(size=(5, 7)) * 4).astype(np.float32)
+    scale, zp = np.float32(0.05), np.uint8(128)
+    q = run_op("QuantizeLinear", [x, scale, zp])
+    np.testing.assert_array_equal(np.asarray(q), quant_ref(x, 0.05, 128, np.uint8))
+    deq = run_op("DequantizeLinear", [np.asarray(q), scale, zp])
+    np.testing.assert_allclose(np.asarray(deq),
+                               (quant_ref(x, 0.05, 128, np.uint8).astype(np.float32)
+                                - 128) * 0.05, atol=1e-7)
+    # int8 variant with negative zero point
+    q8 = run_op("QuantizeLinear", [x, scale, np.int8(-3)])
+    np.testing.assert_array_equal(np.asarray(q8), quant_ref(x, 0.05, -3, np.int8))
+
+
+def test_quantize_per_axis():
+    rs = np.random.default_rng(1)
+    x = rs.normal(size=(3, 4, 5)).astype(np.float32)
+    scale = np.asarray([0.1, 0.02, 0.3, 0.5], np.float32)
+    zp = np.asarray([0, 10, -5, 3], np.int8)
+    q = np.asarray(run_op("QuantizeLinear", [x, scale, zp], axis=1))
+    for c in range(4):
+        np.testing.assert_array_equal(q[:, c], quant_ref(x[:, c], scale[c],
+                                                         int(zp[c]), np.int8))
+    deq = np.asarray(run_op("DequantizeLinear", [q, scale, zp], axis=1))
+    for c in range(4):
+        np.testing.assert_allclose(deq[:, c],
+                                   (q[:, c].astype(np.float32) - zp[c]) * scale[c])
+
+
+def test_dynamic_quantize_linear_spec():
+    x = np.asarray([[-1.0, 0.0, 2.5, 3.1]], np.float32)
+    y, scale, zp = run_op("DynamicQuantizeLinear", [x])
+    lo, hi = min(x.min(), 0.0), max(x.max(), 0.0)
+    ref_scale = (hi - lo) / 255.0
+    ref_zp = np.clip(np.rint(-lo / ref_scale), 0, 255).astype(np.uint8)
+    assert float(scale) == pytest.approx(ref_scale)
+    assert int(zp) == int(ref_zp)
+    np.testing.assert_array_equal(
+        np.asarray(y), np.clip(np.rint(x / ref_scale) + int(ref_zp),
+                               0, 255).astype(np.uint8))
+    # all-zero input must not divide by zero
+    y0, s0, z0 = run_op("DynamicQuantizeLinear", [np.zeros((3,), np.float32)])
+    assert np.asarray(y0).dtype == np.uint8 and float(s0) > 0
+
+
+def test_matmul_integer_exact():
+    rs = np.random.default_rng(2)
+    a = rs.integers(0, 255, (6, 9)).astype(np.uint8)
+    b = rs.integers(-128, 127, (9, 4)).astype(np.int8)
+    out = np.asarray(run_op("MatMulInteger", [a, b, np.uint8(113), np.int8(-7)]))
+    ref = (a.astype(np.int32) - 113) @ (b.astype(np.int32) + 7)
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, ref)
+    # zero points optional
+    out2 = np.asarray(run_op("MatMulInteger", [a, b]))
+    np.testing.assert_array_equal(out2, a.astype(np.int32) @ b.astype(np.int32))
+
+
+def qlinear_matmul_ref(a, a_s, a_z, b, b_s, b_z, y_s, y_z):
+    acc = (a.astype(np.int32) - a_z) @ (b.astype(np.int32) - b_z)
+    y = np.rint(acc.astype(np.float64) * (a_s * b_s / y_s)) + y_z
+    info = np.iinfo(np.uint8)
+    return np.clip(y, info.min, info.max).astype(np.uint8)
+
+
+def test_qlinear_matmul():
+    rs = np.random.default_rng(3)
+    a = rs.integers(0, 255, (5, 8)).astype(np.uint8)
+    b = rs.integers(0, 255, (8, 6)).astype(np.uint8)
+    args = [a, np.float32(0.02), np.uint8(120), b, np.float32(0.05),
+            np.uint8(131), np.float32(0.4), np.uint8(7)]
+    out = np.asarray(run_op("QLinearMatMul", args))
+    np.testing.assert_array_equal(
+        out, qlinear_matmul_ref(a, 0.02, 120, b, 0.05, 131, 0.4, 7))
+
+
+def test_qlinear_matmul_per_row_scale_zp():
+    """ONNX allows a_scale/a_zero_point of shape [M] (per-row)."""
+    rs = np.random.default_rng(30)
+    M, K, N = 4, 7, 5
+    a = rs.integers(0, 255, (M, K)).astype(np.uint8)
+    b = rs.integers(0, 255, (K, N)).astype(np.uint8)
+    a_s = rs.uniform(0.01, 0.05, M).astype(np.float32)
+    a_z = rs.integers(100, 150, M).astype(np.uint8)
+    args = [a, a_s, a_z, b, np.float32(0.05), np.uint8(131),
+            np.float32(0.4), np.uint8(7)]
+    out = np.asarray(run_op("QLinearMatMul", args))
+    ref = np.empty((M, N), np.uint8)
+    for m in range(M):
+        ref[m] = qlinear_matmul_ref(a[m:m + 1], float(a_s[m]), int(a_z[m]),
+                                    b, 0.05, 131, 0.4, 7)[0]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_qlinear_conv_per_channel():
+    rs = np.random.default_rng(4)
+    x = rs.integers(0, 255, (2, 3, 8, 8)).astype(np.uint8)
+    w = rs.integers(-100, 100, (5, 3, 3, 3)).astype(np.int8)
+    bias = rs.integers(-1000, 1000, (5,)).astype(np.int32)
+    x_s, x_z = np.float32(0.03), np.uint8(110)
+    w_s = rs.uniform(0.01, 0.05, 5).astype(np.float32)    # per-output-channel
+    w_z = np.zeros(5, np.int8)
+    y_s, y_z = np.float32(0.1), np.uint8(128)
+    out = np.asarray(run_op(
+        "QLinearConv", [x, x_s, x_z, w, w_s, w_z, y_s, y_z, bias],
+        kernel_shape=[3, 3], pads=[1, 1, 1, 1]))
+    # float oracle: integer-exact conv then requantize
+    from scipy.signal import correlate
+
+    xf = x.astype(np.float64) - 110
+    ref_acc = np.zeros((2, 5, 8, 8))
+    for n in range(2):
+        for m in range(5):
+            s = sum(correlate(xf[n, c], w[m, c].astype(np.float64), mode="same")
+                    for c in range(3))
+            ref_acc[n, m] = s + bias[m]
+    ref = np.clip(np.rint(ref_acc * (0.03 * w_s[None, :, None, None] / 0.1))
+                  + 128, 0, 255).astype(np.uint8)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_qdq_model_end_to_end():
+    """A quantized MLP (Quantize -> QLinearMatMul -> Dequantize -> Relu)
+    through the full ONNXModel transformer path."""
+    rs = np.random.default_rng(5)
+    W = rs.integers(0, 255, (4, 3)).astype(np.uint8)
+    g = GraphProto(
+        name="qmlp",
+        node=[
+            node("QuantizeLinear", ["x", "xs", "xz"], ["xq"]),
+            node("QLinearMatMul", ["xq", "xs", "xz", "W", "ws", "wz",
+                                   "ys", "yz"], ["yq"]),
+            node("DequantizeLinear", ["yq", "ys", "yz"], ["yf"]),
+            node("Relu", ["yf"], ["out"]),
+        ],
+        initializer=[
+            numpy_to_tensor(W, "W"),
+            numpy_to_tensor(np.float32(0.02), "xs"),
+            numpy_to_tensor(np.uint8(128), "xz"),
+            numpy_to_tensor(np.float32(0.05), "ws"),
+            numpy_to_tensor(np.uint8(131), "wz"),
+            numpy_to_tensor(np.float32(0.3), "ys"),
+            numpy_to_tensor(np.uint8(100), "yz"),
+        ],
+        input=[ValueInfoProto(name="x", elem_type=P.FLOAT, dims=["N", 4])],
+        output=[ValueInfoProto(name="out", elem_type=P.FLOAT, dims=["N", 3])],
+    )
+    data = ModelProto(graph=g).encode()
+    X = (rs.normal(size=(9, 4)) * 2).astype(np.float32)
+    om = ONNXModel(model_bytes=data, mini_batch_size=4,
+                   feed_dict={"x": "features"}, fetch_dict={"out": "out"})
+    out = np.stack(list(om.transform(DataFrame.from_dict({"features": X}))
+                        .collect_column("out")))
+    xq = quant_ref(X, 0.02, 128, np.uint8)
+    yq = qlinear_matmul_ref(xq, 0.02, 128, W, 0.05, 131, 0.3, 100)
+    ref = np.maximum((yq.astype(np.float32) - 100) * 0.3, 0)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+# ---------------- advanced indexing ----------------
+
+def test_gather_nd():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    idx = np.asarray([[0, 2], [1, 0]], np.int64)          # -> x[0,2], x[1,0]
+    out = np.asarray(run_op("GatherND", [x, idx]))
+    np.testing.assert_array_equal(out, np.stack([x[0, 2], x[1, 0]]))
+    # batch_dims=1: per-batch row gather
+    idx_b = np.asarray([[1], [2]], np.int64)              # x[0,1], x[1,2]
+    out_b = np.asarray(run_op("GatherND", [x, idx_b], batch_dims=1))
+    np.testing.assert_array_equal(out_b, np.stack([x[0, 1], x[1, 2]]))
+
+
+def test_scatter_nd_set_and_add():
+    x = np.zeros((4, 3), np.float32)
+    idx = np.asarray([[1], [3]], np.int64)
+    upd = np.asarray([[1.0, 2, 3], [4, 5, 6]], np.float32)
+    out = np.asarray(run_op("ScatterND", [x, idx, upd]))
+    ref = x.copy(); ref[1] = upd[0]; ref[3] = upd[1]
+    np.testing.assert_array_equal(out, ref)
+    out_add = np.asarray(run_op("ScatterND", [np.ones((4, 3), np.float32),
+                                              idx, upd], reduction="add"))
+    np.testing.assert_array_equal(out_add, np.ones((4, 3)) + ref)
+
+
+def test_scatter_reductions_min_max_and_unknown():
+    x = np.asarray([5.0, 5.0, 5.0], np.float32)
+    idx = np.asarray([[0], [1], [2]], np.int64)
+    upd = np.asarray([9.0, 1.0, 9.0], np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(run_op("ScatterND", [x, idx, upd], reduction="max")),
+        [9.0, 5.0, 9.0])
+    np.testing.assert_array_equal(
+        np.asarray(run_op("ScatterND", [x, idx, upd], reduction="min")),
+        [5.0, 1.0, 5.0])
+    with pytest.raises(NotImplementedError, match="reduction"):
+        run_op("ScatterElements", [x, np.asarray([0, 1, 2]), upd],
+               reduction="bogus")
+
+
+def test_index_ops_jit_safe_with_runtime_indices():
+    """GatherND/ScatterND/ScatterElements must accept traced index tensors
+    (NMS/TopK outputs feed them inside ONNXModel's jitted execution)."""
+    import jax
+
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    nd_idx = np.asarray([[1], [3]], np.int64)
+    upd = np.ones((2, 3), np.float32)
+
+    out_g = jax.jit(lambda d, i: OP_REGISTRY["GatherND"]([d, i], {}))(x, nd_idx)
+    np.testing.assert_array_equal(np.asarray(out_g), x[[1, 3]])
+    out_s = jax.jit(lambda d, i, u: OP_REGISTRY["ScatterND"]([d, i, u],
+                                                             {}))(x, nd_idx, upd)
+    assert np.asarray(out_s)[1].tolist() == [1, 1, 1]
+    el_idx = np.asarray([[0], [2], [1], [0]], np.int64)
+    out_e = jax.jit(lambda d, i, u: OP_REGISTRY["ScatterElements"](
+        [d, i, u], {"axis": 1}))(x, el_idx, np.zeros((4, 1), np.float32))
+    assert np.asarray(out_e)[1, 2] == 0.0
+
+
+def test_scatter_elements_matches_put_along_axis():
+    rs = np.random.default_rng(6)
+    x = rs.normal(size=(4, 5)).astype(np.float32)
+    idx = rs.integers(0, 5, (4, 2)).astype(np.int64)
+    upd = rs.normal(size=(4, 2)).astype(np.float32)
+    out = np.asarray(run_op("ScatterElements", [x, idx, upd], axis=1))
+    ref = x.copy()
+    np.put_along_axis(ref, idx, upd, axis=1)
+    np.testing.assert_array_equal(out, ref)
+    # negative indices wrap
+    out_n = np.asarray(run_op("ScatterElements",
+                              [x, idx - 5, upd], axis=1))
+    np.testing.assert_array_equal(out_n, ref)
+
+
+def test_tile_and_reduce_prod():
+    x = np.arange(6).reshape(2, 3).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(run_op("Tile", [x, np.asarray([2, 1])])),
+                                  np.tile(x, (2, 1)))
+    np.testing.assert_allclose(np.asarray(run_op("ReduceProd", [x + 1, np.asarray([1])])),
+                               np.prod(x + 1, axis=1, keepdims=True))
+
+
+# ---------------- NonMaxSuppression ----------------
+
+def nms_ref(boxes, scores, max_out, iou_thr, score_thr):
+    """Greedy numpy oracle, padded to B*C*max_out rows with -1."""
+    B, N, _ = boxes.shape
+    C = scores.shape[1]
+    rows = []
+    for b in range(B):
+        y1 = np.minimum(boxes[b, :, 0], boxes[b, :, 2])
+        y2 = np.maximum(boxes[b, :, 0], boxes[b, :, 2])
+        x1 = np.minimum(boxes[b, :, 1], boxes[b, :, 3])
+        x2 = np.maximum(boxes[b, :, 1], boxes[b, :, 3])
+        area = (y2 - y1) * (x2 - x1)
+        for c in range(C):
+            alive = np.ones(N, bool)
+            picked = []
+            while len(picked) < max_out:
+                masked = np.where(alive, scores[b, c], -np.inf)
+                i = int(masked.argmax())
+                if not (masked[i] > score_thr):
+                    break
+                picked.append(i)
+                yy1, yy2 = np.maximum(y1, y1[i]), np.minimum(y2, y2[i])
+                xx1, xx2 = np.maximum(x1, x1[i]), np.minimum(x2, x2[i])
+                inter = np.maximum(yy2 - yy1, 0) * np.maximum(xx2 - xx1, 0)
+                iou = inter / np.maximum(area + area[i] - inter, 1e-12)
+                alive &= iou <= iou_thr
+                alive[i] = False
+            for k in range(max_out):
+                rows.append([b, c, picked[k]] if k < len(picked) else [-1, -1, -1])
+    return np.asarray(rows, np.int32)
+
+
+@pytest.mark.parametrize("iou_thr,score_thr", [(0.5, 0.0), (0.3, 0.35)])
+def test_nms_matches_greedy_oracle(iou_thr, score_thr):
+    rs = np.random.default_rng(7)
+    B, N, C = 2, 24, 3
+    centers = rs.uniform(0, 10, (B, N, 2))
+    sizes = rs.uniform(0.5, 3.0, (B, N, 2))
+    boxes = np.concatenate([centers - sizes / 2, centers + sizes / 2],
+                           axis=-1).astype(np.float32)
+    scores = rs.uniform(0, 1, (B, C, N)).astype(np.float32)
+    out = np.asarray(run_op(
+        "NonMaxSuppression",
+        [boxes, scores, np.asarray([5]), np.float32(iou_thr),
+         np.float32(score_thr)]))
+    np.testing.assert_array_equal(out, nms_ref(boxes, scores, 5,
+                                               iou_thr, score_thr))
+
+
+def test_nms_center_point_and_suppression():
+    # two near-identical boxes + one far box: exactly two survive
+    boxes = np.asarray([[[5, 5, 2, 2], [5.1, 5, 2, 2], [20, 20, 2, 2]]],
+                       np.float32)                        # center format
+    scores = np.asarray([[[0.9, 0.8, 0.7]]], np.float32)
+    out = np.asarray(run_op(
+        "NonMaxSuppression",
+        [boxes, scores, np.asarray([3]), np.float32(0.5), None],
+        center_point_box=1))
+    kept = out[out[:, 2] >= 0][:, 2].tolist()
+    assert kept == [0, 2]                                 # 1 suppressed by 0
+
+
+def test_nms_in_converted_graph():
+    """NMS as a graph node with initializer thresholds, via convert_graph."""
+    g = GraphProto(
+        name="det",
+        node=[node("NonMaxSuppression",
+                   ["boxes", "scores", "mo", "iou"], ["sel"])],
+        initializer=[numpy_to_tensor(np.asarray([2], np.int64), "mo"),
+                     numpy_to_tensor(np.float32(0.5), "iou")],
+        input=[ValueInfoProto(name="boxes", elem_type=P.FLOAT, dims=[1, 4, 4]),
+               ValueInfoProto(name="scores", elem_type=P.FLOAT, dims=[1, 1, 4])],
+        output=[ValueInfoProto(name="sel", elem_type=P.INT32, dims=[2, 3])],
+    )
+    conv = convert_graph(ModelProto(graph=g).encode())
+    boxes = np.asarray([[[0, 0, 1, 1], [0, 0, 1.05, 1], [3, 3, 4, 4],
+                         [8, 8, 9, 9]]], np.float32)
+    scores = np.asarray([[[0.9, 0.85, 0.6, 0.2]]], np.float32)
+    sel = np.asarray(conv(boxes=boxes, scores=scores)["sel"])
+    np.testing.assert_array_equal(sel, [[0, 0, 0], [0, 0, 2]])
+
+
+def test_detection_tail_jitted_through_onnx_model():
+    """The real detection-head tail — NMS -> Slice/Concat the (batch, box)
+    index pairs -> GatherND the selected boxes — through ONNXModel's JITTED
+    execution path, with runtime indices flowing between the new ops."""
+    g = GraphProto(
+        name="dettail",
+        node=[
+            node("NonMaxSuppression", ["boxes", "scores", "mo", "iou"],
+                 ["sel"]),
+            node("Slice", ["sel", "s0", "e1", "ax1"], ["col_b"]),   # [:, 0:1]
+            node("Slice", ["sel", "s2", "e3", "ax1"], ["col_i"]),   # [:, 2:3]
+            node("Concat", ["col_b", "col_i"], ["idx"], axis=1),
+            node("GatherND", ["boxes", "idx"], ["picked"]),
+        ],
+        initializer=[numpy_to_tensor(np.asarray([3], np.int64), "mo"),
+                     numpy_to_tensor(np.float32(0.5), "iou"),
+                     numpy_to_tensor(np.asarray([0], np.int64), "s0"),
+                     numpy_to_tensor(np.asarray([1], np.int64), "e1"),
+                     numpy_to_tensor(np.asarray([2], np.int64), "s2"),
+                     numpy_to_tensor(np.asarray([3], np.int64), "e3"),
+                     numpy_to_tensor(np.asarray([1], np.int64), "ax1")],
+        input=[ValueInfoProto(name="boxes", elem_type=P.FLOAT, dims=[1, 3, 4]),
+               ValueInfoProto(name="scores", elem_type=P.FLOAT, dims=[1, 1, 3])],
+        output=[ValueInfoProto(name="picked", elem_type=P.FLOAT, dims=[3, 4])],
+    )
+    import jax
+
+    conv = convert_graph(ModelProto(graph=g).encode())
+    # three well-separated boxes -> all three selected, ordered by score
+    boxes = np.asarray([[[0, 0, 1, 1], [3, 3, 4, 4], [8, 8, 9, 9]]],
+                       np.float32)
+    scores = np.asarray([[[0.7, 0.9, 0.8]]], np.float32)
+    # same jit wrapping as ONNXModel._jitted: feeds are tracers, so the
+    # NMS -> Slice/Concat -> GatherND index flow runs fully traced
+    picked = jax.jit(lambda b, s: conv(boxes=b, scores=s)["picked"])(
+        boxes, scores)
+    np.testing.assert_array_equal(np.asarray(picked), boxes[0][[1, 2, 0]])
