@@ -817,6 +817,11 @@ def _constant(ins, attrs):
 def _reduce(fn, ins, attrs):
     axes = (tuple(int(a) for a in np.asarray(ins[1]))
             if len(ins) > 1 and ins[1] is not None else attrs.get("axes"))
+    # opset-18 axes-as-input: an EMPTY axes tensor with
+    # noop_with_empty_axes=1 means identity, not reduce-all
+    if axes is not None and len(tuple(axes)) == 0 \
+            and attrs.get("noop_with_empty_axes"):
+        return ins[0]
     keep = bool(attrs.get("keepdims", 1))
     return fn(ins[0], axis=tuple(axes) if axes else None, keepdims=keep)
 
@@ -862,6 +867,8 @@ def _topk(ins, attrs):
 
 @op("ArgMax")
 def _argmax(ins, attrs):
+    if attrs.get("select_last_index"):
+        raise NotImplementedError("ArgMax select_last_index=1")
     out = jnp.argmax(ins[0], axis=attrs.get("axis", 0))
     if attrs.get("keepdims", 1):
         out = jnp.expand_dims(out, attrs.get("axis", 0))
@@ -876,6 +883,247 @@ def _reduce_prod(ins, attrs):
 @op("Tile")
 def _tile(ins, attrs):
     return jnp.tile(ins[0], tuple(int(r) for r in np.asarray(ins[1])))
+
+
+# ---------------- elementwise / logic / layout tail ----------------
+# (the long tail of ORT's opset behind the reference ONNXModel; NonZero,
+# Compress and Unique are deliberately absent — their outputs are
+# dynamically shaped, which XLA's static-shape model cannot express)
+
+def _variadic(fn):
+    def handler(ins, attrs):
+        out = ins[0]
+        for x in ins[1:]:
+            out = fn(out, x)
+        return out
+    return handler
+
+
+OP_REGISTRY["Min"] = _variadic(jnp.minimum)
+OP_REGISTRY["Max"] = _variadic(jnp.maximum)
+OP_REGISTRY["Sum"] = _variadic(jnp.add)
+OP_REGISTRY["And"] = _variadic(jnp.logical_and)
+OP_REGISTRY["Or"] = _variadic(jnp.logical_or)
+OP_REGISTRY["Xor"] = _variadic(jnp.logical_xor)
+
+
+@op("Mean")
+def _mean_variadic(ins, attrs):
+    return _variadic(jnp.add)(ins, attrs) / len(ins)
+
+
+for _name, _fn in {
+    "Floor": jnp.floor, "Ceil": jnp.ceil, "Round": jnp.round,  # jnp.round IS half-to-even, per spec
+    "Sign": jnp.sign, "Reciprocal": lambda x: 1.0 / x,
+    "Softplus": jax.nn.softplus,
+    "Softsign": lambda x: x / (1 + jnp.abs(x)),
+    "Mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "IsNaN": jnp.isnan,
+}.items():
+    OP_REGISTRY[_name] = (lambda f: lambda ins, attrs: f(ins[0]))(_fn)
+
+
+@op("Mod")
+def _mod(ins, attrs):
+    if attrs.get("fmod"):
+        return jnp.fmod(ins[0], ins[1])
+    return jnp.mod(ins[0], ins[1])  # sign follows divisor, the ONNX int default
+
+
+@op("PRelu")
+def _prelu(ins, attrs):
+    x, slope = ins[0], ins[1]
+    return jnp.where(x < 0, slope * x, x)
+
+
+@op("Elu")
+def _elu(ins, attrs):
+    a = attrs.get("alpha", 1.0)
+    x = ins[0]
+    return jnp.where(x < 0, a * (jnp.exp(x) - 1.0), x)
+
+
+@op("Selu")
+def _selu(ins, attrs):
+    a = attrs.get("alpha", 1.67326319217681884765625)
+    g = attrs.get("gamma", 1.05070102214813232421875)
+    x = ins[0]
+    return g * jnp.where(x < 0, a * (jnp.exp(x) - 1.0), x)
+
+
+@op("Celu")
+def _celu(ins, attrs):
+    a = attrs.get("alpha", 1.0)
+    x = ins[0]
+    return jnp.maximum(x, 0) + jnp.minimum(0, a * (jnp.exp(x / a) - 1.0))
+
+
+@op("ThresholdedRelu")
+def _thresholded_relu(ins, attrs):
+    a = attrs.get("alpha", 1.0)
+    return jnp.where(ins[0] > a, ins[0], 0.0)
+
+
+@op("Shrink")
+def _shrink(ins, attrs):
+    lambd = attrs.get("lambd", 0.5)
+    bias = attrs.get("bias", 0.0)
+    x = ins[0]
+    return jnp.where(x < -lambd, x + bias, jnp.where(x > lambd, x - bias, 0.0))
+
+
+@op("IsInf")
+def _isinf(ins, attrs):
+    x = ins[0]
+    pos = bool(attrs.get("detect_positive", 1))
+    neg = bool(attrs.get("detect_negative", 1))
+    return ((jnp.isposinf(x) & pos) | (jnp.isneginf(x) & neg))
+
+
+@op("GreaterOrEqual")
+def _greater_equal(ins, attrs):
+    return ins[0] >= ins[1]
+
+
+@op("LessOrEqual")
+def _less_equal(ins, attrs):
+    return ins[0] <= ins[1]
+
+
+@op("BitShift")
+def _bit_shift(ins, attrs):
+    if attrs.get("direction") == "LEFT":
+        return jnp.left_shift(ins[0], ins[1])
+    return jnp.right_shift(ins[0], ins[1])
+
+
+@op("CumSum")
+def _cumsum(ins, attrs):
+    x = ins[0]
+    axis = int(np.asarray(ins[1]).ravel()[0])
+    if attrs.get("reverse"):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive"):
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        out = jnp.pad(out, pad)
+        out = jax.lax.slice_in_dim(out, 0, x.shape[axis], axis=axis)
+    if attrs.get("reverse"):
+        out = jnp.flip(out, axis)
+    return out
+
+
+@op("OneHot")
+def _one_hot(ins, attrs):
+    indices, depth, values = ins[0], int(np.asarray(ins[1]).ravel()[0]), ins[2]
+    axis = attrs.get("axis", -1)
+    idx = jnp.asarray(indices)
+    idx = jnp.where(idx < 0, idx + depth, idx)           # negative wrap, per spec
+    # select via boolean mask, not float blending — off/on keep their exact
+    # dtype (int64 on-values above 2^24 would corrupt through float32)
+    oh = jax.nn.one_hot(idx, depth, axis=axis, dtype=jnp.bool_)
+    vals = jnp.asarray(values)
+    return jnp.where(oh, vals[1], vals[0])
+
+
+@op("ArgMin")
+def _argmin(ins, attrs):
+    if attrs.get("select_last_index"):
+        raise NotImplementedError("ArgMin select_last_index=1")
+    out = jnp.argmin(ins[0], axis=attrs.get("axis", 0))
+    if attrs.get("keepdims", 1):
+        out = jnp.expand_dims(out, attrs.get("axis", 0))
+    return out
+
+
+@op("ReduceL1")
+def _reduce_l1(ins, attrs):
+    return _reduce(lambda x, axis, keepdims: jnp.sum(jnp.abs(x), axis=axis,
+                                                     keepdims=keepdims),
+                   ins, attrs)
+
+
+@op("ReduceL2")
+def _reduce_l2(ins, attrs):
+    return _reduce(lambda x, axis, keepdims: jnp.sqrt(
+        jnp.sum(x * x, axis=axis, keepdims=keepdims)), ins, attrs)
+
+
+@op("ReduceSumSquare")
+def _reduce_sum_square(ins, attrs):
+    return _reduce(lambda x, axis, keepdims: jnp.sum(x * x, axis=axis,
+                                                     keepdims=keepdims),
+                   ins, attrs)
+
+
+@op("ReduceLogSum")
+def _reduce_log_sum(ins, attrs):
+    return _reduce(lambda x, axis, keepdims: jnp.log(
+        jnp.sum(x, axis=axis, keepdims=keepdims)), ins, attrs)
+
+
+@op("ReduceLogSumExp")
+def _reduce_log_sum_exp(ins, attrs):
+    import jax.scipy.special as jsp
+
+    return _reduce(lambda x, axis, keepdims: jsp.logsumexp(
+        x, axis=axis, keepdims=keepdims), ins, attrs)
+
+
+@op("DepthToSpace")
+def _depth_to_space(ins, attrs):
+    x = ins[0]
+    b = int(attrs["blocksize"])
+    N, C, H, W = x.shape
+    if attrs.get("mode", "DCR") == "CRD":
+        t = x.reshape(N, C // (b * b), b, b, H, W)
+        t = jnp.transpose(t, (0, 1, 4, 2, 5, 3))
+    else:                                                # DCR (default)
+        t = x.reshape(N, b, b, C // (b * b), H, W)
+        t = jnp.transpose(t, (0, 3, 4, 1, 5, 2))
+    return t.reshape(N, C // (b * b), H * b, W * b)
+
+
+@op("SpaceToDepth")
+def _space_to_depth(ins, attrs):
+    x = ins[0]
+    b = int(attrs["blocksize"])
+    N, C, H, W = x.shape
+    t = x.reshape(N, C, H // b, b, W // b, b)
+    t = jnp.transpose(t, (0, 3, 5, 1, 2, 4))
+    return t.reshape(N, C * b * b, H // b, W // b)
+
+
+@op("ReverseSequence")
+def _reverse_sequence(ins, attrs):
+    x, seq_lens = jnp.asarray(ins[0]), jnp.asarray(ins[1])
+    batch_axis = attrs.get("batch_axis", 1)
+    time_axis = attrs.get("time_axis", 0)
+    T = x.shape[time_axis]
+    t_idx = jnp.arange(T)
+    # per-batch: first len[b] entries reversed, the rest untouched
+    rev = jnp.where(t_idx[None, :] < seq_lens[:, None],
+                    seq_lens[:, None] - 1 - t_idx[None, :],
+                    t_idx[None, :])                      # [B, T]
+    xb = jnp.moveaxis(x, (batch_axis, time_axis), (0, 1))
+    out = jax.vmap(lambda row, idx: jnp.take(row, idx, axis=0))(xb, rev)
+    return jnp.moveaxis(out, (0, 1), (batch_axis, time_axis))
+
+
+@op("EyeLike")
+def _eye_like(ins, attrs):
+    from .proto import _DTYPE_TO_NP
+
+    x = ins[0]
+    # x.dtype works on tracers too; np.asarray would concretize under jit
+    dtype = _DTYPE_TO_NP[attrs["dtype"]] if "dtype" in attrs else x.dtype
+    return jnp.eye(x.shape[0], x.shape[1], k=attrs.get("k", 0), dtype=dtype)
+
+
+@op("Size")
+def _size(ins, attrs):
+    return np.asarray(int(np.prod(np.shape(ins[0]))), np.int64)
 
 
 # ---------------- quantization family ----------------

@@ -1,0 +1,217 @@
+"""Elementwise / logic / layout tail ops vs numpy spec oracles — the long
+tail of ORT's opset behind the reference ONNXModel (`ONNXRuntime.scala:25`)."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.onnx.convert import OP_REGISTRY
+
+
+def run_op(op, ins, **attrs):
+    return np.asarray(OP_REGISTRY[op](
+        [None if x is None else np.asarray(x) for x in ins], attrs))
+
+
+rs = np.random.default_rng(0)
+X = (rs.normal(size=(3, 5)) * 3).astype(np.float32)
+Y = (rs.normal(size=(3, 5)) * 3).astype(np.float32)
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("Floor", np.floor), ("Ceil", np.ceil), ("Round", np.rint),
+    ("Sign", np.sign), ("Reciprocal", lambda x: 1 / x),
+    ("Softplus", lambda x: np.log1p(np.exp(x))),
+    ("Softsign", lambda x: x / (1 + np.abs(x))),
+    ("Mish", lambda x: x * np.tanh(np.log1p(np.exp(x)))),
+    ("IsNaN", np.isnan),
+])
+def test_unary_elementwise(op, ref):
+    np.testing.assert_allclose(run_op(op, [X]), ref(X), rtol=1e-5, atol=1e-6)
+
+
+def test_round_is_half_to_even():
+    x = np.asarray([0.5, 1.5, 2.5, -0.5, -1.5], np.float32)
+    np.testing.assert_array_equal(run_op("Round", [x]), [0, 2, 2, -0, -2])
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("Min", np.minimum), ("Max", np.maximum), ("Sum", np.add),
+])
+def test_variadic(op, ref):
+    np.testing.assert_allclose(run_op(op, [X, Y, X]), ref(ref(X, Y), X))
+
+
+def test_mean_variadic():
+    np.testing.assert_allclose(run_op("Mean", [X, Y, X]), (X + Y + X) / 3,
+                               rtol=1e-6)
+
+
+def test_logic_and_comparison():
+    a, b = X > 0, Y > 0
+    np.testing.assert_array_equal(run_op("And", [a, b]), a & b)
+    np.testing.assert_array_equal(run_op("Or", [a, b]), a | b)
+    np.testing.assert_array_equal(run_op("Xor", [a, b]), a ^ b)
+    np.testing.assert_array_equal(run_op("GreaterOrEqual", [X, Y]), X >= Y)
+    np.testing.assert_array_equal(run_op("LessOrEqual", [X, Y]), X <= Y)
+
+
+def test_mod_semantics():
+    a = np.asarray([-4, 7, 5], np.int64)
+    b = np.asarray([3, -3, 8], np.int64)
+    np.testing.assert_array_equal(run_op("Mod", [a, b]), np.mod(a, b))
+    af = np.asarray([-4.3, 7.2], np.float32)
+    bf = np.asarray([2.1, -3.3], np.float32)
+    np.testing.assert_allclose(run_op("Mod", [af, bf], fmod=1),
+                               np.fmod(af, bf), rtol=1e-6)
+
+
+def test_activation_family():
+    np.testing.assert_allclose(run_op("PRelu", [X, np.float32(0.1)]),
+                               np.where(X < 0, 0.1 * X, X))
+    np.testing.assert_allclose(run_op("Elu", [X], alpha=0.5),
+                               np.where(X < 0, 0.5 * (np.exp(X) - 1), X),
+                               rtol=1e-6)
+    a, g = 1.67326319217681884765625, 1.05070102214813232421875
+    np.testing.assert_allclose(run_op("Selu", [X]),
+                               g * np.where(X < 0, a * (np.exp(X) - 1), X),
+                               rtol=1e-5)
+    np.testing.assert_allclose(run_op("Celu", [X], alpha=2.0),
+                               np.maximum(X, 0)
+                               + np.minimum(0, 2.0 * (np.exp(X / 2.0) - 1)),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(run_op("ThresholdedRelu", [X], alpha=1.0),
+                               np.where(X > 1.0, X, 0))
+    np.testing.assert_allclose(
+        run_op("Shrink", [X], lambd=0.5, bias=0.2),
+        np.where(X < -0.5, X + 0.2, np.where(X > 0.5, X - 0.2, 0)))
+
+
+def test_isinf_directions():
+    x = np.asarray([np.inf, -np.inf, 1.0], np.float32)
+    np.testing.assert_array_equal(run_op("IsInf", [x]), [True, True, False])
+    np.testing.assert_array_equal(run_op("IsInf", [x], detect_negative=0),
+                                  [True, False, False])
+    np.testing.assert_array_equal(run_op("IsInf", [x], detect_positive=0),
+                                  [False, True, False])
+
+
+def test_bit_shift():
+    a = np.asarray([1, 2, 8], np.uint8)
+    np.testing.assert_array_equal(run_op("BitShift", [a, np.uint8(2)],
+                                         direction="LEFT"), a << 2)
+    np.testing.assert_array_equal(run_op("BitShift", [a, np.uint8(1)],
+                                         direction="RIGHT"), a >> 1)
+
+
+@pytest.mark.parametrize("exclusive,reverse", [(0, 0), (1, 0), (0, 1), (1, 1)])
+def test_cumsum_modes(exclusive, reverse):
+    x = np.asarray([[1.0, 2, 3], [4, 5, 6]], np.float32)
+    got = run_op("CumSum", [x, np.asarray(1)], exclusive=exclusive,
+                 reverse=reverse)
+    ref = x[:, ::-1] if reverse else x
+    ref = np.cumsum(ref, axis=1)
+    if exclusive:
+        ref = np.concatenate([np.zeros((2, 1), np.float32), ref[:, :-1]], 1)
+    if reverse:
+        ref = ref[:, ::-1]
+    np.testing.assert_allclose(got, ref)
+
+
+def test_one_hot():
+    idx = np.asarray([0, 2, -1], np.int64)        # -1 wraps to depth-1
+    vals = np.asarray([2.0, 9.0], np.float32)     # [off, on]
+    got = run_op("OneHot", [idx, np.asarray(4), vals])
+    ref = np.full((3, 4), 2.0, np.float32)
+    ref[0, 0] = ref[1, 2] = ref[2, 3] = 9.0
+    np.testing.assert_array_equal(got, ref)
+    got_ax0 = run_op("OneHot", [idx, np.asarray(4), vals], axis=0)
+    np.testing.assert_array_equal(got_ax0, ref.T)
+
+
+def test_argmin_and_reduce_family():
+    np.testing.assert_array_equal(
+        run_op("ArgMin", [X], axis=1, keepdims=0), X.argmin(1))
+    np.testing.assert_allclose(run_op("ReduceL1", [X, np.asarray([1])]),
+                               np.abs(X).sum(1, keepdims=True), rtol=1e-6)
+    np.testing.assert_allclose(run_op("ReduceL2", [X, np.asarray([1])]),
+                               np.sqrt((X ** 2).sum(1, keepdims=True)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(run_op("ReduceSumSquare", [X, np.asarray([1])]),
+                               (X ** 2).sum(1, keepdims=True), rtol=1e-6)
+    Xp = np.abs(X) + 0.1
+    np.testing.assert_allclose(run_op("ReduceLogSum", [Xp, np.asarray([1])]),
+                               np.log(Xp.sum(1, keepdims=True)), rtol=1e-6)
+    np.testing.assert_allclose(
+        run_op("ReduceLogSumExp", [X, np.asarray([1])]),
+        np.log(np.exp(X).sum(1, keepdims=True)), rtol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["DCR", "CRD"])
+def test_depth_to_space_roundtrip(mode):
+    x = rs.normal(size=(2, 8, 3, 4)).astype(np.float32)
+    up = run_op("DepthToSpace", [x], blocksize=2, mode=mode)
+    assert up.shape == (2, 2, 6, 8)
+    if mode == "DCR":  # SpaceToDepth is DCR's exact inverse
+        back = run_op("SpaceToDepth", [up], blocksize=2)
+        np.testing.assert_array_equal(back, x)
+
+
+def test_depth_to_space_dcr_oracle():
+    # 1x4x1x1, blocksize 2 -> channels [0,1,2,3] land row-major in the 2x2
+    x = np.arange(4, dtype=np.float32).reshape(1, 4, 1, 1)
+    out = run_op("DepthToSpace", [x], blocksize=2)
+    np.testing.assert_array_equal(out.reshape(2, 2), [[0, 1], [2, 3]])
+
+
+def test_reverse_sequence():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)     # [T=4, B=3]
+    lens = np.asarray([4, 2, 1], np.int64)
+    got = run_op("ReverseSequence", [x, lens])            # defaults T=0, B=1
+    ref = x.copy()
+    for b, L in enumerate(lens):
+        ref[:L, b] = ref[:L, b][::-1]
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_eye_like_and_size():
+    x = np.zeros((3, 5), np.float32)
+    np.testing.assert_array_equal(run_op("EyeLike", [x], k=1),
+                                  np.eye(3, 5, k=1, dtype=np.float32))
+    assert int(run_op("Size", [x])) == 15
+
+
+def test_eye_like_jit_safe_without_dtype_attr():
+    import jax
+
+    out = jax.jit(lambda x: OP_REGISTRY["EyeLike"]([x], {}))(
+        np.zeros((3, 3), np.float32))
+    np.testing.assert_array_equal(np.asarray(out), np.eye(3, dtype=np.float32))
+    assert np.asarray(out).dtype == np.float32
+
+
+def test_one_hot_exact_int64_values():
+    # on-value above 2^24: float32 blending would corrupt it
+    big = 2 ** 24 + 1
+    got = run_op("OneHot", [np.asarray([1], np.int64), np.asarray(3),
+                            np.asarray([0, big], np.int64)])
+    # jax demotes int64->int32 (x64 disabled), but the VALUE stays exact —
+    # float32 blending would have rounded it to 2^24
+    assert got.dtype.kind == "i"
+    np.testing.assert_array_equal(got, [[0, big, 0]])
+
+
+def test_argminmax_select_last_index_raises():
+    with pytest.raises(NotImplementedError, match="select_last_index"):
+        run_op("ArgMin", [X], select_last_index=1)
+    with pytest.raises(NotImplementedError, match="select_last_index"):
+        run_op("ArgMax", [X], select_last_index=1)
+
+
+def test_reduce_noop_with_empty_axes():
+    got = run_op("ReduceL2", [X, np.asarray([], np.int64)],
+                 noop_with_empty_axes=1)
+    np.testing.assert_array_equal(got, X)            # identity, per opset 18
+    # without the flag an empty axes tensor means reduce-all
+    got_all = run_op("ReduceSum", [X, np.asarray([], np.int64)])
+    np.testing.assert_allclose(got_all, X.sum(keepdims=True).reshape(1, 1),
+                               rtol=1e-6)
