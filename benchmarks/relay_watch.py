@@ -29,13 +29,16 @@ _spec.loader.exec_module(bench)
 
 # (config, total child deadline seconds) — generous: this path has no
 # driver kill-timeout to stay under, only the session's lifetime.
+# smallest-compile-first: a brief window should bank the cheap configs
+# before the ViT-B/16 compile (which outran 450s and appeared to wedge the
+# relay in both 2026-07-31 windows) gets its attempt
 QUEUE = [
-    ("gbdt-higgs", 900),
-    ("vit", 900),
     ("onnx-resnet", 600),
     ("llama-decode", 600),
-    ("gbdt-hist-backends", 900),
     ("flagship", 480),   # recapture: the 2026-07-31 window number was contended
+    ("gbdt-higgs", 900),
+    ("gbdt-hist-backends", 900),
+    ("vit", 900),
 ]
 MAX_ATTEMPTS = 4         # per config, counting only backend-up failures
 HANG_BACKOFF_S = 480

@@ -817,9 +817,9 @@ def _constant(ins, attrs):
 def _reduce(fn, ins, attrs):
     axes = (tuple(int(a) for a in np.asarray(ins[1]))
             if len(ins) > 1 and ins[1] is not None else attrs.get("axes"))
-    # opset-18 axes-as-input: an EMPTY axes tensor with
+    # opset-18 axes-as-input: an EMPTY (or entirely omitted) axes tensor with
     # noop_with_empty_axes=1 means identity, not reduce-all
-    if axes is not None and len(tuple(axes)) == 0 \
+    if (axes is None or len(tuple(axes)) == 0) \
             and attrs.get("noop_with_empty_axes"):
         return ins[0]
     keep = bool(attrs.get("keepdims", 1))
@@ -886,9 +886,10 @@ def _tile(ins, attrs):
 
 
 # ---------------- elementwise / logic / layout tail ----------------
-# (the long tail of ORT's opset behind the reference ONNXModel; NonZero,
-# Compress and Unique are deliberately absent — their outputs are
-# dynamically shaped, which XLA's static-shape model cannot express)
+# (the long tail of ORT's opset behind the reference ONNXModel. NonZero,
+# Compress and Unique have dynamically-shaped outputs that XLA's static-shape
+# model cannot express — they run in eager (non-jit) execution only, where
+# their inputs are concrete; under jit they raise with a clear message.)
 
 def _variadic(fn):
     def handler(ins, attrs):
@@ -1387,6 +1388,124 @@ def _non_max_suppression(ins, attrs):
     return jnp.concatenate(rows, axis=0).astype(jnp.int32)
 
 
+# ---------------- trig / hyperbolic / misc unary tail ----------------
+
+for _name, _fn in {
+    "Tan": jnp.tan, "Asin": jnp.arcsin, "Acos": jnp.arccos,
+    "Atan": jnp.arctan, "Sinh": jnp.sinh, "Cosh": jnp.cosh,
+    "Asinh": jnp.arcsinh, "Acosh": jnp.arccosh, "Atanh": jnp.arctanh,
+}.items():
+    OP_REGISTRY[_name] = (lambda f: lambda ins, attrs: f(ins[0]))(_fn)
+
+
+@op("Hardmax")
+def _hardmax(ins, attrs):
+    """One-hot of the argmax along ``axis`` (opset-13 elementwise semantics;
+    ties go to the first index, matching ORT)."""
+    x = ins[0]
+    axis = attrs.get("axis", -1)
+    return jax.nn.one_hot(jnp.argmax(x, axis=axis), x.shape[axis], axis=axis,
+                          dtype=x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                          else jnp.float32)
+
+
+@op("LRN")
+def _lrn(ins, attrs):
+    """AlexNet-era local response normalization over the channel axis (NCHW):
+    y = x / (bias + alpha/size * sum_window x^2)^beta. The cross-channel
+    window sum is a sum of ``size`` channel-shifted slices — XLA fuses these
+    into one pass, no conv needed."""
+    x = ins[0]
+    size = int(attrs["size"])
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    bias = attrs.get("bias", 1.0)
+    C = x.shape[1]
+    lo = (size - 1) // 2          # window: [c - lo, c + (size - 1 - lo)]
+    hi = size - 1 - lo
+    pad = [(0, 0), (lo, hi)] + [(0, 0)] * (x.ndim - 2)
+    sq = jnp.pad(jnp.square(x.astype(jnp.float32)), pad)
+    acc = sum(jax.lax.slice_in_dim(sq, i, i + C, axis=1) for i in range(size))
+    return (x / jnp.power(bias + (alpha / size) * acc, beta)).astype(x.dtype)
+
+
+@op("LpNormalization")
+def _lp_normalization(ins, attrs):
+    x = ins[0]
+    axis = attrs.get("axis", -1)
+    p = attrs.get("p", 2)
+    if p == 1:
+        n = jnp.sum(jnp.abs(x), axis=axis, keepdims=True)
+    elif p == 2:
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        raise NotImplementedError(f"LpNormalization p={p} (spec allows 1 or 2)")
+    return x / n
+
+
+@op("GlobalLpPool")
+def _global_lp_pool(ins, attrs):
+    x = ins[0]
+    p = attrs.get("p", 2)
+    axes = tuple(range(2, x.ndim))
+    out = jnp.sum(jnp.abs(x.astype(jnp.float32)) ** p, axis=axes,
+                  keepdims=True) ** (1.0 / p)
+    return out.astype(x.dtype)
+
+
+# ---------------- dynamically-shaped ops (eager execution only) ----------------
+
+def _require_concrete(x, opname: str):
+    import jax.core
+
+    if isinstance(x, jax.core.Tracer):
+        raise NotImplementedError(
+            f"ONNX {opname} has a data-dependent output shape, which XLA's "
+            f"static-shape model cannot express — run the model in eager "
+            f"(non-jit) mode for this graph")
+    return np.asarray(x)
+
+
+@op("NonZero")
+def _nonzero(ins, attrs):
+    x = _require_concrete(ins[0], "NonZero")
+    # int64 per spec; host numpy so disabled-x64 jnp doesn't clamp indices
+    return np.stack(np.nonzero(x)).astype(np.int64)
+
+
+@op("Compress")
+def _compress(ins, attrs):
+    # only the CONDITION must be concrete — the data may stay traced (the
+    # output shape is known once the mask is)
+    cond = _require_concrete(ins[1], "Compress").astype(bool)
+    idx = jnp.asarray(np.nonzero(cond)[0].astype(np.int32))
+    axis = attrs.get("axis")
+    if axis is None:
+        return jnp.take(jnp.reshape(ins[0], (-1,)), idx, axis=0)
+    return jnp.take(ins[0], idx, axis=int(axis))
+
+
+@op("Unique")
+def _unique(ins, attrs):
+    """Y, indices, inverse_indices, counts — all int64 per spec. For
+    sorted=0 the uniques are reordered to first-occurrence order (numpy
+    always sorts, so the inverse map is re-ranked through the permutation)."""
+    x = _require_concrete(ins[0], "Unique")
+    axis = attrs.get("axis")
+    vals, index, inverse, counts = np.unique(
+        x if axis is not None else x.ravel(), axis=axis,
+        return_index=True, return_inverse=True, return_counts=True)
+    if not attrs.get("sorted", 1):
+        order = np.argsort(index, kind="stable")
+        rank = np.empty_like(order)
+        rank[order] = np.arange(len(order))
+        vals = np.take(vals, order, axis=0 if axis is None else axis)
+        index, counts = index[order], counts[order]
+        inverse = rank[inverse]
+    return (vals, index.astype(np.int64), inverse.ravel().astype(np.int64),
+            counts.astype(np.int64))
+
+
 # ---------------------------------------------------------------------------
 # graph executor
 # ---------------------------------------------------------------------------
@@ -1409,6 +1528,10 @@ def _exec_nodes(graph, env: dict) -> None:
         ins = [env[i] if i else None for i in node.input]
         if node.op_type == "If":
             out = _exec_if(node, ins, env)
+        elif node.op_type == "Loop":
+            out = _exec_loop(node, ins, env)
+        elif node.op_type == "Scan":
+            out = _exec_scan(node, ins, env)
         else:
             out = OP_REGISTRY[node.op_type](ins, node.attrs())
         outs = out if isinstance(out, tuple) else (out,)
@@ -1431,10 +1554,221 @@ def _exec_if(node, ins, env: dict):
             "statically; only shape-guard Ifs (torch export) are supported")
     attrs = {a.name: a.g for a in node.attribute}
     branch = attrs["then_branch"] if bool(np.asarray(cond)) else attrs["else_branch"]
-    sub_env = dict(env)  # outer scope is readable, never written back
-    sub_env.update(_load_initializers(branch))
-    _exec_nodes(branch, sub_env)
-    return tuple(sub_env[vi.name] for vi in branch.output)
+    return tuple(_run_subgraph(branch, env, {}))
+
+
+def _run_subgraph(body, env: dict, bound: dict):
+    """Execute ``body`` with ``bound`` formal inputs over a read-only copy of
+    the outer scope; returns the body outputs in declaration order."""
+    sub_env = dict(env)
+    sub_env.update(_load_initializers(body))
+    sub_env.update(bound)
+    _exec_nodes(body, sub_env)
+    return [sub_env[vi.name] for vi in body.output]
+
+
+def _is_traced(*xs) -> bool:
+    import jax.core
+
+    return any(isinstance(x, jax.core.Tracer) for x in xs if x is not None)
+
+
+def _exec_scan(node, ins, env: dict):
+    """ONNX Scan → ``lax.scan``: the body subgraph becomes the (traceable)
+    step function, loop-state variables the carry, scan inputs the xs (sliced
+    along ``scan_input_axes``, flipped for backward directions), and the
+    stacked per-step outputs are placed on ``scan_output_axes``. One compiled
+    step serves every iteration — no Python-loop unrolling in the jitted path.
+    Reference runs the full opset through ORT (`ONNXRuntime.scala:25`)."""
+    attrs = node.attrs()
+    body = attrs["body"]
+    n_scan = int(attrs["num_scan_inputs"])
+    n_state = len(ins) - n_scan
+    in_axes = [int(a) for a in (attrs.get("scan_input_axes") or [0] * n_scan)]
+    in_dirs = [int(d) for d in (attrs.get("scan_input_directions") or [0] * n_scan)]
+    n_scan_out = len(body.output) - n_state
+    out_axes = [int(a) for a in (attrs.get("scan_output_axes") or [0] * n_scan_out)]
+    out_dirs = [int(d) for d in (attrs.get("scan_output_directions") or [0] * n_scan_out)]
+
+    state0 = tuple(jnp.asarray(s) for s in ins[:n_state])
+    xs = []
+    for x, ax, d in zip(ins[n_state:], in_axes, in_dirs):
+        x = jnp.moveaxis(jnp.asarray(x), ax, 0)
+        xs.append(jnp.flip(x, 0) if d else x)
+    body_in = [vi.name for vi in body.input]
+
+    def step(carry, xslice):
+        bound = dict(zip(body_in[:n_state], carry))
+        bound.update(zip(body_in[n_state:], xslice))
+        outs = _run_subgraph(body, env, bound)
+        new_state = tuple(jnp.asarray(o).astype(c.dtype)
+                          for o, c in zip(outs[:n_state], carry))
+        return new_state, tuple(jnp.asarray(o) for o in outs[n_state:])
+
+    final_state, stacked = jax.lax.scan(step, state0, tuple(xs))
+    outs = list(final_state)
+    for y, ax, d in zip(stacked, out_axes, out_dirs):
+        y = jnp.flip(y, 0) if d else y
+        outs.append(jnp.moveaxis(y, 0, ax))
+    return tuple(outs)
+
+
+def _exec_loop(node, ins, env: dict):
+    """ONNX Loop. In eager execution (concrete values — the default
+    ``ConvertedModel.__call__`` path) this is a plain Python loop with exact
+    spec semantics, including data-dependent early exit and dynamically-sized
+    scan outputs. Under jit, two static forms lower to XLA control flow:
+
+    - state-only loops (no scan outputs) → ``lax.while_loop`` on
+      (iter < M) & cond — data-dependent trip counts stay on-device;
+    - full-trip for-loops (concrete M, scan outputs) → ``lax.scan`` over M
+      steps, the form torch's exporter emits for ``for`` loops. A traced
+      early exit with scan outputs would need a dynamic output shape —
+      rejected explicitly.
+    """
+    attrs = node.attrs()
+    body = attrs["body"]
+    M, cond0 = ins[0], ins[1]
+    states = [jnp.asarray(v) for v in ins[2:]]
+    n_state = len(states)
+    body_in = [vi.name for vi in body.input]  # iter_num, cond_in, states...
+    n_scan_out = len(body.output) - 1 - n_state
+    traced = _is_traced(M, cond0, *states) or any(
+        _is_traced(env.get(name)) for name in _outer_reads(body))
+
+    if M is None or _is_traced(M):
+        max_trip = None  # unbounded (or device-resident; see while_loop path)
+    else:
+        _m = np.asarray(M).ravel()
+        max_trip = int(_m[0]) if _m.size else None
+    keep = True if cond0 is None else cond0
+
+    if not traced:
+        # ---- eager: exact ONNX semantics, dynamic everything ----
+        scan_rows: list[list] = [[] for _ in range(n_scan_out)]
+        i = 0
+        keep_b = bool(np.asarray(keep).ravel()[0]) if keep is not True else True
+        while keep_b and (max_trip is None or i < max_trip):
+            bound = {body_in[0]: jnp.asarray(i, jnp.int32),
+                     body_in[1]: jnp.asarray(keep_b)}
+            bound.update(zip(body_in[2:], states))
+            outs = _run_subgraph(body, env, bound)
+            keep_b = bool(np.asarray(outs[0]).ravel()[0])
+            states = [jnp.asarray(o) for o in outs[1:1 + n_state]]
+            for j in range(n_scan_out):
+                scan_rows[j].append(jnp.asarray(outs[1 + n_state + j]))
+            i += 1
+        if n_scan_out and not scan_rows[0]:
+            # zero-trip loop: recover each scan output's per-step shape/dtype
+            # by speculatively running the body once (pure — no state commit)
+            bound = {body_in[0]: jnp.asarray(0, jnp.int32),
+                     body_in[1]: jnp.asarray(True)}
+            bound.update(zip(body_in[2:], states))
+            try:
+                outs = _run_subgraph(body, env, bound)
+                templates = [jnp.asarray(o) for o in outs[1 + n_state:]]
+            except Exception:  # noqa: BLE001 — fall back to rank-1 empties
+                templates = [jnp.zeros((), jnp.float32)] * n_scan_out
+            return tuple(states) + tuple(
+                jnp.zeros((0,) + t.shape, t.dtype) for t in templates)
+        return tuple(states) + tuple(jnp.stack(rows) for rows in scan_rows)
+
+    # ---- traced ----
+    I32_MAX = np.iinfo(np.int32).max
+    if n_scan_out == 0:
+        if _is_traced(M):
+            # clamp in the source dtype BEFORE narrowing: torch exports
+            # while-loops with M = INT64_MAX, which would wrap to -1
+            m_dev = jnp.minimum(jnp.asarray(M).ravel()[0],
+                                I32_MAX).astype(jnp.int32)
+        elif max_trip is None:
+            m_dev = jnp.asarray(I32_MAX, jnp.int32)
+        else:
+            m_dev = jnp.asarray(min(max_trip, I32_MAX), jnp.int32)
+        cond_init = (jnp.asarray(True) if keep is True
+                     else jnp.asarray(keep).ravel()[0].astype(bool))
+
+        def cond_fn(carry):
+            i, c, _ = carry
+            return c & (i < m_dev)
+
+        def body_fn(carry):
+            i, c, st = carry
+            bound = {body_in[0]: i, body_in[1]: c}
+            bound.update(zip(body_in[2:], st))
+            outs = _run_subgraph(body, env, bound)
+            new_c = jnp.asarray(outs[0]).ravel()[0].astype(bool)
+            new_st = tuple(jnp.asarray(o).astype(s.dtype)
+                           for o, s in zip(outs[1:], st))
+            return i + 1, new_c, new_st
+
+        _, _, final = jax.lax.while_loop(
+            cond_fn, body_fn, (jnp.asarray(0, jnp.int32), cond_init,
+                               tuple(states)))
+        return tuple(final)
+
+    if not isinstance(max_trip, int):
+        raise NotImplementedError(
+            "ONNX Loop with scan outputs under jit requires a static "
+            "(concrete) trip count M — a traced early exit would produce a "
+            "dynamically-shaped output")
+    if _is_traced(keep):
+        raise NotImplementedError(
+            "ONNX Loop with scan outputs under jit requires a concrete "
+            "initial condition — a traced cond would produce a "
+            "dynamically-shaped output")
+    if keep is not True and not bool(np.asarray(keep).ravel()[0]):
+        # concrete-False initial cond: zero trips — statically expressible.
+        # One dead body execution recovers each scan output's row template
+        # (XLA DCE removes the unused ops from the jitted graph).
+        bound = {body_in[0]: jnp.asarray(0, jnp.int32),
+                 body_in[1]: np.asarray(True)}
+        bound.update(zip(body_in[2:], states))
+        outs = _run_subgraph(body, env, bound)
+        return tuple(states) + tuple(
+            jnp.zeros((0,) + jnp.shape(o), jnp.asarray(o).dtype)
+            for o in outs[1 + n_state:])
+
+    def step(carry, i):
+        st = carry
+        # cond_in bound CONCRETE True: a for-loop body (cond_out = Identity/
+        # logic of cond_in, the torch-export form) constant-folds to a
+        # concrete True we can verify; a data-dependent cond surfaces as a
+        # tracer and is rejected at trace time rather than silently ignored
+        bound = {body_in[0]: i, body_in[1]: np.asarray(True)}
+        bound.update(zip(body_in[2:], st))
+        outs = _run_subgraph(body, env, bound)
+        if _is_traced(outs[0]) or not bool(np.asarray(outs[0]).ravel()[0]):
+            raise NotImplementedError(
+                "ONNX Loop with scan outputs under jit supports only "
+                "full-trip for-loops (cond stays true); this body's exit "
+                "condition is data-dependent (or immediately false), which "
+                "would produce a dynamically-shaped output")
+        new_st = tuple(jnp.asarray(o).astype(s.dtype)
+                       for o, s in zip(outs[1:1 + n_state], st))
+        return new_st, tuple(jnp.asarray(o) for o in outs[1 + n_state:])
+
+    final, stacked = jax.lax.scan(step, tuple(states),
+                                  jnp.arange(max_trip, dtype=jnp.int32))
+    return tuple(final) + tuple(stacked)
+
+
+def _outer_reads(body) -> set:
+    """Names a subgraph reads from the outer scope (inputs of its nodes that
+    no local node/initializer/formal-input produces), recursing into nested
+    If/Loop/Scan bodies — a nested branch reading a traced outer tensor must
+    flip the enclosing Loop onto its traced lowering path."""
+    local = {vi.name for vi in body.input} | {t.name for t in body.initializer}
+    reads = set()
+    for n in body.node:
+        for i in n.input:
+            if i and i not in local:
+                reads.add(i)
+        for a in n.attribute:
+            if a.g is not None:
+                reads |= {r for r in _outer_reads(a.g) if r not in local}
+        local.update(o for o in n.output if o)
+    return reads
 
 
 def _all_op_types(graph) -> set:
@@ -1466,7 +1800,8 @@ class ConvertedModel:
         self.input_types = {vi.name: vi.elem_type for vi in g.input
                             if vi.name not in init_names}
         unsupported = sorted(o for o in _all_op_types(g)
-                             if o != "If" and o not in OP_REGISTRY)
+                             if o not in ("If", "Loop", "Scan")
+                             and o not in OP_REGISTRY)
         if unsupported:
             raise NotImplementedError(
                 f"ONNX ops not supported by the TPU converter: {unsupported} "

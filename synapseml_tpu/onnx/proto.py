@@ -339,6 +339,8 @@ class AttributeProto:
             a.type, a.s = ATTR_STRING, value.encode("utf-8")
         elif isinstance(value, np.ndarray):
             a.type, a.t = ATTR_TENSOR, numpy_to_tensor(value)
+        elif isinstance(value, GraphProto):
+            a.type, a.g = ATTR_GRAPH, value
         elif isinstance(value, (list, tuple)):
             if all(isinstance(x, int) for x in value):
                 a.type, a.ints = ATTR_INTS, list(value)

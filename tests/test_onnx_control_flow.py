@@ -1,0 +1,375 @@
+"""ONNX Loop/Scan subgraph ops lowered to lax control flow, plus the
+dynamically-shaped eager-only tail (NonZero/Compress/Unique) and remaining
+unary/normalization ops. The reference runs these through ONNX Runtime behind
+ONNXModel (`ONNXRuntime.scala:25`); here Scan becomes one compiled lax.scan
+step and Loop picks between exact eager semantics and lax.while_loop/scan."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from synapseml_tpu.onnx import (
+    AttributeProto,
+    GraphProto,
+    ModelProto,
+    NodeProto,
+    ValueInfoProto,
+    numpy_to_tensor,
+)
+from synapseml_tpu.onnx import proto as P
+from synapseml_tpu.onnx.convert import OP_REGISTRY, ConvertedModel
+
+
+def node(op, inputs, outputs, **attrs):
+    return NodeProto(input=list(inputs), output=list(outputs), op_type=op,
+                     attribute=[AttributeProto.make(k, v)
+                                for k, v in attrs.items()])
+
+
+def run_op(op, ins, **attrs):
+    out = OP_REGISTRY[op]([None if x is None else np.asarray(x) for x in ins],
+                          attrs)
+    return out
+
+
+def vi(name, dims=()):
+    return ValueInfoProto(name=name, elem_type=P.FLOAT, dims=list(dims))
+
+
+# ---------------------------------------------------------------------------
+# unary / normalization tail
+# ---------------------------------------------------------------------------
+
+rs = np.random.default_rng(0)
+X = rs.uniform(-0.9, 0.9, size=(3, 5)).astype(np.float32)
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("Tan", np.tan), ("Asin", np.arcsin), ("Acos", np.arccos),
+    ("Atan", np.arctan), ("Sinh", np.sinh), ("Cosh", np.cosh),
+    ("Asinh", np.arcsinh), ("Atanh", np.arctanh),
+])
+def test_trig_unary(op, ref):
+    np.testing.assert_allclose(np.asarray(run_op(op, [X])), ref(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_acosh():
+    x = (1.0 + np.abs(X) * 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(run_op("Acosh", [x])), np.arccosh(x),
+                               rtol=1e-5)
+
+
+def test_hardmax():
+    out = np.asarray(run_op("Hardmax", [X], axis=-1))
+    expect = np.zeros_like(X)
+    expect[np.arange(3), X.argmax(-1)] = 1.0
+    np.testing.assert_array_equal(out, expect)
+    out0 = np.asarray(run_op("Hardmax", [X], axis=0))
+    assert out0.sum(axis=0).tolist() == [1.0] * 5
+
+
+def test_lrn_matches_window_spec():
+    x = rs.normal(size=(2, 7, 3, 3)).astype(np.float32)
+    size, alpha, beta, bias = 3, 2e-4, 0.6, 1.5
+    out = np.asarray(run_op("LRN", [x], size=size, alpha=alpha, beta=beta,
+                            bias=bias))
+    C = x.shape[1]
+    lo = (size - 1) // 2
+    expect = np.empty_like(x)
+    for c in range(C):
+        w = slice(max(0, c - lo), min(C, c + (size - 1 - lo) + 1))
+        s = (x[:, w] ** 2).sum(axis=1)
+        expect[:, c] = x[:, c] / (bias + alpha / size * s) ** beta
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_lp_normalization():
+    np.testing.assert_allclose(
+        np.asarray(run_op("LpNormalization", [X], axis=1, p=2)),
+        X / np.linalg.norm(X, axis=1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(run_op("LpNormalization", [X], axis=0, p=1)),
+        X / np.abs(X).sum(0, keepdims=True), rtol=1e-5)
+
+
+def test_global_lp_pool():
+    x = rs.normal(size=(2, 3, 4, 5)).astype(np.float32)
+    out = np.asarray(run_op("GlobalLpPool", [x], p=2))
+    expect = np.sqrt((x ** 2).sum(axis=(2, 3), keepdims=True))
+    assert out.shape == (2, 3, 1, 1)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# dynamically-shaped eager-only ops
+# ---------------------------------------------------------------------------
+
+def test_nonzero():
+    x = np.array([[1, 0, 2], [0, 3, 0]], np.float32)
+    out = run_op("NonZero", [x])
+    np.testing.assert_array_equal(out, np.stack(np.nonzero(x)))
+    assert out.dtype == np.int64
+
+
+def test_nonzero_rejected_under_jit():
+    with pytest.raises(NotImplementedError, match="eager"):
+        jax.jit(lambda x: OP_REGISTRY["NonZero"]([x], {}))(jnp.ones((3,)))
+
+
+def test_compress():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    cond = np.array([0, 1, 1, 0], bool)
+    np.testing.assert_array_equal(np.asarray(run_op("Compress", [x, cond],
+                                                    axis=1)),
+                                  np.compress(cond, x, axis=1))
+    flat_cond = np.array([1, 0, 1, 0, 1], bool)
+    np.testing.assert_array_equal(
+        np.asarray(run_op("Compress", [x, flat_cond])),
+        np.compress(flat_cond, x.ravel()))
+
+
+def test_compress_traced_data_concrete_condition():
+    # condition concrete => static output shape => data may stay traced
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    cond = np.array([1, 0, 1, 0], bool)
+    out = jax.jit(lambda d: OP_REGISTRY["Compress"]([d, cond], {"axis": 1}))(x)
+    np.testing.assert_array_equal(np.asarray(out), x[:, [0, 2]])
+
+
+@pytest.mark.parametrize("sorted_", [1, 0])
+def test_unique(sorted_):
+    x = np.array([2, 1, 1, 3, 4, 3], np.int64)
+    y, idx, inv, counts = run_op("Unique", [x], sorted=sorted_)
+    if sorted_:
+        expect = np.array([1, 2, 3, 4])
+    else:
+        expect = np.array([2, 1, 3, 4])  # first-occurrence order
+    np.testing.assert_array_equal(y, expect)
+    np.testing.assert_array_equal(np.asarray(y)[inv], x)  # inverse rebuilds x
+    np.testing.assert_array_equal(counts, [np.sum(x == v) for v in expect])
+    np.testing.assert_array_equal(x[idx], expect)  # first occurrences
+
+
+def test_unique_axis():
+    x = np.array([[1, 1], [2, 3], [1, 1]], np.float32)
+    y, idx, inv, counts = run_op("Unique", [x], axis=0)
+    np.testing.assert_array_equal(y, [[1, 1], [2, 3]])
+    np.testing.assert_array_equal(counts, [2, 1])
+
+
+# ---------------------------------------------------------------------------
+# Scan
+# ---------------------------------------------------------------------------
+
+def scan_cumsum_model(reverse=False, out_axis=0):
+    """state s; per-step: s' = s + x_t, scan-output s' — a running cumsum."""
+    body = GraphProto(
+        name="body",
+        node=[node("Add", ["s_in", "x_t"], ["s_out"]),
+              node("Identity", ["s_out"], ["y_t"])],
+        input=[vi("s_in", [2]), vi("x_t", [2])],
+        output=[vi("s_out", [2]), vi("y_t", [2])],
+    )
+    attrs = dict(body=body, num_scan_inputs=1)
+    if reverse:
+        attrs["scan_input_directions"] = [1]
+        attrs["scan_output_directions"] = [1]
+    if out_axis:
+        attrs["scan_output_axes"] = [out_axis]
+    g = GraphProto(
+        name="scan_cumsum",
+        node=[node("Scan", ["s0", "xs"], ["s_final", "ys"], **attrs)],
+        input=[vi("s0", [2]), vi("xs", [5, 2])],
+        output=[vi("s_final", [2]),
+                vi("ys", [2, 5] if out_axis else [5, 2])],
+    )
+    return ConvertedModel(ModelProto(graph=g))
+
+
+def test_scan_cumsum_eager_and_jit():
+    m = scan_cumsum_model()
+    xs = rs.normal(size=(5, 2)).astype(np.float32)
+    s0 = np.zeros(2, np.float32)
+    out = m(s0=s0, xs=xs)
+    np.testing.assert_allclose(np.asarray(out["s_final"]), xs.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["ys"]), np.cumsum(xs, 0),
+                               rtol=1e-5)
+    jout = m.jit_fn()(s0, xs)
+    np.testing.assert_allclose(np.asarray(jout["ys"]), np.cumsum(xs, 0),
+                               rtol=1e-5)
+
+
+def test_scan_reverse_direction():
+    m = scan_cumsum_model(reverse=True)
+    xs = rs.normal(size=(5, 2)).astype(np.float32)
+    out = m(s0=np.zeros(2, np.float32), xs=xs)
+    # reverse scan + reverse output = suffix sums aligned with input order
+    np.testing.assert_allclose(np.asarray(out["ys"]),
+                               np.cumsum(xs[::-1], 0)[::-1], rtol=1e-5)
+
+
+def test_scan_output_axis():
+    m = scan_cumsum_model(out_axis=1)
+    xs = rs.normal(size=(5, 2)).astype(np.float32)
+    out = m(s0=np.zeros(2, np.float32), xs=xs)
+    np.testing.assert_allclose(np.asarray(out["ys"]), np.cumsum(xs, 0).T,
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Loop
+# ---------------------------------------------------------------------------
+
+def loop_model(with_scan_output=True, early_exit_at=None):
+    """Loop body: s' = s + (i+1)^2; optional scan output s'; optional
+    cond_out = i < early_exit_at - 1 (else constant true)."""
+    body_nodes = [
+        node("Cast", ["i"], ["i_f"], to=P.FLOAT),
+        node("Add", ["i_f", "one"], ["i1"]),
+        node("Mul", ["i1", "i1"], ["sq"]),
+        node("Add", ["s_in", "sq"], ["s_out"]),
+    ]
+    if early_exit_at is None:
+        body_nodes.append(node("Identity", ["cond_in"], ["cond_out"]))
+    else:
+        body_nodes += [
+            node("Cast", ["i"], ["i64"], to=P.INT64),
+            node("Less", ["i64", "limit"], ["cond_out"]),
+        ]
+    outputs = [vi("cond_out"), vi("s_out")]
+    if with_scan_output:
+        body_nodes.append(node("Identity", ["s_out"], ["y_t"]))
+        outputs.append(vi("y_t"))
+    inits = [numpy_to_tensor(np.float32(1.0), "one")]
+    if early_exit_at is not None:
+        inits.append(numpy_to_tensor(np.int64(early_exit_at - 1), "limit"))
+    body = GraphProto(name="body", node=body_nodes,
+                      input=[vi("i"), vi("cond_in"), vi("s_in")],
+                      output=outputs, initializer=inits)
+    g_outputs = [vi("s_final")]
+    loop_outs = ["s_final"]
+    if with_scan_output:
+        loop_outs.append("ys")
+        g_outputs.append(vi("ys", [None]))
+    g = GraphProto(
+        name="loop_model",
+        node=[node("Loop", ["M", "cond0", "s0"], loop_outs, body=body)],
+        input=[ValueInfoProto(name="M", elem_type=P.INT64, dims=[]),
+               ValueInfoProto(name="cond0", elem_type=P.BOOL, dims=[]),
+               vi("s0")],
+        output=g_outputs,
+    )
+    return ConvertedModel(ModelProto(graph=g))
+
+
+def test_loop_for_eager():
+    m = loop_model()
+    out = m(M=np.int64(4), cond0=np.array(True), s0=np.float32(0.0))
+    # sum of squares 1+4+9+16
+    assert float(out["s_final"]) == 30.0
+    np.testing.assert_allclose(np.asarray(out["ys"]), [1, 5, 14, 30])
+
+
+def test_loop_for_jit_static_m():
+    m = loop_model()
+    # M concrete (closure), data traced -> lax.scan path
+    fn = jax.jit(lambda s0: m(M=np.int64(4), cond0=np.array(True), s0=s0))
+    out = fn(jnp.float32(0.0))
+    assert float(out["s_final"]) == 30.0
+    np.testing.assert_allclose(np.asarray(out["ys"]), [1, 5, 14, 30])
+
+
+def test_loop_early_exit_eager_dynamic_length():
+    m = loop_model(early_exit_at=3)
+    out = m(M=np.int64(100), cond0=np.array(True), s0=np.float32(0.0))
+    # exits after iteration 3: scan output has EXACTLY 3 rows (dynamic length)
+    np.testing.assert_allclose(np.asarray(out["ys"]), [1, 5, 14])
+    assert float(out["s_final"]) == 14.0
+
+
+def test_loop_while_traced_state_only():
+    m = loop_model(with_scan_output=False, early_exit_at=5)
+    fn = jax.jit(lambda s0: m(M=np.int64(100), cond0=np.array(True), s0=s0))
+    out = fn(jnp.float32(0.0))  # lax.while_loop path
+    assert float(out["s_final"]) == sum((k + 1) ** 2 for k in range(5))
+
+
+def test_loop_traced_early_exit_with_scan_output_rejected():
+    m = loop_model(early_exit_at=3)
+    with pytest.raises(NotImplementedError, match="static"):
+        # M itself traced + scan outputs => dynamic output shape
+        jax.jit(lambda M, s0: m(M=M, cond0=np.array(True), s0=s0))(
+            jnp.int64(4) if jax.config.jax_enable_x64 else jnp.int32(4),
+            jnp.float32(0.0))
+
+
+def test_loop_jit_data_dependent_cond_with_scan_output_rejected():
+    # concrete M but the body's cond_out depends on traced data: must raise,
+    # not silently run all M iterations (eager answer would be [1, 5, 14])
+    m = loop_model(early_exit_at=3)
+    with pytest.raises(NotImplementedError, match="data-dependent"):
+        jax.jit(lambda s0: m(M=np.int64(100), cond0=np.array(True), s0=s0))(
+            jnp.float32(0.0))
+
+
+def test_loop_while_int64_max_trip_count():
+    # torch exports while-loops with M = INT64_MAX; must clamp, not wrap
+    m = loop_model(with_scan_output=False, early_exit_at=5)
+    fn = jax.jit(lambda s0: m(M=np.int64(np.iinfo(np.int64).max),
+                              cond0=np.array(True), s0=s0))
+    out = fn(jnp.float32(0.0))
+    assert float(out["s_final"]) == sum((k + 1) ** 2 for k in range(5))
+
+
+def test_loop_zero_trip_scan_output_shape():
+    # cond0 false: scan output must keep the per-step row shape/dtype, (0,)+row
+    body = GraphProto(
+        name="body",
+        node=[node("Identity", ["cond_in"], ["cond_out"]),
+              node("Identity", ["s_in"], ["s_out"]),
+              node("Identity", ["s_in"], ["y_t"])],
+        input=[vi("i"), vi("cond_in"), vi("s_in", [2])],
+        output=[vi("cond_out"), vi("s_out", [2]), vi("y_t", [2])],
+    )
+    g = GraphProto(
+        name="zero_trip",
+        node=[node("Loop", ["M", "cond0", "s0"], ["s_final", "ys"], body=body)],
+        input=[ValueInfoProto(name="M", elem_type=P.INT64, dims=[]),
+               ValueInfoProto(name="cond0", elem_type=P.BOOL, dims=[]),
+               vi("s0", [2])],
+        output=[vi("s_final", [2]), vi("ys", [None, 2])],
+    )
+    m = ConvertedModel(ModelProto(graph=g))
+    out = m(M=np.int64(5), cond0=np.array(False), s0=np.ones(2, np.float32))
+    assert np.asarray(out["ys"]).shape == (0, 2)
+    np.testing.assert_array_equal(np.asarray(out["s_final"]), [1, 1])
+
+
+def test_loop_jit_concrete_false_cond_zero_trips():
+    # concrete cond0=False under jit: zero iterations, correctly-shaped
+    # empty scan output — not M silently-executed trips
+    m = loop_model()
+    out = jax.jit(lambda s0: m(M=np.int64(5), cond0=np.array(False), s0=s0))(
+        jnp.float32(7.0))
+    assert float(out["s_final"]) == 7.0
+    assert np.asarray(out["ys"]).shape == (0,)
+
+
+def test_loop_jit_traced_cond0_with_scan_output_rejected():
+    m = loop_model()
+    with pytest.raises(NotImplementedError, match="concrete"):
+        jax.jit(lambda c, s0: m(M=np.int64(5), cond0=c, s0=s0))(
+            jnp.asarray(True), jnp.float32(0.0))
+
+
+def test_reduce_noop_with_empty_axes_omitted_input():
+    # opset-18: axes omitted entirely + noop_with_empty_axes=1 => identity
+    x = rs.normal(size=(2, 3)).astype(np.float32)
+    out = OP_REGISTRY["ReduceSum"]([x], {"noop_with_empty_axes": 1})
+    np.testing.assert_array_equal(np.asarray(out), x)
+    # without the flag, reduce-all still holds
+    out2 = OP_REGISTRY["ReduceSum"]([x], {})
+    np.testing.assert_allclose(np.asarray(out2), x.sum(), rtol=1e-6)
