@@ -80,11 +80,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale        # [block_q, D]
-        k_blk = k_ref[0].astype(jnp.float32)            # [block_k, D]
-        v_blk = v_ref[0].astype(jnp.float32)
+        # dots stay in the INPUT dtype (bf16 on the training path) with f32
+        # accumulation — a pre-cast to f32 would push the MXU onto its ~4x
+        # slower f32 path; only the softmax statistics need f32. The scale is
+        # applied post-dot in f32 (no bf16 rounding of q, no padded-D fixup).
+        q = q_ref[0]                                    # [block_q, D]
+        k_blk = k_ref[0]                                # [block_k, D]
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bk]
+                                preferred_element_type=jnp.float32) * scale
         valid = mask_ref[0, 0] != 0                     # [bk]
         s = jnp.where(valid[None, :], s, _NEG_INF)
         if causal:
@@ -100,7 +104,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         l_scr[...] = (l_scr[...] * alpha[:, None]
                       + jnp.broadcast_to(jnp.sum(p, axis=1)[:, None], l_scr.shape))
         acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(new_m[:, None], m_scr.shape)
 
     if causal:
@@ -121,14 +126,16 @@ def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_core(q, k, v, kv_mask, causal, block_q, block_k):
-    out, _ = _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_core(q, k, v, kv_mask, causal, block_q, block_k, scale):
+    out, _ = _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k,
+                                  scale)
     return out
 
 
-def _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k):
-    """q,k,v: [BH, T, Dp]; kv_mask: [BH, Tk] bool. Returns (out, lse)."""
+def _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k, scale):
+    """q,k,v: [BH, T, Dp]; kv_mask: [BH, Tk] bool. ``scale`` is 1/sqrt of the
+    TRUE head dim (D may be lane-padded here). Returns (out, lse)."""
     from jax.experimental import pallas as pl
 
     from jax.experimental.pallas import tpu as pltpu
@@ -136,7 +143,6 @@ def _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k):
     BH, Tq, Dp = q.shape
     Tk = k.shape[1]
     n_kblocks = Tk // block_k
-    scale = 1.0 / np.sqrt(q.shape[-1])
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                n_kblocks=n_kblocks, scale=scale, causal=causal,
                                block_q=block_q)
@@ -168,24 +174,23 @@ def _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k):
     return out, lse[:, :, 0]
 
 
-def _flash_core_fwd(q, k, v, kv_mask, causal, block_q, block_k):
-    out, lse = _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k)
+def _flash_core_fwd(q, k, v, kv_mask, causal, block_q, block_k, scale):
+    out, lse = _flash_core_fwd_impl(q, k, v, kv_mask, causal, block_q, block_k,
+                                    scale)
     return out, (q, k, v, kv_mask, out, lse)
 
 
-def _flash_core_bwd(causal, block_q, block_k, res, g):
+def _flash_core_bwd(causal, block_q, block_k, scale, res, g):
     """Blockwise XLA backward from saved LSE — O(T·block) memory via lax.scan
-    over kv blocks (dq) / q blocks (dk, dv)."""
+    over kv blocks (dq) / q blocks (dk, dv). Matmul operands stay in the
+    input dtype (bf16 on the training path) with f32 accumulation; only the
+    softmax/probability statistics are f32."""
     q, k, v, kv_mask, out, lse = res
     BH, Tq, Dp = q.shape
     Tk = k.shape[1]
-    scale = 1.0 / np.sqrt(Dp)
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
+    qf, kf, vf, gf = q, k, v, g.astype(q.dtype)
     # delta_i = sum_d out_i * g_i  (rowwise), standard flash bwd identity
-    delta = jnp.sum(out.astype(jnp.float32) * gf, axis=-1)  # [BH, Tq]
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
 
     q_pos = jnp.arange(Tq)
     kv_pos = jnp.arange(Tk)
@@ -219,7 +224,7 @@ def _flash_core_bwd(causal, block_q, block_k, res, g):
             dp = jnp.einsum("bqd,bkd->bqk", g_blk, vb,
                             preferred_element_type=jnp.float32)
             ds = p * (dp - d_blk[:, :, None])
-            return dq_acc + jnp.einsum("bqk,bkd->bqd", ds, kb,
+            return dq_acc + jnp.einsum("bqk,bkd->bqd", ds.astype(kb.dtype), kb,
                                        preferred_element_type=jnp.float32) * scale
 
         dq_blk = jax.lax.fori_loop(0, n_kb, inner,
@@ -242,12 +247,14 @@ def _flash_core_bwd(causal, block_q, block_k, res, g):
             g_blk = jax.lax.dynamic_slice_in_dim(gf, qi0, block_q, axis=1)
             d_blk = jax.lax.dynamic_slice_in_dim(delta, qi0, block_q, axis=1)
             p, _ = p_block(q_blk, lse_blk, ki, kf, qi0)
-            dv_acc = dv_acc + jnp.einsum("bqk,bqd->bkd", p, g_blk,
+            dv_acc = dv_acc + jnp.einsum("bqk,bqd->bkd", p.astype(g_blk.dtype),
+                                         g_blk,
                                          preferred_element_type=jnp.float32)
             dp = jnp.einsum("bqd,bkd->bqk", g_blk, vb,
                             preferred_element_type=jnp.float32)
             ds = p * (dp - d_blk[:, :, None])
-            dk_acc = dk_acc + jnp.einsum("bqk,bqd->bkd", ds, q_blk,
+            dk_acc = dk_acc + jnp.einsum("bqk,bqd->bkd", ds.astype(q_blk.dtype),
+                                         q_blk,
                                          preferred_element_type=jnp.float32) * scale
             return dk_acc, dv_acc
 
@@ -288,18 +295,18 @@ def flash_attention(q, k, v, kv_mask=None, causal: bool = False,
     block_k = min(block_k, _ceil_to(Tk, 8))
     Tq_p, Tk_p = _ceil_to(Tq, block_q), _ceil_to(Tk, block_k)
     Dp = _ceil_to(D, 128)
-    scale_fix = np.sqrt(Dp) / np.sqrt(D)  # kernel scales by 1/sqrt(Dp); undo
+    scale = 1.0 / np.sqrt(D)  # true head dim — padding D must not change it
 
     def to_bh(x, T, Tp):
         x = jnp.pad(x, ((0, 0), (0, Tp - T), (0, 0), (0, Dp - D)))
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, Tp, Dp)
 
-    qb = to_bh(q * jnp.asarray(scale_fix, q.dtype), Tq, Tq_p)
+    qb = to_bh(q, Tq, Tq_p)
     kb = to_bh(k, Tk, Tk_p)
     vb = to_bh(v, Tk, Tk_p)
     maskb = jnp.pad(kv_mask, ((0, 0), (0, Tk_p - Tk)))
     maskb = jnp.broadcast_to(maskb[:, None, :], (B, H, Tk_p)).reshape(B * H, Tk_p)
 
-    out = _flash_core(qb, kb, vb, maskb, causal, block_q, block_k)
+    out = _flash_core(qb, kb, vb, maskb, causal, block_q, block_k, scale)
     out = out.reshape(B, H, Tq_p, Dp)[:, :, :Tq, :D]
     return jnp.transpose(out, (0, 2, 1, 3))

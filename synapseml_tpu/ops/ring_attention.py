@@ -40,15 +40,17 @@ def _pick_chunk(t_local: int, chunk: int) -> int:
     return max(c, 1)
 
 
-def _block_fwd(qf, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, m, l, acc,
+def _block_fwd(q, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, m, l, acc,
                chunk):
     """Fold one K/V block into the running softmax, scanning over chunks.
 
-    qf: [B, T, H, D] f32; k_blk/v_blk: [B, T, H, D]; mask_blk: [B, T] bool;
-    kv_pos0: scalar global position of the block's first row.
-    m, l: [B, H, T]; acc: [B, H, T, D]. Returns updated (m, l, acc).
+    q/k_blk/v_blk: [B, T, H, D] in the INPUT dtype — the einsums run in that
+    dtype (bf16 on the training path keeps the MXU off its ~4x slower f32
+    path) with f32 accumulation; the softmax statistics are f32.
+    mask_blk: [B, T] bool; kv_pos0: scalar global position of the block's
+    first row. m, l: [B, H, T]; acc: [B, H, T, D] (f32). Returns (m, l, acc).
     """
-    B, T, H, D = qf.shape
+    B, T, H, D = q.shape
     C = _pick_chunk(T, chunk)
     n_chunks = T // C
     scale = 1.0 / np.sqrt(D)
@@ -59,7 +61,7 @@ def _block_fwd(qf, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, m, l, acc,
         ks = jax.lax.dynamic_slice_in_dim(k_blk, start, C, axis=1)
         vs = jax.lax.dynamic_slice_in_dim(v_blk, start, C, axis=1)
         ms = jax.lax.dynamic_slice_in_dim(mask_blk, start, C, axis=1)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, ks.astype(jnp.float32),
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ks,
                             preferred_element_type=jnp.float32) * scale
         scores = jnp.where(ms[:, None, None, :], scores, _NEG_INF)
         if causal:
@@ -74,7 +76,7 @@ def _block_fwd(qf, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, m, l, acc,
                       jnp.exp(scores - new_m[..., None]))      # [B, H, T, C]
         new_l = l * alpha + jnp.sum(p, axis=-1)
         new_acc = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vs.astype(jnp.float32),
+            "bhqk,bkhd->bhqd", p.astype(vs.dtype), vs,
             preferred_element_type=jnp.float32)
         return (new_m, new_l, new_acc), None
 
@@ -82,12 +84,14 @@ def _block_fwd(qf, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, m, l, acc,
     return m, l, acc
 
 
-def _block_bwd(qf, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, lse, do,
+def _block_bwd(q, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, lse, do,
                delta, dq, dk_blk, dv_blk, chunk):
     """Backward for one visiting K/V block: accumulate local dq and the
-    block's traveling dk/dv. All f32. lse: [B, H, T]; do: [B, H, T, D];
-    delta: [B, H, T] (sum(do * out)). Returns (dq, dk_blk, dv_blk)."""
-    B, T, H, D = qf.shape
+    block's traveling dk/dv. Matmul operands stay in the input dtype with f32
+    accumulation; probability/score statistics and the dq/dk/dv accumulators
+    are f32. lse: [B, H, T]; do: [B, H, T, D] (input dtype);
+    delta: [B, H, T] (f32 sum(do * out)). Returns (dq, dk_blk, dv_blk)."""
+    B, T, H, D = q.shape
     C = _pick_chunk(T, chunk)
     n_chunks = T // C
     scale = 1.0 / np.sqrt(D)
@@ -98,7 +102,7 @@ def _block_bwd(qf, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, lse, do,
         ks = jax.lax.dynamic_slice_in_dim(k_blk, start, C, axis=1)
         vs = jax.lax.dynamic_slice_in_dim(v_blk, start, C, axis=1)
         ms = jax.lax.dynamic_slice_in_dim(mask_blk, start, C, axis=1)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, ks,
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ks,
                             preferred_element_type=jnp.float32) * scale
         scores = jnp.where(ms[:, None, None, :], scores, _NEG_INF)
         if causal:
@@ -107,14 +111,14 @@ def _block_bwd(qf, q_pos, k_blk, v_blk, mask_blk, kv_pos0, causal, lse, do,
             scores = jnp.where(allowed[None, None], scores, _NEG_INF)
         p = jnp.where(scores <= _NEG_INF * 0.5, 0.0,
                       jnp.exp(scores - lse[..., None]))        # [B, H, T, C]
-        dv_c = jnp.einsum("bhqk,bhqd->bkhd", p, do,
+        dv_c = jnp.einsum("bhqk,bhqd->bkhd", p.astype(do.dtype), do,
                           preferred_element_type=jnp.float32)
         dp = jnp.einsum("bhqd,bkhd->bhqk", do, vs,
                         preferred_element_type=jnp.float32)
         ds = p * (dp - delta[..., None])                       # [B, H, T, C]
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, ks,
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds.astype(ks.dtype), ks,
                              preferred_element_type=jnp.float32) * scale
-        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf,
+        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds.astype(q.dtype), q,
                           preferred_element_type=jnp.float32) * scale
         dk_blk = jax.lax.dynamic_update_slice_in_dim(
             dk_blk, jax.lax.dynamic_slice_in_dim(dk_blk, start, C, 1) + dk_c,
@@ -145,7 +149,7 @@ def _ring_fwd_impl(q, k, v, kv_mask, axis_name, axis_size, causal, chunk):
     def step(s, carry):
         k_cur, v_cur, mask_cur, m, l, acc = carry
         origin = (my - s) % axis_size
-        m, l, acc = _block_fwd(qf, q_pos, k_cur, v_cur, mask_cur, origin * T,
+        m, l, acc = _block_fwd(q, q_pos, k_cur, v_cur, mask_cur, origin * T,
                                causal, m, l, acc, chunk)
         # rotate K/V/mask to the next device; the final rotation restores the
         # original residency and keeps the loop body uniform
@@ -183,16 +187,16 @@ def _ring_core_bwd(axis_name, axis_size, causal, chunk, res, g):
     q_pos = my * T + jnp.arange(T)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    do = jnp.transpose(g.astype(jnp.float32), (0, 2, 1, 3))    # [B, H, T, D]
+    do = jnp.transpose(g, (0, 2, 1, 3)).astype(q.dtype)        # [B, H, T, D]
     # re-apply the softmax-normalization jacobian piece: out = acc / l and
     # d(acc/l) folds into ds via delta = sum(do * out)
-    delta = jnp.sum(do * out, axis=-1)                         # [B, H, T]
+    delta = jnp.sum(do.astype(jnp.float32) * out, axis=-1)     # [B, H, T]
 
     def step(s, carry):
         k_cur, v_cur, mask_cur, dk_cur, dv_cur, dq = carry
         origin = (my - s) % axis_size
         dq, dk_cur, dv_cur = _block_bwd(
-            qf, q_pos, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            q, q_pos, k_cur, v_cur,
             mask_cur, origin * T, causal, lse, do, delta, dq, dk_cur, dv_cur,
             chunk)
         # dk/dv travel WITH their block so every shard adds its contribution;

@@ -40,6 +40,34 @@ def test_flash_gradients_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
 
+def test_flash_bf16_matches_f32_reference():
+    # the MXU training path: bf16 q/k/v, dots in bf16 with f32 accumulation
+    # (NOT pre-upcast to f32 — that would hit the ~4x slower f32 MXU path).
+    # Values and grads must track the f32 oracle within bf16 resolution.
+    # (small T keeps this in the fast default lane; shape/pad coverage lives
+    # in the f32 tests above)
+    q, k, v, mask = make_qkv(T=16)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref = reference_attention(q, k, v, kv_mask=mask, causal=True)
+    out = flash_attention(qb, kb, vb, kv_mask=mask, causal=True,
+                          block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(ref),
+                               np.asarray(out, dtype=np.float32), atol=3e-2)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v, kv_mask=mask, causal=True).astype(jnp.float32) ** 2)
+
+    g_ref = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    g_fa = jax.grad(loss(lambda *a, **kw: flash_attention(
+        *a, block_q=16, block_k=16, **kw)), argnums=(0, 1, 2))(qb, kb, vb)
+    for a, b in zip(g_ref, g_fa):
+        np.testing.assert_allclose(np.asarray(a),
+                                   np.asarray(b, dtype=np.float32),
+                                   atol=0.15, rtol=0.05)
+
+
 def test_flash_unaligned_shapes():
     # T not a multiple of the block, D not a multiple of 128: pad/slice path
     q, k, v, _ = make_qkv(T=50, D=24)
@@ -68,6 +96,34 @@ def test_ring_attention_matches_reference(causal):
     ref = reference_attention(q, k, v, kv_mask=mask, causal=causal)
     out = ring_attention_sharded(mesh, q, k, v, kv_mask=mask, causal=causal)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_ring_attention_bf16_matches_f32_reference():
+    # MXU training path: bf16 shards, ring einsums in bf16 with f32
+    # accumulation and f32 softmax statistics/traveling grad accumulators
+    q, k, v, mask = make_qkv(T=32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    mesh = create_mesh(MeshConfig(data=1, seq=8))
+    ref = reference_attention(q, k, v, kv_mask=mask, causal=True)
+    out = ring_attention_sharded(mesh, qb, kb, vb, kv_mask=mask, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(ref),
+                               np.asarray(out, dtype=np.float32), atol=3e-2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(
+            mesh, q, k, v, kv_mask=mask, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, kv_mask=mask,
+                                           causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(qb, kb, vb)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(a),
+                                   np.asarray(b, dtype=np.float32),
+                                   atol=0.15, rtol=0.05)
 
 
 def test_ring_attention_mixed_mesh():
