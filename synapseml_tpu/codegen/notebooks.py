@@ -1,0 +1,171 @@
+"""Notebook corpus emission — the reference's ``docs/**/*.ipynb`` tier.
+
+The reference ships its documentation as executable Jupyter notebooks
+(``/root/reference/docs/Explore Algorithms/...``) validated by an nbtest
+tier (``core/src/test/scala/.../nbtest/DatabricksUtilities.scala``). This
+framework keeps the SOURCE of truth as plain ``# %%`` percent-cell Python
+scripts (``docs/examples/``, ``docs/walkthroughs/`` — executed directly by
+the test suite, diff-friendly in review) and EMITS the ``.ipynb`` corpus from
+them, the same emitted-artifact pattern as :func:`..codegen.emit_wrappers`:
+the committed notebooks are generated files, covered by a drift test.
+
+Cell grammar (the jupytext "percent" convention):
+
+* ``# %% [markdown]`` starts a markdown cell; following comment lines are
+  de-commented into markdown source.
+* ``# %%`` (with an optional trailing title, kept as a leading comment)
+  starts a code cell.
+* Anything before the first marker: a module docstring becomes the leading
+  markdown cell; other preamble code joins the first code cell.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["percent_to_notebook", "emit_notebooks", "notebook_code"]
+
+_NB_METADATA = {
+    "kernelspec": {"display_name": "Python 3", "language": "python",
+                   "name": "python3"},
+    "language_info": {"name": "python", "version": "3"},
+}
+
+
+def _markdown_cell(lines: list[str]) -> dict:
+    src = []
+    for ln in lines:
+        s = ln.rstrip("\n")
+        if s.startswith("# "):
+            s = s[2:]
+        elif s == "#":
+            s = ""
+        src.append(s)
+    while src and not src[0].strip():
+        src.pop(0)
+    while src and not src[-1].strip():
+        src.pop()
+    return {"cell_type": "markdown", "metadata": {},
+            "source": [s + "\n" for s in src[:-1]] + src[-1:]} if src else None
+
+
+def _code_cell(lines: list[str]) -> dict:
+    src = [ln.rstrip("\n") for ln in lines]
+    while src and not src[0].strip():
+        src.pop(0)
+    while src and not src[-1].strip():
+        src.pop()
+    if not src:
+        return None
+    return {"cell_type": "code", "execution_count": None, "metadata": {},
+            "outputs": [], "source": [s + "\n" for s in src[:-1]] + src[-1:]}
+
+
+def _split_module_docstring(text: str):
+    """Return (docstring, rest) if ``text`` opens with a module docstring
+    BEFORE any ``# %%`` marker, else (None, text)."""
+    import ast
+
+    first_marker = None
+    for i, ln in enumerate(text.splitlines()):
+        if ln.strip().startswith("# %%"):
+            first_marker = i + 1  # 1-based, like ast linenos
+            break
+    try:
+        mod = ast.parse(text)
+    except SyntaxError:
+        return None, text
+    if (mod.body and isinstance(mod.body[0], ast.Expr)
+            and isinstance(mod.body[0].value, ast.Constant)
+            and isinstance(mod.body[0].value.value, str)
+            and (first_marker is None or mod.body[0].end_lineno < first_marker)):
+        lines = text.splitlines(keepends=True)
+        return (mod.body[0].value.value.strip(),
+                "".join(lines[mod.body[0].end_lineno:]))
+    return None, text
+
+
+def percent_to_notebook(text: str) -> dict:
+    """Convert ``# %%`` percent-cell script text to a nbformat-4 notebook."""
+    doc, text = _split_module_docstring(text)
+    lines = text.splitlines()
+    cells = []
+    if doc:
+        cells.append({"cell_type": "markdown", "metadata": {},
+                      "source": [s + "\n" for s in doc.splitlines()[:-1]]
+                      + doc.splitlines()[-1:]})
+    cur: list[str] = []
+    kind = "code"
+
+    def flush():
+        cell = (_markdown_cell(cur) if kind == "markdown" else _code_cell(cur))
+        if cell:
+            cells.append(cell)
+        cur.clear()
+
+    for ln in lines:
+        stripped = ln.strip()
+        if stripped.startswith("# %%"):
+            flush()
+            rest = stripped[4:].strip()
+            if rest.startswith("[markdown]"):
+                kind = "markdown"
+            else:
+                kind = "code"
+                if rest:  # keep the cell title as a leading comment
+                    cur.append(f"# {rest}")
+            continue
+        cur.append(ln)
+    flush()
+    return {"nbformat": 4, "nbformat_minor": 5, "metadata": dict(_NB_METADATA),
+            "cells": cells}
+
+
+def notebook_code(nb: dict) -> str:
+    """All code-cell source joined — the nbtest executor's input."""
+    return "\n\n".join("".join(c["source"]) for c in nb["cells"]
+                       if c["cell_type"] == "code")
+
+
+def emit_notebooks(src_dirs, out_dir: str) -> list[str]:
+    """Emit one ``.ipynb`` per percent-cell ``.py`` under ``src_dirs``.
+
+    Returns the written paths. Deterministic output (sorted inputs, stable
+    JSON) so a drift test can regenerate and diff.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    seen: dict[str, str] = {}
+    for src_dir in src_dirs:
+        for name in sorted(os.listdir(src_dir)):
+            if not name.endswith(".py") or name.startswith("_"):
+                continue
+            if name in seen:
+                raise ValueError(
+                    f"notebook basename collision: {name} exists in both "
+                    f"{seen[name]} and {src_dir} — one would silently "
+                    f"overwrite the other in {out_dir}")
+            seen[name] = src_dir
+            with open(os.path.join(src_dir, name)) as f:
+                nb = percent_to_notebook(f.read())
+            out = os.path.join(out_dir, name[:-3] + ".ipynb")
+            with open(out, "w") as f:
+                json.dump(nb, f, indent=1, sort_keys=True)
+                f.write("\n")
+            written.append(out)
+    return written
+
+
+def main() -> None:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    docs = os.path.join(repo, "docs")
+    out = emit_notebooks([os.path.join(docs, "examples"),
+                          os.path.join(docs, "walkthroughs")],
+                         os.path.join(docs, "notebooks"))
+    print(f"wrote {len(out)} notebooks to docs/notebooks/")
+
+
+if __name__ == "__main__":
+    main()

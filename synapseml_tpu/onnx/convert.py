@@ -1707,11 +1707,14 @@ def _exec_loop(node, ins, env: dict):
                                tuple(states)))
         return tuple(final)
 
-    if not isinstance(max_trip, int):
+    if not isinstance(max_trip, int) or max_trip > 2 ** 24:
+        # the huge-M form is torch's while-loop export (M = INT64_MAX):
+        # materializing M-length scan outputs is not meaningful — reject
+        # clearly instead of attempting jnp.arange(2^63)
         raise NotImplementedError(
             "ONNX Loop with scan outputs under jit requires a static "
-            "(concrete) trip count M — a traced early exit would produce a "
-            "dynamically-shaped output")
+            "(concrete, reasonably-sized) trip count M — a traced or "
+            "unbounded early exit would produce a dynamically-shaped output")
     if _is_traced(keep):
         raise NotImplementedError(
             "ONNX Loop with scan outputs under jit requires a concrete "
