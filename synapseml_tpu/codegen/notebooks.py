@@ -137,6 +137,12 @@ def emit_notebooks(src_dirs, out_dir: str) -> list[str]:
     os.makedirs(out_dir, exist_ok=True)
     written = []
     seen: dict[str, str] = {}
+    expected = {name[:-3] + ".ipynb"
+                for src_dir in src_dirs for name in os.listdir(src_dir)
+                if name.endswith(".py") and not name.startswith("_")}
+    for stale in sorted(set(os.listdir(out_dir)) - expected):
+        if stale.endswith(".ipynb"):  # renamed/removed source: drop its notebook
+            os.remove(os.path.join(out_dir, stale))
     for src_dir in src_dirs:
         for name in sorted(os.listdir(src_dir)):
             if not name.endswith(".py") or name.startswith("_"):

@@ -1,0 +1,192 @@
+"""``com.microsoft`` contrib ops — the ORT transformer-fusion opset.
+
+The reference's ONNXModel runs on ONNX Runtime, whose graph optimizer
+rewrites transformer models into contrib ops (``ONNXRuntime.scala:25``;
+ORT's ``FusionAttention``/``FusionSkipLayerNormalization`` passes emit
+``com.microsoft`` nodes). Models saved AFTER that optimization — the form
+many deployed BERT/GPT ONNX artifacts ship in — therefore need these ops for
+migration, not just the stock opset.
+
+Registered into :data:`~synapseml_tpu.onnx.convert.OP_REGISTRY` by name
+(contrib names don't collide with the standard opset; the converter keys by
+``op_type``). Each lowering is plain jnp — XLA re-fuses what ORT fused by
+hand, and the attention math hits the MXU as three dots per head group.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .convert import OP_REGISTRY, op
+
+_SQRT_2_OVER_PI = 0.7978845608028654
+
+
+def _tanh_gelu(x):
+    return 0.5 * x * (1.0 + jnp.tanh(_SQRT_2_OVER_PI
+                                     * (x + 0.044715 * x * x * x)))
+
+
+@op("FastGelu")
+def _fast_gelu(ins, attrs):
+    x = ins[0]
+    if len(ins) > 1 and ins[1] is not None:
+        x = x + ins[1]
+    return _tanh_gelu(x)
+
+
+@op("BiasGelu")
+def _bias_gelu(ins, attrs):
+    return jax.nn.gelu(ins[0] + ins[1], approximate=False)
+
+
+@op("QuickGelu")
+def _quick_gelu(ins, attrs):
+    alpha = attrs.get("alpha", 1.702)
+    return ins[0] * jax.nn.sigmoid(alpha * ins[0])
+
+
+def _layer_norm(h, gamma, beta, eps):
+    hf = h.astype(jnp.float32)
+    mean = jnp.mean(hf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(hf - mean), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    out = (hf - mean) * inv * gamma.astype(jnp.float32)
+    if beta is not None:
+        out = out + beta.astype(jnp.float32)
+    return out.astype(h.dtype), mean, inv
+
+
+@op("SkipLayerNormalization")
+def _skip_layer_norm(ins, attrs):
+    """input + skip (+ bias) -> layernorm. Outputs (out, mean, inv_std_var,
+    input_skip_bias_sum) — callers binding fewer outputs just take a prefix."""
+    x, skip, gamma = ins[0], ins[1], ins[2]
+    beta = ins[3] if len(ins) > 3 else None
+    bias = ins[4] if len(ins) > 4 else None
+    h = x + skip
+    if bias is not None:
+        h = h + bias
+    out, mean, inv = _layer_norm(h, gamma, beta, attrs.get("epsilon", 1e-12))
+    return out, mean, inv, h
+
+
+@op("EmbedLayerNormalization")
+def _embed_layer_norm(ins, attrs):
+    """(input_ids, segment_ids, word_emb, pos_emb, seg_emb, gamma, beta,
+    [mask], [position_ids]) -> (output, mask_index, [embedding_sum])."""
+    input_ids = jnp.asarray(ins[0]).astype(jnp.int32)
+    seg_ids = ins[1]
+    word_emb, pos_emb = ins[2], ins[3]
+    seg_emb = ins[4] if len(ins) > 4 else None
+    gamma, beta = ins[5], ins[6] if len(ins) > 6 else None
+    mask = ins[7] if len(ins) > 7 else None
+    pos_ids = ins[8] if len(ins) > 8 else None
+    B, S = input_ids.shape
+    emb = jnp.take(jnp.asarray(word_emb), input_ids, axis=0)
+    if pos_ids is None:
+        pos = jnp.asarray(pos_emb)[:S][None, :, :]
+    else:
+        pos = jnp.take(jnp.asarray(pos_emb),
+                       jnp.asarray(pos_ids).astype(jnp.int32), axis=0)
+    emb = emb + pos
+    if seg_emb is not None and seg_ids is not None:
+        emb = emb + jnp.take(jnp.asarray(seg_emb),
+                             jnp.asarray(seg_ids).astype(jnp.int32), axis=0)
+    out, _, _ = _layer_norm(emb, jnp.asarray(gamma),
+                            None if beta is None else jnp.asarray(beta),
+                            attrs.get("epsilon", 1e-12))
+    if mask is not None:
+        mask_index = jnp.sum(jnp.asarray(mask).astype(jnp.int32), axis=1)
+    else:
+        mask_index = jnp.full((B,), S, jnp.int32)
+    return out, mask_index, emb
+
+
+@op("FusedMatMul")
+def _fused_matmul(ins, attrs):
+    a, b = ins[0], ins[1]
+    if attrs.get("transBatchA") or attrs.get("transBatchB"):
+        raise NotImplementedError(
+            "FusedMatMul transBatchA/transBatchB (batch-dim transposition) "
+            "is not lowered")
+    if attrs.get("transA"):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("transB"):
+        b = jnp.swapaxes(b, -1, -2)
+    out = jnp.matmul(a, b)
+    alpha = attrs.get("alpha", 1.0)
+    return out if alpha == 1.0 else out * jnp.asarray(alpha, out.dtype)
+
+
+@op("Attention")
+def _attention(ins, attrs):
+    """ORT fused self-attention: (input [B,S,Hin], weights [Hin,3*H],
+    bias [3*H], [mask], [past], [attention_bias]) -> [B,S,H].
+
+    Supported mask forms: None, raw 2D [B, S] key mask (1 = attend), or 1D
+    [B] right-side key lengths. ``unidirectional=1`` adds the causal mask
+    (the GPT fusion form). ``past``/``present`` KV-cache states are not
+    lowered — batch scoring re-runs the full sequence (the reference's
+    ONNXModel usage); a clear error guards the gap.
+    """
+    x, w, b = ins[0], ins[1], ins[2]
+    mask = ins[3] if len(ins) > 3 else None
+    past = ins[4] if len(ins) > 4 else None
+    attn_bias = ins[5] if len(ins) > 5 else None
+    if past is not None:
+        raise NotImplementedError(
+            "com.microsoft Attention with a `past` KV state is a decode-loop "
+            "form; batch scoring re-runs the full sequence without it")
+    if attrs.get("do_rotary"):
+        raise NotImplementedError(
+            "com.microsoft Attention with do_rotary=1 (the GPT-NeoX fusion "
+            "form) is not lowered")
+    n_heads = int(attrs["num_heads"])
+    if attrs.get("qkv_hidden_sizes"):
+        sizes = [int(s) for s in attrs["qkv_hidden_sizes"]]
+        if len(set(sizes)) != 1:
+            raise NotImplementedError(
+                f"Attention with unequal qkv_hidden_sizes {sizes}")
+    B, S, _ = x.shape
+    qkv = jnp.matmul(x, jnp.asarray(w)) + jnp.asarray(b)     # [B, S, 3H]
+    H3 = qkv.shape[-1]
+    H = H3 // 3
+    d = H // n_heads
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):  # [B, S, H] -> [B, n, S, d]
+        return jnp.transpose(t.reshape(B, S, n_heads, d), (0, 2, 1, 3))
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scale = attrs.get("scale") or 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    neg = jnp.asarray(-1e30, jnp.float32)
+    if mask is not None:
+        m = jnp.asarray(mask)
+        if m.ndim == 1:                      # [B] key lengths
+            key_ok = jnp.arange(S)[None, :] < m[:, None].astype(jnp.int32)
+        elif m.ndim == 2:                    # [B, S] raw key mask
+            key_ok = m.astype(bool)
+        else:
+            raise NotImplementedError(
+                f"Attention mask_index of rank {m.ndim} (supported: 1D "
+                f"lengths, 2D raw key mask)")
+        scores = jnp.where(key_ok[:, None, None, :], scores, neg)
+    if attn_bias is not None:
+        scores = scores + jnp.asarray(attn_bias).astype(jnp.float32)
+    if attrs.get("unidirectional"):
+        causal = jnp.arange(S)[None, :] <= jnp.arange(S)[:, None]
+        scores = jnp.where(causal[None, None], scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bnqk,bnkd->bnqd", probs, v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    return jnp.transpose(out, (0, 2, 1, 3)).reshape(B, S, H)
+
+
+# Gelu exists in the standard opset registry; com.microsoft Gelu is the same
+# exact-erf form, so the shared entry in convert.py covers both domains.
+assert "Gelu" in OP_REGISTRY

@@ -1839,3 +1839,8 @@ class ConvertedModel:
 
 def convert_graph(model_bytes: bytes) -> ConvertedModel:
     return ConvertedModel(parse_model(model_bytes))
+
+
+# com.microsoft contrib opset (ORT transformer-fusion ops) registers itself
+# into OP_REGISTRY; imported last so the registry base exists
+from . import contrib  # noqa: E402,F401  (registration side effect)

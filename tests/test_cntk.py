@@ -1,0 +1,32 @@
+"""CNTKModel — the legacy scoring surface (reference
+`deep-learning/.../cntk/CNTKModel.py`), evaluated through the ONNX
+interchange path (CNTK's supported export format)."""
+
+import numpy as np
+import pytest
+
+import synapseml_tpu as st
+from synapseml_tpu.models import CNTKModel
+from tests.test_onnx import make_mlp_bytes, mlp_reference
+
+
+def test_cntk_model_scores_onnx_interchange(tmp_path):
+    data, (W1, b1, W2, b2) = make_mlp_bytes()
+    path = tmp_path / "exported.onnx"
+    path.write_bytes(data)
+    m = (CNTKModel(location=str(path))
+         .set_feed_dict("x", "features")
+         .set_fetch_dict("probs_col", "probs"))
+    X = np.random.default_rng(0).normal(size=(9, 4)).astype(np.float32)
+    df = st.DataFrame.from_dict({"features": X})
+    out = m.transform(df)
+    _, _, probs = mlp_reference(X, W1, b1, W2, b2)
+    np.testing.assert_allclose(np.stack(out.collect_column("probs_col")),
+                               probs, rtol=1e-4, atol=1e-5)
+
+
+def test_cntk_native_checkpoint_rejected(tmp_path):
+    path = tmp_path / "legacy.dnn"
+    path.write_bytes(b"CNTK\x02legacy-checkpoint-bytes")
+    with pytest.raises(ValueError, match="ONNX"):
+        CNTKModel(location=str(path))

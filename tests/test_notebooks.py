@@ -90,6 +90,17 @@ def test_module_docstring_becomes_leading_markdown():
     assert "import os" in "".join(nb["cells"][1]["source"])
 
 
+def test_emit_removes_stale_notebooks(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "keep.py").write_text("# %% [markdown]\n# hi\n# %%\nx = 1\n")
+    out = tmp_path / "out"
+    out.mkdir()
+    (out / "renamed_away.ipynb").write_text("{}")
+    emit_notebooks([str(src)], str(out))
+    assert sorted(os.listdir(out)) == ["keep.ipynb"]
+
+
 def test_emit_rejects_basename_collision(tmp_path):
     a, b = tmp_path / "a", tmp_path / "b"
     a.mkdir(), b.mkdir()

@@ -1,0 +1,235 @@
+"""com.microsoft contrib ops (the ORT transformer-fusion opset) vs numpy
+oracles, plus an end-to-end fused-BERT-block graph of the shape ORT's
+optimizer emits (EmbedLayerNormalization -> Attention ->
+SkipLayerNormalization -> FusedMatMul/BiasGelu) run through ConvertedModel."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.onnx import (
+    AttributeProto,
+    GraphProto,
+    ModelProto,
+    NodeProto,
+    ValueInfoProto,
+    numpy_to_tensor,
+)
+from synapseml_tpu.onnx import proto as P
+from synapseml_tpu.onnx.convert import OP_REGISTRY, ConvertedModel
+
+rs = np.random.default_rng(0)
+
+
+def run_op(opname, ins, **attrs):
+    return OP_REGISTRY[opname](
+        [None if x is None else np.asarray(x) for x in ins], attrs)
+
+
+def node(op, inputs, outputs, domain="com.microsoft", **attrs):
+    return NodeProto(input=list(inputs), output=list(outputs), op_type=op,
+                     domain=domain,
+                     attribute=[AttributeProto.make(k, v)
+                                for k, v in attrs.items()])
+
+
+def np_gelu(x):
+    from scipy.special import erf
+    return 0.5 * x * (1 + erf(x / np.sqrt(2)))
+
+
+def np_layernorm(h, gamma, beta, eps=1e-12):
+    mean = h.mean(-1, keepdims=True)
+    var = ((h - mean) ** 2).mean(-1, keepdims=True)
+    return (h - mean) / np.sqrt(var + eps) * gamma + beta
+
+
+def test_bias_gelu_and_fast_gelu():
+    x = rs.normal(size=(3, 8)).astype(np.float32)
+    b = rs.normal(size=(8,)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(run_op("BiasGelu", [x, b])),
+                               np_gelu(x + b), rtol=1e-5, atol=1e-6)
+    # FastGelu is the tanh approximation (+ optional bias)
+    h = x + b
+    expect = 0.5 * h * (1 + np.tanh(0.7978845608 * (h + 0.044715 * h ** 3)))
+    np.testing.assert_allclose(np.asarray(run_op("FastGelu", [x, b])), expect,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(run_op("QuickGelu", [x], alpha=1.702)),
+        x / (1 + np.exp(-1.702 * x)), rtol=1e-5, atol=1e-6)
+
+
+def test_skip_layer_normalization():
+    x = rs.normal(size=(2, 4, 8)).astype(np.float32)
+    skip = rs.normal(size=(2, 4, 8)).astype(np.float32)
+    gamma = rs.normal(size=(8,)).astype(np.float32)
+    beta = rs.normal(size=(8,)).astype(np.float32)
+    bias = rs.normal(size=(8,)).astype(np.float32)
+    out = run_op("SkipLayerNormalization", [x, skip, gamma, beta, bias],
+                 epsilon=1e-12)
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               np_layernorm(x + skip + bias, gamma, beta),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out[3]), x + skip + bias, rtol=1e-6)
+
+
+def test_embed_layer_normalization():
+    V, S, H = 20, 6, 8
+    ids = rs.integers(0, V, (2, S)).astype(np.int64)
+    seg = rs.integers(0, 2, (2, S)).astype(np.int64)
+    word = rs.normal(size=(V, H)).astype(np.float32)
+    pos = rs.normal(size=(S + 2, H)).astype(np.float32)
+    segemb = rs.normal(size=(2, H)).astype(np.float32)
+    gamma = np.ones(H, np.float32)
+    beta = np.zeros(H, np.float32)
+    mask = np.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 0, 0, 0, 0]], np.int64)
+    out, mask_index, emb_sum = run_op(
+        "EmbedLayerNormalization",
+        [ids, seg, word, pos, segemb, gamma, beta, mask])
+    expect_sum = word[ids] + pos[:S][None] + segemb[seg]
+    np.testing.assert_allclose(np.asarray(emb_sum), expect_sum, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out),
+                               np_layernorm(expect_sum, gamma, beta),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(mask_index), [4, 2])
+
+
+def test_fused_matmul():
+    a = rs.normal(size=(3, 4)).astype(np.float32)
+    b = rs.normal(size=(5, 4)).astype(np.float32)
+    out = run_op("FusedMatMul", [a, b], transB=1, alpha=0.5)
+    np.testing.assert_allclose(np.asarray(out), 0.5 * (a @ b.T), rtol=1e-5)
+
+
+def np_attention(x, w, b, n_heads, key_mask=None, unidirectional=False):
+    B, S, _ = x.shape
+    qkv = x @ w + b
+    H = qkv.shape[-1] // 3
+    d = H // n_heads
+    q, k, v = np.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, S, n_heads, d).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = np.einsum("bnqd,bnkd->bnqk", q, k) / np.sqrt(d)
+    if key_mask is not None:
+        scores = np.where(key_mask[:, None, None, :].astype(bool), scores, -1e30)
+    if unidirectional:
+        causal = np.tril(np.ones((S, S), bool))
+        scores = np.where(causal[None, None], scores, -1e30)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    out = np.einsum("bnqk,bnkd->bnqd", p, v)
+    return out.transpose(0, 2, 1, 3).reshape(B, S, H)
+
+
+@pytest.mark.parametrize("mask_kind", [None, "raw2d", "lengths"])
+@pytest.mark.parametrize("unidirectional", [0, 1])
+def test_attention(mask_kind, unidirectional):
+    B, S, Hin, n_heads = 2, 6, 8, 2
+    x = rs.normal(size=(B, S, Hin)).astype(np.float32)
+    w = (rs.normal(size=(Hin, 3 * Hin)) * 0.3).astype(np.float32)
+    b = rs.normal(size=(3 * Hin,)).astype(np.float32)
+    raw = np.asarray([[1, 1, 1, 1, 1, 0], [1, 1, 1, 0, 0, 0]], np.int64)
+    if mask_kind is None:
+        mask, key_mask = None, None
+    elif mask_kind == "raw2d":
+        mask, key_mask = raw, raw
+    else:
+        mask = raw.sum(1)                       # right-padded lengths
+        key_mask = np.arange(S)[None] < mask[:, None]
+    got = np.asarray(run_op("Attention", [x, w, b, mask],
+                            num_heads=n_heads, unidirectional=unidirectional))
+    expect = np_attention(x, w, b, n_heads, key_mask, bool(unidirectional))
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_custom_scale():
+    B, S, Hin = 1, 4, 8
+    x = rs.normal(size=(B, S, Hin)).astype(np.float32)
+    w = (rs.normal(size=(Hin, 3 * Hin)) * 0.3).astype(np.float32)
+    b = np.zeros(3 * Hin, np.float32)
+    got = np.asarray(run_op("Attention", [x, w, b], num_heads=2, scale=0.125))
+    # oracle with the custom scale folded in (heads d=4 -> default would be 0.5)
+    qkv = x @ w
+    q, k, v = np.split(qkv.reshape(B, S, 3, 2, 4).transpose(2, 0, 3, 1, 4), 3)
+    q, k, v = q[0], k[0], v[0]
+    s = np.einsum("bnqd,bnkd->bnqk", q, k) * 0.125
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = np.einsum("bnqk,bnkd->bnqd", p, v).transpose(0, 2, 1, 3).reshape(B, S, Hin)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_fusion_forms_rejected():
+    x = rs.normal(size=(1, 2, 4)).astype(np.float32)
+    w = rs.normal(size=(4, 12)).astype(np.float32)
+    b = np.zeros(12, np.float32)
+    with pytest.raises(NotImplementedError, match="rotary"):
+        run_op("Attention", [x, w, b], num_heads=1, do_rotary=1)
+    with pytest.raises(NotImplementedError, match="transBatch"):
+        run_op("FusedMatMul", [x, x], transBatchA=1)
+
+
+def test_attention_past_rejected():
+    x = rs.normal(size=(1, 2, 4)).astype(np.float32)
+    w = rs.normal(size=(4, 12)).astype(np.float32)
+    b = np.zeros(12, np.float32)
+    past = np.zeros((2, 1, 1, 2, 2), np.float32)
+    with pytest.raises(NotImplementedError, match="past"):
+        run_op("Attention", [x, w, b, None, past], num_heads=1)
+
+
+def test_fused_bert_block_graph():
+    """The ORT-optimizer output shape: EmbedLayerNormalization -> Attention ->
+    SkipLayerNormalization -> FusedMatMul+BiasGelu -> FusedMatMul ->
+    SkipLayerNormalization, as one ConvertedModel — vs a numpy oracle."""
+    V, S, H, n_heads, F = 30, 6, 8, 2, 16
+    ids = rs.integers(0, V, (2, S)).astype(np.int64)
+    mask = np.asarray([[1] * 6, [1, 1, 1, 1, 0, 0]], np.int64)
+    word = (rs.normal(size=(V, H)) * 0.5).astype(np.float32)
+    pos = (rs.normal(size=(S, H)) * 0.5).astype(np.float32)
+    g1, b1 = np.ones(H, np.float32), np.zeros(H, np.float32)
+    wq = (rs.normal(size=(H, 3 * H)) * 0.3).astype(np.float32)
+    bq = np.zeros(3 * H, np.float32)
+    g2, b2 = np.ones(H, np.float32), np.zeros(H, np.float32)
+    w_up = (rs.normal(size=(H, F)) * 0.3).astype(np.float32)
+    b_up = rs.normal(size=(F,)).astype(np.float32)
+    w_dn = (rs.normal(size=(F, H)) * 0.3).astype(np.float32)
+    g3, b3 = np.ones(H, np.float32), np.zeros(H, np.float32)
+
+    g = GraphProto(
+        name="fused_bert_block",
+        node=[
+            node("EmbedLayerNormalization",
+                 ["ids", "", "word", "pos", "", "g1", "b1", "mask"],
+                 ["emb", "mask_idx"], epsilon=1e-12),
+            node("Attention", ["emb", "wq", "bq", "mask"], ["attn"],
+                 num_heads=n_heads),
+            node("SkipLayerNormalization", ["attn", "emb", "g2", "b2"],
+                 ["h1"], epsilon=1e-12),
+            node("FusedMatMul", ["h1", "w_up"], ["up"]),
+            node("BiasGelu", ["up", "b_up"], ["act"]),
+            node("FusedMatMul", ["act", "w_dn"], ["down"]),
+            node("SkipLayerNormalization", ["down", "h1", "g3", "b3"],
+                 ["out"], epsilon=1e-12),
+        ],
+        initializer=[numpy_to_tensor(a, n) for a, n in [
+            (word, "word"), (pos, "pos"), (g1, "g1"), (b1, "b1"),
+            (wq, "wq"), (bq, "bq"), (g2, "g2"), (b2, "b2"),
+            (w_up, "w_up"), (b_up, "b_up"), (w_dn, "w_dn"),
+            (g3, "g3"), (b3, "b3")]],
+        input=[ValueInfoProto(name="ids", elem_type=P.INT64, dims=["B", S]),
+               ValueInfoProto(name="mask", elem_type=P.INT64, dims=["B", S])],
+        output=[ValueInfoProto(name="out", elem_type=P.FLOAT,
+                               dims=["B", S, H])],
+    )
+    m = ConvertedModel(ModelProto(graph=g))
+    got = np.asarray(m(ids=ids, mask=mask)["out"])
+
+    emb = np_layernorm(word[ids] + pos[None], g1, b1)
+    attn = np_attention(emb, wq, bq, n_heads, mask)
+    h1 = np_layernorm(attn + emb, g2, b2)
+    act = np_gelu(h1 @ w_up + b_up)
+    expect = np_layernorm(act @ w_dn + h1, g3, b3)
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
