@@ -1,9 +1,12 @@
-"""GBDT histogram backend comparison: segment_sum (scatter) vs one-hot
-matmul (MXU) on the Higgs-1M shape. The measurement this exists for is the
-TPU one — scatter-adds serialize on TPU while the one-hot form is matmul
-FLOPs — but it runs anywhere (CPU mode uses a smaller shape). Prints one
-JSON line with per-backend train seconds; the winner should become
-``histogram_impl``'s default on that platform."""
+"""GBDT histogram backend comparison on the Higgs-1M shape: segment_sum
+(scatter — TPUs serialize it) vs XLA one-hot matmul (MXU FLOPs but the
+one-hot operand is materialized in HBM) vs the Pallas fused kernel (one-hot
+tiles generated in VMEM, ``gbdt/pallas_hist.py``). The measurement this
+exists for is the TPU one, but it runs anywhere (CPU mode uses a smaller
+shape and skips the interpret-mode Pallas kernel — interpret timings say
+nothing about the chip). Prints one JSON line with per-backend train
+seconds; the winner should become ``histogram_impl``'s default on that
+platform."""
 import json
 import sys
 import time
@@ -30,22 +33,24 @@ def run(jax, platform, n_chips):
     y = ((X @ w + rng.normal(size=N)) > 0).astype(np.float32)
 
     times = {}
-    for impl in ("segment", "onehot"):
+    impls = ("segment", "onehot", "pallas") if on_tpu else ("segment", "onehot")
+    for impl in impls:
         t0 = time.perf_counter()
         train_booster(X, y, objective="binary", num_iterations=n_iter,
                       learning_rate=0.1, num_leaves=31, max_bin=max_bin,
                       histogram_impl=impl)
         times[impl] = round(time.perf_counter() - t0, 2)
 
-    return {
+    result = {
         "metric": "GBDT histogram backend train time"
                   + ("" if on_tpu else " (CPU smoke)"),
         "value": min(times.values()), "unit": "s", "lower_is_better": True,
         "platform": platform,
         "rows": N, "iters": n_iter,
-        "segment_s": times["segment"], "onehot_s": times["onehot"],
-        "speedup_onehot": round(times["segment"] / times["onehot"], 2),
         "winner": min(times, key=times.get)}
+    for impl, t in times.items():
+        result[f"{impl}_s"] = t
+    return result
 
 
 def main():

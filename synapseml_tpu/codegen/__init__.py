@@ -143,6 +143,12 @@ _NAMESPACE_MAP = {
     "io": "io",
 }
 
+# module-granular overrides where the reference splits one of our packages
+# across namespaces (synapse.ml.cntk lives beside synapse.ml.dl)
+_MODULE_NAMESPACE_MAP = {
+    "models.cntk": "cntk",
+}
+
 _WRAPPER_HEADER = '''"""Generated pyspark-style wrappers — do not edit.
 
 Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
@@ -169,8 +175,11 @@ def emit_wrappers(out_dir: str | None = None) -> list[str]:
     os.makedirs(out_dir, exist_ok=True)
     by_ns: dict[str, list] = {}
     for full_name, cls in sorted(discover_stages().items()):
-        pkg = cls.__module__.split(".")[1]
-        by_ns.setdefault(_NAMESPACE_MAP.get(pkg, pkg), []).append((full_name, cls))
+        parts = cls.__module__.split(".")
+        pkg = parts[1]
+        ns = (_MODULE_NAMESPACE_MAP.get(".".join(parts[1:3]))
+              or _NAMESPACE_MAP.get(pkg, pkg))
+        by_ns.setdefault(ns, []).append((full_name, cls))
 
     # non-default out_dir must also carry the runtime base the generated
     # modules import (the in-tree package has it committed)

@@ -1,0 +1,51 @@
+"""Generated pyspark-style wrappers — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
+reference's codegen (``Wrappable.scala:56-389``) emits the same surface from
+Scala stages; here it is emitted from the native param registry.
+"""
+
+from ._base import WrapperBase
+
+
+class CNTKModel(WrapperBase):
+    """(ref ``cntk/CNTKModel.py``; scoring semantics of ``_CNTKModel``) (wraps ``synapseml_tpu.models.cntk.CNTKModel``)."""
+
+    _target = 'synapseml_tpu.models.cntk.CNTKModel'
+
+    def setArgmaxDict(self, value):
+        return self._set('argmax_dict', value)
+
+    def getArgmaxDict(self):
+        return self._get('argmax_dict')
+
+    def setFeedDict(self, value):
+        return self._set('feed_dict', value)
+
+    def getFeedDict(self):
+        return self._get('feed_dict')
+
+    def setFetchDict(self, value):
+        return self._set('fetch_dict', value)
+
+    def getFetchDict(self):
+        return self._get('fetch_dict')
+
+    def setMiniBatchSize(self, value):
+        return self._set('mini_batch_size', value)
+
+    def getMiniBatchSize(self):
+        return self._get('mini_batch_size')
+
+    def setModelPayload(self, value):
+        return self._set('model_payload', value)
+
+    def getModelPayload(self):
+        return self._get('model_payload')
+
+    def setSoftmaxDict(self, value):
+        return self._set('softmax_dict', value)
+
+    def getSoftmaxDict(self):
+        return self._get('softmax_dict')
+

@@ -92,11 +92,13 @@ class _LightGBMParams:
                                  converter=TypeConverters.to_int)
     seed = Param("seed", "random seed", default=0, converter=TypeConverters.to_int)
     histogram_impl = Param("histogram_impl", "histogram backend: segment "
-                           "(scatter-add) | onehot (MXU matmul); equivalent "
-                           "results, pick by measurement "
+                           "(scatter-add) | onehot (XLA matmul) | pallas "
+                           "(fused VMEM one-hot kernel); equivalent results, "
+                           "pick by measurement "
                            "(benchmarks/gbdt_hist_backends.py)",
                            default="segment",
-                           validator=lambda v: v in ("segment", "onehot"))
+                           validator=lambda v: v in ("segment", "onehot",
+                                                     "pallas"))
     verbosity = Param("verbosity", "print eval metrics when > 0", default=-1,
                       converter=TypeConverters.to_int)
     mesh_config = ComplexParam("mesh_config", "MeshConfig to shard rows over the "

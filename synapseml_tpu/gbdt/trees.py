@@ -51,9 +51,11 @@ class GrowthConfig(NamedTuple):
     # (reference params/LightGBMParams.scala monotoneConstraints; the 'basic'
     # method: split-direction gating + child-value midpoint bounds)
     monotone_constraints: tuple = ()
-    # histogram backend: 'segment' (segment_sum -> scatter-add) or 'onehot'
-    # (row-chunked one-hot matmul — MXU-shaped; scatter serializes on TPU).
-    # Equivalent results; pick by measurement (benchmarks/gbdt_hist_backends.py)
+    # histogram backend: 'segment' (segment_sum -> scatter-add), 'onehot'
+    # (row-chunked one-hot matmul — MXU-shaped but XLA materializes the
+    # one-hot in HBM), or 'pallas' (fused kernel generating one-hot tiles in
+    # VMEM — .pallas_hist). Equivalent results; pick by measurement
+    # (benchmarks/gbdt_hist_backends.py)
     hist_impl: str = "segment"
     # categorical features (sorted feature indices; their bins ARE the raw
     # category codes). Split finding is LightGBM's many-vs-many: bins sorted
@@ -153,9 +155,16 @@ def _level_histogram(bins: jax.Array, g: jax.Array, h: jax.Array, presence: jax.
             seg = rel * num_bins + f_bins.astype(jnp.int32)
             hist = jax.ops.segment_sum(data, seg, num_segments=WB)
             return carry, hist.reshape(width, num_bins, 3)
+    elif hist_impl == "pallas":
+        from .pallas_hist import pallas_segment_histogram
+
+        def one_feature(carry, f_bins):
+            seg = rel * num_bins + f_bins.astype(jnp.int32)
+            hist = pallas_segment_histogram(seg, data, WB)
+            return carry, hist.reshape(width, num_bins, 3)
     else:
-        raise ValueError(f"hist_impl must be 'segment' or 'onehot', "
-                         f"got {hist_impl!r}")
+        raise ValueError(f"hist_impl must be 'segment', 'onehot' or "
+                         f"'pallas', got {hist_impl!r}")
 
     _, hists = jax.lax.scan(one_feature, 0, jnp.swapaxes(bins, 0, 1))  # (F, W, B, 3)
     return jnp.swapaxes(hists, 0, 1)  # (W, F, B, 3)
