@@ -38,6 +38,7 @@ QUEUE = [
     ("flagship", 480),   # recapture: the 2026-07-31 window number was contended
     ("gbdt-higgs", 900),
     ("gbdt-hist-backends", 900),
+    ("attn-backends", 900),   # einsum-vs-flash decision after the bf16 kernel fix
     ("vit", 900),
 ]
 MAX_ATTEMPTS = 4         # per config, counting only backend-up failures
