@@ -76,26 +76,42 @@ class BinMapper:
         return self
 
     def transform(self, features: np.ndarray) -> np.ndarray:
-        """Rows → bin codes, shape (N, F), dtype int32 (uint8 when it fits)."""
+        """Rows → bin codes, shape (N, F), dtype int32 (uint8 when it fits).
+
+        float32 inputs take the multithreaded native row-major path
+        (``native.bin_rows`` — the Dataset-marshaling hot loop; exact parity:
+        double(float32) is lossless, so comparisons match the numpy float64
+        path bit-for-bit). Other dtypes, and toolchain-less hosts, use the
+        numpy per-column fallback.
+        """
         if self.boundaries_ is None:
             raise RuntimeError("BinMapper not fitted")
-        x = np.asarray(features, dtype=np.float64)
-        n, f = x.shape
+        arr = np.asarray(features)
+        n, f = arr.shape
         if f != self.boundaries_.shape[0]:
             raise ValueError(f"feature count {f} != fitted {self.boundaries_.shape[0]}")
-        out = np.empty((n, f), dtype=np.int32)
-        cat = set(self.categorical)
-        for j in range(f):
-            if j in cat:
-                col = x[:, j]
-                code = np.floor(col)
-                valid = np.isfinite(col) & (code >= 0) & (code < self.max_bin)
-                out[:, j] = np.where(valid, code, self.nan_bin).astype(np.int32)
-            else:
-                out[:, j] = np.searchsorted(self.boundaries_[j], x[:, j], side="right")
-        nan_mask = np.isnan(x)
-        if nan_mask.any():
-            out[nan_mask] = self.nan_bin  # no-op for cat columns (already set)
+        out = None
+        if arr.dtype == np.float32:
+            from .. import native
+
+            out = native.bin_rows(arr, self.boundaries_, self.nan_bin,
+                                  self.max_bin, self.categorical)
+        if out is None:
+            x = np.asarray(arr, dtype=np.float64)  # no-op view for f64 input
+            out = np.empty((n, f), dtype=np.int32)
+            cat = set(self.categorical)
+            for j in range(f):
+                if j in cat:
+                    col = x[:, j]
+                    code = np.floor(col)
+                    valid = np.isfinite(col) & (code >= 0) & (code < self.max_bin)
+                    out[:, j] = np.where(valid, code, self.nan_bin).astype(np.int32)
+                else:
+                    out[:, j] = np.searchsorted(self.boundaries_[j], x[:, j],
+                                                side="right")
+            nan_mask = np.isnan(x)
+            if nan_mask.any():
+                out[nan_mask] = self.nan_bin  # no-op for cat cols (already set)
         if self.num_bins <= 256:
             return out.astype(np.uint8)
         return out

@@ -23,7 +23,7 @@ import threading
 import numpy as np
 
 __all__ = ["available", "murmur3_32_native", "murmur3_batch", "docs_token_hashes",
-           "library_path"]
+           "bin_rows", "library_path"]
 
 _LOCK = threading.Lock()
 _LIB = None
@@ -34,15 +34,22 @@ _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src",
 
 
 def library_path() -> str:
+    # keyed by source digest, not mtime: a cached build of an OLDER source
+    # (wheel installs preserve mtimes) must never load — a missing symbol
+    # would raise out of the ctypes binding instead of falling back
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
     cache = os.environ.get("SYNAPSEML_TPU_NATIVE_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "synapseml_tpu", "native")
     os.makedirs(cache, exist_ok=True)
-    return os.path.join(cache, "libnative_ops.so")
+    return os.path.join(cache, f"libnative_ops-{digest}.so")
 
 
 def _build() -> str | None:
-    out = library_path()
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(_SRC):
+    out = library_path()  # content-addressed: existing file IS this source
+    if os.path.exists(out):
         return out
     try:
         subprocess.run(
@@ -79,6 +86,12 @@ def _load():
             ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64)]
+        lib.nat_bin_rows.restype = None
+        lib.nat_bin_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
         _LIB = lib
         return _LIB
 
@@ -135,3 +148,34 @@ def docs_token_hashes(texts: list[str], seed: int = 0, num_bits: int = 18,
         counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     return [out[i * max_tokens_per_doc : i * max_tokens_per_doc + counts[i]].copy()
             for i in range(n)]
+
+
+def bin_rows(x: np.ndarray, boundaries: np.ndarray, nan_bin: int, max_bin: int,
+             categorical: tuple = (), n_threads: int | None = None):
+    """Row-major multithreaded binning (the GBDT Dataset-construction hot
+    loop; reference analog: the Swig marshaling behind
+    ``LGBM_DatasetPushRowsWithMetadata``). searchsorted-right semantics per
+    column; NaN -> ``nan_bin``; categorical columns bin by identity. Returns
+    (N, F) int32, or None when the library is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    xf = np.ascontiguousarray(x, dtype=np.float32)
+    n, f = xf.shape
+    bounds = np.ascontiguousarray(boundaries, dtype=np.float64)
+    if bounds.ndim != 2 or bounds.shape[0] != f:
+        raise ValueError(f"boundaries shape {bounds.shape} does not match "
+                         f"feature count {f}")
+    is_cat = np.zeros(f, np.uint8)
+    if categorical:
+        is_cat[np.asarray(categorical, np.int64)] = 1
+    out = np.empty((n, f), np.int32)
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    lib.nat_bin_rows(
+        xf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        n, f, bounds.shape[1], nan_bin, max_bin,
+        is_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n_threads)
+    return out

@@ -7,8 +7,11 @@
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 native_ops.cpp -o libnative_ops.so
 
+#include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -118,6 +121,64 @@ void nat_docs_token_hashes(const uint8_t* data, const int64_t* offsets,
         data + offsets[d], offsets[d + 1] - offsets[d], seed, mask, do_lower,
         out + d * max_tokens_per_doc, max_tokens_per_doc);
   }
+}
+
+// Row binning — the GBDT Dataset-construction hot loop (reference analog:
+// the Swig row marshaling behind LGBM_DatasetPushRowsWithMetadata,
+// StreamingPartitionTask.scala:220). x is [n, f] float32 row-major;
+// bounds is [f, b] float64 ascending upper boundaries (padded with +inf);
+// is_cat[f] marks identity-binned categorical columns. out[n, f] int32:
+// searchsorted-right over bounds, NaN/invalid -> nan_bin. Multithreaded
+// over row blocks (each thread writes a disjoint slice).
+void nat_bin_rows(const float* x, const double* bounds, int64_t n, int64_t f,
+                  int64_t b, int32_t nan_bin, int32_t max_bin,
+                  const uint8_t* is_cat, int32_t* out, int32_t n_threads) {
+  auto work = [&](int64_t r0, int64_t r1) {
+    for (int64_t r = r0; r < r1; r++) {
+      const float* row = x + r * f;
+      int32_t* orow = out + r * f;
+      for (int64_t j = 0; j < f; j++) {
+        const float v = row[j];
+        if (std::isnan(v)) {
+          orow[j] = nan_bin;
+          continue;
+        }
+        if (is_cat[j]) {
+          const double code = std::floor(static_cast<double>(v));
+          orow[j] = (code >= 0 && code < max_bin && std::isfinite(v))
+                        ? static_cast<int32_t>(code)
+                        : nan_bin;
+          continue;
+        }
+        // branchless-ish binary search: first index with bounds[idx] >= v is
+        // lower_bound; searchsorted(side='right') is first bounds[idx] > v
+        const double* bj = bounds + j * b;
+        int64_t lo = 0, hi = b;
+        const double vd = static_cast<double>(v);
+        while (lo < hi) {
+          const int64_t mid = (lo + hi) >> 1;
+          if (bj[mid] <= vd) {
+            lo = mid + 1;
+          } else {
+            hi = mid;
+          }
+        }
+        orow[j] = static_cast<int32_t>(lo);
+      }
+    }
+  };
+  if (n_threads <= 1 || n < 4096) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  const int64_t block = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; t++) {
+    const int64_t r0 = t * block;
+    const int64_t r1 = std::min(n, r0 + block);
+    if (r0 < r1) pool.emplace_back(work, r0, r1);
+  }
+  for (auto& th : pool) th.join();
 }
 
 }  // extern "C"

@@ -97,3 +97,44 @@ def test_codegen_docs(tmp_path):
     entry = next(e for e in manifest if e["name"] == "ONNXModel")
     assert entry["kind"] == "Transformer"
     assert any(p["name"] == "model_payload" for p in entry["params"])
+
+
+def test_bin_rows_parity_with_numpy_path():
+    """native.bin_rows == BinMapper's numpy searchsorted path bit-for-bit
+    (float32 input: double(float32) is lossless), incl. NaN and categorical
+    identity binning with out-of-range codes."""
+    from synapseml_tpu.gbdt.binning import BinMapper
+
+    rs = np.random.default_rng(3)
+    X = rs.normal(size=(5000, 6)).astype(np.float32)
+    X[::17, 1] = np.nan
+    X[:, 4] = rs.integers(-3, 40, len(X))  # categorical incl. invalid codes
+    m = BinMapper(max_bin=31, categorical=(4,)).fit(X)
+    got = native.bin_rows(X, m.boundaries_, m.nan_bin, m.max_bin,
+                          categorical=(4,))
+    if got is None:
+        pytest.skip("native library unavailable")
+    # numpy oracle: float64 path through the same mapper
+    expect = m.transform(X.astype(np.float64))
+    np.testing.assert_array_equal(got.astype(expect.dtype), expect)
+    # boundary exactness: values exactly ON a boundary go right
+    b0 = float(m.boundaries_[0, 3])
+    Xb = np.full((2, 6), 0.0, np.float32)
+    Xb[0, 0] = np.float32(b0)
+    g = native.bin_rows(Xb, m.boundaries_, m.nan_bin, m.max_bin,
+                        categorical=(4,))
+    e = m.transform(Xb.astype(np.float64))
+    np.testing.assert_array_equal(g.astype(e.dtype), e)
+
+
+def test_bin_rows_single_thread_matches_multi():
+    from synapseml_tpu.gbdt.binning import BinMapper
+
+    rs = np.random.default_rng(5)
+    X = rs.normal(size=(9000, 4)).astype(np.float32)
+    m = BinMapper(max_bin=63).fit(X)
+    a = native.bin_rows(X, m.boundaries_, m.nan_bin, m.max_bin, n_threads=1)
+    b = native.bin_rows(X, m.boundaries_, m.nan_bin, m.max_bin, n_threads=8)
+    if a is None:
+        pytest.skip("native library unavailable")
+    np.testing.assert_array_equal(a, b)
