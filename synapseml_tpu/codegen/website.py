@@ -1,0 +1,239 @@
+"""Static website emission — the reference's docusaurus ``website/`` tier.
+
+The reference ships a generated docs website (``/root/reference/website/``,
+docusaurus over the ``docs/`` markdown + notebook corpus, with
+``website/doctest.py`` executing its code blocks). Here the analog is a
+dependency-free static site emitted from the SAME sources the test suite
+already executes (docs-as-tests: ``tests/test_examples.py``,
+``tests/test_walkthroughs.py``, ``tests/test_notebooks.py`` are the doctest
+tier): every ``docs/**/*.md`` page plus an index page per section, rendered
+with a small CommonMark-subset renderer (headers, fenced code, lists,
+tables, links, emphasis) — no docusaurus/node in the image, and none needed
+to browse: ``python -m http.server -d docs/site``.
+
+Generated output (``docs/site/``) is committed and drift-tested like the
+notebook corpus and the wrapper surface.
+"""
+
+from __future__ import annotations
+
+import html
+import os
+import re
+
+__all__ = ["markdown_to_html", "emit_site"]
+
+_CSS = """
+body { font-family: system-ui, sans-serif; margin: 0; display: flex;
+       color: #1a1a1a; }
+nav { width: 250px; min-height: 100vh; background: #f4f6f8; padding: 1rem;
+      box-sizing: border-box; flex-shrink: 0; }
+nav h2 { font-size: 0.85rem; text-transform: uppercase; color: #556; }
+nav a { display: block; padding: 2px 0; color: #2a6df4;
+        text-decoration: none; font-size: 0.92rem; }
+main { padding: 2rem 3rem; max-width: 900px; box-sizing: border-box; }
+code { background: #f0f2f4; padding: 1px 4px; border-radius: 3px;
+       font-size: 0.9em; }
+pre { background: #0f1419; color: #e6e1cf; padding: 1rem; overflow-x: auto;
+      border-radius: 6px; }
+pre code { background: none; color: inherit; padding: 0; }
+table { border-collapse: collapse; margin: 1rem 0; }
+th, td { border: 1px solid #ccd; padding: 4px 10px; font-size: 0.92rem; }
+th { background: #eef1f4; }
+h1, h2, h3 { line-height: 1.25; }
+a { color: #2a6df4; }
+"""
+
+
+def _inline(text: str) -> str:
+    """Inline markdown -> HTML (escape first; then code/links/emphasis)."""
+    out = html.escape(text, quote=False)
+    out = re.sub(r"`([^`]+)`", r"<code>\1</code>", out)
+    out = re.sub(r"\[([^\]]+)\]\(([^)\s]+)\)", r'<a href="\2">\1</a>', out)
+    out = re.sub(r"\*\*([^*]+)\*\*", r"<strong>\1</strong>", out)
+    out = re.sub(r"(?<![\w*])\*([^*\n]+)\*(?![\w*])", r"<em>\1</em>", out)
+    return out
+
+
+def markdown_to_html(md: str) -> str:
+    """CommonMark-subset renderer: headers, fenced code, unordered/ordered
+    lists, pipe tables, blockquotes, paragraphs."""
+    lines = md.splitlines()
+    out: list[str] = []
+    para: list[str] = []
+    in_code = False
+    code_buf: list[str] = []
+    list_stack: list[str] = []
+
+    def flush_para():
+        if para:
+            out.append(f"<p>{_inline(' '.join(para))}</p>")
+            para.clear()
+
+    def close_lists():
+        while list_stack:
+            out.append(f"</{list_stack.pop()}>")
+
+    i = 0
+    while i < len(lines):
+        ln = lines[i]
+        if in_code:
+            if ln.strip().startswith("```"):
+                out.append("<pre><code>"
+                           + html.escape("\n".join(code_buf)) + "</code></pre>")
+                code_buf.clear()
+                in_code = False
+            else:
+                code_buf.append(ln)
+            i += 1
+            continue
+        stripped = ln.strip()
+        if stripped.startswith("```"):
+            flush_para()
+            close_lists()
+            in_code = True
+            i += 1
+            continue
+        m = re.match(r"(#{1,6})\s+(.*)", stripped)
+        if m:
+            flush_para()
+            close_lists()
+            lvl = len(m.group(1))
+            out.append(f"<h{lvl}>{_inline(m.group(2))}</h{lvl}>")
+            i += 1
+            continue
+        if stripped.startswith("|") and i + 1 < len(lines) \
+                and re.match(r"^\s*\|[\s:|-]+\|\s*$", lines[i + 1]):
+            flush_para()
+            close_lists()
+            header = [c.strip() for c in stripped.strip("|").split("|")]
+            out.append("<table><tr>"
+                       + "".join(f"<th>{_inline(c)}</th>" for c in header)
+                       + "</tr>")
+            i += 2
+            while i < len(lines) and lines[i].strip().startswith("|"):
+                cells = [c.strip() for c in lines[i].strip().strip("|").split("|")]
+                out.append("<tr>" + "".join(f"<td>{_inline(c)}</td>"
+                                            for c in cells) + "</tr>")
+                i += 1
+            out.append("</table>")
+            continue
+        m = re.match(r"^(\s*)([-*]|\d+\.)\s+(.*)", ln)
+        if m:
+            flush_para()
+            tag = "ol" if m.group(2)[0].isdigit() else "ul"
+            if not list_stack:
+                out.append(f"<{tag}>")
+                list_stack.append(tag)
+            out.append(f"<li>{_inline(m.group(3))}</li>")
+            i += 1
+            # absorb hanging continuation lines of the same list item
+            while i < len(lines) and lines[i].startswith("  ") \
+                    and not re.match(r"^(\s*)([-*]|\d+\.)\s+", lines[i]):
+                out[-1] = out[-1][:-5] + " " + _inline(lines[i].strip()) + "</li>"
+                i += 1
+            continue
+        if stripped.startswith(">"):
+            flush_para()
+            close_lists()
+            out.append(f"<blockquote>{_inline(stripped[1:].strip())}</blockquote>")
+            i += 1
+            continue
+        if not stripped:
+            flush_para()
+            close_lists()
+            i += 1
+            continue
+        para.append(stripped)
+        i += 1
+    if in_code:  # unterminated fence
+        out.append("<pre><code>" + html.escape("\n".join(code_buf))
+                   + "</code></pre>")
+    flush_para()
+    close_lists()
+    return "\n".join(out)
+
+
+def _page(title: str, nav_html: str, body: str) -> str:
+    return (f"<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title><style>{_CSS}</style></head>"
+            f"<body><nav>{nav_html}</nav><main>{body}</main></body></html>\n")
+
+
+def emit_site(docs_dir: str | None = None, out_dir: str | None = None) -> list[str]:
+    """Render every docs markdown page into ``docs/site/``; returns paths.
+
+    Deterministic (sorted inputs) so a drift test can regenerate and diff.
+    Stale pages from renamed sources are removed.
+    """
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    docs_dir = docs_dir or os.path.join(repo, "docs")
+    out_dir = out_dir or os.path.join(docs_dir, "site")
+    os.makedirs(out_dir, exist_ok=True)
+
+    sections = {"": ["GETTING_STARTED.md", "BENCHMARKS.md"],
+                "api": sorted(f for f in os.listdir(os.path.join(docs_dir, "api"))
+                              if f.endswith(".md"))}
+    pages = []  # (out_name, title, src_path)
+    for sec, names in sections.items():
+        for name in names:
+            src = os.path.join(docs_dir, sec, name) if sec else \
+                os.path.join(docs_dir, name)
+            if not os.path.exists(src):
+                continue
+            stem = name[:-3].lower()
+            out_name = (f"{sec}_{stem}.html" if sec else f"{stem}.html")
+            title = stem.replace("_", " ")
+            pages.append((out_name, title, src))
+
+    nav = ["<h2>synapseml_tpu</h2>", '<a href="index.html">Index</a>']
+    for out_name, title, _ in pages:
+        nav.append(f'<a href="{out_name}">{html.escape(title)}</a>')
+    nav_html = "\n".join(nav)
+
+    written = []
+    expected = {"index.html"}
+    for out_name, title, src in pages:
+        with open(src) as f:
+            body = markdown_to_html(f.read())
+        path = os.path.join(out_dir, out_name)
+        with open(path, "w") as f:
+            f.write(_page(title, nav_html, body))
+        written.append(path)
+        expected.add(out_name)
+
+    # index: narrative entry + the executable corpus listings
+    nb_dir = os.path.join(docs_dir, "notebooks")
+    notebooks = sorted(n for n in os.listdir(nb_dir) if n.endswith(".ipynb")) \
+        if os.path.isdir(nb_dir) else []
+    body = ["<h1>synapseml_tpu documentation</h1>",
+            "<p>TPU-native rebuild of the SynapseML feature set: JAX/XLA "
+            "compute, one device mesh for every parallelism, the same "
+            "estimator/transformer surface.</p>",
+            "<h2>Guides</h2><ul>"]
+    body += [f'<li><a href="{o}">{html.escape(t)}</a></li>'
+             for o, t, _ in pages if not o.startswith("api_")]
+    body.append("</ul><h2>API reference</h2><ul>")
+    body += [f'<li><a href="{o}">{html.escape(t)}</a></li>'
+             for o, t, _ in pages if o.startswith("api_")]
+    body.append("</ul><h2>Notebook corpus</h2><p>Executable notebooks "
+                "(emitted from the percent-cell scripts, executed by the "
+                "test suite):</p><ul>")
+    body += [f"<li><code>docs/notebooks/{html.escape(n)}</code></li>"
+             for n in notebooks]
+    body.append("</ul>")
+    index_path = os.path.join(out_dir, "index.html")
+    with open(index_path, "w") as f:
+        f.write(_page("synapseml_tpu docs", nav_html, "\n".join(body)))
+    written.append(index_path)
+
+    for stale in sorted(set(os.listdir(out_dir)) - expected):
+        if stale.endswith(".html"):
+            os.remove(os.path.join(out_dir, stale))
+    return written
+
+
+if __name__ == "__main__":
+    out = emit_site()
+    print(f"wrote {len(out)} pages to docs/site/")

@@ -1,0 +1,72 @@
+"""Static website tier (reference: the docusaurus ``website/`` over docs
+markdown, with doctest.py running its code blocks — here the docs-as-tests
+suites are the doctest tier and the site is emitted by codegen/website.py,
+committed and drift-tested like the notebook corpus)."""
+
+import os
+
+import pytest
+
+from synapseml_tpu.codegen.website import emit_site, markdown_to_html
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SITE = os.path.join(REPO, "docs", "site")
+
+
+def test_site_has_no_drift(tmp_path):
+    out = emit_site(out_dir=str(tmp_path))
+    regenerated = {os.path.basename(p) for p in out}
+    committed = {n for n in os.listdir(SITE) if n.endswith(".html")}
+    assert regenerated == committed, (
+        "site drift: regenerate with `python synapseml_tpu/codegen/website.py`"
+        f" (missing={sorted(regenerated - committed)},"
+        f" stale={sorted(committed - regenerated)})")
+    for name in sorted(regenerated):
+        with open(os.path.join(str(tmp_path), name)) as f:
+            fresh = f.read()
+        with open(os.path.join(SITE, name)) as f:
+            assert f.read() == fresh, (
+                f"{name} is stale — regenerate with "
+                f"`python synapseml_tpu/codegen/website.py`")
+
+
+def test_site_index_links_resolve():
+    with open(os.path.join(SITE, "index.html")) as f:
+        index = f.read()
+    import re
+
+    for href in re.findall(r'href="([^"]+\.html)"', index):
+        assert os.path.exists(os.path.join(SITE, href)), f"dangling link {href}"
+    assert "API reference" in index and "Notebook corpus" in index
+
+
+@pytest.mark.parametrize("md,expect", [
+    ("# Title", "<h1>Title</h1>"),
+    ("plain `code` here", "<code>code</code>"),
+    ("a [link](x.html) b", '<a href="x.html">link</a>'),
+    ("**bold** and *em*", "<strong>bold</strong>"),
+    ("- one\n- two", "<li>one</li>"),
+    ("1. first\n2. second", "<ol>"),
+    ("> quoted", "<blockquote>quoted</blockquote>"),
+])
+def test_markdown_renderer_constructs(md, expect):
+    assert expect in markdown_to_html(md)
+
+
+def test_markdown_code_fence_escapes_html():
+    out = markdown_to_html("```\nx = a < b & c\n<script>\n```")
+    assert "<script>" not in out
+    assert "&lt;script&gt;" in out
+    assert out.count("<pre><code>") == 1
+
+
+def test_markdown_table():
+    out = markdown_to_html("| a | b |\n|---|---|\n| 1 | `c` |")
+    assert "<table>" in out and "<th>a</th>" in out
+    assert "<td><code>c</code></td>" in out
+
+
+def test_markdown_paragraph_joins_wrapped_lines():
+    out = markdown_to_html("first line\nsecond line\n\nnew para")
+    assert out.count("<p>") == 2
+    assert "first line second line" in out
