@@ -90,6 +90,11 @@ class BinMapper:
         n, f = arr.shape
         if f != self.boundaries_.shape[0]:
             raise ValueError(f"feature count {f} != fitted {self.boundaries_.shape[0]}")
+        if self.categorical and not all(0 <= i < f for i in self.categorical):
+            # both paths must agree; a negative index would identity-bin on
+            # the native path but quantile-bin on the numpy path
+            raise ValueError(f"categorical indices {sorted(self.categorical)} "
+                             f"out of range [0, {f})")
         out = None
         if arr.dtype == np.float32:
             from .. import native

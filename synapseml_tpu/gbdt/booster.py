@@ -293,7 +293,10 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         from ..core.instrumentation import InstrumentationMeasures
 
         measures = InstrumentationMeasures()
-    x = np.asarray(features, dtype=np.float64)
+    # keep the caller's dtype: float32 input takes the multithreaded native
+    # binning path (BinMapper.transform); boundary FITTING widens to float64
+    # inside BinMapper either way, so bin codes are dtype-independent
+    x = np.asarray(features)
     y = np.asarray(labels, dtype=np.float32)
     n, f = x.shape
     if max_depth is None or max_depth <= 0:
@@ -398,7 +401,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     # validation state (kept binned; scores updated incrementally)
     has_valid = valid_features is not None and valid_labels is not None
     if has_valid:
-        vbins = jnp.asarray(mapper.transform(np.asarray(valid_features, np.float64)).astype(np.int32))
+        vbins = jnp.asarray(mapper.transform(np.asarray(valid_features)).astype(np.int32))
         vy = jnp.asarray(np.asarray(valid_labels, np.float32))
         vscores = jnp.broadcast_to(jnp.asarray(init)[None, :], (vbins.shape[0], K)).astype(jnp.float32)
         if is_rank:

@@ -44,11 +44,22 @@ def library_path() -> str:
     cache = os.environ.get("SYNAPSEML_TPU_NATIVE_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "synapseml_tpu", "native")
     os.makedirs(cache, exist_ok=True)
+    # superseded digests would otherwise accumulate forever
+    for old in os.listdir(cache):
+        if (old.startswith("libnative_ops") and old.endswith(".so")
+                and digest not in old):
+            try:
+                os.remove(os.path.join(cache, old))
+            except OSError:
+                pass
     return os.path.join(cache, f"libnative_ops-{digest}.so")
 
 
 def _build() -> str | None:
-    out = library_path()  # content-addressed: existing file IS this source
+    try:
+        out = library_path()  # content-addressed: existing file IS this source
+    except OSError:  # source stripped from the install: pure-Python fallback
+        return None
     if os.path.exists(out):
         return out
     try:
@@ -168,7 +179,11 @@ def bin_rows(x: np.ndarray, boundaries: np.ndarray, nan_bin: int, max_bin: int,
                          f"feature count {f}")
     is_cat = np.zeros(f, np.uint8)
     if categorical:
-        is_cat[np.asarray(categorical, np.int64)] = 1
+        idx = np.asarray(categorical, np.int64)
+        if idx.size and (idx.min() < 0 or idx.max() >= f):
+            raise ValueError(f"categorical indices {sorted(categorical)} out "
+                             f"of range [0, {f})")
+        is_cat[idx] = 1
     out = np.empty((n, f), np.int32)
     if n_threads is None:
         n_threads = min(os.cpu_count() or 1, 16)
