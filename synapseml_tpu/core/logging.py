@@ -28,8 +28,33 @@ def scrub(text: str) -> str:
     return _BEARER_PAT.sub("Bearer ####", text)
 
 
+_TELEMETRY_SINKS: list = []
+
+
+def add_telemetry_sink(fn) -> None:
+    """Register an extra consumer of every stage-event payload (e.g. Fabric
+    certified events — ``services.fabric.install_certified_events``; the
+    reference fans SynapseMLLogging out the same way)."""
+    _TELEMETRY_SINKS.append(fn)
+
+
+def remove_telemetry_sink(fn) -> None:
+    if fn in _TELEMETRY_SINKS:
+        _TELEMETRY_SINKS.remove(fn)
+
+
 def log_stage_event(payload: dict) -> None:
-    logger.info(scrub(json.dumps(payload, default=str)))
+    text = scrub(json.dumps(payload, default=str))
+    logger.info(text)
+    if _TELEMETRY_SINKS:
+        # sinks get the SCRUBBED payload — they forward off-box (certified
+        # events), so the secret-stripping must cover the fan-out path too
+        sanitized = json.loads(text)
+        for sink in _TELEMETRY_SINKS:
+            try:
+                sink(sanitized)
+            except Exception:  # noqa: BLE001 — telemetry must never break a stage
+                logger.debug("telemetry sink failed", exc_info=True)
 
 
 class StageTelemetry:

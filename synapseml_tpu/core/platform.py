@@ -1,0 +1,68 @@
+"""Platform detection — which hosted environment is this process in.
+
+Reference: ``logging/common/PlatformDetails.scala`` (Fabric via the
+trident-context file, Synapse via ``AZURE_SERVICE``, Databricks via
+``/dbfs``, Binder via env) and ``synapse/ml/core/platform`` on the Python
+side. The TPU rebuild adds TPU-VM detection (libtpu accel devices / the
+``TPU_NAME`` metadata env GKE and GCE TPU VMs export) since executor↔TPU-host
+pinning decisions key off it.
+
+``env``/``root`` are injectable so detection is unit-testable off-platform.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "PLATFORM_FABRIC", "PLATFORM_SYNAPSE", "PLATFORM_DATABRICKS",
+    "PLATFORM_BINDER", "PLATFORM_TPU_VM", "PLATFORM_UNKNOWN",
+    "current_platform", "running_on_fabric", "running_on_synapse",
+    "running_on_databricks", "running_on_tpu_vm",
+]
+
+# names mirror PlatformDetails.scala (Fabric reports as synapse_internal)
+PLATFORM_FABRIC = "synapse_internal"
+PLATFORM_SYNAPSE = "synapse"
+PLATFORM_DATABRICKS = "databricks"
+PLATFORM_BINDER = "binder"
+PLATFORM_TPU_VM = "tpu_vm"
+PLATFORM_UNKNOWN = "unknown"
+
+SYNAPSE_PROJECT_NAME = "Microsoft.ProjectArcadia"
+TRIDENT_CONTEXT_PATH = "home/trusted-service-user/.trident-context"
+
+
+def current_platform(env: dict | None = None, root: str = "/") -> str:
+    """Detection precedence mirrors the reference: the trident-context file
+    is authoritative for Fabric; ``AZURE_SERVICE`` marks Synapse; ``/dbfs``
+    Databricks; Binder its launch-host env; then TPU-VM markers."""
+    e = os.environ if env is None else env
+    if os.path.exists(os.path.join(root, TRIDENT_CONTEXT_PATH)):
+        return PLATFORM_FABRIC
+    if e.get("AZURE_SERVICE") == SYNAPSE_PROJECT_NAME:
+        return PLATFORM_SYNAPSE
+    if os.path.exists(os.path.join(root, "dbfs")):
+        return PLATFORM_DATABRICKS
+    if "BINDER_LAUNCH_HOST" in e:
+        return PLATFORM_BINDER
+    if "TPU_NAME" in e or "TPU_WORKER_ID" in e \
+            or os.path.exists(os.path.join(root, "dev", "accel0")):
+        return PLATFORM_TPU_VM
+    return PLATFORM_UNKNOWN
+
+
+def running_on_fabric(env: dict | None = None, root: str = "/") -> bool:
+    return current_platform(env, root) == PLATFORM_FABRIC
+
+
+def running_on_synapse(env: dict | None = None, root: str = "/") -> bool:
+    return current_platform(env, root) == PLATFORM_SYNAPSE
+
+
+def running_on_databricks(env: dict | None = None, root: str = "/") -> bool:
+    return current_platform(env, root) == PLATFORM_DATABRICKS
+
+
+def running_on_tpu_vm(env: dict | None = None, root: str = "/") -> bool:
+    return current_platform(env, root) == PLATFORM_TPU_VM

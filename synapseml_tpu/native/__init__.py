@@ -44,12 +44,20 @@ def library_path() -> str:
     cache = os.environ.get("SYNAPSEML_TPU_NATIVE_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "synapseml_tpu", "native")
     os.makedirs(cache, exist_ok=True)
-    # superseded digests would otherwise accumulate forever
+    # prune superseded digests, but only STALE ones (>30 days unused):
+    # immediate deletion would let two package versions sharing the cache
+    # evict each other's builds every startup — or even race a concurrent
+    # process between its _build() and CDLL()
+    import time
+
+    cutoff = time.time() - 30 * 86400
     for old in os.listdir(cache):
         if (old.startswith("libnative_ops") and old.endswith(".so")
                 and digest not in old):
+            path = os.path.join(cache, old)
             try:
-                os.remove(os.path.join(cache, old))
+                if os.path.getmtime(path) < cutoff:
+                    os.remove(path)
             except OSError:
                 pass
     return os.path.join(cache, f"libnative_ops-{digest}.so")

@@ -55,6 +55,12 @@ from .geospatial import AddressGeocoder, CheckPointInPolygon, ReverseAddressGeoc
 from .speech import ConversationTranscriber, SpeechToText, TextToSpeech
 from .aifoundry import AIFoundryChatCompletion
 from .langchain import LangChainTransformer
+from .fabric import (
+    FabricClient,
+    install_certified_events,
+    log_to_certified_events,
+    parse_jwt_expiry,
+)
 
 __all__ = [
     "CognitiveServiceBase", "HasAsyncReply",
@@ -75,4 +81,6 @@ __all__ = [
     "AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon",
     "SpeechToText", "TextToSpeech", "ConversationTranscriber", "AIFoundryChatCompletion",
     "LangChainTransformer",
+    "FabricClient", "parse_jwt_expiry", "log_to_certified_events",
+    "install_certified_events",
 ]

@@ -1,0 +1,308 @@
+"""Microsoft Fabric platform glue — workspace context, tokens, endpoints,
+certified-event telemetry.
+
+Reference: ``fabric/FabricClient.scala`` (context-file parsing, workspace/
+capacity/artifact IDs, ML workload endpoint construction incl. the
+private-endpoint host form), ``fabric/FabricTokenParser.scala`` (JWT expiry),
+``fabric/TokenLibrary.scala`` (platform token provider, reached by
+reflection there — here an injectable callable), and
+``logging/fabric/CertifiedEventClient.scala`` (usage telemetry posted to the
+admin workload endpoint when running on Fabric, wired into every stage's
+``SynapseMLLogging`` emission).
+
+Everything is instance-based with injectable ``root``/``env``/token provider
+so the full surface unit-tests off-platform (the reference needs a live
+Trident runtime; SURVEY §2.5 "Fabric platform glue").
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+import os
+import time
+import uuid
+
+from ..core.platform import running_on_fabric
+
+__all__ = ["FabricClient", "parse_jwt_expiry", "InvalidJwtToken",
+           "JwtExpiryMissing", "log_to_certified_events",
+           "install_certified_events"]
+
+
+class InvalidJwtToken(ValueError):
+    pass
+
+
+class JwtExpiryMissing(ValueError):
+    pass
+
+
+def parse_jwt_expiry(token: str) -> int:
+    """Expiry of a JWT in epoch **milliseconds** (FabricTokenParser.getExpiry).
+
+    Decodes the base64url payload ([header].[payload].[signature]); a
+    malformed token raises :class:`InvalidJwtToken`, a payload without
+    ``exp`` raises :class:`JwtExpiryMissing`.
+    """
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise InvalidJwtToken(f"JWT must have 3 segments, got {len(parts)}")
+    payload = parts[1].replace("-", "+").replace("_", "/")
+    payload += "=" * (-len(payload) % 4)
+    try:
+        decoded = json.loads(base64.b64decode(payload))
+    except (binascii.Error, ValueError) as e:
+        raise InvalidJwtToken(f"undecodable JWT payload: {e}") from e
+    exp = decoded.get("exp")
+    if not isinstance(exp, (int, float)):
+        raise JwtExpiryMissing("JWT payload has no numeric 'exp' claim")
+    return int(exp) * 1000
+
+
+_CONTEXT_PATH = "home/trusted-service-user/.trident-context"
+_SPARK_CONF_PATH = "opt/spark/conf/spark-defaults.conf"
+_CLUSTER_INFO_PATH = "opt/health-agent/conf/cluster-info.json"
+
+# pbienv -> shared PBI API host (FabricClient.getPbiSharedHost)
+_PBI_HOSTS = {
+    "edog": "powerbiapi.analysis-df.windows.net",
+    "daily": "dailyapi.fabric.microsoft.com",
+    "dxt": "dxtapi.fabric.microsoft.com",
+    "msit": "msitapi.fabric.microsoft.com",
+}
+
+
+class FabricClient:
+    """Workspace context + ML workload endpoints + authenticated usage POSTs.
+
+    ``root`` points at the filesystem root holding the Trident context files
+    (injectable for tests); ``token_provider`` returns the AAD access token
+    (the reference reaches the Trident TokenLibrary by reflection — here the
+    provider defaults to the ``SYNAPSEML_TPU_FABRIC_TOKEN`` env var).
+    """
+
+    def __init__(self, root: str = "/", env: dict | None = None,
+                 token_provider=None, http_send=None):
+        self.root = root
+        self.env = os.environ if env is None else env
+        self._token_provider = token_provider
+        self._http_send = http_send  # injectable for tests
+        self._context: dict | None = None
+
+    # -------- context files --------
+    def _read_kv(self, rel: str, sep) -> dict:
+        """key/value lines; a VALUE still containing the separator marks an
+        ambiguous entry and is dropped (the reference's rule). ``sep=None``
+        splits on any whitespace run (spark-defaults.conf uses spaces OR
+        tabs), stripping the value before the ambiguity check so ordinary
+        multi-space alignment doesn't drop real entries."""
+        out = {}
+        try:
+            with open(os.path.join(self.root, rel)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    parts = line.split(sep, 1)
+                    if len(parts) != 2:
+                        continue
+                    key, value = parts[0].strip(), parts[1].strip()
+                    ambiguous = (any(c.isspace() for c in value)
+                                 if sep is None else sep in value)
+                    if key and value and not ambiguous:
+                        out[key] = value
+        except OSError:
+            pass
+        return out
+
+    @property
+    def context(self) -> dict:
+        if self._context is None:
+            ctx = self._read_kv(_CONTEXT_PATH, "=")
+            ctx.update(self._read_kv(_SPARK_CONF_PATH, None))
+            self._context = ctx
+        return self._context
+
+    def _cluster_metadata(self) -> dict:
+        try:
+            with open(os.path.join(self.root, _CLUSTER_INFO_PATH)) as f:
+                return json.load(f).get("cluster_metadata", {}) or {}
+        except (OSError, ValueError):
+            return {}
+
+    # -------- identity --------
+    @property
+    def capacity_id(self):
+        return self.context.get("trident.capacity.id")
+
+    @property
+    def workspace_id(self):
+        return (self.context.get("trident.artifact.workspace.id")
+                or self.context.get("trident.workspace.id"))
+
+    @property
+    def artifact_id(self):
+        return self.context.get("trident.artifact.id")
+
+    @property
+    def pbi_env(self) -> str:
+        return self.context.get("spark.trident.pbienv", "public").lower()
+
+    @property
+    def workspace_pe_enabled(self) -> bool:
+        return str(self._cluster_metadata().get("workspace-pe-enabled", "")
+                   ).lower() == "true"
+
+    # -------- hosts / endpoints --------
+    @property
+    def ml_workload_host(self):
+        if self.workspace_pe_enabled:
+            ws = self.workspace_id
+            if not ws:
+                return None
+            cleaned = ws.lower().replace("-", "")
+            mark = (f"{self.pbi_env}-"
+                    if self.pbi_env in ("daily", "dxt", "msit") else "")
+            return (f"https://{cleaned}.z{cleaned[:2]}."
+                    f"{mark}c.fabric.microsoft.com")
+        ep = self.context.get("trident.lakehouse.tokenservice.endpoint")
+        if not ep:
+            return None
+        from urllib.parse import urlparse
+
+        u = urlparse(ep)
+        return f"{u.scheme}://{u.hostname}" if u.scheme and u.hostname else None
+
+    @property
+    def pbi_shared_host(self):
+        if self.workspace_pe_enabled:
+            ws = self.workspace_id
+            if not ws:
+                return None
+            cleaned = ws.lower().replace("-", "")
+            mark = self.pbi_env if self.pbi_env in ("daily", "dxt", "msit") else ""
+            return (f"https://{cleaned}.z{cleaned[:2]}.w."
+                    f"{mark}api.fabric.microsoft.com")
+        host = self.context.get("spark.trident.pbiHost", "").strip()
+        if host:
+            host = host.replace("https://", "").replace("http://", "")
+        else:
+            host = _PBI_HOSTS.get(self.pbi_env, "api.fabric.microsoft.com")
+        return "https://" + host
+
+    def ml_workload_endpoint(self, endpoint_type: str) -> str:
+        """(FabricClient.getMLWorkloadEndpoint) — ML | LlmPlugin | Automatic |
+        Registry | MLAdmin."""
+        return (f"{self.ml_workload_host or ''}/webapi/capacities/"
+                f"{self.capacity_id or ''}/workloads/ML/{endpoint_type}/"
+                f"Automatic/workspaceid/{self.workspace_id or ''}/")
+
+    @property
+    def cognitive_endpoint(self) -> str:
+        return self.ml_workload_endpoint("ML") + "cognitive/"
+
+    @property
+    def openai_endpoint(self) -> str:
+        return self.cognitive_endpoint + "openai/"
+
+    # -------- auth / posting --------
+    def access_token(self) -> str:
+        if self._token_provider is not None:
+            return self._token_provider()
+        tok = self.env.get("SYNAPSEML_TPU_FABRIC_TOKEN")
+        if not tok:
+            raise RuntimeError(
+                "no Fabric token available: pass token_provider= or set "
+                "SYNAPSEML_TPU_FABRIC_TOKEN (the reference reaches the "
+                "Trident TokenLibrary, which only exists on-platform)")
+        return tok
+
+    def auth_headers(self) -> dict:
+        return {"Authorization": f"Bearer {self.access_token()}",
+                "RequestId": str(uuid.uuid4()),
+                "Content-Type": "application/json"}
+
+    def usage_post(self, url: str, body: dict | str):
+        from ..io.http import HTTPRequest, send_with_retries
+
+        payload = body if isinstance(body, str) else json.dumps(body)
+        req = HTTPRequest(url=url, method="POST", headers=self.auth_headers(),
+                          entity=payload.encode())
+        send = self._http_send or send_with_retries
+        return send(req)
+
+
+def log_to_certified_events(feature_name: str, activity_name: str,
+                            attributes: dict | None = None,
+                            client: FabricClient | None = None,
+                            force: bool = False) -> bool:
+    """(CertifiedEventClient.logToCertifiedEvents) — POST a usage event to
+    the MLAdmin telemetry endpoint; no-op (returns False) off-Fabric."""
+    client = client or FabricClient()
+    if not force and not running_on_fabric(env=client.env, root=client.root):
+        return False
+    payload = {"timestamp": int(time.time()),
+               "feature_name": feature_name,
+               "activity_name": activity_name,
+               "attributes": attributes or {}}
+    client.usage_post(client.ml_workload_endpoint("MLAdmin") + "telemetry",
+                      payload)
+    return True
+
+
+_installed_sink = None
+_install_lock = __import__("threading").Lock()
+
+
+def install_certified_events(client: FabricClient | None = None,
+                             max_queue: int = 256):
+    """Register certified-event emission as a telemetry sink: every stage's
+    fit/transform log line also posts a usage event when on Fabric.
+
+    ASYNCHRONOUS, like the reference (SynapseMLLogging posts certified
+    events off-thread): the sink only enqueues; a daemon worker drains the
+    bounded queue and events are DROPPED when it is full — stage latency can
+    never be held hostage by the telemetry endpoint. Idempotent: re-running
+    an install cell replaces the previous sink instead of stacking
+    duplicates. Returns the sink (pass to ``remove_telemetry_sink`` to
+    uninstall)."""
+    import queue
+    import threading
+
+    from ..core import logging as stage_logging
+
+    global _installed_sink
+    c = client or FabricClient()
+    q: queue.Queue = queue.Queue(maxsize=max_queue)
+
+    def worker():
+        while True:
+            payload = q.get()
+            try:
+                log_to_certified_events(payload.get("featureName", "core"),
+                                        payload.get("method", "unknown"),
+                                        {"uid": str(payload.get("uid", ""))},
+                                        client=c)
+            except Exception:  # noqa: BLE001 — telemetry must never raise
+                pass
+            finally:
+                q.task_done()
+
+    threading.Thread(target=worker, daemon=True,
+                     name="fabric-certified-events").start()
+
+    def sink(payload: dict) -> None:
+        try:
+            q.put_nowait(payload)
+        except queue.Full:
+            pass  # drop: telemetry must never block a stage
+
+    sink._queue = q  # tests drain this to assert delivery
+    with _install_lock:
+        if _installed_sink is not None:
+            stage_logging.remove_telemetry_sink(_installed_sink)
+        stage_logging.add_telemetry_sink(sink)
+        _installed_sink = sink
+    return sink

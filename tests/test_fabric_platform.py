@@ -1,0 +1,230 @@
+"""Fabric platform glue + platform detection (SURVEY §2.5: PlatformDetails,
+FabricClient/TokenLibrary/FabricTokenParser, CertifiedEventClient) — the
+whole surface unit-tested off-platform through injectable roots/envs."""
+
+import base64
+import json
+import os
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.platform import (
+    PLATFORM_DATABRICKS,
+    PLATFORM_FABRIC,
+    PLATFORM_SYNAPSE,
+    PLATFORM_TPU_VM,
+    PLATFORM_UNKNOWN,
+    current_platform,
+    running_on_fabric,
+)
+from synapseml_tpu.services.fabric import (
+    FabricClient,
+    InvalidJwtToken,
+    JwtExpiryMissing,
+    install_certified_events,
+    log_to_certified_events,
+    parse_jwt_expiry,
+)
+
+
+# ---------------------------------------------------------------------------
+# platform detection
+# ---------------------------------------------------------------------------
+
+def _fabric_root(tmp_path, context_lines=(), spark_lines=(), cluster=None):
+    ctx = tmp_path / "home" / "trusted-service-user"
+    ctx.mkdir(parents=True, exist_ok=True)
+    (ctx / ".trident-context").write_text("\n".join(context_lines) + "\n")
+    if spark_lines:
+        conf = tmp_path / "opt" / "spark" / "conf"
+        conf.mkdir(parents=True, exist_ok=True)
+        (conf / "spark-defaults.conf").write_text("\n".join(spark_lines) + "\n")
+    if cluster is not None:
+        info = tmp_path / "opt" / "health-agent" / "conf"
+        info.mkdir(parents=True, exist_ok=True)
+        (info / "cluster-info.json").write_text(json.dumps(cluster))
+    return str(tmp_path)
+
+
+def test_platform_detection_precedence(tmp_path):
+    assert current_platform(env={}, root=str(tmp_path)) == PLATFORM_UNKNOWN
+    assert current_platform(env={"TPU_NAME": "v5e-16"},
+                            root=str(tmp_path)) == PLATFORM_TPU_VM
+    assert current_platform(env={"AZURE_SERVICE": "Microsoft.ProjectArcadia"},
+                            root=str(tmp_path)) == PLATFORM_SYNAPSE
+    (tmp_path / "dbfs").mkdir()
+    assert current_platform(env={}, root=str(tmp_path)) == PLATFORM_DATABRICKS
+    root = _fabric_root(tmp_path)  # trident-context wins over everything
+    assert current_platform(env={"TPU_NAME": "x"}, root=root) == PLATFORM_FABRIC
+    assert running_on_fabric(env={}, root=root)
+
+
+# ---------------------------------------------------------------------------
+# FabricClient context / endpoints
+# ---------------------------------------------------------------------------
+
+def make_client(tmp_path, **kw):
+    root = _fabric_root(
+        tmp_path,
+        context_lines=[
+            "trident.capacity.id=cap-123",
+            "trident.artifact.workspace.id=AB-work-456",
+            "trident.artifact.id=art-789",
+            "ambiguous=a=b",              # double-separator line: dropped
+        ],
+        spark_lines=[
+            "# comment",
+            "spark.trident.pbienv MSIT",
+            "trident.lakehouse.tokenservice.endpoint https://tokens.fabric.example.com/x/y",
+        ],
+        cluster=kw.pop("cluster", None))
+    return FabricClient(root=root, env=kw.pop("env", {}), **kw)
+
+
+def test_context_parsing_and_ids(tmp_path):
+    c = make_client(tmp_path)
+    assert c.capacity_id == "cap-123"
+    assert c.workspace_id == "AB-work-456"
+    assert c.artifact_id == "art-789"
+    assert "ambiguous" not in c.context       # reference drops double-= lines
+    assert c.pbi_env == "msit"                # lowercased
+
+
+def test_spark_conf_whitespace_forms(tmp_path):
+    # real spark-defaults.conf separates with tabs or aligned multi-space
+    root = _fabric_root(
+        tmp_path,
+        spark_lines=["spark.a\tv1", "spark.b      v2", "spark.c v3 extra"])
+    c = FabricClient(root=root, env={})
+    assert c.context["spark.a"] == "v1"
+    assert c.context["spark.b"] == "v2"
+    assert "spark.c" not in c.context  # multi-token value: ambiguous, dropped
+
+
+def test_ml_workload_endpoint(tmp_path):
+    c = make_client(tmp_path)
+    assert c.ml_workload_host == "https://tokens.fabric.example.com"
+    ep = c.ml_workload_endpoint("ML")
+    assert ep == ("https://tokens.fabric.example.com/webapi/capacities/"
+                  "cap-123/workloads/ML/ML/Automatic/workspaceid/"
+                  "AB-work-456/")
+    assert c.openai_endpoint.endswith("/cognitive/openai/")
+
+
+def test_private_endpoint_hosts(tmp_path):
+    c = make_client(tmp_path,
+                    cluster={"cluster_metadata": {"workspace-pe-enabled": "True"}})
+    # cleaned workspace id: lowercase, dashes stripped; msit env mark applied
+    assert c.ml_workload_host == \
+        "https://abwork456.zab.msit-c.fabric.microsoft.com"
+    assert c.pbi_shared_host == \
+        "https://abwork456.zab.w.msitapi.fabric.microsoft.com"
+
+
+def test_pbi_shared_host_env_table(tmp_path):
+    c = make_client(tmp_path)
+    assert c.pbi_shared_host == "https://msitapi.fabric.microsoft.com"
+
+
+# ---------------------------------------------------------------------------
+# JWT expiry (FabricTokenParser)
+# ---------------------------------------------------------------------------
+
+def _jwt(payload: dict) -> str:
+    seg = base64.urlsafe_b64encode(json.dumps(payload).encode()
+                                   ).decode().rstrip("=")
+    return f"hdr.{seg}.sig"
+
+
+def test_parse_jwt_expiry():
+    assert parse_jwt_expiry(_jwt({"exp": 1700000000})) == 1700000000000
+    with pytest.raises(JwtExpiryMissing):
+        parse_jwt_expiry(_jwt({"sub": "x"}))
+    with pytest.raises(InvalidJwtToken):
+        parse_jwt_expiry("only.two")
+    with pytest.raises(InvalidJwtToken):
+        parse_jwt_expiry("a.!!!!.c")
+
+
+# ---------------------------------------------------------------------------
+# auth + certified events
+# ---------------------------------------------------------------------------
+
+def test_usage_post_auth_headers(tmp_path):
+    sent = []
+    c = make_client(tmp_path, env={"SYNAPSEML_TPU_FABRIC_TOKEN": "tok123"},
+                    http_send=lambda req: sent.append(req))
+    c.usage_post("https://x.example/telemetry", {"a": 1})
+    (req,) = sent
+    assert req.headers["Authorization"] == "Bearer tok123"
+    assert "RequestId" in req.headers
+    assert json.loads(req.entity) == {"a": 1}
+
+
+def test_access_token_requires_provider_off_platform(tmp_path):
+    c = make_client(tmp_path)
+    with pytest.raises(RuntimeError, match="token"):
+        c.access_token()
+    c2 = make_client(tmp_path, token_provider=lambda: "prov")
+    assert c2.access_token() == "prov"
+
+
+def test_certified_events_noop_off_fabric(tmp_path):
+    sent = []
+    c = FabricClient(root=str(tmp_path / "nowhere"), env={},
+                     http_send=lambda req: sent.append(req))
+    assert log_to_certified_events("gbdt", "fit", client=c) is False
+    assert not sent
+
+
+def test_certified_events_post_on_fabric(tmp_path):
+    sent = []
+    c = make_client(tmp_path, env={"SYNAPSEML_TPU_FABRIC_TOKEN": "t"},
+                    http_send=lambda req: sent.append(req))
+    assert log_to_certified_events("gbdt", "fit", {"rows": "10"},
+                                   client=c) is True
+    (req,) = sent
+    assert req.url.endswith("/workloads/ML/MLAdmin/Automatic/workspaceid/"
+                            "AB-work-456/telemetry")
+    body = json.loads(req.entity)
+    assert body["feature_name"] == "gbdt" and body["activity_name"] == "fit"
+
+
+def test_telemetry_sinks_receive_scrubbed_payloads():
+    from synapseml_tpu.core import logging as stage_logging
+
+    got = []
+    sink = got.append
+    stage_logging.add_telemetry_sink(sink)
+    try:
+        stage_logging.log_stage_event(
+            {"uid": "u1", "error": "HTTPError https://x/?sig=SECRET123&a=1"})
+    finally:
+        stage_logging.remove_telemetry_sink(sink)
+    assert not stage_logging._TELEMETRY_SINKS
+    assert got and "SECRET123" not in got[0]["error"]
+    assert "sig=####" in got[0]["error"]
+
+
+def test_install_certified_events_fires_from_stage_telemetry(tmp_path):
+    import synapseml_tpu as st
+    from synapseml_tpu.core import logging as stage_logging
+    from synapseml_tpu.stages import SelectColumns
+
+    sent = []
+    c = make_client(tmp_path, env={"SYNAPSEML_TPU_FABRIC_TOKEN": "t"},
+                    http_send=lambda req: sent.append(req))
+    sink = install_certified_events(client=c)
+    # idempotent: re-install replaces, never stacks
+    sink = install_certified_events(client=c)
+    assert stage_logging._TELEMETRY_SINKS.count(sink) == 1
+    try:
+        df = st.DataFrame.from_dict({"a": np.arange(3), "b": np.arange(3)})
+        SelectColumns(cols=["a"]).transform(df)
+        sink._queue.join()  # posting is ASYNC — drain the worker queue
+        assert sent, "stage transform did not emit a certified event"
+        body = json.loads(sent[-1].entity)
+        assert body["activity_name"] == "transform"
+    finally:
+        stage_logging.remove_telemetry_sink(sink)
