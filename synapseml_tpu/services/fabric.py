@@ -252,6 +252,39 @@ def log_to_certified_events(feature_name: str, activity_name: str,
     return True
 
 
+def assert_model_status(model_name: str, client: FabricClient | None = None) -> None:
+    """(OpenAIFabricSetting.assertModelStatus) — check the Fabric tenant
+    setting for a default OpenAI model and raise with the admin-facing
+    guidance when it is disallowed/missing. A transport failure is tolerated
+    (the reference: "likely running in the system context of Fabric")."""
+    c = client or FabricClient()
+    try:
+        resp = c.usage_post(c.ml_workload_endpoint("ML")
+                            + "cognitive/openai/tenantsetting",
+                            json.dumps([model_name]))
+        status = resp.json().get(model_name.lower())
+    except Exception:  # noqa: BLE001 — status check is advisory off-tenant
+        return
+    messages = {
+        "Disallowed": f"Default OpenAI model {model_name} is Disallowed; "
+                      "contact your admin to enable the default Fabric LLM "
+                      "model, or set your own Azure OpenAI credentials.",
+        "DisallowedForCrossGeo": f"Default OpenAI model {model_name} is "
+                                 "Disallowed for Cross Geo; contact your "
+                                 "admin or set your own Azure OpenAI "
+                                 "credentials.",
+        "ModelNotFound": f"Default OpenAI model {model_name} not found; "
+                         "check the deployment name.",
+        "InvalidResult": "Cannot get tenant admin setting status correctly",
+    }
+    if status in messages:
+        raise RuntimeError(messages[status])
+    if status not in ("Allowed", None):
+        raise RuntimeError(
+            f"Unexpected Fabric tenant-setting status {status!r} for "
+            f"{model_name}")
+
+
 _installed_sink = None
 _install_lock = __import__("threading").Lock()
 

@@ -191,6 +191,32 @@ def test_certified_events_post_on_fabric(tmp_path):
     assert body["feature_name"] == "gbdt" and body["activity_name"] == "fit"
 
 
+def test_assert_model_status(tmp_path):
+    from synapseml_tpu.services.fabric import assert_model_status
+
+    class FakeResp:
+        def __init__(self, body):
+            self._body = body
+
+        def json(self):
+            return self._body
+
+    def client_with(status):
+        return make_client(
+            tmp_path, env={"SYNAPSEML_TPU_FABRIC_TOKEN": "t"},
+            http_send=lambda req: FakeResp({"gpt-4o-mini": status}))
+
+    assert_model_status("gpt-4o-mini", client_with("Allowed"))  # no raise
+    with pytest.raises(RuntimeError, match="Disallowed"):
+        assert_model_status("gpt-4o-mini", client_with("Disallowed"))
+    with pytest.raises(RuntimeError, match="not found"):
+        assert_model_status("gpt-4o-mini", client_with("ModelNotFound"))
+    # transport failure: advisory no-op (system-context Fabric)
+    boom = make_client(tmp_path, env={"SYNAPSEML_TPU_FABRIC_TOKEN": "t"},
+                       http_send=lambda req: (_ for _ in ()).throw(OSError()))
+    assert_model_status("gpt-4o-mini", boom)
+
+
 def test_telemetry_sinks_receive_scrubbed_payloads():
     from synapseml_tpu.core import logging as stage_logging
 
