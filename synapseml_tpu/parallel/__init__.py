@@ -18,6 +18,7 @@ from .batching import (
 from .checkpoint import (AsyncCheckpointer, latest_step,
                          restore_checkpoint, save_checkpoint)
 from .mesh import MeshConfig, MeshContext, P, create_mesh, logical_axis_rules, shard_params
+from .pipeline import pipeline_apply, pipeline_sharded, stack_stage_params
 
 __all__ = [
     "DistributedBackend", "DriverRendezvous", "initialize_backend", "reset_backend",
@@ -26,4 +27,5 @@ __all__ = [
     "pad_sequences", "round_up_to_multiple", "unpad",
     "AsyncCheckpointer", "latest_step", "restore_checkpoint", "save_checkpoint",
     "MeshConfig", "MeshContext", "P", "create_mesh", "logical_axis_rules", "shard_params",
+    "pipeline_apply", "pipeline_sharded", "stack_stage_params",
 ]

@@ -12,6 +12,7 @@ horovod ring-allreduce ``dl/utils.py:31-46``) with ONE backend: a named
   'tensor'  tensor (model) parallelism                (none — net new)
   'seq'     sequence/context parallelism              (none — net new, ring attention)
   'expert'  expert parallelism for MoE                (none — net new)
+  'pipe'    pipeline (stage) parallelism              (none — net new, GPipe schedule)
 
 Collectives ride ICI within a slice, DCN across slices; XLA inserts them from
 sharding annotations (GSPMD), we only name axes and place constraints.
@@ -31,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["MeshConfig", "MeshContext", "create_mesh", "batch_sharding", "replicated",
            "logical_axis_rules", "shard_params", "shard_inference_params", "P"]
 
-AXES = ("data", "fsdp", "tensor", "seq", "expert")
+AXES = ("data", "fsdp", "tensor", "seq", "expert", "pipe")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +44,7 @@ class MeshConfig:
     tensor: int = 1
     seq: int = 1
     expert: int = 1
+    pipe: int = 1
 
     def resolve(self, n_devices: int) -> dict[str, int]:
         sizes = dataclasses.asdict(self)
@@ -155,6 +157,7 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
     ("vocab", "tensor"),
     ("seq", "seq"),
     ("expert", "expert"),
+    ("pipe", "pipe"),
 )
 
 

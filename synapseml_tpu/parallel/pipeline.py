@@ -1,0 +1,145 @@
+"""Pipeline (stage) parallelism over the ``pipe`` mesh axis — GPipe schedule.
+
+Net-new capability (the classical-Spark reference has no model parallelism at
+all — SURVEY §2.7); completes the mesh-axis family so every parallelism
+(dp/fsdp/tp/sp/ep/pp) is an axis of ONE ``jax.sharding.Mesh``.
+
+Design (the standard SPMD pipelining recipe on TPU):
+
+* Stage s's parameters live only on pipe-coordinate s: the stacked param
+  pytree has a leading ``[n_stages, ...]`` axis sharded over ``pipe``, so
+  per-device memory is one stage's weights.
+* The microbatch stream flows through a rotating buffer: at schedule tick t,
+  stage 0 ingests microbatch t (while t < n_micro), every stage applies its
+  layer to whatever it holds, and activations ``ppermute`` one hop down the
+  ring (ICI neighbor exchange — the same collective ring attention uses).
+* After ``n_stages - 1 + n_micro`` ticks every microbatch has crossed all
+  stages; outputs are collected on the LAST stage and psum-broadcast back
+  (tiny tensors in the estimator use cases; callers that want them sharded
+  can keep the last-stage copy).
+* The whole schedule is a ``lax.scan`` over ticks — compile size independent
+  of both ring length and microbatch count, and differentiable by autodiff
+  (ppermute's transpose is the reverse permute; the scan transposes to the
+  reverse-time scan — 1F1B-style memory comes from ``jax.checkpoint`` on the
+  stage fn if needed).
+
+The bubble fraction is the textbook (S-1)/(S-1+M): callers pick
+``n_micro >> n_stages`` to amortize.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["pipeline_apply", "pipeline_sharded", "stack_stage_params"]
+
+
+def _pvary(x, axis_name):
+    """Mark x as varying over axis_name (vma typing); tolerate jax versions
+    where the API is pcast / pvary / absent."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_name)
+    return x
+
+
+def stack_stage_params(stage_params_list):
+    """[params_stage0, ...] -> one pytree with a leading stage axis (shard it
+    over ``pipe``)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params_list)
+
+
+def pipeline_apply(stage_fn, stacked_params, x_micro, axis_name: str = "pipe"):
+    """Run ``n_micro`` microbatches through ``n_stages`` chained stages.
+
+    Call INSIDE ``shard_map`` (or via :func:`pipeline_sharded`). Per-device
+    arguments:
+
+      stage_fn:       ``(params, x) -> y`` — one stage's computation; y must
+                      have x's shape/dtype (chainable stages).
+      stacked_params: THIS device's stage params (leading stage axis already
+                      consumed by sharding: ``[1, ...]`` per leaf).
+      x_micro:        ``[n_micro, mb, ...]`` microbatches (stage 0 reads
+                      them; other devices pass zeros of the same shape).
+
+    Returns ``[n_micro, mb, ...]`` outputs, valid on every device (psum off
+    the last stage).
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    shard = jax.tree.leaves(stacked_params)[0].shape[0]
+    if shard != 1:
+        raise ValueError(
+            f"pipeline_apply: stage count must equal the {axis_name!r} axis "
+            f"size ({n_stages}); this device holds {shard} stages — only the "
+            f"first would run (wrong results, not an error, if allowed)")
+    my_params = jax.tree.map(lambda p: p[0], stacked_params)
+    n_micro = x_micro.shape[0]
+    n_ticks = n_stages - 1 + n_micro
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outs = carry
+        # stage 0 ingests microbatch t; everyone else keeps the rotated state
+        feed = jnp.where(t < n_micro, x_micro[jnp.minimum(t, n_micro - 1)],
+                         jnp.zeros_like(state))
+        inp = jnp.where(idx == 0, feed, state)
+        y = stage_fn(my_params, inp)
+        # the LAST stage finished microbatch t - (n_stages - 1) at this tick
+        m = t - (n_stages - 1)
+        take = (idx == n_stages - 1) & (m >= 0)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(take, y, outs[jnp.maximum(m, 0)]),
+            jnp.maximum(m, 0), axis=0)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, outs), None
+
+    # the carry becomes pipe-VARYING inside the loop (ppermute/idx-dependent
+    # writes); the init must carry the same varying-axes type or scan rejects
+    # the carry under shard_map's vma checking
+    state0 = _pvary(jnp.zeros_like(x_micro[0]), axis_name)
+    outs0 = _pvary(jnp.zeros_like(x_micro), axis_name)
+    (_, outs), _ = jax.lax.scan(tick, (state0, outs0),
+                                jnp.arange(n_ticks, dtype=jnp.int32))
+    # only the last stage holds real outputs; zero elsewhere -> psum = bcast
+    outs = jnp.where(idx == n_stages - 1, outs, jnp.zeros_like(outs))
+    return jax.lax.psum(outs, axis_name)
+
+
+def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
+                     axis_name: str = "pipe"):
+    """Full-array entry point: shard_map :func:`pipeline_apply` over the
+    mesh's ``pipe`` axis (params stage-sharded, microbatches replicated).
+    Falls back to a sequential stage chain when the axis is absent/size-1."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = getattr(mesh_ctx, "mesh", mesh_ctx)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+    pipe_size = sizes.get(axis_name, 1)
+    if pipe_size > 1 and n_stages != pipe_size:
+        raise ValueError(
+            f"pipeline_sharded: {n_stages} stages cannot shard over a "
+            f"{axis_name!r} axis of size {pipe_size} (one stage per device)")
+    if pipe_size <= 1:
+        def seq_apply(params_all, xs):
+            n_stages = jax.tree.leaves(params_all)[0].shape[0]
+            y = xs
+            for s in range(n_stages):
+                y = jax.vmap(lambda x: stage_fn(
+                    jax.tree.map(lambda p: p[s], params_all), x))(y)
+            return y
+        return seq_apply(stacked_params, x_micro)
+
+    fn = functools.partial(pipeline_apply, stage_fn, axis_name=axis_name)
+    mapped = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis_name), stacked_params),
+                  P()),
+        out_specs=P(),
+    )
+    return mapped(stacked_params, x_micro)

@@ -259,10 +259,10 @@ def assert_model_status(model_name: str, client: FabricClient | None = None) -> 
     (the reference: "likely running in the system context of Fabric")."""
     c = client or FabricClient()
     try:
-        resp = c.usage_post(c.ml_workload_endpoint("ML")
-                            + "cognitive/openai/tenantsetting",
-                            json.dumps([model_name]))
-        status = resp.json().get(model_name.lower())
+        resp = c.usage_post(c.openai_endpoint + "tenantsetting", [model_name])
+        body = resp.json()
+        # the service keys by lowercase; tolerate verbatim-keyed responses
+        status = body.get(model_name.lower(), body.get(model_name))
     except Exception:  # noqa: BLE001 — status check is advisory off-tenant
         return
     messages = {

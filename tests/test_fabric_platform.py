@@ -209,6 +209,9 @@ def test_assert_model_status(tmp_path):
     assert_model_status("gpt-4o-mini", client_with("Allowed"))  # no raise
     with pytest.raises(RuntimeError, match="Disallowed"):
         assert_model_status("gpt-4o-mini", client_with("Disallowed"))
+    with pytest.raises(RuntimeError, match="Disallowed"):
+        # service keys lowercase; a mixed-case request must still match
+        assert_model_status("GPT-4o-Mini", client_with("Disallowed"))
     with pytest.raises(RuntimeError, match="not found"):
         assert_model_status("gpt-4o-mini", client_with("ModelNotFound"))
     # transport failure: advisory no-op (system-context Fabric)

@@ -24,14 +24,15 @@ def test_eight_devices_present():
 
 def test_mesh_config_resolution():
     assert MeshConfig(data=-1, tensor=2).resolve(8) == {
-        "data": 4, "fsdp": 1, "tensor": 2, "seq": 1, "expert": 1}
+        "data": 4, "fsdp": 1, "tensor": 2, "seq": 1, "expert": 1, "pipe": 1}
     with pytest.raises(ValueError):
         MeshConfig(data=3, tensor=3).resolve(8)
 
 
 def test_mesh_creation_and_sharding(mesh8):
     assert mesh8.n_devices == 8
-    assert mesh8.axis_sizes == {"data": 2, "fsdp": 2, "tensor": 2, "seq": 1, "expert": 1}
+    assert mesh8.axis_sizes == {"data": 2, "fsdp": 2, "tensor": 2, "seq": 1,
+                                "expert": 1, "pipe": 1}
     x = np.arange(32, dtype=np.float32).reshape(8, 4)
     placed = mesh8.shard_batch({"x": x})
     assert placed["x"].sharding.is_equivalent_to(mesh8.batch_sharding(), 2)
