@@ -63,6 +63,13 @@ class TransformerConfig:
     # true long-context over the mesh.
     attn_impl: str = "einsum"
     seq_axis: str = "seq"
+    # mixture-of-experts MLP (switch-transformer routing): 0 = dense MLP.
+    # Expert weights carry the 'expert' logical axis, so on a mesh with an
+    # expert axis the per-expert matmuls shard and GSPMD inserts the token
+    # all-to-alls from the dispatch einsums (expert parallelism).
+    moe_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -290,6 +297,108 @@ class MlpBlock(nn.Module):
         return dense("down", cfg.hidden, "mlp", "embed")(h)
 
 
+class MoEBlock(nn.Module):
+    """Switch-transformer MoE MLP: top-k routing, capacity-bucketed einsum
+    dispatch, per-expert MLPs with the ``expert`` logical axis.
+
+    Net-new vs the reference (no model parallelism there); the TPU-native
+    shape of MoE: dispatch/combine are one-hot einsums (MXU work, static
+    shapes), expert weights ``[E, ...]`` shard over the mesh ``expert`` axis
+    and GSPMD derives the token all-to-alls from the einsum shardings.
+    Tokens overflowing an expert's capacity are dropped (switch behavior —
+    the residual connection in :class:`Block` carries them through).
+    The load-balancing auxiliary loss is sown under
+    ``intermediates/moe_aux_loss`` (mean over layers = the switch aux term).
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        E, k = cfg.moe_experts, cfg.moe_top_k
+        B, T, H = x.shape
+        S = B * T
+        xf = x.reshape(S, H)
+
+        router = nn.Dense(
+            E, dtype=jnp.float32, param_dtype=cfg.param_dtype, use_bias=False,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), ("embed", None)),
+            name="router")
+        logits = router(xf.astype(jnp.float32))           # [S, E] f32
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # capacity per expert, lane-friendly and >= 1
+        C = max(int(np.ceil(cfg.moe_capacity_factor * S * k / E)), 1)
+
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)      # [S, k]
+        if k > 1:
+            gate_vals = gate_vals / jnp.maximum(
+                jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+        dispatch = jnp.zeros((S, E, C), cfg.dtype)
+        combine = jnp.zeros((S, E, C), jnp.float32)
+        position_fill = jnp.zeros((E,), jnp.int32)
+        for choice in range(k):
+            e_oh = jax.nn.one_hot(gate_idx[:, choice], E, dtype=jnp.int32)
+            # position of each token within its chosen expert's buffer,
+            # continuing after slots used by earlier choices
+            pos = jnp.cumsum(e_oh, axis=0) - e_oh + position_fill[None, :]
+            pos_tok = jnp.sum(pos * e_oh, axis=1)          # [S]
+            keep = pos_tok < C
+            slot = jax.nn.one_hot(pos_tok, C, dtype=cfg.dtype) \
+                * keep[:, None].astype(cfg.dtype)          # [S, C]
+            d = e_oh.astype(cfg.dtype)[:, :, None] * slot[:, None, :]
+            dispatch = dispatch + d
+            combine = combine + d.astype(jnp.float32) \
+                * gate_vals[:, choice][:, None, None]
+            position_fill = position_fill + jnp.sum(e_oh, axis=0)
+
+        expert_in = jnp.einsum("sec,sh->ech", dispatch, xf,
+                               preferred_element_type=cfg.dtype)
+        expert_in = nn.with_logical_constraint(expert_in,
+                                               ("expert", None, "embed"))
+
+        def w(name, shape, axes):
+            return self.param(name, nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), axes), shape,
+                cfg.param_dtype)
+
+        w_up = w("w_up", (E, H, cfg.mlp_dim), ("expert", "embed", "mlp"))
+        b_up = self.param("b_up", nn.with_logical_partitioning(
+            nn.initializers.zeros, ("expert", "mlp")), (E, cfg.mlp_dim),
+            cfg.param_dtype)
+        w_dn = w("w_dn", (E, cfg.mlp_dim, H), ("expert", "mlp", "embed"))
+        b_dn = self.param("b_dn", nn.with_logical_partitioning(
+            nn.initializers.zeros, ("expert", "embed")), (E, H),
+            cfg.param_dtype)
+
+        act = _act_fn(cfg.act)
+        h = act(jnp.einsum("ech,ehm->ecm", expert_in, w_up.astype(cfg.dtype),
+                           preferred_element_type=jnp.float32).astype(cfg.dtype)
+                + b_up[:, None, :].astype(cfg.dtype))
+        h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
+        if cfg.dropout > 0:  # same placement as MlpBlock's hidden dropout
+            h = nn.Dropout(cfg.dropout,
+                           deterministic=not self.has_rng("dropout"))(h)
+        out_e = jnp.einsum("ecm,emh->ech", h, w_dn.astype(cfg.dtype),
+                           preferred_element_type=jnp.float32).astype(cfg.dtype) \
+            + b_dn[:, None, :].astype(cfg.dtype)
+
+        y = jnp.einsum("sec,ech->sh", combine.astype(jnp.float32),
+                       out_e.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+
+        # switch load-balance aux loss: E * sum_e f_e * P_e
+        frac_tokens = jnp.mean(
+            jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32), axis=0)
+        frac_probs = jnp.mean(probs, axis=0)
+        self.sow("intermediates", "moe_aux_loss",
+                 E * jnp.sum(frac_tokens * frac_probs))
+        return y.reshape(B, T, H).astype(cfg.dtype)
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
     decode: bool = False
@@ -297,18 +406,19 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None, positions=None):
         cfg = self.cfg
+        mlp_cls = MoEBlock if cfg.moe_experts > 0 else MlpBlock
         if cfg.norm_position == "post":
             # original-BERT residual structure: add then norm
             h = Attention(cfg, decode=self.decode, name="attn")(x, mask, positions)
             x = _norm(cfg)(x + h)
-            h = MlpBlock(cfg, name="mlp")(x)
+            h = mlp_cls(cfg, name="mlp")(x)
             x = _norm(cfg)(x + h)
         else:
             h = _norm(cfg)(x)
             h = Attention(cfg, decode=self.decode, name="attn")(h, mask, positions)
             x = x + h
             h = _norm(cfg)(x)
-            h = MlpBlock(cfg, name="mlp")(h)
+            h = mlp_cls(cfg, name="mlp")(h)
             x = x + h
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 

@@ -57,6 +57,10 @@ class TrainerConfig:
     lr_schedule: str = "constant"  # constant | cosine | linear
     b1: float = 0.9
     b2: float = 0.999
+    # weight on the switch-MoE load-balance aux loss (sown by MoEBlock as
+    # intermediates/moe_aux_loss); only consulted when the module's config
+    # has moe_experts > 0
+    moe_aux_weight: float = 0.01
 
 
 def _graft_params(boxed, values):
@@ -261,18 +265,38 @@ class Trainer:
         drop = {"labels", "label", "mask", "_valid"}
         return {k: v for k, v in batch.items() if k not in drop}
 
+    @property
+    def _has_moe(self) -> bool:
+        return getattr(getattr(self.module, "cfg", None), "moe_experts", 0) > 0
+
     def default_loss(self, variables, batch, train: bool):
         kwargs = dict(self._model_inputs(batch))
         mutable = []
         if self.has_batch_stats:
             kwargs["train"] = train
             mutable = ["batch_stats"] if train else []
+        if train and self._has_moe:
+            # collect the sown switch load-balance terms — without this the
+            # router trains with zero balancing pressure and can collapse
+            # every token onto one expert
+            mutable = list(mutable) + ["intermediates"]
         if mutable:
             logits, new_vars = self.module.apply(variables, mutable=mutable, **kwargs)
         else:
             logits, new_vars = self.module.apply(variables, **kwargs), {}
         labels = batch.get("labels", batch.get("label"))
         loss = cross_entropy_loss(logits, labels, batch.get("_valid"))
+        inter = new_vars.get("intermediates") if isinstance(new_vars, dict) else None
+        if inter:
+            aux_terms = [jnp.mean(jnp.asarray(v)) for path, v
+                         in jax.tree_util.tree_flatten_with_path(inter)[0]
+                         if any("moe_aux_loss" in str(getattr(k, "key", k))
+                                for k in path)]
+            if aux_terms:
+                loss = loss + self.cfg.moe_aux_weight * (
+                    sum(aux_terms) / len(aux_terms))
+            new_vars = {k: v for k, v in new_vars.items()
+                        if k != "intermediates"}
         return loss, (logits, new_vars)
 
     # ---- the jitted step ----
