@@ -1,0 +1,118 @@
+# %% [markdown]
+# # Walkthrough: every parallelism is a mesh axis
+#
+# The reference ships three per-engine communication stacks (LightGBM's
+# socket ring, VW's spanning tree, horovod's ring-allreduce) and has no
+# model parallelism at all. The TPU rebuild expresses EVERY parallelism as
+# an axis of ONE `jax.sharding.Mesh`:
+#
+# | axis     | strategy                          | collective underneath |
+# |----------|-----------------------------------|-----------------------|
+# | `data`   | data parallelism                  | psum (gradients)      |
+# | `fsdp`   | parameter sharding inside DP      | all-gather/reduce-scatter |
+# | `tensor` | tensor (model) parallelism        | all-reduce per layer  |
+# | `seq`    | sequence/context parallelism      | ppermute ring / all-to-all |
+# | `expert` | mixture-of-experts dispatch       | all-to-all (GSPMD-derived) |
+# | `pipe`   | pipeline (stage) parallelism      | ppermute hop per tick |
+#
+# This walkthrough drives each one on a virtual 8-device CPU mesh — the
+# exact code runs unchanged on a TPU pod slice.
+
+# %%  Setup: an 8-device mesh world
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from synapseml_tpu.parallel import MeshConfig, create_mesh
+
+print("devices:", jax.device_count())
+
+# %% [markdown]
+# ## 1. Data + FSDP + tensor + sequence parallelism in one training step
+#
+# A composite mesh trains a BERT-tiny classifier with ring attention on the
+# `seq` axis; GSPMD inserts every collective from the sharding annotations.
+
+# %%
+from synapseml_tpu.models.flax_nets.bert import BertClassifier, bert_tiny
+from synapseml_tpu.models.trainer import Trainer, TrainerConfig
+
+mesh = create_mesh(MeshConfig(data=1, fsdp=2, tensor=2, seq=2))
+cfg = bert_tiny(n_layers=2, attn_impl="ring")
+trainer = Trainer(BertClassifier(cfg, num_classes=2), mesh,
+                  TrainerConfig(learning_rate=1e-3, total_steps=3))
+rng = np.random.default_rng(0)
+batch = {"input_ids": rng.integers(0, cfg.vocab_size, (16, 32)).astype(np.int32),
+         "attention_mask": np.ones((16, 32), np.int32),
+         "labels": rng.integers(0, 2, (16,)).astype(np.int32)}
+state = trainer.init_state(batch)
+for i in range(3):
+    state, metrics = trainer.train_step(state, batch)
+    print(f"composite-mesh step {i}: loss={float(metrics['loss']):.4f}")
+
+# %% [markdown]
+# ## 2. Expert parallelism: a switch-MoE encoder
+#
+# `moe_experts=2` swaps the dense MLP for a routed mixture; expert weights
+# carry the `expert` logical axis, so on this mesh each device group holds
+# one expert and tokens flow through GSPMD-derived all-to-alls. The router's
+# load-balance aux loss is folded into the objective by the Trainer.
+
+# %%
+mesh_ep = create_mesh(MeshConfig(data=-1, expert=2))
+cfg_moe = bert_tiny(n_layers=2, moe_experts=2, moe_top_k=2)
+trainer = Trainer(BertClassifier(cfg_moe, num_classes=2), mesh_ep,
+                  TrainerConfig(learning_rate=1e-3, total_steps=3))
+state = trainer.init_state(batch)
+for i in range(3):
+    state, metrics = trainer.train_step(state, batch)
+    print(f"expert-parallel step {i}: loss={float(metrics['loss']):.4f}")
+
+# %% [markdown]
+# ## 3. Pipeline parallelism: a GPipe schedule over the `pipe` axis
+#
+# Four MLP stages live on four devices; microbatch activations rotate one
+# hop per tick via `ppermute`. The schedule is one `lax.scan`, so compile
+# size is independent of both ring length and microbatch count — and it is
+# differentiable, so the same primitive trains.
+
+# %%
+from synapseml_tpu.parallel import pipeline_sharded, stack_stage_params
+
+mesh_pp = create_mesh(MeshConfig(data=2, pipe=4))
+d, n_micro, mb = 8, 4, 2
+stages = [{"w": jnp.asarray(rng.normal(size=(d, d)) * 0.4, jnp.float32),
+           "b": jnp.zeros((d,), jnp.float32)} for _ in range(4)]
+params = stack_stage_params(stages)
+x = jnp.asarray(rng.normal(size=(n_micro, mb, d)), jnp.float32)
+target = jnp.asarray(rng.normal(size=(n_micro, mb, d)), jnp.float32)
+
+
+def stage_fn(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+@jax.jit
+def pp_step(params):
+    def loss(p):
+        out = pipeline_sharded(mesh_pp, stage_fn, p, x)
+        return jnp.mean((out - target) ** 2)
+
+    l, g = jax.value_and_grad(loss)(params)
+    return jax.tree.map(lambda a, b: a - 0.5 * b, params, g), l
+
+
+for i in range(3):
+    params, l = pp_step(params)
+    print(f"pipeline step {i}: loss={float(l):.4f}")
+
+# %% [markdown]
+# ## 4. The point
+#
+# Six parallelisms, zero custom communication code: the mesh names the
+# topology, sharding annotations name the placement, and XLA compiles the
+# collectives (psum, all-gather, ppermute, all-to-all) onto ICI links. The
+# reference needed a separate native networking stack per engine to get
+# one of these (data parallelism).
+
+print("walkthrough complete")
