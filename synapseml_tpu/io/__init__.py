@@ -17,7 +17,8 @@ from .http import (
     send_with_retries,
 )
 from .serving import ServingServer, serve_pipeline
-from .files import read_binary_files, read_image_files
+from .files import (read_binary_files, read_csv, read_image_files,
+                    read_jsonl, write_csv, write_jsonl)
 from .powerbi import PowerBIWriter
 from .distributed_serving import serve_pipeline_distributed
 
@@ -25,5 +26,7 @@ __all__ = [
     "HTTPRequest", "HTTPResponse", "HTTPTransformer", "SimpleHTTPTransformer",
     "JSONInputParser", "JSONOutputParser", "CustomInputParser",
     "StringOutputParser", "AsyncHTTPClient", "send_with_retries",
-    "ServingServer", "serve_pipeline", "read_binary_files", "read_image_files", "PowerBIWriter", "serve_pipeline_distributed",
+    "ServingServer", "serve_pipeline", "read_binary_files", "read_image_files",
+    "read_csv", "write_csv", "read_jsonl", "write_jsonl",
+    "PowerBIWriter", "serve_pipeline_distributed",
 ]

@@ -18,7 +18,8 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 
-__all__ = ["read_binary_files", "read_image_files"]
+__all__ = ["read_binary_files", "read_image_files", "read_csv", "write_csv",
+           "read_jsonl", "write_jsonl"]
 
 _IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tif", ".tiff", ".webp")
 
@@ -91,3 +92,91 @@ def read_image_files(path: str, recursive: bool = True, num_partitions: int = 1,
                      "height": arr.shape[0], "width": arr.shape[1],
                      "channels": arr.shape[2]})
     return _partitioned(rows, num_partitions)
+
+
+# ---------------------------------------------------------------------------
+# tabular file formats (the Spark csv/json DataSource roles)
+# ---------------------------------------------------------------------------
+
+def read_csv(path: str, num_partitions: int | None = None, **pandas_kw) -> DataFrame:
+    """CSV file(s)/glob/directory -> DataFrame; one PARTITION PER FILE by
+    default (Spark's file-split model), or repartitioned to
+    ``num_partitions``. Parsing is pandas' C engine (in-container); kwargs
+    pass through (``dtype=``, ``usecols=``...)."""
+    import pandas as pd
+
+    paths = _resolve_paths(path, recursive=True, exts=None) \
+        if any(ch in path for ch in "*?") or os.path.isdir(path) else [path]
+    if not paths:
+        raise FileNotFoundError(f"no CSV files match {path!r}")
+    frames = [pd.read_csv(p, **pandas_kw) for p in paths]
+    parts = [DataFrame.from_pandas(f) for f in frames if len(f)]
+    if not parts:
+        return DataFrame.from_pandas(frames[0])
+    df = parts[0]
+    for other in parts[1:]:
+        df = df.union(other)
+    return df.repartition(num_partitions) if num_partitions else df
+
+
+def write_csv(df: DataFrame, path: str, partitioned: bool = False) -> list[str]:
+    """DataFrame -> CSV. ``partitioned=True`` writes ``part-NNNNN.csv`` files
+    under ``path`` (the Spark output-directory layout); otherwise one file."""
+    written = []
+    if partitioned:
+        os.makedirs(path, exist_ok=True)
+        for i, part in enumerate(df.partitions):
+            import pandas as pd
+
+            out = os.path.join(path, f"part-{i:05d}.csv")
+            pd.DataFrame({k: list(v) for k, v in part.items()}).to_csv(
+                out, index=False)
+            written.append(out)
+        return written
+    df.to_pandas().to_csv(path, index=False)
+    return [path]
+
+
+def read_jsonl(path: str, num_partitions: int | None = None) -> DataFrame:
+    """JSON-lines file(s)/glob -> DataFrame (one partition per file)."""
+    import json as _json
+
+    paths = _resolve_paths(path, recursive=True, exts=None) \
+        if any(ch in path for ch in "*?") or os.path.isdir(path) else [path]
+    if not paths:
+        raise FileNotFoundError(f"no JSONL files match {path!r}")
+    parts = []
+    for p in paths:
+        with open(p) as f:
+            rows = [_json.loads(line) for line in f if line.strip()]
+        if rows:
+            parts.append(DataFrame.from_rows(rows))
+    if not parts:
+        return DataFrame.from_rows([])
+    df = parts[0]
+    for other in parts[1:]:
+        df = df.union(other)
+    return df.repartition(num_partitions) if num_partitions else df
+
+
+def write_jsonl(df: DataFrame, path: str) -> str:
+    """DataFrame -> one JSON-lines file (numpy scalars/arrays to plain JSON)."""
+    import json as _json
+
+    def default(o):
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+        if isinstance(o, np.generic):
+            return o.item()
+        if isinstance(o, bytes):
+            return o.decode("utf-8", "replace")
+        raise TypeError(f"not JSON-serializable: {type(o)}")
+
+    with open(path, "w") as f:
+        for part in df.partitions:
+            cols = list(part.keys())
+            n = len(next(iter(part.values()))) if cols else 0
+            for i in range(n):
+                f.write(_json.dumps({c: part[c][i] for c in cols},
+                                    default=default) + "\n")
+    return path

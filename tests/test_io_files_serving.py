@@ -256,3 +256,44 @@ def test_keepalive_routes_and_routing_client():
         client.close()
     finally:
         handle.stop()
+
+
+def test_csv_round_trip(tmp_path):
+    from synapseml_tpu.io import read_csv, write_csv
+
+    df = DataFrame.from_dict({"a": np.arange(10).astype(np.int64),
+                              "b": np.linspace(0, 1, 10),
+                              "s": np.asarray([f"r{i}" for i in range(10)],
+                                              dtype=object)},
+                             num_partitions=3)
+    files = write_csv(df, str(tmp_path / "out"), partitioned=True)
+    assert len(files) == 3 and all(f.endswith(".csv") for f in files)
+    back = read_csv(str(tmp_path / "out"))
+    assert back.num_partitions == 3  # one partition per file (Spark model)
+    assert back.count() == 10
+    np.testing.assert_array_equal(np.sort(back.collect_column("a")),
+                                  np.arange(10))
+    # single-file form + repartition
+    one = write_csv(df, str(tmp_path / "single.csv"))
+    back1 = read_csv(one[0], num_partitions=2)
+    assert back1.count() == 10 and back1.num_partitions == 2
+
+
+def test_jsonl_round_trip(tmp_path):
+    from synapseml_tpu.io import read_jsonl, write_jsonl
+
+    df = DataFrame.from_rows(
+        [{"x": float(i), "name": f"n{i}", "v": np.asarray([i, i + 1])}
+         for i in range(6)], num_partitions=2)
+    path = write_jsonl(df, str(tmp_path / "rows.jsonl"))
+    back = read_jsonl(path)
+    assert back.count() == 6
+    assert list(back.collect_column("name")[:2]) == ["n0", "n1"]
+    assert list(back.collect_column("v")[0]) == [0, 1]
+
+
+def test_read_csv_missing_raises(tmp_path):
+    from synapseml_tpu.io import read_csv
+
+    with pytest.raises(FileNotFoundError):
+        read_csv(str(tmp_path / "*.csv"))
