@@ -98,36 +98,50 @@ def read_image_files(path: str, recursive: bool = True, num_partitions: int = 1,
 # tabular file formats (the Spark csv/json DataSource roles)
 # ---------------------------------------------------------------------------
 
-def read_csv(path: str, num_partitions: int | None = None, **pandas_kw) -> DataFrame:
-    """CSV file(s)/glob/directory -> DataFrame; one PARTITION PER FILE by
-    default (Spark's file-split model), or repartitioned to
-    ``num_partitions``. Parsing is pandas' C engine (in-container); kwargs
-    pass through (``dtype=``, ``usecols=``...)."""
-    import pandas as pd
-
-    paths = _resolve_paths(path, recursive=True, exts=None) \
-        if any(ch in path for ch in "*?") or os.path.isdir(path) else [path]
+def _read_tabular(path: str, what: str, loader, num_partitions: int | None) -> DataFrame:
+    """Shared glob-or-literal resolution + one-DataFrame-partition-per-file
+    union fold for the tabular readers."""
+    is_glob = any(ch in path for ch in "*?[")
+    paths = (_resolve_paths(path, recursive=True, exts=None)
+             if is_glob or os.path.isdir(path) else [path])
     if not paths:
-        raise FileNotFoundError(f"no CSV files match {path!r}")
-    frames = [pd.read_csv(p, **pandas_kw) for p in paths]
-    parts = [DataFrame.from_pandas(f) for f in frames if len(f)]
+        raise FileNotFoundError(f"no {what} files match {path!r}")
+    parts = [p for p in (loader(f) for f in paths) if p is not None]
     if not parts:
-        return DataFrame.from_pandas(frames[0])
+        return DataFrame.from_rows([])
     df = parts[0]
     for other in parts[1:]:
         df = df.union(other)
     return df.repartition(num_partitions) if num_partitions else df
 
 
+def read_csv(path: str, num_partitions: int | None = None, **pandas_kw) -> DataFrame:
+    """CSV file(s)/glob/directory -> DataFrame; one PARTITION PER FILE
+    (Spark's file-split model — header-only files stay as empty partitions
+    so the file<->partition mapping holds), or repartitioned to
+    ``num_partitions``. Parsing is pandas' C engine (in-container); kwargs
+    pass through (``dtype=``, ``usecols=``...)."""
+    import pandas as pd
+
+    return _read_tabular(path, "CSV",
+                         lambda p: DataFrame.from_pandas(
+                             pd.read_csv(p, **pandas_kw)),
+                         num_partitions)
+
+
 def write_csv(df: DataFrame, path: str, partitioned: bool = False) -> list[str]:
     """DataFrame -> CSV. ``partitioned=True`` writes ``part-NNNNN.csv`` files
-    under ``path`` (the Spark output-directory layout); otherwise one file."""
+    under ``path`` (the Spark output-directory layout; stale part files from
+    a previous wider write are removed — they would silently merge into the
+    next read); otherwise one file."""
+    import pandas as pd
+
     written = []
     if partitioned:
         os.makedirs(path, exist_ok=True)
+        for stale in _glob.glob(os.path.join(path, "part-*.csv")):
+            os.remove(stale)
         for i, part in enumerate(df.partitions):
-            import pandas as pd
-
             out = os.path.join(path, f"part-{i:05d}.csv")
             pd.DataFrame({k: list(v) for k, v in part.items()}).to_csv(
                 out, index=False)
@@ -138,25 +152,24 @@ def write_csv(df: DataFrame, path: str, partitioned: bool = False) -> list[str]:
 
 
 def read_jsonl(path: str, num_partitions: int | None = None) -> DataFrame:
-    """JSON-lines file(s)/glob -> DataFrame (one partition per file)."""
+    """JSON-lines file(s)/glob -> DataFrame (one partition per file).
+
+    Heterogeneous records are unioned over ALL keys seen in the file
+    (missing fields become None) — JSONL rows rarely share an exact schema.
+    """
     import json as _json
 
-    paths = _resolve_paths(path, recursive=True, exts=None) \
-        if any(ch in path for ch in "*?") or os.path.isdir(path) else [path]
-    if not paths:
-        raise FileNotFoundError(f"no JSONL files match {path!r}")
-    parts = []
-    for p in paths:
+    def load(p):
         with open(p) as f:
             rows = [_json.loads(line) for line in f if line.strip()]
-        if rows:
-            parts.append(DataFrame.from_rows(rows))
-    if not parts:
-        return DataFrame.from_rows([])
-    df = parts[0]
-    for other in parts[1:]:
-        df = df.union(other)
-    return df.repartition(num_partitions) if num_partitions else df
+        if not rows:
+            return None
+        keys: list = []
+        for r in rows:
+            keys += [k for k in r if k not in keys]
+        return DataFrame.from_rows([{k: r.get(k) for k in keys} for r in rows])
+
+    return _read_tabular(path, "JSONL", load, num_partitions)
 
 
 def write_jsonl(df: DataFrame, path: str) -> str:

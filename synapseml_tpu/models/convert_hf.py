@@ -369,6 +369,14 @@ def llama_config_from_hf(config: dict, **overrides) -> Any:
         norm_eps=config.get("rms_norm_eps", 1e-5),
         rope_theta=config.get("rope_theta", 10000.0),
     )
+    if config.get("num_local_experts"):  # Mixtral-family sparse-MoE decoder
+        E = int(config["num_local_experts"])
+        k = int(config.get("num_experts_per_tok", 2))
+        kw.update(moe_experts=E, moe_top_k=k,
+                  # HF routing is DROPLESS: per-token expert choices are
+                  # distinct, so one expert receives at most S tokens —
+                  # capacity C = cf*S*k/E with cf = E/k gives exactly C = S
+                  moe_capacity_factor=float(E) / k)
     kw.update(overrides)
     return llama2_7b(**kw)
 
@@ -402,16 +410,37 @@ def llama_params_from_hf(sd: dict[str, np.ndarray],
                 "o": _oproj(body, f"{p}.self_attn.o_proj", n_heads, head_dim),
             },
             "RMSNorm_1": {"scale": body[f"{p}.post_attention_layernorm.weight"]},
-            "mlp": {
+        }
+        moe_gate = f"{p}.block_sparse_moe.gate.weight"
+        if moe_gate in body:
+            # Mixtral sparse-MoE block: router gate [E, H]; per-expert
+            # w1 (SwiGLU gate), w3 (up), w2 (down), all bias-free
+            E = sum(1 for k in body
+                    if k.startswith(f"{p}.block_sparse_moe.experts.")
+                    and k.endswith(".w1.weight"))
+            ex = f"{p}.block_sparse_moe.experts"
+            w_gate = np.stack([np.ascontiguousarray(
+                body[f"{ex}.{e}.w1.weight"].T) for e in range(E)])
+            w_up = np.stack([np.ascontiguousarray(
+                body[f"{ex}.{e}.w3.weight"].T) for e in range(E)])
+            w_dn = np.stack([np.ascontiguousarray(
+                body[f"{ex}.{e}.w2.weight"].T) for e in range(E)])
+            decoder[f"layer_{i}"]["mlp"] = {
+                "router": {"kernel": np.ascontiguousarray(body[moe_gate].T)},
+                "w_gate": w_gate, "w_up": w_up, "w_dn": w_dn,
+                "b_up": _zero_bias(w_up.shape[::2], w_up.dtype),
+                "b_dn": _zero_bias(w_dn.shape[::2], w_dn.dtype),
+            }
+        else:
+            decoder[f"layer_{i}"]["mlp"] = {
                 "gate": _dense(body, f"{p}.mlp.gate_proj"),
                 "up": _dense(body, f"{p}.mlp.up_proj"),
                 "down": _dense(body, f"{p}.mlp.down_proj"),
-            },
-        }
-        for proj in ("gate", "up", "down"):
-            d = decoder[f"layer_{i}"]["mlp"][proj]
-            if "bias" not in d:
-                d["bias"] = _zero_bias((d["kernel"].shape[1],), d["kernel"].dtype)
+            }
+            for proj in ("gate", "up", "down"):
+                d = decoder[f"layer_{i}"]["mlp"][proj]
+                if "bias" not in d:
+                    d["bias"] = _zero_bias((d["kernel"].shape[1],), d["kernel"].dtype)
     decoder["RMSNorm_0"] = {"scale": body["norm.weight"]}
 
     lm_head = (np.ascontiguousarray(sd["lm_head.weight"].T)

@@ -334,6 +334,10 @@ class MoEBlock(nn.Module):
 
         gate_vals, gate_idx = jax.lax.top_k(probs, k)      # [S, k]
         if k > 1:
+            # renormalize over the selected experts — identical to Mixtral's
+            # softmax-then-topk-then-divide. k=1 keeps the RAW router
+            # probability (switch-transformer semantics: the gate carries the
+            # router gradient); Mixtral never ships k=1 configs.
             gate_vals = gate_vals / jnp.maximum(
                 jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
 
@@ -375,9 +379,17 @@ class MoEBlock(nn.Module):
             cfg.param_dtype)
 
         act = _act_fn(cfg.act)
-        h = act(jnp.einsum("ech,ehm->ecm", expert_in, w_up.astype(cfg.dtype),
-                           preferred_element_type=jnp.float32).astype(cfg.dtype)
-                + b_up[:, None, :].astype(cfg.dtype))
+        up = jnp.einsum("ech,ehm->ecm", expert_in, w_up.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32).astype(cfg.dtype) \
+            + b_up[:, None, :].astype(cfg.dtype)
+        if cfg.gated_mlp:
+            # SwiGLU experts (the Mixtral block): act(x W_gate) * (x W_up)
+            w_g = w("w_gate", (E, H, cfg.mlp_dim), ("expert", "embed", "mlp"))
+            gate = jnp.einsum("ech,ehm->ecm", expert_in, w_g.astype(cfg.dtype),
+                              preferred_element_type=jnp.float32).astype(cfg.dtype)
+            h = act(gate) * up
+        else:
+            h = act(up)
         h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
         if cfg.dropout > 0:  # same placement as MlpBlock's hidden dropout
             h = nn.Dropout(cfg.dropout,

@@ -141,6 +141,35 @@ def test_llama_parity_gqa(tmp_path):
     np.testing.assert_allclose(got, want, atol=5e-4)
 
 
+def test_mixtral_parity_sparse_moe(tmp_path):
+    """Mixtral-family ingestion: SwiGLU experts + top-2 routing converted
+    from a (tiny, random) HF MixtralForCausalLM, logits vs torch."""
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(5)
+    tcfg = MixtralConfig(vocab_size=97, hidden_size=32, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         intermediate_size=48, max_position_embeddings=64,
+                         num_local_experts=4, num_experts_per_tok=2,
+                         rms_norm_eps=1e-5, attention_dropout=0.0,
+                         sliding_window=None, output_router_logits=False)
+    tmodel = MixtralForCausalLM(tcfg)
+    d = _save(tmodel, tmp_path, tcfg)
+
+    from synapseml_tpu.models.flax_nets.llama import LlamaLM
+
+    cfg, params = C.pretrained_causal_lm(d, dtype=jnp.float32)
+    assert cfg.moe_experts == 4 and cfg.moe_top_k == 2 and cfg.gated_mlp
+    assert cfg.moe_capacity_factor >= 4.0 / 2  # dropless: C = S exactly
+    module = LlamaLM(cfg)
+
+    ids = np.random.default_rng(6).integers(0, 97, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = tmodel(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(module.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
 def test_resnet_parity_hf(tmp_path):
     from transformers import ResNetConfig, ResNetForImageClassification
 

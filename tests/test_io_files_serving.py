@@ -297,3 +297,41 @@ def test_read_csv_missing_raises(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         read_csv(str(tmp_path / "*.csv"))
+
+
+def test_write_csv_removes_stale_parts(tmp_path):
+    from synapseml_tpu.io import read_csv, write_csv
+
+    wide = DataFrame.from_dict({"a": np.arange(10)}, num_partitions=5)
+    narrow = DataFrame.from_dict({"a": np.arange(4)}, num_partitions=2)
+    out = str(tmp_path / "dir")
+    write_csv(wide, out, partitioned=True)
+    write_csv(narrow, out, partitioned=True)  # must clear part-00002..4
+    back = read_csv(out)
+    assert back.count() == 4 and back.num_partitions == 2
+
+
+def test_read_csv_bracket_glob_and_empty_file(tmp_path):
+    import pandas as pd
+
+    from synapseml_tpu.io import read_csv
+
+    pd.DataFrame({"a": [1, 2]}).to_csv(tmp_path / "part-0.csv", index=False)
+    pd.DataFrame({"a": [3]}).to_csv(tmp_path / "part-1.csv", index=False)
+    df = read_csv(str(tmp_path / "part-[01].csv"))
+    assert df.count() == 3
+    # header-only file keeps its (empty) partition: file<->partition mapping
+    pd.DataFrame({"a": []}).to_csv(tmp_path / "part-2.csv", index=False)
+    df3 = read_csv(str(tmp_path / "part-[012].csv"))
+    assert df3.num_partitions == 3 and df3.count() == 3
+
+
+def test_read_jsonl_heterogeneous_records(tmp_path):
+    from synapseml_tpu.io import read_jsonl
+
+    p = tmp_path / "h.jsonl"
+    p.write_text('{"a": 1}\n{"a": 2, "b": "x"}\n{"b": "y"}\n')
+    df = read_jsonl(str(p))
+    assert sorted(df.columns) == ["a", "b"]
+    assert list(df.collect_column("a")) == [1, 2, None]
+    assert list(df.collect_column("b")) == [None, "x", "y"]
