@@ -1541,20 +1541,32 @@ def _exec_nodes(graph, env: dict) -> None:
 
 
 def _exec_if(node, ins, env: dict):
-    """ONNX If with a STATICALLY-resolved condition (the form torch's
-    exporter emits for shape guards — the cond is host/concrete at trace
-    time, so exactly one branch is traced, staying XLA-compatible). A
-    traced (data-dependent) condition is rejected explicitly."""
-    import jax.core
-
+    """ONNX If. A STATICALLY-resolved condition (the form torch's exporter
+    emits for shape guards — host/concrete at trace time) traces exactly one
+    branch. A traced (data-dependent) condition lowers to ``lax.cond`` when
+    both branches produce matching shapes/dtypes — XLA's conditional, both
+    branches compiled, one executed on-device; shape-divergent branches are
+    rejected with a clear message (a dynamic output shape cannot exist
+    under XLA)."""
     cond = ins[0]
-    if isinstance(cond, jax.core.Tracer):
-        raise NotImplementedError(
-            "ONNX If with a data-dependent condition cannot be lowered "
-            "statically; only shape-guard Ifs (torch export) are supported")
     attrs = {a.name: a.g for a in node.attribute}
-    branch = attrs["then_branch"] if bool(np.asarray(cond)) else attrs["else_branch"]
-    return tuple(_run_subgraph(branch, env, {}))
+    if not _is_traced(cond):
+        branch = (attrs["then_branch"] if bool(np.asarray(cond))
+                  else attrs["else_branch"])
+        return tuple(_run_subgraph(branch, env, {}))
+
+    def run(branch):
+        return lambda: tuple(jnp.asarray(o)
+                             for o in _run_subgraph(branch, env, {}))
+
+    try:
+        return jax.lax.cond(jnp.asarray(cond).ravel()[0].astype(bool),
+                            run(attrs["then_branch"]),
+                            run(attrs["else_branch"]))
+    except TypeError as e:
+        raise NotImplementedError(
+            "ONNX If with a data-dependent condition requires both branches "
+            f"to produce matching shapes/dtypes for lax.cond: {e}") from e
 
 
 def _run_subgraph(body, env: dict, bound: dict):

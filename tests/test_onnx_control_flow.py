@@ -365,6 +365,64 @@ def test_loop_jit_traced_cond0_with_scan_output_rejected():
             jnp.asarray(True), jnp.float32(0.0))
 
 
+def if_model():
+    """out = x*2 if mean(x) > 0 else x-1 — a DATA-dependent If."""
+    then_b = GraphProto(name="then",
+                        node=[node("Mul", ["x", "two"], ["y_then"])],
+                        initializer=[numpy_to_tensor(np.float32(2.0), "two")],
+                        output=[vi("y_then", [3])])
+    else_b = GraphProto(name="else",
+                        node=[node("Sub", ["x", "one"], ["y_else"])],
+                        initializer=[numpy_to_tensor(np.float32(1.0), "one")],
+                        output=[vi("y_else", [3])])
+    g = GraphProto(
+        name="data_if",
+        node=[node("ReduceMean", ["x"], ["m"], keepdims=0),
+              node("Greater", ["m", "zero"], ["cond"]),
+              node("If", ["cond"], ["y"], then_branch=then_b,
+                   else_branch=else_b)],
+        initializer=[numpy_to_tensor(np.float32(0.0), "zero")],
+        input=[vi("x", [3])],
+        output=[vi("y", [3])],
+    )
+    return ConvertedModel(ModelProto(graph=g))
+
+
+def test_if_data_dependent_condition():
+    m = if_model()
+    pos = np.asarray([1.0, 2.0, 3.0], np.float32)
+    neg = np.asarray([-1.0, -2.0, -3.0], np.float32)
+    # eager: concrete cond, single branch
+    np.testing.assert_allclose(np.asarray(m(x=pos)["y"]), pos * 2)
+    np.testing.assert_allclose(np.asarray(m(x=neg)["y"]), neg - 1)
+    # jit: traced cond -> lax.cond, both branches compiled once
+    fn = jax.jit(lambda x: m(x=x)["y"])
+    np.testing.assert_allclose(np.asarray(fn(pos)), pos * 2)
+    np.testing.assert_allclose(np.asarray(fn(neg)), neg - 1)
+
+
+def test_if_shape_divergent_branches_rejected_under_jit():
+    then_b = GraphProto(name="then",
+                        node=[node("Identity", ["x"], ["a"])],
+                        output=[vi("a", [3])])
+    else_b = GraphProto(
+        name="else",
+        node=[node("Concat", ["x", "x"], ["b"], axis=0)],
+        output=[vi("b", [6])])
+    g = GraphProto(
+        name="divergent",
+        node=[node("ReduceMean", ["x"], ["m"], keepdims=0),
+              node("Greater", ["m", "zero"], ["cond"]),
+              node("If", ["cond"], ["y"], then_branch=then_b,
+                   else_branch=else_b)],
+        initializer=[numpy_to_tensor(np.float32(0.0), "zero")],
+        input=[vi("x", [3])], output=[vi("y", [None])],
+    )
+    m = ConvertedModel(ModelProto(graph=g))
+    with pytest.raises(NotImplementedError, match="matching shapes"):
+        jax.jit(lambda x: m(x=x)["y"])(jnp.ones(3, jnp.float32))
+
+
 def test_reduce_noop_with_empty_axes_omitted_input():
     # opset-18: axes omitted entirely + noop_with_empty_axes=1 => identity
     x = rs.normal(size=(2, 3)).astype(np.float32)
