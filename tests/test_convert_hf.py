@@ -169,6 +169,18 @@ def test_mixtral_parity_sparse_moe(tmp_path):
     got = np.asarray(module.apply({"params": params}, jnp.asarray(ids)))
     np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
 
+    # end-to-end greedy decode parity through the KV-cache path (MoE runs
+    # per decode step on a single-token slice)
+    from synapseml_tpu.models.flax_nets.llama import LlamaLM as LM, greedy_generate
+
+    with torch.no_grad():
+        twant = tmodel.generate(torch.tensor(ids[:1], dtype=torch.long),
+                                max_new_tokens=5, do_sample=False,
+                                num_beams=1).numpy()
+    ours = np.asarray(greedy_generate(LM(cfg, decode=True), params,
+                                      jnp.asarray(ids[:1]), 5))
+    np.testing.assert_array_equal(ours, twant)
+
 
 def test_resnet_parity_hf(tmp_path):
     from transformers import ResNetConfig, ResNetForImageClassification
