@@ -317,6 +317,34 @@ class DataFrame:
     def cache(self) -> "DataFrame":
         return self  # eager: everything already materialized
 
+    def group_by(self, *keys: str) -> "GroupedDataFrame":
+        """Group rows by key column(s); aggregate with ``.agg(...)``.
+
+        Host-side (collect + pandas groupby): the reference delegates this to
+        Spark's shuffle; here grouping is metadata-scale work — the TPU plane
+        carries the numeric compute, not the relational shuffle.
+        """
+        missing = [k for k in keys if k not in self.columns]
+        if missing:
+            raise KeyError(f"group_by keys {missing} not in {self.columns}")
+        return GroupedDataFrame(self, keys)
+
+    def join(self, other: "DataFrame", on: str | Sequence[str],
+             how: str = "inner") -> "DataFrame":
+        """Relational join on key column(s) (host-side pandas merge;
+        ``how``: inner | left | right | outer). Result is single-partition —
+        repartition() for parallel downstream stages."""
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"how must be inner|left|right|outer, got {how!r}")
+        keys = [on] if isinstance(on, str) else list(on)
+        for k in keys:
+            if k not in self.columns:
+                raise KeyError(f"join key {k!r} not in left columns {self.columns}")
+            if k not in other.columns:
+                raise KeyError(f"join key {k!r} not in right columns {other.columns}")
+        merged = self.to_pandas().merge(other.to_pandas(), on=keys, how=how)
+        return DataFrame.from_pandas(merged)
+
     # ---------------- materialization ----------------
     def collect(self) -> Partition:
         return concat_partitions(self._parts)
@@ -345,3 +373,35 @@ class DataFrame:
         for k, v in whole.items():
             flat[k] = list(v) if v.ndim > 1 else v
         return pd.DataFrame(flat)
+
+
+class GroupedDataFrame:
+    """Result of :meth:`DataFrame.group_by`; terminal ``agg``/``count``."""
+
+    _AGGS = ("sum", "mean", "min", "max", "count", "first", "std", "nunique")
+
+    def __init__(self, df: DataFrame, keys):
+        self._df = df
+        self._keys = list(keys)
+
+    def agg(self, spec: Mapping[str, str]) -> DataFrame:
+        """``{column: aggregation}`` -> one row per group. Aggregations:
+        sum | mean | min | max | count | first | std | nunique. Output
+        columns are named ``{col}_{agg}`` (Spark's default naming)."""
+        bad = {c: a for c, a in spec.items() if a not in self._AGGS}
+        if bad:
+            raise ValueError(f"unsupported aggregations {bad}; "
+                             f"choose from {self._AGGS}")
+        missing = [c for c in spec if c not in self._df.columns]
+        if missing:
+            raise KeyError(f"agg columns {missing} not in {self._df.columns}")
+        pdf = self._df.to_pandas()
+        out = pdf.groupby(self._keys, sort=True).agg(
+            **{f"{c}_{a}": (c, a) for c, a in spec.items()}).reset_index()
+        return DataFrame.from_pandas(out)
+
+    def count(self) -> DataFrame:
+        """Rows per group as a ``count`` column."""
+        pdf = self._df.to_pandas()
+        out = pdf.groupby(self._keys, sort=True).size().rename("count").reset_index()
+        return DataFrame.from_pandas(out)

@@ -76,3 +76,43 @@ def test_to_pandas_roundtrip():
     pdf = df.to_pandas()
     df2 = DataFrame.from_pandas(pdf)
     assert list(df2.collect_column("b")) == ["x", "y"]
+
+
+def test_group_by_agg():
+    df = DataFrame.from_dict(
+        {"k": np.asarray(["a", "b", "a", "b", "a"], dtype=object),
+         "v": np.asarray([1.0, 2.0, 3.0, 4.0, 5.0]),
+         "w": np.asarray([10, 20, 30, 40, 50])}, num_partitions=2)
+    out = df.group_by("k").agg({"v": "sum", "w": "max"})
+    assert sorted(out.columns) == ["k", "v_sum", "w_max"]
+    rows = {r["k"]: r for r in out.collect_rows()}
+    assert rows["a"]["v_sum"] == 9.0 and rows["a"]["w_max"] == 50
+    assert rows["b"]["v_sum"] == 6.0 and rows["b"]["w_max"] == 40
+    counts = {r["k"]: r["count"] for r in df.group_by("k").count().collect_rows()}
+    assert counts == {"a": 3, "b": 2}
+
+
+def test_group_by_validation():
+    df = DataFrame.from_dict({"k": np.arange(3), "v": np.arange(3)})
+    with pytest.raises(KeyError):
+        df.group_by("nope")
+    with pytest.raises(ValueError, match="unsupported"):
+        df.group_by("k").agg({"v": "median_of_medians"})
+
+
+def test_join_inner_and_left():
+    left = DataFrame.from_dict(
+        {"id": np.asarray([1, 2, 3]), "x": np.asarray([10.0, 20.0, 30.0])},
+        num_partitions=2)
+    right = DataFrame.from_dict(
+        {"id": np.asarray([2, 3, 4]), "y": np.asarray(["b", "c", "d"],
+                                                      dtype=object)})
+    inner = left.join(right, on="id")
+    assert inner.count() == 2
+    assert sorted(inner.collect_column("id").tolist()) == [2, 3]
+    outer = left.join(right, on="id", how="left")
+    assert outer.count() == 3  # id=1 kept with missing y
+    with pytest.raises(KeyError):
+        left.join(right, on="x")
+    with pytest.raises(ValueError, match="how"):
+        left.join(right, on="id", how="cross")
