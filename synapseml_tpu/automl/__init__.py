@@ -2,6 +2,7 @@
 hyperparameter search and best-model selection."""
 
 from .hyperparams import (  # noqa: F401
+    DefaultHyperparams,
     DiscreteHyperParam,
     GridSpace,
     HyperparamBuilder,

@@ -6,7 +6,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["DiscreteHyperParam", "RangeHyperParam", "HyperparamBuilder",
-           "GridSpace", "RandomSpace"]
+           "GridSpace", "RandomSpace", "DefaultHyperparams"]
 
 
 class DiscreteHyperParam:
@@ -76,3 +76,44 @@ class RandomSpace:
 
     def configs(self, n: int) -> list[dict]:
         return [{k: v.sample(self.rng) for k, v in self.space.items()} for _ in range(n)]
+
+
+class DefaultHyperparams:
+    """Good default sweep ranges per learner family (reference
+    ``automl/DefaultHyperparams.scala`` — publicly visible so users can pick
+    the ranges to sweep). Keyed by estimator CLASS or instance; ranges are
+    expressed against this framework's learners (GBDT and VW linear replace
+    SparkML's tree/LR families)."""
+
+    @staticmethod
+    def default_range(learner) -> dict:
+        name = learner if isinstance(learner, str) else type(learner).__name__
+        spaces = {
+            "LightGBMClassifier": {
+                "num_leaves": RangeHyperParam(8, 63),
+                "num_iterations": RangeHyperParam(20, 100),
+                "learning_rate": RangeHyperParam(0.01, 0.3, log=True),
+                "min_data_in_leaf": RangeHyperParam(5, 50),
+                "lambda_l2": RangeHyperParam(1e-3, 1.0, log=True),
+            },
+            "LightGBMRegressor": {
+                "num_leaves": RangeHyperParam(8, 63),
+                "num_iterations": RangeHyperParam(20, 100),
+                "learning_rate": RangeHyperParam(0.01, 0.3, log=True),
+                "lambda_l2": RangeHyperParam(1e-3, 1.0, log=True),
+            },
+            "VowpalWabbitClassifier": {
+                "learning_rate": RangeHyperParam(0.01, 1.0, log=True),
+                "num_passes": RangeHyperParam(1, 10),
+                "l2": RangeHyperParam(1e-8, 1e-2, log=True),
+            },
+            "VowpalWabbitRegressor": {
+                "learning_rate": RangeHyperParam(0.01, 1.0, log=True),
+                "num_passes": RangeHyperParam(1, 10),
+                "l2": RangeHyperParam(1e-8, 1e-2, log=True),
+            },
+        }
+        if name not in spaces:
+            raise ValueError(f"no default hyperparameter range for {name}; "
+                             f"have {sorted(spaces)}")
+        return spaces[name]

@@ -116,6 +116,24 @@ class ImageTransformer(WrapperBase):
         return self._get('to_tensor')
 
 
+class UnrollBinaryImage(WrapperBase):
+    """Decode ENCODED image bytes (png/jpeg) straight to the flat vector — (wraps ``synapseml_tpu.image.unroll.UnrollBinaryImage``)."""
+
+    _target = 'synapseml_tpu.image.unroll.UnrollBinaryImage'
+
+    def setInputCol(self, value):
+        return self._set('input_col', value)
+
+    def getInputCol(self):
+        return self._get('input_col')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+
 class UnrollImage(WrapperBase):
     """Base of every stage; persists via metadata.json + out-of-band complex params. (wraps ``synapseml_tpu.image.unroll.UnrollImage``)."""
 

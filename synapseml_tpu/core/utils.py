@@ -13,7 +13,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
-__all__ = ["StopWatch", "retry_with_timeout", "using", "ClusterInfo", "cluster_info"]
+__all__ = ["StopWatch", "retry_with_timeout", "using", "ClusterInfo", "cluster_info",
+           "ParamsStringBuilder"]
 
 
 class StopWatch:
@@ -121,3 +122,64 @@ def stack_vector_column(col, dtype="float32"):
             return np.zeros((0, 0), dtype)
         arr = np.stack([np.asarray(v) for v in arr])
     return arr.astype(dtype)
+
+
+class ParamsStringBuilder:
+    """Typed params -> one native-style argument string (reference
+    ``core/utils/ParamsStringBuilder.scala``: the builder behind LightGBM
+    param strings and VW ``passThroughArgs``).
+
+    Append-with-override semantics: the FIRST occurrence of a parameter wins
+    (raw ``append`` text is primary and never replaced by later typed
+    appends); ``append_param_value_if_not_there`` skips params already
+    present under either their long name or short flag.
+
+    >>> (ParamsStringBuilder(prefix="--", delimiter="=")
+    ...  .append("--first_param=a")
+    ...  .append_param_value_if_not_there("first_param", "a2")
+    ...  .append_param_value_if_not_there("second_param", "b")
+    ...  .append_param_value_if_not_there("third_param", None)
+    ...  .result())
+    '--first_param=a --second_param=b'
+    """
+
+    def __init__(self, prefix: str = "", delimiter: str = "="):
+        self.prefix = prefix
+        self.delimiter = delimiter
+        self._parts: list[str] = []
+
+    def _contains(self, name: str, short: str | None = None) -> bool:
+        import re
+
+        text = " ".join(self._parts)
+        pats = [re.escape(self.prefix + name) + "[ =]",
+                re.escape(self.prefix + name) + "$"]
+        if short:
+            pats += [re.escape("-" + short) + "[ =]",
+                     re.escape("-" + short) + "$"]
+        return any(re.search(p, text) for p in pats)
+
+    def append(self, text: str) -> "ParamsStringBuilder":
+        if text:
+            self._parts.append(text)
+        return self
+
+    def append_param_value_if_not_there(self, name: str, value,
+                                        short: str | None = None
+                                        ) -> "ParamsStringBuilder":
+        if value is None or self._contains(name, short):
+            return self
+        if isinstance(value, bool):
+            value = str(value).lower()
+        elif isinstance(value, (list, tuple)):
+            value = ",".join(str(v) for v in value)
+        self._parts.append(f"{self.prefix}{name}{self.delimiter}{value}")
+        return self
+
+    def append_flag_if_true(self, name: str, value: bool) -> "ParamsStringBuilder":
+        if value and not self._contains(name):
+            self._parts.append(f"{self.prefix}{name}")
+        return self
+
+    def result(self) -> str:
+        return " ".join(self._parts)

@@ -24,11 +24,11 @@ from .transforms import (
     Threshold,
 )
 from .augment import ImageSetAugmenter
-from .unroll import UnrollImage
+from .unroll import UnrollBinaryImage, UnrollImage
 from .superpixel import SuperpixelTransformer, slic_segments
 
 __all__ = [
     "ImageTransformer", "Resize", "Crop", "CenterCrop", "ColorFormat", "Flip",
-    "GaussianBlur", "Threshold", "ImageSetAugmenter", "UnrollImage",
+    "GaussianBlur", "Threshold", "ImageSetAugmenter", "UnrollImage", "UnrollBinaryImage",
     "SuperpixelTransformer", "slic_segments",
 ]

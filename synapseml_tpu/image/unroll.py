@@ -11,7 +11,7 @@ from ..core.params import Param
 from ..core.pipeline import Transformer
 from .transforms import as_image
 
-__all__ = ["UnrollImage"]
+__all__ = ["UnrollImage", "UnrollBinaryImage"]
 
 
 class UnrollImage(Transformer):
@@ -25,6 +25,39 @@ class UnrollImage(Transformer):
 
         def per_part(p):
             flats = [as_image(x).ravel() for x in p[self.get("input_col")]]
+            lens = {len(f) for f in flats}
+            if len(lens) == 1 and flats:
+                return np.stack(flats)
+            out = np.empty(len(flats), dtype=object)
+            out[:] = flats
+            return out
+
+        return df.with_column(self.get("output_col"), per_part)
+
+
+class UnrollBinaryImage(Transformer):
+    """Decode ENCODED image bytes (png/jpeg) straight to the flat vector —
+    the reference's binary variant (``image/UnrollImage.scala:204``,
+    ``UnrollBinaryImage``) used downstream of the binary-file source without
+    an intermediate decoded-image column."""
+
+    feature_name = "image"
+
+    input_col = Param("input_col", "binary image-bytes column", default="content")
+    output_col = Param("output_col", "flattened vector column", default="unrolled")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        from ..io.files import decode_image_bytes
+
+        self.require_columns(df, self.get("input_col"))
+
+        def per_part(p):
+            flats = []
+            for raw in p[self.get("input_col")]:
+                try:
+                    flats.append(decode_image_bytes(bytes(raw)).ravel())
+                except Exception:  # undecodable bytes -> empty vector
+                    flats.append(np.zeros(0, np.uint8))
             lens = {len(f) for f in flats}
             if len(lens) == 1 and flats:
                 return np.stack(flats)

@@ -118,3 +118,36 @@ def test_pipeline_save_load(tmp_path):
     a = model.transform(df).collect_column("centered")
     b = m2.transform(df).collect_column("centered")
     np.testing.assert_allclose(a, b)
+
+
+def test_params_string_builder():
+    from synapseml_tpu.core.utils import ParamsStringBuilder
+
+    r = (ParamsStringBuilder(prefix="--", delimiter="=")
+         .append("--first_param=a")
+         .append_param_value_if_not_there("first_param", "a2")
+         .append_param_value_if_not_there("second_param", "b")
+         .append_param_value_if_not_there("third_param", None)
+         .append_param_value_if_not_there("listy", [1, 2, 3])
+         .append_flag_if_true("quiet", True)
+         .append_flag_if_true("verbose", False)
+         .result())
+    assert r == "--first_param=a --second_param=b --listy=1,2,3 --quiet"
+    # short-flag collision: "-q ..." blocks the long form
+    r2 = (ParamsStringBuilder(prefix="--")
+          .append("-q 1")
+          .append_param_value_if_not_there("quiet_level", 2, short="q")
+          .result())
+    assert r2 == "-q 1"
+
+
+def test_default_hyperparams_ranges():
+    from synapseml_tpu.automl import DefaultHyperparams, RandomSpace
+    from synapseml_tpu.gbdt import LightGBMClassifier
+
+    space = DefaultHyperparams.default_range(LightGBMClassifier())
+    assert "num_leaves" in space and "learning_rate" in space
+    cands = RandomSpace(space, seed=0).configs(3)
+    assert len(cands) == 3 and all(8 <= c["num_leaves"] <= 63 for c in cands)
+    with pytest.raises(ValueError, match="no default"):
+        DefaultHyperparams.default_range("SomethingElse")

@@ -109,3 +109,21 @@ def test_missing_column_errors():
     df = make_image_df()
     with pytest.raises(ValueError, match="input column"):
         ImageTransformer(input_col="nope").transform(df)
+
+
+def test_unroll_binary_image(tmp_path):
+    import io as _io
+
+    from PIL import Image
+
+    from synapseml_tpu.image import UnrollBinaryImage
+
+    buf = _io.BytesIO()
+    arr = np.arange(27, dtype=np.uint8).reshape(3, 3, 3)
+    Image.fromarray(arr).save(buf, format="PNG")
+    good = buf.getvalue()
+    df = DataFrame.from_rows([{"content": good}, {"content": b"not-an-image"}])
+    out = UnrollBinaryImage().transform(df)
+    vecs = out.collect_column("unrolled")
+    np.testing.assert_array_equal(vecs[0], arr.ravel())
+    assert len(vecs[1]) == 0  # undecodable -> empty vector, not a crash
