@@ -269,6 +269,49 @@ def _conv(ins, attrs):
     return out
 
 
+@op("ConvTranspose")
+def _conv_transpose(ins, attrs):
+    """Transposed (fractionally-strided) convolution — the UNet/segmentation
+    upsampling op. Lowered as ``conv_general_dilated`` with lhs_dilation =
+    stride over the spatially-flipped, channel-swapped kernel (the gradient
+    identity), which XLA maps straight onto the MXU. ONNX weight layout is
+    ``[C_in, C_out/groups, *k]``; output spatial size follows the spec:
+    (in-1)*s + ((k-1)*d + 1) - pad_begin - pad_end + output_padding."""
+    x, w = ins[0], ins[1]
+    rank = x.ndim - 2
+    strides = [int(s) for s in (attrs.get("strides") or [1] * rank)]
+    dilations = [int(d) for d in (attrs.get("dilations") or [1] * rank)]
+    out_pad = [int(p) for p in (attrs.get("output_padding") or [0] * rank)]
+    groups = int(attrs.get("group", 1))
+    if attrs.get("output_shape"):
+        raise NotImplementedError("ConvTranspose with explicit output_shape")
+    if attrs.get("auto_pad", "NOTSET") not in ("NOTSET", ""):
+        raise NotImplementedError("ConvTranspose auto_pad")
+    pads = attrs.get("pads") or [0] * (2 * rank)
+    k = [int(w.shape[2 + i]) for i in range(rank)]
+    # flip spatial dims; swap [C_in, C_out/g, ...] -> [C_out/g * g?, ...]:
+    # per group, the transposed kernel is [C_out/g, C_in/g, *k] OIHW
+    wf = jnp.flip(w, axis=tuple(range(2, 2 + rank)))
+    if groups == 1:
+        wt = jnp.swapaxes(wf, 0, 1)                       # [C_out, C_in, *k]
+    else:
+        cin, cog = w.shape[0], w.shape[1]
+        wt = wf.reshape((groups, cin // groups, cog) + tuple(k))
+        wt = jnp.swapaxes(wt, 1, 2)                       # [g, C_out/g, C_in/g, *k]
+        wt = wt.reshape((groups * cog, cin // groups) + tuple(k))
+    padding = [((k[i] - 1) * dilations[i] - pads[i],
+                (k[i] - 1) * dilations[i] - pads[rank + i] + out_pad[i])
+               for i in range(rank)]
+    dn = ("NCHW", "OIHW", "NCHW") if rank == 2 else None
+    out = jax.lax.conv_general_dilated(
+        x, wt, window_strides=[1] * rank, padding=padding,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        feature_group_count=groups, dimension_numbers=dn)
+    if len(ins) > 2 and ins[2] is not None:
+        out = out + ins[2].reshape((1, -1) + (1,) * rank)
+    return out
+
+
 @op("BatchNormalization")
 def _batchnorm(ins, attrs):
     x, scale, bias, mean, var = ins[:5]

@@ -123,3 +123,69 @@ def test_resize_modes_match_torch_interpolate():
     want = F.interpolate(t, size=(10, 4), mode="bilinear",
                          align_corners=True).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_transpose_parity_with_torch():
+    """ConvTranspose (the UNet upsampling op) vs torch, incl. the classic
+    stride-2/pad-1/output_padding-1 doubling config, groups, and dilation."""
+    import torch
+
+    rs = np.random.default_rng(11)
+    from synapseml_tpu.onnx.convert import OP_REGISTRY
+
+    configs = [
+        dict(cin=4, cout=6, k=3, stride=2, pad=1, out_pad=1, groups=1, dil=1),
+        dict(cin=4, cout=4, k=2, stride=2, pad=0, out_pad=0, groups=1, dil=1),
+        dict(cin=4, cout=8, k=3, stride=1, pad=1, out_pad=0, groups=2, dil=1),
+        dict(cin=3, cout=3, k=3, stride=2, pad=2, out_pad=1, groups=1, dil=2),
+    ]
+    for c in configs:
+        x = rs.normal(size=(2, c["cin"], 7, 7)).astype(np.float32)
+        m = torch.nn.ConvTranspose2d(
+            c["cin"], c["cout"], c["k"], stride=c["stride"], padding=c["pad"],
+            output_padding=c["out_pad"], groups=c["groups"],
+            dilation=c["dil"])
+        with torch.no_grad():
+            want = m(torch.tensor(x)).numpy()
+        got = np.asarray(OP_REGISTRY["ConvTranspose"](
+            [x, m.weight.detach().numpy(), m.bias.detach().numpy()],
+            {"strides": [c["stride"]] * 2, "pads": [c["pad"]] * 4,
+             "output_padding": [c["out_pad"]] * 2, "group": c["groups"],
+             "dilations": [c["dil"]] * 2}))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                   err_msg=str(c))
+
+
+def test_unet_style_export_parity(tmp_path):
+    """A torch-exported encoder-decoder (conv down, ConvTranspose up, skip
+    concat) through the full ONNX->JAX conversion."""
+    import torch
+
+    class MiniUNet(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.down = torch.nn.Conv2d(3, 8, 3, stride=2, padding=1)
+            self.mid = torch.nn.Conv2d(8, 8, 3, padding=1)
+            self.up = torch.nn.ConvTranspose2d(8, 4, 3, stride=2, padding=1,
+                                               output_padding=1)
+            self.out = torch.nn.Conv2d(7, 2, 1)  # 4 up + 3 skip channels
+
+        def forward(self, x):
+            d = torch.relu(self.down(x))
+            m = torch.relu(self.mid(d))
+            u = torch.relu(self.up(m))
+            return self.out(torch.cat([u, x], dim=1))
+
+    torch.manual_seed(0)
+    model = MiniUNet().eval()
+    x = np.random.default_rng(12).normal(size=(1, 3, 16, 16)).astype(np.float32)
+    buf = io.BytesIO()
+    torch.onnx.export(model, (torch.tensor(x),), buf, input_names=["x"],
+                      output_names=["y"], dynamo=False)
+    with torch.no_grad():
+        want = model(torch.tensor(x)).numpy()
+    from synapseml_tpu.onnx import convert_graph
+
+    conv = convert_graph(buf.getvalue())
+    got = np.asarray(conv(x=x)["y"])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
