@@ -307,3 +307,51 @@ def test_llama2_7b_training_state_fits_v5e16_abstractly():
     assert total_params > 6e9
     gb = per_device / 1e9
     assert gb < 12, f"{gb:.2f} GB/device training state exceeds v5e headroom"
+
+
+def test_optimizer_state_shards_with_params():
+    """ZeRO-style weight-update sharding (cf. 'Automatic Cross-Replica
+    Sharding of Weight Update in Data-Parallel Training'): on an fsdp mesh
+    the Adam moments must carry the SAME shardings as their params — a
+    replicated moment would silently multiply optimizer memory by the fsdp
+    factor."""
+    import jax
+
+    from synapseml_tpu.models.flax_nets.bert import BertClassifier, bert_tiny
+    from synapseml_tpu.models.trainer import Trainer, TrainerConfig
+
+    cfg = bert_tiny(n_layers=1)
+    mesh = create_mesh(MeshConfig(data=2, fsdp=4))
+    trainer = Trainer(BertClassifier(cfg, num_classes=2), mesh,
+                      TrainerConfig(learning_rate=1e-3, total_steps=2))
+    rs = np.random.default_rng(0)
+    batch = {"input_ids": rs.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32),
+             "attention_mask": np.ones((8, 16), np.int32),
+             "labels": rs.integers(0, 2, (8,)).astype(np.int32)}
+    state = trainer.init_state(batch)
+
+    param_shardings = {
+        jax.tree_util.keystr(path): leaf.sharding
+        for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]}
+    any_sharded = any(
+        any(s is not None for s in getattr(sh.spec, "_partitions", sh.spec))
+        for sh in param_shardings.values()
+        if hasattr(sh, "spec"))
+    assert any_sharded, "fsdp mesh produced fully-replicated params"
+
+    # any param-shaped optimizer moment (Adam mu/nu mirror the param tree)
+    # must carry its param's sharding, not replication
+    checked = 0
+    mu_nu = [leaf for leaf in jax.tree.leaves(state.opt_state)
+             if hasattr(leaf, "shape") and leaf.ndim >= 2]
+    params_by_shape = {}
+    for leaf in jax.tree.leaves(state.params):
+        params_by_shape.setdefault(leaf.shape, leaf.sharding)
+    for leaf in mu_nu:
+        want = params_by_shape.get(leaf.shape)
+        if want is not None:
+            assert leaf.sharding == want, (
+                f"opt-state leaf {leaf.shape} sharded {leaf.sharding}, "
+                f"param counterpart {want}")
+            checked += 1
+    assert checked >= 4, "no param-shaped optimizer moments found to check"
