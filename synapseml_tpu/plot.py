@@ -1,0 +1,70 @@
+"""Plotting helpers — the reference's ``synapse.ml.plot`` python glue
+(``core/src/main/python/synapse/ml/plot/plot.py``: confusionMatrix + roc over
+a scored DataFrame).
+
+Accepts this framework's DataFrame or a pandas frame; renders onto the
+current matplotlib axes (Agg-safe) and returns the Axes so notebooks can
+compose. ``confusionMatrix``/``roc`` aliases keep the reference's camelCase
+call sites working verbatim.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["confusion_matrix_plot", "roc_plot", "confusionMatrix", "roc"]
+
+
+def _columns(df, cols):
+    if hasattr(df, "collect_column"):  # synapseml_tpu DataFrame
+        return [np.asarray(df.collect_column(c)) for c in cols]
+    return [np.asarray(df[c]) for c in cols]
+
+
+def confusion_matrix_plot(df, y_col: str, y_hat_col: str, labels, ax=None):
+    """Row-normalized confusion-matrix heatmap with per-cell counts and the
+    accuracy in the title area (the reference's layout)."""
+    import matplotlib.pyplot as plt
+    from sklearn.metrics import confusion_matrix
+
+    y, y_hat = _columns(df, [y_col, y_hat_col])
+    ax = ax or plt.gca()
+    accuracy = float(np.mean(np.asarray(y) == np.asarray(y_hat)))
+    cm = confusion_matrix(y, y_hat)
+    cmn = cm.astype(float) / np.maximum(cm.sum(axis=1)[:, None], 1)
+    im = ax.imshow(cmn, interpolation="nearest", cmap="Blues", vmin=0, vmax=1)
+    ticks = np.arange(len(labels))
+    ax.set_xticks(ticks, labels=labels)
+    ax.set_yticks(ticks, labels=labels, rotation=90)
+    for i in range(cm.shape[0]):
+        for j in range(cm.shape[1]):
+            ax.text(j, i, str(cm[i, j]), ha="center",
+                    color="white" if cmn[i, j] > 0.1 else "black")
+    ax.set_xlabel("Predicted Label")
+    ax.set_ylabel("True Label")
+    ax.set_title(f"Accuracy = {accuracy * 100:.1f}%")
+    ax.figure.colorbar(im, ax=ax)
+    return ax
+
+
+def roc_plot(df, y_col: str, y_hat_col: str, thresh: float = 0.5, ax=None):
+    """ROC curve of score column vs (thresholded) label column, AUC in the
+    legend."""
+    import matplotlib.pyplot as plt
+    from sklearn.metrics import auc, roc_curve
+
+    y, scores = _columns(df, [y_col, y_hat_col])
+    y_bin = (np.asarray(y, dtype=float) > thresh).astype(int)
+    fpr, tpr, _ = roc_curve(y_bin, np.asarray(scores, dtype=float))
+    ax = ax or plt.gca()
+    ax.plot(fpr, tpr, label=f"AUC = {auc(fpr, tpr):.3f}")
+    ax.plot([0, 1], [0, 1], linestyle="--", linewidth=0.8)
+    ax.set_xlabel("False Positive Rate")
+    ax.set_ylabel("True Positive Rate")
+    ax.legend(loc="lower right")
+    return ax
+
+
+# reference-verbatim camelCase call sites
+confusionMatrix = confusion_matrix_plot
+roc = roc_plot
