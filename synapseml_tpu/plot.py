@@ -4,8 +4,9 @@ a scored DataFrame).
 
 Accepts this framework's DataFrame or a pandas frame; renders onto the
 current matplotlib axes (Agg-safe) and returns the Axes so notebooks can
-compose. ``confusionMatrix``/``roc`` aliases keep the reference's camelCase
-call sites working verbatim.
+compose. Metric math comes from :mod:`synapseml_tpu.train.statistics`
+(pure numpy — no sklearn dependency). ``confusionMatrix``/``roc`` aliases
+keep the reference's camelCase call sites working verbatim.
 """
 
 from __future__ import annotations
@@ -23,23 +24,36 @@ def _columns(df, cols):
 
 def confusion_matrix_plot(df, y_col: str, y_hat_col: str, labels, ax=None):
     """Row-normalized confusion-matrix heatmap with per-cell counts and the
-    accuracy in the title area (the reference's layout)."""
+    accuracy in the title (the reference's layout). ``labels`` PINS the
+    row/column order — classes are matched to it, absent classes render as
+    empty rows/columns rather than shifting the grid."""
     import matplotlib.pyplot as plt
-    from sklearn.metrics import confusion_matrix
 
     y, y_hat = _columns(df, [y_col, y_hat_col])
     ax = ax or plt.gca()
-    accuracy = float(np.mean(np.asarray(y) == np.asarray(y_hat)))
-    cm = confusion_matrix(y, y_hat)
+    accuracy = float(np.mean(y == y_hat))
+    k = len(labels)
+    # build the matrix against the CALLER'S label order; integer-coded
+    # classes index positionally into `labels` (the reference's usage)
+    if y.dtype.kind in "iub" and not any(v in set(labels) for v in np.unique(y)):
+        classes = list(range(k))
+    else:
+        classes = list(labels)
+    lut = {c: i for i, c in enumerate(classes)}
+    cm = np.zeros((k, k), dtype=np.int64)
+    for t, p in zip(y, y_hat):
+        ti, pi = lut.get(t), lut.get(p)
+        if ti is not None and pi is not None:
+            cm[ti, pi] += 1
     cmn = cm.astype(float) / np.maximum(cm.sum(axis=1)[:, None], 1)
     im = ax.imshow(cmn, interpolation="nearest", cmap="Blues", vmin=0, vmax=1)
-    ticks = np.arange(len(labels))
+    ticks = np.arange(k)
     ax.set_xticks(ticks, labels=labels)
     ax.set_yticks(ticks, labels=labels, rotation=90)
-    for i in range(cm.shape[0]):
-        for j in range(cm.shape[1]):
+    for i in range(k):
+        for j in range(k):
             ax.text(j, i, str(cm[i, j]), ha="center",
-                    color="white" if cmn[i, j] > 0.1 else "black")
+                    color="white" if cmn[i, j] > 0.5 else "black")
     ax.set_xlabel("Predicted Label")
     ax.set_ylabel("True Label")
     ax.set_title(f"Accuracy = {accuracy * 100:.1f}%")
@@ -48,16 +62,39 @@ def confusion_matrix_plot(df, y_col: str, y_hat_col: str, labels, ax=None):
 
 
 def roc_plot(df, y_col: str, y_hat_col: str, thresh: float = 0.5, ax=None):
-    """ROC curve of score column vs (thresholded) label column, AUC in the
-    legend."""
+    """ROC curve of the score column vs the label column, AUC in the legend.
+
+    Labels binarize with the same ``> 0`` convention as
+    :func:`synapseml_tpu.train.statistics.roc_auc` for numeric labels (so
+    {0,1} and {-1,1} codings both work); non-numeric labels use the
+    second-sorted class as positive. ``thresh`` only applies when the label
+    column is itself a float score (the reference's signature).
+    """
     import matplotlib.pyplot as plt
-    from sklearn.metrics import auc, roc_curve
+
+    from .train.statistics import roc_auc
 
     y, scores = _columns(df, [y_col, y_hat_col])
-    y_bin = (np.asarray(y, dtype=float) > thresh).astype(int)
-    fpr, tpr, _ = roc_curve(y_bin, np.asarray(scores, dtype=float))
+    scores = np.asarray(scores, dtype=float)
+    if y.dtype.kind == "f":
+        y_bin = (y > thresh).astype(int)
+    elif y.dtype.kind in "iub":
+        y_bin = (y > 0).astype(int)
+    else:  # string/object labels: positive = last class in sorted order
+        classes = sorted(set(y.tolist()))
+        if len(classes) != 2:
+            raise ValueError(f"roc needs binary labels, got {classes}")
+        y_bin = (y == classes[1]).astype(int)
+
+    # fpr/tpr by descending-score sweep (pure numpy)
+    order = np.argsort(-scores, kind="stable")
+    ys = y_bin[order]
+    tp = np.concatenate([[0], np.cumsum(ys)])
+    fp = np.concatenate([[0], np.cumsum(1 - ys)])
+    n_pos, n_neg = max(tp[-1], 1), max(fp[-1], 1)
+    tpr, fpr = tp / n_pos, fp / n_neg
     ax = ax or plt.gca()
-    ax.plot(fpr, tpr, label=f"AUC = {auc(fpr, tpr):.3f}")
+    ax.plot(fpr, tpr, label=f"AUC = {roc_auc(y_bin, scores):.3f}")
     ax.plot([0, 1], [0, 1], linestyle="--", linewidth=0.8)
     ax.set_xlabel("False Positive Rate")
     ax.set_ylabel("True Positive Rate")

@@ -116,27 +116,3 @@ def test_join_inner_and_left():
         left.join(right, on="x")
     with pytest.raises(ValueError, match="how"):
         left.join(right, on="id", how="cross")
-
-
-def test_plot_helpers_render_headless():
-    import matplotlib
-
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-
-    from synapseml_tpu.plot import confusionMatrix, roc
-
-    rs = np.random.default_rng(0)
-    y = rs.integers(0, 2, 200)
-    scores = np.clip(y * 0.6 + rs.normal(0.2, 0.25, 200), 0, 1)
-    df = DataFrame.from_dict({"label": y, "prob": scores,
-                              "pred": (scores > 0.5).astype(int)})
-    fig, ax = plt.subplots()
-    out = confusionMatrix(df, "label", "pred", labels=["neg", "pos"], ax=ax)
-    assert out.get_xlabel() == "Predicted Label"
-    assert "Accuracy" in out.get_title()
-    plt.close(fig)
-    fig, ax = plt.subplots()
-    out = roc(df, "label", "prob", ax=ax)
-    assert "AUC" in out.get_legend().get_texts()[0].get_text()
-    plt.close(fig)

@@ -1,0 +1,78 @@
+"""Plotting glue (reference `synapse/ml/plot/plot.py`) — headless rendering,
+label-order pinning, and label-coding tolerance."""
+
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+from synapseml_tpu.core import DataFrame  # noqa: E402
+from synapseml_tpu.plot import confusionMatrix, roc  # noqa: E402
+
+
+def scored_df(label_kind="int"):
+    rs = np.random.default_rng(0)
+    y = rs.integers(0, 2, 200)
+    scores = np.clip(y * 0.6 + rs.normal(0.2, 0.25, 200), 0, 1)
+    pred = (scores > 0.5).astype(int)
+    if label_kind == "str":
+        names = np.asarray(["neg", "pos"], dtype=object)
+        y, pred = names[y], names[pred]
+    return DataFrame.from_dict({"label": y, "prob": scores, "pred": pred})
+
+
+def test_confusion_matrix_renders_and_reports_accuracy():
+    fig, ax = plt.subplots()
+    out = confusionMatrix(scored_df(), "label", "pred", labels=["neg", "pos"],
+                          ax=ax)
+    assert out.get_xlabel() == "Predicted Label"
+    assert "Accuracy" in out.get_title()
+    plt.close(fig)
+
+
+def test_confusion_matrix_pins_caller_label_order():
+    # string classes with labels REVERSED vs sorted order: cell (0,0) must be
+    # the 'pos'->'pos' count, not sklearn-style sorted 'neg' first
+    df = scored_df(label_kind="str")
+    y = df.collect_column("label")
+    p = df.collect_column("pred")
+    pos_pos = int(np.sum((y == "pos") & (p == "pos")))
+    fig, ax = plt.subplots()
+    confusionMatrix(df, "label", "pred", labels=["pos", "neg"], ax=ax)
+    texts = [t.get_text() for t in ax.texts]
+    assert texts[0] == str(pos_pos), (texts, pos_pos)
+    plt.close(fig)
+
+
+def test_confusion_matrix_single_class_keeps_grid():
+    df = DataFrame.from_dict({"label": np.ones(10, np.int64),
+                              "pred": np.ones(10, np.int64)})
+    fig, ax = plt.subplots()
+    confusionMatrix(df, "label", "pred", labels=["neg", "pos"], ax=ax)
+    assert len(ax.texts) == 4  # full 2x2 grid, absent class renders zeros
+    assert ax.texts[3].get_text() == "10"  # (pos, pos) cell
+    plt.close(fig)
+
+
+@pytest.mark.parametrize("kind", ["int", "str"])
+def test_roc_handles_label_codings(kind):
+    fig, ax = plt.subplots()
+    out = roc(scored_df(kind), "label", "prob", ax=ax)
+    legend = out.get_legend().get_texts()[0].get_text()
+    assert "AUC" in legend
+    auc = float(legend.split("=")[1])
+    assert auc > 0.7  # scores genuinely separate the classes
+    plt.close(fig)
+
+
+def test_roc_pm1_coding():
+    rs = np.random.default_rng(1)
+    y = rs.choice([-1, 1], 100)
+    scores = (y > 0) * 0.5 + rs.normal(0.25, 0.2, 100)
+    df = DataFrame.from_dict({"label": y, "prob": scores})
+    fig, ax = plt.subplots()
+    out = roc(df, "label", "prob", ax=ax)
+    assert "AUC" in out.get_legend().get_texts()[0].get_text()
+    plt.close(fig)
