@@ -424,3 +424,32 @@ def test_legacy_prenorm_artifact_detection():
     got = np.asarray(list(out.collect_column("scores")))[0]
     # the served model computes in bf16 (arch default); reference is f32
     np.testing.assert_allclose(got, want[0], atol=5e-3)
+
+
+def test_mixtral_through_causal_lm_transformer(tmp_path):
+    """The user-facing path: a Mixtral checkpoint dir on HuggingFaceCausalLM
+    batch inference (greedy, KV cache), hashing tokenizer supplied like any
+    tokenizer-less local checkpoint."""
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(7)
+    tcfg = MixtralConfig(vocab_size=61, hidden_size=16, num_hidden_layers=1,
+                         num_attention_heads=2, num_key_value_heads=2,
+                         intermediate_size=32, max_position_embeddings=64,
+                         num_local_experts=2, num_experts_per_tok=2,
+                         sliding_window=None)
+    tmodel = MixtralForCausalLM(tcfg)
+    d = _save(tmodel, tmp_path, tcfg)
+
+    import synapseml_tpu as st
+    from synapseml_tpu.hf import HuggingFaceCausalLM
+    from synapseml_tpu.models.tokenizer import HashingTokenizer
+
+    lm = HuggingFaceCausalLM(model_name=d, max_new_tokens=4, batch_size=2,
+                             prompt_bucket=8,
+                             tokenizer=HashingTokenizer(vocab_size=61))
+    df = st.DataFrame.from_rows([{"prompt": "route me through experts"},
+                                 {"prompt": "sparse mixture decoding"}])
+    out = lm.transform(df)
+    gens = list(out.collect_column("completions"))
+    assert len(gens) == 2 and all(len(g) == 4 for g in gens)
