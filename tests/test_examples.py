@@ -19,3 +19,18 @@ def test_example_runs(example):
     proc = subprocess.run([sys.executable, str(example)], env=env,
                           capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, f"{example.name} failed:\n{proc.stdout}\n{proc.stderr}"
+
+
+@pytest.mark.slow
+def test_module_selftest_passes():
+    """`python -m synapseml_tpu` environment self-test: all checks PASS."""
+    import subprocess
+    import sys
+
+    env = {"PATH": "/usr/bin:/bin", "HOME": "/root",
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": str(pathlib.Path(__file__).parent.parent)}
+    proc = subprocess.run([sys.executable, "-m", "synapseml_tpu"], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "6/6 checks passed" in proc.stdout
