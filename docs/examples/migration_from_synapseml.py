@@ -44,3 +44,36 @@ print("compat wrapper == native estimator:", True)
 # %%
 booster = model_a.unwrap().get_booster()
 print("feature importances:", booster.feature_importance("split"))
+
+# %% [markdown]
+# ## The Spark habits: files, joins, grouping
+# Reference pipelines lean on `spark.read.csv`, `df.join`, and
+# `df.groupBy().agg()`. The DataFrame plane carries the same verbs
+# (host-side pandas engine — the TPU plane does the numeric compute):
+
+# %%
+import tempfile, os
+
+tmp = tempfile.mkdtemp()
+from synapseml_tpu.io import read_csv, write_csv
+
+scored = model_b.transform(df).with_column(
+    "segment", lambda p: (np.arange(len(p["label"])) % 3).astype(np.int64))
+write_csv(scored.select("label", "prediction", "segment"),
+          os.path.join(tmp, "scored"), partitioned=True)
+back = read_csv(os.path.join(tmp, "scored"))
+print("read back:", back.count(), "rows in", back.num_partitions, "partitions")
+
+per_segment = back.group_by("segment").agg({"prediction": "mean",
+                                            "label": "mean"})
+print("per-segment rates:")
+for row in per_segment.collect_rows():
+    print("  segment", row["segment"], "pred", round(row["prediction_mean"], 2),
+          "label", round(row["label_mean"], 2))
+
+names = st.DataFrame.from_dict({"segment": np.arange(3),
+                                "name": np.asarray(["a", "b", "c"],
+                                                   dtype=object)})
+joined = per_segment.join(names, on="segment")
+assert sorted(joined.collect_column("name").tolist()) == ["a", "b", "c"]
+print("join on segment:", joined.count(), "rows")
