@@ -157,6 +157,12 @@ class LightGBMClassificationModel(WrapperBase):
     def getMinSumHessianInLeaf(self):
         return self._get('min_sum_hessian_in_leaf')
 
+    def setModelString(self, value):
+        return self._set('model_string', value)
+
+    def getModelString(self):
+        return self._get('model_string')
+
     def setMonotoneConstraints(self, value):
         return self._set('monotone_constraints', value)
 
@@ -372,6 +378,12 @@ class LightGBMClassifier(WrapperBase):
 
     def getMinSumHessianInLeaf(self):
         return self._get('min_sum_hessian_in_leaf')
+
+    def setModelString(self, value):
+        return self._set('model_string', value)
+
+    def getModelString(self):
+        return self._get('model_string')
 
     def setMonotoneConstraints(self, value):
         return self._set('monotone_constraints', value)
@@ -607,6 +619,12 @@ class LightGBMRanker(WrapperBase):
     def getMinSumHessianInLeaf(self):
         return self._get('min_sum_hessian_in_leaf')
 
+    def setModelString(self, value):
+        return self._set('model_string', value)
+
+    def getModelString(self):
+        return self._get('model_string')
+
     def setMonotoneConstraints(self, value):
         return self._set('monotone_constraints', value)
 
@@ -816,6 +834,12 @@ class LightGBMRankerModel(WrapperBase):
 
     def getMinSumHessianInLeaf(self):
         return self._get('min_sum_hessian_in_leaf')
+
+    def setModelString(self, value):
+        return self._set('model_string', value)
+
+    def getModelString(self):
+        return self._get('model_string')
 
     def setMonotoneConstraints(self, value):
         return self._set('monotone_constraints', value)
@@ -1027,6 +1051,12 @@ class LightGBMRegressionModel(WrapperBase):
     def getMinSumHessianInLeaf(self):
         return self._get('min_sum_hessian_in_leaf')
 
+    def setModelString(self, value):
+        return self._set('model_string', value)
+
+    def getModelString(self):
+        return self._get('model_string')
+
     def setMonotoneConstraints(self, value):
         return self._set('monotone_constraints', value)
 
@@ -1230,6 +1260,12 @@ class LightGBMRegressor(WrapperBase):
 
     def getMinSumHessianInLeaf(self):
         return self._get('min_sum_hessian_in_leaf')
+
+    def setModelString(self, value):
+        return self._set('model_string', value)
+
+    def getModelString(self):
+        return self._get('model_string')
 
     def setMonotoneConstraints(self, value):
         return self._set('monotone_constraints', value)

@@ -275,7 +275,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                   max_drop: int = 50, skip_drop: float = 0.5,
                   monotone_constraints=None, scale_pos_weight: float = 1.0,
                   is_unbalance: bool = False, histogram_impl: str = "segment",
-                  categorical_features=None,
+                  categorical_features=None, init_model=None,
                   measures=None, verbose: bool = False) -> TpuBooster:
     """Grow a forest. The full binned matrix + running scores stay on device
     for the whole run; pass ``mesh`` to shard rows over its ``data`` axis
@@ -382,8 +382,39 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         metric = jax.jit(o.metric)
         init = np.asarray(jax.device_get(o.init_score(jnp.asarray(y[:n]))), np.float32).reshape(K)
 
-    scores = jnp.broadcast_to(jnp.asarray(init)[None, :], (n + pad, K)).astype(jnp.float32)
-    scores = _device_put_sharded(np.asarray(scores), mesh)
+    # warm start (reference modelString continuation, LightGBMBase.scala:48-60):
+    # training resumes from the previous booster's raw margins; its trees are
+    # prepended to the returned model
+    prev = None
+    if init_model is not None:
+        if isinstance(init_model, (str, bytes)):
+            from .interop import parse_lightgbm_string
+
+            prev = parse_lightgbm_string(init_model if isinstance(init_model, str)
+                                         else init_model.decode())
+        else:
+            prev = init_model
+        if prev.num_features != f:
+            raise ValueError(f"init_model has {prev.num_features} features, "
+                             f"data has {f}")
+        if prev.num_model_out != K:
+            raise ValueError(f"init_model outputs {prev.num_model_out} models "
+                             f"per iteration, this objective needs {K}")
+        if prev.average_output:
+            raise ValueError("continued training from an rf (averaged) model "
+                             "is not supported (matches LightGBM)")
+        if boosting_type == "rf":
+            raise ValueError("boosting_type='rf' cannot continue from "
+                             "init_model: averaged output would fold the "
+                             "previous full-weight trees into the mean")
+        init = np.asarray(prev.init_score, np.float32).reshape(K)
+        base = np.asarray(prev.raw_score(x), np.float32).reshape(n, K)
+        scores_np = np.broadcast_to(init[None, :], (n + pad, K)).copy()
+        scores_np[:n] = base
+        scores = _device_put_sharded(scores_np.astype(np.float32), mesh)
+    else:
+        scores = jnp.broadcast_to(jnp.asarray(init)[None, :], (n + pad, K)).astype(jnp.float32)
+        scores = _device_put_sharded(np.asarray(scores), mesh)
 
     cfg = T.GrowthConfig(max_depth=max_depth, num_leaves=num_leaves,
                          num_bins=mapper.num_bins, lambda_l1=lambda_l1,
@@ -403,7 +434,12 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     if has_valid:
         vbins = jnp.asarray(mapper.transform(np.asarray(valid_features)).astype(np.int32))
         vy = jnp.asarray(np.asarray(valid_labels, np.float32))
-        vscores = jnp.broadcast_to(jnp.asarray(init)[None, :], (vbins.shape[0], K)).astype(jnp.float32)
+        if prev is not None:  # warm start: eval continues from prev margins too
+            vscores = jnp.asarray(np.asarray(prev.raw_score(
+                np.asarray(valid_features, np.float32)), np.float32))
+        else:
+            vscores = jnp.broadcast_to(jnp.asarray(init)[None, :],
+                                       (vbins.shape[0], K)).astype(jnp.float32)
         if is_rank:
             if valid_group_sizes is None:
                 raise ValueError("lambdarank validation requires valid_group_sizes")
@@ -701,9 +737,77 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         thr_val_h = np.where(is_cat_lut[np.maximum(feat_h, 0)] & (feat_h >= 0),
                              0.0, thr_val_h).astype(np.float32)
 
+    val_h, gain_h, cover_h = (np.asarray(val_dev), np.asarray(gain_dev),
+                              np.asarray(cover_dev))
+    if prev is not None and not hasattr(prev, "feature"):
+        # imported model.txt continuation: imported trees use child-array
+        # layout (depth unbounded — not heap-expressible), so the merge
+        # happens in LightGBM format: new trees export to model.txt and the
+        # concatenated forest reparses into one ImportedBooster (scoring-
+        # surface compatible with the model transformers)
+        from .interop import parse_lightgbm_string, to_lightgbm_string
+
+        new_b = TpuBooster(
+            feat_h, thr_val_h, val_h, gain_h, cover=cover_h,
+            max_depth=max_depth, num_model_out=K, objective=o.name,
+            init_score=np.zeros(K, np.float32),  # increments on prev margins
+            num_features=f, best_iteration=best_iter,
+            cat_mask=cat_mask_h, categorical_features=cat_feats)
+        new_imported = parse_lightgbm_string(to_lightgbm_string(new_b))
+        import dataclasses as _dc
+
+        # resume was from best_iteration-truncated margins: stale post-best
+        # trees must not ride into the merged forest
+        n_prev = (prev.best_iteration or prev.num_iterations) * prev.num_model_out
+        merged = _dc.replace(prev, trees=list(prev.trees[:n_prev])
+                             + list(new_imported.trees),
+                             best_iteration=None)
+        merged.bin_mapper = mapper
+        merged.train_measures = measures.to_dict()
+        return merged
+    if prev is not None:
+        # prepend the previous forest; a shallower heap layout embeds into a
+        # deeper one unchanged (node ids are depth-invariant), so pad node
+        # arrays to the larger M with leaf defaults
+        depth_all = max(max_depth, prev.max_depth)
+        M = 2 ** (depth_all + 1) - 1
+
+        def pad_nodes(a, fill=0.0):
+            return np.pad(a, ((0, 0), (0, 0), (0, M - a.shape[2])),
+                          constant_values=fill)
+
+        if (prev.cat_mask is None) != (cat_mask_h is None) or (
+                prev.cat_mask is not None
+                and prev.cat_mask.shape[-1] != cat_mask_h.shape[-1]):
+            raise ValueError(
+                "continued training with categorical features requires "
+                "the same max_bin/categorical setup as init_model")
+        # resume was from best_iteration-truncated margins: slice stale
+        # post-best trees away before prepending
+        n_prev = prev.best_iteration or prev.num_iterations
+        feat_h = np.concatenate([pad_nodes(prev.feature[:n_prev], -1),
+                                 pad_nodes(feat_h, -1)])
+        thr_val_h = np.concatenate([pad_nodes(prev.threshold_value[:n_prev]),
+                                    pad_nodes(thr_val_h)])
+        val_h = np.concatenate([pad_nodes(prev.leaf_value[:n_prev]),
+                                pad_nodes(val_h)])
+        gain_h = np.concatenate([pad_nodes(prev.gain[:n_prev]),
+                                 pad_nodes(gain_h)])
+        prev_cover = (prev.cover if prev.cover is not None
+                      else np.zeros_like(prev.gain))
+        cover_h = np.concatenate([pad_nodes(prev_cover[:n_prev]),
+                                  pad_nodes(cover_h)])
+        if cat_mask_h is not None:
+            cm_pad = lambda a: np.pad(  # noqa: E731
+                a, ((0, 0), (0, 0), (0, M - a.shape[2]), (0, 0)))
+            cat_mask_h = np.concatenate([cm_pad(prev.cat_mask[:n_prev]),
+                                         cm_pad(cat_mask_h)])
+        max_depth = depth_all
+        best_iter = (n_prev + best_iter) if best_iter else None
+
     booster = TpuBooster(
-        feat_h, thr_val_h, np.asarray(val_dev), np.asarray(gain_dev),
-        cover=np.asarray(cover_dev),
+        feat_h, thr_val_h, val_h, gain_h,
+        cover=cover_h,
         max_depth=max_depth, num_model_out=K, objective=o.name, init_score=init,
         num_features=f, best_iteration=best_iter,
         average_output=boosting_type == "rf",

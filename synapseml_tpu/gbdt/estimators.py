@@ -101,20 +101,32 @@ class _LightGBMParams:
                                                      "pallas"))
     verbosity = Param("verbosity", "print eval metrics when > 0", default=-1,
                       converter=TypeConverters.to_int)
+    model_string = ComplexParam(
+        "model_string", "previous booster (TpuBooster or LightGBM model.txt "
+        "string) to continue training from (reference modelString, "
+        "LightGBMBase.scala:48-60)", default=None)
     mesh_config = ComplexParam("mesh_config", "MeshConfig to shard rows over the "
                                "mesh data axis (multi-host training)", default=None)
 
     # ---- shared helpers ----
     def _features(self, df: DataFrame) -> np.ndarray:
+        # float32 sources KEEP float32: that is the multithreaded native
+        # binning fast path (BinMapper.transform); everything else widens to
+        # float64 (boundary fitting widens internally either way)
         cols = self.get("feature_cols")
         if cols:
             self.require_columns(df, *cols)
-            return np.stack([np.asarray(df.collect_column(c), np.float64) for c in cols], axis=1)
+            arrs = [np.asarray(df.collect_column(c)) for c in cols]
+            dt = (np.float32 if all(a.dtype == np.float32 for a in arrs)
+                  else np.float64)
+            return np.stack([np.asarray(a, dt) for a in arrs], axis=1)
         fc = self.get("features_col")
         self.require_columns(df, fc)
         col = df.collect_column(fc)
         if col.dtype == object:
-            col = np.stack([np.asarray(v, np.float64) for v in col])
+            col = np.stack([np.asarray(v) for v in col])
+        if col.dtype == np.float32:
+            return col
         return np.asarray(col, np.float64)
 
     def _split_validation(self, df: DataFrame):
@@ -160,6 +172,7 @@ class _LightGBMParams:
             skip_drop=self.get("skip_drop"),
             seed=self.get("seed"),
             histogram_impl=self.get("histogram_impl"),
+            init_model=self.get("model_string"),
             verbose=self.get("verbosity") > 0,
             mesh=self._mesh(),
         )

@@ -833,3 +833,85 @@ def test_pallas_histogram_backend_grows_same_tree():
     np.testing.assert_array_equal(b_seg.feature, b_pl.feature)
     np.testing.assert_allclose(b_seg.raw_score(X[:50]), b_pl.raw_score(X[:50]),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_warm_start_continued_training():
+    """init_model continuation (reference modelString, LightGBMBase.scala:48-60):
+    training resumes from the previous booster's margins, its trees ride
+    along in the returned model, and the continued model beats the prefix."""
+    X, y = _mode_dataset(seed=51, n=500)
+    a = train_booster(X, y, objective="binary", num_iterations=5,
+                      learning_rate=0.2, num_leaves=7, seed=0)
+    b = train_booster(X, y, objective="binary", num_iterations=5,
+                      learning_rate=0.2, num_leaves=7, seed=1, init_model=a)
+    assert b.num_iterations == 10
+    # continuation == the margins keep improving the train loss
+    def logloss(m, n_it=None):
+        p = np.clip(np.asarray(m.predict(X, num_iterations=n_it)).ravel(),
+                    1e-6, 1 - 1e-6)
+        return float(-np.mean(y * np.log(p) + (1 - y) * np.log1p(-p)))
+
+    assert logloss(b) < logloss(a)
+    # the first 5 trees of the continued model ARE the previous model
+    np.testing.assert_allclose(b.raw_score(X[:50], num_iterations=5),
+                               a.raw_score(X[:50]), rtol=1e-5, atol=1e-6)
+
+
+def test_warm_start_from_model_string_and_estimator():
+    X, y = _mode_dataset(seed=52, n=300)
+    a = train_booster(X, y, objective="binary", num_iterations=4,
+                      learning_rate=0.3, num_leaves=7, seed=0)
+    from synapseml_tpu.gbdt.interop import to_lightgbm_string
+
+    s = to_lightgbm_string(a)
+    b = train_booster(X, y, objective="binary", num_iterations=3,
+                      learning_rate=0.3, num_leaves=7, init_model=s)
+    assert b.num_iterations == 7
+    # the merged forest's first 4 trees reproduce the source model
+    np.testing.assert_allclose(b.raw_score(X[:40], num_iterations=4),
+                               a.raw_score(X[:40]), rtol=1e-4, atol=1e-4)
+
+    df = DataFrame.from_dict({"features": X.astype(np.float32), "label": y})
+    est = LightGBMClassifier(num_iterations=3, num_leaves=7, model_string=a)
+    model = est.fit(df)
+    assert model.get_booster().num_iterations == 7
+
+
+def test_warm_start_validation():
+    X, y = _mode_dataset(seed=53, n=200)
+    a = train_booster(X, y, objective="binary", num_iterations=2, num_leaves=7)
+    with pytest.raises(ValueError, match="features"):
+        train_booster(X[:, :4], y, objective="binary", num_iterations=2,
+                      init_model=a)
+    rf = train_booster(X, y, objective="binary", num_iterations=2,
+                       boosting_type="rf", bagging_fraction=0.8,
+                       bagging_freq=1, num_leaves=7)
+    with pytest.raises(ValueError, match="averaged"):
+        train_booster(X, y, objective="binary", num_iterations=2,
+                      init_model=rf)
+
+
+def test_warm_start_truncates_early_stopped_prev():
+    """Continuation from an early-stopped model must drop its stale
+    post-best trees: merged prefix == prev's TRUNCATED raw scores."""
+    X, y = _mode_dataset(seed=54, n=600)
+    a = train_booster(X[:400], y[:400], objective="binary", num_iterations=100,
+                      learning_rate=0.5, num_leaves=7,
+                      valid_features=X[400:], valid_labels=y[400:],
+                      early_stopping_round=2)
+    assert a.best_iteration and a.best_iteration < a.num_iterations
+    b = train_booster(X[:400], y[:400], objective="binary", num_iterations=3,
+                      learning_rate=0.5, num_leaves=7, init_model=a)
+    assert b.num_iterations == a.best_iteration + 3
+    np.testing.assert_allclose(
+        b.raw_score(X[:50], num_iterations=a.best_iteration),
+        a.raw_score(X[:50]), rtol=1e-5, atol=1e-6)
+
+
+def test_warm_start_rf_rejected():
+    X, y = _mode_dataset(seed=55, n=200)
+    a = train_booster(X, y, objective="binary", num_iterations=2, num_leaves=7)
+    with pytest.raises(ValueError, match="rf"):
+        train_booster(X, y, objective="binary", num_iterations=2,
+                      boosting_type="rf", bagging_fraction=0.8,
+                      bagging_freq=1, init_model=a)
