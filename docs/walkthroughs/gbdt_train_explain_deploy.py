@@ -117,4 +117,21 @@ cat_acc = float(np.mean(cat_out.collect_column("prediction")
                         == cat_out.collect_column("label")))
 print("categorical membership learned in 4 tiny trees:", cat_acc)
 assert cat_acc > 0.97
-print("walkthrough complete: train -> explain -> persist -> serve -> categorical")
+# %%  Stage 7 — continued training (the modelString surface)
+# New data arrives after deployment: resume boosting FROM the shipped model
+# instead of retraining from scratch; the continued model contains the old
+# trees plus the new ones.
+from synapseml_tpu.gbdt.booster import train_booster
+
+first = model.get_booster()
+n_prev = first.best_iteration or first.num_iterations
+X_tr = data.data[tr].astype(np.float32)
+y_tr = data.target[tr].astype(np.float32)
+cont = train_booster(X_tr, y_tr, objective="binary",
+                     num_iterations=10, learning_rate=0.1, num_leaves=15,
+                     init_model=first)
+print("continued:", n_prev, "+ 10 =", cont.num_iterations, "trees")
+assert cont.num_iterations == n_prev + 10
+
+print("walkthrough complete: train -> explain -> persist -> serve -> "
+      "categorical -> continue")
