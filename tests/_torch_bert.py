@@ -72,7 +72,7 @@ class TorchBertEncoder(nn.Module):
             Layer(hidden, heads, mlp) for _ in range(layers))
         self.head = nn.Linear(hidden, num_classes)
 
-    def forward(self, input_ids, attention_mask):
+    def features(self, input_ids, attention_mask):
         T = input_ids.size(1)
         positions = torch.arange(T, device=input_ids.device).unsqueeze(0)
         x = self.ln(self.tok(input_ids) + self.pos(positions))
@@ -81,7 +81,10 @@ class TorchBertEncoder(nn.Module):
         bias = bias.unsqueeze(1).unsqueeze(2)  # [B, 1, 1, T]
         for layer in self.layers:
             x = layer(x, bias)
-        return self.head(x[:, 0])  # CLS logits
+        return x  # [B, T, H] hidden states
+
+    def forward(self, input_ids, attention_mask):
+        return self.head(self.features(input_ids, attention_mask)[:, 0])
 
 
 def export_bert_onnx_bytes(model: nn.Module, ids: torch.Tensor,

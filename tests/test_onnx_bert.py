@@ -68,3 +68,47 @@ def test_transformer_logits_match_torch(exported):
             want = model(ids, mask).numpy()
         got = np.asarray(fn(ids.numpy(), mask.numpy()))
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_sentence_transformer_head_export_parity():
+    """An EIGHTH real-export family: the sentence-transformer serving form —
+    encoder + masked mean pooling + L2 normalization exported as ONE graph
+    (the shape HuggingFaceSentenceEmbedder's ONNX deployments ship in)."""
+    import io
+
+    import torch.nn as tnn
+
+    from synapseml_tpu.onnx import convert_graph
+
+    class SentenceModel(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            torch.manual_seed(3)
+            self.encoder = TorchBertEncoder(vocab=128, hidden=32, heads=2,
+                                            layers=1, mlp=64, max_len=64,
+                                            num_classes=3)
+
+        def forward(self, input_ids, attention_mask):
+            # reuse the encoder body up to the hidden states: emulate by
+            # running embeddings+layers (the encoder's features path)
+            h = self.encoder.features(input_ids, attention_mask)
+            m = attention_mask.unsqueeze(-1).to(h.dtype)
+            pooled = (h * m).sum(1) / m.sum(1).clamp(min=1e-9)
+            return tnn.functional.normalize(pooled, p=2, dim=1)
+
+    model = SentenceModel().eval()
+    ids = torch.randint(0, 128, (3, 12))
+    mask = torch.ones(3, 12, dtype=torch.long)
+    mask[2, 7:] = 0
+    buf = io.BytesIO()
+    torch.onnx.export(model, (ids, mask), buf,
+                      input_names=["input_ids", "attention_mask"],
+                      output_names=["embedding"], dynamo=False)
+    with torch.no_grad():
+        want = model(ids, mask).numpy()
+    conv = convert_graph(buf.getvalue())
+    got = np.asarray(conv(input_ids=ids.numpy().astype(np.int64),
+                          attention_mask=mask.numpy().astype(np.int64))
+                     ["embedding"])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.linalg.norm(got, axis=1), 1.0, rtol=1e-5)
