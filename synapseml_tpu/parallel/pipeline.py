@@ -53,7 +53,8 @@ def stack_stage_params(stage_params_list):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params_list)
 
 
-def pipeline_apply(stage_fn, stacked_params, x_micro, axis_name: str = "pipe"):
+def pipeline_apply(stage_fn, stacked_params, x_micro, axis_name: str = "pipe",
+                   remat: bool = False):
     """Run ``n_micro`` microbatches through ``n_stages`` chained stages.
 
     Call INSIDE ``shard_map`` (or via :func:`pipeline_sharded`). Per-device
@@ -69,6 +70,11 @@ def pipeline_apply(stage_fn, stacked_params, x_micro, axis_name: str = "pipe"):
     Returns ``[n_micro, mb, ...]`` outputs, valid on every device (psum off
     the last stage).
     """
+    if remat:
+        # recompute stage activations in the backward scan instead of saving
+        # every tick's outputs — the GPipe memory trade (docstring: 1F1B-style
+        # memory comes from checkpointing the stage fn)
+        stage_fn = jax.checkpoint(stage_fn)
     n_stages = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     shard = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -111,7 +117,7 @@ def pipeline_apply(stage_fn, stacked_params, x_micro, axis_name: str = "pipe"):
 
 
 def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
-                     axis_name: str = "pipe"):
+                     axis_name: str = "pipe", remat: bool = False):
     """Full-array entry point: shard_map :func:`pipeline_apply` over the
     mesh's ``pipe`` axis (params stage-sharded, microbatches replicated).
     Falls back to a sequential stage chain when the axis is absent/size-1."""
@@ -135,7 +141,8 @@ def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
             return y
         return seq_apply(stacked_params, x_micro)
 
-    fn = functools.partial(pipeline_apply, stage_fn, axis_name=axis_name)
+    fn = functools.partial(pipeline_apply, stage_fn, axis_name=axis_name,
+                           remat=remat)
     mapped = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis_name), stacked_params),

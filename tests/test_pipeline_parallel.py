@@ -126,3 +126,23 @@ def test_pipeline_inside_shard_map_direct():
     np.testing.assert_allclose(np.asarray(mapped(stacked, x)),
                                np.asarray(sequential(stages, x)),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_remat_gradients_match():
+    # jax.checkpoint on the stage fn: same grads, recomputed activations
+    n_stages, n_micro, mb, d = 4, 4, 2, 8
+    stages = make_stages(n_stages, d, seed=12)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(13).normal(size=(n_micro, mb, d)),
+                    jnp.float32)
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+
+    def loss(params, remat):
+        return jnp.sum(pipeline_sharded(mesh, mlp_stage, params, x,
+                                        remat=remat) ** 2)
+
+    g_plain = jax.grad(lambda p: loss(p, False))(stacked)
+    g_remat = jax.grad(lambda p: loss(p, True))(stacked)
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
