@@ -233,3 +233,38 @@ def test_fused_bert_block_graph():
     act = np_gelu(h1 @ w_up + b_up)
     expect = np_layernorm(act @ w_dn + h1, g3, b3)
     np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_embed_layer_norm_position_ids():
+    V, S, H = 12, 4, 8
+    ids = rs.integers(0, V, (2, S)).astype(np.int64)
+    word = rs.normal(size=(V, H)).astype(np.float32)
+    pos = rs.normal(size=(10, H)).astype(np.float32)
+    gamma, beta = np.ones(H, np.float32), np.zeros(H, np.float32)
+    pos_ids = np.asarray([[5, 6, 7, 8], [0, 1, 2, 3]], np.int64)
+    out, _, emb_sum = run_op(
+        "EmbedLayerNormalization",
+        [ids, None, word, pos, None, gamma, beta, None, pos_ids])
+    expect = word[ids] + pos[pos_ids]
+    np.testing.assert_allclose(np.asarray(emb_sum), expect, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out), np_layernorm(expect, gamma, beta),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_attention_additive_bias_input():
+    B, S, Hin = 1, 4, 8
+    x = rs.normal(size=(B, S, Hin)).astype(np.float32)
+    w = (rs.normal(size=(Hin, 3 * Hin)) * 0.3).astype(np.float32)
+    b = np.zeros(3 * Hin, np.float32)
+    bias = rs.normal(size=(1, 2, S, S)).astype(np.float32)  # per-head additive
+    got = np.asarray(run_op("Attention", [x, w, b, None, None, bias],
+                            num_heads=2))
+    # oracle with the bias folded into scores
+    qkv = x @ w
+    q, k, v = np.split(qkv.reshape(B, S, 3, 2, 4).transpose(2, 0, 3, 1, 4), 3)
+    q, k, v = q[0], k[0], v[0]
+    s = np.einsum("bnqd,bnkd->bnqk", q, k) / 2.0 + bias
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = np.einsum("bnqk,bnkd->bnqd", p, v).transpose(0, 2, 1, 3).reshape(B, S, Hin)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
