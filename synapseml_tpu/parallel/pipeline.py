@@ -61,14 +61,18 @@ def pipeline_apply(stage_fn, stacked_params, x_micro, axis_name: str = "pipe",
     arguments:
 
       stage_fn:       ``(params, x) -> y`` — one stage's computation; y must
-                      have x's shape/dtype (chainable stages).
+                      have x's pytree structure/shapes/dtypes (chainable
+                      stages). ``x`` may be ANY pytree — e.g. ``(h, mask)``
+                      so attention masks travel with their microbatch (a
+                      stage returns the mask unchanged).
       stacked_params: THIS device's stage params (leading stage axis already
                       consumed by sharding: ``[1, ...]`` per leaf).
-      x_micro:        ``[n_micro, mb, ...]`` microbatches (stage 0 reads
-                      them; other devices pass zeros of the same shape).
+      x_micro:        pytree of ``[n_micro, mb, ...]`` microbatches (stage 0
+                      reads them; other devices pass zeros of the same
+                      shapes).
 
-    Returns ``[n_micro, mb, ...]`` outputs, valid on every device (psum off
-    the last stage).
+    Returns the same pytree of ``[n_micro, mb, ...]`` outputs, valid on
+    every device (psum off the last stage).
     """
     if remat:
         # recompute stage activations in the backward scan instead of saving
@@ -84,36 +88,39 @@ def pipeline_apply(stage_fn, stacked_params, x_micro, axis_name: str = "pipe",
             f"size ({n_stages}); this device holds {shard} stages — only the "
             f"first would run (wrong results, not an error, if allowed)")
     my_params = jax.tree.map(lambda p: p[0], stacked_params)
-    n_micro = x_micro.shape[0]
+    n_micro = jax.tree.leaves(x_micro)[0].shape[0]
     n_ticks = n_stages - 1 + n_micro
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    tmap = jax.tree.map
 
     def tick(carry, t):
         state, outs = carry
         # stage 0 ingests microbatch t; everyone else keeps the rotated state
-        feed = jnp.where(t < n_micro, x_micro[jnp.minimum(t, n_micro - 1)],
-                         jnp.zeros_like(state))
-        inp = jnp.where(idx == 0, feed, state)
+        feed = tmap(lambda xm, st: jnp.where(
+            t < n_micro, xm[jnp.minimum(t, n_micro - 1)], jnp.zeros_like(st)),
+            x_micro, state)
+        inp = tmap(lambda fd, st: jnp.where(idx == 0, fd, st), feed, state)
         y = stage_fn(my_params, inp)
         # the LAST stage finished microbatch t - (n_stages - 1) at this tick
         m = t - (n_stages - 1)
         take = (idx == n_stages - 1) & (m >= 0)
-        outs = jax.lax.dynamic_update_index_in_dim(
-            outs, jnp.where(take, y, outs[jnp.maximum(m, 0)]),
-            jnp.maximum(m, 0), axis=0)
-        state = jax.lax.ppermute(y, axis_name, perm)
+        outs = tmap(lambda os, yy: jax.lax.dynamic_update_index_in_dim(
+            os, jnp.where(take, yy, os[jnp.maximum(m, 0)]),
+            jnp.maximum(m, 0), axis=0), outs, y)
+        state = tmap(lambda yy: jax.lax.ppermute(yy, axis_name, perm), y)
         return (state, outs), None
 
     # the carry becomes pipe-VARYING inside the loop (ppermute/idx-dependent
     # writes); the init must carry the same varying-axes type or scan rejects
     # the carry under shard_map's vma checking
-    state0 = _pvary(jnp.zeros_like(x_micro[0]), axis_name)
-    outs0 = _pvary(jnp.zeros_like(x_micro), axis_name)
+    state0 = tmap(lambda xm: _pvary(jnp.zeros_like(xm[0]), axis_name), x_micro)
+    outs0 = tmap(lambda xm: _pvary(jnp.zeros_like(xm), axis_name), x_micro)
     (_, outs), _ = jax.lax.scan(tick, (state0, outs0),
                                 jnp.arange(n_ticks, dtype=jnp.int32))
     # only the last stage holds real outputs; zero elsewhere -> psum = bcast
-    outs = jnp.where(idx == n_stages - 1, outs, jnp.zeros_like(outs))
-    return jax.lax.psum(outs, axis_name)
+    outs = tmap(lambda os: jnp.where(idx == n_stages - 1, os,
+                                     jnp.zeros_like(os)), outs)
+    return tmap(lambda os: jax.lax.psum(os, axis_name), outs)
 
 
 def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
@@ -133,9 +140,9 @@ def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
             f"{axis_name!r} axis of size {pipe_size} (one stage per device)")
     if pipe_size <= 1:
         def seq_apply(params_all, xs):
-            n_stages = jax.tree.leaves(params_all)[0].shape[0]
+            n_st = jax.tree.leaves(params_all)[0].shape[0]
             y = xs
-            for s in range(n_stages):
+            for s in range(n_st):
                 y = jax.vmap(lambda x: stage_fn(
                     jax.tree.map(lambda p: p[s], params_all), x))(y)
             return y
@@ -146,7 +153,7 @@ def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
     mapped = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis_name), stacked_params),
-                  P()),
-        out_specs=P(),
+                  jax.tree.map(lambda _: P(), x_micro)),
+        out_specs=jax.tree.map(lambda _: P(), x_micro),
     )
     return mapped(stacked_params, x_micro)

@@ -146,3 +146,31 @@ def test_pipeline_remat_gradients_match():
     for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_pytree_payload_carries_mask():
+    """Stages may pipe PYTREE payloads: (hidden, mask) travel together, the
+    stage transforms hidden under its mask and passes the mask through —
+    the transformer-block shape of pipelining."""
+    n_stages, n_micro, mb, d = 4, 5, 3, 8
+    stages = make_stages(n_stages, d, seed=14)
+    stacked = stack_stage_params(stages)
+    rs = np.random.default_rng(15)
+    x = jnp.asarray(rs.normal(size=(n_micro, mb, d)), jnp.float32)
+    mask = jnp.asarray(rs.random((n_micro, mb, d)) > 0.3, jnp.float32)
+
+    def masked_stage(p, payload):
+        h, m = payload
+        return jnp.tanh((h * m) @ p["w"] + p["b"]), m
+
+    def seq(x, mask):
+        y = x
+        for p in stages:
+            y, _ = jax.vmap(lambda h, m, p=p: masked_stage(p, (h, m)))(y, mask)
+        return y
+
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    out_h, out_m = pipeline_sharded(mesh, masked_stage, stacked, (x, mask))
+    np.testing.assert_allclose(np.asarray(out_h), np.asarray(seq(x, mask)),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out_m), np.asarray(mask))
