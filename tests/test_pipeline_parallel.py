@@ -174,3 +174,46 @@ def test_pipeline_pytree_payload_carries_mask():
     np.testing.assert_allclose(np.asarray(out_h), np.asarray(seq(x, mask)),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_array_equal(np.asarray(out_m), np.asarray(mask))
+
+
+def test_pipeline_real_transformer_blocks():
+    """REAL transformer Blocks through the pipeline: an Encoder's per-layer
+    params restack into stages, each stage applies its Block with the
+    attention mask riding the payload — outputs match the sequential
+    Encoder apply exactly."""
+    from flax.core import meta
+
+    from synapseml_tpu.models.flax_nets.transformer import (Block,
+                                                            TransformerConfig)
+
+    cfg = TransformerConfig(hidden=16, n_layers=4, n_heads=2, mlp_dim=32,
+                            max_len=16, dtype=jnp.float32)
+    block = Block(cfg)
+    rs = np.random.default_rng(16)
+    n_micro, mb, T = 4, 2, 8
+    x = jnp.asarray(rs.normal(size=(n_micro, mb, T, cfg.hidden)), jnp.float32)
+    mask_rows = rs.random((n_micro, mb, T)) > 0.2
+    mask = jnp.asarray(mask_rows[:, :, None, None, :])  # [nm, mb, 1, 1, T]
+
+    layer_params = []
+    for i in range(4):
+        v = block.init(jax.random.PRNGKey(i), x[0], mask[0])
+        layer_params.append(meta.unbox(v)["params"])
+    stacked = stack_stage_params(layer_params)
+
+    def stage(p, payload):
+        h, m = payload
+        return block.apply({"params": p}, h, m), m
+
+    def sequential_blocks(xs, ms):
+        y = xs
+        for p in layer_params:
+            y = jnp.stack([block.apply({"params": p}, y[i], ms[i])
+                           for i in range(n_micro)])
+        return y
+
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    out, _ = pipeline_sharded(mesh, stage, stacked, (x, mask))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(sequential_blocks(x, mask)),
+                               rtol=2e-4, atol=2e-5)
