@@ -80,7 +80,9 @@ def pallas_segment_histogram(seg: jax.Array, data: jax.Array,
             "magnitude slower — use 'segment' or 'onehot' here",
             stacklevel=2)
     N = seg.shape[0]
-    chunk = min(_ROW_CHUNK, max(int(2 ** np.ceil(np.log2(max(N, 8)))), 8))
+    # floor of 128: last-dim tiles below the TPU's 128-lane register width
+    # are not guaranteed to compile in Mosaic (padding covers the unused tail)
+    chunk = min(_ROW_CHUNK, max(int(2 ** np.ceil(np.log2(max(N, 8)))), 128))
     n_chunks = -(-N // chunk)
     n_pad = n_chunks * chunk - N
     bin_tile = min(_BIN_TILE, max(-(-num_segments // 128) * 128, 128))

@@ -375,8 +375,13 @@ def llama_config_from_hf(config: dict, **overrides) -> Any:
         kw.update(moe_experts=E, moe_top_k=k,
                   # HF routing is DROPLESS: per-token expert choices are
                   # distinct, so one expert receives at most S tokens —
-                  # capacity C = cf*S*k/E with cf = E/k gives exactly C = S
-                  moe_capacity_factor=float(E) / k)
+                  # capacity C = cf*S*k/E with cf = E/k gives exactly C = S.
+                  # Dropless capacity REQUIRES the scatter dispatch: the
+                  # einsum layout's [S, E, C] one-hot tensors are O(S^2*E)
+                  # at C = S, unrunnable at real sequence lengths; scatter
+                  # keeps it at O(E*S*H) buffers + O(S*k) index vectors.
+                  moe_capacity_factor=float(E) / k,
+                  moe_dispatch="scatter")
     kw.update(overrides)
     return llama2_7b(**kw)
 

@@ -70,6 +70,14 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
+    # token->expert routing layout: 'einsum' builds [S, E, C] one-hot
+    # dispatch/combine tensors (pure MXU work; right when C is small, i.e.
+    # capacity_factor ~1-2 with switch-style dropping). 'scatter' sorts the
+    # (token, choice) assignments by expert and scatters rows into [E, C, H]
+    # buffers — O(E*C*H) memory and O(S*k*H) index work, never O(S*E*C) —
+    # which is the only feasible layout when capacity must be dropless
+    # (C = S, e.g. ingested Mixtral checkpoints at real sequence lengths).
+    moe_dispatch: str = "einsum"
 
     @property
     def head_dim(self) -> int:
@@ -341,26 +349,54 @@ class MoEBlock(nn.Module):
             gate_vals = gate_vals / jnp.maximum(
                 jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
 
-        dispatch = jnp.zeros((S, E, C), cfg.dtype)
-        combine = jnp.zeros((S, E, C), jnp.float32)
-        position_fill = jnp.zeros((E,), jnp.int32)
-        for choice in range(k):
-            e_oh = jax.nn.one_hot(gate_idx[:, choice], E, dtype=jnp.int32)
-            # position of each token within its chosen expert's buffer,
-            # continuing after slots used by earlier choices
-            pos = jnp.cumsum(e_oh, axis=0) - e_oh + position_fill[None, :]
-            pos_tok = jnp.sum(pos * e_oh, axis=1)          # [S]
-            keep = pos_tok < C
-            slot = jax.nn.one_hot(pos_tok, C, dtype=cfg.dtype) \
-                * keep[:, None].astype(cfg.dtype)          # [S, C]
-            d = e_oh.astype(cfg.dtype)[:, :, None] * slot[:, None, :]
-            dispatch = dispatch + d
-            combine = combine + d.astype(jnp.float32) \
-                * gate_vals[:, choice][:, None, None]
-            position_fill = position_fill + jnp.sum(e_oh, axis=0)
+        if cfg.moe_dispatch not in ("einsum", "scatter"):
+            raise ValueError(
+                f"moe_dispatch must be 'einsum' or 'scatter', got "
+                f"{cfg.moe_dispatch!r}")
+        if cfg.moe_dispatch == "scatter":
+            # Sort the S*k (choice, token) assignments by expert so each
+            # expert's tokens are contiguous, then scatter rows into [E, C, H]
+            # buffers. One extra drop row absorbs capacity overflow (indices
+            # stay in-bounds under jit). The flat layout is CHOICE-MAJOR and
+            # the sort is stable, so capacity fills all first choices before
+            # any second choice — the same drop priority as the einsum loop.
+            Sk = S * k
+            expert_flat = gate_idx.T.reshape(Sk)
+            token_flat = jnp.tile(jnp.arange(S), k)
+            gates_flat = gate_vals.T.reshape(Sk)
+            order = jnp.argsort(expert_flat, stable=True)
+            e_sorted = expert_flat[order]
+            t_sorted = token_flat[order]
+            g_sorted = gates_flat[order]
+            counts = jnp.bincount(e_sorted, length=E)
+            starts = jnp.cumsum(counts) - counts
+            pos = jnp.arange(Sk) - starts[e_sorted]        # slot within expert
+            keep = pos < C
+            buf_idx = jnp.where(keep, e_sorted * C + pos, E * C)
+            expert_in = jnp.zeros((E * C + 1, H), cfg.dtype)
+            expert_in = expert_in.at[buf_idx].set(xf[t_sorted].astype(cfg.dtype))
+            expert_in = expert_in[:E * C].reshape(E, C, H)
+        else:
+            dispatch = jnp.zeros((S, E, C), cfg.dtype)
+            combine = jnp.zeros((S, E, C), jnp.float32)
+            position_fill = jnp.zeros((E,), jnp.int32)
+            for choice in range(k):
+                e_oh = jax.nn.one_hot(gate_idx[:, choice], E, dtype=jnp.int32)
+                # position of each token within its chosen expert's buffer,
+                # continuing after slots used by earlier choices
+                pos = jnp.cumsum(e_oh, axis=0) - e_oh + position_fill[None, :]
+                pos_tok = jnp.sum(pos * e_oh, axis=1)      # [S]
+                keep = pos_tok < C
+                slot = jax.nn.one_hot(pos_tok, C, dtype=cfg.dtype) \
+                    * keep[:, None].astype(cfg.dtype)      # [S, C]
+                d = e_oh.astype(cfg.dtype)[:, :, None] * slot[:, None, :]
+                dispatch = dispatch + d
+                combine = combine + d.astype(jnp.float32) \
+                    * gate_vals[:, choice][:, None, None]
+                position_fill = position_fill + jnp.sum(e_oh, axis=0)
 
-        expert_in = jnp.einsum("sec,sh->ech", dispatch, xf,
-                               preferred_element_type=cfg.dtype)
+            expert_in = jnp.einsum("sec,sh->ech", dispatch, xf,
+                                   preferred_element_type=cfg.dtype)
         expert_in = nn.with_logical_constraint(expert_in,
                                                ("expert", None, "embed"))
 
@@ -398,13 +434,22 @@ class MoEBlock(nn.Module):
                            preferred_element_type=jnp.float32).astype(cfg.dtype) \
             + b_dn[:, None, :].astype(cfg.dtype)
 
-        y = jnp.einsum("sec,ech->sh", combine.astype(jnp.float32),
-                       out_e.astype(jnp.float32),
-                       preferred_element_type=jnp.float32)
+        if cfg.moe_dispatch == "scatter":
+            rows = out_e.reshape(E * C, H)[jnp.minimum(buf_idx, E * C - 1)]
+            contrib = rows.astype(jnp.float32) \
+                * (g_sorted * keep.astype(jnp.float32))[:, None]
+            y = jnp.zeros((S, H), jnp.float32).at[t_sorted].add(contrib)
+        else:
+            y = jnp.einsum("sec,ech->sh", combine.astype(jnp.float32),
+                           out_e.astype(jnp.float32),
+                           preferred_element_type=jnp.float32)
 
-        # switch load-balance aux loss: E * sum_e f_e * P_e
+        # load-balance aux loss: E * sum_e f_e * P_e, with f_e the token
+        # fraction averaged over ALL k routing choices (the Mixtral/switch
+        # formulation — top-1-only would let second choices escape balancing
+        # pressure when k > 1)
         frac_tokens = jnp.mean(
-            jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32), axis=0)
+            jax.nn.one_hot(gate_idx, E, dtype=jnp.float32), axis=(0, 1))
         frac_probs = jnp.mean(probs, axis=0)
         self.sow("intermediates", "moe_aux_loss",
                  E * jnp.sum(frac_tokens * frac_probs))

@@ -1606,7 +1606,10 @@ def _exec_if(node, ins, env: dict):
         return jax.lax.cond(jnp.asarray(cond).ravel()[0].astype(bool),
                             run(attrs["then_branch"]),
                             run(attrs["else_branch"]))
-    except TypeError as e:
+    except (TypeError, ValueError) as e:
+        # lax.cond raises TypeError for dtype/structure mismatches but
+        # ValueError for shape-divergent branches — both mean the same thing
+        # to the caller: this If cannot lower to a traced conditional
         raise NotImplementedError(
             "ONNX If with a data-dependent condition requires both branches "
             f"to produce matching shapes/dtypes for lax.cond: {e}") from e

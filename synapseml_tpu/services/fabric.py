@@ -287,6 +287,7 @@ def assert_model_status(model_name: str, client: FabricClient | None = None) -> 
 
 _installed_sink = None
 _install_lock = __import__("threading").Lock()
+_WORKER_SHUTDOWN = object()  # sentinel: tells a replaced sink's worker to exit
 
 
 def install_certified_events(client: FabricClient | None = None,
@@ -314,6 +315,8 @@ def install_certified_events(client: FabricClient | None = None,
         while True:
             payload = q.get()
             try:
+                if payload is _WORKER_SHUTDOWN:
+                    return
                 log_to_certified_events(payload.get("featureName", "core"),
                                         payload.get("method", "unknown"),
                                         {"uid": str(payload.get("uid", ""))},
@@ -323,8 +326,9 @@ def install_certified_events(client: FabricClient | None = None,
             finally:
                 q.task_done()
 
-    threading.Thread(target=worker, daemon=True,
-                     name="fabric-certified-events").start()
+    thread = threading.Thread(target=worker, daemon=True,
+                              name="fabric-certified-events")
+    thread.start()
 
     def sink(payload: dict) -> None:
         try:
@@ -333,9 +337,31 @@ def install_certified_events(client: FabricClient | None = None,
             pass  # drop: telemetry must never block a stage
 
     sink._queue = q  # tests drain this to assert delivery
+    sink._thread = thread
     with _install_lock:
         if _installed_sink is not None:
             stage_logging.remove_telemetry_sink(_installed_sink)
+            # release the replaced worker — without the sentinel it would
+            # block on its queue's get() forever, leaking one thread per
+            # re-run of the install cell. The worker drains concurrently, so
+            # every queue op here can race (Full/Empty both possible at any
+            # attempt); retry, then fall back to a bounded blocking put.
+            old_q = _installed_sink._queue
+            for _ in range(4):
+                try:
+                    old_q.put_nowait(_WORKER_SHUTDOWN)
+                    break
+                except queue.Full:
+                    try:
+                        old_q.get_nowait()  # make room for the sentinel
+                        old_q.task_done()
+                    except queue.Empty:
+                        pass
+            else:
+                try:
+                    old_q.put(_WORKER_SHUTDOWN, timeout=1.0)
+                except queue.Full:
+                    pass  # worker wedged mid-post; it is a daemon — abandon
         stage_logging.add_telemetry_sink(sink)
         _installed_sink = sink
     return sink

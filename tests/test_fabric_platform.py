@@ -244,10 +244,14 @@ def test_install_certified_events_fires_from_stage_telemetry(tmp_path):
     sent = []
     c = make_client(tmp_path, env={"SYNAPSEML_TPU_FABRIC_TOKEN": "t"},
                     http_send=lambda req: sent.append(req))
-    sink = install_certified_events(client=c)
-    # idempotent: re-install replaces, never stacks
+    first = install_certified_events(client=c)
+    # idempotent: re-install replaces, never stacks — and the replaced
+    # sink's worker thread must exit instead of leaking on its queue
     sink = install_certified_events(client=c)
     assert stage_logging._TELEMETRY_SINKS.count(sink) == 1
+    assert first not in stage_logging._TELEMETRY_SINKS
+    first._thread.join(timeout=5)
+    assert not first._thread.is_alive(), "replaced worker thread leaked"
     try:
         df = st.DataFrame.from_dict({"a": np.arange(3), "b": np.arange(3)})
         SelectColumns(cols=["a"]).transform(df)
