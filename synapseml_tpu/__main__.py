@@ -23,8 +23,42 @@ def _check(name: str, fn, report: list) -> None:
                        f"{type(e).__name__}: {e}"))
 
 
+def _backend_responsive(timeout_s: float = 75.0) -> bool:
+    """Probe default-backend init in a SUBPROCESS under a timeout.
+
+    The axon TPU relay can wedge so hard that even ``jax.devices()`` never
+    returns, and once a process is stuck in that C call it cannot be
+    un-hung — so the probe must burn a child process, not a thread."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        return subprocess.run(
+            [_sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True).returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def selftest(argv: list[str] | None = None) -> int:
     import numpy as np
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--help" in argv or "-h" in argv:
+        print("usage: synapseml-tpu-selftest [--cpu]\n\n"
+              "Environment self-test: backend, mesh, GBDT, text classifier,\n"
+              "ONNX registry, native build — each reported PASS/FAIL.\n\n"
+              "  --cpu   skip the accelerator probe and run on CPU")
+        return 0
+
+    import jax
+
+    if "--cpu" in argv:
+        jax.config.update("jax_platforms", "cpu")
+    elif not _backend_responsive():
+        print("default backend unresponsive (relay down?) — "
+              "falling back to CPU\n")
+        jax.config.update("jax_platforms", "cpu")
 
     report: list = []
 

@@ -1602,17 +1602,19 @@ def _exec_if(node, ins, env: dict):
         return lambda: tuple(jnp.asarray(o)
                              for o in _run_subgraph(branch, env, {}))
 
-    try:
-        return jax.lax.cond(jnp.asarray(cond).ravel()[0].astype(bool),
-                            run(attrs["then_branch"]),
-                            run(attrs["else_branch"]))
-    except (TypeError, ValueError) as e:
-        # lax.cond raises TypeError for dtype/structure mismatches but
-        # ValueError for shape-divergent branches — both mean the same thing
-        # to the caller: this If cannot lower to a traced conditional
+    then_fn, else_fn = run(attrs["then_branch"]), run(attrs["else_branch"])
+    # trace each branch OUTSIDE the mismatch diagnosis: a genuine op error
+    # inside a branch body must surface as itself, not be relabeled as a
+    # branch shape/dtype mismatch
+    then_out = jax.eval_shape(then_fn)
+    else_out = jax.eval_shape(else_fn)
+    if then_out != else_out:
         raise NotImplementedError(
             "ONNX If with a data-dependent condition requires both branches "
-            f"to produce matching shapes/dtypes for lax.cond: {e}") from e
+            "to produce matching shapes/dtypes for lax.cond: "
+            f"then={then_out} vs else={else_out}")
+    return jax.lax.cond(jnp.asarray(cond).ravel()[0].astype(bool),
+                        then_fn, else_fn)
 
 
 def _run_subgraph(body, env: dict, bound: dict):

@@ -339,29 +339,32 @@ def install_certified_events(client: FabricClient | None = None,
     sink._queue = q  # tests drain this to assert delivery
     sink._thread = thread
     with _install_lock:
-        if _installed_sink is not None:
-            stage_logging.remove_telemetry_sink(_installed_sink)
-            # release the replaced worker — without the sentinel it would
-            # block on its queue's get() forever, leaking one thread per
-            # re-run of the install cell. The worker drains concurrently, so
-            # every queue op here can race (Full/Empty both possible at any
-            # attempt); retry, then fall back to a bounded blocking put.
-            old_q = _installed_sink._queue
-            for _ in range(4):
-                try:
-                    old_q.put_nowait(_WORKER_SHUTDOWN)
-                    break
-                except queue.Full:
-                    try:
-                        old_q.get_nowait()  # make room for the sentinel
-                        old_q.task_done()
-                    except queue.Empty:
-                        pass
-            else:
-                try:
-                    old_q.put(_WORKER_SHUTDOWN, timeout=1.0)
-                except queue.Full:
-                    pass  # worker wedged mid-post; it is a daemon — abandon
+        replaced = _installed_sink
+        if replaced is not None:
+            stage_logging.remove_telemetry_sink(replaced)
         stage_logging.add_telemetry_sink(sink)
         _installed_sink = sink
+    if replaced is not None:
+        # release the replaced worker — without the sentinel it would block
+        # on its queue's get() forever, leaking one thread per re-run of the
+        # install cell. Done AFTER dropping the lock so a wedged worker
+        # can't stall other installers. The worker drains concurrently, so
+        # every queue op here can race (Full/Empty both possible at any
+        # attempt); retry, then fall back to a bounded blocking put.
+        old_q = replaced._queue
+        for _ in range(4):
+            try:
+                old_q.put_nowait(_WORKER_SHUTDOWN)
+                break
+            except queue.Full:
+                try:
+                    old_q.get_nowait()  # make room for the sentinel
+                    old_q.task_done()
+                except queue.Empty:
+                    pass
+        else:
+            try:
+                old_q.put(_WORKER_SHUTDOWN, timeout=1.0)
+            except queue.Full:
+                pass  # worker wedged mid-post; it is a daemon — abandon
     return sink
