@@ -3,8 +3,13 @@ per-tenant ALS over (user, resource) access counts; the anomaly score of an
 observed access is its standardized NEGATIVE predicted affinity — accesses the
 factor model finds unlikely score high.
 
-TPU shape: the ALS normal equations are dense batched solves (jax
-``vmap(solve)`` over users/resources); per-tenant models are independent.
+TPU shape: the ALS normal equations are batched solves (``vmap(solve)``
+over users/resources). Small tenants materialize the dense [U, R] count
+matrix; past ``_DENSE_LIMIT`` cells the solver switches to an
+nnz-proportional edge-list formulation (the Hu-Koren-Volinsky identity:
+``A_u = FᵀF + Σ_obs (c-1)·f fᵀ + λI``, ``b_u = Σ_obs c·f``) built with
+``segment_sum`` — memory scales with observed interactions, never with
+U×R, matching the reference's sparse distributed ALS at tenant scale.
 """
 
 from __future__ import annotations
@@ -50,6 +55,61 @@ def _als(counts: np.ndarray, rank: int, reg: float, n_iter: int, seed: int,
     return np.asarray(u_f), np.asarray(r_f)
 
 
+# dense-path ceiling: tenants whose U*R cell count exceeds this solve on the
+# edge list instead (identical math — the sparse/dense equivalence is tested)
+_DENSE_LIMIT = 1 << 22
+
+
+def _als_sparse(u_idx: np.ndarray, r_idx: np.ndarray, w: np.ndarray,
+                n_users: int, n_res: int, rank: int, reg: float,
+                n_iter: int, seed: int, alpha: float = 1.0):
+    """Implicit-feedback ALS on the (user, res, weight) edge list.
+
+    Memory and FLOPs are proportional to nnz (plus the [U,k]/[R,k] factors
+    and transient [nnz, k, k] outer products), never to U*R. Exactly the
+    same math as :func:`_als`: unobserved cells have confidence 1 and
+    preference 0, so their whole contribution is the shared FᵀF term.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    u_f = jnp.asarray(rng.normal(scale=0.1, size=(n_users, rank)), jnp.float32)
+    r_f = jnp.asarray(rng.normal(scale=0.1, size=(n_res, rank)), jnp.float32)
+    u_e = jnp.asarray(u_idx, jnp.int32)
+    r_e = jnp.asarray(r_idx, jnp.int32)
+    conf = jnp.asarray(1.0 + alpha * w, jnp.float32)          # per-edge c
+    # explicit preference, exactly the dense path's (counts > 0): an edge
+    # whose aggregated weight is 0 or negative keeps its confidence term in
+    # A but contributes nothing to b — without this, zero-weight edges
+    # would silently flip preference depending on which solver a tenant's
+    # size routed it to
+    pref = jnp.asarray(np.asarray(w) > 0, jnp.float32)
+    eye = jnp.eye(rank, dtype=jnp.float32) * reg
+
+    def solve_side(fixed, seg_ids, gather_ids, n_rows):
+        # per row i: (FᵀF + Σ_obs (c-1) f fᵀ + λI) x = Σ_obs c p f
+        G = fixed.T @ fixed                                    # [k, k]
+        f_e = fixed[gather_ids]                                # [nnz, k]
+        outer = (conf - 1.0)[:, None, None] \
+            * (f_e[:, :, None] * f_e[:, None, :])              # [nnz, k, k]
+        A = jax.ops.segment_sum(outer, seg_ids, num_segments=n_rows) \
+            + G[None] + eye[None]
+        b = jax.ops.segment_sum((conf * pref)[:, None] * f_e, seg_ids,
+                                num_segments=n_rows)
+        return jnp.linalg.solve(A, b[..., None])[..., 0]
+
+    @jax.jit
+    def sweep(uf, rf):
+        uf = solve_side(rf, u_e, r_e, n_users)
+        rf = solve_side(uf, r_e, u_e, n_res)
+        return uf, rf
+
+    for _ in range(n_iter):
+        u_f, r_f = sweep(u_f, r_f)
+    return np.asarray(u_f), np.asarray(r_f)
+
+
 class AccessAnomaly(Estimator):
     feature_name = "cyber"
 
@@ -87,11 +147,23 @@ class AccessAnomaly(Estimator):
             m = tenants == tenant
             u_levels, u_idx = np.unique(users[m], return_inverse=True)
             r_levels, r_idx = np.unique(ress[m], return_inverse=True)
-            counts = np.zeros((len(u_levels), len(r_levels)), np.float64)
-            np.add.at(counts, (u_idx, r_idx), weights[m])
-            u_f, r_f = _als(counts, min(self.get("rank"),
-                                        min(counts.shape) or 1),
-                            self.get("reg"), self.get("max_iter"), self.get("seed"))
+            U, R = len(u_levels), len(r_levels)
+            rank_t = min(self.get("rank"), min(U, R) or 1)
+            if U * R <= _DENSE_LIMIT:
+                counts = np.zeros((U, R), np.float64)
+                np.add.at(counts, (u_idx, r_idx), weights[m])
+                u_f, r_f = _als(counts, rank_t, self.get("reg"),
+                                self.get("max_iter"), self.get("seed"))
+            else:
+                # aggregate duplicate (user, res) edges, then solve on the
+                # edge list — never materializing the [U, R] matrix
+                key = u_idx.astype(np.int64) * R + r_idx
+                uniq, inv = np.unique(key, return_inverse=True)
+                w_agg = np.zeros(len(uniq), np.float64)
+                np.add.at(w_agg, inv, weights[m])
+                u_f, r_f = _als_sparse(uniq // R, uniq % R, w_agg, U, R,
+                                       rank_t, self.get("reg"),
+                                       self.get("max_iter"), self.get("seed"))
             # standardize affinity over OBSERVED accesses within the tenant
             aff = np.sum(u_f[u_idx] * r_f[r_idx], axis=1)
             mu, sd = float(aff.mean()), float(aff.std() or 1.0)

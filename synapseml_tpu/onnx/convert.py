@@ -1603,18 +1603,22 @@ def _exec_if(node, ins, env: dict):
                              for o in _run_subgraph(branch, env, {}))
 
     then_fn, else_fn = run(attrs["then_branch"]), run(attrs["else_branch"])
-    # trace each branch OUTSIDE the mismatch diagnosis: a genuine op error
-    # inside a branch body must surface as itself, not be relabeled as a
-    # branch shape/dtype mismatch
-    then_out = jax.eval_shape(then_fn)
-    else_out = jax.eval_shape(else_fn)
-    if then_out != else_out:
-        raise NotImplementedError(
-            "ONNX If with a data-dependent condition requires both branches "
-            "to produce matching shapes/dtypes for lax.cond: "
-            f"then={then_out} vs else={else_out}")
-    return jax.lax.cond(jnp.asarray(cond).ravel()[0].astype(bool),
-                        then_fn, else_fn)
+    try:
+        return jax.lax.cond(jnp.asarray(cond).ravel()[0].astype(bool),
+                            then_fn, else_fn)
+    except (TypeError, ValueError):
+        # diagnose only on failure (the happy path stays single-trace):
+        # re-trace each branch ALONE — a genuine op error inside a branch
+        # body surfaces as itself here, while matching branch structures
+        # mean the failure was lax.cond's own and is re-raised unchanged
+        then_out = jax.eval_shape(then_fn)
+        else_out = jax.eval_shape(else_fn)
+        if then_out != else_out:
+            raise NotImplementedError(
+                "ONNX If with a data-dependent condition requires both "
+                "branches to produce matching shapes/dtypes for lax.cond: "
+                f"then={then_out} vs else={else_out}") from None
+        raise
 
 
 def _run_subgraph(body, env: dict, bound: dict):

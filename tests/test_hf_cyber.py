@@ -104,6 +104,72 @@ def test_access_anomaly():
     assert np.isnan(model.transform(t2).collect_column("anomaly_score")[0])
 
 
+def test_access_anomaly_sparse_matches_dense():
+    # the edge-list ALS is the same math as the dense solver — identical
+    # init (same seed, same shapes), so factors must agree to float tolerance
+    from synapseml_tpu.cyber.anomaly import _als, _als_sparse
+
+    rs = np.random.default_rng(0)
+    U, R, nnz = 40, 25, 300
+    u = rs.integers(0, U, nnz)
+    r = rs.integers(0, R, nnz)
+    w = rs.uniform(0.5, 3.0, nnz)
+    w[:15] = 0.0  # zero-weight edges: preference 0 on both paths
+    counts = np.zeros((U, R))
+    np.add.at(counts, (u, r), w)
+    key = u.astype(np.int64) * R + r
+    uniq, inv = np.unique(key, return_inverse=True)
+    w_agg = np.zeros(len(uniq))
+    np.add.at(w_agg, inv, w)
+
+    uf_d, rf_d = _als(counts, rank=6, reg=0.1, n_iter=6, seed=3)
+    uf_s, rf_s = _als_sparse(uniq // R, uniq % R, w_agg, U, R,
+                             rank=6, reg=0.1, n_iter=6, seed=3)
+    np.testing.assert_allclose(uf_s, uf_d, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(rf_s, rf_d, rtol=2e-3, atol=2e-4)
+
+
+def test_access_anomaly_sparse_path_through_estimator(monkeypatch):
+    # force the sparse solver for the public fit/transform flow: the same
+    # behavioral guarantees as the dense path must hold
+    from synapseml_tpu.cyber import anomaly as anomaly_mod
+
+    monkeypatch.setattr(anomaly_mod, "_DENSE_LIMIT", 0)
+    df = make_access_df()
+    model = AccessAnomaly(tenant_col="tenant", rank=4, max_iter=8).fit(df)
+    test = DataFrame.from_dict({
+        "tenant": np.asarray(["A", "A"], dtype=object),
+        "user": np.asarray(["u0", "u4"], dtype=object),
+        "res": np.asarray(["r0", "r0"], dtype=object)})
+    scores = model.transform(test).collect_column("anomaly_score")
+    assert scores[1] > scores[0] + 0.5
+
+
+@pytest.mark.slow
+def test_access_anomaly_large_tenant_gate():
+    # >=100k interactions on a tenant whose U*R cell count (5M) exceeds
+    # _DENSE_LIMIT: fitting must take the edge-list path (never building
+    # the dense matrix) and still separate in-clique from cross-clique
+    from synapseml_tpu.cyber.anomaly import _DENSE_LIMIT
+
+    rs = np.random.default_rng(0)
+    U, R, n = 5000, 1000, 120_000
+    assert U * R > _DENSE_LIMIT
+    # two cliques: users 0..U/2 access resources 0..R/2, rest the other half
+    uu = rs.integers(0, U, n)
+    clique = (uu < U // 2).astype(np.int64)
+    rr = rs.integers(0, R // 2, n) + (1 - clique) * (R // 2)
+    df = DataFrame.from_dict({
+        "user": np.char.add("u", uu.astype(str)).astype(object),
+        "res": np.char.add("r", rr.astype(str)).astype(object)})
+    model = AccessAnomaly(rank=8, max_iter=4).fit(df)
+    probe = DataFrame.from_dict({
+        "user": np.asarray(["u10", "u10"], dtype=object),
+        "res": np.asarray(["r10", f"r{R - 10}"], dtype=object)})
+    s = model.transform(probe).collect_column("anomaly_score")
+    assert s[1] > s[0] + 0.5, s  # cross-clique access is anomalous
+
+
 def test_complement_access():
     df = make_access_df()
     comp = ComplementAccessTransformer(tenant_col="tenant", factor=1, seed=0)
