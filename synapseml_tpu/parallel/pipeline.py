@@ -34,16 +34,21 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["pipeline_apply", "pipeline_sharded", "stack_stage_params"]
+__all__ = ["pipeline_apply", "pipeline_apply_scattered", "pipeline_sharded",
+           "stack_stage_params"]
 
 
 def _pvary(x, axis_name):
     """Mark x as varying over axis_name (vma typing); tolerate jax versions
-    where the API is pcast / pvary / absent."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axis_name, to="varying")
-    if hasattr(jax.lax, "pvary"):
-        return jax.lax.pvary(x, axis_name)
+    where the API is pcast / pvary / absent, and values already varying
+    over the axis (pcast rejects varying->varying)."""
+    try:
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, axis_name, to="varying")
+        if hasattr(jax.lax, "pvary"):
+            return jax.lax.pvary(x, axis_name)
+    except ValueError:
+        pass  # already varying over axis_name
     return x
 
 
@@ -51,6 +56,26 @@ def stack_stage_params(stage_params_list):
     """[params_stage0, ...] -> one pytree with a leading stage axis (shard it
     over ``pipe``)."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params_list)
+
+
+def _stage_preamble(stage_fn, stacked_params, axis_name, remat):
+    """Shared per-device setup for both schedules: optional remat wrap, axis
+    geometry, and the one-stage-per-device check. Returns
+    ``(stage_fn, n_stages, idx, my_params)``."""
+    if remat:
+        # recompute stage activations in the backward scan instead of saving
+        # every tick's outputs — the GPipe memory trade
+        stage_fn = jax.checkpoint(stage_fn)
+    n_stages = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    shard = jax.tree.leaves(stacked_params)[0].shape[0]
+    if shard != 1:
+        raise ValueError(
+            f"pipeline: stage count must equal the {axis_name!r} axis size "
+            f"({n_stages}); this device holds {shard} stages — only the "
+            f"first would run (wrong results, not an error, if allowed)")
+    my_params = jax.tree.map(lambda p: p[0], stacked_params)
+    return stage_fn, n_stages, idx, my_params
 
 
 def pipeline_apply(stage_fn, stacked_params, x_micro, axis_name: str = "pipe",
@@ -74,20 +99,8 @@ def pipeline_apply(stage_fn, stacked_params, x_micro, axis_name: str = "pipe",
     Returns the same pytree of ``[n_micro, mb, ...]`` outputs, valid on
     every device (psum off the last stage).
     """
-    if remat:
-        # recompute stage activations in the backward scan instead of saving
-        # every tick's outputs — the GPipe memory trade (docstring: 1F1B-style
-        # memory comes from checkpointing the stage fn)
-        stage_fn = jax.checkpoint(stage_fn)
-    n_stages = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
-    shard = jax.tree.leaves(stacked_params)[0].shape[0]
-    if shard != 1:
-        raise ValueError(
-            f"pipeline_apply: stage count must equal the {axis_name!r} axis "
-            f"size ({n_stages}); this device holds {shard} stages — only the "
-            f"first would run (wrong results, not an error, if allowed)")
-    my_params = jax.tree.map(lambda p: p[0], stacked_params)
+    stage_fn, n_stages, idx, my_params = _stage_preamble(
+        stage_fn, stacked_params, axis_name, remat)
     n_micro = jax.tree.leaves(x_micro)[0].shape[0]
     n_ticks = n_stages - 1 + n_micro
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -123,13 +136,93 @@ def pipeline_apply(stage_fn, stacked_params, x_micro, axis_name: str = "pipe",
     return tmap(lambda os: jax.lax.psum(os, axis_name), outs)
 
 
+def pipeline_apply_scattered(stage_fn, stacked_params, x_local,
+                             axis_name: str = "pipe", remat: bool = False):
+    """Memory-scaled variant of :func:`pipeline_apply`: microbatch inputs AND
+    outputs are sharded over the pipe axis (device d owns microbatches
+    ``[d*chunk, (d+1)*chunk)``), so per-device live memory is
+    ``O(n_micro / n_stages)`` owned microbatches plus three in-flight slots —
+    never the replicated ``O(n_micro)`` buffers of the GPipe entry point.
+
+    Mechanics (all static-shape, all ICI neighbor traffic):
+
+    * FEED ring (reverse rotation): slot d holds microbatch ``t + d`` at tick
+      t; a device swaps in its own copy whenever that index falls in its
+      chunk, and stage 0 consumes slot 0 — microbatch t arrives exactly on
+      schedule without ever being replicated.
+    * compute + forward rotation: identical to :func:`pipeline_apply`.
+    * DRAIN ring (forward rotation): a finished microbatch enters at the last
+      stage and rides the ring; every device sees it within S-1 hops and its
+      owner copies it into the local output chunk (idempotent on later
+      passes, so stale entries are harmless).
+
+    Tick count grows from ``S-1+M`` to ``M + 2S - 2`` (the drain tail).
+    """
+    stage_fn, n_stages, idx, my_params = _stage_preamble(
+        stage_fn, stacked_params, axis_name, remat)
+    chunk = jax.tree.leaves(x_local)[0].shape[0]
+    n_micro = chunk * n_stages
+    n_ticks = n_micro + 2 * n_stages - 2
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    rev = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    tmap = jax.tree.map
+
+    def tick(carry, t):
+        state, feed, drain, drain_m, outs = carry
+        # feed ring: this device's slot carries microbatch t + idx
+        m_here = t + idx
+        local_i = jnp.clip(m_here - idx * chunk, 0, chunk - 1)
+        mine = (m_here >= idx * chunk) & (m_here < (idx + 1) * chunk)
+        feed = tmap(lambda xl, f: jnp.where(mine, xl[local_i], f),
+                    x_local, feed)
+        inp = tmap(lambda f, st: jnp.where(idx == 0, f, st), feed, state)
+        y = stage_fn(my_params, inp)
+        # drain ring: the last stage finished microbatch t - (S-1) this tick
+        m_done = t - (n_stages - 1)
+        fresh = (idx == n_stages - 1) & (m_done >= 0) & (m_done < n_micro)
+        drain = tmap(lambda yy, dr: jnp.where(fresh, yy, dr), y, drain)
+        drain_m = jnp.where(fresh, m_done, drain_m)
+        # owners copy passing microbatches into their local output chunk
+        own = (drain_m >= idx * chunk) & (drain_m < (idx + 1) * chunk)
+        slot = jnp.clip(drain_m - idx * chunk, 0, chunk - 1)
+        outs = tmap(lambda os, dr: jax.lax.dynamic_update_index_in_dim(
+            os, jnp.where(own, dr, os[slot]), slot, axis=0), outs, drain)
+        state = tmap(lambda yy: jax.lax.ppermute(yy, axis_name, fwd), y)
+        feed = tmap(lambda f: jax.lax.ppermute(f, axis_name, rev), feed)
+        drain = tmap(lambda d: jax.lax.ppermute(d, axis_name, fwd), drain)
+        drain_m = jax.lax.ppermute(drain_m, axis_name, fwd)
+        return (state, feed, drain, drain_m, outs), None
+
+    one = tmap(lambda xl: _pvary(jnp.zeros_like(xl[0]), axis_name), x_local)
+    outs0 = tmap(lambda xl: _pvary(jnp.zeros_like(xl), axis_name), x_local)
+    m0 = _pvary(jnp.int32(-1), axis_name)
+    (_, _, _, _, outs), _ = jax.lax.scan(
+        tick, (one, tmap(jnp.copy, one), tmap(jnp.copy, one), m0, outs0),
+        jnp.arange(n_ticks, dtype=jnp.int32))
+    return outs
+
+
 def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
-                     axis_name: str = "pipe", remat: bool = False):
-    """Full-array entry point: shard_map :func:`pipeline_apply` over the
-    mesh's ``pipe`` axis (params stage-sharded, microbatches replicated).
-    Falls back to a sequential stage chain when the axis is absent/size-1."""
+                     axis_name: str = "pipe", remat: bool = False,
+                     io: str = "replicated"):
+    """Full-array entry point: shard_map the pipeline schedule over the
+    mesh's ``pipe`` axis (params stage-sharded). Falls back to a sequential
+    stage chain when the axis is absent/size-1.
+
+    ``io`` picks the microbatch layout:
+
+    * ``"replicated"`` (GPipe default): microbatches replicated in, outputs
+      psum-broadcast to every device — right for the estimator-sized
+      tensors this library pipelines by default.
+    * ``"sharded"``: microbatches and outputs sharded over the pipe axis
+      (``n_micro`` must divide by it) via :func:`pipeline_apply_scattered` —
+      per-device activation memory scales as 1/n_stages, the production
+      layout for real model sizes.
+    """
     from jax.sharding import PartitionSpec as P
 
+    if io not in ("replicated", "sharded"):
+        raise ValueError(f"io must be 'replicated' or 'sharded', got {io!r}")
     mesh = getattr(mesh_ctx, "mesh", mesh_ctx)
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -138,6 +231,14 @@ def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
         raise ValueError(
             f"pipeline_sharded: {n_stages} stages cannot shard over a "
             f"{axis_name!r} axis of size {pipe_size} (one stage per device)")
+    if io == "sharded":
+        # validated BEFORE the size-1 fallback so misuse surfaces in
+        # single-device dev runs, not first on the deployment mesh
+        n_micro = jax.tree.leaves(x_micro)[0].shape[0]
+        if n_micro % max(pipe_size, n_stages):
+            raise ValueError(
+                f"io='sharded' needs n_micro ({n_micro}) divisible by the "
+                f"{axis_name!r} axis size ({max(pipe_size, n_stages)})")
     if pipe_size <= 1:
         def seq_apply(params_all, xs):
             n_st = jax.tree.leaves(params_all)[0].shape[0]
@@ -148,12 +249,18 @@ def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
             return y
         return seq_apply(stacked_params, x_micro)
 
-    fn = functools.partial(pipeline_apply, stage_fn, axis_name=axis_name,
-                           remat=remat)
+    if io == "sharded":
+        fn = functools.partial(pipeline_apply_scattered, stage_fn,
+                               axis_name=axis_name, remat=remat)
+        micro_spec = jax.tree.map(lambda _: P(axis_name), x_micro)
+    else:
+        fn = functools.partial(pipeline_apply, stage_fn, axis_name=axis_name,
+                               remat=remat)
+        micro_spec = jax.tree.map(lambda _: P(), x_micro)
     mapped = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis_name), stacked_params),
-                  jax.tree.map(lambda _: P(), x_micro)),
-        out_specs=jax.tree.map(lambda _: P(), x_micro),
+                  micro_spec),
+        out_specs=micro_spec,
     )
     return mapped(stacked_params, x_micro)

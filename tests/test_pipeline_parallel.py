@@ -176,6 +176,115 @@ def test_pipeline_pytree_payload_carries_mask():
     np.testing.assert_array_equal(np.asarray(out_m), np.asarray(mask))
 
 
+@pytest.mark.parametrize("n_micro", [4, 8])
+def test_pipeline_sharded_io_matches_sequential(n_micro):
+    # io='sharded': microbatches in AND out live sharded over pipe
+    n_stages, mb, d = 4, 3, 8
+    stages = make_stages(n_stages, d)
+    stacked = stack_stage_params(stages)
+    rs = np.random.default_rng(21)
+    x = jnp.asarray(rs.normal(size=(n_micro, mb, d)), jnp.float32)
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    out = pipeline_sharded(mesh, mlp_stage, stacked, x, io="sharded")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(sequential(stages, x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_sharded_io_gradients_match():
+    n_stages, n_micro, mb, d = 4, 8, 2, 8
+    stages = make_stages(n_stages, d, seed=22)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(23).normal(size=(n_micro, mb, d)),
+                    jnp.float32)
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+
+    def loss(params, io):
+        return jnp.sum(pipeline_sharded(mesh, mlp_stage, params, x,
+                                        io=io) ** 2)
+
+    g_rep = jax.grad(lambda p: loss(p, "replicated"))(stacked)
+    g_shd = jax.grad(lambda p: loss(p, "sharded"))(stacked)
+    for a, b in zip(jax.tree.leaves(g_rep), jax.tree.leaves(g_shd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_sharded_io_memory_scales_inverse_with_stages():
+    """The 1/S memory contract: with io='sharded' each device addresses only
+    n_micro/S microbatches of the output (and the schedule's carry holds
+    O(chunk) slots), vs the replicated layout's full n_micro everywhere."""
+    n_stages, n_micro, mb, d = 4, 8, 2, 8
+    stages = make_stages(n_stages, d, seed=24)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(25).normal(size=(n_micro, mb, d)),
+                    jnp.float32)
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    assert dict(mesh.mesh.shape)["pipe"] == n_stages  # not the seq fallback
+    with mesh.mesh:
+        out_s = jax.jit(lambda p, xx: pipeline_sharded(
+            mesh, mlp_stage, p, xx, io="sharded"))(stacked, x)
+        out_r = jax.jit(lambda p, xx: pipeline_sharded(
+            mesh, mlp_stage, p, xx, io="replicated"))(stacked, x)
+    # per-device shard of the sharded output is 1/S of the microbatches
+    shard_shapes = {s.data.shape for s in out_s.addressable_shards}
+    assert shard_shapes == {(n_micro // n_stages, mb, d)}, shard_shapes
+    # the replicated layout holds ALL microbatches on every device
+    assert {s.data.shape for s in out_r.addressable_shards} \
+        == {(n_micro, mb, d)}
+    # and the compiled per-device program's live buffers reflect it when the
+    # backend reports memory analysis (probing guarded — the assert is not)
+    out_sz_s = out_sz_r = 0
+    try:
+        lowered_s = jax.jit(lambda p, xx: pipeline_sharded(
+            mesh, mlp_stage, p, xx, io="sharded")).lower(stacked, x)
+        lowered_r = jax.jit(lambda p, xx: pipeline_sharded(
+            mesh, mlp_stage, p, xx, io="replicated")).lower(stacked, x)
+        ma_s = lowered_s.compile().memory_analysis()
+        ma_r = lowered_r.compile().memory_analysis()
+        out_sz_s = getattr(ma_s, "output_size_in_bytes", 0)
+        out_sz_r = getattr(ma_r, "output_size_in_bytes", 0)
+    except (NotImplementedError, AttributeError, RuntimeError):
+        pass  # backend without memory analysis: shard-shape assertions above
+    if out_sz_s and out_sz_r:
+        assert out_sz_s <= out_sz_r, (out_sz_s, out_sz_r)
+
+
+def test_pipeline_sharded_io_pytree_payload():
+    n_stages, n_micro, mb, d = 4, 4, 3, 8
+    stages = make_stages(n_stages, d, seed=26)
+    stacked = stack_stage_params(stages)
+    rs = np.random.default_rng(27)
+    x = jnp.asarray(rs.normal(size=(n_micro, mb, d)), jnp.float32)
+    mask = jnp.asarray(rs.random((n_micro, mb, d)) > 0.3, jnp.float32)
+
+    def masked_stage(p, payload):
+        h, m = payload
+        return jnp.tanh((h * m) @ p["w"] + p["b"]), m
+
+    def seq(x, mask):
+        y = x
+        for p in stages:
+            y, _ = jax.vmap(lambda h, m, p=p: masked_stage(p, (h, m)))(y, mask)
+        return y
+
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    out_h, out_m = pipeline_sharded(mesh, masked_stage, stacked, (x, mask),
+                                    io="sharded")
+    np.testing.assert_allclose(np.asarray(out_h), np.asarray(seq(x, mask)),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out_m), np.asarray(mask))
+
+
+def test_pipeline_sharded_io_rejects_indivisible():
+    stages = make_stages(4, 4, seed=28)
+    stacked = stack_stage_params(stages)
+    x = jnp.zeros((6, 2, 4), jnp.float32)  # 6 % 4 != 0
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline_sharded(mesh, mlp_stage, stacked, x, io="sharded")
+
+
 def test_pipeline_real_transformer_blocks():
     """REAL transformer Blocks through the pipeline: an Encoder's per-layer
     params restack into stages, each stage applies its Block with the
