@@ -21,7 +21,7 @@ from ..core.params import ComplexParam, Param, ServiceParam
 from ..core.pipeline import Estimator, Model, PipelineStage, Transformer
 
 __all__ = ["discover_stages", "stage_manifest", "generate_markdown_docs",
-           "write_docs", "emit_wrappers"]
+           "write_docs", "emit_wrappers", "facts"]
 
 _ABSTRACT = {"PipelineStage", "Transformer", "Estimator", "Model"}
 
@@ -114,8 +114,48 @@ def generate_markdown_docs() -> dict[str, str]:
     return docs
 
 
+def facts() -> dict:
+    """Self-reported numbers computed FROM the code, never hand-maintained.
+
+    Reports (COVERAGE.md, README.md, docstrings) must quote these; the
+    drift test (``tests/test_codegen.py``) greps the documents for numeric
+    claims and fails when they disagree with this function — the same
+    pattern that keeps the generated wrappers honest.
+    """
+    from ..onnx.convert import OP_REGISTRY
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    def _count(relpath: str, suffix: str) -> int:
+        d = os.path.join(repo, relpath)
+        try:
+            return sum(1 for n in os.listdir(d) if n.endswith(suffix))
+        except OSError:
+            return 0
+
+    from ..onnx.contrib import CONTRIB_OPS
+
+    svc_dir = os.path.join(repo, "synapseml_tpu", "services")
+    try:
+        n_services = sum(1 for n in os.listdir(svc_dir)
+                         if n.endswith(".py") and n != "__init__.py")
+    except OSError:
+        n_services = 0
+    return {
+        "onnx_ops": len(OP_REGISTRY),
+        "onnx_contrib_ops": len(CONTRIB_OPS),
+        "stage_classes": len(discover_stages()),
+        "notebooks": _count("docs/notebooks", ".ipynb"),
+        "walkthroughs": _count("docs/walkthroughs", ".py"),
+        "examples": _count("docs/examples", ".py"),
+        "service_modules": n_services,
+    }
+
+
 def write_docs(output_dir: str) -> list[str]:
-    """Emit docs/api/*.md + stages.json; returns written paths."""
+    """Emit docs/api/*.md + stages.json + facts.json; returns written
+    paths."""
     os.makedirs(output_dir, exist_ok=True)
     written = []
     for family, md in generate_markdown_docs().items():
@@ -127,6 +167,10 @@ def write_docs(output_dir: str) -> list[str]:
     with open(manifest_path, "w") as f:
         json.dump(stage_manifest(), f, indent=2)
     written.append(manifest_path)
+    facts_path = os.path.join(output_dir, "facts.json")
+    with open(facts_path, "w") as f:
+        json.dump(facts(), f, indent=2)
+    written.append(facts_path)
     return written
 
 

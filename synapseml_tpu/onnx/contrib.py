@@ -21,6 +21,8 @@ import numpy as np
 
 from .convert import OP_REGISTRY, op
 
+_BEFORE_CONTRIB = frozenset(OP_REGISTRY)
+
 _SQRT_2_OVER_PI = 0.7978845608028654
 
 
@@ -190,3 +192,7 @@ def _attention(ins, attrs):
 # Gelu exists in the standard opset registry; com.microsoft Gelu is the same
 # exact-erf form, so the shared entry in convert.py covers both domains.
 assert "Gelu" in OP_REGISTRY
+
+# registration-time truth for codegen.facts(): exactly the ops this module
+# added to the shared registry
+CONTRIB_OPS = frozenset(OP_REGISTRY) - _BEFORE_CONTRIB

@@ -7,7 +7,7 @@ compiles (and fuses) for the device. Weights become closure constants so XLA
 can constant-fold/bake them into the executable, mirroring a session's
 "model resident in device memory".
 
-The 68-op registry is proven through REAL torch.onnx exports, one per model
+The 144-op registry is proven through REAL torch.onnx exports, one per model
 family: convnets (ResNet-50, ``tests/test_onnx_resnet.py``), transformer
 encoders with einsum attention and dynamic shapes (``tests/test_onnx_bert.py``),
 causal decoders with Trilu masks, GatherElements and shape-guard If nodes

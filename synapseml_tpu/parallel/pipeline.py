@@ -47,8 +47,9 @@ def _pvary(x, axis_name):
             return jax.lax.pcast(x, axis_name, to="varying")
         if hasattr(jax.lax, "pvary"):
             return jax.lax.pvary(x, axis_name)
-    except ValueError:
-        pass  # already varying over axis_name
+    except ValueError as e:
+        if "varying" not in str(e):  # only swallow varying->varying
+            raise
     return x
 
 
