@@ -1,0 +1,98 @@
+# %% [markdown]
+# # Time-series anomaly detection services
+# The Anomaly Detector family (reference: `services/anomaly/
+# AnomalyDetection.scala`): `DetectLastAnomaly` scores the newest point
+# given its history, `DetectAnomalies` scores a whole series, and
+# `SimpleDetectAnomalies` does the same from FLAT rows — it groups by
+# `group_col`, assembles each group's series, calls the service once per
+# group, and scatters the flags back onto the rows. Mocked endpoints keep
+# the real request/response shapes.
+
+# %%
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Mock(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _json(self, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        p = self.path.split("?")[0]
+        series = body["series"]
+        vals = [pt["value"] for pt in series]
+        if p.endswith("/timeseries/last/detect"):
+            spike = vals[-1] > 3 * (sum(vals[:-1]) / max(len(vals) - 1, 1))
+            return self._json({"isAnomaly": bool(spike),
+                               "expectedValue": float(np.median(vals))})
+        if p.endswith("/timeseries/entire/detect"):
+            med = float(np.median(vals))
+            return self._json({"isAnomaly": [v > 3 * max(med, 1e-9)
+                                             for v in vals]})
+        self.send_error(404)
+
+
+import numpy as np
+
+srv = ThreadingHTTPServer(("127.0.0.1", 0), Mock)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+URL = f"http://127.0.0.1:{srv.server_address[1]}"
+
+# %% [markdown]
+# ## Score a series column
+
+# %%
+import synapseml_tpu as st
+from synapseml_tpu.services import (DetectAnomalies, DetectLastAnomaly,
+                                    SimpleDetectAnomalies)
+
+stamps = [f"2026-07-{d:02d}T00:00:00Z" for d in range(1, 9)]
+values = [1.0, 1.1, 0.9, 1.0, 1.2, 0.8, 1.1, 9.0]  # spike at the end
+series = [{"timestamp": t, "value": v} for t, v in zip(stamps, values)]
+df = st.DataFrame.from_dict({"series": [series]})
+
+last = DetectLastAnomaly(url=URL, subscription_key="demo-key",
+                         granularity="daily").transform(df)
+print("last point anomalous:", last.collect_column("out")[0]["isAnomaly"])
+
+whole = DetectAnomalies(url=URL, subscription_key="demo-key",
+                        granularity="daily").transform(df)
+flags = whole.collect_column("out")[0]["isAnomaly"]
+print("per-point flags:", flags)
+assert flags[-1] and not any(flags[:-1])
+
+# %% [markdown]
+# ## Flat rows: group, assemble, detect, scatter back
+# The common warehouse shape — one row per (sensor, timestamp, value).
+
+# %%
+rows = []
+for sensor in ("s1", "s2"):
+    for t, v in zip(stamps, values):
+        rows.append({"group": sensor, "timestamp": t,
+                     "value": v if sensor == "s1" else 1.0})
+sdf = st.DataFrame.from_rows(rows)
+sda = SimpleDetectAnomalies(url=URL, subscription_key="demo-key",
+                            granularity="daily")
+out = sda.transform(sdf)
+got = list(zip(out.collect_column("group"), out.collect_column("out")))
+s1_flags = [f for g, f in got if g == "s1"]
+s2_flags = [f for g, f in got if g == "s2"]
+print("s1 flags:", s1_flags)
+print("s2 flags:", s2_flags)
+assert s1_flags[-1] and not any(s2_flags)
+
+# %%
+srv.shutdown()
+print("done")

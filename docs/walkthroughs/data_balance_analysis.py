@@ -1,0 +1,68 @@
+# %% [markdown]
+# # Data balance analysis: measuring representation before training
+# The three balance measures (reference: `core/.../exploratory/
+# DataBalanceAnalysis` — FeatureBalanceMeasure, DistributionBalanceMeasure,
+# AggregateBalanceMeasure) quantify how fairly sensitive groups are
+# represented, BEFORE a model bakes the skew in. All three are pure
+# column aggregations (`synapseml_tpu/exploratory/balance.py`).
+
+# %%
+import numpy as np
+
+import synapseml_tpu as st
+from synapseml_tpu.exploratory import (AggregateBalanceMeasure,
+                                       DistributionBalanceMeasure,
+                                       FeatureBalanceMeasure)
+
+rs = np.random.default_rng(0)
+n = 2000
+gender = rs.choice(["F", "M"], n, p=[0.35, 0.65])
+eth = rs.choice(["a", "b", "c", "d"], n, p=[0.55, 0.25, 0.15, 0.05])
+# the label is skewed FOR M: 70% positive vs 40% for F
+label = np.where(gender == "M",
+                 rs.random(n) < 0.7, rs.random(n) < 0.4).astype(np.int64)
+df = st.DataFrame.from_dict({"gender": gender, "eth": eth, "label": label})
+
+# %% [markdown]
+# ## Feature balance: label parity gaps between group pairs
+# Statistical parity difference (and the associated gap family) between
+# every pair of values of the sensitive column — positive means the first
+# group receives the positive label more often.
+
+# %%
+fb = FeatureBalanceMeasure(sensitive_cols=["gender"]).transform(df)
+row = fb.collect_rows()[0]
+print("gender parity gaps:", {k: round(float(v), 3)
+                              for k, v in row.items()
+                              if isinstance(v, (int, float, np.floating))})
+
+# %% [markdown]
+# ## Distribution balance: how far from uniform is each sensitive column?
+
+# %%
+db = DistributionBalanceMeasure(sensitive_cols=["eth"]).transform(df)
+m = db.collect_rows()[0]
+print("eth distribution measures:", {k: round(float(v), 4)
+                                     for k, v in m.items()
+                                     if isinstance(v, (int, float, np.floating))})
+
+# %% [markdown]
+# ## Aggregate balance: one number per dataset
+# Atkinson/Theil-style indices over the sensitive-combination counts: 0 is
+# perfectly balanced; rising values mean concentration.
+
+# %%
+agg_skewed = AggregateBalanceMeasure(sensitive_cols=["eth"]).transform(df)
+uniform = st.DataFrame.from_dict(
+    {"eth": np.repeat(["a", "b", "c", "d"], 500)})
+agg_uniform = AggregateBalanceMeasure(sensitive_cols=["eth"]).transform(uniform)
+s = agg_skewed.collect_rows()[0]
+u = agg_uniform.collect_rows()[0]
+for k in s:
+    if isinstance(s[k], (int, float, np.floating)):
+        print(f"{k}: skewed {float(s[k]):.4f} vs uniform {float(u[k]):.4f}")
+        assert abs(float(u[k])) <= abs(float(s[k])) + 1e-9
+
+# %% [markdown]
+# In a training pipeline these run as plain transformers — gate a `fit` on
+# the measures, or log them as telemetry next to the model's metrics.

@@ -1,0 +1,93 @@
+# %% [markdown]
+# # OpenAI services: prompt templates, chat, and embeddings over DataFrames
+# The OpenAI family (reference: `services/openai/`) turns each row into a
+# completion/chat/embedding request. `OpenAIPrompt` renders a template per
+# row and can post-process replies (regex extraction, CSV splitting) into
+# typed columns. The mock echoes the wire shapes; swap `url=` +
+# `deployment_name=` for a real Azure OpenAI resource.
+
+# %%
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Mock(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _json(self, payload, status=200):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        if not self.headers.get("api-key"):
+            return self._json({"error": "unauthorized"}, 401)
+        if "/chat/completions" in self.path:
+            user = [m for m in body["messages"] if m["role"] == "user"][-1]
+            text = user["content"]
+            if "capital of" in text:
+                place = text.rsplit(" ", 1)[-1].strip("?")
+                reply = {"France": "Paris", "Japan": "Tokyo"}.get(place, "?")
+            else:
+                reply = f"echo:{text}"
+            return self._json({"choices": [{"message": {
+                "role": "assistant", "content": reply}}]})
+        if "/embeddings" in self.path:
+            t = body["input"]
+            return self._json({"data": [{"embedding":
+                                         [float(len(t)), 1.0, 0.5]}]})
+        self.send_error(404)
+
+
+srv = ThreadingHTTPServer(("127.0.0.1", 0), Mock)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+URL = f"http://127.0.0.1:{srv.server_address[1]}"
+
+# %% [markdown]
+# ## Prompt templates: one request per row, rendered from columns
+
+# %%
+import synapseml_tpu as st
+from synapseml_tpu.services import (OpenAIChatCompletion, OpenAIEmbedding,
+                                    OpenAIPrompt)
+
+df = st.DataFrame.from_dict({"country": ["France", "Japan"]})
+prompt = OpenAIPrompt(url=URL, subscription_key="demo-key",
+                      deployment_name="gpt-4o-mini",
+                      prompt_template="What is the capital of {country}?")
+out = prompt.transform(df)
+print("answers:", list(out.collect_column("outParsedOutput")))
+assert list(out.collect_column("outParsedOutput")) == ["Paris", "Tokyo"]
+
+# %% [markdown]
+# ## Raw chat: full message lists per row
+
+# %%
+chat_df = st.DataFrame.from_dict({"messages": [
+    [{"role": "system", "content": "be terse"},
+     {"role": "user", "content": "hello"}]]})
+chat = OpenAIChatCompletion(url=URL, subscription_key="demo-key",
+                            deployment_name="gpt-4o-mini")
+print("chat:", chat.transform(chat_df).collect_column("chat_completions"))
+
+# %% [markdown]
+# ## Embeddings feed KNN / SAR / AccessAnomaly downstream
+
+# %%
+emb = OpenAIEmbedding(url=URL, subscription_key="demo-key",
+                      deployment_name="text-embedding-3-small")
+vecs = emb.transform(st.DataFrame.from_dict(
+    {"text": ["short", "a longer sentence"]})).collect_column("embedding")
+print("embedding dims:", [len(v) for v in vecs])
+assert vecs[0][0] != vecs[1][0]  # mock encodes length in dim 0
+
+# %%
+srv.shutdown()
+print("done")

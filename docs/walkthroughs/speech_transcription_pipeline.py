@@ -1,0 +1,84 @@
+# %% [markdown]
+# # Speech services: transcription and synthesis as pipeline stages
+# `SpeechToText` posts audio bytes to the short-audio REST endpoint and lands
+# the recognition result in a column; `TextToSpeech` renders SSML and returns
+# synthesized audio bytes (reference: `services/speech/SpeechToTextSDK.scala`
+# — redesigned over REST, documented in docs/api/services.md). This demo
+# serves an in-process mock with the real request/response shapes, so it
+# runs with zero egress; point `url=` at a real Azure region in production.
+
+# %%
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Mock(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _send(self, body, ctype):
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        p = self.path.split("?")[0]
+        if "/speech/recognition/" in p:  # short-audio STT
+            assert body.startswith(b"RIFF"), "audio bytes expected"
+            return self._send(json.dumps(
+                {"RecognitionStatus": "Success",
+                 "DisplayText": "the quick brown fox"}).encode(),
+                "application/json")
+        if p.endswith("/cognitiveservices/v1"):  # TTS: SSML in, audio out
+            assert b"<speak" in body
+            return self._send(b"RIFF" + b"\x00" * 16, "audio/wav")
+        self.send_error(404)
+
+
+srv = ThreadingHTTPServer(("127.0.0.1", 0), Mock)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+URL = f"http://127.0.0.1:{srv.server_address[1]}"
+
+# %% [markdown]
+# ## Transcribe a batch of audio rows
+# Audio travels as raw bytes in a DataFrame column; the transformer fans
+# requests out through the shared async HTTP client (`concurrency` requests
+# in flight) and never fails a batch on one bad row — errors land in the
+# `error_col` instead.
+
+# %%
+import synapseml_tpu as st
+from synapseml_tpu.services import SpeechToText, TextToSpeech
+
+clips = st.DataFrame.from_dict({"audio": [b"RIFF" + bytes([i]) * 8
+                                          for i in range(3)]})
+stt = SpeechToText(url=URL, subscription_key="demo-key", language="en-US")
+texts = stt.transform(clips)
+for r in texts.collect_column("out"):
+    print("transcript:", r["DisplayText"])
+
+# %% [markdown]
+# ## Synthesize speech from text
+# `TextToSpeech` escapes the text into SSML with the configured voice and
+# returns the rendered audio bytes — ready for a binary-file sink.
+
+# %%
+lines = st.DataFrame.from_dict({"text": ["hello <world>", "goodbye"]})
+tts = TextToSpeech(url=URL, subscription_key="demo-key",
+                   voice="en-US-JennyNeural")
+audio = tts.transform(lines).collect_column("out")
+print("synthesized:", [a[:4] for a in audio])
+assert all(a.startswith(b"RIFF") for a in audio)
+
+# %% [markdown]
+# Chain them: speech in, speech out — a round-trip voice pipeline is just
+# two stages in a `st.Pipeline` with the text column wired between them.
+
+# %%
+srv.shutdown()
+print("done")

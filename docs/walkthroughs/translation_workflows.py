@@ -1,0 +1,93 @@
+# %% [markdown]
+# # Translator workflows: translate, transliterate, sentence metrics,
+# # dictionary lookup
+# The full Translator-family surface (reference:
+# `services/translate/Translate.scala`) as DataFrame stages. Each
+# transformer batches rows into the Translator REST body shape
+# (`[{"Text": ...}]`) and parses the reply into a column. The mock below
+# keeps the exact wire shapes; swap `url=` for the real endpoint.
+
+# %%
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Mock(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _json(self, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        p = self.path.split("?")[0]
+        if p == "/translate":
+            return self._json([{"translations": [
+                {"text": f"es({t['Text']})", "to": "es"}]} for t in body])
+        if p == "/transliterate":
+            return self._json([{"text": "namaste", "script": "Latn"}
+                               for _ in body])
+        if p == "/breaksentence":
+            return self._json([{"sentLen": [len(s) + 1 for s in
+                                            t["Text"].split(".") if s]}
+                               for t in body])
+        if p == "/dictionary/lookup":
+            return self._json([{"translations": [
+                {"normalizedTarget": "volar"},
+                {"normalizedTarget": "mosca"}]} for _ in body])
+        self.send_error(404)
+
+
+srv = ThreadingHTTPServer(("127.0.0.1", 0), Mock)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+URL = f"http://127.0.0.1:{srv.server_address[1]}"
+
+# %% [markdown]
+# ## Translate a column
+
+# %%
+import synapseml_tpu as st
+from synapseml_tpu.services import (BreakSentence, DictionaryLookup,
+                                    Translate, Transliterate)
+
+df = st.DataFrame.from_dict({"text": ["hello world", "good morning."]})
+out = Translate(url=URL, subscription_key="demo-key",
+                to_language="es").transform(df)
+print("translations:", out.collect_column("translation"))
+
+# %% [markdown]
+# ## Transliterate between scripts
+# Script conversion (Devanagari -> Latin here) keeps the language, changes
+# the writing system.
+
+# %%
+tl = Transliterate(url=URL, subscription_key="demo-key", language="hi",
+                   from_script="Deva", to_script="Latn")
+print("transliterated:", tl.transform(
+    st.DataFrame.from_dict({"text": ["नमस्ते"]})).collect_column("transliteration"))
+
+# %% [markdown]
+# ## Sentence boundaries and bilingual dictionary
+
+# %%
+bs = BreakSentence(url=URL, subscription_key="demo-key")
+print("sentence lengths:", bs.transform(df).collect_column("sent_len"))
+
+dl = DictionaryLookup(url=URL, subscription_key="demo-key",
+                      from_language="en", to_language="es")
+looked = dl.transform(st.DataFrame.from_dict({"text": ["fly"]}))
+targets = list(looked.collect_column("translations")[0])
+print("dictionary targets:", targets)
+assert targets == ["volar", "mosca"]
+
+# %%
+srv.shutdown()
+print("done")

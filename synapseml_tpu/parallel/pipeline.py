@@ -48,7 +48,11 @@ def _pvary(x, axis_name):
         if hasattr(jax.lax, "pvary"):
             return jax.lax.pvary(x, axis_name)
     except ValueError as e:
-        if "varying" not in str(e):  # only swallow varying->varying
+        # swallow only the already-varying case. pcast says "Unsupported
+        # pcast from=varying"; pvary phrases it "invariant->variant
+        # collective ... must not be present in jax.typeof(inp).vma"
+        msg = str(e)
+        if "varying" not in msg and "vma" not in msg:
             raise
     return x
 

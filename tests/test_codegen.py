@@ -71,10 +71,14 @@ def test_numeric_claims_quote_facts():
             if int(m.group(1)) != live["onnx_ops"]:
                 bad.append(f"{path}: '{m.group(0)}' vs live "
                            f"{live['onnx_ops']}")
-        for m in re.finditer(r"(\d+)-stage manifest", text):
+        for m in re.finditer(r"(\d+)[- ]stage (?:manifest|classes)", text):
             if int(m.group(1)) != live["stage_classes"]:
                 bad.append(f"{path}: '{m.group(0)}' vs live "
                            f"{live['stage_classes']}")
+        for m in re.finditer(r"(\d+)-notebook corpus", text):
+            if int(m.group(1)) != live["notebooks"]:
+                bad.append(f"{path}: '{m.group(0)}' vs live "
+                           f"{live['notebooks']}")
     assert not bad, "stale numeric claims:\n" + "\n".join(bad)
 
 

@@ -111,10 +111,13 @@ def test_emit_rejects_basename_collision(tmp_path):
 
 
 @pytest.mark.slow
-def test_execute_one_emitted_notebook(tmp_path):
-    """nbtest analog: run a committed .ipynb's code cells in a fresh
-    interpreter (CPU), proving the emitted corpus is executable as-is."""
-    with open(os.path.join(NB_DIR, "onnx_model_inference.ipynb")) as f:
+@pytest.mark.parametrize("notebook", ["onnx_model_inference.ipynb",
+                                      "knn_similarity_search.ipynb"])
+def test_execute_emitted_notebooks(tmp_path, notebook):
+    """nbtest analog: run committed .ipynb code cells in a fresh
+    interpreter (CPU), proving the emitted corpus is executable as-is —
+    one example notebook and one walkthrough notebook."""
+    with open(os.path.join(NB_DIR, notebook)) as f:
         code = notebook_code(json.load(f))
     script = tmp_path / "nb_exec.py"
     script.write_text(
