@@ -60,7 +60,10 @@ class TransformerConfig:
     # 'einsum' is the measured-fastest default on v5e at T=128..4096
     # (docs/BENCHMARKS.md) — XLA's fused attention beats the Pallas kernel;
     # use 'flash' only when the O(T^2) score buffer doesn't fit, 'ring' for
-    # true long-context over the mesh.
+    # true long-context over the mesh. CAVEAT: that table predates the bf16
+    # MXU fix (commit ee387ce) which made the flash/ring kernels ~4x faster;
+    # re-measurement is queued as the `attn-backends` bench child — treat
+    # the default as provisional until it lands (docs/BENCHMARKS.md).
     attn_impl: str = "einsum"
     seq_axis: str = "seq"
     # mixture-of-experts MLP (switch-transformer routing): 0 = dense MLP.
