@@ -34,7 +34,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["pipeline_apply", "pipeline_apply_scattered", "pipeline_sharded",
+__all__ = ["pipeline_apply", "pipeline_apply_interleaved",
+           "pipeline_apply_scattered", "pipeline_sharded",
            "stack_stage_params"]
 
 
@@ -207,9 +208,72 @@ def pipeline_apply_scattered(stage_fn, stacked_params, x_local,
     return outs
 
 
+def pipeline_apply_interleaved(stage_fn, stacked_params, x_micro,
+                               axis_name: str = "pipe", remat: bool = False):
+    """Interleaved (circular) schedule: device d holds ``v`` ROUND-ROBIN
+    stage chunks (global stage ``d + c*S`` at local chunk c), so a payload
+    hops to the next device every tick and wraps from the last device back
+    to device 0 into its next chunk. With L = S*v total stages the bubble
+    shrinks from GPipe's ``(S-1)/(S-1+M)`` (stages fused v-per-device) to
+    ``~S/(M*v + S)`` — the Megatron interleaved-schedule effect, here as
+    one ``lax.scan`` over a single rotating slot per device.
+
+    Per-device arguments: ``stacked_params`` leading axis = v chunks in
+    round-robin order (``pipeline_sharded`` does the permutation);
+    ``x_micro`` replicated ``[M, mb, ...]`` with M divisible by S. Outputs
+    are captured on device 0 (where completed payloads wrap to) and
+    psum-broadcast, like :func:`pipeline_apply`.
+    """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+    n_stages = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    v = jax.tree.leaves(stacked_params)[0].shape[0]
+    n_micro = jax.tree.leaves(x_micro)[0].shape[0]
+    if n_micro % n_stages:
+        raise ValueError(
+            f"interleaved schedule needs n_micro ({n_micro}) divisible by "
+            f"the {axis_name!r} axis size ({n_stages})")
+    S, round_len = n_stages, n_stages * v
+    n_ticks = n_micro * v + S
+    fwd = [(i, (i + 1) % S) for i in range(S)]
+    tmap = jax.tree.map
+
+    def tick(carry, t):
+        state, outs = carry
+        in_round = (t % round_len) < S  # injection/arrival window
+        # a payload arriving at device 0 in the window is COMPLETE: it was
+        # chunk v-1 on device S-1 last tick. Its identity follows from the
+        # deterministic schedule alone.
+        m_done = (t // round_len) * S + t % round_len - S
+        take = (idx == 0) & in_round & (m_done >= 0) & (m_done < n_micro)
+        slot = jnp.clip(m_done, 0, n_micro - 1)
+        outs = tmap(lambda os, st: jax.lax.dynamic_update_index_in_dim(
+            os, jnp.where(take, st, os[slot]), slot, axis=0), outs, state)
+        # device 0 injects a fresh microbatch in the same window
+        m_in = (t // round_len) * S + t % round_len
+        inject = (idx == 0) & in_round & (m_in < n_micro)
+        inp = tmap(lambda xm, st: jnp.where(
+            inject, xm[jnp.clip(m_in, 0, n_micro - 1)], st), x_micro, state)
+        # local chunk this tick: ((t - d) // S) mod v
+        c = jnp.mod(jnp.floor_divide(t - idx, S), v)
+        params_c = tmap(lambda p: jax.lax.dynamic_index_in_dim(
+            p, c, axis=0, keepdims=False), stacked_params)
+        y = stage_fn(params_c, inp)
+        state = tmap(lambda yy: jax.lax.ppermute(yy, axis_name, fwd), y)
+        return (state, outs), None
+
+    state0 = tmap(lambda xm: _pvary(jnp.zeros_like(xm[0]), axis_name), x_micro)
+    outs0 = tmap(lambda xm: _pvary(jnp.zeros_like(xm), axis_name), x_micro)
+    (_, outs), _ = jax.lax.scan(tick, (state0, outs0),
+                                jnp.arange(n_ticks, dtype=jnp.int32))
+    outs = tmap(lambda os: jnp.where(idx == 0, os, jnp.zeros_like(os)), outs)
+    return tmap(lambda os: jax.lax.psum(os, axis_name), outs)
+
+
 def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
                      axis_name: str = "pipe", remat: bool = False,
-                     io: str = "replicated"):
+                     io: str = "replicated", interleave: int = 1):
     """Full-array entry point: shard_map the pipeline schedule over the
     mesh's ``pipe`` axis (params stage-sharded). Falls back to a sequential
     stage chain when the axis is absent/size-1.
@@ -223,22 +287,45 @@ def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
       (``n_micro`` must divide by it) via :func:`pipeline_apply_scattered` —
       per-device activation memory scales as 1/n_stages, the production
       layout for real model sizes.
+
+    ``interleave=v`` (with ``n_stages == pipe_size * v``) runs the circular
+    schedule instead: stages assigned round-robin (device d gets stages
+    ``d, d+S, ...``), cutting the pipeline bubble by ~v at the cost of a
+    param-chunk select per tick. Requires ``io='replicated'`` and
+    ``n_micro`` divisible by the axis size.
     """
     from jax.sharding import PartitionSpec as P
 
     if io not in ("replicated", "sharded"):
         raise ValueError(f"io must be 'replicated' or 'sharded', got {io!r}")
+    if interleave < 1:
+        raise ValueError(f"interleave must be >= 1, got {interleave}")
+    if interleave > 1 and io != "replicated":
+        raise ValueError("interleave > 1 requires io='replicated'")
     mesh = getattr(mesh_ctx, "mesh", mesh_ctx)
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
     pipe_size = sizes.get(axis_name, 1)
-    if pipe_size > 1 and n_stages != pipe_size:
+    if pipe_size > 1 and n_stages != pipe_size * interleave:
         raise ValueError(
             f"pipeline_sharded: {n_stages} stages cannot shard over a "
-            f"{axis_name!r} axis of size {pipe_size} (one stage per device)")
+            f"{axis_name!r} axis of size {pipe_size}"
+            + (f" with interleave={interleave} (need pipe*interleave "
+               "stages)" if interleave > 1 else " (one stage per device)"))
+    # validated BEFORE the size-1 fallback so misuse surfaces in
+    # single-device dev runs, not first on the deployment mesh
+    if interleave > 1:
+        if n_stages % interleave:
+            raise ValueError(
+                f"pipeline_sharded: {n_stages} stages cannot interleave by "
+                f"{interleave} (need pipe*interleave stages)")
+        n_micro = jax.tree.leaves(x_micro)[0].shape[0]
+        ring = pipe_size if pipe_size > 1 else n_stages // interleave
+        if n_micro % ring:
+            raise ValueError(
+                f"interleaved schedule needs n_micro ({n_micro}) divisible "
+                f"by the {axis_name!r} axis size ({ring})")
     if io == "sharded":
-        # validated BEFORE the size-1 fallback so misuse surfaces in
-        # single-device dev runs, not first on the deployment mesh
         n_micro = jax.tree.leaves(x_micro)[0].shape[0]
         if n_micro % max(pipe_size, n_stages):
             raise ValueError(
@@ -254,7 +341,17 @@ def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
             return y
         return seq_apply(stacked_params, x_micro)
 
-    if io == "sharded":
+    if interleave > 1:
+        # shard_map splits the leading axis contiguously, so permute the
+        # stack: position d*v + c must hold global stage d + c*S
+        S, vv = pipe_size, interleave
+        perm = [(i // vv) + (i % vv) * S for i in range(n_stages)]
+        stacked_params = jax.tree.map(
+            lambda p: jnp.take(p, jnp.asarray(perm), axis=0), stacked_params)
+        fn = functools.partial(pipeline_apply_interleaved, stage_fn,
+                               axis_name=axis_name, remat=remat)
+        micro_spec = jax.tree.map(lambda _: P(), x_micro)
+    elif io == "sharded":
         fn = functools.partial(pipeline_apply_scattered, stage_fn,
                                axis_name=axis_name, remat=remat)
         micro_spec = jax.tree.map(lambda _: P(axis_name), x_micro)

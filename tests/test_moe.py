@@ -177,6 +177,24 @@ def test_moe_expert_parallel_matches_unsharded():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
 
 
+def test_moe_scatter_expert_parallel_matches_unsharded():
+    # the HF/Mixtral dispatch layout under a sharded expert axis: GSPMD must
+    # reshard the scatter/gather traffic without changing results
+    cfg = cfg_with(moe_top_k=2, moe_capacity_factor=8.0,
+                   moe_dispatch="scatter")
+    block = MoEBlock(cfg)
+    rs = np.random.default_rng(13)
+    x = jnp.asarray(rs.normal(size=(2, 8, 16)), jnp.float32)
+    variables = block.init(jax.random.PRNGKey(1), x)
+    ref = np.asarray(block.apply(variables, x))
+
+    mesh = create_mesh(MeshConfig(data=2, expert=4))
+    placed = shard_params(variables, mesh)
+    with mesh.mesh:
+        out = jax.jit(lambda v, xx: block.apply(v, xx))(placed, x)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
 def test_moe_encoder_trains():
     # gradient flow end-to-end: a 2-layer MoE encoder fits a tiny regression
     cfg = cfg_with(n_layers=2, moe_top_k=2)

@@ -285,6 +285,78 @@ def test_pipeline_sharded_io_rejects_indivisible():
         pipeline_sharded(mesh, mlp_stage, stacked, x, io="sharded")
 
 
+@pytest.mark.parametrize("n_micro", [4, 8])
+def test_pipeline_interleaved_matches_sequential(n_micro):
+    # circular schedule: 8 stages round-robin on pipe=4 (v=2)
+    n_stages, mb, d = 8, 3, 8
+    stages = make_stages(n_stages, d, seed=31)
+    stacked = stack_stage_params(stages)
+    rs = np.random.default_rng(32)
+    x = jnp.asarray(rs.normal(size=(n_micro, mb, d)), jnp.float32)
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    out = pipeline_sharded(mesh, mlp_stage, stacked, x, interleave=2)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(sequential(stages, x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_interleaved_gradients_match_sequential():
+    n_stages, n_micro, mb, d = 8, 8, 2, 8
+    stages = make_stages(n_stages, d, seed=33)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(34).normal(size=(n_micro, mb, d)),
+                    jnp.float32)
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+
+    def loss_pp(params):
+        return jnp.sum(pipeline_sharded(mesh, mlp_stage, params, x,
+                                        interleave=2) ** 2)
+
+    def loss_seq(params):
+        y = x
+        for s in range(n_stages):
+            p = jax.tree.map(lambda q: q[s], params)
+            y = jax.vmap(lambda xx, p=p: mlp_stage(p, xx))(y)
+        return jnp.sum(y ** 2)
+
+    g_pp = jax.grad(loss_pp)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree.leaves(g_seq), jax.tree.leaves(g_pp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_interleaved_deeper_chunks():
+    # v=4: 8 stages on pipe=2, jitted, payload wraps three times
+    n_stages, n_micro, mb, d = 8, 6, 2, 4
+    stages = make_stages(n_stages, d, seed=35)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(36).normal(size=(n_micro, mb, d)),
+                    jnp.float32)
+    mesh = create_mesh(MeshConfig(data=4, pipe=2))
+    out = jax.jit(lambda p, xx: pipeline_sharded(
+        mesh, mlp_stage, p, xx, interleave=4))(stacked, x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(sequential(stages, x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_interleaved_rejections():
+    stages = make_stages(8, 4, seed=37)
+    stacked = stack_stage_params(stages)
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline_sharded(mesh, mlp_stage, stacked,
+                         jnp.zeros((6, 2, 4), jnp.float32), interleave=2)
+    with pytest.raises(ValueError, match="pipe\\*interleave"):
+        pipeline_sharded(mesh, mlp_stage, stacked,
+                         jnp.zeros((8, 2, 4), jnp.float32), interleave=3)
+    with pytest.raises(ValueError, match="io='replicated'"):
+        pipeline_sharded(mesh, mlp_stage, stacked,
+                         jnp.zeros((8, 2, 4), jnp.float32), interleave=2,
+                         io="sharded")
+
+
 def test_pipeline_real_transformer_blocks():
     """REAL transformer Blocks through the pipeline: an Encoder's per-layer
     params restack into stages, each stage applies its Block with the
