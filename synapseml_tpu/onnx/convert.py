@@ -7,7 +7,7 @@ compiles (and fuses) for the device. Weights become closure constants so XLA
 can constant-fold/bake them into the executable, mirroring a session's
 "model resident in device memory".
 
-The 144-op registry is proven through REAL torch.onnx exports, one per model
+The 155-op registry is proven through REAL torch.onnx exports, one per model
 family: convnets (ResNet-50, ``tests/test_onnx_resnet.py``), transformer
 encoders with einsum attention and dynamic shapes (``tests/test_onnx_bert.py``),
 causal decoders with Trilu masks, GatherElements and shape-guard If nodes
@@ -1494,6 +1494,276 @@ def _global_lp_pool(ins, attrs):
     out = jnp.sum(jnp.abs(x.astype(jnp.float32)) ** p, axis=axes,
                   keepdims=True) ** (1.0 / p)
     return out.astype(x.dtype)
+
+
+# ---------------- spatial sampling / losses / opset-18 tail ----------------
+
+
+def _denorm_coord(g, size, align_corners):
+    # normalized [-1, 1] -> pixel coordinates per the GridSample spec
+    if align_corners:
+        return (g + 1.0) * 0.5 * (size - 1)
+    return ((g + 1.0) * size - 1.0) * 0.5
+
+
+def _reflect_coord(c, lo, hi):
+    # reflect into [lo, hi] with period 2*(hi-lo) (border pixels not doubled
+    # in the align_corners sense ORT uses for padding_mode='reflection').
+    # A degenerate span (size-1 dim under align_corners) has nothing to
+    # reflect — everything maps to the single coordinate (mod 0 is NaN).
+    span = hi - lo
+    if span <= 0:
+        return jnp.full_like(c, lo)
+    c = jnp.abs(c - lo)
+    c = jnp.mod(c, 2 * span)
+    return jnp.where(c > span, 2 * span - c, c) + lo
+
+
+@op("GridSample")
+def _grid_sample(ins, attrs):
+    """Spatial-transformer sampling (opset 16+, 4D): for each output pixel,
+    sample the input at a grid-supplied normalized coordinate. Gathers are
+    XLA ``gather`` ops — batched via one advanced-index per corner."""
+    x, grid = ins[0], ins[1]
+    mode = attrs.get("mode", b"bilinear")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    if mode == "linear":
+        mode = "bilinear"
+    pad = attrs.get("padding_mode", b"zeros")
+    pad = pad.decode() if isinstance(pad, bytes) else pad
+    align = bool(attrs.get("align_corners", 0))
+    if x.ndim != 4:
+        raise NotImplementedError("GridSample: only 4D (NCHW) supported")
+    N, C, H, W = x.shape
+    gx = _denorm_coord(grid[..., 0].astype(jnp.float32), W, align)  # [N,Ho,Wo]
+    gy = _denorm_coord(grid[..., 1].astype(jnp.float32), H, align)
+
+    if pad == "reflection":
+        if align:
+            gx, gy = _reflect_coord(gx, 0.0, W - 1), _reflect_coord(gy, 0.0, H - 1)
+        else:
+            gx = jnp.clip(_reflect_coord(gx, -0.5, W - 0.5), 0, W - 1)
+            gy = jnp.clip(_reflect_coord(gy, -0.5, H - 0.5), 0, H - 1)
+        pad = "border"  # reflected coords are in range; sample like border
+
+    def sample_int(ix, iy):
+        # gather x[n, :, iy, ix] with clipped indices; [N, C, Ho, Wo]
+        ixc = jnp.clip(ix, 0, W - 1)
+        iyc = jnp.clip(iy, 0, H - 1)
+        n_idx = jnp.arange(N)[:, None, None]
+        vals = x[n_idx, :, iyc, ixc]            # [N, Ho, Wo, C]
+        vals = jnp.moveaxis(vals, -1, 1)        # [N, C, Ho, Wo]
+        if pad == "zeros":
+            inb = ((ix >= 0) & (ix <= W - 1) & (iy >= 0)
+                   & (iy <= H - 1))[:, None, :, :]
+            vals = vals * inb.astype(vals.dtype)
+        return vals
+
+    if mode == "nearest":
+        out = sample_int(jnp.round(gx).astype(jnp.int32),
+                         jnp.round(gy).astype(jnp.int32))
+    elif mode == "bilinear":
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        wx = (gx - x0)[:, None, :, :].astype(x.dtype)
+        wy = (gy - y0)[:, None, :, :].astype(x.dtype)
+        if pad == "border":
+            # clamp the CONTINUOUS coordinate first so the two corners
+            # straddle the clamped point (matches ORT border semantics)
+            gxc = jnp.clip(gx, 0, W - 1)
+            gyc = jnp.clip(gy, 0, H - 1)
+            x0 = jnp.floor(gxc).astype(jnp.int32)
+            y0 = jnp.floor(gyc).astype(jnp.int32)
+            wx = (gxc - x0)[:, None, :, :].astype(x.dtype)
+            wy = (gyc - y0)[:, None, :, :].astype(x.dtype)
+        out = (sample_int(x0, y0) * (1 - wx) * (1 - wy)
+               + sample_int(x0 + 1, y0) * wx * (1 - wy)
+               + sample_int(x0, y0 + 1) * (1 - wx) * wy
+               + sample_int(x0 + 1, y0 + 1) * wx * wy)
+    else:
+        raise NotImplementedError(f"GridSample mode {mode!r}")
+    return out
+
+
+@op("RoiAlign")
+def _roi_align(ins, attrs):
+    """Mask-R-CNN ROI pooling (opset 16): bilinear samples on a fixed grid
+    per output bin, averaged (or maxed). ``sampling_ratio=0`` (adaptive,
+    data-dependent grid) is approximated with a fixed 2x2 grid per bin —
+    static shapes are the XLA constraint; torch exports set the ratio
+    explicitly."""
+    x = jnp.asarray(ins[0])  # numpy input + traced roi index can't mix
+    rois, batch_idx = ins[1], ins[2]
+    out_h = int(attrs.get("output_height", 1))
+    out_w = int(attrs.get("output_width", 1))
+    ratio = int(attrs.get("sampling_ratio", 0)) or 2
+    scale = float(attrs.get("spatial_scale", 1.0))
+    mode = attrs.get("mode", b"avg")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    ctm = attrs.get("coordinate_transformation_mode", b"half_pixel")
+    ctm = ctm.decode() if isinstance(ctm, bytes) else ctm
+    N, C, H, W = x.shape
+    half_pixel = ctm == "half_pixel"
+    offset = 0.5 if half_pixel else 0.0
+    r = rois.astype(jnp.float32) * scale - offset    # [R, 4] x1 y1 x2 y2
+
+    def one_roi(roi, b):
+        x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+        rw, rh = x2 - x1, y2 - y1
+        if not half_pixel:  # ORT applies the legacy >=1 clamp ONLY here
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_w, bin_h = rw / out_w, rh / out_h
+        # sample centers: out_{h,w} bins x ratio points per bin
+        sx = x1 + (jnp.arange(out_w * ratio) + 0.5) * (bin_w / ratio)
+        sy = y1 + (jnp.arange(out_h * ratio) + 0.5) * (bin_h / ratio)
+        gx, gy = jnp.meshgrid(sx, sy)              # [oh*r, ow*r]
+        # ORT sample semantics: a point past the 1-pixel halo contributes
+        # zero; anything else is CLAMPED into the image (border pixels at
+        # full weight), never corner-zeroed
+        empty = (gx < -1.0) | (gx > W) | (gy < -1.0) | (gy > H)
+        gx = jnp.clip(gx, 0.0, W - 1)
+        gy = jnp.clip(gy, 0.0, H - 1)
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        wx, wy = gx - x0, gy - y0
+
+        def corner(ix, iy):
+            v = x[b, :, jnp.clip(iy, 0, H - 1), jnp.clip(ix, 0, W - 1)]
+            return jnp.moveaxis(v, -1, 0)
+
+        live = (~empty).astype(x.dtype)
+        contribs = (corner(x0, y0) * (1 - wx) * (1 - wy) * live,
+                    corner(x0 + 1, y0) * wx * (1 - wy) * live,
+                    corner(x0, y0 + 1) * (1 - wx) * wy * live,
+                    corner(x0 + 1, y0 + 1) * wx * wy * live)
+        if mode == "max":
+            # ORT max mode: max over the WEIGHTED corner contributions of
+            # every sample, not max of interpolated values
+            vals = jnp.max(jnp.stack(contribs), axis=0)
+            vals = vals.reshape(C, out_h, ratio, out_w, ratio)
+            return jnp.max(vals, axis=(2, 4))
+        vals = sum(contribs).reshape(C, out_h, ratio, out_w, ratio)
+        return jnp.mean(vals, axis=(2, 4))
+
+    return jax.vmap(one_roi)(r, batch_idx.astype(jnp.int32))
+
+
+@op("GroupNormalization")
+def _group_norm(ins, attrs):
+    """Opset-18 GroupNormalization (the diffusion-UNet norm): normalize over
+    each of ``num_groups`` channel groups. Handles both the opset-18
+    per-group and opset-21 per-channel scale/bias shapes."""
+    x, scale, bias = ins[0], ins[1], ins[2]
+    eps = attrs.get("epsilon", 1e-5)
+    G = int(attrs["num_groups"])
+    N, C = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape(N, G, C // G, *spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    if scale.shape[0] == G != C:  # opset-18 per-group parameters
+        scale = jnp.repeat(scale, C // G)
+        bias = jnp.repeat(bias, C // G)
+    shape = (1, C) + (1,) * len(spatial)
+    return y * scale.reshape(shape) + bias.reshape(shape)
+
+
+@op("MeanVarianceNormalization")
+def _mvn(ins, attrs):
+    x = ins[0]
+    axes = tuple(attrs.get("axes", (0, 2, 3)))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    std = jnp.sqrt(jnp.var(x, axis=axes, keepdims=True))
+    return (x - mean) / (std + 1e-9)
+
+
+@op("BitwiseAnd")
+def _bitwise_and(ins, attrs):
+    return jnp.bitwise_and(ins[0], ins[1])
+
+
+@op("BitwiseOr")
+def _bitwise_or(ins, attrs):
+    return jnp.bitwise_or(ins[0], ins[1])
+
+
+@op("BitwiseXor")
+def _bitwise_xor(ins, attrs):
+    return jnp.bitwise_xor(ins[0], ins[1])
+
+
+@op("BitwiseNot")
+def _bitwise_not(ins, attrs):
+    return jnp.bitwise_not(ins[0])
+
+
+@op("CenterCropPad")
+def _center_crop_pad(ins, attrs):
+    """Opset-18: center-crop dims larger than the target, center-pad (zeros)
+    dims smaller. ``shape`` input must be static (XLA shapes)."""
+    x = ins[0]
+    target = np.asarray(ins[1], np.int64)
+    axes = attrs.get("axes")
+    axes = (list(range(x.ndim)) if axes is None
+            else [int(a) % x.ndim for a in axes])
+    out = x
+    for a, t in zip(axes, target.tolist()):
+        cur = out.shape[a]
+        if cur > t:  # crop, extra pixel goes to the end slice
+            start = (cur - t) // 2
+            out = jax.lax.slice_in_dim(out, start, start + t, axis=a)
+        elif cur < t:
+            before = (t - cur) // 2
+            pads = [(0, 0, 0)] * out.ndim
+            pads[a] = (before, t - cur - before, 0)
+            out = jax.lax.pad(out, jnp.zeros((), out.dtype), pads)
+    return out
+
+
+def _nll_core(log_prob, labels, weights, reduction, ignore_index):
+    """Shared NegativeLogLikelihoodLoss / SoftmaxCrossEntropyLoss core:
+    gather -log p[label], apply class weights, mask ignore_index, reduce."""
+    C = log_prob.shape[1]
+    labels = labels.astype(jnp.int32)
+    valid = (jnp.ones_like(labels, dtype=bool) if ignore_index is None
+             else labels != ignore_index)
+    safe = jnp.where(valid, labels, 0)
+    picked = -jnp.take_along_axis(
+        log_prob, safe[:, None] if log_prob.ndim == 2
+        else safe[:, None, ...], axis=1).squeeze(1)
+    w = (jnp.ones((C,), log_prob.dtype) if weights is None
+         else weights.astype(log_prob.dtype))
+    wl = jnp.take(w, safe) * valid.astype(log_prob.dtype)
+    loss = picked * wl
+    reduction = (reduction.decode()
+                 if isinstance(reduction, bytes) else reduction)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(wl), 1e-9)  # weighted mean
+
+
+@op("NegativeLogLikelihoodLoss")
+def _nll_loss(ins, attrs):
+    weights = ins[2] if len(ins) > 2 else None
+    return _nll_core(ins[0], ins[1], weights,
+                     attrs.get("reduction", "mean"),
+                     attrs.get("ignore_index"))
+
+
+@op("SoftmaxCrossEntropyLoss")
+def _softmax_ce_loss(ins, attrs):
+    scores, labels = ins[0], ins[1]
+    weights = ins[2] if len(ins) > 2 else None
+    log_prob = jax.nn.log_softmax(scores, axis=1)
+    loss = _nll_core(log_prob, labels, weights,
+                     attrs.get("reduction", "mean"),
+                     attrs.get("ignore_index"))
+    return (loss, log_prob)  # second output is optional (log_prob)
 
 
 # ---------------- dynamically-shaped ops (eager execution only) ----------------

@@ -1,0 +1,270 @@
+"""Spatial-sampling, normalization, and training-loss ONNX ops: GridSample
+and the losses parity-checked against REAL torch exports; RoiAlign and the
+opset-18 tail vs numpy spec oracles (no torchvision in the image).
+Reference runs these through ONNX Runtime (``onnx/ONNXModel.scala:211``)."""
+
+import io
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+from torch import nn  # noqa: E402
+
+from _torch_resnet import _install_onnx_shim  # noqa: E402
+
+from synapseml_tpu.onnx.convert import OP_REGISTRY  # noqa: E402
+
+
+def run_op(op, ins, **attrs):
+    return OP_REGISTRY[op]([None if x is None else np.asarray(x)
+                            for x in ins], attrs)
+
+
+# ---------------------------------------------------------------------------
+# GridSample vs a real torch export
+# ---------------------------------------------------------------------------
+
+class SamplerNet(nn.Module):
+    def __init__(self, mode, padding_mode, align_corners):
+        super().__init__()
+        self.kw = dict(mode=mode, padding_mode=padding_mode,
+                       align_corners=align_corners)
+
+    def forward(self, x, grid):
+        return F.grid_sample(x, grid, **self.kw)
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("padding_mode", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align_corners", [False, True])
+def test_grid_sample_matches_torch_export(mode, padding_mode, align_corners):
+    from synapseml_tpu.onnx import convert_graph
+
+    _install_onnx_shim()
+    torch.manual_seed(0)
+    model = SamplerNet(mode, padding_mode, align_corners).eval()
+    x = torch.randn(2, 3, 5, 7)
+    # grid spills past [-1, 1] so the padding mode actually matters
+    grid = (torch.rand(2, 4, 6, 2) * 2.6 - 1.3)
+    buf = io.BytesIO()
+    torch.onnx.export(model, (x, grid), buf, dynamo=False,
+                      input_names=["x", "grid"], output_names=["y"],
+                      opset_version=16)
+    conv = convert_graph(buf.getvalue())
+    got = np.asarray(conv(x=x.numpy(), grid=grid.numpy())["y"])
+    with torch.no_grad():
+        want = model(x, grid).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# losses vs real torch exports
+# ---------------------------------------------------------------------------
+
+class CELossNet(nn.Module):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean"):
+        super().__init__()
+        self.kw = dict(ignore_index=ignore_index, reduction=reduction)
+        self.weight = weight
+
+    def forward(self, scores, labels):
+        return F.cross_entropy(scores, labels, weight=self.weight, **self.kw)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+@pytest.mark.parametrize("weighted", [False, True])
+def test_softmax_ce_loss_matches_torch_export(reduction, weighted):
+    from synapseml_tpu.onnx import convert_graph
+
+    _install_onnx_shim()
+    torch.manual_seed(1)
+    weight = torch.rand(5) + 0.5 if weighted else None
+    model = CELossNet(weight=weight, ignore_index=3,
+                      reduction=reduction).eval()
+    scores = torch.randn(8, 5)
+    labels = torch.tensor([0, 1, 2, 3, 4, 0, 3, 2])  # two ignored rows
+    buf = io.BytesIO()
+    torch.onnx.export(model, (scores, labels), buf, dynamo=False,
+                      input_names=["scores", "labels"], output_names=["loss"])
+    conv = convert_graph(buf.getvalue())
+    got = np.asarray(conv(scores=scores.numpy(), labels=labels.numpy())["loss"])
+    with torch.no_grad():
+        want = model(scores, labels).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_nll_loss_direct_matches_torch():
+    torch.manual_seed(2)
+    log_prob = F.log_softmax(torch.randn(6, 4), dim=1)
+    labels = torch.tensor([0, 1, 2, 3, 1, 0])
+    for reduction in ("mean", "sum", "none"):
+        got = run_op("NegativeLogLikelihoodLoss",
+                     [log_prob.numpy(), labels.numpy()],
+                     reduction=reduction)
+        want = F.nll_loss(log_prob, labels, reduction=reduction).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# RoiAlign vs a numpy spec oracle
+# ---------------------------------------------------------------------------
+
+def roi_align_oracle(x, rois, batch_idx, out_h, out_w, ratio, scale,
+                     mode="avg", half_pixel=True):
+    """ONNX Runtime RoiAlign semantics: samples past the 1-pixel halo
+    contribute zero, everything else clamps into the image; the legacy >=1
+    ROI-size clamp applies only in output_half_pixel mode; max mode maxes
+    the WEIGHTED corner contributions."""
+    N, C, H, W = x.shape
+    out = np.zeros((len(rois), C, out_h, out_w), np.float32)
+    off = 0.5 if half_pixel else 0.0
+
+    def sample(b, yy, xx):
+        if yy < -1.0 or yy > H or xx < -1.0 or xx > W:
+            return [np.zeros(C, np.float32)] * 4
+        yy, xx = min(max(yy, 0.0), H - 1), min(max(xx, 0.0), W - 1)
+        x0, y0 = int(np.floor(xx)), int(np.floor(yy))
+        wx, wy = xx - x0, yy - y0
+        cs = []
+        for dy, fy in ((0, 1 - wy), (1, wy)):
+            for dx, fx in ((0, 1 - wx), (1, wx)):
+                ix = min(x0 + dx, W - 1)
+                iy = min(y0 + dy, H - 1)
+                cs.append(x[b, :, iy, ix] * fx * fy)
+        return cs
+
+    for r, (roi, b) in enumerate(zip(rois, batch_idx)):
+        x1, y1, x2, y2 = roi * scale - off
+        rw, rh = x2 - x1, y2 - y1
+        if not half_pixel:
+            rw, rh = max(rw, 1.0), max(rh, 1.0)
+        bw, bh = rw / out_w, rh / out_h
+        for oy in range(out_h):
+            for ox in range(out_w):
+                corners = [sample(
+                    b, y1 + (oy * ratio + sy + 0.5) * bh / ratio,
+                    x1 + (ox * ratio + sx + 0.5) * bw / ratio)
+                    for sy in range(ratio) for sx in range(ratio)]
+                if mode == "max":
+                    agg = np.max([c for cs in corners for c in cs], axis=0)
+                else:
+                    agg = np.mean([np.sum(cs, axis=0) for cs in corners],
+                                  axis=0)
+                out[r, :, oy, ox] = agg
+    return out
+
+
+@pytest.mark.parametrize("mode", ["avg", "max"])
+@pytest.mark.parametrize("half_pixel", [True, False])
+def test_roi_align_matches_oracle(mode, half_pixel):
+    rs = np.random.default_rng(0)
+    x = rs.normal(size=(2, 3, 10, 12)).astype(np.float32)
+    # includes an edge-touching ROI (border clamp) and a tiny sub-pixel ROI
+    # (exercises the mode-dependent legacy size clamp)
+    rois = np.asarray([[1.0, 1.0, 8.0, 7.0], [0.0, 2.0, 11.0, 9.0],
+                       [3.0, 0.5, 6.0, 4.0], [0.0, 0.0, 3.0, 2.0],
+                       [2.0, 2.0, 2.4, 2.4]], np.float32)
+    bidx = np.asarray([0, 1, 0, 1, 0], np.int64)
+    ctm = b"half_pixel" if half_pixel else b"output_half_pixel"
+    got = np.asarray(run_op("RoiAlign", [x, rois, bidx], output_height=4,
+                            output_width=3, sampling_ratio=2,
+                            spatial_scale=1.0, mode=mode.encode(),
+                            coordinate_transformation_mode=ctm))
+    want = roi_align_oracle(x, rois, bidx, 4, 3, 2, 1.0, mode=mode,
+                            half_pixel=half_pixel)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# opset-18 tail vs oracles
+# ---------------------------------------------------------------------------
+
+def test_roi_align_max_is_weighted_corner_max():
+    # constant image, sample centered in a cell (all corner weights 0.25):
+    # ORT max mode yields 0.25 * value, NOT the interpolated value
+    x = np.full((1, 1, 6, 6), 4.0, np.float32)
+    rois = np.asarray([[1.0, 1.0, 3.0, 3.0]], np.float32)
+    got = np.asarray(run_op("RoiAlign", [x, rois, np.asarray([0])],
+                            output_height=1, output_width=1,
+                            sampling_ratio=1, spatial_scale=1.0,
+                            mode=b"max",
+                            coordinate_transformation_mode=b"half_pixel"))
+    np.testing.assert_allclose(got, [[[[1.0]]]], rtol=1e-6)
+
+
+def test_grid_sample_size_one_dim_reflection():
+    # H=1 with align_corners reflection: the reflect span is 0 — must return
+    # the single row, never NaN
+    x = np.arange(5, dtype=np.float32).reshape(1, 1, 1, 5)
+    grid = np.stack(np.meshgrid(np.linspace(-1.2, 1.2, 4),
+                                np.asarray([0.3])), axis=-1)[None].astype(
+        np.float32)
+    got = np.asarray(run_op("GridSample", [x, grid], mode=b"bilinear",
+                            padding_mode=b"reflection", align_corners=1))
+    assert np.all(np.isfinite(got)), got
+
+
+def test_group_normalization_both_param_shapes():
+    rs = np.random.default_rng(1)
+    x = rs.normal(size=(2, 6, 4, 4)).astype(np.float32)
+    G = 3
+    # per-channel params (opset 21 / torch GroupNorm semantics)
+    scale_c = rs.normal(size=6).astype(np.float32)
+    bias_c = rs.normal(size=6).astype(np.float32)
+    got = np.asarray(run_op("GroupNormalization", [x, scale_c, bias_c],
+                            num_groups=G, epsilon=1e-5))
+    want = F.group_norm(torch.from_numpy(x), G, torch.from_numpy(scale_c),
+                        torch.from_numpy(bias_c), eps=1e-5).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # per-group params (opset-18 shape [num_groups]) = repeat to channels
+    scale_g = rs.normal(size=G).astype(np.float32)
+    bias_g = rs.normal(size=G).astype(np.float32)
+    got_g = np.asarray(run_op("GroupNormalization", [x, scale_g, bias_g],
+                              num_groups=G, epsilon=1e-5))
+    want_g = F.group_norm(torch.from_numpy(x), G,
+                          torch.from_numpy(np.repeat(scale_g, 2)),
+                          torch.from_numpy(np.repeat(bias_g, 2)),
+                          eps=1e-5).numpy()
+    np.testing.assert_allclose(got_g, want_g, rtol=1e-4, atol=1e-5)
+
+
+def test_mean_variance_normalization():
+    rs = np.random.default_rng(2)
+    x = rs.normal(loc=3.0, scale=2.0, size=(2, 3, 4, 5)).astype(np.float32)
+    got = np.asarray(run_op("MeanVarianceNormalization", [x]))
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    std = x.std(axis=(0, 2, 3), keepdims=True)
+    np.testing.assert_allclose(got, (x - mean) / (std + 1e-9), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bitwise_family():
+    rs = np.random.default_rng(3)
+    a = rs.integers(0, 255, (4, 5)).astype(np.int32)
+    b = rs.integers(0, 255, (4, 5)).astype(np.int32)
+    np.testing.assert_array_equal(run_op("BitwiseAnd", [a, b]), a & b)
+    np.testing.assert_array_equal(run_op("BitwiseOr", [a, b]), a | b)
+    np.testing.assert_array_equal(run_op("BitwiseXor", [a, b]), a ^ b)
+    np.testing.assert_array_equal(run_op("BitwiseNot", [a]), ~a)
+
+
+def test_center_crop_pad():
+    rs = np.random.default_rng(4)
+    x = rs.normal(size=(3, 8, 5)).astype(np.float32)
+    # crop dim 1 (8 -> 4, center), pad dim 2 (5 -> 9, center)
+    got = np.asarray(run_op("CenterCropPad", [x, np.asarray([4, 9])],
+                            axes=[1, 2]))
+    assert got.shape == (3, 4, 9)
+    np.testing.assert_allclose(got[:, :, 2:7], x[:, 2:6, :])
+    assert np.all(got[:, :, :2] == 0) and np.all(got[:, :, 7:] == 0)
+    # all-axes form with odd crop: extra element comes off the end
+    got2 = np.asarray(run_op("CenterCropPad", [x, np.asarray([3, 3, 3])]))
+    np.testing.assert_allclose(got2, x[:, 2:5, 1:4])
