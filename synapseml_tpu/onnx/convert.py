@@ -7,7 +7,7 @@ compiles (and fuses) for the device. Weights become closure constants so XLA
 can constant-fold/bake them into the executable, mirroring a session's
 "model resident in device memory".
 
-The 155-op registry is proven through REAL torch.onnx exports, one per model
+The 157-op registry is proven through REAL torch.onnx exports, one per model
 family: convnets (ResNet-50, ``tests/test_onnx_resnet.py``), transformer
 encoders with einsum attention and dynamic shapes (``tests/test_onnx_bert.py``),
 causal decoders with Trilu masks, GatherElements and shape-guard If nodes
@@ -1764,6 +1764,72 @@ def _softmax_ce_loss(ins, attrs):
                      attrs.get("reduction", "mean"),
                      attrs.get("ignore_index"))
     return (loss, log_prob)  # second output is optional (log_prob)
+
+
+@op("STFT")
+def _stft(ins, attrs):
+    """Short-time Fourier transform (opset 17, the audio-frontend op):
+    frame the signal, window, FFT per frame. Frame geometry must be static
+    (XLA shapes); output is ``[B, frames, bins, 2]`` real/imag."""
+    signal = jnp.asarray(ins[0])
+    step = int(np.asarray(ins[1]))
+    window = None if len(ins) <= 2 or ins[2] is None else jnp.asarray(ins[2])
+    if len(ins) > 3 and ins[3] is not None:
+        frame_len = int(np.asarray(ins[3]))
+    elif window is not None:
+        frame_len = window.shape[0]
+    else:
+        raise NotImplementedError("STFT needs window or frame_length")
+    onesided = bool(attrs.get("onesided", 1))
+    if signal.ndim == 3:
+        if signal.shape[-1] == 2:  # complex [B, L, 2] layout
+            signal = signal[..., 0] + 1j * signal[..., 1]
+        else:  # real [B, L, 1] layout
+            signal = signal[..., 0]
+    B, L = signal.shape
+    n_frames = (L - frame_len) // step + 1
+    idx = (jnp.arange(n_frames)[:, None] * step
+           + jnp.arange(frame_len)[None, :])        # [frames, frame_len]
+    frames = signal[:, idx]                         # [B, frames, frame_len]
+    if window is not None:
+        frames = frames * window.astype(frames.dtype)
+    complex_in = jnp.iscomplexobj(signal)
+    spec = (jnp.fft.rfft(frames, axis=-1) if onesided and not complex_in
+            else jnp.fft.fft(frames, axis=-1))
+    out = jnp.stack([jnp.real(spec), jnp.imag(spec)], axis=-1)
+    real_dtype = jnp.real(jnp.zeros((), signal.dtype)).dtype
+    return out.astype(real_dtype)
+
+
+@op("Col2Im")
+def _col2im(ins, attrs):
+    """Opset-18 inverse im2col: scatter-ADD column blocks back into the
+    image (overlaps accumulate). Block geometry must be static."""
+    cols = jnp.asarray(ins[0])                      # [N, C*kh*kw, L]
+    image_shape = [int(v) for v in np.asarray(ins[1])]
+    block_shape = [int(v) for v in np.asarray(ins[2])]
+    if len(image_shape) != 2:
+        raise NotImplementedError("Col2Im: only 2D images supported")
+    H, W = image_shape
+    kh, kw = block_shape
+    dh, dw = _pair(attrs.get("dilations"), 1)
+    sh, sw = _pair(attrs.get("strides"), 1)
+    pads = attrs.get("pads", (0, 0, 0, 0))
+    pt, pl, pb, pr = (int(p) for p in pads)
+    N = cols.shape[0]
+    C = cols.shape[1] // (kh * kw)
+    n_h = (H + pt + pb - (dh * (kh - 1) + 1)) // sh + 1
+    n_w = (W + pl + pr - (dw * (kw - 1) + 1)) // sw + 1
+    x = cols.reshape(N, C, kh, kw, n_h, n_w)
+    out = jnp.zeros((N, C, H + pt + pb, W + pl + pr), cols.dtype)
+    rows = jnp.arange(n_h) * sh                     # block top edges (padded)
+    cs = jnp.arange(n_w) * sw
+    for i in range(kh):
+        for j in range(kw):
+            r = rows + i * dh                       # [n_h]
+            c = cs + j * dw                         # [n_w]
+            out = out.at[:, :, r[:, None], c[None, :]].add(x[:, :, i, j])
+    return out[:, :, pt:pt + H, pl:pl + W]
 
 
 # ---------------- dynamically-shaped ops (eager execution only) ----------------

@@ -256,6 +256,68 @@ def test_bitwise_family():
     np.testing.assert_array_equal(run_op("BitwiseNot", [a]), ~a)
 
 
+def test_stft_matches_torch():
+    torch.manual_seed(5)
+    B, L, n_fft, hop = 2, 64, 16, 4
+    sig = torch.randn(B, L)
+    win = torch.hann_window(n_fft)
+    want = torch.stft(sig, n_fft=n_fft, hop_length=hop, win_length=n_fft,
+                      window=win, center=False, onesided=True,
+                      return_complex=True)
+    got = np.asarray(run_op("STFT", [sig.numpy(),
+                                     np.asarray(hop, np.int64),
+                                     win.numpy()], onesided=1))
+    # ONNX layout [B, frames, bins, 2]; torch returns [B, bins, frames]
+    got_c = got[..., 0] + 1j * got[..., 1]
+    np.testing.assert_allclose(got_c.transpose(0, 2, 1), want.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    # 3D real-input layout [B, L, 1] is the spec's canonical signal shape
+    got3 = np.asarray(run_op("STFT", [sig.numpy()[..., None],
+                                      np.asarray(hop, np.int64),
+                                      win.numpy()], onesided=1))
+    np.testing.assert_allclose(got3, got, rtol=1e-6)
+
+
+def test_stft_complex_input():
+    # complex [B, L, 2] layout: full FFT of the complex signal (onesided is
+    # a real-input-only concept), never the FFT of just the real part
+    torch.manual_seed(7)
+    B, L, n_fft, hop = 1, 32, 8, 4
+    sig_c = torch.randn(B, L, dtype=torch.complex64)
+    win = torch.hann_window(n_fft)
+    want = torch.stft(sig_c, n_fft=n_fft, hop_length=hop, win_length=n_fft,
+                      window=win, center=False, onesided=False,
+                      return_complex=True)
+    sig_ri = np.stack([sig_c.real.numpy(), sig_c.imag.numpy()], axis=-1)
+    got = np.asarray(run_op("STFT", [sig_ri, np.asarray(hop, np.int64),
+                                     win.numpy()], onesided=1))
+    got_c = got[..., 0] + 1j * got[..., 1]
+    np.testing.assert_allclose(got_c.transpose(0, 2, 1), want.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_col2im_inverts_unfold():
+    # fold(unfold(x)) multiplies each pixel by its patch coverage count —
+    # the torch F.fold oracle, including stride/padding/dilation
+    torch.manual_seed(6)
+    x = torch.randn(2, 3, 8, 10)
+    for kw_args in (dict(kernel_size=(3, 3), stride=(2, 2), padding=(1, 1),
+                         dilation=(1, 1)),
+                    dict(kernel_size=(2, 4), stride=(1, 2), padding=(0, 1),
+                         dilation=(2, 1))):
+        cols = F.unfold(x, **kw_args)
+        want = F.fold(cols, output_size=(8, 10), **kw_args).numpy()
+        k = kw_args["kernel_size"]
+        p = kw_args["padding"]
+        got = np.asarray(run_op(
+            "Col2Im",
+            [cols.numpy(), np.asarray([8, 10]), np.asarray(k)],
+            strides=list(kw_args["stride"]),
+            dilations=list(kw_args["dilation"]),
+            pads=[p[0], p[1], p[0], p[1]]))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
 def test_center_crop_pad():
     rs = np.random.default_rng(4)
     x = rs.normal(size=(3, 8, 5)).astype(np.float32)
