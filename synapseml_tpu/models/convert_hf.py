@@ -599,8 +599,100 @@ def pretrained_vision(ckpt_dir: str, num_classes: int | None = None, seed: int =
     raise ValueError(f"unrecognized vision checkpoint (model_type={mt!r})")
 
 
+def gpt2_config_from_hf(config: dict, **overrides) -> Any:
+    """HF GPT-2 config -> the generic causal-LM TransformerConfig: LayerNorm
+    pre-norm with biases, tanh-GELU MLP, learned absolute positions, no
+    RoPE — ``LlamaLM`` runs it unchanged (the wrapper adds wpe when
+    ``learned_pos``)."""
+    from .flax_nets.llama import llama2_7b
+
+    kw = dict(
+        vocab_size=config.get("vocab_size", 50257),
+        hidden=config.get("n_embd", 768),
+        n_layers=config.get("n_layer", 12),
+        n_heads=config.get("n_head", 12),
+        n_kv_heads=config.get("n_head", 12),
+        mlp_dim=config.get("n_inner") or 4 * config.get("n_embd", 768),
+        max_len=config.get("n_positions", config.get("n_ctx", 1024)),
+        norm_eps=config.get("layer_norm_epsilon", 1e-5),
+    )
+    act_map = {"gelu_new": "gelu_tanh", "gelu_pytorch_tanh": "gelu_tanh",
+               "gelu": "gelu", "relu": "relu", "silu": "silu",
+               "swish": "silu"}
+    hf_act = config.get("activation_function", "gelu_new")
+    if hf_act not in act_map:
+        raise NotImplementedError(
+            f"GPT-2 activation_function={hf_act!r} is not supported")
+    for flag in ("scale_attn_by_inverse_layer_idx", "reorder_and_upcast_attn"):
+        if config.get(flag):
+            raise NotImplementedError(
+                f"GPT-2 {flag}=true changes attention math; this mapping "
+                "covers the standard-attention family only")
+    kw.update(norm="layernorm", act=act_map[hf_act], gated_mlp=False,
+              use_rope=False, learned_pos=True)
+    kw.update(overrides)
+    return llama2_7b(**kw)
+
+
+def gpt2_params_from_hf(sd: dict[str, np.ndarray], n_heads: int) -> dict:
+    """HF GPT2LMHeadModel (or bare GPT2Model) -> ``LlamaLM`` params.
+
+    GPT-2 Conv1D weights are stored ``[in, out]`` (already kernel-shaped, no
+    transpose); ``c_attn`` fuses qkv and splits here; the LM head is tied
+    to ``wte``."""
+    body = _strip_prefix(sd, "transformer.")
+    n_layers = 1 + max(int(k.split(".")[1]) for k in body
+                       if k.startswith("h."))
+    embed = body["wte.weight"]
+    hidden = embed.shape[1]
+    D = hidden // n_heads
+
+    decoder: dict[str, Any] = {}
+    for i in range(n_layers):
+        p = f"h.{i}"
+        w = body[f"{p}.attn.c_attn.weight"]    # Conv1D [H, 3H] (kernel-shaped)
+        b = body[f"{p}.attn.c_attn.bias"]      # [3H]
+        wq, wk, wv = np.split(w, 3, axis=1)
+        bq, bk, bv = np.split(b, 3)
+        wo = body[f"{p}.attn.c_proj.weight"]   # [H, H]
+        decoder[f"layer_{i}"] = {
+            "LayerNorm_0": _ln(body, f"{p}.ln_1"),
+            "attn": {
+                # DenseGeneral shapes: qkv [H, heads, D], o [heads, D, H]
+                "q": {"kernel": wq.reshape(hidden, n_heads, D),
+                      "bias": bq.reshape(n_heads, D)},
+                "k": {"kernel": wk.reshape(hidden, n_heads, D),
+                      "bias": bk.reshape(n_heads, D)},
+                "v": {"kernel": wv.reshape(hidden, n_heads, D),
+                      "bias": bv.reshape(n_heads, D)},
+                "o": {"kernel": wo.reshape(n_heads, D, hidden),
+                      "bias": body[f"{p}.attn.c_proj.bias"]},
+            },
+            "LayerNorm_1": _ln(body, f"{p}.ln_2"),
+            "mlp": {
+                "up": {"kernel": body[f"{p}.mlp.c_fc.weight"],
+                       "bias": body[f"{p}.mlp.c_fc.bias"]},
+                "down": {"kernel": body[f"{p}.mlp.c_proj.weight"],
+                         "bias": body[f"{p}.mlp.c_proj.bias"]},
+            },
+        }
+    decoder["LayerNorm_0"] = _ln(body, "ln_f")
+    lm_head = (np.ascontiguousarray(sd["lm_head.weight"].T)
+               if "lm_head.weight" in sd else np.ascontiguousarray(embed.T))
+    return {"embed": {"embedding": embed},
+            "wpe": {"embedding": body["wpe.weight"]},
+            "decoder": decoder, "lm_head": {"kernel": lm_head}}
+
+
 def pretrained_causal_lm(ckpt_dir: str, **cfg_overrides):
-    """(TransformerConfig, params) for ``LlamaLM`` from a local HF dir."""
+    """(TransformerConfig, params) for ``LlamaLM`` from a local HF dir.
+
+    Dispatches on ``config.json``'s ``model_type``: llama/mistral/mixtral
+    share the Llama mapping; ``gpt2`` takes the learned-position LayerNorm
+    mapping."""
     config, sd = load_checkpoint(ckpt_dir)
+    if config.get("model_type") == "gpt2":
+        cfg = gpt2_config_from_hf(config, **cfg_overrides)
+        return cfg, gpt2_params_from_hf(sd, n_heads=cfg.n_heads)
     cfg = llama_config_from_hf(config, **cfg_overrides)
     return cfg, llama_params_from_hf(sd, n_heads=cfg.n_heads)

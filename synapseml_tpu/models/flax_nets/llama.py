@@ -46,6 +46,16 @@ class LlamaLM(nn.Module):
                      embedding_init=nn.with_logical_partitioning(
                          nn.initializers.normal(0.02), ("vocab", "embed")),
                      name="embed")(input_ids)
+        if cfg.learned_pos:  # GPT-2-family absolute position embeddings
+            B, T = input_ids.shape
+            pos = (positions if positions is not None
+                   else jnp.broadcast_to(jnp.arange(T)[None, :], (B, T)))
+            x = x + nn.Embed(
+                cfg.max_len, cfg.hidden, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                embedding_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), (None, "embed")),
+                name="wpe")(pos)
         mask = None
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)

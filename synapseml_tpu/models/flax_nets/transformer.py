@@ -49,6 +49,9 @@ class TransformerConfig:
     # (norm after each residual add, no final norm — original BERT). Post-norm
     # is required for faithful ingestion of HF BERT checkpoints.
     norm_position: str = "pre"
+    # learned absolute position embeddings added by the LM wrapper (GPT-2
+    # family); RoPE models leave this False
+    learned_pos: bool = False
     gated_mlp: bool = False  # SwiGLU when True
     act: str = "gelu"
     remat: bool = False

@@ -141,6 +141,78 @@ def test_llama_parity_gqa(tmp_path):
     np.testing.assert_allclose(got, want, atol=5e-4)
 
 
+def test_gpt2_parity(tmp_path):
+    """GPT-2 family ingestion: learned positions, LayerNorm pre-norm with
+    biases, fused-qkv Conv1D split, tied LM head — logits vs torch."""
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(7)
+    tcfg = GPT2Config(vocab_size=93, n_embd=48, n_layer=2, n_head=4,
+                      n_positions=64, resid_pdrop=0.0, embd_pdrop=0.0,
+                      attn_pdrop=0.0)
+    tmodel = GPT2LMHeadModel(tcfg)
+    d = _save(tmodel, tmp_path, tcfg)
+
+    from synapseml_tpu.models.flax_nets.llama import LlamaLM
+
+    cfg, params = C.pretrained_causal_lm(d, dtype=jnp.float32)
+    assert cfg.learned_pos and cfg.norm == "layernorm" and cfg.causal
+    assert cfg.act == "gelu_tanh" and not cfg.use_rope
+    module = LlamaLM(cfg)
+
+    ids = np.random.default_rng(8).integers(0, 93, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = tmodel(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(module.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=5e-4)
+
+
+def test_gpt2_greedy_decode_matches_torch(tmp_path):
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(9)
+    tcfg = GPT2Config(vocab_size=61, n_embd=32, n_layer=2, n_head=4,
+                      n_positions=48, resid_pdrop=0.0, embd_pdrop=0.0,
+                      attn_pdrop=0.0)
+    tmodel = GPT2LMHeadModel(tcfg)
+    d = _save(tmodel, tmp_path, tcfg)
+
+    from synapseml_tpu.models.flax_nets.llama import LlamaLM, greedy_generate
+
+    cfg, params = C.pretrained_causal_lm(d, dtype=jnp.float32)
+    prompt = np.random.default_rng(10).integers(0, 61, (1, 6)).astype(np.int32)
+    got = np.asarray(greedy_generate(LlamaLM(cfg, decode=True), params,
+                                     jnp.asarray(prompt), max_new_tokens=8))
+    want = tmodel.generate(torch.tensor(prompt, dtype=torch.long),
+                           max_new_tokens=8, do_sample=False,
+                           pad_token_id=0).numpy()
+    np.testing.assert_array_equal(got[:, prompt.shape[1]:],
+                                  want[:, prompt.shape[1]:])
+
+
+def test_gpt2_through_causal_lm_transformer(tmp_path):
+    # the user-facing path: checkpoint dir -> HuggingFaceCausalLM -> decode
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(11)
+    tcfg = GPT2Config(vocab_size=61, n_embd=32, n_layer=1, n_head=4,
+                      n_positions=48, resid_pdrop=0.0, embd_pdrop=0.0,
+                      attn_pdrop=0.0)
+    d = _save(GPT2LMHeadModel(tcfg), tmp_path, tcfg)
+
+    from synapseml_tpu.core import DataFrame
+    from synapseml_tpu.hf import HuggingFaceCausalLM
+    from synapseml_tpu.models.tokenizer import HashingTokenizer
+
+    lm = HuggingFaceCausalLM(model_name=d, max_new_tokens=4,
+                             tokenizer=HashingTokenizer(vocab_size=61),
+                             prompt_bucket=16)  # fit the 48-position cache
+    df = DataFrame.from_dict({"prompt": np.asarray(["hello there"],
+                                                   dtype=object)})
+    gens = list(lm.transform(df).collect_column("completions"))
+    assert len(gens) == 1 and len(gens[0]) == 4
+
+
 def test_mixtral_parity_sparse_moe(tmp_path):
     """Mixtral-family ingestion: SwiGLU experts + top-2 routing converted
     from a (tiny, random) HF MixtralForCausalLM, logits vs torch."""
