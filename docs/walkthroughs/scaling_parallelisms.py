@@ -107,6 +107,22 @@ for i in range(3):
     print(f"pipeline step {i}: loss={float(l):.4f}")
 
 # %% [markdown]
+# Two more schedules behind the same call: `io="sharded"` keeps microbatch
+# inputs AND outputs sharded over the pipe axis (per-device activation
+# memory scales as 1/stages — the production layout), and `interleave=v`
+# runs the circular schedule (stages round-robin across devices, bubble
+# cut ~v-fold). Both match GPipe numerically:
+
+# %%
+out_gpipe = pipeline_sharded(mesh_pp, stage_fn, params, x)
+out_shard = pipeline_sharded(mesh_pp, stage_fn, params, x, io="sharded")
+import numpy as np
+
+np.testing.assert_allclose(np.asarray(out_shard), np.asarray(out_gpipe),
+                           rtol=1e-5, atol=1e-6)
+print("io='sharded' == GPipe; per-device outputs are 1/stages of the batch")
+
+# %% [markdown]
 # ## 4. The point
 #
 # Six parallelisms, zero custom communication code: the mesh names the
