@@ -269,6 +269,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                   valid_group_sizes: np.ndarray | None = None,
                   early_stopping_round: int = 0, seed: int = 0,
                   mesh=None, objective_alpha: float | None = None,
+                  tweedie_variance_power: float | None = None,
                   callbacks: Sequence[Callable] | None = None,
                   boosting_type: str = "gbdt", top_rate: float = 0.2,
                   other_rate: float = 0.1, drop_rate: float = 0.1,
@@ -337,8 +338,12 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
             spw = (n - n_pos) / n_pos
         w_np[:n] = np.where(pos, w_np[:n] * spw, w_np[:n])
 
-    o = obj.get_objective(objective, num_class=num_class,
-                          **({"alpha": objective_alpha} if objective_alpha is not None else {}))
+    obj_kw = {}
+    if objective_alpha is not None:
+        obj_kw["alpha"] = objective_alpha
+    if tweedie_variance_power is not None:
+        obj_kw["tweedie_variance_power"] = tweedie_variance_power
+    o = obj.get_objective(objective, num_class=num_class, **obj_kw)
     K = o.num_model_out
 
     with measures.measure("device_transfer"):

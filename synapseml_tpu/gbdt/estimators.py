@@ -316,10 +316,14 @@ class LightGBMClassificationModel(_LightGBMModelBase):
 class LightGBMRegressor(Estimator, _LightGBMParams):
     feature_name = "lightgbm"
 
-    objective = Param("objective", "regression | regression_l1 | huber | poisson | quantile",
+    objective = Param("objective", "regression | regression_l1 | huber | "
+                      "poisson | quantile | tweedie",
                       default="regression")
     alpha = Param("alpha", "huber delta / quantile level", default=0.9,
                   converter=TypeConverters.to_float)
+    tweedie_variance_power = Param(
+        "tweedie_variance_power", "tweedie rho in (1, 2): 1 -> poisson-like, "
+        "2 -> gamma-like", default=1.5, converter=TypeConverters.to_float)
 
     def _fit(self, df: DataFrame) -> "LightGBMRegressionModel":
         train, valid = self._split_validation(df)
@@ -338,6 +342,7 @@ class LightGBMRegressor(Estimator, _LightGBMParams):
         booster = train_booster(
             x, y, objective=self.get("objective"), weights=w,
             objective_alpha=self.get("alpha"),
+            tweedie_variance_power=self.get("tweedie_variance_power"),
             valid_features=vx, valid_labels=vy, **self._train_kwargs())
         model = LightGBMRegressionModel(booster=booster)
         model.set(**{k: v for k, v in self._param_values.items()

@@ -397,7 +397,8 @@ def parse_lightgbm_string(text: str) -> ImportedBooster:
         K, base = 1, "binary"
     elif first == "lambdarank":
         K, base = 1, "lambdarank"
-    elif first in ("regression_l1", "huber", "poisson", "quantile"):
+    elif first in ("regression_l1", "huber", "poisson", "quantile",
+                   "tweedie"):
         K, base = 1, first  # link-carrying regression objectives
     else:
         K, base = 1, "regression"

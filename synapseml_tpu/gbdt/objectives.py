@@ -64,8 +64,29 @@ def _quantile_grad_hess(s, y, alpha=0.5):
     return jnp.where(r >= 0, 1.0 - alpha, -alpha), jnp.ones_like(y)
 
 
+def _tweedie_grad_hess(s, y, rho=1.5):
+    # LightGBM tweedie (1 < rho < 2, log link): deviance
+    # -y e^{(1-rho)s}/(1-rho) + e^{(2-rho)s}/(2-rho); d/ds and d2/ds2
+    a = jnp.exp((1.0 - rho) * s[:, 0])
+    b = jnp.exp((2.0 - rho) * s[:, 0])
+    grad = -y * a + b
+    hess = -y * (1.0 - rho) * a + (2.0 - rho) * b
+    return grad, hess
+
+
 def _rmse(s, y):
     return jnp.sqrt(jnp.mean((s[:, 0] - y) ** 2))
+
+
+def _rmse_exp_link(s, y):
+    # log-link objectives (poisson/tweedie) carry raw scores on the LOG
+    # scale; the validation metric must compare on the mean scale or early
+    # stopping optimizes a wrong-scale number
+    return jnp.sqrt(jnp.mean((jnp.exp(s[:, 0]) - y) ** 2))
+
+
+def _log_mean_init(y):
+    return jnp.log(jnp.maximum(jnp.mean(y), 1e-6))[None]
 
 
 def _mae(s, y):
@@ -195,16 +216,22 @@ def get_objective(name: str, num_class: int = 1, **kw) -> Objective:
                          lambda s, y: _huber_grad_hess(s, y, delta),
                          lambda s: s[:, 0], _rmse, "rmse")
     if name == "poisson":
-        return Objective("poisson", 1,
-                         lambda y: jnp.log(jnp.maximum(jnp.mean(y), 1e-6))[None],
-                         _poisson_grad_hess,
-                         lambda s: jnp.exp(s[:, 0]), _rmse, "rmse")
+        return Objective("poisson", 1, _log_mean_init, _poisson_grad_hess,
+                         lambda s: jnp.exp(s[:, 0]), _rmse_exp_link, "rmse")
     if name == "quantile":
         alpha = float(kw.get("alpha", 0.5))
         return Objective("quantile", 1,
                          lambda y: jnp.quantile(y, alpha)[None],
                          lambda s, y: _quantile_grad_hess(s, y, alpha),
                          lambda s: s[:, 0], _mae, "mae")
+    if name == "tweedie":
+        rho = float(kw.get("tweedie_variance_power", 1.5))
+        if not 1.0 <= rho < 2.0:  # LightGBM's bound; rho=1 = poisson limit
+            raise ValueError(
+                f"tweedie_variance_power must be in [1, 2), got {rho}")
+        return Objective("tweedie", 1, _log_mean_init,
+                         lambda s, y: _tweedie_grad_hess(s, y, rho),
+                         lambda s: jnp.exp(s[:, 0]), _rmse_exp_link, "rmse")
     if name == "binary":
         return Objective("binary", 1, _binary_init, _binary_grad_hess,
                          lambda s: _sigmoid(s[:, 0]), _binary_logloss, "binary_logloss")
