@@ -322,7 +322,7 @@ class LightGBMRegressor(Estimator, _LightGBMParams):
     alpha = Param("alpha", "huber delta / quantile level", default=0.9,
                   converter=TypeConverters.to_float)
     tweedie_variance_power = Param(
-        "tweedie_variance_power", "tweedie rho in (1, 2): 1 -> poisson-like, "
+        "tweedie_variance_power", "tweedie rho in [1, 2): 1 -> poisson limit, "
         "2 -> gamma-like", default=1.5, converter=TypeConverters.to_float)
 
     def _fit(self, df: DataFrame) -> "LightGBMRegressionModel":

@@ -65,7 +65,7 @@ def _quantile_grad_hess(s, y, alpha=0.5):
 
 
 def _tweedie_grad_hess(s, y, rho=1.5):
-    # LightGBM tweedie (1 < rho < 2, log link): deviance
+    # LightGBM tweedie (1 <= rho < 2, log link): deviance
     # -y e^{(1-rho)s}/(1-rho) + e^{(2-rho)s}/(2-rho); d/ds and d2/ds2
     a = jnp.exp((1.0 - rho) * s[:, 0])
     b = jnp.exp((2.0 - rho) * s[:, 0])

@@ -679,6 +679,15 @@ def test_tweedie_objective():
     with pytest.raises(ValueError, match="tweedie_variance_power"):
         get_objective("tweedie", tweedie_variance_power=2.5)
 
+    # negative labels fail fast (LightGBM parity): the log-link hessian
+    # would flip sign and silently destabilize leaf weights
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    bad_y = yv.copy()
+    bad_y[0] = -1.0
+    with pytest.raises(ValueError, match="non-negative"):
+        train_booster(X, bad_y, objective="tweedie", num_iterations=2)
+
     # model-string round-trip keeps the log link (like poisson)
     from synapseml_tpu.gbdt import parse_lightgbm_string, to_lightgbm_string
 
