@@ -1321,6 +1321,12 @@ class LightGBMRegressor(WrapperBase):
     def getTopRate(self):
         return self._get('top_rate')
 
+    def setTweedieVariancePower(self, value):
+        return self._set('tweedie_variance_power', value)
+
+    def getTweedieVariancePower(self):
+        return self._get('tweedie_variance_power')
+
     def setValidationIndicatorCol(self, value):
         return self._set('validation_indicator_col', value)
 
