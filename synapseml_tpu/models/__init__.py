@@ -1,10 +1,13 @@
 from .cntk import CNTKModel
+from .downloader import ModelDownloader, ModelSchema
 from .text import DeepTextClassifier, DeepTextModel
 from .tokenizer import HashingTokenizer, resolve_tokenizer
 from .trainer import Trainer, TrainerConfig, TrainState, cross_entropy_loss
 from .vision import DeepVisionClassifier, DeepVisionModel
 
 __all__ = [
+    "ModelDownloader",
+    "ModelSchema",
     "CNTKModel",
     "DeepTextClassifier", "DeepTextModel",
     "DeepVisionClassifier", "DeepVisionModel",

@@ -1,0 +1,190 @@
+"""Pretrained-model repository client (reference
+``core/src/main/python/synapse/ml/downloader/ModelDownloader.py``).
+
+The reference downloads CNTK model files from a CDN and tracks them with
+``ModelSchema`` records. Here the repository holds HF-format checkpoint
+directories (the format every ingestion path consumes —
+:mod:`synapseml_tpu.models.convert_hf`): ``local_models()`` enumerates
+checkpoint dirs under the local path, ``remote_models()`` reads a JSON
+index from a model server, and ``download_model()`` fetches a model's
+files with sha256 verification. Remote calls honor the environment:
+zero-egress hosts get an actionable error, and everything is testable
+against an in-process HTTP mock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Any, Iterator
+
+__all__ = ["ModelSchema", "ModelDownloader"]
+
+
+@dataclasses.dataclass
+class ModelSchema:
+    """One model's record (the reference's ModelSchema analog)."""
+
+    name: str
+    kind: str = "causal-lm"  # causal-lm | text-classifier | vision | other
+    uri: str = ""            # local dir or remote base URL
+    files: tuple = ()        # file names within the model dir
+    sha256: dict = dataclasses.field(default_factory=dict)  # per-file
+    size_bytes: int = 0
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModelSchema":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in known}
+        kw["files"] = tuple(kw.get("files", ()))
+        return cls(**kw)
+
+    def to_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["files"] = list(out["files"])
+        return out
+
+
+class ModelDownloader:
+    """Enumerate/fetch pretrained checkpoints.
+
+    ``local_path`` is the model cache (one subdirectory per model);
+    ``server_url`` is a repository serving ``index.json`` (a list of
+    ModelSchema dicts) and the model files beneath ``<url>/<name>/``.
+    """
+
+    def __init__(self, local_path: str, server_url: str | None = None,
+                 timeout_s: float = 10.0):
+        self.local_path = local_path
+        self.server_url = (server_url or "").rstrip("/") or None
+        self.timeout_s = timeout_s
+        os.makedirs(local_path, exist_ok=True)
+
+    def _safe_path(self, *rel: str) -> str:
+        """Join remote-supplied names into the cache dir, rejecting absolute
+        paths and traversal — the index is REMOTE UNTRUSTED data (the same
+        guard as ``ONNXHub._safe_cache_path``)."""
+        for r in rel:
+            if os.path.isabs(r):
+                raise ValueError(f"index path must be relative: {r!r}")
+        path = os.path.realpath(os.path.join(self.local_path, *rel))
+        root = os.path.realpath(self.local_path)
+        if not (path == root or path.startswith(root + os.sep)):
+            raise ValueError(f"index path escapes the cache dir: {rel!r}")
+        return path
+
+    # ---- local ----
+    def local_models(self) -> Iterator[ModelSchema]:
+        for name in sorted(os.listdir(self.local_path)):
+            d = os.path.join(self.local_path, name)
+            # a checkpoint dir = config.json + at least one weights file
+            if not (os.path.isdir(d)
+                    and os.path.isfile(os.path.join(d, "config.json"))
+                    and any(f.endswith((".safetensors", ".bin"))
+                            for f in os.listdir(d))):
+                continue
+            files = tuple(sorted(
+                f for f in os.listdir(d)
+                if os.path.isfile(os.path.join(d, f))))
+            size = sum(os.path.getsize(os.path.join(d, f)) for f in files)
+            kind = "other"
+            try:
+                with open(os.path.join(d, "config.json")) as fh:
+                    cfg = json.load(fh)
+                mt = cfg.get("model_type", "")
+                kind = {"gpt2": "causal-lm", "llama": "causal-lm",
+                        "mistral": "causal-lm", "mixtral": "causal-lm",
+                        "bert": "text-classifier", "vit": "vision",
+                        "resnet": "vision"}.get(mt, "other")
+            except (OSError, json.JSONDecodeError):
+                pass
+            yield ModelSchema(name=name, kind=kind, uri=d, files=files,
+                              size_bytes=size)
+
+    # ---- remote ----
+    def _open(self, url: str):
+        try:
+            return urllib.request.urlopen(url, timeout=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            # the server responded — a bad index entry or missing file, NOT
+            # an egress problem; keep the real status in the message
+            raise RuntimeError(f"model server returned {e.code} for "
+                               f"{url!r}: {e.reason}") from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise RuntimeError(
+                f"model server unreachable at {url!r}: {e}. On zero-egress "
+                "hosts, place checkpoint directories under "
+                f"{self.local_path!r} instead (local_models() finds them)."
+            ) from e
+
+    def _fetch(self, url: str) -> bytes:
+        with self._open(url) as r:
+            return r.read()
+
+    def _fetch_to_file(self, url: str, path: str) -> str:
+        """Stream a download to ``path`` atomically (.part + os.replace),
+        hashing incrementally — one pass, constant memory."""
+        h = hashlib.sha256()
+        tmp = path + ".part"
+        with self._open(url) as r, open(tmp, "wb") as f:
+            for chunk in iter(lambda: r.read(1 << 20), b""):
+                h.update(chunk)
+                f.write(chunk)
+        os.replace(tmp, path)
+        return h.hexdigest()
+
+    def remote_models(self) -> list[ModelSchema]:
+        if self.server_url is None:
+            raise ValueError("remote_models() needs server_url")
+        index = json.loads(self._fetch(self.server_url + "/index.json"))
+        return [ModelSchema.from_dict(d) for d in index]
+
+    def download_model(self, schema: ModelSchema) -> ModelSchema:
+        """Fetch one model's files into the local cache; verifies sha256
+        when the index provides digests. Files download into a staging dir
+        that only becomes the model dir once EVERY file verified — a failed
+        download never leaves a partial checkpoint that local_models()
+        would list. Returns the LOCAL schema."""
+        if self.server_url is None:
+            raise ValueError("download_model() needs server_url")
+        dest = self._safe_path(schema.name)
+        stage = self._safe_path(schema.name + ".staging")
+        os.makedirs(stage, exist_ok=True)
+        try:
+            for fname in schema.files:
+                path = self._safe_path(schema.name + ".staging", fname)
+                got = self._fetch_to_file(
+                    f"{self.server_url}/{schema.name}/{fname}", path)
+                want = schema.sha256.get(fname)
+                if want and got != want:
+                    raise RuntimeError(
+                        f"sha256 mismatch for {schema.name}/{fname}: "
+                        f"expected {want}, got {got}")
+        except Exception:
+            import shutil
+
+            shutil.rmtree(stage, ignore_errors=True)
+            raise
+        if os.path.isdir(dest):
+            import shutil
+
+            shutil.rmtree(dest)
+        os.replace(stage, dest)
+        return dataclasses.replace(schema, uri=dest)
+
+    def download_by_name(self, name: str) -> ModelSchema:
+        for schema in self.remote_models():
+            if schema.name == name:
+                return self.download_model(schema)
+        raise KeyError(f"model {name!r} not in the remote index")
+
+    def download_models(self, models: list[ModelSchema] | None = None
+                        ) -> list[ModelSchema]:
+        return [self.download_model(s)
+                for s in (models if models is not None
+                          else self.remote_models())]

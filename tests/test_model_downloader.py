@@ -1,0 +1,150 @@
+"""ModelDownloader (reference ``synapse/ml/downloader/ModelDownloader.py``):
+local checkpoint enumeration, remote index + fetch with sha256
+verification against an in-process mock repository, and the downloaded
+model feeding straight into checkpoint ingestion."""
+
+import hashlib
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.models import ModelDownloader, ModelSchema
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def model_repo(tmp_path_factory):
+    """A mock model server with one tiny GPT-2 checkpoint in its index."""
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    src = tmp_path_factory.mktemp("repo") / "gpt2-nano"
+    torch.manual_seed(0)
+    cfg = GPT2Config(vocab_size=61, n_embd=32, n_layer=1, n_head=4,
+                     n_positions=48)
+    m = GPT2LMHeadModel(cfg).eval()
+    m.save_pretrained(src, safe_serialization=True)
+    cfg.save_pretrained(src)
+    files = sorted(p.name for p in src.iterdir() if p.is_file())
+    digests = {f: hashlib.sha256((src / f).read_bytes()).hexdigest()
+               for f in files}
+    index = [ModelSchema(name="gpt2-nano", kind="causal-lm", files=files,
+                         sha256=digests,
+                         size_bytes=sum((src / f).stat().st_size
+                                        for f in files)).to_dict()]
+
+    class Repo(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path == "/index.json":
+                body = json.dumps(index).encode()
+            else:
+                name = self.path.strip("/").split("/", 1)[-1]
+                target = src / name
+                if not target.is_file():
+                    self.send_error(404)
+                    return
+                body = target.read_bytes()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Repo)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", src
+    srv.shutdown()
+
+
+def test_remote_index_and_download(model_repo, tmp_path):
+    url, _src = model_repo
+    dl = ModelDownloader(str(tmp_path / "cache"), server_url=url)
+    remote = dl.remote_models()
+    assert [s.name for s in remote] == ["gpt2-nano"]
+    local = dl.download_by_name("gpt2-nano")
+    assert local.uri.endswith("gpt2-nano")
+
+    # the downloaded checkpoint ingests through the normal pretrained path
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.convert_hf import pretrained_causal_lm
+    from synapseml_tpu.models.flax_nets.llama import LlamaLM
+
+    cfg, params = pretrained_causal_lm(local.uri, dtype=jnp.float32)
+    logits = LlamaLM(cfg).apply(
+        {"params": params}, jnp.zeros((1, 4), jnp.int32))
+    assert logits.shape == (1, 4, 61)
+
+    # and local_models() now lists it
+    names = [s.name for s in dl.local_models()]
+    assert "gpt2-nano" in names
+
+
+def test_sha256_mismatch_rejected(model_repo, tmp_path):
+    url, _ = model_repo
+    dl = ModelDownloader(str(tmp_path / "cache2"), server_url=url)
+    (schema,) = dl.remote_models()
+    bad = dict(schema.sha256)
+    bad[schema.files[0]] = "0" * 64
+    import dataclasses
+
+    with pytest.raises(RuntimeError, match="sha256 mismatch"):
+        dl.download_model(dataclasses.replace(schema, sha256=bad))
+
+
+def test_path_traversal_from_index_rejected(model_repo, tmp_path):
+    # the remote index is UNTRUSTED: names/files must not escape the cache
+    url, _ = model_repo
+    dl = ModelDownloader(str(tmp_path / "cache3"), server_url=url)
+    evil_name = ModelSchema(name="../evil", files=("config.json",))
+    with pytest.raises(ValueError, match="escapes|relative"):
+        dl.download_model(evil_name)
+    evil_file = ModelSchema(name="ok", files=("../../evil.txt",))
+    with pytest.raises(ValueError, match="escapes|relative"):
+        dl.download_model(evil_file)
+    assert not (tmp_path / "evil.txt").exists()
+
+
+def test_sha256_failure_leaves_no_partial_model(model_repo, tmp_path):
+    url, _ = model_repo
+    cache = tmp_path / "cache4"
+    dl = ModelDownloader(str(cache), server_url=url)
+    (schema,) = dl.remote_models()
+    bad = dict(schema.sha256)
+    bad[schema.files[-1]] = "0" * 64  # last file fails AFTER earlier ones
+    import dataclasses
+
+    with pytest.raises(RuntimeError, match="sha256 mismatch"):
+        dl.download_model(dataclasses.replace(schema, sha256=bad))
+    # nothing staged, nothing half-installed, nothing listed
+    assert not (cache / schema.name).exists()
+    assert not (cache / (schema.name + ".staging")).exists()
+    assert list(dl.local_models()) == []
+
+
+def test_http_404_is_not_reported_as_unreachable(model_repo, tmp_path):
+    url, _ = model_repo
+    dl = ModelDownloader(str(tmp_path / "cache5"), server_url=url)
+    schema = ModelSchema(name="gpt2-nano", files=("no_such_file.bin",))
+    with pytest.raises(RuntimeError, match="returned 404"):
+        dl.download_model(schema)
+
+
+def test_zero_egress_error_is_actionable(tmp_path):
+    dl = ModelDownloader(str(tmp_path), server_url="http://127.0.0.1:9",
+                         timeout_s=0.5)
+    with pytest.raises(RuntimeError, match="local_models"):
+        dl.remote_models()
+
+
+def test_local_models_empty_cache(tmp_path):
+    dl = ModelDownloader(str(tmp_path / "fresh"))
+    assert list(dl.local_models()) == []
+    with pytest.raises(ValueError, match="server_url"):
+        dl.remote_models()
