@@ -1,0 +1,105 @@
+"""Flagship A/B: HEAD vs the round-2 commit, back to back on one window.
+
+VERDICT r4 next-#2: the 1664 -> 1271 samples/s/chip flagship drop was filed
+as relay contention on circumstantial evidence. This script settles it the
+only honest way — both revisions measured on the SAME healthy window with
+the same protocol:
+
+1. run the flagship bench child at HEAD (in-process);
+2. materialize the round-2 measurement commit (48e5726) in a git worktree
+   and run ITS bench.py flagship child as a subprocess;
+3. print one JSON line with both numbers and the verdict field.
+
+Run it manually on a window, or let relay_watch.py reach it in the queue
+(it is last — the never-measured configs keep priority). Exits cleanly
+when the relay is down (platform 'none' result).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+ROUND2_COMMIT = "48e5726"
+REPO = str(Path(__file__).parent.parent)
+
+
+def _head_flagship(budget_s: float = 420.0):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    result, err, _elapsed, _hang, _up = bench._run_child(
+        "tpu", "flagship", 75, budget_s)
+    return result, err
+
+
+def _round2_flagship(budget_s: float = 420.0):
+    """Round-2 bench.py in a worktree subprocess (its own flagship child)."""
+    wt = tempfile.mkdtemp(prefix="r2ab_")
+    try:
+        subprocess.run(["git", "-C", REPO, "worktree", "add", "--detach",
+                        wt, ROUND2_COMMIT], check=True, capture_output=True)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(wt, "bench.py")],
+            env={"PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+                 "HOME": os.environ.get("HOME", "/root"),
+                 "PYTHONPATH": wt,
+                 "PALLAS_AXON_POOL_IPS":
+                     os.environ.get("PALLAS_AXON_POOL_IPS", ""),
+                 # passthrough lets a smoke test force the CPU path
+                 **({"JAX_PLATFORMS": os.environ["JAX_PLATFORMS"]}
+                    if os.environ.get("JAX_PLATFORMS") else {}),
+                 "BENCH_CONFIGS": "flagship"},
+            capture_output=True, text=True, timeout=budget_s + 240, cwd=wt)
+        for line in reversed(proc.stdout.splitlines()):
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in d:
+                return d, None
+        return None, f"no JSON line; stderr tail: {proc.stderr[-400:]}"
+    except subprocess.TimeoutExpired:
+        return None, "round-2 bench timed out"
+    finally:
+        subprocess.run(["git", "-C", REPO, "worktree", "remove", "--force",
+                        wt], capture_output=True)
+        subprocess.run(["git", "-C", REPO, "worktree", "prune"],
+                       capture_output=True)
+
+
+def main() -> None:
+    head, err_h = _head_flagship()
+    if not head or head.get("platform") != "tpu":
+        print(json.dumps({"metric": "flagship A/B (skipped)", "value": 0.0,
+                          "unit": "n/a", "platform": "none",
+                          "reason": err_h or "no TPU window"}))
+        return
+    r2, err_2 = _round2_flagship()
+    out = {"metric": "flagship A/B HEAD vs round-2",
+           "unit": "samples/sec/chip", "platform": "tpu",
+           "head": head, "round2_commit": ROUND2_COMMIT, "round2": r2,
+           "value": head.get("value", 0.0)}
+    if r2 and r2.get("platform") == "tpu" and r2.get("value"):
+        ratio = head["value"] / r2["value"]
+        out["head_over_round2"] = round(ratio, 4)
+        out["verdict"] = ("HEAD >= round-2: contention confirmed"
+                          if ratio >= 0.95 else
+                          "HEAD slower on the same window: REAL regression "
+                          "— bisect the einsum-path changes since round 2")
+    else:
+        out["round2_error"] = err_2
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

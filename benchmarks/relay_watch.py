@@ -40,6 +40,7 @@ QUEUE = [
     ("gbdt-hist-backends", 900),
     ("attn-backends", 900),   # einsum-vs-flash decision after the bf16 kernel fix
     ("vit", 900),
+    ("flagship-ab", 1500),    # HEAD vs round-2 A/B — settles 1664-vs-1271 last
 ]
 MAX_ATTEMPTS = 4         # per config, counting only backend-up failures
 HANG_BACKOFF_S = 480
@@ -53,13 +54,46 @@ def _note(msg: str) -> None:
 RESULTS_JSONL = "/tmp/relay_watch_results.jsonl"
 
 
+def _run_flagship_ab(budget: float):
+    """Adapter giving flagship_ab.py the (result, err, elapsed, hang,
+    backend_up) shape the queue loop expects."""
+    import subprocess
+    import sys as _sys
+
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [_sys.executable, os.path.join(REPO, "benchmarks",
+                                           "flagship_ab.py")],
+            capture_output=True, text=True, timeout=budget)
+    except subprocess.TimeoutExpired:
+        return None, "flagship A/B timed out", time.time() - t0, True, False
+    elapsed = time.time() - t0
+    for line in reversed(proc.stdout.splitlines()):
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in d:
+            if d.get("platform") == "tpu":
+                return d, None, elapsed, False, True
+            return None, d.get("reason", "no window"), elapsed, True, False
+    return (None, f"no JSON line: {proc.stderr[-200:]}", elapsed, False,
+            True)
+
+
 def main() -> None:
     queue = list(QUEUE)
     attempts: dict = {}
     while queue:
         name, budget = queue[0]
-        result, err, elapsed, hang, backend_up = bench._run_child(
-            "tpu", name, 75, budget)
+        if name == "flagship-ab":
+            # the HEAD-vs-round-2 A/B (VERDICT r4 next-#2): runs last, only
+            # once the regular configs have had their windows
+            result, err, elapsed, hang, backend_up = _run_flagship_ab(budget)
+        else:
+            result, err, elapsed, hang, backend_up = bench._run_child(
+                "tpu", name, 75, budget)
         if result is not None and result.get("platform") == "tpu":
             with open(RESULTS_JSONL, "a") as f:   # belt-and-braces record
                 f.write(json.dumps({"config": name, **result}) + "\n")
