@@ -37,9 +37,9 @@ def _head_flagship(budget_s: float = 420.0):
         "bench", os.path.join(REPO, "bench.py"))
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
-    result, err, _elapsed, _hang, _up = bench._run_child(
+    result, err, _elapsed, hang, backend_up = bench._run_child(
         "tpu", "flagship", 75, budget_s)
-    return result, err
+    return result, err, hang, backend_up
 
 
 def _round2_flagship(budget_s: float = 420.0):
@@ -50,14 +50,10 @@ def _round2_flagship(budget_s: float = 420.0):
                         wt, ROUND2_COMMIT], check=True, capture_output=True)
         proc = subprocess.run(
             [sys.executable, os.path.join(wt, "bench.py")],
-            env={"PATH": os.environ.get("PATH", "/usr/bin:/bin"),
-                 "HOME": os.environ.get("HOME", "/root"),
-                 "PYTHONPATH": wt,
-                 "PALLAS_AXON_POOL_IPS":
-                     os.environ.get("PALLAS_AXON_POOL_IPS", ""),
-                 # passthrough lets a smoke test force the CPU path
-                 **({"JAX_PLATFORMS": os.environ["JAX_PLATFORMS"]}
-                    if os.environ.get("JAX_PLATFORMS") else {}),
+            # FULL environment with targeted overrides: the HEAD leg (via
+            # bench._run_child) inherits everything, so the round-2 leg
+            # must too or the comparison is structurally asymmetric
+            env={**os.environ, "PYTHONPATH": wt,
                  "BENCH_CONFIGS": "flagship"},
             capture_output=True, text=True, timeout=budget_s + 240, cwd=wt)
         for line in reversed(proc.stdout.splitlines()):
@@ -78,10 +74,11 @@ def _round2_flagship(budget_s: float = 420.0):
 
 
 def main() -> None:
-    head, err_h = _head_flagship()
+    head, err_h, hang, backend_up = _head_flagship()
     if not head or head.get("platform") != "tpu":
         print(json.dumps({"metric": "flagship A/B (skipped)", "value": 0.0,
                           "unit": "n/a", "platform": "none",
+                          "hang": bool(hang), "backend_up": bool(backend_up),
                           "reason": err_h or "no TPU window"}))
         return
     r2, err_2 = _round2_flagship()
@@ -97,7 +94,9 @@ def main() -> None:
                           "HEAD slower on the same window: REAL regression "
                           "— bisect the einsum-path changes since round 2")
     else:
-        out["round2_error"] = err_2
+        out["round2_error"] = (err_2 or (
+            f"round-2 leg ran on {r2.get('platform')!r}, not tpu — window "
+            "degraded between the legs" if r2 else "no round-2 result"))
     print(json.dumps(out))
 
 
