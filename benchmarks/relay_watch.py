@@ -79,9 +79,18 @@ def _run_flagship_ab(budget: float):
         if d.get("platform") == "tpu" and "verdict" in d:
             return d, None, elapsed, False, True
         if d.get("platform") == "tpu":
-            # head leg landed but round-2 didn't: the A/B question is NOT
-            # settled — count as a backend-up failure so it retries with a
-            # bounded attempt count, never as a capture
+            # head leg landed but round-2 didn't: BANK the scarce head
+            # measurement (results log + baseline seed) before retrying —
+            # the A/B question stays unsettled, so this still counts as a
+            # backend-up failure with a bounded attempt count
+            head = d.get("head")
+            if isinstance(head, dict) and head.get("platform") == "tpu":
+                with open(RESULTS_JSONL, "a") as f:
+                    f.write(json.dumps({"config": "flagship-ab-head-only",
+                                        **head}) + "\n")
+                if not bench._seed_baseline(head, bench._load_recorded()):
+                    _note("A/B head-only capture: baseline seed FAILED — "
+                          f"result only in {RESULTS_JSONL}")
             return (None, d.get("round2_error", "round-2 leg failed"),
                     elapsed, False, True)
         # skipped line: hang/backend_up say whether this was relay trouble
@@ -111,7 +120,10 @@ def main() -> None:
                 # diagnostic composite, NOT a baseline: the head leg's
                 # flagship number seeds under its own metric; the A/B
                 # verdict lives in RESULTS_JSONL and the log
-                bench._seed_baseline(result["head"], bench._load_recorded())
+                if not bench._seed_baseline(result["head"],
+                                            bench._load_recorded()):
+                    _note("A/B head seed FAILED — head number only in "
+                          f"{RESULTS_JSONL}")
                 _note(f"A/B VERDICT in {elapsed:.0f}s: {json.dumps(result)}")
                 queue.pop(0)
                 continue

@@ -344,7 +344,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     if tweedie_variance_power is not None:
         obj_kw["tweedie_variance_power"] = tweedie_variance_power
     o = obj.get_objective(objective, num_class=num_class, **obj_kw)
-    if o.name in ("poisson", "tweedie") and np.any(y[:n] < 0):
+    if o.name in ("poisson", "tweedie", "gamma") and np.any(y[:n] < 0):
         # stock LightGBM fails fast too: negative labels flip the hessian
         # sign under the log link and silently destabilize leaf weights
         raise ValueError(f"{o.name} objective requires non-negative labels")

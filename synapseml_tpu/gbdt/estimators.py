@@ -317,7 +317,7 @@ class LightGBMRegressor(Estimator, _LightGBMParams):
     feature_name = "lightgbm"
 
     objective = Param("objective", "regression | regression_l1 | huber | "
-                      "poisson | quantile | tweedie",
+                      "poisson | quantile | tweedie | gamma | mape",
                       default="regression")
     alpha = Param("alpha", "huber delta / quantile level", default=0.9,
                   converter=TypeConverters.to_float)

@@ -398,7 +398,7 @@ def parse_lightgbm_string(text: str) -> ImportedBooster:
     elif first == "lambdarank":
         K, base = 1, "lambdarank"
     elif first in ("regression_l1", "huber", "poisson", "quantile",
-                   "tweedie"):
+                   "tweedie", "gamma", "mape"):
         K, base = 1, first  # link-carrying regression objectives
     else:
         K, base = 1, "regression"

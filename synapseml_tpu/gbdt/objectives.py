@@ -64,6 +64,21 @@ def _quantile_grad_hess(s, y, alpha=0.5):
     return jnp.where(r >= 0, 1.0 - alpha, -alpha), jnp.ones_like(y)
 
 
+def _gamma_grad_hess(s, y):
+    # gamma deviance with log link (LightGBM RegressionGammaLoss):
+    # grad = 1 - y e^{-s}, hess = y e^{-s}
+    e = y * jnp.exp(-s[:, 0])
+    return 1.0 - e, e
+
+
+def _mape_grad_hess(s, y):
+    # mean absolute percentage error: |r|/max(|y|,1) with L1-style grad;
+    # the per-row 1/|y| factor rides the HESSIAN-side weight like LightGBM
+    w = 1.0 / jnp.maximum(jnp.abs(y), 1.0)
+    r = s[:, 0] - y
+    return jnp.sign(r) * w, w
+
+
 def _tweedie_grad_hess(s, y, rho=1.5):
     # LightGBM tweedie (1 <= rho < 2, log link): deviance
     # -y e^{(1-rho)s}/(1-rho) + e^{(2-rho)s}/(2-rho); d/ds and d2/ds2
@@ -224,6 +239,26 @@ def get_objective(name: str, num_class: int = 1, **kw) -> Objective:
                          lambda y: jnp.quantile(y, alpha)[None],
                          lambda s, y: _quantile_grad_hess(s, y, alpha),
                          lambda s: s[:, 0], _mae, "mae")
+    if name == "gamma":
+        return Objective("gamma", 1, _log_mean_init, _gamma_grad_hess,
+                         lambda s: jnp.exp(s[:, 0]), _rmse_exp_link, "rmse")
+    if name == "mape":
+        def _mape_init(y):
+            # MAPE's optimum is the 1/max(|y|,1)-WEIGHTED median — starting
+            # from the plain median leaves slow constant-hessian updates a
+            # long way to travel on skewed targets (LightGBM inits from the
+            # weighted percentile too)
+            w = 1.0 / jnp.maximum(jnp.abs(y), 1.0)
+            order = jnp.argsort(y)
+            cw = jnp.cumsum(w[order])
+            idx = jnp.searchsorted(cw, cw[-1] / 2.0)
+            return y[order][jnp.minimum(idx, y.shape[0] - 1)][None]
+
+        return Objective("mape", 1, _mape_init, _mape_grad_hess,
+                         lambda s: s[:, 0],
+                         lambda s, y: jnp.mean(jnp.abs(s[:, 0] - y)
+                                               / jnp.maximum(jnp.abs(y), 1.0)),
+                         "mape")
     if name == "tweedie":
         rho = float(kw.get("tweedie_variance_power", 1.5))
         if not 1.0 <= rho < 2.0:  # LightGBM's bound; rho=1 = poisson limit

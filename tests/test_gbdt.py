@@ -700,6 +700,73 @@ def test_tweedie_objective():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_gamma_and_mape_objectives():
+    """Gamma (log link) grad/hess vs autodiff of the deviance; MAPE
+    recovers group MEDIANS (L1-style) with per-row 1/|y| weighting."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.gbdt.objectives import get_objective
+
+    rs = np.random.default_rng(31)
+    s = jnp.asarray(rs.normal(size=(40, 1)), jnp.float32)
+    y = jnp.asarray(rs.gamma(2.0, 1.5, 40), jnp.float32)
+
+    o = get_objective("gamma")
+
+    def gamma_dev(si, yi):
+        # gamma deviance (log link), up to y-only terms: si + yi e^{-si}
+        return si + yi * jnp.exp(-si)
+
+    grad, hess = o.grad_hess(s, y)
+    want_g = jax.vmap(jax.grad(gamma_dev))(s[:, 0], y)
+    want_h = jax.vmap(jax.grad(jax.grad(gamma_dev)))(s[:, 0], y)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(want_g),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hess), np.asarray(want_h),
+                               rtol=1e-4, atol=1e-5)
+
+    # estimator surfaces: gamma predictions positive and near group means
+    import synapseml_tpu as st
+    from synapseml_tpu.gbdt import LightGBMRegressor
+
+    X = np.zeros((400, 1), np.float32)
+    X[200:] = 1.0
+    yv = np.where(X[:, 0] > 0.5, rs.gamma(3.0, 2.0, 400),
+                  rs.gamma(3.0, 0.5, 400)).astype(np.float32)
+    df = st.DataFrame.from_dict({"features": X, "label": yv})
+    g = LightGBMRegressor(objective="gamma", num_iterations=40,
+                          learning_rate=0.2, num_leaves=3).fit(df)
+    pred = np.asarray(g.transform(df).collect_column("prediction"))
+    assert np.all(pred > 0)
+    assert abs(pred[200:].mean() - yv[200:].mean()) < 0.3 * yv[200:].mean()
+
+    m = LightGBMRegressor(objective="mape", num_iterations=150,
+                          learning_rate=0.3, num_leaves=3).fit(df)
+    mp = np.asarray(m.transform(df).collect_column("prediction"))
+
+    def weighted_median(v):
+        # MAPE's optimum: the 1/|y|-weighted median (small targets weigh more)
+        w = 1.0 / np.maximum(np.abs(v), 1.0)
+        order = np.argsort(v)
+        cw = np.cumsum(w[order])
+        return v[order][np.searchsorted(cw, cw[-1] / 2)]
+
+    hi_target = weighted_median(yv[200:])
+    lo_target = weighted_median(yv[:200])
+    assert abs(mp[200:].mean() - hi_target) < 0.35 * hi_target, \
+        (mp[200:].mean(), hi_target)
+    assert mp[200:].mean() > mp[:200].mean() > 0  # group ordering preserved
+    assert abs(mp[:200].mean() - lo_target) < 0.5 * max(lo_target, 1.0)
+
+    # negative labels fail fast for gamma too
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    bad = yv.copy(); bad[0] = -2.0
+    with pytest.raises(ValueError, match="non-negative"):
+        train_booster(X, bad, objective="gamma", num_iterations=2)
+
+
 def test_imported_booster_save_native_round_trip(tmp_path):
     """Migrate-in models persist: ImportedBooster-backed transformers
     save_native_model and reload with identical scores."""
