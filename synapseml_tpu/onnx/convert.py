@@ -7,7 +7,7 @@ compiles (and fuses) for the device. Weights become closure constants so XLA
 can constant-fold/bake them into the executable, mirroring a session's
 "model resident in device memory".
 
-The 157-op registry is proven through REAL torch.onnx exports, one per model
+The 158-op registry is proven through REAL torch.onnx exports, one per model
 family: convnets (ResNet-50, ``tests/test_onnx_resnet.py``), transformer
 encoders with einsum attention and dynamic shapes (``tests/test_onnx_bert.py``),
 causal decoders with Trilu masks, GatherElements and shape-guard If nodes
@@ -1764,6 +1764,46 @@ def _softmax_ce_loss(ins, attrs):
                      attrs.get("reduction", "mean"),
                      attrs.get("ignore_index"))
     return (loss, log_prob)  # second output is optional (log_prob)
+
+
+@op("DFT")
+def _dft(ins, attrs):
+    """Discrete Fourier transform (opset 17 form: axis/inverse/onesided as
+    attributes, optional dft_length input). Input trailing dim 1 = real,
+    2 = complex; output is [..., 2] re/im along the transformed axis."""
+    x = jnp.asarray(ins[0])
+    axis = int(attrs.get("axis", 1))
+    inverse = bool(attrs.get("inverse", 0))
+    onesided = bool(attrs.get("onesided", 0))
+    if inverse and onesided:
+        raise NotImplementedError("DFT: inverse and onesided are exclusive")
+    if x.shape[-1] == 2:
+        sig = x[..., 0] + 1j * x[..., 1]
+    elif x.shape[-1] == 1:
+        sig = x[..., 0]
+    else:
+        raise NotImplementedError(
+            f"DFT input trailing dim must be 1 (real) or 2 (complex), "
+            f"got {x.shape[-1]}")
+    axis = axis % sig.ndim
+    if len(ins) > 1 and ins[1] is not None:
+        n = int(np.asarray(ins[1]))
+        cur = sig.shape[axis]
+        if n < cur:
+            sig = jax.lax.slice_in_dim(sig, 0, n, axis=axis)
+        elif n > cur:
+            pads = [(0, 0, 0)] * sig.ndim
+            pads[axis] = (0, n - cur, 0)
+            sig = jax.lax.pad(sig, jnp.zeros((), sig.dtype), pads)
+    if inverse:
+        spec = jnp.fft.ifft(sig, axis=axis)
+    elif onesided and not jnp.iscomplexobj(sig):
+        spec = jnp.fft.rfft(sig, axis=axis)
+    else:
+        spec = jnp.fft.fft(sig, axis=axis)
+    real_dtype = jnp.real(jnp.zeros((), sig.dtype)).dtype
+    return jnp.stack([jnp.real(spec), jnp.imag(spec)],
+                     axis=-1).astype(real_dtype)
 
 
 @op("STFT")

@@ -256,6 +256,31 @@ def test_bitwise_family():
     np.testing.assert_array_equal(run_op("BitwiseNot", [a]), ~a)
 
 
+def test_dft_matches_numpy():
+    rs = np.random.default_rng(12)
+    x = rs.normal(size=(2, 16, 1)).astype(np.float32)
+    # forward full FFT along axis 1
+    got = np.asarray(run_op("DFT", [x], axis=1))
+    want = np.fft.fft(x[..., 0], axis=1)
+    np.testing.assert_allclose(got[..., 0] + 1j * got[..., 1], want,
+                               rtol=1e-4, atol=1e-4)
+    # onesided on real input
+    got1 = np.asarray(run_op("DFT", [x], axis=1, onesided=1))
+    want1 = np.fft.rfft(x[..., 0], axis=1)
+    np.testing.assert_allclose(got1[..., 0] + 1j * got1[..., 1], want1,
+                               rtol=1e-4, atol=1e-4)
+    # inverse on complex input round-trips
+    xc = np.stack([want.real, want.imag], axis=-1).astype(np.float32)
+    back = np.asarray(run_op("DFT", [xc], axis=1, inverse=1))
+    np.testing.assert_allclose(back[..., 0], x[..., 0], rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(back[..., 1], 0.0, atol=1e-4)
+    # dft_length pads the axis
+    got_pad = np.asarray(run_op("DFT", [x, np.asarray(32, np.int64)], axis=1))
+    want_pad = np.fft.fft(np.pad(x[..., 0], ((0, 0), (0, 16))), axis=1)
+    np.testing.assert_allclose(got_pad[..., 0] + 1j * got_pad[..., 1],
+                               want_pad, rtol=1e-4, atol=1e-4)
+
+
 def test_stft_matches_torch():
     torch.manual_seed(5)
     B, L, n_fft, hop = 2, 64, 16, 4
