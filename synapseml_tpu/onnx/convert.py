@@ -1777,15 +1777,24 @@ def _dft(ins, attrs):
     onesided = bool(attrs.get("onesided", 0))
     if inverse and onesided:
         raise NotImplementedError("DFT: inverse and onesided are exclusive")
+    # axis counts against the FULL rank (component dim included, spec
+    # DFT-17); the trailing re/im dim itself is not a valid transform axis
+    axis = axis % x.ndim
+    if axis == x.ndim - 1:
+        raise NotImplementedError(
+            "DFT axis must not be the trailing re/im component dimension")
     if x.shape[-1] == 2:
         sig = x[..., 0] + 1j * x[..., 1]
+        if onesided:
+            raise NotImplementedError(
+                "DFT: onesided=1 requires a real input (ORT rejects the "
+                "complex combination too)")
     elif x.shape[-1] == 1:
         sig = x[..., 0]
     else:
         raise NotImplementedError(
             f"DFT input trailing dim must be 1 (real) or 2 (complex), "
             f"got {x.shape[-1]}")
-    axis = axis % sig.ndim
     if len(ins) > 1 and ins[1] is not None:
         n = int(np.asarray(ins[1]))
         cur = sig.shape[axis]
@@ -1797,7 +1806,7 @@ def _dft(ins, attrs):
             sig = jax.lax.pad(sig, jnp.zeros((), sig.dtype), pads)
     if inverse:
         spec = jnp.fft.ifft(sig, axis=axis)
-    elif onesided and not jnp.iscomplexobj(sig):
+    elif onesided:
         spec = jnp.fft.rfft(sig, axis=axis)
     else:
         spec = jnp.fft.fft(sig, axis=axis)
@@ -1833,8 +1842,11 @@ def _stft(ins, attrs):
     frames = signal[:, idx]                         # [B, frames, frame_len]
     if window is not None:
         frames = frames * window.astype(frames.dtype)
-    complex_in = jnp.iscomplexobj(signal)
-    spec = (jnp.fft.rfft(frames, axis=-1) if onesided and not complex_in
+    if onesided and jnp.iscomplexobj(signal):
+        raise NotImplementedError(
+            "STFT: onesided=1 requires a real input (ORT rejects the "
+            "complex combination too)")
+    spec = (jnp.fft.rfft(frames, axis=-1) if onesided
             else jnp.fft.fft(frames, axis=-1))
     out = jnp.stack([jnp.real(spec), jnp.imag(spec)], axis=-1)
     real_dtype = jnp.real(jnp.zeros((), signal.dtype)).dtype

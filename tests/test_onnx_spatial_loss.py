@@ -279,6 +279,17 @@ def test_dft_matches_numpy():
     want_pad = np.fft.fft(np.pad(x[..., 0], ((0, 0), (0, 16))), axis=1)
     np.testing.assert_allclose(got_pad[..., 0] + 1j * got_pad[..., 1],
                                want_pad, rtol=1e-4, atol=1e-4)
+    # negative axis counts against the FULL rank (component dim included):
+    # axis=-2 on [B, T, 1] is the T axis
+    got_neg = np.asarray(run_op("DFT", [x], axis=-2))
+    np.testing.assert_allclose(got_neg, got, rtol=1e-6)
+    # the component dim itself is not a transform axis; complex+onesided
+    # is rejected like ORT
+    with pytest.raises(NotImplementedError, match="component"):
+        run_op("DFT", [x], axis=2)
+    xc2 = np.stack([x[..., 0], x[..., 0]], axis=-1)
+    with pytest.raises(NotImplementedError, match="onesided"):
+        run_op("DFT", [xc2], axis=1, onesided=1)
 
 
 def test_stft_matches_torch():
@@ -304,8 +315,9 @@ def test_stft_matches_torch():
 
 
 def test_stft_complex_input():
-    # complex [B, L, 2] layout: full FFT of the complex signal (onesided is
-    # a real-input-only concept), never the FFT of just the real part
+    # complex [B, L, 2] layout with onesided=0: full FFT of the COMPLEX
+    # signal, never the FFT of just the real part; onesided=1 on complex
+    # input is rejected like ORT does
     torch.manual_seed(7)
     B, L, n_fft, hop = 1, 32, 8, 4
     sig_c = torch.randn(B, L, dtype=torch.complex64)
@@ -315,10 +327,13 @@ def test_stft_complex_input():
                       return_complex=True)
     sig_ri = np.stack([sig_c.real.numpy(), sig_c.imag.numpy()], axis=-1)
     got = np.asarray(run_op("STFT", [sig_ri, np.asarray(hop, np.int64),
-                                     win.numpy()], onesided=1))
+                                     win.numpy()], onesided=0))
     got_c = got[..., 0] + 1j * got[..., 1]
     np.testing.assert_allclose(got_c.transpose(0, 2, 1), want.numpy(),
                                rtol=1e-4, atol=1e-4)
+    with pytest.raises(NotImplementedError, match="onesided"):
+        run_op("STFT", [sig_ri, np.asarray(hop, np.int64), win.numpy()],
+               onesided=1)
 
 
 def test_col2im_inverts_unfold():

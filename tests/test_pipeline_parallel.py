@@ -341,6 +341,67 @@ def test_pipeline_interleaved_deeper_chunks():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_pipeline_interleaved_remat_gradients_match():
+    n_stages, n_micro, mb, d = 8, 4, 2, 8
+    stages = make_stages(n_stages, d, seed=38)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(39).normal(size=(n_micro, mb, d)),
+                    jnp.float32)
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+
+    def loss(params, remat):
+        return jnp.sum(pipeline_sharded(mesh, mlp_stage, params, x,
+                                        interleave=2, remat=remat) ** 2)
+
+    g_plain = jax.grad(lambda p: loss(p, False))(stacked)
+    g_remat = jax.grad(lambda p: loss(p, True))(stacked)
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_interleaved_real_transformer_blocks():
+    """Eight REAL transformer Blocks on pipe=4 with v=2 round-robin chunks:
+    the circular schedule must match the sequential Encoder chain with the
+    attention mask riding the payload."""
+    from flax.core import meta
+
+    from synapseml_tpu.models.flax_nets.transformer import (Block,
+                                                            TransformerConfig)
+
+    cfg = TransformerConfig(hidden=16, n_layers=8, n_heads=2, mlp_dim=32,
+                            max_len=16, dtype=jnp.float32)
+    block = Block(cfg)
+    rs = np.random.default_rng(40)
+    n_micro, mb, T = 4, 2, 8
+    x = jnp.asarray(rs.normal(size=(n_micro, mb, T, cfg.hidden)), jnp.float32)
+    mask_rows = rs.random((n_micro, mb, T)) > 0.2
+    mask = jnp.asarray(mask_rows[:, :, None, None, :])
+
+    layer_params = []
+    for i in range(8):
+        v = block.init(jax.random.PRNGKey(i), x[0], mask[0])
+        layer_params.append(meta.unbox(v)["params"])
+    stacked = stack_stage_params(layer_params)
+
+    def stage(p, payload):
+        h, m = payload
+        return block.apply({"params": p}, h, m), m
+
+    def sequential_blocks(xs, ms):
+        y = xs
+        for p in layer_params:
+            y = jnp.stack([block.apply({"params": p}, y[i], ms[i])
+                           for i in range(n_micro)])
+        return y
+
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    out, _ = pipeline_sharded(mesh, stage, stacked, (x, mask), interleave=2)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(sequential_blocks(x, mask)),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_pipeline_interleaved_rejections():
     stages = make_stages(8, 4, seed=37)
     stacked = stack_stage_params(stages)
