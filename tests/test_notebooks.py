@@ -112,11 +112,13 @@ def test_emit_rejects_basename_collision(tmp_path):
 
 @pytest.mark.slow
 @pytest.mark.parametrize("notebook", ["onnx_model_inference.ipynb",
-                                      "knn_similarity_search.ipynb"])
+                                      "knn_similarity_search.ipynb",
+                                      "data_balance_analysis.ipynb",
+                                      "isolation_forest_anomaly.ipynb"])
 def test_execute_emitted_notebooks(tmp_path, notebook):
     """nbtest analog: run committed .ipynb code cells in a fresh
     interpreter (CPU), proving the emitted corpus is executable as-is —
-    one example notebook and one walkthrough notebook."""
+    example and walkthrough notebooks across four families."""
     with open(os.path.join(NB_DIR, notebook)) as f:
         code = notebook_code(json.load(f))
     script = tmp_path / "nb_exec.py"
