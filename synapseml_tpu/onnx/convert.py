@@ -7,7 +7,7 @@ compiles (and fuses) for the device. Weights become closure constants so XLA
 can constant-fold/bake them into the executable, mirroring a session's
 "model resident in device memory".
 
-The 158-op registry is proven through REAL torch.onnx exports, one per model
+The 164-op registry is proven through REAL torch.onnx exports, one per model
 family: convnets (ResNet-50, ``tests/test_onnx_resnet.py``), transformer
 encoders with einsum attention and dynamic shapes (``tests/test_onnx_bert.py``),
 causal decoders with Trilu masks, GatherElements and shape-guard If nodes
@@ -1882,6 +1882,81 @@ def _col2im(ins, attrs):
             c = cs + j * dw                         # [n_w]
             out = out.at[:, :, r[:, None], c[None, :]].add(x[:, :, i, j])
     return out[:, :, pt:pt + H, pl:pl + W]
+
+
+# ---------------- random-sampling family ----------------
+
+_UNSEEDED_NODES = __import__("itertools").count()
+
+
+def _rand_key(attrs):
+    # the spec's optional float `seed` attr pins a node's stream. Unseeded
+    # nodes fold a per-instantiation counter into a fixed base: distinct
+    # nodes decorrelate (ORT draws independently per node) while jit keeps
+    # replay deterministic — each node traces once, freezing its key.
+    seed = attrs.get("seed")
+    if seed is not None:
+        return jax.random.PRNGKey(np.float32(seed).view(np.int32))
+    return jax.random.fold_in(jax.random.PRNGKey(0), next(_UNSEEDED_NODES))
+
+
+def _rand_dtype(attrs, default=jnp.float32):
+    from .proto import _DTYPE_TO_NP
+
+    return _DTYPE_TO_NP[attrs["dtype"]] if "dtype" in attrs else default
+
+
+@op("RandomNormal")
+def _random_normal(ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    x = jax.random.normal(_rand_key(attrs), shape, _rand_dtype(attrs))
+    return x * attrs.get("scale", 1.0) + attrs.get("mean", 0.0)
+
+
+@op("RandomUniform")
+def _random_uniform(ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    return jax.random.uniform(_rand_key(attrs), shape, _rand_dtype(attrs),
+                              minval=attrs.get("low", 0.0),
+                              maxval=attrs.get("high", 1.0))
+
+
+@op("RandomNormalLike")
+def _random_normal_like(ins, attrs):
+    x = ins[0]
+    y = jax.random.normal(_rand_key(attrs), x.shape,
+                          _rand_dtype(attrs, x.dtype))
+    return y * attrs.get("scale", 1.0) + attrs.get("mean", 0.0)
+
+
+@op("RandomUniformLike")
+def _random_uniform_like(ins, attrs):
+    x = ins[0]
+    return jax.random.uniform(_rand_key(attrs), x.shape,
+                              _rand_dtype(attrs, x.dtype),
+                              minval=attrs.get("low", 0.0),
+                              maxval=attrs.get("high", 1.0))
+
+
+@op("Bernoulli")
+def _bernoulli(ins, attrs):
+    x = ins[0]
+    out = jax.random.bernoulli(_rand_key(attrs),
+                               jnp.asarray(x, jnp.float32))
+    return out.astype(_rand_dtype(attrs, x.dtype))
+
+
+@op("Multinomial")
+def _multinomial(ins, attrs):
+    """Sample class indices from unnormalized LOG probabilities (the spec's
+    input is logits-like, matching torch.multinomial on softmax)."""
+    x = ins[0]                                    # [batch, classes]
+    n = int(attrs.get("sample_size", 1))
+    out_dt = _rand_dtype(attrs, jnp.int32)
+    keys = jax.random.split(_rand_key(attrs), x.shape[0])
+    samples = jax.vmap(lambda k, logits: jax.random.categorical(
+        k, logits, shape=(n,)))(keys, jnp.asarray(x, jnp.float32))
+    return samples.astype(out_dt)
 
 
 # ---------------- dynamically-shaped ops (eager execution only) ----------------

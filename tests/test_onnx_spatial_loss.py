@@ -358,6 +358,44 @@ def test_col2im_inverts_unfold():
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+def test_random_sampling_family():
+    # deterministic under a seed; statistics match the declared law
+    a = np.asarray(run_op("RandomNormal", [], shape=[2000],
+                          mean=3.0, scale=2.0, seed=1.0))
+    b = np.asarray(run_op("RandomNormal", [], shape=[2000],
+                          mean=3.0, scale=2.0, seed=1.0))
+    np.testing.assert_array_equal(a, b)  # same seed, same draw
+    assert abs(a.mean() - 3.0) < 0.2 and abs(a.std() - 2.0) < 0.2
+
+    u = np.asarray(run_op("RandomUniform", [], shape=[2000],
+                          low=-1.0, high=5.0, seed=2.0))
+    assert u.min() >= -1.0 and u.max() <= 5.0
+    assert abs(u.mean() - 2.0) < 0.3
+
+    like = np.asarray(run_op("RandomNormalLike",
+                             [np.zeros((3, 4), np.float32)], seed=3.0))
+    assert like.shape == (3, 4) and like.dtype == np.float32
+
+    p = np.full((4000,), 0.3, np.float32)
+    bern = np.asarray(run_op("Bernoulli", [p], seed=4.0))
+    assert set(np.unique(bern)) <= {0.0, 1.0}
+    assert abs(bern.mean() - 0.3) < 0.05
+    bern_bool = np.asarray(run_op("Bernoulli", [p], seed=4.0, dtype=9))
+    assert bern_bool.dtype == np.bool_  # spec dtype=9 (bool) honored
+
+    # two UNSEEDED nodes must draw independently (ORT draws per node)
+    u1 = np.asarray(run_op("RandomNormalLike", [np.zeros((64,), np.float32)]))
+    u2 = np.asarray(run_op("RandomNormalLike", [np.zeros((64,), np.float32)]))
+    assert not np.array_equal(u1, u2)
+
+    # multinomial: heavily peaked logits pick the peak class almost always
+    logits = np.log(np.asarray([[0.01, 0.98, 0.01],
+                                [0.98, 0.01, 0.01]], np.float32))
+    m = np.asarray(run_op("Multinomial", [logits], sample_size=200, seed=5.0))
+    assert m.shape == (2, 200) and m.dtype == np.int32
+    assert (m[0] == 1).mean() > 0.9 and (m[1] == 0).mean() > 0.9
+
+
 def test_center_crop_pad():
     rs = np.random.default_rng(4)
     x = rs.normal(size=(3, 8, 5)).astype(np.float32)
