@@ -21,6 +21,7 @@ time.
 
 from __future__ import annotations
 
+import itertools
 from typing import Callable
 
 import jax
@@ -1885,8 +1886,14 @@ def _col2im(ins, attrs):
 
 
 # ---------------- random-sampling family ----------------
+#
+# LIMITATION (documented divergence from ORT): a random op inside a Loop/
+# Scan body that lowers to lax.scan/lax.while_loop traces ONCE, so its key
+# freezes and every iteration draws the same value — ORT draws fresh per
+# iteration. Keep random nodes outside compiled loop bodies (or run the
+# graph in eager mode, where each iteration re-executes the op).
 
-_UNSEEDED_NODES = __import__("itertools").count()
+_UNSEEDED_NODES = itertools.count()
 
 
 def _rand_key(attrs):
