@@ -7,7 +7,7 @@ compiles (and fuses) for the device. Weights become closure constants so XLA
 can constant-fold/bake them into the executable, mirroring a session's
 "model resident in device memory".
 
-The 164-op registry is proven through REAL torch.onnx exports, one per model
+The 165-op registry is proven through REAL torch.onnx exports, one per model
 family: convnets (ResNet-50, ``tests/test_onnx_resnet.py``), transformer
 encoders with einsum attention and dynamic shapes (``tests/test_onnx_bert.py``),
 causal decoders with Trilu masks, GatherElements and shape-guard If nodes
@@ -1584,6 +1584,34 @@ def _grid_sample(ins, attrs):
     else:
         raise NotImplementedError(f"GridSample mode {mode!r}")
     return out
+
+
+@op("AffineGrid")
+def _affine_grid(ins, attrs):
+    """Opset-20 AffineGrid (the torch ``affine_grid`` lowering): batched
+    affine maps over a normalized base grid, feeding GridSample."""
+    theta = jnp.asarray(ins[0], jnp.float32)
+    size = [int(v) for v in np.asarray(ins[1])]
+    align = bool(attrs.get("align_corners", 0))
+
+    def coords(n):
+        if align:
+            return (jnp.linspace(-1.0, 1.0, n) if n > 1
+                    else jnp.zeros((1,), jnp.float32))
+        return (2.0 * jnp.arange(n) + 1.0) / n - 1.0
+
+    if len(size) == 4:                       # 2D: [N, C, H, W] -> [N,H,W,2]
+        _, _, H, W = size
+        gx, gy = jnp.meshgrid(coords(W), coords(H))
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        return jnp.einsum("hwk,nik->nhwi", base, theta)
+    if len(size) == 5:                       # 3D: [N, C, D, H, W]
+        _, _, D, H, W = size
+        gz, gy, gx = jnp.meshgrid(coords(D), coords(H), coords(W),
+                                  indexing="ij")
+        base = jnp.stack([gx, gy, gz, jnp.ones_like(gx)], axis=-1)
+        return jnp.einsum("dhwk,nik->ndhwi", base, theta)
+    raise NotImplementedError(f"AffineGrid size rank {len(size)}")
 
 
 @op("RoiAlign")

@@ -186,6 +186,25 @@ def test_roi_align_matches_oracle(mode, half_pixel):
 # opset-18 tail vs oracles
 # ---------------------------------------------------------------------------
 
+@pytest.mark.parametrize("align", [False, True])
+def test_affine_grid_matches_torch(align):
+    torch.manual_seed(13)
+    theta = torch.randn(2, 2, 3)
+    want = F.affine_grid(theta, (2, 3, 5, 7), align_corners=align).numpy()
+    got = np.asarray(run_op("AffineGrid",
+                            [theta.numpy(), np.asarray([2, 3, 5, 7])],
+                            align_corners=int(align)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # 3D volumetric grids too
+    theta3 = torch.randn(1, 3, 4)
+    want3 = F.affine_grid(theta3, (1, 2, 3, 4, 5),
+                          align_corners=align).numpy()
+    got3 = np.asarray(run_op("AffineGrid",
+                             [theta3.numpy(), np.asarray([1, 2, 3, 4, 5])],
+                             align_corners=int(align)))
+    np.testing.assert_allclose(got3, want3, rtol=1e-5, atol=1e-6)
+
+
 def test_roi_align_max_is_weighted_corner_max():
     # constant image, sample centered in a cell (all corner weights 0.25):
     # ORT max mode yields 0.25 * value, NOT the interpolated value
