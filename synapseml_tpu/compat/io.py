@@ -37,6 +37,12 @@ class HTTPTransformer(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setTimeoutS(self, value):
         return self._set('timeout_s', value)
 
@@ -90,6 +96,12 @@ class SimpleHTTPTransformer(WrapperBase):
 
     def getOutputParser(self):
         return self._get('output_parser')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setTimeoutS(self, value):
         return self._set('timeout_s', value)

@@ -19,6 +19,12 @@ class AIFoundryChatCompletion(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -55,6 +61,12 @@ class AIFoundryChatCompletion(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -85,6 +97,12 @@ class DetectAnomalies(WrapperBase):
 
     _target = 'synapseml_tpu.services.anomaly.DetectAnomalies'
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -114,6 +132,12 @@ class DetectAnomalies(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSensitivity(self, value):
         return self._set('sensitivity', value)
@@ -151,6 +175,12 @@ class DetectLastAnomaly(WrapperBase):
 
     _target = 'synapseml_tpu.services.anomaly.DetectLastAnomaly'
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -180,6 +210,12 @@ class DetectLastAnomaly(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSensitivity(self, value):
         return self._set('sensitivity', value)
@@ -217,6 +253,12 @@ class DetectMultivariateAnomaly(WrapperBase):
 
     _target = 'synapseml_tpu.services.anomaly.DetectMultivariateAnomaly'
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -234,6 +276,12 @@ class DetectMultivariateAnomaly(WrapperBase):
 
     def getErrorCol(self):
         return self._get('error_col')
+
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
 
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
@@ -258,6 +306,12 @@ class DetectMultivariateAnomaly(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSourceCol(self, value):
         return self._set('source_col', value)
@@ -367,6 +421,12 @@ class SimpleDetectAnomalies(WrapperBase):
 
     _target = 'synapseml_tpu.services.anomaly.SimpleDetectAnomalies'
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -402,6 +462,12 @@ class SimpleDetectAnomalies(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSensitivity(self, value):
         return self._set('sensitivity', value)
@@ -445,6 +511,12 @@ class CognitiveServiceBase(WrapperBase):
 
     _target = 'synapseml_tpu.services.base.CognitiveServiceBase'
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -462,6 +534,12 @@ class CognitiveServiceBase(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -487,6 +565,12 @@ class HasAsyncReply(WrapperBase):
 
     _target = 'synapseml_tpu.services.base.HasAsyncReply'
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -498,6 +582,12 @@ class HasAsyncReply(WrapperBase):
 
     def getErrorCol(self):
         return self._get('error_col')
+
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
 
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
@@ -516,6 +606,12 @@ class HasAsyncReply(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -541,6 +637,12 @@ class DetectFace(WrapperBase):
 
     _target = 'synapseml_tpu.services.face.DetectFace'
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -564,6 +666,12 @@ class DetectFace(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setReturnFaceAttributes(self, value):
         return self._set('return_face_attributes', value)
@@ -607,6 +715,12 @@ class FindSimilarFace(WrapperBase):
 
     _target = 'synapseml_tpu.services.face.FindSimilarFace'
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -643,6 +757,12 @@ class FindSimilarFace(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -666,6 +786,12 @@ class GroupFaces(WrapperBase):
     """(ref ``GroupFaces``) (wraps ``synapseml_tpu.services.face.GroupFaces``)."""
 
     _target = 'synapseml_tpu.services.face.GroupFaces'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -691,6 +817,12 @@ class GroupFaces(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -714,6 +846,12 @@ class IdentifyFaces(WrapperBase):
     """(ref ``IdentifyFaces``) (wraps ``synapseml_tpu.services.face.IdentifyFaces``)."""
 
     _target = 'synapseml_tpu.services.face.IdentifyFaces'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -757,6 +895,12 @@ class IdentifyFaces(WrapperBase):
     def getPersonGroupId(self):
         return self._get('person_group_id')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -780,6 +924,12 @@ class VerifyFaces(WrapperBase):
     """(ref ``VerifyFaces``) — same-person check for two face ids. (wraps ``synapseml_tpu.services.face.VerifyFaces``)."""
 
     _target = 'synapseml_tpu.services.face.VerifyFaces'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -811,6 +961,12 @@ class VerifyFaces(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -840,6 +996,12 @@ class AnalyzeBusinessCards(WrapperBase):
 
     def getApiVersion(self):
         return self._get('api_version')
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -871,6 +1033,12 @@ class AnalyzeBusinessCards(WrapperBase):
     def getLocale(self):
         return self._get('locale')
 
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
+
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
 
@@ -900,6 +1068,12 @@ class AnalyzeBusinessCards(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -931,6 +1105,12 @@ class AnalyzeDocument(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -961,6 +1141,12 @@ class AnalyzeDocument(WrapperBase):
     def getLocale(self):
         return self._get('locale')
 
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
+
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
 
@@ -990,6 +1176,12 @@ class AnalyzeDocument(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1021,6 +1213,12 @@ class AnalyzeIDDocuments(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1051,6 +1249,12 @@ class AnalyzeIDDocuments(WrapperBase):
     def getLocale(self):
         return self._get('locale')
 
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
+
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
 
@@ -1080,6 +1284,12 @@ class AnalyzeIDDocuments(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1111,6 +1321,12 @@ class AnalyzeInvoices(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1141,6 +1357,12 @@ class AnalyzeInvoices(WrapperBase):
     def getLocale(self):
         return self._get('locale')
 
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
+
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
 
@@ -1170,6 +1392,12 @@ class AnalyzeInvoices(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1201,6 +1429,12 @@ class AnalyzeLayout(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1231,6 +1465,12 @@ class AnalyzeLayout(WrapperBase):
     def getLocale(self):
         return self._get('locale')
 
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
+
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
 
@@ -1260,6 +1500,12 @@ class AnalyzeLayout(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1291,6 +1537,12 @@ class AnalyzeReceipts(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1321,6 +1573,12 @@ class AnalyzeReceipts(WrapperBase):
     def getLocale(self):
         return self._get('locale')
 
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
+
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
 
@@ -1350,6 +1608,12 @@ class AnalyzeReceipts(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1435,6 +1699,12 @@ class AddressGeocoder(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1458,6 +1728,12 @@ class AddressGeocoder(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1489,6 +1765,12 @@ class CheckPointInPolygon(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1518,6 +1800,12 @@ class CheckPointInPolygon(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1555,6 +1843,12 @@ class ReverseAddressGeocoder(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1584,6 +1878,12 @@ class ReverseAddressGeocoder(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1645,6 +1945,12 @@ class OpenAIChatCompletion(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1680,6 +1986,12 @@ class OpenAIChatCompletion(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1717,6 +2029,12 @@ class OpenAICompletion(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1752,6 +2070,12 @@ class OpenAICompletion(WrapperBase):
 
     def getPromptCol(self):
         return self._get('prompt_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1789,6 +2113,12 @@ class OpenAIEmbedding(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1818,6 +2148,12 @@ class OpenAIEmbedding(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -1860,6 +2196,12 @@ class OpenAIPrompt(WrapperBase):
 
     def getApiVersion(self):
         return self._get('api_version')
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -1909,6 +2251,12 @@ class OpenAIPrompt(WrapperBase):
     def getPromptTemplate(self):
         return self._get('prompt_template')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -1951,6 +2299,12 @@ class OpenAIResponses(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -1986,6 +2340,12 @@ class OpenAIResponses(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2028,6 +2388,12 @@ class AzureSearchWriter(WrapperBase):
 
     def getApiVersion(self):
         return self._get('api_version')
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setBatchSize(self, value):
         return self._set('batch_size', value)
@@ -2077,6 +2443,12 @@ class AzureSearchWriter(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -2113,6 +2485,12 @@ class ConversationTranscriber(WrapperBase):
     def getAudioUrlCol(self):
         return self._get('audio_url_col')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2137,6 +2515,12 @@ class ConversationTranscriber(WrapperBase):
     def getLanguage(self):
         return self._get('language')
 
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
+
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
 
@@ -2160,6 +2544,12 @@ class ConversationTranscriber(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2197,6 +2587,12 @@ class SpeechToText(WrapperBase):
     def getAudioFormat(self):
         return self._get('audio_format')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2233,6 +2629,12 @@ class SpeechToText(WrapperBase):
     def getProfanity(self):
         return self._get('profanity')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -2256,6 +2658,12 @@ class TextToSpeech(WrapperBase):
     """Text -> synthesized audio bytes (SSML POST). (wraps ``synapseml_tpu.services.speech.TextToSpeech``)."""
 
     _target = 'synapseml_tpu.services.speech.TextToSpeech'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -2286,6 +2694,12 @@ class TextToSpeech(WrapperBase):
 
     def getOutputFormat(self):
         return self._get('output_format')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2329,6 +2743,12 @@ class AnalyzeText(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2358,6 +2778,12 @@ class AnalyzeText(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2395,6 +2821,12 @@ class AnalyzeTextLRO(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2419,6 +2851,12 @@ class AnalyzeTextLRO(WrapperBase):
     def getLanguage(self):
         return self._get('language')
 
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
+
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
 
@@ -2436,6 +2874,12 @@ class AnalyzeTextLRO(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2479,6 +2923,12 @@ class EntityRecognizer(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2508,6 +2958,12 @@ class EntityRecognizer(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2545,6 +3001,12 @@ class KeyPhraseExtractor(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2574,6 +3036,12 @@ class KeyPhraseExtractor(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2611,6 +3079,12 @@ class LanguageDetector(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2640,6 +3114,12 @@ class LanguageDetector(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2677,6 +3157,12 @@ class TextSentiment(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2706,6 +3192,12 @@ class TextSentiment(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2743,6 +3235,12 @@ class BreakSentence(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2766,6 +3264,12 @@ class BreakSentence(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2803,6 +3307,12 @@ class DictionaryExamples(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2826,6 +3336,12 @@ class DictionaryExamples(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2875,6 +3391,12 @@ class DictionaryLookup(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2898,6 +3420,12 @@ class DictionaryLookup(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -2941,6 +3469,12 @@ class Translate(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -2964,6 +3498,12 @@ class Translate(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -3007,6 +3547,12 @@ class Transliterate(WrapperBase):
     def getApiVersion(self):
         return self._get('api_version')
 
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
+
     def setConcurrency(self, value):
         return self._set('concurrency', value)
 
@@ -3036,6 +3582,12 @@ class Transliterate(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -3072,6 +3624,12 @@ class AnalyzeImage(WrapperBase):
     """(ref ``AnalyzeImage``) (wraps ``synapseml_tpu.services.vision.AnalyzeImage``)."""
 
     _target = 'synapseml_tpu.services.vision.AnalyzeImage'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -3115,6 +3673,12 @@ class AnalyzeImage(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -3144,6 +3708,12 @@ class DescribeImage(WrapperBase):
     """Shared image-url-or-bytes input handling (ref ``HasImageInput``). (wraps ``synapseml_tpu.services.vision.DescribeImage``)."""
 
     _target = 'synapseml_tpu.services.vision.DescribeImage'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -3181,6 +3751,12 @@ class DescribeImage(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -3204,6 +3780,12 @@ class GenerateThumbnails(WrapperBase):
     """Shared image-url-or-bytes input handling (ref ``HasImageInput``). (wraps ``synapseml_tpu.services.vision.GenerateThumbnails``)."""
 
     _target = 'synapseml_tpu.services.vision.GenerateThumbnails'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -3241,6 +3823,12 @@ class GenerateThumbnails(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSmartCropping(self, value):
         return self._set('smart_cropping', value)
 
@@ -3276,6 +3864,12 @@ class OCR(WrapperBase):
     """(ref ``OCR``) — synchronous printed-text recognition. (wraps ``synapseml_tpu.services.vision.OCR``)."""
 
     _target = 'synapseml_tpu.services.vision.OCR'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -3313,6 +3907,12 @@ class OCR(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -3336,6 +3936,12 @@ class ReadImage(WrapperBase):
     """(ref ``ReadImage``) — the async Read API: 202 + Operation-Location. (wraps ``synapseml_tpu.services.vision.ReadImage``)."""
 
     _target = 'synapseml_tpu.services.vision.ReadImage'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -3361,6 +3967,12 @@ class ReadImage(WrapperBase):
     def getImageUrlCol(self):
         return self._get('image_url_col')
 
+    def setLroDeadlineS(self, value):
+        return self._set('lro_deadline_s', value)
+
+    def getLroDeadlineS(self):
+        return self._get('lro_deadline_s')
+
     def setMaxPollAttempts(self, value):
         return self._set('max_poll_attempts', value)
 
@@ -3378,6 +3990,12 @@ class ReadImage(WrapperBase):
 
     def getPollingIntervalS(self):
         return self._get('polling_interval_s')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
@@ -3402,6 +4020,12 @@ class RecognizeDomainSpecificContent(WrapperBase):
     """Shared image-url-or-bytes input handling (ref ``HasImageInput``). (wraps ``synapseml_tpu.services.vision.RecognizeDomainSpecificContent``)."""
 
     _target = 'synapseml_tpu.services.vision.RecognizeDomainSpecificContent'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -3439,6 +4063,12 @@ class RecognizeDomainSpecificContent(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
+
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)
 
@@ -3462,6 +4092,12 @@ class TagImage(WrapperBase):
     """Shared image-url-or-bytes input handling (ref ``HasImageInput``). (wraps ``synapseml_tpu.services.vision.TagImage``)."""
 
     _target = 'synapseml_tpu.services.vision.TagImage'
+
+    def setBackoffsMs(self, value):
+        return self._set('backoffs_ms', value)
+
+    def getBackoffsMs(self):
+        return self._get('backoffs_ms')
 
     def setConcurrency(self, value):
         return self._set('concurrency', value)
@@ -3492,6 +4128,12 @@ class TagImage(WrapperBase):
 
     def getOutputCol(self):
         return self._get('output_col')
+
+    def setRetryPolicy(self, value):
+        return self._set('retry_policy', value)
+
+    def getRetryPolicy(self):
+        return self._get('retry_policy')
 
     def setSubscriptionKey(self, value):
         return self._set('subscription_key', value)

@@ -1,6 +1,17 @@
 from .dataframe import DataFrame, Partition, concat_partitions, schema_of
+from .faults import FaultPlan, FaultSpec, active_fault_plan, inject_faults
 from .params import ComplexParam, GlobalParams, Param, Params, ServiceParam, TypeConverters
 from .pipeline import Estimator, Model, Pipeline, PipelineModel, PipelineStage, Transformer, load_stage
+from .resilience import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExpired,
+    RetryBudget,
+    RetryPolicy,
+    all_resilience_measures,
+    reset_resilience_measures,
+    resilience_measures,
+)
 from .utils import ClusterInfo, StopWatch, cluster_info, retry_with_timeout, using
 
 __all__ = [
@@ -8,4 +19,7 @@ __all__ = [
     "Param", "ComplexParam", "ServiceParam", "Params", "GlobalParams", "TypeConverters",
     "PipelineStage", "Transformer", "Estimator", "Model", "Pipeline", "PipelineModel", "load_stage",
     "StopWatch", "retry_with_timeout", "using", "ClusterInfo", "cluster_info",
+    "RetryPolicy", "RetryBudget", "CircuitBreaker", "Deadline", "DeadlineExpired",
+    "resilience_measures", "reset_resilience_measures", "all_resilience_measures",
+    "FaultPlan", "FaultSpec", "inject_faults", "active_fault_plan",
 ]

@@ -13,6 +13,7 @@ for on-demand XLA-level traces.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Iterator
 
@@ -46,6 +47,9 @@ class InstrumentationMeasures:
         self._phases: dict[str, float] = {}
         self._counts: dict[str, int] = {}
         self._marks: dict[str, float] = {}
+        # counters are bumped from serving/executor threads (the resilience
+        # planes share one collector per plane): guard the read-modify-write
+        self._count_lock = threading.Lock()
 
     @contextlib.contextmanager
     def measure(self, name: str) -> Iterator[None]:
@@ -60,7 +64,8 @@ class InstrumentationMeasures:
         self._marks[name] = (time.perf_counter() - self._t0) * 1e3
 
     def count(self, name: str, n: int = 1) -> None:
-        self._counts[name] = self._counts.get(name, 0) + n
+        with self._count_lock:
+            self._counts[name] = self._counts.get(name, 0) + n
 
     def phase_ms(self, name: str) -> float:
         return self._phases.get(name, 0.0)

@@ -31,6 +31,8 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler
 
+from ..core import faults as _faults
+from ..core.resilience import CircuitBreaker, resilience_measures
 from .serving import NoDelayHTTPServer
 
 __all__ = ["WorkerRegistry", "RoutingFront", "RoutingClient",
@@ -124,7 +126,12 @@ class _ConnPool:
     def get(self, key: tuple):
         """(connection, fresh) — a pooled keep-alive connection when one is
         idle, else a freshly connected TCP_NODELAY one (raises OSError when
-        the worker is unreachable)."""
+        the worker is unreachable). An active fault plan (``core/faults.py``)
+        may inject a connect failure / crash / blackhole here — the hook sits
+        before the pool so injected faults hit pooled connections too."""
+        plan = _faults.active_fault_plan()
+        if plan is not None:
+            plan.on_connect(key)
         with self._lock:
             stack = self._idle.get(key)
             if stack:
@@ -192,20 +199,26 @@ class RoutingFront:
     where requests are served wherever they land).
 
     Reliability semantics (the reference's serve-where-it-lands plane never
-    loses workers permanently, ``DistributedHTTPSource.scala:88-203``):
+    loses workers permanently, ``DistributedHTTPSource.scala:88-203``), built
+    on per-worker ``core.resilience.CircuitBreaker``s:
 
-    * connect failures AND timeouts mark a worker dead for
-      ``resurrect_after_s`` seconds, after which it is probed again
-      (time-based resurrection — a slow-but-alive worker is excluded only
-      briefly, while a blackholed one stops stalling every rotation by
-      ``timeout_s``); any successful reply clears the mark immediately;
-    * when every worker is marked dead the least-recently-failed one is
+    * connect failures AND timeouts trip the worker's breaker OPEN (the
+      any-failure configuration: threshold 0, window 1); an open breaker is
+      skipped for ``resurrect_after_s`` seconds, after which it moves to
+      HALF-OPEN and the worker is probed again (time-based resurrection — a
+      slow-but-alive worker is excluded only briefly, while a blackholed one
+      stops stalling every rotation by ``timeout_s``); any successful reply
+      closes the breaker immediately;
+    * when every worker's breaker is open the least-recently-failed one is
       probed anyway (the front degrades to retrying, never to a permanent
       503);
     * with a ``registry``, the routing table refreshes from it on every
       request, so workers registered AFTER startup (restarts, scale-up) are
       routed to immediately; a static ``workers`` list is merged in (the
-      registry entry wins on a (host, port) collision).
+      registry entry wins on a (host, port) collision);
+    * ``GET /stats`` reports the ``distributed_serving`` resilience counters
+      (retries, breaker opens, deadline expiries, injected faults) plus the
+      live per-worker breaker states.
     """
 
     def __init__(self, workers: list[dict] | None = None, port: int = 0,
@@ -215,7 +228,8 @@ class RoutingFront:
             raise ValueError("RoutingFront needs workers and/or a registry")
         self._static_workers = list(workers or [])
         self._registry = registry
-        self._dead: dict[tuple, float] = {}  # (host, port) -> time marked
+        self._resurrect_after_s = resurrect_after_s
+        self._breakers: dict[tuple, CircuitBreaker] = {}  # (host, port) ->
         self._rr = 0
         self._lock = threading.Lock()
         self._pool = _ConnPool(timeout_s)
@@ -247,21 +261,35 @@ class RoutingFront:
                     self._reply(200, table,
                                 {"Content-Type": "application/json"})
                     return
+                if self.path == "/stats":  # resilience counters + breakers
+                    stats = json.dumps({
+                        "resilience": resilience_measures(
+                            "distributed_serving").to_dict(),
+                        "breakers": front.breaker_states()}).encode()
+                    self._reply(200, stats,
+                                {"Content-Type": "application/json"})
+                    return
                 hdrs = {k: v for k, v in self.headers.items()
                         if k.lower() not in ("host", "connection")}
-                for w in front._candidates():
+                candidates, desperate = front._candidates()
+                tried = 0
+                for w in candidates:
                     key = (w.get("host"), w.get("port"))
+                    breaker = front._breaker(key)
+                    if not desperate and not breaker.allow():
+                        continue  # raced shut since the candidate list
+                    if tried:  # rerouting after a failure = one retry
+                        resilience_measures("distributed_serving").count("retry")
+                    tried += 1
                     try:
                         got = _pooled_request(front._pool, key, method,
                                               self.path, body, hdrs)
                     except (http.client.HTTPException, OSError):
-                        with front._lock:
-                            front._dead[key] = time.monotonic()
+                        breaker.record_failure()
                         front._pool.clear(key)
                         continue
                     status, payload = got
-                    with front._lock:
-                        front._dead.pop(key, None)  # proven alive
+                    breaker.record_success()  # proven alive
                     self._reply(status, payload,
                                 {"X-Served-By": str(w.get("pid", ""))})
                     return
@@ -273,7 +301,6 @@ class RoutingFront:
             def do_POST(self):
                 self._forward("POST")
 
-        self._resurrect_after_s = resurrect_after_s
         self._server = NoDelayHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
@@ -288,26 +315,52 @@ class RoutingFront:
         return reg + [w for w in self._static_workers
                       if (w.get("host"), w.get("port")) not in seen]
 
-    def _candidates(self) -> list[dict]:
-        """Routing order for one request: alive + resurrection-due workers
-        round-robin rotated; if none, the least-recently-failed worker."""
+    def _breaker(self, key: tuple) -> CircuitBreaker:
+        """Per-worker breaker, created on first sight with the any-failure
+        configuration (one connect failure opens; the half-open probe fires
+        after ``resurrect_after_s`` — the old resurrection timer)."""
+        with self._lock:
+            breaker = self._breakers.get(key)
+            if breaker is None:
+                breaker = CircuitBreaker(
+                    failure_rate_threshold=0.0, window=1, min_samples=1,
+                    probe_interval_s=self._resurrect_after_s,
+                    measures=resilience_measures("distributed_serving"),
+                    name=f"{key[0]}:{key[1]}")
+                self._breakers[key] = breaker
+            return breaker
+
+    def breaker_states(self) -> dict:
+        """(host:port -> breaker state) snapshot, for ``/stats``."""
+        with self._lock:
+            return {f"{h}:{p}": br.state
+                    for (h, p), br in self._breakers.items()}
+
+    def _candidates(self) -> tuple[list[dict], bool]:
+        """(routing order for one request, desperate): breaker-available
+        (closed or probe-due) workers round-robin rotated; if none, the
+        least-recently-failed worker as a desperation probe."""
         table = self._table()
         if not table:
-            return []
-        now = time.monotonic()
+            return [], False
+        live_keys = {(w.get("host"), w.get("port")) for w in table}
         with self._lock:
-            alive = [w for w in table
-                     if (now - self._dead.get((w.get("host"), w.get("port")),
-                                              -1e18)) >= self._resurrect_after_s]
+            # prune breakers for departed workers (respawns land on fresh
+            # ephemeral ports; without this the map grows forever)
+            if len(self._breakers) > len(live_keys):
+                self._breakers = {k: b for k, b in self._breakers.items()
+                                  if k in live_keys}
+        alive = [w for w in table
+                 if self._breaker((w.get("host"), w.get("port"))).available()]
+        with self._lock:
             self._rr += 1
             rot = self._rr % max(len(alive), 1)
         if alive:
-            return alive[rot:] + alive[:rot]
+            return alive[rot:] + alive[:rot], False
         # everything recently failed: probe the stalest failure anyway
-        with self._lock:
-            stalest = min(table, key=lambda w: self._dead.get(
-                (w.get("host"), w.get("port")), 0.0))
-        return [stalest]
+        stalest = min(table, key=lambda w: self._breaker(
+            (w.get("host"), w.get("port"))).last_failure_at or 0.0)
+        return [stalest], True
 
     @property
     def address(self) -> str:
@@ -324,12 +377,15 @@ class RoutingClient:
     ``/routes`` (or takes a worker list), then talks to workers DIRECTLY over
     its own persistent connections, round-robin — zero proxy hops, the
     client-side analog of Spark clients hitting whichever executor serves
-    them (``DistributedHTTPSource.scala:88-203``). Failing workers are
-    skipped for the rotation and the table is refreshed; thread-safe.
+    them (``DistributedHTTPSource.scala:88-203``). Failing workers trip a
+    per-worker circuit breaker (skipped until the ``resurrect_after_s``
+    half-open probe) and the table is refreshed; when every breaker is open
+    the least-recently-failed worker is tried anyway. Thread-safe.
     """
 
     def __init__(self, front_address: str | None = None,
-                 workers: list[dict] | None = None, timeout_s: float = 10.0):
+                 workers: list[dict] | None = None, timeout_s: float = 10.0,
+                 resurrect_after_s: float = 2.0):
         if front_address is None and workers is None:
             raise ValueError("RoutingClient needs front_address or workers")
         self._front = front_address
@@ -338,16 +394,35 @@ class RoutingClient:
         self._rr = 0
         self._lock = threading.Lock()
         self._timeout_s = timeout_s
+        self._resurrect_after_s = resurrect_after_s
+        self._breakers: dict[tuple, CircuitBreaker] = {}
         if self._front is not None:
             self.refresh()
+
+    def _breaker(self, key: tuple) -> CircuitBreaker:
+        with self._lock:
+            breaker = self._breakers.get(key)
+            if breaker is None:
+                breaker = CircuitBreaker(
+                    failure_rate_threshold=0.0, window=1, min_samples=1,
+                    probe_interval_s=self._resurrect_after_s,
+                    measures=resilience_measures("distributed_serving"),
+                    name=f"client {key[0]}:{key[1]}")
+                self._breakers[key] = breaker
+            return breaker
 
     def refresh(self) -> list[dict]:
         if self._front is not None:
             with urllib.request.urlopen(self._front + "/routes",
                                         timeout=self._timeout_s) as r:
                 table = json.loads(r.read())
+            live_keys = {(w.get("host"), w.get("port")) for w in table}
             with self._lock:
                 self._workers = table
+                # drop breakers for workers no longer in the table (respawn
+                # churn would otherwise grow the map forever)
+                self._breakers = {k: b for k, b in self._breakers.items()
+                                  if k in live_keys}
         return list(self._workers)
 
     def request(self, path: str, body: bytes | None = None,
@@ -361,20 +436,46 @@ class RoutingClient:
             rot = self._rr
         if not table:
             raise ConnectionError("no workers in the routing table")
-        last_err = None
+        last_err, tried = None, 0
         for i in range(len(table)):
             w = table[(rot + i) % len(table)]
             key = (w.get("host"), w.get("port"))
+            breaker = self._breaker(key)
+            if not breaker.allow():
+                continue  # breaker open: skip until its half-open probe
+            if tried:
+                resilience_measures("distributed_serving").count("retry")
+            tried += 1
             try:
-                return _pooled_request(self._pool, key, method, path, body,
-                                       headers)
+                result = _pooled_request(self._pool, key, method, path, body,
+                                         headers)
+                breaker.record_success()
+                return result
             except (http.client.HTTPException, OSError) as e:
+                breaker.record_failure()
+                self._pool.clear(key)
                 last_err = e
             if self._front is not None:
                 try:
                     table = self.refresh() or table
                 except (urllib.error.URLError, OSError):
                     pass
+        if tried == 0:
+            # every breaker open: desperation-probe the stalest failure (the
+            # client degrades to retrying, never to a permanent error)
+            w = min(table, key=lambda w: self._breaker(
+                (w.get("host"), w.get("port"))).last_failure_at or 0.0)
+            key = (w.get("host"), w.get("port"))
+            breaker = self._breaker(key)
+            try:
+                result = _pooled_request(self._pool, key, method, path, body,
+                                         headers)
+                breaker.record_success()
+                return result
+            except (http.client.HTTPException, OSError) as e:
+                breaker.record_failure()
+                self._pool.clear(key)
+                last_err = e
         raise ConnectionError(f"all {len(table)} workers failed: {last_err}")
 
     def close(self) -> None:

@@ -8,23 +8,39 @@ structs), ``HTTPClients.scala`` (``HandlingUtils.advancedUDF`` retry/backoff/
 
 Python-native: stdlib ``urllib`` for transport (zero deps), a thread pool for
 the async buffered client (the reference's concurrency/concurrentTimeout
-params), exponential backoff honoring Retry-After on 429/503.
+params), jittered exponential backoff honoring Retry-After on 429/503.
+
+Resilience: retries run through ``core/resilience.py`` (``RetryPolicy`` with
+FULL jitter + optional ``RetryBudget``; an optional ``Deadline`` caps every
+attempt's timeout so total latency is bounded), instrumented on
+``resilience_measures("http")``; ``core/faults.py`` fault plans hook the
+``_urlopen`` send path for offline fault-injection tests.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
 import dataclasses
+import datetime
+import email.utils
 import json
+import math
 import time
 import urllib.error
 import urllib.request
 
 import numpy as np
 
+from ..core import faults as _faults
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Transformer
+from ..core.resilience import (
+    Deadline,
+    DeadlineExpired,
+    RetryPolicy,
+    resilience_measures,
+)
 
 __all__ = ["HTTPRequest", "HTTPResponse", "send_with_retries", "AsyncHTTPClient",
            "HTTPTransformer", "SimpleHTTPTransformer", "JSONInputParser",
@@ -68,38 +84,102 @@ class HTTPResponse:
 
 _RETRY_STATUSES = (429, 500, 502, 503, 504)
 
+# Retry-After clamp: negative (clock skew / past HTTP-date) waits become 0,
+# absurd server-sent waits are capped so one bad header can't stall a lane
+RETRY_AFTER_CAP_MS = 30_000.0
+
+
+def _retry_after_ms(value) -> float | None:
+    """Parse a Retry-After header: delta-seconds or an HTTP-date (RFC 9110
+    §10.2.3, via ``email.utils.parsedate_to_datetime``). None when absent or
+    unparseable (caller falls back to the backoff schedule); clamped to
+    [0, RETRY_AFTER_CAP_MS]."""
+    if value is None:
+        return None
+    try:
+        sec = float(value)
+    except (TypeError, ValueError):
+        try:
+            dt = email.utils.parsedate_to_datetime(str(value))
+        except (TypeError, ValueError):
+            return None
+        if dt.tzinfo is None:   # RFC 5322 fallback: naive means UTC
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        sec = (dt - datetime.datetime.now(datetime.timezone.utc)).total_seconds()
+    if not math.isfinite(sec):  # 'Retry-After: nan'/'inf' parse as floats but
+        return None             # would poison the sleep below
+    return min(max(sec, 0.0) * 1000.0, RETRY_AFTER_CAP_MS)
+
+
+def _urlopen(request: HTTPRequest, timeout_s: float):
+    """The one send hook: an active fault plan (``core/faults.py``) may raise
+    an injected error or add latency before the real request goes out."""
+    plan = _faults.active_fault_plan()
+    if plan is not None:
+        plan.on_http_send(request.url)
+    return urllib.request.urlopen(request.to_urllib(), timeout=timeout_s)
+
 
 def send_with_retries(request: HTTPRequest, backoffs_ms=(100, 500, 1000),
-                      timeout_s: float = 60.0) -> HTTPResponse:
-    """(ref ``HandlingUtils.advancedUDF`` — retry on 429/5xx with backoff,
-    honoring Retry-After.) Network errors after the last retry return a
-    response row with ``error`` set rather than raising (errors-as-data, like
-    the reference's error column)."""
+                      timeout_s: float = 60.0,
+                      policy: RetryPolicy | None = None,
+                      deadline: Deadline | None = None) -> HTTPResponse:
+    """(ref ``HandlingUtils.advancedUDF`` — retry on 429/5xx with jittered
+    backoff, honoring Retry-After.) Network errors after the last retry return
+    a response row with ``error`` set rather than raising (errors-as-data,
+    like the reference's error column).
+
+    ``policy`` (default: ``RetryPolicy(backoffs_ms)``) adds full jitter and an
+    optional retry budget — when the budget is drained the call fails fast
+    instead of amplifying a storm. ``deadline`` caps every attempt's timeout
+    by the remaining total budget; on expiry the last known response/error is
+    returned with ``deadline_expired`` counted."""
+    policy = policy if policy is not None \
+        else RetryPolicy(backoffs_ms=tuple(backoffs_ms))
+    m = resilience_measures("http")
     last_err = None
-    for attempt in range(len(backoffs_ms) + 1):
+    for attempt in range(policy.max_attempts):
         try:
-            with urllib.request.urlopen(request.to_urllib(), timeout=timeout_s) as r:
+            attempt_timeout = timeout_s if deadline is None \
+                else deadline.cap(timeout_s)
+        except DeadlineExpired:
+            m.count("deadline_expired")
+            return HTTPResponse(status_code=0, reason="deadline expired",
+                                error=f"deadline expired: {last_err}")
+        try:
+            with _urlopen(request, attempt_timeout) as r:
+                policy.on_success(first_attempt=attempt == 0)
                 return HTTPResponse(status_code=r.status, reason=r.reason or "",
                                     headers=dict(r.headers), entity=r.read())
         except urllib.error.HTTPError as e:
             body = e.read() if hasattr(e, "read") else b""
-            if e.code in _RETRY_STATUSES and attempt < len(backoffs_ms):
-                retry_after = e.headers.get("Retry-After") if e.headers else None
-                try:
-                    # Retry-After may be an HTTP-date, not just seconds
-                    wait_ms = float(retry_after) * 1000.0
-                except (TypeError, ValueError):
-                    wait_ms = backoffs_ms[attempt]
-                time.sleep(wait_ms / 1000.0)
-                last_err = e
-                continue
+            if e.code in _RETRY_STATUSES and attempt < policy.max_attempts - 1:
+                wait_ms = _retry_after_ms(
+                    e.headers.get("Retry-After") if e.headers else None)
+                if wait_ms is None:
+                    wait_ms = policy.backoff_ms(attempt)
+                # deadline first — a refused sleep must not burn a budget token
+                if deadline is not None and \
+                        not deadline.sleep_allowed(wait_ms / 1000.0):
+                    m.count("deadline_expired")
+                elif policy.acquire_retry():
+                    m.count("retry")
+                    time.sleep(wait_ms / 1000.0)
+                    last_err = e
+                    continue
             return HTTPResponse(status_code=e.code, reason=str(e.reason),
                                 headers=dict(e.headers or {}), entity=body)
         except (urllib.error.URLError, OSError) as e:
             last_err = e
-            if attempt < len(backoffs_ms):
-                time.sleep(backoffs_ms[attempt] / 1000.0)
-                continue
+            if attempt < policy.max_attempts - 1:
+                wait_ms = policy.backoff_ms(attempt)
+                if deadline is not None and \
+                        not deadline.sleep_allowed(wait_ms / 1000.0):
+                    m.count("deadline_expired")
+                elif policy.acquire_retry():
+                    m.count("retry")
+                    time.sleep(wait_ms / 1000.0)
+                    continue
             return HTTPResponse(status_code=0, reason="connection error",
                                 error=str(last_err))
     return HTTPResponse(status_code=0, reason="unreachable", error=str(last_err))
@@ -111,10 +191,17 @@ class AsyncHTTPClient:
     responses returned in request order."""
 
     def __init__(self, concurrency: int = 8, timeout_s: float = 60.0,
-                 backoffs_ms=(100, 500, 1000)):
+                 backoffs_ms=(100, 500, 1000),
+                 policy: RetryPolicy | None = None,
+                 deadline: Deadline | None = None):
         self.concurrency = max(int(concurrency), 1)
         self.timeout_s = timeout_s
         self.backoffs_ms = tuple(backoffs_ms)
+        # one shared policy per client: the retry BUDGET is a per-client
+        # token bucket, so a storm across the whole pool drains one bucket
+        self.policy = policy if policy is not None \
+            else RetryPolicy(backoffs_ms=self.backoffs_ms)
+        self.deadline = deadline
         self._pool: concurrent.futures.ThreadPoolExecutor | None = None
 
     def _executor(self) -> concurrent.futures.ThreadPoolExecutor:
@@ -135,10 +222,13 @@ class AsyncHTTPClient:
         except Exception:
             pass
 
-    def send_all(self, requests: list[HTTPRequest | None]) -> list[HTTPResponse | None]:
+    def send_all(self, requests: list[HTTPRequest | None],
+                 deadline: Deadline | None = None) -> list[HTTPResponse | None]:
         pool = self._executor()
+        deadline = deadline if deadline is not None else self.deadline
         futures = [None if r is None else
-                   pool.submit(send_with_retries, r, self.backoffs_ms, self.timeout_s)
+                   pool.submit(send_with_retries, r, self.backoffs_ms,
+                               self.timeout_s, self.policy, deadline)
                    for r in requests]
         return [None if f is None else f.result() for f in futures]
 
@@ -158,11 +248,15 @@ class HTTPTransformer(Transformer):
                       converter=TypeConverters.to_float)
     backoffs_ms = ComplexParam("backoffs_ms", "retry backoff schedule",
                                default=(100, 500, 1000))
+    retry_policy = ComplexParam("retry_policy", "core.resilience.RetryPolicy "
+                                "(overrides backoffs_ms; carries jitter rng "
+                                "and retry budget)", default=None)
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("input_col"))
         client = AsyncHTTPClient(self.get("concurrency"), self.get("timeout_s"),
-                                 self.get("backoffs_ms"))
+                                 self.get("backoffs_ms"),
+                                 policy=self.get("retry_policy"))
 
         def per_part(p):
             reqs = list(p[self.get("input_col")])
@@ -242,6 +336,8 @@ class SimpleHTTPTransformer(Transformer):
                       converter=TypeConverters.to_float)
     backoffs_ms = ComplexParam("backoffs_ms", "retry backoff schedule",
                                default=(100, 500, 1000))
+    retry_policy = ComplexParam("retry_policy", "core.resilience.RetryPolicy "
+                                "(overrides backoffs_ms)", default=None)
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("input_col"))
@@ -250,7 +346,8 @@ class SimpleHTTPTransformer(Transformer):
         http = HTTPTransformer(
             input_col="_http_request", output_col="_http_response",
             concurrency=self.get("concurrency"), timeout_s=self.get("timeout_s"),
-            backoffs_ms=self.get("backoffs_ms"))
+            backoffs_ms=self.get("backoffs_ms"),
+            retry_policy=self.get("retry_policy"))
 
         def build_requests(p):
             col = p[self.get("input_col")]

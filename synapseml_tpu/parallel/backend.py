@@ -89,24 +89,60 @@ class DriverRendezvous:
 
 
 def worker_rendezvous(driver_address: str, executor_id: str, partition_id: int,
-                      timeout_s: float = 120.0, retry_interval_s: float = 0.25) -> dict:
+                      timeout_s: float = 120.0, retry_interval_s: float = 0.25,
+                      policy=None, deadline=None) -> dict:
     """Worker side: register with the driver, receive (coordinator, rank, world).
-    Retries with backoff like ``NetworkManager.initLightGBMNetwork:195-218``."""
+    Retries with jittered backoff like ``NetworkManager.initLightGBMNetwork:195-218``,
+    bounded by a ``core.resilience.Deadline`` — every connect attempt's
+    timeout is capped by the remaining budget, so a hung coordinator can
+    never stall a worker past ``timeout_s`` total. Retries and expiries are
+    counted on ``resilience_measures("parallel")``."""
+    from ..core.resilience import Deadline, DeadlineExpired, RetryPolicy, \
+        resilience_measures
+
     host, port = driver_address.rsplit(":", 1)
-    deadline = time.monotonic() + timeout_s
+    measures = resilience_measures("parallel")
+    deadline = deadline if deadline is not None else Deadline(timeout_s)
+    if policy is None:
+        # geometric schedule seeded from retry_interval_s, capped at 5s —
+        # the old doubling loop, now with full jitter so a fleet of workers
+        # restarting together doesn't hammer the driver in lockstep
+        backoffs, b = [], retry_interval_s * 1000.0
+        while len(backoffs) < 64:
+            backoffs.append(min(b, 5000.0))
+            b *= 2
+        policy = RetryPolicy(backoffs_ms=tuple(backoffs))
     last: BaseException | None = None
-    while time.monotonic() < deadline:
+    attempt = 0
+    while True:
         try:
-            with socket.create_connection((host, int(port)), timeout=timeout_s) as s:
+            connect_timeout = deadline.cap(timeout_s)
+        except DeadlineExpired:
+            measures.count("deadline_expired")
+            raise TimeoutError(
+                f"rendezvous with {driver_address} failed: {last}") from last
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=connect_timeout) as s:
                 payload = {"host": socket.gethostname(), "executor_id": executor_id,
                            "partition_id": partition_id}
                 s.sendall((json.dumps(payload) + "\n").encode())
                 return json.loads(s.makefile("r").readline())
         except OSError as e:
             last = e
-            time.sleep(retry_interval_s)
-            retry_interval_s = min(retry_interval_s * 2, 5.0)
-    raise TimeoutError(f"rendezvous with {driver_address} failed: {last}")
+            wait_s = policy.backoff_ms(attempt) / 1000.0
+            attempt += 1
+            if not deadline.sleep_allowed(wait_s):
+                measures.count("deadline_expired")
+                raise TimeoutError(
+                    f"rendezvous with {driver_address} failed: {last}") from last
+            if not policy.acquire_retry():
+                measures.count("retry_budget_exhausted")
+                raise TimeoutError(
+                    f"rendezvous with {driver_address} failed "
+                    f"(retry budget exhausted): {last}") from last
+            measures.count("retry")
+            time.sleep(wait_s)
 
 
 @dataclass
@@ -127,11 +163,14 @@ _BACKEND: DistributedBackend | None = None
 
 
 def initialize_backend(driver_address: str | None = None, executor_id: str | None = None,
-                       partition_id: int = 0) -> DistributedBackend:
+                       partition_id: int = 0,
+                       rendezvous_timeout_s: float = 120.0) -> DistributedBackend:
     """Initialize jax.distributed from rendezvous (multi-host) or env/defaults.
 
     Single-process (tests, 1 TPU VM, CPU mesh): no-op beyond recording a
-    world-of-1 backend. Multi-host: rendezvous -> jax.distributed.initialize.
+    world-of-1 backend. Multi-host: deadline-bounded rendezvous (at most
+    ``rendezvous_timeout_s`` total across all connect retries) ->
+    jax.distributed.initialize.
     """
     global _BACKEND
     if _BACKEND is not None:
@@ -143,7 +182,8 @@ def initialize_backend(driver_address: str | None = None, executor_id: str | Non
                                       coordinator=os.environ.get("JAX_COORDINATOR_ADDRESS"),
                                       initialized=False)
         return _BACKEND
-    info = worker_rendezvous(driver_address, executor_id or socket.gethostname(), partition_id)
+    info = worker_rendezvous(driver_address, executor_id or socket.gethostname(),
+                             partition_id, timeout_s=rendezvous_timeout_s)
     jax.distributed.initialize(coordinator_address=info["coordinator"],
                                num_processes=info["world"], process_id=info["rank"])
     _BACKEND = DistributedBackend(rank=info["rank"], world=info["world"],

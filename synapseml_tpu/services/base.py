@@ -15,8 +15,9 @@ import time
 import numpy as np
 
 from ..core.dataframe import DataFrame
-from ..core.params import Param, ServiceParam, TypeConverters
+from ..core.params import ComplexParam, Param, ServiceParam, TypeConverters
 from ..core.pipeline import Transformer
+from ..core.resilience import Deadline, resilience_measures
 from ..io.http import (
     AsyncHTTPClient,
     HTTPRequest,
@@ -40,6 +41,13 @@ class CognitiveServiceBase(Transformer):
                         converter=TypeConverters.to_int)
     timeout_s = Param("timeout_s", "request timeout", default=60.0,
                       converter=TypeConverters.to_float)
+    backoffs_ms = ComplexParam("backoffs_ms", "retry backoff schedule "
+                               "(threaded to the HTTP client, as "
+                               "HTTPTransformer does)",
+                               default=(100, 500, 1000))
+    retry_policy = ComplexParam("retry_policy", "core.resilience.RetryPolicy "
+                                "(overrides backoffs_ms; carries jitter rng "
+                                "and retry budget)", default=None)
 
     # ---- subclass hooks -------------------------------------------------
     def build_request(self, row_params: dict) -> HTTPRequest | None:
@@ -97,7 +105,9 @@ class CognitiveServiceBase(Transformer):
     def _transform(self, df: DataFrame) -> DataFrame:
         for col_param in self.input_bindings().values():
             self.require_columns(df, self.get(col_param))
-        client = AsyncHTTPClient(self.get("concurrency"), self.get("timeout_s"))
+        client = AsyncHTTPClient(self.get("concurrency"), self.get("timeout_s"),
+                                 self.get("backoffs_ms"),
+                                 policy=self.get("retry_policy"))
 
         def per_part(p):
             n = len(next(iter(p.values()))) if p else 0
@@ -130,6 +140,10 @@ class HasAsyncReply(CognitiveServiceBase):
                                converter=TypeConverters.to_float)
     max_poll_attempts = Param("max_poll_attempts", "max polls per row", default=40,
                               converter=TypeConverters.to_int)
+    lro_deadline_s = Param("lro_deadline_s", "total wall-clock budget for the "
+                           "whole polling sweep (0 = attempts-bounded only); "
+                           "expiry marks pending rows as timed out",
+                           default=0.0, converter=TypeConverters.to_float)
 
     _AUTH_HEADERS = ("Ocp-Apim-Subscription-Key", "api-key", "Authorization")
 
@@ -166,17 +180,26 @@ class HasAsyncReply(CognitiveServiceBase):
                 loc = self.poll_location(resp)
                 if loc:
                     pending[i] = loc
+        budget = self.get("lro_deadline_s")
+        deadline = Deadline(budget) if budget and budget > 0 else None
+        deadline_cut = False
         for _ in range(self.get("max_poll_attempts")):
             if not pending:
+                break
+            if deadline is not None and deadline.expired():
+                deadline_cut = True
                 break
             time.sleep(self.get("polling_interval_s"))
             idxs = list(pending)
             polled = client.send_all(
                 [HTTPRequest(url=pending[i], method="GET",
                              headers=self.poll_headers(requests[i]))
-                 for i in idxs])
+                 for i in idxs], deadline=deadline)
             for i, resp in zip(idxs, polled):
                 if resp is None or resp.status_code // 100 != 2:
+                    if (resp is not None and resp.status_code == 0
+                            and resp.reason == "deadline expired"):
+                        deadline_cut = True  # cut off by the poll deadline
                     out[i] = resp
                     del pending[i]
                     continue
@@ -189,6 +212,8 @@ class HasAsyncReply(CognitiveServiceBase):
                 if done:
                     out[i] = resp
                     del pending[i]
+        if deadline_cut:
+            resilience_measures("services").count("deadline_expired")
         for i in pending:
             out[i] = HTTPResponse(status_code=0, reason="LRO timeout",
                                   error="long-running operation timed out")

@@ -19,7 +19,12 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5) has no jax_num_cpu_devices option; the
+    # xla_force_host_platform_device_count flag above already forces 8
+    pass
 
 import numpy as np
 import pytest
@@ -88,6 +93,69 @@ def pytest_addoption(parser):
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running (full-size model) "
                             "tests, skipped unless --runslow")
+    config.addinivalue_line("markers", "chaos: injected-fault / worker-kill "
+                            "tests; guarded by the per-test thread watchdog "
+                            "(pyproject.toml registers this marker too)")
+
+
+# ---- chaos watchdog ------------------------------------------------------
+# Injected-fault tests (tests/test_resilience.py) kill workers, blackhole
+# connections and drive retry loops — a bug in any of those paths could hang
+# the tier-1 lane forever. Every @pytest.mark.chaos test runs under a
+# stdlib-only thread-based alarm: if the test body exceeds its limit
+# (default CHAOS_TIMEOUT_S; override with @pytest.mark.chaos(timeout_s=N)),
+# a timer thread interrupts the main thread and the hookwrapper below
+# converts that into a bounded test FAILURE instead of a session abort.
+
+CHAOS_TIMEOUT_S = 120.0
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("chaos")
+    if marker is None:
+        yield
+        return
+    import signal
+    import threading
+    import time as _time
+
+    limit = float(marker.kwargs.get("timeout_s", CHAOS_TIMEOUT_S))
+    fired = threading.Event()
+    done = threading.Event()
+    main_ident = threading.main_thread().ident
+
+    def alarm():
+        if done.is_set():  # test body already finished: don't interrupt
+            return
+        fired.set()
+        try:
+            # a real OS signal interrupts blocking syscalls (sleep, recv) —
+            # _thread.interrupt_main() would only set a pending flag
+            signal.pthread_kill(main_ident, signal.SIGINT)
+        except (ValueError, OSError):
+            import _thread
+            _thread.interrupt_main()
+
+    timer = threading.Timer(limit, alarm)
+    timer.daemon = True
+    timer.start()
+    outcome = yield
+    done.set()
+    timer.cancel()
+    if fired.is_set() and outcome.excinfo is not None:
+        # replace the KeyboardInterrupt (it would abort the whole session)
+        # with a bounded failure of just this test
+        outcome.force_exception(
+            pytest.fail.Exception(f"chaos watchdog: test exceeded {limit:.0f}s",
+                                  pytrace=False))
+    elif fired.is_set():
+        # the alarm raced the end of the test body: absorb the SIGINT it
+        # delivered so it can't abort the session in teardown / the next test
+        try:
+            _time.sleep(0.1)
+        except KeyboardInterrupt:
+            pass
 
 
 def _slow_manifest() -> set:

@@ -125,11 +125,9 @@ def test_distributed_serving_round_robin_under_load():
 
 
 def test_routing_front_resurrects_dead_workers():
-    """A worker marked dead after a connect failure rejoins the rotation once
-    its resurrection window passes (advisor finding: the old front 503'd
-    forever after every worker failed once)."""
-    import time as _time
-
+    """A worker whose breaker tripped open after a connect failure rejoins
+    the rotation once its resurrection window passes (advisor finding: the
+    old front 503'd forever after every worker failed once)."""
     from synapseml_tpu.io.serving import serve_pipeline
     from synapseml_tpu.io.distributed_serving import RoutingFront
 
@@ -145,14 +143,15 @@ def test_routing_front_resurrects_dead_workers():
                 return r.status
 
         assert call() == 200
-        # poison the routing table entry: mark the (only) worker dead
-        with front._lock:
-            front._dead[(live["host"], live["port"])] = _time.monotonic() + 60
+        # poison the routing table entry: trip the (only) worker's breaker
+        breaker = front._breaker((live["host"], live["port"]))
+        breaker.record_failure()
+        assert breaker.state == breaker.OPEN
         # inside the window, the desperation probe still reaches it (the front
         # never settles into a permanent 503 while a worker is reachable)
         assert call() == 200
-        # a success clears the dead mark entirely
-        assert (live["host"], live["port"]) not in front._dead
+        # a success closes the breaker entirely
+        assert breaker.state == breaker.CLOSED
     finally:
         front.close()
         srv.stop()

@@ -62,7 +62,7 @@ class MockServiceHandler(BaseHTTPRequestHandler):
                 MockServiceHandler.flaky_counts.get(key, 0) + 1
             if MockServiceHandler.flaky_counts[key] < 2:
                 self._reply({"err": "throttled"}, status=429,
-                            headers={"Retry-After": "Wed, 21 Oct 2026 07:28:00 GMT"})
+                            headers={"Retry-After": "Wed, 21 Oct 2015 07:28:00 GMT"})
             else:
                 self._reply({"ok": True})
         elif self.path.startswith("/flaky/"):
@@ -337,8 +337,8 @@ def test_prompt_with_literal_braces(mock_server):
 
 
 def test_retry_after_http_date(mock_server):
-    # date-formatted Retry-After must fall back to the backoff schedule,
-    # not crash in float()
+    # date-formatted Retry-After is PARSED (email.utils.parsedate_to_datetime);
+    # a past date clamps to a zero wait instead of crashing in float()
     MockServiceHandler.flaky_counts.clear()
     resp = send_with_retries(HTTPRequest(url=f"{mock_server}/flaky-date/x"),
                              backoffs_ms=(5, 5))

@@ -1,0 +1,680 @@
+"""Unified resilience layer (core/resilience.py + core/faults.py) driven
+entirely under injected fault plans — no real network, loopback only.
+
+Covers: jittered retry policies + retry-budget exhaustion, circuit breaker
+closed/open/half-open cycling, deadlines capping cumulative attempt time,
+seeded fault-plan determinism, the rewired http / services / distributed-
+serving / parallel planes, and a RoutingFront chaos run (kill 2 of 3 workers,
+resurrect, zero permanently-failed requests)."""
+
+import json
+import random
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from email.utils import formatdate
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.dataframe import DataFrame
+from synapseml_tpu.core.faults import FaultPlan, FaultSpec, inject_faults
+from synapseml_tpu.core.instrumentation import InstrumentationMeasures
+from synapseml_tpu.core.resilience import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExpired,
+    RetryBudget,
+    RetryPolicy,
+    resilience_measures,
+)
+from synapseml_tpu.io.http import (
+    RETRY_AFTER_CAP_MS,
+    HTTPRequest,
+    _retry_after_ms,
+    send_with_retries,
+)
+
+
+def counter(plane: str, name: str) -> int:
+    return resilience_measures(plane).to_dict().get(f"{name}_count", 0)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    """Replies {"port": <server port>} to any GET/POST (who served this?)."""
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        if n:
+            self.rfile.read(n)
+        body = json.dumps({"port": self.server.server_address[1]}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = _reply
+    do_POST = _reply
+
+
+def _start_echo(port: int = 0) -> ThreadingHTTPServer:
+    srv = ThreadingHTTPServer(("127.0.0.1", port), _EchoHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+@pytest.fixture(scope="module")
+def ok_server():
+    srv = _start_echo()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / RetryBudget
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_full_jitter_deterministic_under_seed():
+    sched1 = [RetryPolicy(backoffs_ms=(100, 500, 1000),
+                          rng=random.Random(5)).backoff_ms(i) for i in range(3)]
+    sched2 = [RetryPolicy(backoffs_ms=(100, 500, 1000),
+                          rng=random.Random(5)).backoff_ms(i) for i in range(3)]
+    assert sched1 == sched2  # same seed => same jittered schedule
+    for wait, base in zip(sched1, (100, 500, 1000)):
+        assert 0.0 <= wait <= base  # full jitter: uniform(0, base]
+    # jitter actually jitters (astronomically unlikely to hit the base)
+    assert sched1 != [100.0, 500.0, 1000.0]
+    # and without jitter the raw schedule comes back
+    plain = RetryPolicy(backoffs_ms=(100, 500), jitter=False)
+    assert [plain.backoff_ms(i) for i in range(2)] == [100.0, 500.0]
+
+
+def test_retry_budget_exhaustion_fails_fast():
+    budget = RetryBudget(max_tokens=1.0, deposit_per_success=0.0)
+    policy = RetryPolicy(backoffs_ms=(1, 1, 1), budget=budget,
+                         rng=random.Random(0))
+    with inject_faults([FaultSpec("connection_error",
+                                  match="budget.invalid")]) as plan:
+        r1 = send_with_retries(HTTPRequest(url="http://budget.invalid/a"),
+                               policy=policy, timeout_s=1.0)
+        # 1 token => first attempt + exactly one retry, then fail fast
+        assert r1.error and len(plan.injected) == 2
+        r2 = send_with_retries(HTTPRequest(url="http://budget.invalid/b"),
+                               policy=policy, timeout_s=1.0)
+        # bucket empty => single attempt, no retries (storms can't amplify)
+        assert r2.error and len(plan.injected) == 3
+    assert budget.tokens == 0.0
+
+
+def test_retry_budget_refills_on_success(ok_server):
+    budget = RetryBudget(max_tokens=2.0, deposit_per_success=0.5,
+                         initial_tokens=0.0)
+    policy = RetryPolicy(backoffs_ms=(1,), budget=budget)
+    assert not policy.acquire_retry()
+    for _ in range(3):
+        resp = send_with_retries(HTTPRequest(url=f"{ok_server}/ok"),
+                                 policy=policy, timeout_s=5.0)
+        assert resp.status_code == 200
+    assert budget.tokens == pytest.approx(1.5)
+    assert policy.acquire_retry()  # deposits re-enable retries
+
+
+def test_retry_budget_not_replenished_by_retried_success(ok_server):
+    """A success that itself consumed a retry token must not deposit back —
+    otherwise the bucket drains far slower than the retry-rate bound."""
+    budget = RetryBudget(max_tokens=5.0, deposit_per_success=1.0,
+                         initial_tokens=5.0)
+    policy = RetryPolicy(backoffs_ms=(1, 1), budget=budget)
+    with inject_faults([FaultSpec("status", status=503, times=1,
+                                  match="/retried")]):
+        resp = send_with_retries(HTTPRequest(url=f"{ok_server}/retried"),
+                                 policy=policy, timeout_s=5.0)
+    assert resp.status_code == 200
+    assert budget.tokens == pytest.approx(4.0)  # spent 1, no deposit back
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_open_half_open_closed_cycle():
+    clk = FakeClock()
+    m = InstrumentationMeasures()
+    br = CircuitBreaker(failure_rate_threshold=0.5, window=4, min_samples=2,
+                        probe_interval_s=5.0, clock=clk, measures=m)
+    br.record_failure()
+    assert br.state == br.CLOSED  # min_samples not reached
+    br.record_failure()
+    assert br.state == br.OPEN
+    assert m.to_dict()["breaker_open_count"] == 1
+    assert not br.allow() and not br.available()
+    clk.advance(5.0)
+    assert br.available()
+    assert br.allow()  # probe lease; open -> half-open
+    assert br.state == br.HALF_OPEN
+    assert not br.allow()  # only one probe in flight
+    br.record_success()
+    assert br.state == br.CLOSED and br.allow()
+
+
+def test_circuit_breaker_reopens_on_probe_failure():
+    clk = FakeClock()
+    br = CircuitBreaker(failure_rate_threshold=0.0, window=1, min_samples=1,
+                        probe_interval_s=2.0, clock=clk)
+    br.record_failure()
+    assert br.state == br.OPEN
+    clk.advance(2.0)
+    assert br.allow()  # half-open probe
+    br.record_failure()  # probe failed
+    assert br.state == br.OPEN
+    assert not br.allow()  # interval restarts from the probe failure
+    clk.advance(2.0)
+    assert br.allow()
+    br.record_success()
+    assert br.state == br.CLOSED
+
+
+def test_circuit_breaker_failure_rate_window():
+    br = CircuitBreaker(failure_rate_threshold=0.5, window=10, min_samples=4,
+                        clock=FakeClock())
+    for _ in range(3):
+        br.record_success()
+    br.record_failure()  # 1/4 = 0.25 < 0.5
+    assert br.state == br.CLOSED
+    br.record_failure()
+    br.record_failure()  # 3/6 = 0.5 >= 0.5
+    assert br.state == br.OPEN
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+# ---------------------------------------------------------------------------
+
+def test_deadline_caps_attempt_timeouts():
+    clk = FakeClock()
+    dl = Deadline(10.0, clock=clk)
+    assert dl.cap(60.0) == 10.0   # attempt timeout capped by the budget
+    clk.advance(4.0)
+    assert dl.cap(3.0) == 3.0     # smaller timeouts pass through
+    assert dl.cap(60.0) == pytest.approx(6.0)
+    assert dl.sleep_allowed(5.9) and not dl.sleep_allowed(6.1)
+    clk.advance(7.0)
+    assert dl.expired()
+    with pytest.raises(DeadlineExpired):
+        dl.cap(1.0)
+
+
+def test_deadline_bounds_total_retry_time():
+    """A 503 storm with a 5s Retry-After cannot stall past the deadline: the
+    backoff sleep is refused and the last response returns immediately."""
+    before = counter("http", "deadline_expired")
+    with inject_faults([FaultSpec("status", status=503, retry_after=5,
+                                  match="deadline.invalid")]):
+        t0 = time.monotonic()
+        resp = send_with_retries(HTTPRequest(url="http://deadline.invalid/x"),
+                                 backoffs_ms=(1, 1, 1), timeout_s=1.0,
+                                 deadline=Deadline(0.2))
+        elapsed = time.monotonic() - t0
+    assert resp.status_code == 503
+    assert elapsed < 2.0  # NOT the 5s Retry-After, and no 4x amplification
+    assert counter("http", "deadline_expired") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: determinism + injection kinds
+# ---------------------------------------------------------------------------
+
+def _drive_plan(seed: int) -> list:
+    plan = FaultPlan([FaultSpec("connection_error", probability=0.5,
+                                match="127.0.0.1:1")], seed=seed)
+    with inject_faults(plan):
+        for i in range(20):
+            send_with_retries(HTTPRequest(url=f"http://127.0.0.1:1/{i}"),
+                              backoffs_ms=(), timeout_s=0.5)
+    return list(plan.injected)
+
+
+def test_fault_plan_deterministic_under_seed():
+    a, b = _drive_plan(seed=7), _drive_plan(seed=7)
+    assert a == b                     # same seed => same injected sequence
+    assert 0 < len(a) < 20            # probability actually gates
+    c = _drive_plan(seed=8)
+    assert a != c                     # different seed => different draws
+
+
+def test_fault_injection_connection_errors_counted():
+    before = counter("http", "faults_injected")
+    before_retry = counter("http", "retry")
+    with inject_faults([FaultSpec("connection_error",
+                                  match="conn.invalid")]) as plan:
+        resp = send_with_retries(HTTPRequest(url="http://conn.invalid/x"),
+                                 backoffs_ms=(1, 1), timeout_s=1.0)
+    assert resp.status_code == 0 and "injected" in resp.error
+    assert len(plan.injected) == 3  # initial attempt + 2 retries
+    assert counter("http", "faults_injected") == before + 3
+    assert counter("http", "retry") == before_retry + 2
+
+
+def test_fault_injection_429_retry_after_honored(ok_server):
+    before = counter("http", "retry")
+    with inject_faults([FaultSpec("status", status=429, retry_after=0,
+                                  times=2, match="/throttle")]) as plan:
+        resp = send_with_retries(HTTPRequest(url=f"{ok_server}/throttle"),
+                                 backoffs_ms=(1, 1, 1), timeout_s=5.0)
+    assert resp.status_code == 200          # survived the throttle window
+    assert [k for _, k, _ in plan.injected] == ["status", "status"]
+    assert counter("http", "retry") == before + 2
+
+
+def test_fault_injection_latency_and_blackhole(ok_server):
+    with inject_faults([FaultSpec("latency", latency_ms=40, times=1,
+                                  match="/slowpath")]):
+        t0 = time.monotonic()
+        resp = send_with_retries(HTTPRequest(url=f"{ok_server}/slowpath"),
+                                 backoffs_ms=(), timeout_s=5.0)
+        assert resp.status_code == 200
+        assert time.monotonic() - t0 >= 0.04  # latency added, then served
+    with inject_faults([FaultSpec("blackhole",
+                                  match="hole.invalid")]) as plan:
+        resp = send_with_retries(HTTPRequest(url="http://hole.invalid/x"),
+                                 backoffs_ms=(1,), timeout_s=1.0)
+        assert resp.status_code == 0 and "blackhole" in resp.error
+        assert len(plan.injected) == 2
+
+
+def test_inject_faults_refuses_nesting():
+    with inject_faults([FaultSpec("latency")]):
+        with pytest.raises(RuntimeError, match="already active"):
+            with inject_faults([FaultSpec("latency")]):
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Retry-After parsing (satellite: HTTP-date handling)
+# ---------------------------------------------------------------------------
+
+def test_retry_after_parses_seconds_dates_and_clamps():
+    assert _retry_after_ms("3") == 3000.0
+    assert _retry_after_ms(None) is None
+    assert _retry_after_ms("not a date") is None        # -> backoff schedule
+    assert _retry_after_ms("-5") == 0.0                 # negative clamps to 0
+    assert _retry_after_ms("99999") == RETRY_AFTER_CAP_MS  # absurd waits cap
+    # float('nan')/float('inf') parse without error but must not reach sleep
+    assert _retry_after_ms("nan") is None
+    assert _retry_after_ms("inf") is None
+    # HTTP-date in the past: zero wait, not a schedule fallback
+    assert _retry_after_ms("Wed, 21 Oct 2015 07:28:00 GMT") == 0.0
+    # HTTP-date ~10s out parses to roughly that wait
+    soon = formatdate(time.time() + 10, usegmt=True)
+    assert 5_000.0 <= _retry_after_ms(soon) <= 10_500.0
+
+
+# ---------------------------------------------------------------------------
+# services plane (satellite: backoffs threaded; LRO deadline)
+# ---------------------------------------------------------------------------
+
+def _ping_service(url: str, **params):
+    from synapseml_tpu.services.base import CognitiveServiceBase
+
+    class PingService(CognitiveServiceBase):
+        def build_request(self, rp):
+            return HTTPRequest(url=f"{self.get('url')}/ping")
+
+    return PingService(url=url, output_col="out", error_col="err", **params)
+
+
+def test_service_base_threads_backoffs_ms(ok_server):
+    df = DataFrame.from_dict({"x": np.asarray([1])})
+    # no-retry schedule: the single injected 503 surfaces as the row error
+    with inject_faults([FaultSpec("status", status=503, times=1,
+                                  match="/ping")]):
+        svc = _ping_service(ok_server, backoffs_ms=())
+        errs = list(svc.transform(df).collect_column("err"))
+    assert errs[0] and "503" in errs[0]
+    # with a schedule, the same fault is retried through to success — the
+    # param reaches the underlying AsyncHTTPClient (it used to be dropped)
+    with inject_faults([FaultSpec("status", status=503, times=1,
+                                  match="/ping")]):
+        svc = _ping_service(ok_server, backoffs_ms=(1, 1))
+        out = svc.transform(df)
+        assert list(out.collect_column("err"))[0] is None
+        assert list(out.collect_column("out"))[0] == {"port": int(ok_server.rsplit(":", 1)[1])}
+
+
+def test_lro_polling_respects_deadline():
+    """An LRO that never completes is cut off by lro_deadline_s, not left to
+    max_poll_attempts x interval."""
+
+    class LROHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _json(self, payload, status=200, headers=None):
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            if n:
+                self.rfile.read(n)
+            host = self.headers.get("Host")
+            self._json({"status": "accepted"}, status=202,
+                       headers={"Operation-Location": f"http://{host}/poll"})
+
+        def do_GET(self):
+            self._json({"status": "running"})  # never finishes
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), LROHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        from synapseml_tpu.services.base import HasAsyncReply
+
+        class SlowLRO(HasAsyncReply):
+            def build_request(self, rp):
+                return HTTPRequest(url=f"{self.get('url')}/start",
+                                   method="POST", entity=b"{}")
+
+        before = counter("services", "deadline_expired")
+        svc = SlowLRO(url=url, output_col="out", error_col="err",
+                      polling_interval_s=0.02, max_poll_attempts=10_000,
+                      lro_deadline_s=0.3)
+        df = DataFrame.from_dict({"x": np.asarray([1, 2])})
+        t0 = time.monotonic()
+        errs = list(svc.transform(df).collect_column("err"))
+        assert time.monotonic() - t0 < 5.0  # NOT 10k polls x 20ms
+        assert all(e for e in errs)  # rows carry the timeout error
+        assert counter("services", "deadline_expired") > before
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# parallel plane: deadline-bounded rendezvous
+# ---------------------------------------------------------------------------
+
+def test_worker_rendezvous_deadline_bounded():
+    from synapseml_tpu.parallel.backend import worker_rendezvous
+
+    before_r = counter("parallel", "retry")
+    before_d = counter("parallel", "deadline_expired")
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="rendezvous"):
+        worker_rendezvous("127.0.0.1:1", "exec0", 0, timeout_s=0.5,
+                          retry_interval_s=0.02)
+    assert time.monotonic() - t0 < 5.0
+    assert counter("parallel", "retry") > before_r
+    assert counter("parallel", "deadline_expired") == before_d + 1
+
+
+def test_worker_rendezvous_retries_until_late_driver():
+    from synapseml_tpu.parallel.backend import worker_rendezvous
+
+    port = _free_port()
+    reply = {"coordinator": "127.0.0.1:9999", "rank": 0, "world": 1}
+
+    def late_driver():
+        time.sleep(0.3)
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(1)
+        conn, _ = srv.accept()
+        conn.makefile("r").readline()
+        conn.sendall((json.dumps(reply) + "\n").encode())
+        conn.close()
+        srv.close()
+
+    before = counter("parallel", "retry")
+    threading.Thread(target=late_driver, daemon=True).start()
+    info = worker_rendezvous(f"127.0.0.1:{port}", "exec0", 0, timeout_s=30.0,
+                             retry_interval_s=0.05)
+    assert info == reply
+    assert counter("parallel", "retry") > before  # connect was retried
+
+
+# ---------------------------------------------------------------------------
+# RoutingFront / RoutingClient failure semantics (satellite coverage)
+# ---------------------------------------------------------------------------
+
+def _front_call(front, payload=b"{}", timeout=10):
+    req = urllib.request.Request(front.address, data=payload, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_routing_front_dead_marking_and_stats():
+    from synapseml_tpu.io.distributed_serving import RoutingFront
+
+    srv = _start_echo()
+    dead_port = _free_port()
+    front = RoutingFront([{"host": "127.0.0.1", "port": dead_port, "pid": 1},
+                          {"host": "127.0.0.1", "port": srv.server_address[1],
+                           "pid": 2}],
+                         timeout_s=5, resurrect_after_s=60)
+    before = counter("distributed_serving", "breaker_open")
+    try:
+        for _ in range(6):
+            status, body = _front_call(front)
+            assert status == 200
+            assert body["port"] == srv.server_address[1]
+        breaker = front._breaker(("127.0.0.1", dead_port))
+        assert breaker.state == breaker.OPEN  # connect failure tripped it
+        assert counter("distributed_serving", "breaker_open") >= before + 1
+        with urllib.request.urlopen(front.address + "/stats", timeout=10) as r:
+            stats = json.loads(r.read())
+        assert stats["breakers"][f"127.0.0.1:{dead_port}"] == "open"
+        for key in ("retry_count", "breaker_open_count",
+                    "deadline_expired_count", "faults_injected_count"):
+            assert key in stats["resilience"]
+    finally:
+        front.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_routing_front_time_based_resurrection():
+    from synapseml_tpu.io.distributed_serving import RoutingFront
+
+    port = _free_port()
+    live = _start_echo()
+    front = RoutingFront([{"host": "127.0.0.1", "port": port, "pid": 1},
+                          {"host": "127.0.0.1", "port": live.server_address[1],
+                           "pid": 2}],
+                         timeout_s=5, resurrect_after_s=0.3)
+    try:
+        for _ in range(4):
+            assert _front_call(front)[0] == 200
+        breaker = front._breaker(("127.0.0.1", port))
+        assert breaker.state == breaker.OPEN
+        revived = _start_echo(port)  # worker comes back on its old port
+        try:
+            time.sleep(0.4)  # past the resurrection window -> half-open probe
+            seen = {_front_call(front)[1]["port"] for _ in range(8)}
+            assert port in seen  # resurrected worker rejoined the rotation
+            assert breaker.state == breaker.CLOSED
+        finally:
+            revived.shutdown()
+            revived.server_close()
+    finally:
+        front.close()
+        live.shutdown()
+        live.server_close()
+
+
+def test_routing_front_all_dead_probes_least_recently_failed():
+    from synapseml_tpu.io.distributed_serving import RoutingFront
+
+    port_a, port_b = _free_port(), _free_port()
+    front = RoutingFront([{"host": "127.0.0.1", "port": port_a, "pid": 1},
+                          {"host": "127.0.0.1", "port": port_b, "pid": 2}],
+                         timeout_s=2, resurrect_after_s=300)
+    try:
+        req = urllib.request.Request(front.address, data=b"{}", method="POST")
+        with pytest.raises(urllib.error.HTTPError, match="503"):
+            urllib.request.urlopen(req, timeout=10)
+        # everything down, desperation probe failed too
+        br_a = front._breaker(("127.0.0.1", port_a))
+        br_b = front._breaker(("127.0.0.1", port_b))
+        assert br_a.state == br_a.OPEN and br_b.state == br_b.OPEN
+        # A becomes the stalest failure; bring ONLY A back up
+        br_a.last_failure_at = br_b.last_failure_at - 10.0
+        revived = _start_echo(port_a)
+        try:
+            status, body = _front_call(front)
+            assert status == 200 and body["port"] == port_a
+            assert br_a.state == br_a.CLOSED  # desperation success closed it
+            assert br_b.state == br_b.OPEN
+        finally:
+            revived.shutdown()
+            revived.server_close()
+    finally:
+        front.close()
+
+
+def test_routing_front_registry_refresh_routes_to_late_worker():
+    from synapseml_tpu.io.distributed_serving import RoutingFront, WorkerRegistry
+
+    registry = WorkerRegistry()
+    front = RoutingFront(registry=registry, timeout_s=5)
+    srv = _start_echo()
+    try:
+        req = urllib.request.Request(front.address, data=b"{}", method="POST")
+        with pytest.raises(urllib.error.HTTPError, match="503"):
+            urllib.request.urlopen(req, timeout=10)  # empty routing table
+        # a worker registers AFTER the front started: routed to immediately
+        info = {"host": "127.0.0.1", "port": srv.server_address[1], "pid": 7}
+        urllib.request.urlopen(urllib.request.Request(
+            registry.address + "/register", data=json.dumps(info).encode(),
+            method="POST"), timeout=10).read()
+        status, body = _front_call(front)
+        assert status == 200 and body["port"] == srv.server_address[1]
+        # a departed worker's breaker is pruned once it leaves the registry
+        # (respawn churn must not grow the breaker map forever)
+        ghost_port = _free_port()
+        ghost = {"host": "127.0.0.1", "port": ghost_port, "pid": 8}
+        urllib.request.urlopen(urllib.request.Request(
+            registry.address + "/register", data=json.dumps(ghost).encode(),
+            method="POST"), timeout=10).read()
+        for _ in range(4):  # routes to the ghost at least once -> breaker
+            assert _front_call(front)[0] == 200
+        assert ("127.0.0.1", ghost_port) in front._breakers
+        registry.remove_pid(8)
+        assert _front_call(front)[0] == 200  # table refresh prunes it
+        assert ("127.0.0.1", ghost_port) not in front._breakers
+    finally:
+        front.close()
+        registry.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_routing_client_breaker_skips_dead_worker():
+    from synapseml_tpu.io.distributed_serving import RoutingClient
+
+    srv = _start_echo()
+    dead_port = _free_port()
+    client = RoutingClient(workers=[
+        {"host": "127.0.0.1", "port": srv.server_address[1], "pid": 1},
+        {"host": "127.0.0.1", "port": dead_port, "pid": 2}],
+        timeout_s=2, resurrect_after_s=300)
+    try:
+        for _ in range(6):
+            status, payload = client.request("/", body=b"{}")
+            assert status == 200
+            assert json.loads(payload)["port"] == srv.server_address[1]
+        breaker = client._breaker(("127.0.0.1", dead_port))
+        assert breaker.state == breaker.OPEN  # marked dead after one failure
+    finally:
+        client.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+@pytest.mark.chaos(timeout_s=90)
+def test_routing_front_chaos_kill_two_of_three_with_resurrection():
+    """Kill 2 of 3 workers under traffic, then resurrect them: every request
+    (before, during, after) must succeed — zero permanently-failed requests —
+    and the resurrected workers must rejoin the rotation."""
+    from synapseml_tpu.io.distributed_serving import RoutingFront
+
+    servers = [_start_echo() for _ in range(3)]
+    ports = [s.server_address[1] for s in servers]
+    front = RoutingFront([{"host": "127.0.0.1", "port": p, "pid": i}
+                          for i, p in enumerate(ports)],
+                         timeout_s=5, resurrect_after_s=0.3)
+    statuses = []
+    try:
+        for _ in range(12):
+            status, body = _front_call(front)
+            statuses.append(status)
+        # kill workers 0 and 1 mid-stream
+        for s in servers[:2]:
+            s.shutdown()
+            s.server_close()
+        for _ in range(12):
+            status, body = _front_call(front)
+            statuses.append(status)
+            assert body["port"] == ports[2]  # survivor carries the traffic
+        # resurrect both on their old ports
+        revived = [_start_echo(p) for p in ports[:2]]
+        try:
+            time.sleep(0.4)
+            seen = set()
+            for _ in range(24):
+                status, body = _front_call(front)
+                statuses.append(status)
+                seen.add(body["port"])
+            assert seen == set(ports)  # all three serve again
+        finally:
+            for s in revived:
+                s.shutdown()
+                s.server_close()
+        assert statuses == [200] * len(statuses)  # zero failed requests
+    finally:
+        front.close()
+        servers[2].shutdown()
+        servers[2].server_close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: exported counter keys
+# ---------------------------------------------------------------------------
+
+def test_resilience_measures_export_counter_keys():
+    for plane in ("http", "distributed_serving", "services", "parallel"):
+        exported = resilience_measures(plane).to_dict()
+        for key in ("retry_count", "breaker_open_count",
+                    "deadline_expired_count", "faults_injected_count"):
+            assert key in exported, (plane, key)
