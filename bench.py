@@ -138,13 +138,20 @@ def run_bench(devices):
     }
     state = trainer.init_state(batch)
 
+    from synapseml_tpu.core.observability import get_registry
+
     _, state, _ = _timed_scan(trainer, state, batch, k)  # compile + warm
     overhead = _roundtrip_latency()
     trials = []
     loss = float("nan")
+    step_hist = get_registry().histogram(
+        "synapseml_train_step_duration_ms",
+        "training step (boosting iteration / optimizer step) wall time",
+        ("engine",)).labels(engine="flagship")
     for _ in range(3):
         t, state, loss = _timed_scan(trainer, state, batch, k)
         trials.append(t)
+        step_hist.observe(max(t - overhead, 0.0) / k * 1e3)
     step_s = max((min(trials) - overhead) / k, 1e-9)
     n_chips = jax.device_count()
     samples_per_sec_chip = B / step_s / n_chips
@@ -168,6 +175,10 @@ def run_bench(devices):
     peak = chip_peak_tflops(getattr(devices[0], "device_kind", "") or "")
     if on_tpu and peak:
         result["mfu"] = round(tflops / n_chips / peak, 4)
+        get_registry().gauge(
+            "synapseml_train_mfu",
+            "model FLOPs utilization vs chip_peak_tflops", ("engine",),
+        ).set(result["mfu"], engine="flagship")
     return result
 
 
@@ -192,6 +203,14 @@ def _child_main(platform: str, config: str) -> None:
         import importlib
 
         result = importlib.import_module(module).run(jax, plat, n_chips)
+    # every record carries the child's MetricsRegistry snapshot so the
+    # perf trajectory keeps full histograms (p50/p95/p99), not just means
+    try:
+        from synapseml_tpu.core.observability import get_registry
+
+        result["metrics"] = get_registry().snapshot()
+    except Exception as e:  # noqa: BLE001 — a metrics bug must not eat a
+        result["metrics"] = {"error": str(e)}  # scarce healthy TPU window
     print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 
@@ -310,7 +329,10 @@ def _seed_baseline(result: dict, recorded: dict) -> bool:
     """
     if result.get("platform") not in ("tpu",) or not result.get("value"):
         return False
-    entry = {k: v for k, v in result.items() if k not in ("vs_baseline", "reason")}
+    # "metrics" (the registry snapshot) stays in the BENCH record but NOT in
+    # the baseline file — baselines hold the comparison scalar only
+    entry = {k: v for k, v in result.items()
+             if k not in ("vs_baseline", "reason", "metrics")}
     entry["measured"] = "round 4+ driver bench rotation"
     import fcntl
 

@@ -1,5 +1,18 @@
 from .dataframe import DataFrame, Partition, concat_partitions, schema_of
 from .faults import FaultPlan, FaultSpec, active_fault_plan, inject_faults
+from .observability import (
+    MetricsRegistry,
+    Span,
+    SpanContext,
+    Tracer,
+    chrome_trace_events,
+    export_chrome_trace,
+    get_registry,
+    get_tracer,
+    register_instrumentation,
+    reset_registry,
+    reset_tracer,
+)
 from .params import ComplexParam, GlobalParams, Param, Params, ServiceParam, TypeConverters
 from .pipeline import Estimator, Model, Pipeline, PipelineModel, PipelineStage, Transformer, load_stage
 from .resilience import (
@@ -22,4 +35,8 @@ __all__ = [
     "RetryPolicy", "RetryBudget", "CircuitBreaker", "Deadline", "DeadlineExpired",
     "resilience_measures", "reset_resilience_measures", "all_resilience_measures",
     "FaultPlan", "FaultSpec", "inject_faults", "active_fault_plan",
+    "MetricsRegistry", "get_registry", "reset_registry",
+    "register_instrumentation",
+    "Tracer", "Span", "SpanContext", "get_tracer", "reset_tracer",
+    "chrome_trace_events", "export_chrome_trace",
 ]

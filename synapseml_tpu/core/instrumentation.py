@@ -47,9 +47,11 @@ class InstrumentationMeasures:
         self._phases: dict[str, float] = {}
         self._counts: dict[str, int] = {}
         self._marks: dict[str, float] = {}
-        # counters are bumped from serving/executor threads (the resilience
-        # planes share one collector per plane): guard the read-modify-write
-        self._count_lock = threading.Lock()
+        # every mutation is bumped from serving/executor threads (the
+        # resilience planes share one collector per plane): ONE lock guards
+        # phases, marks AND counts — measure()/mark() racing count() was a
+        # real lost-update hole when threads shared a plane collector
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def measure(self, name: str) -> Iterator[None]:
@@ -57,23 +59,31 @@ class InstrumentationMeasures:
         try:
             yield
         finally:
-            self._phases[name] = (self._phases.get(name, 0.0)
-                                  + (time.perf_counter() - start) * 1e3)
+            elapsed_ms = (time.perf_counter() - start) * 1e3
+            with self._lock:
+                self._phases[name] = self._phases.get(name, 0.0) + elapsed_ms
 
     def mark(self, name: str) -> None:
-        self._marks[name] = (time.perf_counter() - self._t0) * 1e3
+        at_ms = (time.perf_counter() - self._t0) * 1e3
+        with self._lock:
+            self._marks[name] = at_ms
 
     def count(self, name: str, n: int = 1) -> None:
-        with self._count_lock:
+        with self._lock:
             self._counts[name] = self._counts.get(name, 0) + n
 
     def phase_ms(self, name: str) -> float:
-        return self._phases.get(name, 0.0)
+        with self._lock:
+            return self._phases.get(name, 0.0)
 
     def to_dict(self) -> dict:
-        out = {f"{k}_ms": round(v, 3) for k, v in self._phases.items()}
-        out.update({f"{k}_count": v for k, v in self._counts.items()})
-        out.update({f"{k}_at_ms": round(v, 3) for k, v in self._marks.items()})
+        with self._lock:  # snapshot under the lock: a half-applied measure()
+            phases = dict(self._phases)  # must never tear the export
+            counts = dict(self._counts)
+            marks = dict(self._marks)
+        out = {f"{k}_ms": round(v, 3) for k, v in phases.items()}
+        out.update({f"{k}_count": v for k, v in counts.items()})
+        out.update({f"{k}_at_ms": round(v, 3) for k, v in marks.items()})
         out["total_ms"] = round((time.perf_counter() - self._t0) * 1e3, 3)
         return out
 

@@ -17,14 +17,26 @@ from typing import Any
 
 logger = logging.getLogger("synapseml_tpu")
 
+_SECRET_WORDS = r"(?:sig|key|token|secret|password|authorization|api[-_]?key)"
 _SECRET_PAT = re.compile(
-    r"(?i)(sig|key|token|secret|password|authorization|api[-_]?key)=([^&\s\"]+)")
+    rf"(?i){_SECRET_WORDS}=[^&\s\"]+")
+# JSON-style key/value pairs: the query-string pattern above only matched
+# `key=value`, so `"apiKey": "abc"` / `"Ocp-Apim-Subscription-Key": "..."`
+# sailed through log_stage_event and the telemetry sinks unscrubbed. Matches
+# a quoted key CONTAINING a secret word followed by a quoted string value or
+# a bare scalar (number / null / unquoted token).
+_JSON_SECRET_PAT = re.compile(
+    rf"(?i)(\"[^\"]*{_SECRET_WORDS}[^\"]*\"\s*:\s*)(\"(?:[^\"\\]|\\.)*\"|[^,}}\]\s]+)")
 _BEARER_PAT = re.compile(r"(?i)bearer\s+[a-z0-9\-_\.=]+")
 
 
 def scrub(text: str) -> str:
-    """Strip secrets out of log payloads (reference ``SASScrubber``)."""
-    text = _SECRET_PAT.sub(lambda m: f"{m.group(1)}=####", text)
+    """Strip secrets out of log payloads (reference ``SASScrubber``):
+    query-string pairs (``sig=...``), JSON pairs (``"apiKey": "..."``,
+    ``"Ocp-Apim-Subscription-Key": ...``) and bearer tokens."""
+    text = _SECRET_PAT.sub(
+        lambda m: m.group(0).split("=", 1)[0] + "=####", text)
+    text = _JSON_SECRET_PAT.sub(lambda m: m.group(1) + '"####"', text)
     return _BEARER_PAT.sub("Bearer ####", text)
 
 
@@ -58,7 +70,13 @@ def log_stage_event(payload: dict) -> None:
 
 
 class StageTelemetry:
-    """Mixin providing log_fit / log_transform / log_verb wrappers."""
+    """Mixin providing log_fit / log_transform / log_verb wrappers.
+
+    Every verb now ALSO lands on the unified observability plane
+    (``core/observability.py``): a ``synapseml_stage_duration_ms`` histogram
+    sample + event counter, and one trace span per fit/transform — so a
+    ``Pipeline`` fit renders as a span tree (pipeline span -> per-stage
+    spans) in the Chrome/Perfetto export."""
 
     feature_name: str = "core"
 
@@ -78,11 +96,22 @@ class StageTelemetry:
         log_stage_event(payload)
 
     def log_verb(self, method: str, fn, *args, **kwargs):
+        from . import observability as obs
+
+        tracer = obs.get_tracer()
+        cls = type(self).__name__
         t0 = time.perf_counter()
-        try:
-            out = fn(*args, **kwargs)
-        except BaseException as e:
-            self._emit(method, (time.perf_counter() - t0) * 1e3, error=e)
-            raise
-        self._emit(method, (time.perf_counter() - t0) * 1e3)
+        with tracer.span(f"{cls}.{method}",
+                         {"uid": getattr(self, "uid", "?"),
+                          "featureName": self.feature_name}):
+            try:
+                out = fn(*args, **kwargs)
+            except BaseException as e:
+                dt = (time.perf_counter() - t0) * 1e3
+                obs.observe_stage(cls, method, dt, error=True)
+                self._emit(method, dt, error=e)
+                raise
+        dt = (time.perf_counter() - t0) * 1e3
+        obs.observe_stage(cls, method, dt)
+        self._emit(method, dt)
         return out

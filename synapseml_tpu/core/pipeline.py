@@ -21,6 +21,7 @@ from typing import Sequence
 from .dataframe import DataFrame
 from .logging import StageTelemetry
 from .params import ComplexParam, Param, Params
+from . import observability as _obs
 from . import serialization
 
 __all__ = ["PipelineStage", "Transformer", "Estimator", "Model", "Pipeline", "PipelineModel", "load_stage"]
@@ -92,18 +93,25 @@ class Pipeline(Estimator):
         fitted: list[Transformer] = []
         cur = df
         stages = self.get("stages") or []
+        tracer = _obs.get_tracer()
         for i, stage in enumerate(stages):
-            if isinstance(stage, Estimator):
-                model = stage.fit(cur)
-                fitted.append(model)
-                if i < len(stages) - 1:
-                    cur = model.transform(cur)
-            elif isinstance(stage, Transformer):
-                fitted.append(stage)
-                if i < len(stages) - 1:
-                    cur = stage.transform(cur)
-            else:
-                raise TypeError(f"pipeline stage {stage!r} is neither Estimator nor Transformer")
+            # one span per pipeline slot (the stage's own fit/transform span
+            # nests inside): a Pipeline fit exports as a span TREE —
+            # Pipeline.fit -> pipeline.stage[i] -> Stage.fit/transform
+            with tracer.span(f"pipeline.stage[{i}]",
+                             {"stage": type(stage).__name__,
+                              "uid": getattr(stage, "uid", "?")}):
+                if isinstance(stage, Estimator):
+                    model = stage.fit(cur)
+                    fitted.append(model)
+                    if i < len(stages) - 1:
+                        cur = model.transform(cur)
+                elif isinstance(stage, Transformer):
+                    fitted.append(stage)
+                    if i < len(stages) - 1:
+                        cur = stage.transform(cur)
+                else:
+                    raise TypeError(f"pipeline stage {stage!r} is neither Estimator nor Transformer")
         return PipelineModel(stages=fitted)
 
     # persistence: stages are saved as numbered sub-directories
@@ -125,8 +133,12 @@ class PipelineModel(Model):
 
     def _transform(self, df: DataFrame) -> DataFrame:
         cur = df
-        for stage in self.get("stages") or []:
-            cur = stage.transform(cur)
+        tracer = _obs.get_tracer()
+        for i, stage in enumerate(self.get("stages") or []):
+            with tracer.span(f"pipeline.stage[{i}]",
+                             {"stage": type(stage).__name__,
+                              "uid": getattr(stage, "uid", "?")}):
+                cur = stage.transform(cur)
         return cur
 
     def save(self, path: str, overwrite: bool = True) -> None:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Callable, Sequence
 
 import jax
@@ -294,6 +295,15 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         from ..core.instrumentation import InstrumentationMeasures
 
         measures = InstrumentationMeasures()
+    from ..core import observability as _obs
+
+    # per-iteration step times feed the unified metrics plane so the bench
+    # trajectory carries a p50/p95/p99 distribution, not just the summed
+    # `training_ms` window
+    step_hist = _obs.get_registry().histogram(
+        "synapseml_train_step_duration_ms",
+        "training step (boosting iteration / optimizer step) wall time",
+        ("engine",)).labels(engine="gbdt")
     # keep the caller's dtype: float32 input takes the multithreaded native
     # binning path (BinMapper.transform); boundary FITTING widens to float64
     # inside BinMapper either way, so bin codes are dtype-independent
@@ -609,10 +619,14 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
                                 (scores, vscores),
                                 jnp.arange(num_iterations, dtype=jnp.int32))
 
+        t_scan = time.perf_counter()
         with measures.measure("training"):
             (scores, vscores), trees = run_all(data, scores, vscores)
             jax.block_until_ready(trees.feature)
         measures.count("iterations", num_iterations)
+        # the whole run is one dispatch: record the amortized per-step time
+        step_hist.observe((time.perf_counter() - t_scan) * 1e3
+                          / max(num_iterations, 1))
         feat_dev, thr_dev = trees.feature, trees.threshold_bin   # (T, K, M)
         val_dev, gain_dev, cover_dev = trees.leaf_value, trees.gain, trees.cover
         cat_dev = trees.cat_mask
@@ -634,6 +648,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
             best_v = list(acc_v)
 
         for it in range(num_iterations):
+            t_iter = time.perf_counter()
             dropped: list[int] = []
             if acc_f and drop_rng.random() >= skip_drop:
                 mask = drop_rng.random(len(acc_f)) < drop_rate
@@ -683,6 +698,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
             acc_g.append(trees.gain)
             acc_c.append(trees.cover)
             acc_cm.append(trees.cat_mask)
+            step_hist.observe((time.perf_counter() - t_iter) * 1e3)
             if callbacks:
                 for cb in callbacks:
                     cb(iteration=it, scores=scores)
@@ -706,9 +722,11 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
         acc_f, acc_t, acc_v, acc_g, acc_c, acc_cm = [], [], [], [], [], []
         for it in range(num_iterations):
             measures.count("iterations")
+            t_iter = time.perf_counter()
             with measures.measure("training"):
                 (scores, vscores), trees = iter_jit(
                     data, (scores, vscores), jnp.asarray(it, jnp.int32))
+            step_hist.observe((time.perf_counter() - t_iter) * 1e3)
             # device arrays accumulate WITHOUT host sync; fetched once at the end
             acc_f.append(trees.feature)
             acc_t.append(trees.threshold_bin)

@@ -27,16 +27,74 @@ import subprocess
 import sys
 import threading
 import time
+import itertools
 import urllib.error
 import urllib.request
+import weakref
 from http.server import BaseHTTPRequestHandler
 
 from ..core import faults as _faults
+from ..core import observability as obs
 from ..core.resilience import CircuitBreaker, resilience_measures
 from .serving import NoDelayHTTPServer
 
 __all__ = ["WorkerRegistry", "RoutingFront", "RoutingClient",
-           "serve_pipeline_distributed", "worker_main"]
+           "serve_pipeline_distributed", "worker_main",
+           "collect_distributed_trace"]
+
+_BREAKER_STATE_NUM = {CircuitBreaker.CLOSED: 0.0,
+                      CircuitBreaker.HALF_OPEN: 1.0,
+                      CircuitBreaker.OPEN: 2.0}
+
+# distinct label per RoutingFront/RoutingClient instance: two live owners
+# sharing a worker endpoint must not emit duplicate series (a Prometheus
+# scrape rejects identical label sets)
+_BREAKER_OWNER_IDS = itertools.count(1)
+
+
+def _register_breaker_gauge(owner, plane: str) -> None:
+    """Pull-time ``synapseml_breaker_state`` gauge per worker endpoint
+    (0=closed, 1=half-open, 2=open) for a RoutingFront/RoutingClient.
+    Weakref'd: a collected owner silently stops exporting."""
+    ref = weakref.ref(owner)
+    reg = obs.get_registry()
+    instance = str(next(_BREAKER_OWNER_IDS))
+
+    def collect():
+        o = ref()
+        if o is None:  # owner collected: self-unregister so a long session
+            reg.unregister_collector(collect)  # doesn't accumulate dead fns
+            return
+        for endpoint, state in o.breaker_states().items():
+            yield obs.Sample(
+                "synapseml_breaker_state",
+                {"plane": plane, "endpoint": endpoint, "instance": instance},
+                _BREAKER_STATE_NUM.get(state, -1.0),
+                help="per-worker circuit breaker state "
+                     "(0=closed, 1=half-open, 2=open)")
+
+    reg.register_collector(collect)
+
+
+# hot routing-path metric handles (see HandleCache: one identity check per
+# request instead of registry get-or-create lock traffic)
+_ROUTE_METRICS = obs.HandleCache(lambda reg: {
+    "pick_ms": reg.histogram(
+        "synapseml_route_pick_ms",
+        "time to pick the first candidate worker").labels(),
+    "retries": reg.counter(
+        "synapseml_route_retries_total",
+        "rerouted forwards after a worker failure").labels(),
+    "worker_failures": reg.counter(
+        "synapseml_route_worker_failures_total",
+        "forward attempts that failed, per worker", ("worker",)),
+    "request_ms": reg.histogram(
+        "synapseml_route_request_duration_ms",
+        "routed request latency, per worker", ("worker",)),
+    "unroutable": reg.counter(
+        "synapseml_route_unroutable_total",
+        "requests that exhausted every worker").labels(),
+})
 
 
 def _nodelay_connection(host: str, port: int,
@@ -269,8 +327,34 @@ class RoutingFront:
                     self._reply(200, stats,
                                 {"Content-Type": "application/json"})
                     return
+                # GET-gated like io/serving.py: a POST to a pipeline path
+                # that happens to be named /metrics still forwards
+                if method == "GET" and self.path == "/metrics":
+                    payload, ctype = obs.prometheus_exposition()
+                    self._reply(200, payload, {"Content-Type": ctype})
+                    return
+                if method == "GET" and self.path == "/trace":
+                    payload = json.dumps(
+                        obs.get_tracer().spans_as_dicts()).encode()
+                    self._reply(200, payload,
+                                {"Content-Type": "application/json"})
+                    return
+                tracer = obs.get_tracer()
+                parent = obs.extract_context(self.headers)
+                with tracer.span("route.request",
+                                 {"path": self.path, "method": method},
+                                 parent=parent):
+                    self._route(method, body)
+
+            def _route(self, method: str, body) -> None:
+                rm = _ROUTE_METRICS.get()
                 hdrs = {k: v for k, v in self.headers.items()
-                        if k.lower() not in ("host", "connection")}
+                        if k.lower() not in ("host", "connection",
+                                             "traceparent")}
+                # stitch the forwarded hop to the route.request span: the
+                # worker's serving.request span becomes its child
+                obs.get_tracer().inject(hdrs)
+                t0 = time.perf_counter()
                 candidates, desperate = front._candidates()
                 tried = 0
                 for w in candidates:
@@ -280,19 +364,31 @@ class RoutingFront:
                         continue  # raced shut since the candidate list
                     if tried:  # rerouting after a failure = one retry
                         resilience_measures("distributed_serving").count("retry")
+                        rm["retries"].inc()
+                    else:
+                        # worker pick = table refresh + breaker filtering +
+                        # rotation, before the first byte is forwarded
+                        rm["pick_ms"].observe(
+                            (time.perf_counter() - t0) * 1e3)
                     tried += 1
+                    endpoint = f"{key[0]}:{key[1]}"
+                    fwd0 = time.perf_counter()
                     try:
                         got = _pooled_request(front._pool, key, method,
                                               self.path, body, hdrs)
                     except (http.client.HTTPException, OSError):
                         breaker.record_failure()
                         front._pool.clear(key)
+                        rm["worker_failures"].inc(worker=endpoint)
                         continue
                     status, payload = got
                     breaker.record_success()  # proven alive
+                    rm["request_ms"].observe(
+                        (time.perf_counter() - fwd0) * 1e3, worker=endpoint)
                     self._reply(status, payload,
                                 {"X-Served-By": str(w.get("pid", ""))})
                     return
+                rm["unroutable"].inc()
                 self._reply(503)
 
             def do_GET(self):
@@ -303,6 +399,7 @@ class RoutingFront:
 
         self._server = NoDelayHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._server.server_address[1]
+        _register_breaker_gauge(self, plane="front")
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -396,6 +493,7 @@ class RoutingClient:
         self._timeout_s = timeout_s
         self._resurrect_after_s = resurrect_after_s
         self._breakers: dict[tuple, CircuitBreaker] = {}
+        _register_breaker_gauge(self, plane="client")
         if self._front is not None:
             self.refresh()
 
@@ -410,6 +508,12 @@ class RoutingClient:
                     name=f"client {key[0]}:{key[1]}")
                 self._breakers[key] = breaker
             return breaker
+
+    def breaker_states(self) -> dict:
+        """(host:port -> breaker state) snapshot, mirroring the front's."""
+        with self._lock:
+            return {f"{h}:{p}": br.state
+                    for (h, p), br in self._breakers.items()}
 
     def refresh(self) -> list[dict]:
         if self._front is not None:
@@ -428,8 +532,18 @@ class RoutingClient:
     def request(self, path: str, body: bytes | None = None,
                 method: str | None = None, headers: dict | None = None):
         """(status, payload) from the next worker in rotation; a worker
-        failure rotates on (with a table refresh) before giving up."""
+        failure rotates on (with a table refresh) before giving up. Each
+        request runs in one ``route.client`` span whose context is injected
+        as ``traceparent`` so the worker's serving span joins the trace."""
         method = method or ("POST" if body is not None else "GET")
+        tracer = obs.get_tracer()
+        with tracer.span("route.client", {"path": path, "method": method}):
+            headers = dict(headers or {})
+            tracer.inject(headers)
+            return self._request_routed(path, body, method, headers)
+
+    def _request_routed(self, path: str, body, method: str, headers: dict):
+        rm = _ROUTE_METRICS.get()
         with self._lock:
             table = list(self._workers)
             self._rr += 1
@@ -446,10 +560,13 @@ class RoutingClient:
             if tried:
                 resilience_measures("distributed_serving").count("retry")
             tried += 1
+            t0 = time.perf_counter()
             try:
                 result = _pooled_request(self._pool, key, method, path, body,
                                          headers)
                 breaker.record_success()
+                rm["request_ms"].observe((time.perf_counter() - t0) * 1e3,
+                                         worker=f"{key[0]}:{key[1]}")
                 return result
             except (http.client.HTTPException, OSError) as e:
                 breaker.record_failure()
@@ -617,6 +734,31 @@ def serve_pipeline_distributed(pipeline, num_workers: int = 2,
         raise
     front = RoutingFront(registry=registry)
     return DistributedServing(front, registry, procs, path, spawn=spawn)
+
+
+def collect_distributed_trace(front_address: str,
+                              timeout_s: float = 10.0) -> list[dict]:
+    """Stitch one multi-process trace: the front process's spans
+    (``GET /trace`` served by the front itself) + every live worker's spans
+    (``GET /trace`` on each endpoint from ``/routes``). Returns a flat list
+    of span dicts — feed it to
+    :func:`~synapseml_tpu.core.observability.chrome_trace_events` /
+    ``export_chrome_trace`` for one Perfetto-loadable timeline."""
+    spans: list[dict] = []
+    with urllib.request.urlopen(front_address + "/trace",
+                                timeout=timeout_s) as r:
+        spans.extend(json.loads(r.read()))
+    with urllib.request.urlopen(front_address + "/routes",
+                                timeout=timeout_s) as r:
+        table = json.loads(r.read())
+    for w in table:
+        url = f"http://{w.get('host')}:{w.get('port')}/trace"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as r:
+                spans.extend(json.loads(r.read()))
+        except (urllib.error.URLError, OSError):
+            continue  # a dead worker's spans are simply missing
+    return spans
 
 
 def _free_port() -> int:

@@ -32,6 +32,7 @@ import urllib.request
 import numpy as np
 
 from ..core import faults as _faults
+from ..core import observability as obs
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Transformer
@@ -41,6 +42,21 @@ from ..core.resilience import (
     RetryPolicy,
     resilience_measures,
 )
+
+
+# hot-path metric handles, re-resolved only when the registry is replaced
+_HTTP_METRICS = obs.HandleCache(lambda reg: {
+    "retries": reg.counter(
+        "synapseml_http_retries_total",
+        "client retries by plane and trigger (HTTP status or 'connect')",
+        ("plane", "status")),
+    "request_ms": reg.histogram(
+        "synapseml_http_request_duration_ms",
+        "send_with_retries total latency (all attempts)", ("method",)),
+    "requests": reg.counter(
+        "synapseml_http_requests_total",
+        "send_with_retries outcomes by status class", ("method", "status")),
+})
 
 __all__ = ["HTTPRequest", "HTTPResponse", "send_with_retries", "AsyncHTTPClient",
            "HTTPTransformer", "SimpleHTTPTransformer", "JSONInputParser",
@@ -123,7 +139,8 @@ def _urlopen(request: HTTPRequest, timeout_s: float):
 def send_with_retries(request: HTTPRequest, backoffs_ms=(100, 500, 1000),
                       timeout_s: float = 60.0,
                       policy: RetryPolicy | None = None,
-                      deadline: Deadline | None = None) -> HTTPResponse:
+                      deadline: Deadline | None = None,
+                      trace_parent=None) -> HTTPResponse:
     """(ref ``HandlingUtils.advancedUDF`` — retry on 429/5xx with jittered
     backoff, honoring Retry-After.) Network errors after the last retry return
     a response row with ``error`` set rather than raising (errors-as-data,
@@ -133,7 +150,46 @@ def send_with_retries(request: HTTPRequest, backoffs_ms=(100, 500, 1000),
     optional retry budget — when the budget is drained the call fails fast
     instead of amplifying a storm. ``deadline`` caps every attempt's timeout
     by the remaining total budget; on expiry the last known response/error is
-    returned with ``deadline_expired`` counted."""
+    returned with ``deadline_expired`` counted.
+
+    Observability: the whole call (all attempts) runs in one ``http.request``
+    span — ``trace_parent`` (a ``SpanContext``) pins it to the caller's trace
+    when the send happens on a pool thread — its context is injected as a
+    W3C ``traceparent`` header, the total latency lands in the
+    ``synapseml_http_request_duration_ms`` histogram, and every retry counts
+    on ``synapseml_http_retries_total`` by trigger status."""
+    tracer = obs.get_tracer()
+    t0 = time.perf_counter()
+    resp = None
+    try:
+        with tracer.span("http.request",
+                         {"url": request.url, "method": request.method},
+                         parent=trace_parent):
+            hdrs = dict(request.headers)
+            tracer.inject(hdrs)
+            request = dataclasses.replace(request, headers=hdrs)
+            resp = _send_with_retries(request, backoffs_ms, timeout_s, policy,
+                                      deadline)
+        return resp
+    finally:
+        # metric emission in finally: an unexpected exception (bad scheme,
+        # a bug below) must not let requests_total diverge from span counts
+        m = _HTTP_METRICS.get()
+        m["request_ms"].observe((time.perf_counter() - t0) * 1e3,
+                                method=request.method)
+        if resp is None:
+            status = "exception"
+        elif resp.status_code:
+            status = f"{resp.status_code // 100}xx"
+        else:
+            status = ("deadline" if "deadline" in (resp.reason or "")
+                      else "error")
+        m["requests"].inc(method=request.method, status=status)
+
+
+def _send_with_retries(request: HTTPRequest, backoffs_ms, timeout_s: float,
+                       policy: RetryPolicy | None,
+                       deadline: Deadline | None) -> HTTPResponse:
     policy = policy if policy is not None \
         else RetryPolicy(backoffs_ms=tuple(backoffs_ms))
     m = resilience_measures("http")
@@ -164,6 +220,8 @@ def send_with_retries(request: HTTPRequest, backoffs_ms=(100, 500, 1000),
                     m.count("deadline_expired")
                 elif policy.acquire_retry():
                     m.count("retry")
+                    _HTTP_METRICS.get()["retries"].inc(plane="http",
+                                                status=str(e.code))
                     time.sleep(wait_ms / 1000.0)
                     last_err = e
                     continue
@@ -178,6 +236,8 @@ def send_with_retries(request: HTTPRequest, backoffs_ms=(100, 500, 1000),
                     m.count("deadline_expired")
                 elif policy.acquire_retry():
                     m.count("retry")
+                    _HTTP_METRICS.get()["retries"].inc(plane="http",
+                                                status="connect")
                     time.sleep(wait_ms / 1000.0)
                     continue
             return HTTPResponse(status_code=0, reason="connection error",
@@ -226,9 +286,13 @@ class AsyncHTTPClient:
                  deadline: Deadline | None = None) -> list[HTTPResponse | None]:
         pool = self._executor()
         deadline = deadline if deadline is not None else self.deadline
+        # capture the calling thread's span context so the pool threads'
+        # http.request spans stay in the caller's trace (thread-local
+        # context does not cross the executor boundary by itself)
+        parent = obs.get_tracer().current_context()
         futures = [None if r is None else
                    pool.submit(send_with_retries, r, self.backoffs_ms,
-                               self.timeout_s, self.policy, deadline)
+                               self.timeout_s, self.policy, deadline, parent)
                    for r in requests]
         return [None if f is None else f.result() for f in futures]
 

@@ -19,15 +19,30 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import time
 import uuid
 import socket
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..core import observability as obs
 from ..core.dataframe import DataFrame
 
 __all__ = ["ServingServer", "serve_pipeline", "NoDelayHTTPServer"]
+
+# hot-path metric handles, re-resolved only when the registry is replaced
+_SERVING_METRICS = obs.HandleCache(lambda reg: {
+    "request_ms": reg.histogram(
+        "synapseml_serving_request_duration_ms",
+        "worker HTTP request latency", ("method",)),
+    "requests": reg.counter(
+        "synapseml_serving_requests_total",
+        "worker HTTP requests by status class", ("method", "status")),
+    "queue_wait": reg.histogram(
+        "synapseml_serving_queue_wait_ms",
+        "request time spent queued before batch pickup").labels(),
+})
 
 
 class NoDelayHTTPServer(ThreadingHTTPServer):
@@ -51,6 +66,7 @@ class _Exchange:
         self.path = path
         self.headers = headers
         self.body = body
+        self.enqueued_at = time.perf_counter()  # queue-wait measurement
         self.reply_event = threading.Event()
         self.reply_body: bytes = b""
         self.reply_status: int = 200
@@ -91,9 +107,49 @@ class ServingServer:
             def log_message(self, *a):  # quiet
                 pass
 
+            def _reply_bytes(self, status: int, payload: bytes,
+                             content_type: str | None = None) -> None:
+                self.send_response(status)
+                if content_type:
+                    self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                if payload:
+                    self.wfile.write(payload)
+
             def _handle(self, method: str):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                if method == "GET" and self.path == "/metrics":
+                    payload, ctype = obs.prometheus_exposition()
+                    self._reply_bytes(200, payload, ctype)
+                    return
+                if method == "GET" and self.path == "/trace":
+                    payload = json.dumps(
+                        obs.get_tracer().spans_as_dicts()).encode()
+                    self._reply_bytes(200, payload, "application/json")
+                    return
+                # one span per served request, stitched to the caller's trace
+                # via the W3C traceparent header the RoutingFront injects
+                tracer = obs.get_tracer()
+                parent = obs.extract_context(self.headers)
+                t0 = time.perf_counter()
+                status = None  # stays None when _exchange raises -> "error"
+                try:
+                    with tracer.span("serving.request",
+                                     {"path": self.path, "method": method},
+                                     parent=parent):
+                        status = self._exchange(method, body)
+                finally:
+                    dur_ms = (time.perf_counter() - t0) * 1e3
+                    m = _SERVING_METRICS.get()
+                    m["request_ms"].observe(dur_ms, method=method)
+                    m["requests"].inc(
+                        method=method,
+                        status=(f"{status // 100}xx" if status is not None
+                                else "error"))
+
+            def _exchange(self, method: str, body: bytes) -> int:
                 ex = _Exchange(uuid.uuid4().hex, method, self.path,
                                dict(self.headers), body)
                 with outer._lock:
@@ -106,7 +162,7 @@ class ServingServer:
                     self.send_response(503)  # shed load under backpressure
                     self.send_header("Content-Length", "0")
                     self.end_headers()
-                    return
+                    return 503
                 ok = ex.reply_event.wait(outer.reply_timeout_s)
                 with outer._lock:
                     outer._pending.pop(ex.request_id, None)
@@ -114,7 +170,7 @@ class ServingServer:
                     self.send_response(504)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
-                    return
+                    return 504
                 self.send_response(ex.reply_status)
                 for k, v in ex.reply_headers.items():
                     if k.lower() != "content-length":  # we set the real one
@@ -122,6 +178,7 @@ class ServingServer:
                 self.send_header("Content-Length", str(len(ex.reply_body)))
                 self.end_headers()
                 self.wfile.write(ex.reply_body)
+                return ex.reply_status
 
             def do_GET(self):
                 self._handle("GET")
@@ -159,6 +216,13 @@ class ServingServer:
                 exchanges.append(self._queue.get_nowait())
         except queue.Empty:
             pass
+        if exchanges:
+            # queue wait = enqueue -> drained into a batch (the micro-batch
+            # scheduling delay, distinct from transform time)
+            qw = _SERVING_METRICS.get()["queue_wait"]
+            now = time.perf_counter()
+            for e in exchanges:
+                qw.observe((now - e.enqueued_at) * 1e3)
         if not exchanges:
             # schema'd empty batch (not an empty-dict partition, which breaks
             # downstream schema checks)

@@ -550,6 +550,8 @@ class _ThroughputMeter:
         dev = jax.devices()[0]
         self.peak = (chip_peak_tflops(getattr(dev, "device_kind", "") or "")
                      if dev.platform == "tpu" else None)
+        self._last_t = self.t0
+        self._last_steps = 0
 
     def observe(self, batch: dict, steps: int) -> None:
         """``batch`` leaves are (B, ...) when steps==1, (K, B, ...) stacked
@@ -571,7 +573,32 @@ class _ThroughputMeter:
             if self.peak:
                 out["mfu"] = round(out["model_tflops_per_sec"]
                                    / jax.device_count() / self.peak, 4)
+        self._export(out)
         return out
+
+    def _export(self, out: dict) -> None:
+        """Push each logging window onto the unified metrics plane: the
+        window-average step time feeds the step histogram (p50/p95/p99 over
+        the whole fit), MFU/throughput land as gauges."""
+        from ..core import observability as obs
+
+        now = time.perf_counter()
+        dsteps = self.steps - self._last_steps
+        reg = obs.get_registry()
+        if dsteps > 0:
+            reg.histogram(
+                "synapseml_train_step_duration_ms",
+                "training step (boosting iteration / optimizer step) wall "
+                "time", ("engine",),
+            ).observe((now - self._last_t) * 1e3 / dsteps, engine="trainer")
+        self._last_t, self._last_steps = now, self.steps
+        reg.gauge("synapseml_train_samples_per_sec",
+                  "fit-loop throughput", ("engine",)
+                  ).set(out["samples_per_sec"], engine="trainer")
+        if "mfu" in out:
+            reg.gauge("synapseml_train_mfu",
+                      "model FLOPs utilization vs chip_peak_tflops",
+                      ("engine",)).set(out["mfu"], engine="trainer")
 
 
 def plan_fit(n: int, batch_size: int, epochs: int, max_steps: int) -> tuple[int, int]:

@@ -97,6 +97,29 @@ def worker_rendezvous(driver_address: str, executor_id: str, partition_id: int,
     timeout is capped by the remaining budget, so a hung coordinator can
     never stall a worker past ``timeout_s`` total. Retries and expiries are
     counted on ``resilience_measures("parallel")``."""
+    from ..core import observability as obs
+
+    with obs.get_tracer().span("parallel.rendezvous",
+                               {"driver": driver_address,
+                                "partition_id": partition_id}):
+        t0 = time.perf_counter()
+        try:
+            info = _worker_rendezvous(driver_address, executor_id,
+                                      partition_id, timeout_s,
+                                      retry_interval_s, policy, deadline)
+        finally:
+            # rendezvous wall time — connect retries included — is the
+            # startup tax every MPMD/DP launch pays before step 0
+            obs.get_registry().histogram(
+                "synapseml_rendezvous_duration_ms",
+                "worker rendezvous wall time (connect retries included)",
+            ).observe((time.perf_counter() - t0) * 1e3)
+        return info
+
+
+def _worker_rendezvous(driver_address: str, executor_id: str,
+                       partition_id: int, timeout_s: float,
+                       retry_interval_s: float, policy, deadline) -> dict:
     from ..core.resilience import Deadline, DeadlineExpired, RetryPolicy, \
         resilience_measures
 

@@ -1,0 +1,714 @@
+"""Unified observability plane (core/observability.py): metrics registry,
+trace spans, traceparent propagation, Prometheus + Chrome exporters, and the
+wired hot paths (serving, routing front, stage telemetry).
+
+Reference: ``SynapseMLLogging.scala`` stage events + LightGBM
+``TaskInstrumentationMeasures`` — here unified into one registry/tracer.
+All offline under JAX_PLATFORMS=cpu.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import observability as obs
+from synapseml_tpu.core.dataframe import DataFrame
+from synapseml_tpu.core.instrumentation import InstrumentationMeasures
+from synapseml_tpu.core.logging import scrub
+from synapseml_tpu.core.pipeline import Estimator, Model, Pipeline, Transformer
+
+
+@pytest.fixture(autouse=True)
+def fresh_plane():
+    """Each test gets a clean global registry + tracer (the plane is
+    process-wide by design; tests must not see each other's series)."""
+    obs.reset_registry()
+    obs.reset_tracer()
+    yield
+    obs.reset_registry()
+    obs.reset_tracer()
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = obs.get_registry()
+    c = reg.counter("t_total", "help", ("plane",))
+    c.inc(plane="http")
+    c.inc(2, plane="http")
+    assert c.labels(plane="http").value == 3
+    with pytest.raises(ValueError):
+        c.labels(plane="http").inc(-1)  # counters only go up
+
+    g = reg.gauge("t_gauge", "help")
+    g.labels().set(7.0)
+    g.labels().inc(1.5)
+    assert g.labels().value == 8.5
+
+    h = reg.histogram("t_ms", "help", buckets=(1, 10, 100))
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+    snap = h.labels().snapshot()
+    assert snap["count"] == 4 and snap["sum"] == 555.5
+    assert snap["buckets"]["+Inf"] == 1
+
+
+def test_histogram_bucket_mismatch_raises():
+    reg = obs.get_registry()
+    reg.histogram("b_ms", "x", buckets=(1, 10, 100))
+    with pytest.raises(ValueError):
+        reg.histogram("b_ms", "x", buckets=(1000, 60000))
+    # omitting buckets means "whatever the family has" — no raise
+    assert reg.histogram("b_ms", "x").buckets == (1.0, 10.0, 100.0)
+
+
+def test_registry_rejects_kind_and_label_mismatch():
+    reg = obs.get_registry()
+    reg.counter("dup_total", "x", ("a",))
+    with pytest.raises(ValueError):
+        reg.gauge("dup_total", "x", ("a",))
+    with pytest.raises(ValueError):
+        reg.counter("dup_total", "x", ("b",))  # label-set drift
+    # same spec is idempotent (get-or-create)
+    assert reg.counter("dup_total", "x", ("a",)) is reg.counter(
+        "dup_total", "x", ("a",))
+    with pytest.raises(ValueError):
+        reg.counter("dup_total", "x", ("a",)).labels(wrong="v")
+
+
+def test_exposition_parses_and_counters_are_monotonic():
+    """Parse the text output like a Prometheus scraper would: TYPE lines,
+    sample lines, cumulative bucket ordering, counter monotonicity across
+    two scrapes (the ISSUE acceptance check)."""
+    reg = obs.get_registry()
+    c = reg.counter("req_total", "requests", ("status",))
+    h = reg.histogram("lat_ms", "latency", buckets=(1, 10, 100))
+    c.inc(status="2xx")
+    h.observe(5)
+
+    def parse(text):
+        samples = {}
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\S+)$",
+                         line)
+            assert m, f"unparseable exposition line: {line!r}"
+            samples[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+        return samples
+
+    first = parse(reg.exposition())
+    assert first['req_total{status="2xx"}'] == 1
+    assert first['lat_ms_count'] == 1 and first['lat_ms_sum'] == 5
+    # buckets are CUMULATIVE and ordered
+    assert first['lat_ms_bucket{le="1"}'] == 0
+    assert first['lat_ms_bucket{le="10"}'] == 1
+    assert first['lat_ms_bucket{le="+Inf"}'] == 1
+
+    c.inc(status="2xx")
+    h.observe(50)
+    second = parse(reg.exposition())
+    for key, v in first.items():
+        if "_total" in key or "_count" in key or "_bucket" in key:
+            assert second[key] >= v, f"counter {key} went backwards"
+    # TYPE metadata present for every family
+    text = reg.exposition()
+    assert "# TYPE req_total counter" in text
+    assert "# TYPE lat_ms histogram" in text
+
+
+def test_histogram_snapshot_quantiles():
+    reg = obs.get_registry()
+    h = reg.histogram("q_ms", "q", buckets=(10, 20, 50, 100))
+    for v in [5] * 50 + [15] * 40 + [80] * 10:
+        h.observe(v)
+    snap = h.labels().snapshot()
+    assert snap["count"] == 100
+    assert 0 < snap["p50"] <= 10          # 50th obs is in the first bucket
+    assert 10 < snap["p95"] <= 100
+    assert snap["p99"] <= 100
+    empty = reg.histogram("e_ms", "e", buckets=(1,)).labels().snapshot()
+    assert empty["p50"] is None and empty["count"] == 0
+
+
+def test_registry_thread_safety_under_contention():
+    reg = obs.get_registry()
+    c = reg.counter("hammer_total", "x", ("t",))
+    h = reg.histogram("hammer_ms", "x")
+
+    def work(tid):
+        for i in range(500):
+            c.inc(t=str(tid % 2))
+            h.observe(float(i % 7))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(c.labels(t=s).value for s in ("0", "1"))
+    assert total == 8 * 500
+    assert h.labels().snapshot()["count"] == 8 * 500
+
+
+def test_collector_samples_and_resilience_adapter():
+    from synapseml_tpu.core.resilience import (reset_resilience_measures,
+                                               resilience_measures)
+
+    reset_resilience_measures()
+    resilience_measures("http").count("retry", 3)
+    text = obs.get_registry().exposition()
+    assert 'synapseml_resilience_retry_total{plane="http"} 3' in text
+    # a crashing collector must not take down the endpoint
+    obs.get_registry().register_collector(lambda: 1 / 0)
+    assert "synapseml_resilience_retry_total" in obs.get_registry().exposition()
+    reset_resilience_measures()
+
+
+def test_register_instrumentation_exports_phases_and_counts():
+    m = InstrumentationMeasures()
+    with m.measure("binning"):
+        pass
+    m.count("iterations", 4)
+    obs.register_instrumentation("synapseml_gbdt", m, {"uid": "b1"})
+    snap = obs.get_registry().snapshot()
+    assert snap['synapseml_gbdt_iterations_total{uid="b1"}'] == 4
+    assert 'synapseml_gbdt_binning_ms{uid="b1"}' in snap
+
+
+# ---------------------------------------------------------------------------
+# instrumentation thread-safety (satellite)
+# ---------------------------------------------------------------------------
+
+def test_instrumentation_measures_concurrent_mutation():
+    """measure()/mark() used to mutate without the lock count() takes —
+    hammer all mutators while snapshotting; totals must be exact."""
+    m = InstrumentationMeasures()
+    stop = threading.Event()
+
+    def mutate(i):
+        for k in range(300):
+            with m.measure(f"phase{i % 3}"):
+                pass
+            m.mark(f"mark{i % 3}")
+            m.count("events")
+
+    def snapshot():
+        while not stop.is_set():
+            d = m.to_dict()
+            assert isinstance(d.get("total_ms"), float)
+
+    reader = threading.Thread(target=snapshot)
+    reader.start()
+    threads = [threading.Thread(target=mutate, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    reader.join()
+    assert m.to_dict()["events_count"] == 6 * 300
+
+
+# ---------------------------------------------------------------------------
+# scrubber (satellite)
+# ---------------------------------------------------------------------------
+
+def test_scrub_query_string_style_still_works():
+    assert scrub("https://x/y?sig=ABC123&other=1") == \
+        "https://x/y?sig=####&other=1"
+    assert scrub("Authorization: Bearer abc.DEF-123") == \
+        "Authorization: Bearer ####"
+
+
+def test_scrub_json_style_payloads():
+    """Regression: JSON key/value secrets passed through unscrubbed."""
+    assert scrub('{"apiKey": "abc123"}') == '{"apiKey": "####"}'
+    assert scrub('{"Ocp-Apim-Subscription-Key": "deadbeef"}') == \
+        '{"Ocp-Apim-Subscription-Key": "####"}'
+    assert scrub('{"password": "hunter2", "user": "bob"}') == \
+        '{"password": "####", "user": "bob"}'
+    # non-string secret values are masked too
+    assert scrub('{"apiKey": 12345}') == '{"apiKey": "####"}'
+    # escaped quotes inside the secret cannot leak a suffix
+    assert scrub('{"secret": "a\\"b"}') == '{"secret": "####"}'
+    # innocent keys survive
+    assert scrub('{"count": 3, "className": "X"}') == \
+        '{"count": 3, "className": "X"}'
+
+
+def test_log_stage_event_scrubs_json_payload_for_sinks():
+    from synapseml_tpu.core.logging import (add_telemetry_sink,
+                                            log_stage_event,
+                                            remove_telemetry_sink)
+
+    seen = []
+    add_telemetry_sink(seen.append)
+    try:
+        log_stage_event({"uid": "u1", "apiKey": "supersecret",
+                         "url": "https://x?sig=TOPSECRET"})
+    finally:
+        remove_telemetry_sink(seen.append)
+    assert seen and seen[0]["apiKey"] == "####"
+    assert "TOPSECRET" not in json.dumps(seen[0])
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_context_stack():
+    t = obs.get_tracer()
+    assert t.current_span() is None
+    with t.span("outer") as outer:
+        assert t.current_span() is outer
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+        assert t.current_span() is outer
+    assert t.current_span() is None
+    done = {s.name: s for s in t.finished_spans()}
+    assert set(done) == {"outer", "inner"}
+    assert done["inner"].duration_ms is not None
+    assert done["outer"].duration_ms >= done["inner"].duration_ms
+
+
+def test_span_error_status():
+    t = obs.get_tracer()
+    with pytest.raises(RuntimeError):
+        with t.span("boom"):
+            raise RuntimeError("nope")
+    s = t.finished_spans()[-1]
+    assert s.status == "error" and "RuntimeError" in s.attributes["error"]
+
+
+def test_traceparent_roundtrip_and_malformed():
+    t = obs.get_tracer()
+    with t.span("root") as root:
+        headers = t.inject({})
+    ctx = obs.parse_traceparent(headers["traceparent"])
+    assert ctx.trace_id == root.trace_id and ctx.span_id == root.span_id
+    for bad in (None, "", "garbage", "00-zz-yy-01", "00-" + "0" * 32 +
+                "-" + "1" * 16 + "-01", "00-abc-def-01"):
+        assert obs.parse_traceparent(bad) is None
+    # case-insensitive header extraction
+    assert obs.extract_context(
+        {"TraceParent": headers["traceparent"]}).trace_id == root.trace_id
+
+
+def test_remote_parent_pins_trace():
+    t = obs.get_tracer()
+    remote = obs.SpanContext("ab" * 16, "cd" * 8)
+    with t.span("handler", parent=remote) as s:
+        assert s.trace_id == remote.trace_id
+        assert s.parent_id == remote.span_id
+
+
+def test_tracer_ring_buffer_bounded():
+    t = obs.reset_tracer(max_spans=10)
+    for i in range(25):
+        with t.span(f"s{i}"):
+            pass
+    names = [s.name for s in t.finished_spans()]
+    assert len(names) == 10 and names[-1] == "s24" and names[0] == "s15"
+
+
+def test_chrome_trace_export(tmp_path):
+    t = obs.get_tracer()
+    with t.span("parent", {"k": "v"}):
+        with t.span("child"):
+            pass
+    path = obs.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in events} == {"parent", "child"}
+    for e in events:
+        assert e["ts"] > 0 and e["dur"] >= 0 and "trace_id" in e["args"]
+    assert any(e["ph"] == "M" for e in doc["traceEvents"])  # process meta
+
+
+# ---------------------------------------------------------------------------
+# stage telemetry -> plane (histogram + span tree)
+# ---------------------------------------------------------------------------
+
+class _AddOne(Transformer):
+    def _transform(self, df):
+        return df.with_column("x", lambda p: p["x"] + 1)
+
+
+class _FitCount(Estimator):
+    def _fit(self, df):
+        return _AddOne()
+
+
+def _df():
+    return DataFrame([{"x": np.arange(4, dtype=np.float32)}])
+
+
+def test_stage_verbs_feed_histogram_and_spans():
+    pipe = Pipeline(stages=[_FitCount(), _AddOne()])
+    model = pipe.fit(_df())
+    model.transform(_df())
+    snap = obs.get_registry().snapshot()
+    fit_series = [k for k in snap
+                  if k.startswith("synapseml_stage_duration_ms")
+                  and 'method="fit"' in k]
+    assert any("Pipeline" in k for k in fit_series)
+    assert any("_FitCount" in k for k in fit_series)
+    ok = [k for k in snap if k.startswith("synapseml_stage_events_total")
+          and 'status="ok"' in k]
+    assert ok, snap.keys()
+
+
+def test_pipeline_fit_renders_as_span_tree():
+    """Pipeline.fit -> pipeline.stage[i] -> Stage.fit: depth >= 3, one
+    trace."""
+    Pipeline(stages=[_FitCount(), _AddOne()]).fit(_df())
+    spans = {s.span_id: s for s in obs.get_tracer().finished_spans()}
+    roots = [s for s in spans.values() if s.name == "Pipeline.fit"]
+    assert len(roots) == 1
+    root = roots[0]
+    assert all(s.trace_id == root.trace_id for s in spans.values())
+
+    def depth(s):
+        if s.parent_id is None:
+            return 1
+        parent = spans.get(s.parent_id)
+        return 1 + (depth(parent) if parent else 1)
+
+    assert max(depth(s) for s in spans.values()) >= 3
+    slots = [s for s in spans.values() if s.name.startswith("pipeline.stage")]
+    assert {s.parent_id for s in slots} == {root.span_id}
+
+
+def test_stage_error_counted():
+    class _Boom(Transformer):
+        def _transform(self, df):
+            raise ValueError("x")
+
+    with pytest.raises(ValueError):
+        _Boom().transform(_df())
+    snap = obs.get_registry().snapshot()
+    errs = [k for k in snap if k.startswith("synapseml_stage_events_total")
+            and 'status="error"' in k and "_Boom" in k]
+    assert errs
+    assert obs.get_tracer().finished_spans()[-1].status == "error"
+
+
+# ---------------------------------------------------------------------------
+# static check: every public stage routes through StageTelemetry (satellite)
+# ---------------------------------------------------------------------------
+
+def test_every_stage_routes_verbs_through_stage_telemetry():
+    """No silent unobserved stages: a stage overriding fit()/transform()
+    instead of _fit()/_transform() would bypass log_verb (and with it the
+    stage histogram, the span tree, and the JSON stage events)."""
+    from synapseml_tpu.codegen import discover_stages
+    from synapseml_tpu.core.logging import StageTelemetry
+
+    stages = discover_stages()
+    assert len(stages) > 50  # the walk found the real registry
+    offenders = []
+    for name, cls in stages.items():
+        if not issubclass(cls, StageTelemetry):
+            offenders.append(f"{name}: not a StageTelemetry")
+            continue
+        if issubclass(cls, Estimator) and cls.fit is not Estimator.fit:
+            offenders.append(f"{name}: overrides fit() — bypasses log_verb")
+        if issubclass(cls, Transformer) and \
+                cls.transform is not Transformer.transform:
+            offenders.append(
+                f"{name}: overrides transform() — bypasses log_verb")
+    assert not offenders, "\n".join(offenders)
+
+
+# ---------------------------------------------------------------------------
+# serving endpoints + end-to-end distributed trace
+# ---------------------------------------------------------------------------
+
+class EchoObs(Transformer):
+    """Picklable echo pipeline for worker processes."""
+
+    def _transform(self, df):
+        import os
+
+        def per_part(p):
+            out = dict(p)
+            out["reply"] = np.asarray(
+                [{"ok": True, "pid": os.getpid()}] * len(p["body"]),
+                dtype=object)
+            return out
+
+        return df.map_partitions(per_part)
+
+
+def test_serving_server_metrics_and_trace_endpoints():
+    from synapseml_tpu.io.serving import serve_pipeline
+
+    srv = serve_pipeline(EchoObs(), batch_interval_ms=0)
+    try:
+        t = obs.get_tracer()
+        with t.span("client.request") as cs:
+            req = urllib.request.Request(
+                srv.address + "/predict", data=b'{"a": 1}',
+                headers=t.inject({}), method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.loads(r.read())["ok"] is True
+        with urllib.request.urlopen(srv.address + "/metrics", timeout=30) as r:
+            assert r.headers.get("Content-Type", "").startswith("text/plain")
+            text = r.read().decode()
+        assert "synapseml_serving_request_duration_ms_bucket" in text
+        assert "synapseml_serving_queue_wait_ms" in text
+        assert 'synapseml_serving_requests_total{method="POST",status="2xx"} 1' \
+            in text
+        with urllib.request.urlopen(srv.address + "/trace", timeout=30) as r:
+            spans = json.loads(r.read())
+        served = [s for s in spans if s["name"] == "serving.request"]
+        assert served and served[0]["trace_id"] == cs.trace_id
+        assert served[0]["parent_id"] == cs.span_id
+    finally:
+        srv.stop()
+
+
+@pytest.mark.chaos(timeout_s=120)
+def test_distributed_trace_stitches_across_processes():
+    """THE acceptance check: one RoutingFront request over 2 local worker
+    processes -> one trace (shared trace_id, >= 3 spans, >= 2 pids), valid
+    Chrome trace-event JSON, and /metrics on front AND worker serving
+    Prometheus text with latency buckets + breaker gauges."""
+    from synapseml_tpu.io.distributed_serving import (
+        collect_distributed_trace, serve_pipeline_distributed)
+
+    handle = serve_pipeline_distributed(EchoObs(), num_workers=2,
+                                        batch_interval_ms=0)
+    try:
+        t = obs.get_tracer()
+        with t.span("client.request") as cs:
+            req = urllib.request.Request(
+                handle.address + "/predict", data=b'{"q": 1}',
+                headers=t.inject({}), method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.loads(r.read())["ok"] is True
+
+        # ---- stitched trace ----
+        spans = collect_distributed_trace(handle.address)
+        ours = [s for s in spans if s["trace_id"] == cs.trace_id]
+        names = {s["name"] for s in ours}
+        assert {"client.request", "route.request", "serving.request"} <= names
+        assert len(ours) >= 3
+        assert len({s["pid"] for s in ours}) >= 2  # multi-process
+        by_id = {s["span_id"]: s for s in ours}
+        route = next(s for s in ours if s["name"] == "route.request")
+        serving = next(s for s in ours if s["name"] == "serving.request")
+        assert route["parent_id"] == cs.span_id
+        assert serving["parent_id"] == route["span_id"]
+        assert by_id  # parent links resolve within the stitched set
+
+        # ---- valid Chrome trace-event JSON ----
+        doc = json.loads(json.dumps(obs.chrome_trace_events(ours)))
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in xs} >= {"client.request", "route.request",
+                                           "serving.request"}
+        assert all(isinstance(e["ts"], float) and e["dur"] >= 0 for e in xs)
+
+        # ---- front /metrics ----
+        with urllib.request.urlopen(handle.address + "/metrics",
+                                    timeout=30) as r:
+            front_text = r.read().decode()
+        assert "synapseml_route_request_duration_ms_bucket" in front_text
+        assert 'synapseml_breaker_state{' in front_text
+        assert "synapseml_route_pick_ms" in front_text
+
+        # ---- worker /metrics (hit every worker so both have served) ----
+        with urllib.request.urlopen(handle.address + "/routes",
+                                    timeout=30) as r:
+            table = json.loads(r.read())
+        assert len(table) == 2
+        for _ in range(4):  # round-robin touches both workers
+            urllib.request.urlopen(urllib.request.Request(
+                handle.address + "/predict", data=b'{}', method="POST"),
+                timeout=30).read()
+        for w in table:
+            url = f"http://{w['host']}:{w['port']}/metrics"
+            with urllib.request.urlopen(url, timeout=30) as r:
+                wtext = r.read().decode()
+            assert "synapseml_serving_request_duration_ms_bucket" in wtext, \
+                f"worker {w} /metrics missing request histogram"
+    finally:
+        handle.stop()
+
+
+def test_front_forwards_post_to_metrics_path():
+    """/metrics and /trace are GET-only reserved names on the front: a POST
+    to a pipeline path literally named /metrics must still forward."""
+    from synapseml_tpu.io.distributed_serving import RoutingFront
+    from synapseml_tpu.io.serving import serve_pipeline
+
+    srv = serve_pipeline(EchoObs(), batch_interval_ms=0)
+    front = RoutingFront([{"host": srv.host, "port": srv.port, "pid": 1}],
+                         timeout_s=10)
+    try:
+        req = urllib.request.Request(front.address + "/metrics", data=b"{}",
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read())["ok"] is True  # worker reply, not
+        with urllib.request.urlopen(front.address + "/metrics",  # exposition
+                                    timeout=30) as r:
+            assert r.read().startswith(b"# HELP")
+    finally:
+        front.close()
+        srv.stop()
+
+
+def test_routing_front_breaker_gauge_reports_open():
+    """A worker that fails a connect shows up as breaker_state=2 (open) in
+    the front's exposition."""
+    from synapseml_tpu.io.distributed_serving import RoutingFront
+    from synapseml_tpu.io.serving import serve_pipeline
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    srv = serve_pipeline(EchoObs(), batch_interval_ms=0)
+    dead = free_port()
+    front = RoutingFront([{"host": srv.host, "port": srv.port, "pid": 1},
+                          {"host": "127.0.0.1", "port": dead, "pid": 2}],
+                         timeout_s=5, resurrect_after_s=300)
+    try:
+        for _ in range(4):
+            req = urllib.request.Request(front.address + "/p", data=b"{}",
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+        with urllib.request.urlopen(front.address + "/metrics",
+                                    timeout=30) as r:
+            text = r.read().decode()
+        open_line = [line for line in text.splitlines()
+                     if line.startswith("synapseml_breaker_state")
+                     and f"127.0.0.1:{dead}" in line]
+        assert open_line and open_line[0].endswith(" 2"), open_line
+    finally:
+        front.close()
+        srv.stop()
+
+
+def test_http_client_metrics_and_trace_header():
+    """send_with_retries: latency histogram + status counter + the injected
+    traceparent header reaches the server."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from synapseml_tpu.io.http import HTTPRequest, send_with_retries
+
+    seen = {}
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            seen["traceparent"] = self.headers.get("traceparent")
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        t = obs.get_tracer()
+        with t.span("caller") as cs:
+            resp = send_with_retries(HTTPRequest(
+                url=f"http://127.0.0.1:{srv.server_address[1]}/"))
+        assert resp.status_code == 200
+        ctx = obs.parse_traceparent(seen["traceparent"])
+        assert ctx is not None and ctx.trace_id == cs.trace_id
+        snap = obs.get_registry().snapshot()
+        assert snap['synapseml_http_requests_total'
+                    '{method="GET",status="2xx"}'] == 1
+        hist = snap['synapseml_http_request_duration_ms{method="GET"}']
+        assert hist["count"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_http_retry_counter_by_status():
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from synapseml_tpu.io.http import HTTPRequest, send_with_retries
+
+    calls = {"n": 0}
+
+    class Flaky(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            calls["n"] += 1
+            status = 503 if calls["n"] == 1 else 200
+            self.send_response(status)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Flaky)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        resp = send_with_retries(
+            HTTPRequest(url=f"http://127.0.0.1:{srv.server_address[1]}/"),
+            backoffs_ms=(10, 10))
+        assert resp.status_code == 200 and calls["n"] == 2
+        snap = obs.get_registry().snapshot()
+        assert snap['synapseml_http_retries_total'
+                    '{plane="http",status="503"}'] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_rendezvous_duration_histogram():
+    import socket as socket_mod
+    from synapseml_tpu.parallel.backend import worker_rendezvous
+
+    reply = {"coordinator": "127.0.0.1:9999", "rank": 0, "world": 1}
+    srv = socket_mod.socket()
+    srv.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def driver():
+        conn, _ = srv.accept()
+        conn.makefile("r").readline()
+        conn.sendall((json.dumps(reply) + "\n").encode())
+        conn.close()
+
+    threading.Thread(target=driver, daemon=True).start()
+    info = worker_rendezvous(f"127.0.0.1:{port}", "e0", 0, timeout_s=30)
+    srv.close()
+    assert info == reply
+    snap = obs.get_registry().snapshot()
+    assert snap["synapseml_rendezvous_duration_ms"]["count"] == 1
+    names = [s.name for s in obs.get_tracer().finished_spans()]
+    assert "parallel.rendezvous" in names
+
+
+def test_gbdt_fit_populates_step_histogram():
+    from synapseml_tpu.gbdt.booster import train_booster
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(120, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    train_booster(X, y, objective="binary", num_iterations=3, num_leaves=7)
+    snap = obs.get_registry().snapshot()
+    hist = snap['synapseml_train_step_duration_ms{engine="gbdt"}']
+    assert hist["count"] >= 1 and hist["p50"] is not None
