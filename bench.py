@@ -182,6 +182,46 @@ def run_bench(devices):
     return result
 
 
+def _probe_main() -> None:
+    """``--probe`` child: bring the backend up and print one line. Runs in
+    its own process so a relay hang can only cost the parent's probe
+    timeout, never a wedged interpreter."""
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    from benchmarks._common import init_jax
+
+    _jax, plat, n = init_jax()
+    print("PROBE_OK " + json.dumps({"platform": plat, "n": n}), flush=True)
+
+
+def _probe_backend(timeout_s: float = BACKEND_UP_TIMEOUT_S) -> tuple[bool, str]:
+    """(tpu_usable, reason): probe the JAX backend in a subprocess with a
+    HARD timeout before the rotation spends any per-config budget. A hung
+    relay (the round-2 failure mode: jax.devices() never returns) is killed
+    at the deadline and the whole rotation falls back to CPU immediately —
+    every config still emits its BENCH line instead of each one separately
+    burning its backend-up window against a dead relay."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--probe"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=REPO)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        return False, f"backend probe hung past {timeout_s:.0f}s (relay hang)"
+    for line in (out or "").splitlines():
+        if line.startswith("PROBE_OK "):
+            try:
+                info = json.loads(line[len("PROBE_OK "):])
+            except json.JSONDecodeError:
+                continue
+            if info.get("platform") not in ("cpu",):
+                return True, f"backend up: {info}"
+            return False, f"probe came up on {info.get('platform')} (no TPU)"
+    tail = " | ".join((out or "").splitlines()[-4:])
+    return False, f"probe died rc={proc.returncode}: {tail[-300:]}"
+
+
 def _child_main(platform: str, config: str) -> None:
     """Bring up the backend (announce it), measure, print the result line."""
     if platform == "cpu":
@@ -387,6 +427,9 @@ def main() -> None:
         i = sys.argv.index("--child")
         _child_main(sys.argv[i + 1], sys.argv[i + 2])
         return
+    if "--probe" in sys.argv:
+        _probe_main()
+        return
 
     start = time.monotonic()
 
@@ -398,6 +441,12 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         _log("JAX_PLATFORMS=cpu requested; skipping all TPU attempts")
         tpu_ok = False
+    if tpu_ok:
+        # one hard-deadline subprocess probe up front: a hung relay demotes
+        # the WHOLE rotation to CPU now, instead of every config separately
+        # discovering the hang against its own backend-up window
+        tpu_ok, why = _probe_backend()
+        _log(f"backend probe: {why}" + ("" if tpu_ok else "; cpu fallback"))
 
     # BENCH_CONFIGS=flagship,vit restricts the rotation (CI smoke, manual
     # single-config runs); unset = all configs

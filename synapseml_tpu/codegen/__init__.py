@@ -193,6 +193,25 @@ _MODULE_NAMESPACE_MAP = {
     "models.cntk": "cntk",
 }
 
+# non-stage public surfaces that still get a compat namespace: generated
+# passthrough modules re-exporting the package's __all__ (the registry's
+# classes are not PipelineStages, so the param-reflection wrapper shape
+# doesn't apply — but the compat coverage rule "every public symbol is
+# importable from synapseml_tpu.compat.<ns>" does, and
+# tests/test_codegen.py::test_registry_compat_coverage enforces it)
+_PASSTHROUGH_NAMESPACES = {
+    "registry": "synapseml_tpu.registry",
+}
+
+_PASSTHROUGH_HEADER = '''"""Generated passthrough namespace — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers).
+Re-exports the public surface of ``%s`` so the compat layer covers
+non-stage subsystems too (compat coverage is drift-tested).
+"""
+
+'''
+
 _WRAPPER_HEADER = '''"""Generated pyspark-style wrappers — do not edit.
 
 Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers). The
@@ -259,6 +278,25 @@ def emit_wrappers(out_dir: str | None = None) -> list[str]:
             f.write("\n".join(lines))
         written.append(path)
         all_modules.append(ns)
+
+    for ns, target_mod in sorted(_PASSTHROUGH_NAMESPACES.items()):
+        mod = importlib.import_module(target_mod)
+        names = sorted(getattr(mod, "__all__"))
+        lines = [_PASSTHROUGH_HEADER % target_mod,
+                 f"from {target_mod} import (  # noqa: F401"]
+        lines += [f"    {n}," for n in names]
+        lines.append(")")
+        lines.append("")
+        lines.append("__all__ = [")
+        lines += [f"    {n!r}," for n in names]
+        lines.append("]")
+        lines.append("")
+        path = os.path.join(out_dir, f"{ns}.py")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        written.append(path)
+        all_modules.append(ns)
+    all_modules.sort()
 
     init_lines = ['"""Generated pyspark-style wrapper namespace — do not edit.',
                   "",

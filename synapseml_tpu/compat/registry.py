@@ -1,0 +1,39 @@
+"""Generated passthrough namespace — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers).
+Re-exports the public surface of ``synapseml_tpu.registry`` so the compat layer covers
+non-stage subsystems too (compat coverage is drift-tested).
+"""
+
+
+from synapseml_tpu.registry import (  # noqa: F401
+    ArtifactStore,
+    CanaryController,
+    Deployment,
+    IntegrityError,
+    ModelRegistry,
+    PublishedVersion,
+    RegistryReadOnlyError,
+    ResolvedModel,
+    admin_load,
+    atomic_write_bytes,
+    param_schema_hash,
+    sha256_file,
+    write_stream_verified,
+)
+
+__all__ = [
+    'ArtifactStore',
+    'CanaryController',
+    'Deployment',
+    'IntegrityError',
+    'ModelRegistry',
+    'PublishedVersion',
+    'RegistryReadOnlyError',
+    'ResolvedModel',
+    'admin_load',
+    'atomic_write_bytes',
+    'param_schema_hash',
+    'sha256_file',
+    'write_stream_verified',
+]

@@ -18,10 +18,12 @@ the driver service collecting worker endpoints
 
 from __future__ import annotations
 
+import collections
 import http.client
 import json
 import os
 import pickle
+import random
 import socket
 import subprocess
 import sys
@@ -94,7 +96,55 @@ _ROUTE_METRICS = obs.HandleCache(lambda reg: {
     "unroutable": reg.counter(
         "synapseml_route_unroutable_total",
         "requests that exhausted every worker").labels(),
+    # deployment plane: per-version series (canary observability) — the
+    # acceptance surface for registry/deploy.py rollout decisions
+    "version_requests": reg.counter(
+        "synapseml_route_version_requests_total",
+        "routed requests per pipeline version", ("version", "status")),
+    "version_ms": reg.histogram(
+        "synapseml_route_version_request_ms",
+        "routed request latency per pipeline version", ("version",)),
+    "shadow_requests": reg.counter(
+        "synapseml_route_shadow_requests_total",
+        "shadow-traffic duplicates per version", ("version", "status")),
+    "shadow_delta_ms": reg.histogram(
+        "synapseml_route_shadow_latency_delta_ms",
+        "shadow latency minus primary latency for the same request",
+        ("version",)),
 })
+
+
+class _VersionStats:
+    """Monotonic per-version counters + a bounded latency window, kept by
+    the RoutingFront so the auto-rollback controller (registry/deploy.py)
+    can diff outcomes without scraping the Prometheus text format."""
+
+    __slots__ = ("ok", "err", "shadow_ok", "shadow_err", "latencies_ms")
+
+    def __init__(self):
+        self.ok = 0
+        self.err = 0
+        self.shadow_ok = 0
+        self.shadow_err = 0
+        self.latencies_ms = collections.deque(maxlen=256)
+
+    def snapshot(self) -> dict:
+        lat = list(self.latencies_ms)
+        out = {"ok": self.ok, "err": self.err,
+               "shadow_ok": self.shadow_ok, "shadow_err": self.shadow_err,
+               "n_latencies": len(lat)}
+        if lat:
+            lat.sort()
+            out["p50_ms"] = round(lat[len(lat) // 2], 3)
+            out["p95_ms"] = round(lat[min(len(lat) - 1,
+                                          int(len(lat) * 0.95))], 3)
+        return out
+
+
+def _version_of(w: dict) -> str:
+    """A worker registration's pipeline version label (canary routing /
+    per-version metrics); unlabeled fleets collapse to one series."""
+    return str(w.get("version") or "unversioned")
 
 
 def _nodelay_connection(host: str, port: int,
@@ -277,11 +327,23 @@ class RoutingFront:
     * ``GET /stats`` reports the ``distributed_serving`` resilience counters
       (retries, breaker opens, deadline expiries, injected faults) plus the
       live per-worker breaker states.
+
+    Deployment plane (``registry/deploy.py``): workers may register with a
+    ``version``; ``set_traffic_split({"v1": 0.9, "v2": 0.1})`` routes each
+    request to a version drawn by weight (canary), falling back to any live
+    worker when the drawn version has none (a dying canary degrades to the
+    stable fleet, never to a 503); ``set_shadow(version)`` duplicates
+    requests to a worker of that version in the background, discards the
+    response, and records latency/error deltas. Per-version request/latency
+    /error series land in the PR-2 metrics registry; ``version_stats()``
+    snapshots monotonic per-version counters for the auto-rollback
+    controller. ``POST /admin/split`` applies a split/shadow over HTTP.
     """
 
     def __init__(self, workers: list[dict] | None = None, port: int = 0,
                  timeout_s: float = 60.0, registry: "WorkerRegistry" = None,
-                 resurrect_after_s: float = 2.0):
+                 resurrect_after_s: float = 2.0,
+                 max_inflight_shadows: int = 8):
         if workers is None and registry is None:
             raise ValueError("RoutingFront needs workers and/or a registry")
         self._static_workers = list(workers or [])
@@ -291,6 +353,15 @@ class RoutingFront:
         self._rr = 0
         self._lock = threading.Lock()
         self._pool = _ConnPool(timeout_s)
+        # deployment plane state: canary split, shadow target, per-version
+        # accounting (all guarded by _deploy_lock; the split rng is seedable
+        # for deterministic tests)
+        self._deploy_lock = threading.Lock()
+        self._split: dict[str, float] | None = None
+        self._shadow: tuple[str, float] | None = None  # (version, fraction)
+        self._split_rng = random.Random()
+        self._version_stats: dict[str, _VersionStats] = {}
+        self._shadow_sem = threading.Semaphore(max_inflight_shadows)
         front = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -323,8 +394,16 @@ class RoutingFront:
                     stats = json.dumps({
                         "resilience": resilience_measures(
                             "distributed_serving").to_dict(),
-                        "breakers": front.breaker_states()}).encode()
+                        "breakers": front.breaker_states(),
+                        "traffic_split": front.traffic_split(),
+                        "shadow": front.shadow(),
+                        "versions": front.version_stats()}).encode()
                     self._reply(200, stats,
+                                {"Content-Type": "application/json"})
+                    return
+                if self.path == "/admin/split":  # deployment plane over HTTP
+                    status, reply = front._admin_split(method, body)
+                    self._reply(status, json.dumps(reply).encode(),
                                 {"Content-Type": "application/json"})
                     return
                 # GET-gated like io/serving.py: a POST to a pipeline path
@@ -372,6 +451,7 @@ class RoutingFront:
                             (time.perf_counter() - t0) * 1e3)
                     tried += 1
                     endpoint = f"{key[0]}:{key[1]}"
+                    version = _version_of(w)
                     fwd0 = time.perf_counter()
                     try:
                         got = _pooled_request(front._pool, key, method,
@@ -380,13 +460,25 @@ class RoutingFront:
                         breaker.record_failure()
                         front._pool.clear(key)
                         rm["worker_failures"].inc(worker=endpoint)
+                        front._record_version(version, ok=False)
+                        rm["version_requests"].inc(version=version,
+                                                   status="error")
                         continue
                     status, payload = got
                     breaker.record_success()  # proven alive
-                    rm["request_ms"].observe(
-                        (time.perf_counter() - fwd0) * 1e3, worker=endpoint)
+                    elapsed_ms = (time.perf_counter() - fwd0) * 1e3
+                    rm["request_ms"].observe(elapsed_ms, worker=endpoint)
+                    front._record_version(version, ok=status < 500,
+                                          latency_ms=elapsed_ms)
+                    rm["version_requests"].inc(
+                        version=version,
+                        status=f"{status // 100}xx")
+                    rm["version_ms"].observe(elapsed_ms, version=version)
                     self._reply(status, payload,
-                                {"X-Served-By": str(w.get("pid", ""))})
+                                {"X-Served-By": str(w.get("pid", "")),
+                                 "X-Served-Version": version})
+                    front._maybe_shadow(method, self.path, body, hdrs,
+                                        version, elapsed_ms)
                     return
                 rm["unroutable"].inc()
                 self._reply(503)
@@ -436,7 +528,11 @@ class RoutingFront:
     def _candidates(self) -> tuple[list[dict], bool]:
         """(routing order for one request, desperate): breaker-available
         (closed or probe-due) workers round-robin rotated; if none, the
-        least-recently-failed worker as a desperation probe."""
+        least-recently-failed worker as a desperation probe. With a traffic
+        split active, a version is drawn by weight and its workers are
+        ordered FIRST; every other live worker follows as fallback — a
+        canary whose workers all failed degrades to the stable fleet
+        instead of dropping the request."""
         table = self._table()
         if not table:
             return [], False
@@ -453,11 +549,173 @@ class RoutingFront:
             self._rr += 1
             rot = self._rr % max(len(alive), 1)
         if alive:
-            return alive[rot:] + alive[:rot], False
+            ordered = alive[rot:] + alive[:rot]
+            chosen = self._draw_version()
+            if chosen is not None:
+                preferred = [w for w in ordered
+                             if _version_of(w) == chosen]
+                ordered = preferred + [w for w in ordered
+                                       if _version_of(w) != chosen]
+            return ordered, False
         # everything recently failed: probe the stalest failure anyway
         stalest = min(table, key=lambda w: self._breaker(
             (w.get("host"), w.get("port"))).last_failure_at or 0.0)
         return [stalest], True
+
+    # -- deployment plane: canary splits, shadow traffic, version stats ----
+    def set_traffic_split(self, split: dict[str, float] | None) -> None:
+        """Weighted canary split (version -> weight), e.g. ``{"v1": 0.95,
+        "v2": 0.05}``. Weights are normalized; ``None`` restores plain
+        round-robin."""
+        if split:
+            total = sum(float(v) for v in split.values())
+            if total <= 0:
+                raise ValueError(f"split weights must sum > 0: {split}")
+            split = {str(k): float(v) / total for k, v in split.items()}
+        else:
+            split = None
+        with self._deploy_lock:
+            self._split = split
+
+    def traffic_split(self) -> dict[str, float] | None:
+        with self._deploy_lock:
+            return dict(self._split) if self._split else None
+
+    def set_shadow(self, version: str | None,
+                   fraction: float = 1.0) -> None:
+        """Duplicate ``fraction`` of successfully-served requests to a
+        worker of ``version``, discarding the response and recording
+        latency/error deltas (``synapseml_route_shadow_*``). ``None``
+        disables shadowing."""
+        with self._deploy_lock:
+            self._shadow = (None if version is None
+                            else (str(version), float(fraction)))
+
+    def shadow(self) -> dict | None:
+        with self._deploy_lock:
+            if self._shadow is None:
+                return None
+            return {"version": self._shadow[0],
+                    "fraction": self._shadow[1]}
+
+    def clear_shadow(self) -> None:
+        self.set_shadow(None)
+
+    def version_stats(self) -> dict[str, dict]:
+        """Monotonic per-version outcome counters + latency percentiles
+        (the rollback controller's input; also exported on ``/stats``)."""
+        with self._deploy_lock:
+            return {v: s.snapshot()
+                    for v, s in self._version_stats.items()}
+
+    def _draw_version(self) -> str | None:
+        with self._deploy_lock:
+            if not self._split:
+                return None
+            split = dict(self._split)
+            r = self._split_rng.random()
+        acc = 0.0
+        chosen = None
+        for version, weight in split.items():
+            acc += weight
+            chosen = version
+            if r < acc:
+                break
+        return chosen
+
+    def _record_version(self, version: str, ok: bool,
+                        latency_ms: float | None = None,
+                        shadow: bool = False) -> None:
+        with self._deploy_lock:
+            stats = self._version_stats.get(version)
+            if stats is None:
+                stats = self._version_stats[version] = _VersionStats()
+            if shadow:
+                if ok:
+                    stats.shadow_ok += 1
+                else:
+                    stats.shadow_err += 1
+            elif ok:
+                stats.ok += 1
+            else:
+                stats.err += 1
+            if latency_ms is not None and not shadow:
+                stats.latencies_ms.append(latency_ms)
+
+    def _maybe_shadow(self, method: str, path: str, body, headers: dict,
+                      primary_version: str, primary_ms: float) -> None:
+        """Fire-and-forget duplicate to the shadow version (post-reply, so
+        the primary response is never delayed). Bounded by the in-flight
+        semaphore — saturation drops the duplicate, never queues it."""
+        with self._deploy_lock:
+            shadow = self._shadow
+        if shadow is None:
+            return
+        version, fraction = shadow
+        if version == primary_version:
+            return
+        if fraction < 1.0 and self._split_rng.random() >= fraction:
+            return
+        targets = [w for w in self._table() if _version_of(w) == version]
+        if not targets or not self._shadow_sem.acquire(blocking=False):
+            return
+        target = targets[self._rr % len(targets)]
+        key = (target.get("host"), target.get("port"))
+        rm = _ROUTE_METRICS.get()
+        hdrs = {k: v for k, v in headers.items()
+                if k.lower() != "traceparent"}
+
+        def run():
+            t0 = time.perf_counter()
+            try:
+                status, _payload = _pooled_request(self._pool, key, method,
+                                                   path, body, hdrs)
+            except (http.client.HTTPException, OSError):
+                self._pool.clear(key)
+                self._record_version(version, ok=False, shadow=True)
+                rm["shadow_requests"].inc(version=version, status="error")
+            else:
+                ms = (time.perf_counter() - t0) * 1e3
+                # a 5xx reply is a shadow FAILURE (the primary path counts
+                # status>=500 as err too) — a canary that errors under
+                # shadow must not look healthy to the rollout decision
+                ok = status < 500
+                self._record_version(version, ok=ok, shadow=True)
+                rm["shadow_requests"].inc(
+                    version=version,
+                    status="ok" if ok else f"{status // 100}xx")
+                rm["shadow_delta_ms"].observe(ms - primary_ms,
+                                              version=version)
+            finally:
+                self._shadow_sem.release()
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def _admin_split(self, method: str, body: bytes) -> tuple[int, dict]:
+        """``GET /admin/split`` reads, ``POST /admin/split`` applies
+        ``{"split": {...}|null, "shadow": {"version": v, "fraction": f}
+        |null}`` — the deployment plane's HTTP surface on the front."""
+        if method == "GET":
+            return 200, {"split": self.traffic_split(),
+                         "shadow": self.shadow()}
+        try:
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            if "split" in payload:
+                self.set_traffic_split(payload["split"])
+            if "shadow" in payload:
+                sh = payload["shadow"]
+                if sh is None:
+                    self.clear_shadow()
+                else:
+                    self.set_shadow(sh["version"],
+                                    float(sh.get("fraction", 1.0)))
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            return 400, {"error": str(e)}
+        return 200, {"ok": True, "split": self.traffic_split(),
+                     "shadow": self.shadow()}
 
     @property
     def address(self) -> str:
@@ -600,9 +858,12 @@ class RoutingClient:
 
 
 def worker_main(pipeline_path: str, registry_address: str,
-                batch_interval_ms: int = 0) -> None:
+                batch_interval_ms: int = 0,
+                version: str | None = None) -> None:
     """Worker process entry: load the pickled pipeline, serve it, register,
-    then park forever (the per-executor server loop)."""
+    then park forever (the per-executor server loop). A hot swap
+    (``POST /admin/load``) re-registers the worker with its NEW version so
+    the front's canary routing and per-version metrics follow the swap."""
     import jax
 
     jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
@@ -610,11 +871,20 @@ def worker_main(pipeline_path: str, registry_address: str,
 
     with open(pipeline_path, "rb") as f:
         pipeline = pickle.load(f)
-    server = serve_pipeline(pipeline, batch_interval_ms=batch_interval_ms)
-    info = {"host": server.host, "port": server.port, "pid": os.getpid()}
-    urllib.request.urlopen(urllib.request.Request(
-        registry_address, data=json.dumps(info).encode(), method="POST",
-        headers={"Content-Type": "application/json"}), timeout=30).read()
+    server = serve_pipeline(pipeline, batch_interval_ms=batch_interval_ms,
+                            version=version)
+
+    def register(*_swap_args) -> dict:
+        info = {"host": server.host, "port": server.port,
+                "pid": os.getpid(),
+                "version": server.pipeline_holder.version}
+        urllib.request.urlopen(urllib.request.Request(
+            registry_address, data=json.dumps(info).encode(), method="POST",
+            headers={"Content-Type": "application/json"}), timeout=30).read()
+        return info
+
+    server.pipeline_holder.subscribe(register)
+    info = register()
     print(f"worker ready {info}", flush=True)
     while True:  # killed by the parent
         time.sleep(1.0)
@@ -695,9 +965,12 @@ class DistributedServing:
 
 def serve_pipeline_distributed(pipeline, num_workers: int = 2,
                                batch_interval_ms: int = 0,
-                               startup_timeout_s: float = 90.0) -> DistributedServing:
+                               startup_timeout_s: float = 90.0,
+                               version: str | None = None) -> DistributedServing:
     """Serve a (picklable) Transformer across ``num_workers`` OS processes
-    behind one routed public port — the DistributedHTTPSource analog."""
+    behind one routed public port — the DistributedHTTPSource analog.
+    ``version`` labels the initial pipeline for the deployment plane
+    (canary splits + per-version metrics; see ``registry/deploy.py``)."""
     import tempfile
 
     fd, path = tempfile.mkstemp(suffix=".pipeline.pkl")
@@ -707,7 +980,7 @@ def serve_pipeline_distributed(pipeline, num_workers: int = 2,
     registry = WorkerRegistry()
     code = ("from synapseml_tpu.io.distributed_serving import worker_main; "
             f"worker_main({path!r}, {registry.address + '/register'!r}, "
-            f"{batch_interval_ms})")
+            f"{batch_interval_ms}, version={version!r})")
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -17,6 +17,7 @@ the reference's sub-millisecond continuous path).
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 import time
@@ -29,7 +30,8 @@ import numpy as np
 from ..core import observability as obs
 from ..core.dataframe import DataFrame
 
-__all__ = ["ServingServer", "serve_pipeline", "NoDelayHTTPServer"]
+__all__ = ["ServingServer", "serve_pipeline", "NoDelayHTTPServer",
+           "PipelineHolder"]
 
 # hot-path metric handles, re-resolved only when the registry is replaced
 _SERVING_METRICS = obs.HandleCache(lambda reg: {
@@ -42,7 +44,58 @@ _SERVING_METRICS = obs.HandleCache(lambda reg: {
     "queue_wait": reg.histogram(
         "synapseml_serving_queue_wait_ms",
         "request time spent queued before batch pickup").labels(),
+    "swaps": reg.counter(
+        "synapseml_serving_pipeline_swaps_total",
+        "hot pipeline swaps on this worker, by outcome", ("outcome",)),
 })
+
+
+class PipelineHolder:
+    """The mutable slot the serving loop reads its pipeline from.
+
+    Hot-swap (``POST /admin/load``) loads the replacement side-by-side,
+    warms it, then calls :meth:`swap` — one attribute assignment under a
+    lock, so in-flight batches finish on the old pipeline and the next
+    batch picks up the new one with zero dropped requests. ``subscribe``
+    registers post-swap callbacks (the distributed worker re-registers its
+    new version with the driver registry through one)."""
+
+    def __init__(self, pipeline, version: str | None = None):
+        self._lock = threading.Lock()
+        self._pipeline = pipeline
+        self._version = version
+        self._callbacks: list = []
+
+    @property
+    def version(self) -> str | None:
+        with self._lock:
+            return self._version
+
+    @property
+    def pipeline(self):
+        with self._lock:
+            return self._pipeline
+
+    def get(self):
+        """(pipeline, version) — one consistent snapshot."""
+        with self._lock:
+            return self._pipeline, self._version
+
+    def subscribe(self, fn) -> None:
+        """``fn(new_version, old_version)`` after every successful swap."""
+        self._callbacks.append(fn)
+
+    def swap(self, pipeline, version: str | None = None) -> str | None:
+        with self._lock:
+            old = self._version
+            self._pipeline = pipeline
+            self._version = version
+        for fn in list(self._callbacks):
+            try:
+                fn(version, old)
+            except Exception:  # noqa: BLE001 - a callback must not undo a swap
+                pass
+        return old
 
 
 class NoDelayHTTPServer(ThreadingHTTPServer):
@@ -90,6 +143,11 @@ class ServingServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  reply_timeout_s: float = 30.0, max_queue: int = 4096):
         self.reply_timeout_s = reply_timeout_s
+        # set by serve_pipeline: the hot-swap slot + the loop's parsing
+        # config (the /admin/load warmup must prepare batches EXACTLY like
+        # the serve loop does, or warmup success proves nothing)
+        self.pipeline_holder: PipelineHolder | None = None
+        self._loop_cfg = {"parse_json": True, "input_col": "body"}
         # bounded: a stalled pipeline sheds load with 503s instead of parking
         # unbounded connections (backpressure the round-1 loop lacked)
         self._queue: "queue.Queue[_Exchange]" = queue.Queue(maxsize=max_queue)
@@ -128,6 +186,18 @@ class ServingServer:
                     payload = json.dumps(
                         obs.get_tracer().spans_as_dicts()).encode()
                     self._reply_bytes(200, payload, "application/json")
+                    return
+                # deployment-plane admin endpoints (registry/deploy.py):
+                # handled here, never queued behind the pipeline
+                if method == "GET" and self.path == "/admin/version":
+                    self._reply_bytes(
+                        200, json.dumps(outer._admin_version()).encode(),
+                        "application/json")
+                    return
+                if method == "POST" and self.path == "/admin/load":
+                    status, reply = outer._admin_load(body)
+                    self._reply_bytes(status, json.dumps(reply).encode(),
+                                      "application/json")
                     return
                 # one span per served request, stitched to the caller's trace
                 # via the W3C traceparent header the RoutingFront injects
@@ -206,6 +276,80 @@ class ServingServer:
             self._server.server_close()
             self._running = False
 
+    # ---- deployment-plane admin (hot swap; registry/deploy.py) ----
+    def _admin_version(self) -> dict:
+        holder = self.pipeline_holder
+        if holder is None:
+            return {"version": None, "pipeline": None}
+        pipeline, version = holder.get()
+        return {"version": version, "pipeline": type(pipeline).__name__}
+
+    def _warmup(self, stage, rows: list) -> int:
+        """Run ``rows`` (JSON-able request bodies) through ``stage`` with
+        the SAME batch preparation the serve loop uses. Raises on any
+        transform failure — a pipeline that cannot serve its warmup batch
+        must never be swapped in."""
+        if not rows:
+            return 0
+        bodies = [r if isinstance(r, bytes)
+                  else (r.encode() if isinstance(r, str)
+                        else json.dumps(r).encode()) for r in rows]
+        batch = DataFrame([{
+            "id": np.asarray([f"warmup-{i}" for i in range(len(bodies))],
+                             dtype=object),
+            "method": np.asarray(["POST"] * len(bodies), dtype=object),
+            "path": np.asarray(["/"] * len(bodies), dtype=object),
+            "body": np.asarray(bodies, dtype=object),
+        }])
+        batch = _prepare_batch(batch, **self._loop_cfg)
+        stage.transform(batch)
+        return len(bodies)
+
+    def _admin_load(self, body: bytes) -> tuple[int, dict]:
+        """Load a new pipeline version side-by-side, warm it, atomically
+        swap. Body: ``{"path": <stage dir>}`` or ``{"registry": <root or
+        url>, "model": <name>, "ref": <version or alias>}``, plus optional
+        ``"version"`` label and ``"warmup"`` (list of request bodies). The
+        old pipeline keeps serving until the instant of the swap; a load or
+        warmup failure leaves it untouched (409)."""
+        holder = self.pipeline_holder
+        if holder is None:
+            return 409, {"error": "this server has no swappable pipeline "
+                                  "(started without serve_pipeline?)"}
+        try:
+            payload = json.loads(body.decode() or "{}")
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            return 400, {"error": f"bad JSON body: {e}"}
+        if not isinstance(payload, dict):
+            return 400, {"error": "body must be a JSON object"}
+        t0 = time.perf_counter()
+        try:
+            if "path" in payload:
+                from ..core.serialization import load_stage
+
+                stage = load_stage(payload["path"])
+                version = (payload.get("version")
+                           or os.path.basename(
+                               str(payload["path"]).rstrip("/")))
+            elif "registry" in payload and "model" in payload:
+                from ..registry.registry import ModelRegistry
+
+                resolved = ModelRegistry(payload["registry"]).resolve(
+                    payload["model"], payload.get("ref", "latest"))
+                stage, version = resolved.stage, resolved.version
+            else:
+                return 400, {"error":
+                             "body needs 'path' or 'registry'+'model'"}
+            warmed = self._warmup(stage, payload.get("warmup") or [])
+        except Exception as e:  # noqa: BLE001 - any failure must 409, not swap
+            _SERVING_METRICS.get()["swaps"].inc(outcome="failed")
+            return 409, {"error": f"{type(e).__name__}: {e}"}
+        previous = holder.swap(stage, version)
+        _SERVING_METRICS.get()["swaps"].inc(outcome="ok")
+        return 200, {"ok": True, "version": version, "previous": previous,
+                     "warmup_rows": warmed,
+                     "load_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+
     # ---- micro-batch source/sink API (HTTPMicroBatchReader / HTTPWriter) ----
     def read_batch(self, max_rows: int = 1024, timeout_s: float = 0.1) -> DataFrame:
         """Drain queued requests into a DataFrame (id, method, path, body)."""
@@ -254,15 +398,46 @@ class ServingServer:
         return n
 
 
+def _prepare_batch(batch: DataFrame, parse_json: bool = True,
+                   input_col: str = "body") -> DataFrame:
+    """Request-batch input preparation, shared verbatim between the serve
+    loop and the /admin/load warmup path."""
+    if parse_json:
+        def parse(p):
+            out = np.empty(len(p["body"]), dtype=object)
+            for i, b in enumerate(p["body"]):
+                try:
+                    out[i] = json.loads(b.decode() or "null")
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    out[i] = None
+            return out
+
+        return batch.with_column(input_col, parse)
+    if input_col != "body":
+        return batch.with_column(input_col, lambda p: p["body"])
+    return batch
+
+
 def serve_pipeline(pipeline, port: int = 0, batch_interval_ms: int = 10,
                    input_col: str = "body", reply_col: str = "reply",
-                   parse_json: bool = True, num_threads: int = 1) -> ServingServer:
+                   parse_json: bool = True, num_threads: int = 1,
+                   version: str | None = None) -> ServingServer:
     """Run a Transformer as an HTTP service: request body -> ``input_col`` ->
     pipeline.transform -> ``reply_col`` -> response body. ``batch_interval_ms=0``
     replies per-request (continuous mode); ``num_threads`` transform loops
     drain the queue concurrently (for pipelines that release the GIL or do
-    IO — the reference's concurrent continuous path)."""
-    server = ServingServer(port=port).start()
+    IO — the reference's concurrent continuous path).
+
+    The pipeline lives in a :class:`PipelineHolder` (``version`` labels the
+    initial one; pass a holder directly to share it), so ``POST /admin/load``
+    can hot-swap a new version mid-serve: in-flight batches finish on the
+    old pipeline, the next batch reads the new one — zero dropped requests."""
+    server = ServingServer(port=port)
+    holder = (pipeline if isinstance(pipeline, PipelineHolder)
+              else PipelineHolder(pipeline, version))
+    server.pipeline_holder = holder
+    server._loop_cfg = {"parse_json": parse_json, "input_col": input_col}
+    server.start()
 
     def loop():
         while server._running:
@@ -271,21 +446,11 @@ def serve_pipeline(pipeline, port: int = 0, batch_interval_ms: int = 10,
                 timeout_s=max(batch_interval_ms, 10) / 1000.0)
             if batch.is_empty():
                 continue
-            if parse_json:
-                def parse(p):
-                    out = np.empty(len(p["body"]), dtype=object)
-                    for i, b in enumerate(p["body"]):
-                        try:
-                            out[i] = json.loads(b.decode() or "null")
-                        except (json.JSONDecodeError, UnicodeDecodeError):
-                            out[i] = None
-                    return out
-
-                batch = batch.with_column(input_col, parse)
-            elif input_col != "body":
-                batch = batch.with_column(input_col, lambda p: p["body"])
+            batch = _prepare_batch(batch, parse_json=parse_json,
+                                   input_col=input_col)
+            stage, _version = holder.get()
             try:
-                replied = pipeline.transform(batch)
+                replied = stage.transform(batch)
                 server.reply_batch(replied, reply_col=reply_col)
             except Exception as e:  # noqa: BLE001 - serve loop must survive
                 err = {"error": str(e)}

@@ -15,7 +15,6 @@ against an in-process HTTP mock.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import json
 import os
 import urllib.error
@@ -126,17 +125,17 @@ class ModelDownloader:
         with self._open(url) as r:
             return r.read()
 
-    def _fetch_to_file(self, url: str, path: str) -> str:
-        """Stream a download to ``path`` atomically (.part + os.replace),
-        hashing incrementally — one pass, constant memory."""
-        h = hashlib.sha256()
-        tmp = path + ".part"
-        with self._open(url) as r, open(tmp, "wb") as f:
-            for chunk in iter(lambda: r.read(1 << 20), b""):
-                h.update(chunk)
-                f.write(chunk)
-        os.replace(tmp, path)
-        return h.hexdigest()
+    def _fetch_to_file(self, url: str, path: str,
+                       expected_sha256: str | None = None) -> str:
+        """Stream a download to a temp file atomically (.part + os.replace),
+        hashing incrementally — one pass, constant memory. With
+        ``expected_sha256`` the rename only happens on a digest match, so a
+        bad transfer never lands even transiently (shared helper with the
+        registry artifact store: ``registry/store.write_stream_verified``)."""
+        from ..registry.store import write_stream_verified
+
+        with self._open(url) as r:
+            return write_stream_verified(r, path, expected_sha256)
 
     def remote_models(self) -> list[ModelSchema]:
         if self.server_url is None:
@@ -158,13 +157,11 @@ class ModelDownloader:
         try:
             for fname in schema.files:
                 path = self._safe_path(schema.name + ".staging", fname)
-                got = self._fetch_to_file(
-                    f"{self.server_url}/{schema.name}/{fname}", path)
-                want = schema.sha256.get(fname)
-                if want and got != want:
-                    raise RuntimeError(
-                        f"sha256 mismatch for {schema.name}/{fname}: "
-                        f"expected {want}, got {got}")
+                # verification happens INSIDE the fetch: a digest mismatch
+                # removes the temp file and the destination never appears
+                self._fetch_to_file(
+                    f"{self.server_url}/{schema.name}/{fname}", path,
+                    expected_sha256=schema.sha256.get(fname))
         except Exception:
             import shutil
 

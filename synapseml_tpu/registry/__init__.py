@@ -1,0 +1,30 @@
+"""Model registry + deployment plane.
+
+Content-addressed artifact store (``store``), versioned publish/resolve
+with mutable aliases (``registry``), and hot-swap rollout with canary
+splits, shadow traffic, and auto-rollback (``deploy``). See
+``docs/REGISTRY.md`` for the publish → canary → promote → rollback
+walkthrough.
+"""
+
+from .store import (ArtifactStore, IntegrityError, atomic_write_bytes,
+                    sha256_file, write_stream_verified)
+from .registry import (ModelRegistry, PublishedVersion, RegistryReadOnlyError,
+                       ResolvedModel, param_schema_hash)
+from .deploy import CanaryController, Deployment, admin_load
+
+__all__ = [
+    "ArtifactStore",
+    "IntegrityError",
+    "ModelRegistry",
+    "PublishedVersion",
+    "ResolvedModel",
+    "RegistryReadOnlyError",
+    "Deployment",
+    "CanaryController",
+    "admin_load",
+    "param_schema_hash",
+    "sha256_file",
+    "atomic_write_bytes",
+    "write_stream_verified",
+]

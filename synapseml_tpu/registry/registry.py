@@ -1,0 +1,344 @@
+"""Model registry: versioned publish / resolve / pin over the artifact store.
+
+``publish()`` turns any fitted :class:`~synapseml_tpu.core.pipeline.
+PipelineStage` into a self-describing artifact: the stage tree is saved via
+``core/serialization.save_stage``, every file becomes a content-addressed
+blob, and a signed manifest records the stage list, a param-schema hash
+(computed FROM the saved artifact, so a params refactor that changes the
+wire format changes the hash), framework versions, and a metrics snapshot at
+publish time. ``resolve()`` is the inverse — materialize, verify, and
+``load_stage`` — and accepts either a concrete version (``v3``) or a mutable
+alias (``prod``, ``canary``, ``latest``) stored as an atomically-swapped
+pointer file.
+
+The same registry layout reads back over the ``ModelDownloader`` remote
+protocol: any static file server rooted at the store directory (the
+in-process mock used by ``tests/test_registry.py``, or the model repository
+server from ``models/downloader.py``) serves manifests, blobs, and alias
+pointers as plain files. Remote registries are read-only — ``publish`` and
+``pin`` are local-filesystem operations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import platform
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+from ..core import serialization
+from .store import (ArtifactStore, IntegrityError, _canonical_json,
+                    _safe_component, _version_sort_key, write_stream_verified)
+
+__all__ = ["ModelRegistry", "ResolvedModel", "PublishedVersion",
+           "RegistryReadOnlyError", "param_schema_hash"]
+
+
+class RegistryReadOnlyError(RuntimeError):
+    """A write operation (publish/pin) was attempted on a remote registry."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PublishedVersion:
+    name: str
+    version: str
+    manifest: dict
+    manifest_path: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ResolvedModel:
+    stage: object
+    name: str
+    version: str
+    manifest: dict
+    path: str  # materialized stage directory
+
+
+def param_schema_hash(stage_dir: str) -> str:
+    """sha256 over the artifact's param schema: every ``metadata.json`` in
+    the saved tree contributes (class, sorted simple-param names, sorted
+    complex-param names + kinds). Two artifacts with the same hash are
+    loadable by the same code; a serialization-format change flips it —
+    the drift guard ``tests/test_serialization_roundtrip.py`` asserts the
+    hash is stable across a save→load→save round trip."""
+    entries = []
+    for dirpath, _dirs, files in os.walk(stage_dir):
+        if "metadata.json" not in files:
+            continue
+        with open(os.path.join(dirpath, "metadata.json")) as f:
+            meta = json.load(f)
+        rel = os.path.relpath(dirpath, stage_dir).replace(os.sep, "/")
+        entries.append({
+            "at": "" if rel == "." else rel,
+            "class": meta.get("class", ""),
+            "params": sorted(meta.get("params", {})),
+            "complex": sorted((name, entry.get("kind", ""))
+                              for name, entry in
+                              meta.get("complexParams", {}).items()),
+        })
+    entries.sort(key=lambda e: e["at"])
+    return hashlib.sha256(_canonical_json(entries)).hexdigest()
+
+
+def _framework_versions() -> dict:
+    import numpy
+
+    versions = {"python": platform.python_version(),
+                "numpy": numpy.__version__}
+    try:  # jax may be absent/broken in minimal consumers; record if present
+        import jax
+
+        versions["jax"] = jax.__version__
+    except Exception:  # noqa: BLE001 - any import failure just omits the key
+        pass
+    return versions
+
+
+class ModelRegistry:
+    """Publish/resolve/pin pipeline versions against a local store directory
+    or a read-only remote (``http(s)://``) registry.
+
+    ``cache_dir`` is where resolved versions materialize (default:
+    ``<root>/.cache`` locally, a per-user dir for remotes). A version is
+    materialized once — the ``.complete`` marker makes re-resolution a pure
+    ``load_stage``.
+    """
+
+    def __init__(self, root: str, cache_dir: str | None = None,
+                 timeout_s: float = 10.0):
+        self.root = root.rstrip("/") if root.startswith(("http://", "https://")) \
+            else os.path.abspath(root)
+        self.is_remote = self.root.startswith(("http://", "https://"))
+        self.timeout_s = timeout_s
+        self._store = None if self.is_remote else ArtifactStore(self.root)
+        if cache_dir is None:
+            if self.is_remote:
+                digest = hashlib.sha256(self.root.encode()).hexdigest()[:16]
+                cache_dir = os.path.join(
+                    tempfile.gettempdir(),
+                    f"synapseml_registry_cache_{digest}")
+            else:
+                cache_dir = os.path.join(self.root, ".cache")
+        self.cache_dir = cache_dir
+
+    # -- remote plumbing (ModelDownloader protocol: plain files over HTTP) --
+    def _open_remote(self, rel: str):
+        url = f"{self.root}/{rel}"
+        try:
+            return urllib.request.urlopen(url, timeout=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(f"registry server returned {e.code} for "
+                               f"{url!r}: {e.reason}") from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise RuntimeError(
+                f"registry unreachable at {url!r}: {e}. On zero-egress "
+                "hosts point ModelRegistry at a local store directory "
+                "instead.") from e
+
+    def _read_remote(self, rel: str) -> bytes:
+        with self._open_remote(rel) as r:
+            return r.read()
+
+    def _require_local(self, op: str) -> ArtifactStore:
+        if self._store is None:
+            raise RegistryReadOnlyError(
+                f"{op}() needs a local registry; {self.root!r} is remote "
+                "(read-only)")
+        return self._store
+
+    # -- listing / refs ----------------------------------------------------
+    def list_versions(self, name: str) -> list[str]:
+        if self._store is not None:
+            return self._store.list_versions(name)
+        try:
+            index = json.loads(self._read_remote(
+                f"manifests/{_safe_component(name)}/index.json"))
+        except RuntimeError:
+            return []
+        return sorted((str(v) for v in index), key=_version_sort_key)
+
+    def aliases(self, name: str) -> dict[str, str]:
+        if self._store is not None:
+            return self._store.list_aliases(name)
+        # the remote protocol has no directory listing for aliases; probe
+        # the conventional set (the deployment plane only moves these)
+        out = {}
+        for alias in ("latest", "prod", "canary"):
+            target = self.alias_target(name, alias)
+            if target:
+                out[alias] = target
+        return out
+
+    def alias_target(self, name: str, alias: str) -> str | None:
+        if self._store is not None:
+            return self._store.read_alias(name, alias)
+        try:
+            return self._read_remote(
+                f"aliases/{_safe_component(name)}/"
+                f"{_safe_component(alias)}").decode().strip() or None
+        except RuntimeError:
+            return None
+
+    def resolve_ref(self, name: str, ref: str) -> str:
+        """A concrete version for ``ref`` (version string or alias)."""
+        versions = self.list_versions(name)
+        if ref in versions:
+            return ref
+        target = self.alias_target(name, ref)
+        if target is not None:
+            if target not in versions:
+                raise KeyError(
+                    f"alias {name}:{ref} points at missing version "
+                    f"{target!r}")
+            return target
+        raise KeyError(f"{name}:{ref} is neither a version nor an alias "
+                       f"(versions: {versions or 'none'})")
+
+    def next_version(self, name: str) -> str:
+        nums = [int(v[1:]) for v in self.list_versions(name)
+                if v.startswith("v") and v[1:].isdigit()]
+        return f"v{max(nums, default=0) + 1}"
+
+    def manifest(self, name: str, ref: str = "latest") -> dict:
+        return self._manifest_for_version(name, self.resolve_ref(name, ref))
+
+    def _manifest_for_version(self, name: str, version: str) -> dict:
+        """Manifest for an already-concrete version (no re-resolution — a
+        remote resolve must not pay a second index.json round trip)."""
+        if self._store is not None:
+            return self._store.read_manifest(name, version)
+        return json.loads(self._read_remote(
+            f"manifests/{_safe_component(name)}/"
+            f"{_safe_component(version)}.json"))
+
+    # -- publish -----------------------------------------------------------
+    def publish(self, name: str, stage, version: str | None = None,
+                metrics: dict | None = None, extra: dict | None = None,
+                set_latest: bool = True) -> PublishedVersion:
+        """Save ``stage``, blobify its tree, and write the signed manifest.
+        ``version`` defaults to the next ``v<N>``; ``metrics`` is the
+        caller's evaluation snapshot at publish time (what the deployment
+        plane compares a canary against)."""
+        store = self._require_local("publish")
+        _safe_component(name)
+        version = _safe_component(version or self.next_version(name))
+        if version in self.list_versions(name):
+            raise FileExistsError(
+                f"{name}:{version} already published (versions are "
+                "immutable; pick a new version or alias)")
+        with tempfile.TemporaryDirectory(prefix="synapseml_publish_") as tmp:
+            stage_dir = os.path.join(tmp, "stage")
+            serialization.save_stage(stage, stage_dir)
+            files = store.ingest_tree(stage_dir)
+            stages = _stage_classes(stage_dir)
+            schema_hash = param_schema_hash(stage_dir)
+        manifest = {
+            "name": name,
+            "version": version,
+            "created_at_unix": time.time(),
+            "stages": stages,
+            "param_schema_sha256": schema_hash,
+            "framework": _framework_versions(),
+            "metrics": dict(metrics or {}),
+            "files": files,
+            "total_bytes": sum(e["bytes"] for e in files),
+        }
+        if extra:
+            manifest["extra"] = dict(extra)
+        path = store.write_manifest(name, version, manifest)
+        if set_latest:
+            store.write_alias(name, "latest", version)
+        return PublishedVersion(name, version, manifest, path)
+
+    # -- resolve -----------------------------------------------------------
+    def resolve(self, name: str, ref: str = "latest") -> ResolvedModel:
+        """Materialize + integrity-verify + ``load_stage`` one version."""
+        version = self.resolve_ref(name, ref)
+        manifest = self._manifest_for_version(name, version)
+        dest = os.path.join(self.cache_dir, _safe_component(name),
+                            _safe_component(version))
+        marker = os.path.join(dest, ".complete")
+        if not os.path.isfile(marker):
+            # serialize materialization per version: two workers resolving
+            # the same version concurrently (a fleet-wide hot swap) must not
+            # race the build-then-rename
+            import fcntl
+
+            os.makedirs(dest, exist_ok=True)
+            with open(os.path.join(dest, ".lock"), "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                if not os.path.isfile(marker):
+                    self._materialize(name, version, manifest, dest)
+                    with open(marker, "w") as f:
+                        f.write(version)
+        stage = serialization.load_stage(os.path.join(dest, "stage"))
+        return ResolvedModel(stage=stage, name=name, version=version,
+                             manifest=manifest,
+                             path=os.path.join(dest, "stage"))
+
+    def _materialize(self, name: str, version: str, manifest: dict,
+                     dest: str) -> None:
+        cache_store = ArtifactStore(self.cache_dir) if self.is_remote \
+            else self._store
+
+        def fetch(digest: str, path: str) -> None:
+            # remote blobs mirror into the cache's blob dir first, so a
+            # version re-resolve and shared blobs across versions hit the
+            # network once
+            if not cache_store.has_blob(digest):
+                blob = cache_store.blob_path(digest)
+                os.makedirs(os.path.dirname(blob), exist_ok=True)
+                with self._open_remote(f"blobs/{digest}") as r:
+                    write_stream_verified(r, blob, digest)
+            cache_store.materialize_blob(digest, path)
+
+        stage_root = os.path.join(dest, "stage")
+        cache_store.materialize_tree(
+            manifest["files"], stage_root,
+            fetch=fetch if self.is_remote else None)
+        got = param_schema_hash(stage_root)
+        want = manifest.get("param_schema_sha256")
+        if want and got != want:
+            raise IntegrityError(
+                f"{name}:{version} param schema hash mismatch: manifest "
+                f"{want}, materialized {got} — artifact and manifest "
+                "disagree")
+
+    # -- pin (atomic alias swap) -------------------------------------------
+    def pin(self, name: str, alias: str, ref: str) -> str:
+        """Point ``alias`` at a version (atomic pointer-file swap); returns
+        the concrete version pinned. ``ref`` may itself be an alias."""
+        store = self._require_local("pin")
+        version = self.resolve_ref(name, ref)
+        store.write_alias(name, alias, version)
+        return version
+
+
+def _stage_classes(stage_dir: str) -> list[str]:
+    """The artifact's stage class list: the root metadata class plus any
+    nested stage/stage_list complex params, in tree order."""
+    out = []
+
+    def walk(d: str) -> None:
+        meta_path = os.path.join(d, "metadata.json")
+        if not os.path.isfile(meta_path):
+            return
+        with open(meta_path) as f:
+            meta = json.load(f)
+        out.append(meta.get("class", ""))
+        for name, entry in sorted(meta.get("complexParams", {}).items()):
+            target = os.path.join(d, f"complex_{name}")
+            if entry.get("kind") == "stage":
+                walk(target)
+            elif entry.get("kind") == "stage_list":
+                for i in range(int(entry.get("n", 0))):
+                    walk(f"{target}_{i:03d}")
+
+    walk(stage_dir)
+    return out

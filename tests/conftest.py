@@ -96,6 +96,9 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "chaos: injected-fault / worker-kill "
                             "tests; guarded by the per-test thread watchdog "
                             "(pyproject.toml registers this marker too)")
+    config.addinivalue_line("markers", "registry: model registry + "
+                            "deployment plane tests (tier-1; pyproject.toml "
+                            "registers this marker too)")
 
 
 # ---- chaos watchdog ------------------------------------------------------

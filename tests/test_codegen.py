@@ -82,6 +82,30 @@ def test_numeric_claims_quote_facts():
     assert not bad, "stale numeric claims:\n" + "\n".join(bad)
 
 
+def test_registry_compat_coverage():
+    """Static compat check for the non-stage registry subsystem: EVERY
+    public symbol of ``synapseml_tpu.registry`` must be importable from the
+    generated ``synapseml_tpu.compat.registry`` passthrough (and the
+    passthrough must not carry stale names). A new public registry symbol
+    without regenerated compat coverage fails the suite here."""
+    import synapseml_tpu.compat.registry as compat_registry
+    import synapseml_tpu.registry as registry
+
+    public = set(registry.__all__)
+    covered = set(compat_registry.__all__)
+    missing = sorted(public - covered)
+    assert not missing, (
+        f"public registry symbols missing compat coverage: {missing}; "
+        "run python -m synapseml_tpu.codegen")
+    stale = sorted(covered - public)
+    assert not stale, (
+        f"compat.registry exports symbols the registry no longer has: "
+        f"{stale}; run python -m synapseml_tpu.codegen")
+    for name in sorted(public):
+        assert getattr(compat_registry, name) is getattr(registry, name), (
+            f"compat.registry.{name} is not the registry's own object")
+
+
 def test_wrapper_chaining_fit_transform():
     from synapseml_tpu.compat.lightgbm import (LightGBMClassificationModel,
                                                LightGBMClassifier)

@@ -1,0 +1,460 @@
+"""Model registry + deployment plane (ISSUE 3): content-addressed artifact
+store, versioned publish/resolve with aliases, hot-swap serving, canary
+splits, shadow traffic, and the auto-rollback controller."""
+
+import functools
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.params import Param
+from synapseml_tpu.core.pipeline import Transformer
+from synapseml_tpu.registry import (
+    ArtifactStore,
+    CanaryController,
+    Deployment,
+    IntegrityError,
+    ModelRegistry,
+    RegistryReadOnlyError,
+)
+from synapseml_tpu.registry.store import write_stream_verified
+
+pytestmark = pytest.mark.registry
+
+
+class VersionTag(Transformer):
+    """Serving payload that replies with its version tag (module-level so
+    worker processes can unpickle/load it by reference)."""
+
+    tag = Param("tag", "version tag echoed in every reply", default="base")
+
+    def _transform(self, df):
+        t = self.get("tag")
+
+        def per_part(p):
+            out = dict(p)
+            out["reply"] = np.asarray(
+                [{"v": t, "pid": os.getpid()} for _ in p["body"]],
+                dtype=object)
+            return out
+
+        return df.map_partitions(per_part)
+
+
+class BrokenStage(Transformer):
+    """A version that cannot serve (its warmup must block the swap)."""
+
+    def _transform(self, df):
+        raise RuntimeError("this version is broken on purpose")
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+def test_write_stream_verified_atomic(tmp_path):
+    import io
+
+    dest = tmp_path / "out.bin"
+    digest = write_stream_verified(io.BytesIO(b"payload"), str(dest))
+    assert dest.read_bytes() == b"payload"
+    import hashlib
+
+    assert digest == hashlib.sha256(b"payload").hexdigest()
+    # mismatch: destination never appears, no temp litter
+    bad = tmp_path / "bad.bin"
+    with pytest.raises(IntegrityError, match="sha256 mismatch"):
+        write_stream_verified(io.BytesIO(b"payload"), str(bad), "0" * 64)
+    assert not bad.exists()
+    assert [p.name for p in tmp_path.iterdir()] == ["out.bin"]
+
+
+def test_blob_store_dedup_and_integrity(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    d1 = store.put_blob_bytes(b"weights")
+    d2 = store.put_blob_bytes(b"weights")
+    assert d1 == d2 and store.get_blob(d1) == b"weights"
+    # silent corruption surfaces as IntegrityError, not wrong bytes
+    with open(store.blob_path(d1), "wb") as f:
+        f.write(b"tampered")
+    with pytest.raises(IntegrityError, match="corrupt"):
+        store.get_blob(d1)
+    with pytest.raises(IntegrityError):
+        store.materialize_blob(d1, str(tmp_path / "copy"))
+    assert not (tmp_path / "copy").exists()
+
+
+def test_alias_pointer_swap_is_atomic_file(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    store.write_alias("m", "prod", "v1")
+    assert store.read_alias("m", "prod") == "v1"
+    store.write_alias("m", "prod", "v2")  # swap, not append
+    assert store.read_alias("m", "prod") == "v2"
+    assert store.list_aliases("m") == {"prod": "v2"}
+    assert store.read_alias("m", "nope") is None
+
+
+# ---------------------------------------------------------------------------
+# registry: publish / resolve / versions / aliases
+# ---------------------------------------------------------------------------
+
+def test_publish_resolve_manifest_roundtrip(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    pub = reg.publish("echo", VersionTag(tag="v1"),
+                      metrics={"acc": 0.91, "p95_ms": 1.2})
+    assert pub.version == "v1"
+    m = pub.manifest
+    assert m["stages"] == [f"{VersionTag.__module__}.VersionTag"]
+    assert len(m["param_schema_sha256"]) == 64
+    assert m["metrics"]["acc"] == 0.91
+    assert m["framework"]["numpy"]
+    assert m["files"] and all(len(e["sha256"]) == 64 for e in m["files"])
+
+    res = reg.resolve("echo", "latest")
+    assert res.version == "v1"
+    assert isinstance(res.stage, VersionTag)
+    assert res.stage.get("tag") == "v1"
+    # same params -> same schema hash across republish
+    pub2 = reg.publish("echo", VersionTag(tag="v2"))
+    assert pub2.version == "v2"
+    assert (pub2.manifest["param_schema_sha256"]
+            == m["param_schema_sha256"])
+
+
+def test_manifest_signature_tamper_detected(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish("echo", VersionTag(tag="v1"))
+    store = ArtifactStore(str(tmp_path / "reg"))
+    path = store.manifest_path("echo", "v1")
+    with open(path) as f:
+        manifest = json.load(f)
+    manifest["metrics"] = {"acc": 1.0}  # juice the publish-time metrics
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(IntegrityError, match="signature"):
+        reg.manifest("echo", "v1")
+
+
+def test_versions_aliases_pin(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    for _ in range(3):
+        reg.publish("echo", VersionTag(tag="x"))
+    assert reg.list_versions("echo") == ["v1", "v2", "v3"]
+    assert reg.aliases("echo") == {"latest": "v3"}
+    assert reg.pin("echo", "prod", "v2") == "v2"
+    assert reg.resolve("echo", "prod").version == "v2"
+    # pin through another alias resolves to its concrete version
+    reg.pin("echo", "canary", "latest")
+    assert reg.alias_target("echo", "canary") == "v3"
+    with pytest.raises(KeyError):
+        reg.resolve("echo", "v99")
+    with pytest.raises(FileExistsError):  # versions are immutable
+        reg.publish("echo", VersionTag(), version="v2")
+
+
+def test_unsafe_names_rejected(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    for bad in ("../evil", "a/b", ".hidden", ""):
+        with pytest.raises(ValueError, match="unsafe"):
+            reg.publish(bad, VersionTag())
+    reg.publish("ok", VersionTag())
+    with pytest.raises(ValueError, match="unsafe"):
+        reg.pin("ok", "../../alias", "v1")
+
+
+def test_remote_registry_over_http(tmp_path):
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish("echo", VersionTag(tag="v1"))
+    reg.publish("echo", VersionTag(tag="v2"))
+    reg.pin("echo", "prod", "v1")
+
+    handler = functools.partial(SimpleHTTPRequestHandler, directory=root)
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        remote = ModelRegistry(url, cache_dir=str(tmp_path / "cache"))
+        assert remote.list_versions("echo") == ["v1", "v2"]
+        assert remote.alias_target("echo", "prod") == "v1"
+        res = remote.resolve("echo", "prod")
+        assert res.version == "v1" and res.stage.get("tag") == "v1"
+        # remote is read-only
+        with pytest.raises(RegistryReadOnlyError):
+            remote.publish("echo", VersionTag())
+        with pytest.raises(RegistryReadOnlyError):
+            remote.pin("echo", "prod", "v2")
+        # a corrupted blob on the server cannot materialize
+        manifest = remote.manifest("echo", "v2")
+        victim = manifest["files"][0]["sha256"]
+        with open(os.path.join(root, "blobs", victim), "ab") as f:
+            f.write(b"junk")
+        with pytest.raises((IntegrityError, RuntimeError)):
+            remote.resolve("echo", "v2")
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# hot swap on one worker
+# ---------------------------------------------------------------------------
+
+def _post(url, data):
+    req = urllib.request.Request(url, data=json.dumps(data).encode(),
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_hot_swap_zero_dropped_requests(tmp_path):
+    from synapseml_tpu.io.serving import serve_pipeline
+
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish("echo", VersionTag(tag="v1"), version="v1")
+    reg.publish("echo", VersionTag(tag="v2"), version="v2")
+    reg.publish("echo", BrokenStage(), version="v3")
+
+    srv = serve_pipeline(VersionTag(tag="v1"), batch_interval_ms=0,
+                         version="v1")
+    base = f"http://{srv.host}:{srv.port}"
+    try:
+        assert _post(base + "/", {"i": 0}) == (200, {"v": "v1",
+                                                     "pid": os.getpid()})
+        # hammer while swapping: no request may fail across the swap
+        results, stop = [], threading.Event()
+
+        def pound():
+            i = 0
+            while not stop.is_set():
+                results.append(_post(base + "/", {"i": i}))
+                i += 1
+
+        t = threading.Thread(target=pound)
+        t.start()
+        try:
+            status, reply = _post(base + "/admin/load",
+                                  {"registry": root, "model": "echo",
+                                   "ref": "v2", "warmup": [{"i": -1}]})
+        finally:
+            time.sleep(0.2)  # a few post-swap requests land in results
+            stop.set()
+            t.join(timeout=30)
+        assert status == 200 and reply["ok"] and reply["previous"] == "v1"
+        assert reply["warmup_rows"] == 1
+        assert results and all(s == 200 for s, _ in results)
+        tags = {b["v"] for _, b in results}
+        assert tags <= {"v1", "v2"} and "v2" in tags
+
+        with urllib.request.urlopen(base + "/admin/version",
+                                    timeout=10) as r:
+            assert json.loads(r.read())["version"] == "v2"
+
+        # a broken version fails its warmup batch and is NOT swapped in
+        status, reply = _post(base + "/admin/load",
+                              {"registry": root, "model": "echo",
+                               "ref": "v3", "warmup": [{"i": -1}]})
+        assert status == 409 and "broken on purpose" in reply["error"]
+        assert _post(base + "/", {"i": 1})[1]["v"] == "v2"  # untouched
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# auto-rollback controller (deterministic unit test, no processes)
+# ---------------------------------------------------------------------------
+
+class _FakeFront:
+    def __init__(self):
+        self.stats = {}
+        self.split = None
+        self.shadow_cleared = False
+
+    def version_stats(self):
+        return {v: dict(s) for v, s in self.stats.items()}
+
+    def set_traffic_split(self, split):
+        self.split = split
+
+    def clear_shadow(self):
+        self.shadow_cleared = True
+
+
+def test_canary_controller_trips_on_error_rate(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish("echo", VersionTag(tag="v1"), version="v1")
+    reg.publish("echo", VersionTag(tag="v2"), version="v2")
+    reg.pin("echo", "prod", "v2")  # the rollout moved prod; rollback must flip it
+
+    front = _FakeFront()
+    ctl = CanaryController(front, stable="v1", canary="v2", registry=reg,
+                           model="echo", error_rate_threshold=0.5,
+                           window=10, min_samples=4)
+    front.stats = {"v1": {"ok": 50, "err": 0},
+                   "v2": {"ok": 5, "err": 0}}
+    assert ctl.check_once() is None  # healthy canary: no trip
+    front.stats["v2"] = {"ok": 5, "err": 1}
+    assert ctl.check_once() is None  # 1/6 in the window: under threshold
+    front.stats["v2"] = {"ok": 5, "err": 6}
+    reason = ctl.check_once()
+    assert reason is not None and "error rate" in reason
+    ctl._trip(reason)
+    assert ctl.rolled_back
+    assert front.split == {"v1": 1.0} and front.shadow_cleared
+    assert reg.alias_target("echo", "prod") == "v1"  # alias flipped back
+
+
+def test_canary_controller_ignores_history_before_start():
+    """A long-lived front carries counters from EARLIER rollouts of the
+    same version; a fresh controller must baseline against them, not
+    replay old failures into its new breaker (which would roll back a
+    healthy re-canary instantly)."""
+    front = _FakeFront()
+    front.stats = {"v2": {"ok": 0, "err": 50}}  # last rollout's wreckage
+    ctl = CanaryController(front, stable="v1", canary="v2",
+                           error_rate_threshold=0.5, window=10,
+                           min_samples=2)
+    assert ctl.check_once() is None  # history not replayed
+    front.stats["v2"] = {"ok": 1, "err": 53}  # 3 NEW errors, 1 new ok
+    reason = ctl.check_once()
+    assert reason is not None and "error rate" in reason
+
+
+def test_canary_controller_trips_on_p95_regression():
+    front = _FakeFront()
+    ctl = CanaryController(front, stable="v1", canary="v2",
+                           error_rate_threshold=1.1,  # errors can't trip
+                           p95_regression_factor=2.0,
+                           min_latency_samples=10)
+    front.stats = {
+        "v1": {"ok": 100, "err": 0, "p95_ms": 2.0, "n_latencies": 100},
+        "v2": {"ok": 20, "err": 0, "p95_ms": 3.0, "n_latencies": 20},
+    }
+    assert ctl.check_once() is None  # 1.5x: within budget
+    front.stats["v2"] = {"ok": 40, "err": 0, "p95_ms": 9.0,
+                         "n_latencies": 40}
+    reason = ctl.check_once()
+    assert reason is not None and "p95" in reason
+
+
+# ---------------------------------------------------------------------------
+# acceptance: publish -> serve -> canary -> metrics -> fault -> auto-rollback
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos(timeout_s=110)
+def test_e2e_canary_rollout_with_autorollback(tmp_path):
+    """The ISSUE-3 acceptance path: publish v1+v2, serve v1 on a 2-worker
+    DistributedServing, hot-swap one worker to a 90/10 canary of v2 with
+    zero failed requests during the swap, see per-version series under
+    ``GET /metrics``, then fault-inject v2 (PR-1 FaultPlan) and watch the
+    auto-rollback controller flip ``prod`` back to v1."""
+    from synapseml_tpu.core.faults import FaultSpec, inject_faults
+    from synapseml_tpu.io.distributed_serving import serve_pipeline_distributed
+
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.publish("echo", VersionTag(tag="v1"), version="v1")
+    reg.publish("echo", VersionTag(tag="v2"), version="v2")
+    reg.pin("echo", "prod", "v2")  # eager promote the rollback must undo
+
+    handle = serve_pipeline_distributed(VersionTag(tag="v1"), num_workers=2,
+                                        batch_interval_ms=0, version="v1")
+    try:
+        def call(i):
+            status, body = _post(handle.address, {"i": i})
+            return status, body
+
+        for i in range(6):
+            status, body = call(i)
+            assert status == 200 and body["v"] == "v1"
+
+        dep = Deployment(handle, reg, "echo", warmup=[{"i": -1}])
+        handle.front._split_rng.seed(1234)
+
+        # swap under fire: zero dropped requests while one worker hot-swaps
+        results, stop = [], threading.Event()
+
+        def pound():
+            i = 0
+            while not stop.is_set():
+                results.append(call(i)[0])
+                i += 1
+
+        t = threading.Thread(target=pound)
+        t.start()
+        try:
+            dep.canary("v2", weight=0.1, num_workers=1)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert results and all(s == 200 for s in results)
+        assert reg.alias_target("echo", "canary") == "v2"
+        assert handle.front.traffic_split() == {"v1": 0.9, "v2": 0.1}
+
+        # the 90/10 split routes to both versions
+        replies = [call(i)[1]["v"] for i in range(80)]
+        assert set(replies) == {"v1", "v2"}
+        assert replies.count("v1") > replies.count("v2")
+
+        # shadow traffic: duplicates of stable requests hit the canary
+        handle.front.set_shadow("v2")
+        for i in range(20):
+            call(i)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(s.get("shadow_ok", 0) + s.get("shadow_err", 0) > 0
+                   for s in handle.front.version_stats().values()):
+                break
+            time.sleep(0.05)
+        handle.front.clear_shadow()
+        stats = handle.front.version_stats()
+        assert stats["v2"].get("shadow_ok", 0) >= 1
+
+        # per-version series on the front's /metrics (PR-2 registry)
+        with urllib.request.urlopen(handle.address + "/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        assert "synapseml_route_version_requests_total{" in text
+        assert 'version="v2"' in text and 'version="v1"' in text
+        assert "synapseml_route_shadow_requests_total{" in text
+
+        # fault-inject the canary worker; every request keeps succeeding
+        # (stable fallback) while the controller watches v2 fail
+        controller = CanaryController(
+            handle.front, stable="v1", canary="v2", registry=reg,
+            model="echo", error_rate_threshold=0.5, window=4,
+            min_samples=2, interval_s=0.05).start()
+        (v2_worker,) = [w for w in handle.registry.workers()
+                        if w.get("version") == "v2"]
+        key = f"{v2_worker['host']}:{v2_worker['port']}"
+        try:
+            with inject_faults([FaultSpec(kind="connection_error",
+                                          match=key,
+                                          planes=("distributed_serving",))]):
+                deadline = time.monotonic() + 45
+                i = 0
+                while (time.monotonic() < deadline
+                       and not controller.rolled_back):
+                    status, _ = call(i)
+                    assert status == 200  # zero dropped requests throughout
+                    i += 1
+        finally:
+            controller.stop()
+        assert controller.rolled_back, "controller never tripped"
+        assert "error rate" in (controller.reason or "")
+        # the alias flipped back and traffic snapped to stable
+        assert reg.alias_target("echo", "prod") == "v1"
+        assert handle.front.traffic_split() == {"v1": 1.0}
+        assert call(0)[1]["v"] == "v1"
+    finally:
+        handle.stop()
