@@ -1,10 +1,11 @@
-"""Benchmark rotation over SEVEN configs: the five BASELINE.md targets plus
-two TPU-only decision benches.
+"""Benchmark rotation over EIGHT configs: the five BASELINE.md targets, two
+TPU-only decision benches, and the host-side serving-microbatch A/B.
 
 Prints one JSON line per config — flagship (BERT-base fine-tune) LAST so a
 single-line consumer parses the flagship metric — and exits 0 regardless of
 TPU-relay state. Configs: ONNX ResNet-50, Llama decode, Higgs-1M GBDT,
-histogram-backend decision, attention-backend decision, flagship BERT,
+histogram-backend decision, attention-backend decision, serving-microbatch
+(continuous batching vs fixed-timeout, same round), flagship BERT,
 ViT-B/16 (BASELINE.md:23-29; measurement order rationale at CONFIGS). The
 summed TPU deadlines intentionally exceed GLOBAL_BUDGET_S — late configs
 are truncated by design when earlier ones consume a healthy window. Any
@@ -70,6 +71,9 @@ CONFIGS = [
     ("gbdt-higgs", "gbdt_higgs1m", 420, 300),
     ("gbdt-hist-backends", "gbdt_hist_backends", 420, 0),
     ("attn-backends", "attn_backends", 600, 0),  # 4 BERT-base scan compiles
+    # host-side serving A/B (adaptive continuous batching vs fixed-timeout
+    # baseline, same round) — cheap, runs fine on the CPU fallback
+    ("serving-microbatch", "serving_microbatch", 240, 240),
     ("flagship", None, 420, 360),
     ("vit", "vit_finetune", 450, 300),
 ]

@@ -1,0 +1,194 @@
+"""Serving microbatch: continuous batching + shared compile cache A/B.
+
+Fires a MIXED-SIZE request stream (concurrency phases 1/4/16 so the serve
+loop drains genuinely variable batch sizes) at ``serve_pipeline`` wrapping
+an ONNX MLP scorer — the stage whose jits now come from the process-wide
+``CompiledCache`` over the pow-2 bucket ladder. Two runs in the SAME round:
+
+  (a) fixed    — the old fixed-timeout ``read_batch`` scheduler (baseline);
+  (b) adaptive — the continuous-batching scheduler (flush on a full bucket,
+                 wait up to the latency budget otherwise).
+
+Emits p50/p99 latency, rows/sec, and the compile-cache hit rate per run.
+The acceptance bar: adaptive p99 and throughput no worse than fixed.
+Prints one JSON line.
+"""
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def _make_onnx_scorer():
+    """Tiny MLP as ONNX protobuf bytes -> ONNXModel -> serving Transformer."""
+    from synapseml_tpu.core import DataFrame
+    from synapseml_tpu.core.pipeline import Transformer
+    from synapseml_tpu.onnx import ONNXModel
+    from synapseml_tpu.onnx.proto import (AttributeProto, GraphProto,
+                                          ModelProto, NodeProto,
+                                          ValueInfoProto, numpy_to_tensor)
+    from synapseml_tpu.onnx import proto as P
+
+    rs = np.random.default_rng(0)
+    din, dh, dout = 16, 64, 4
+    W1 = rs.normal(size=(din, dh)).astype(np.float32)
+    b1 = rs.normal(size=(dh,)).astype(np.float32)
+    W2 = rs.normal(size=(dh, dout)).astype(np.float32)
+    b2 = rs.normal(size=(dout,)).astype(np.float32)
+
+    def node(op, inputs, outputs, **attrs):
+        return NodeProto(input=list(inputs), output=list(outputs), op_type=op,
+                         attribute=[AttributeProto.make(k, v)
+                                    for k, v in attrs.items()])
+
+    g = GraphProto(
+        name="mlp",
+        node=[node("Gemm", ["x", "W1", "b1"], ["h_pre"]),
+              node("Relu", ["h_pre"], ["h"]),
+              node("Gemm", ["h", "W2", "b2"], ["logits"]),
+              node("Softmax", ["logits"], ["probs"], axis=-1)],
+        initializer=[numpy_to_tensor(W1, "W1"), numpy_to_tensor(b1, "b1"),
+                     numpy_to_tensor(W2, "W2"), numpy_to_tensor(b2, "b2")],
+        input=[ValueInfoProto(name="x", elem_type=P.FLOAT, dims=["N", din])],
+        output=[ValueInfoProto(name="probs", elem_type=P.FLOAT,
+                               dims=["N", dout])],
+    )
+    onnx = ONNXModel(ModelProto(graph=g).encode(),
+                     feed_dict={"x": "features"},
+                     fetch_dict={"probs": "probs"}, mini_batch_size=64)
+
+    class OnnxScorerT(Transformer):
+        def _transform(self, df):
+            def per_part(p):
+                feats = np.asarray([np.asarray(b["features"], np.float32)
+                                    for b in p["body"]])
+                scored = onnx.transform(
+                    DataFrame.from_dict({"features": feats}))
+                probs = scored.collect_column("probs")
+                out = dict(p)
+                out["reply"] = np.asarray(
+                    [{"argmax": int(np.argmax(row))} for row in probs],
+                    dtype=object)
+                return out
+
+            return df.map_partitions(per_part)
+
+    return OnnxScorerT(), din
+
+
+def _requester(address: str, body: bytes):
+    import http.client
+    import socket
+
+    host, port = address.split("//")[1].split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=60)
+    conn.connect()
+    conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def request():
+        conn.request("POST", "/", body=body)
+        r = conn.getresponse()
+        payload = r.read()
+        assert r.status == 200, (r.status, payload[:200])
+
+    request.close = conn.close
+    return request
+
+
+def _phase(address: str, body: bytes, clients: int, per_client: int) -> list:
+    """One concurrency phase; returns per-request latencies (ms)."""
+    lat_all: list = []
+    errors: list = []
+    ready = threading.Barrier(clients)
+
+    def loop():
+        try:
+            request = _requester(address, body)
+            ready.wait()
+            lat = []
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                request()
+                lat.append((time.perf_counter() - t0) * 1e3)
+            request.close()
+            lat_all.extend(lat)
+        except Exception as e:  # noqa: BLE001 — surfaced after join
+            errors.append(e)
+            try:
+                ready.abort()
+            except Exception:  # noqa: BLE001
+                pass
+
+    threads = [threading.Thread(target=loop) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise RuntimeError(f"{len(errors)} bench clients failed: "
+                           f"{errors[0]!r}") from errors[0]
+    return lat_all
+
+
+def _run_scheduler(scheduler: str, n_per_client: int = 80) -> dict:
+    from synapseml_tpu.core.batching import reset_compiled_cache
+    from synapseml_tpu.io.serving import serve_pipeline
+
+    cache = reset_compiled_cache()
+    stage, din = _make_onnx_scorer()
+    body = json.dumps({"features": [0.1] * din}).encode()
+    srv = serve_pipeline(stage, batch_interval_ms=5, scheduler=scheduler)
+    try:
+        _phase(srv.address, body, clients=2, per_client=10)  # warm compile
+        lat = []
+        t0 = time.perf_counter()
+        for clients in (1, 8, 32):  # mixed-size stream: 1..32-deep queues
+            lat.extend(_phase(srv.address, body, clients,
+                              per_client=n_per_client))
+        wall = time.perf_counter() - t0
+    finally:
+        srv.stop()
+    lat.sort()
+    stats = cache.stats()
+    lookups = stats["hits"] + stats["misses"]
+    return {"p50_ms": round(lat[len(lat) // 2], 3),
+            "p99_ms": round(lat[int(len(lat) * 0.99)], 3),
+            "rows_per_sec": round(len(lat) / wall, 1),
+            "n": len(lat),
+            "compile_cache": {**stats,
+                              "hit_rate": round(stats["hits"] / lookups, 4)
+                              if lookups else None}}
+
+
+def run(jax, platform, n_chips):
+    fixed = _run_scheduler("fixed")
+    adaptive = _run_scheduler("adaptive")
+    return {
+        "metric": "serving microbatch p99 (adaptive continuous batching)",
+        "value": adaptive["p99_ms"], "unit": "ms", "lower_is_better": True,
+        "platform": "cpu host (latency is host-side)",
+        "adaptive": adaptive,
+        "fixed_baseline": fixed,
+        "p99_vs_fixed": round(adaptive["p99_ms"] / fixed["p99_ms"], 3)
+        if fixed["p99_ms"] else None,
+        "throughput_vs_fixed": round(adaptive["rows_per_sec"]
+                                     / fixed["rows_per_sec"], 3)
+        if fixed["rows_per_sec"] else None,
+    }
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    print(json.dumps(run(jax, platform, n_chips)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,13 @@
+from .batching import (
+    CompiledCache,
+    ShapeBucketer,
+    default_bucketer,
+    get_compiled_cache,
+    instance_token,
+    invalidate_token,
+    reset_compiled_cache,
+    set_default_bucketer,
+)
 from .dataframe import DataFrame, Partition, concat_partitions, schema_of
 from .faults import FaultPlan, FaultSpec, active_fault_plan, inject_faults
 from .observability import (
@@ -35,6 +45,9 @@ __all__ = [
     "RetryPolicy", "RetryBudget", "CircuitBreaker", "Deadline", "DeadlineExpired",
     "resilience_measures", "reset_resilience_measures", "all_resilience_measures",
     "FaultPlan", "FaultSpec", "inject_faults", "active_fault_plan",
+    "ShapeBucketer", "CompiledCache", "get_compiled_cache",
+    "reset_compiled_cache", "default_bucketer", "set_default_bucketer",
+    "instance_token", "invalidate_token",
     "MetricsRegistry", "get_registry", "reset_registry",
     "register_instrumentation",
     "Tracer", "Span", "SpanContext", "get_tracer", "reset_tracer",

@@ -1,0 +1,411 @@
+"""Shared shape-bucketed batching + compiled-executable cache.
+
+The serve/predict hot path's central problem: Spark-style dynamic row counts
+vs XLA's static shapes. ``ONNXModel`` solved it privately (fixed-size padded
+microbatches -> one cached executable, ``onnx/model.py``); every other stage
+re-traced whenever the request batch size changed. This module makes the
+trick a framework-level service (the HFTA horizontal-fusion lesson crossed
+with TVM's ahead-of-time executable reuse — PAPERS.md):
+
+* :class:`ShapeBucketer` — a pow-2 (or configurable) bucket ladder for batch
+  and sequence dims with pad/unpad helpers. A variable request stream maps
+  onto a handful of static shapes, so the number of compiled executables is
+  bounded by the ladder, not by the number of distinct request sizes.
+* :class:`CompiledCache` — process-wide LRU of compiled callables keyed by
+  ``(fn_id, bucket_shape, dtype)``. Thread-safe; hit/miss/evict counters and
+  a trace-time histogram land in the :mod:`~synapseml_tpu.core.observability`
+  registry, and every miss's first trace runs under a ``compile`` span so
+  recompiles are visible in the serving timeline.
+
+Adoption convention (enforced by the static check in ``test_codegen.py``):
+stage transform paths never call ``jax.jit`` inline — the jit lives inside a
+builder function (named ``build``/``_build*``) handed to
+:meth:`CompiledCache.get`, so acquisition is always counted, bounded, and
+warmable (``/admin/load`` precompiles the serve ladder through this cache).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+from . import observability as obs
+
+__all__ = [
+    "ShapeBucketer", "CompiledCache",
+    "get_compiled_cache", "reset_compiled_cache",
+    "default_bucketer", "set_default_bucketer",
+    "instance_token", "invalidate_token", "release_executables",
+    "pad_rows", "unpad_rows", "round_up_to_multiple",
+]
+
+
+# hot-path metric handles (HandleCache: one registry-identity check per
+# event instead of get-or-create lock traffic)
+_CACHE_METRICS = obs.HandleCache(lambda reg: {
+    "hits": reg.counter(
+        "synapseml_compile_cache_hits_total",
+        "CompiledCache lookups served by an existing executable", ("fn",)),
+    "misses": reg.counter(
+        "synapseml_compile_cache_misses_total",
+        "CompiledCache lookups that built a new executable", ("fn",)),
+    "evictions": reg.counter(
+        "synapseml_compile_cache_evictions_total",
+        "CompiledCache LRU evictions", ("fn",)),
+    "trace_ms": reg.histogram(
+        "synapseml_compile_trace_ms",
+        "wall time of the first (tracing/compiling) call of a cache miss",
+        ("fn",)),
+})
+
+
+class ShapeBucketer:
+    """Pow-2 / configurable bucket ladder for batch (and sequence) dims.
+
+    ``bucket_for(n)`` returns the smallest ladder rung >= n, so any stream of
+    sizes compiles at most ``len(ladder)`` executables per function. ``cap``
+    arguments (a stage's ``batch_size``/``mini_batch_size``) bound memory:
+    :meth:`slices` chunks at the largest rung <= cap and pads only the final
+    partial chunk to its own rung — a 3-row request pays a rung-of-8
+    executable, not the full-cap one."""
+
+    def __init__(self, ladder: Sequence[int] | None = None,
+                 min_bucket: int = 8, max_bucket: int = 1024):
+        if ladder is not None:
+            rungs = sorted({int(b) for b in ladder})
+            if not rungs or rungs[0] < 1:
+                raise ValueError(f"bucket ladder must be positive ints: {ladder}")
+        else:
+            rungs, b = [], max(int(min_bucket), 1)
+            while b <= int(max_bucket):
+                rungs.append(b)
+                b *= 2
+            if not rungs:
+                raise ValueError(
+                    f"empty pow-2 ladder: min_bucket={min_bucket} > "
+                    f"max_bucket={max_bucket}")
+        self.ladder: tuple[int, ...] = tuple(rungs)
+
+    def __repr__(self):
+        return f"ShapeBucketer(ladder={list(self.ladder)})"
+
+    @property
+    def max_bucket(self) -> int:
+        return self.ladder[-1]
+
+    def bucket_for(self, n: int, multiple_of: int = 1) -> int:
+        """Smallest rung >= n (rounded up to ``multiple_of`` for mesh
+        data-parallel divisibility). Sizes beyond the ladder keep their own
+        exact shape — large offline scoring batches must not pad toward the
+        next pow-2 (up to 2x wasted compute); only serving-sized batches
+        bucket."""
+        n = max(int(n), 1)
+        bucket = n
+        for rung in self.ladder:
+            if rung >= n:
+                bucket = rung
+                break
+        return _round_up(bucket, multiple_of)
+
+    def cap_for(self, max_rows: int, multiple_of: int = 1) -> int:
+        """Chunking cap: the largest rung <= max_rows, EXCEPT when max_rows
+        sits outside the ladder entirely — below the smallest rung it stays
+        a hard memory bound (never rounded up), above the largest rung it is
+        honored exactly (a configured batch_size of 2048 must not be
+        silently halved to the top rung on offline scans)."""
+        cap = max(int(max_rows), 1)
+        if cap <= self.ladder[-1]:
+            for rung in reversed(self.ladder):
+                if rung <= cap:
+                    cap = rung
+                    break
+        return _round_up(cap, multiple_of)
+
+    def buckets_upto(self, max_rows: int, multiple_of: int = 1) -> list[int]:
+        """Every bucket :meth:`slices` can emit for a stream capped at
+        ``max_rows`` — the warmup/precompile set, and the compile-count bound
+        a mixed-size request stream must stay under."""
+        cap = self.cap_for(max_rows, multiple_of)
+        out = sorted({_round_up(r, multiple_of)
+                      for r in self.ladder if r <= cap} | {cap})
+        return out
+
+    def slices(self, n: int, max_rows: int,
+               multiple_of: int = 1) -> Iterator[tuple[int, int, int]]:
+        """Yield ``(start, stop, bucket)`` chunks covering ``n`` rows: full
+        chunks of the ladder-aligned cap, the final partial chunk padded to
+        its own (smaller) rung."""
+        if n <= 0:
+            return
+        cap = self.cap_for(max_rows, multiple_of)
+        for start in range(0, n, cap):
+            stop = min(start + cap, n)
+            yield start, stop, min(self.bucket_for(stop - start, multiple_of),
+                                   cap)
+
+
+def round_up_to_multiple(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` >= ``n`` (the one shared implementation;
+    ``parallel/batching.py`` — the training-side batcher — re-exports it)."""
+    m = max(int(m), 1)
+    return ((int(n) + m - 1) // m) * m
+
+
+_round_up = round_up_to_multiple
+
+
+def pad_rows(a: np.ndarray, bucket: int, mode: str = "zero",
+             constant: float = 0) -> np.ndarray:
+    """Pad the leading (row) dim up to ``bucket``. ``mode='edge'`` repeats
+    the last real row (ONNXModel's padding — safe for models where an
+    all-zero row could hit a different numeric path); ``'constant'`` fills
+    with ``constant`` (attention masks pad with 1 so pooled denominators
+    stay nonzero)."""
+    if a.dtype == object:
+        raise TypeError("cannot pad an object-dtype column; featurize it "
+                        "into a rectangular array first")
+    n = a.shape[0]
+    pad = int(bucket) - n
+    if pad <= 0:
+        return a
+    if mode == "edge" and n:
+        block = np.repeat(a[-1:], pad, axis=0)
+    else:
+        fill = constant if mode == "constant" else 0
+        block = np.full((pad,) + a.shape[1:], fill, dtype=a.dtype)
+    return np.concatenate([a, block], axis=0)
+
+
+def unpad_rows(a, n_valid: int) -> np.ndarray:
+    """Strip padded rows off a device result."""
+    return np.asarray(a)[: int(n_valid)]
+
+
+class CompiledCache:
+    """Thread-safe LRU of compiled callables keyed by
+    ``(fn_id, instance, bucket_shape, dtype)``.
+
+    ``get`` returns the cached callable or invokes ``build`` (which returns
+    the jitted callable — the only place stage code may touch ``jax.jit``).
+    The miss's FIRST invocation is wrapped in a ``compile`` tracer span and
+    its wall time lands in ``synapseml_compile_trace_ms{fn=...}`` — that
+    first call is where JAX actually traces/compiles, so recompile stalls
+    show up attributed in the serving timeline. Eviction drops the jit
+    wrapper (and with it the underlying executables) once the cache exceeds
+    ``capacity``."""
+
+    def __init__(self, capacity: int = 128):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Callable]" = OrderedDict()
+        # local mirrors of the registry counters: cheap to read in tests and
+        # bench loops without parsing the exposition
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions, "size": len(self._entries)}
+
+    def miss_count(self, fn_id: str) -> float:
+        """Registry-backed per-function miss count (the acceptance surface:
+        a mixed-size stream must stay <= the ladder size)."""
+        return _CACHE_METRICS.get()["misses"].labels(fn=fn_id).value
+
+    def get(self, fn_id: str, shape: tuple, build: Callable[[], Callable],
+            *, instance: Any = None, dtype: Any = None) -> Callable:
+        """The one jit-acquisition door. ``fn_id`` labels the metric series
+        (e.g. ``"onnx_model"``); ``shape`` is the bucketed static shape key;
+        ``instance`` discriminates stage instances/configs (use
+        :func:`instance_token`, NOT ``id(self)`` — ids get reused after GC);
+        ``dtype`` joins the key for dtype-polymorphic functions."""
+        key = (fn_id, instance, tuple(shape), dtype)
+        m = _CACHE_METRICS.get()
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                m["hits"].inc(fn=fn_id)
+                return fn
+        # build outside the lock: builders are cheap (a jax.jit wrapper) but
+        # may import jax lazily; a concurrent duplicate build is harmless
+        # (last writer wins, both callables compute the same thing)
+        built = build()
+        fn = self._traced_first_call(built, fn_id, key)
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                m["hits"].inc(fn=fn_id)
+                return existing
+            self._entries[key] = fn
+            self.misses += 1
+            m["misses"].inc(fn=fn_id)
+            while len(self._entries) > self.capacity:
+                evicted_key, _ = self._entries.popitem(last=False)
+                self.evictions += 1
+                # attribute the eviction to the EVICTED entry's function —
+                # that's the stage whose next request pays the recompile
+                m["evictions"].inc(fn=evicted_key[0])
+        return fn
+
+    def _traced_first_call(self, fn: Callable, fn_id: str,
+                           key: tuple) -> Callable:
+        """Wrap so the first invocation (the real trace+compile) runs under
+        a ``compile`` span + trace-time histogram; later calls pay one bool
+        check."""
+        state = {"first": True}
+        first_lock = threading.Lock()
+
+        def wrapper(*args, **kwargs):
+            if state["first"]:
+                with first_lock:
+                    if state["first"]:
+                        t0 = time.perf_counter()
+                        with obs.get_tracer().span(
+                                "compile",
+                                {"fn": fn_id, "shape": str(key[2])}):
+                            out = fn(*args, **kwargs)
+                        _CACHE_METRICS.get()["trace_ms"].observe(
+                            (time.perf_counter() - t0) * 1e3, fn=fn_id)
+                        state["first"] = False
+                        return out
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    def evict_instance(self, instance: Any) -> int:
+        """Drop every entry keyed to ``instance`` (a stage's token). Called
+        when a token is invalidated or a pipeline is hot-swapped out — an
+        orphaned entry's build() closure pins the dead stage's full weights
+        until LRU churn, which an idle server never generates. In-flight
+        calls holding the callable keep working; only the cache's reference
+        is dropped."""
+        m = _CACHE_METRICS.get()
+        with self._lock:
+            doomed = [k for k in self._entries if k[1] == instance]
+            for k in doomed:
+                del self._entries[k]
+                self.evictions += 1
+                m["evictions"].inc(fn=k[0])
+        return len(doomed)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+# ---------------------------------------------------------------------------
+# process-wide defaults
+# ---------------------------------------------------------------------------
+
+_DEFAULT_CACHE = CompiledCache()
+_DEFAULT_BUCKETER = ShapeBucketer()
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_compiled_cache() -> CompiledCache:
+    """The process-wide cache every adopted stage acquires its jits from."""
+    return _DEFAULT_CACHE
+
+
+def reset_compiled_cache(capacity: int = 128) -> CompiledCache:
+    """Fresh process-wide cache (tests). Registry counters are NOT reset —
+    use ``observability.reset_registry()`` for that."""
+    global _DEFAULT_CACHE
+    with _DEFAULT_LOCK:
+        _DEFAULT_CACHE = CompiledCache(capacity)
+        return _DEFAULT_CACHE
+
+
+def default_bucketer() -> ShapeBucketer:
+    """The process-wide bucket ladder (pow-2 from 8 to 1024 unless
+    replaced)."""
+    return _DEFAULT_BUCKETER
+
+
+def set_default_bucketer(bucketer: ShapeBucketer) -> ShapeBucketer:
+    """Swap the process-wide ladder (serving config / tests); returns the
+    previous one so callers can restore it."""
+    global _DEFAULT_BUCKETER
+    with _DEFAULT_LOCK:
+        previous = _DEFAULT_BUCKETER
+        _DEFAULT_BUCKETER = bucketer
+        return previous
+
+
+# ---------------------------------------------------------------------------
+# instance tokens: stable cache-key discriminators per stage instance
+# ---------------------------------------------------------------------------
+
+_TOKEN_SLOT = "_compiled_cache_token"
+
+
+def instance_token(obj: Any) -> str:
+    """Random per-instance token for CompiledCache keys. Unlike ``id(obj)``
+    it is never reused after GC, and unlike a process-local counter it
+    cannot collide across pickling boundaries (a stage pickled into a
+    distributed-serving worker keeps its token, and any stage freshly
+    minted in that worker draws a disjoint uuid — two DIFFERENT stages can
+    never alias one executable, while identical pickled copies share theirs
+    safely: any config change invalidates the token). Lazily created so
+    stages built via ``cls.__new__`` (deserialization) work. Minting is
+    locked: two serve-loop threads racing the first call on a shared stage
+    must agree on ONE token, or each would populate the cache under its own
+    and duplicate every compile."""
+    tok = obj.__dict__.get(_TOKEN_SLOT)
+    if tok is None:
+        with _DEFAULT_LOCK:
+            tok = obj.__dict__.get(_TOKEN_SLOT)
+            if tok is None:
+                tok = obj.__dict__[_TOKEN_SLOT] = uuid.uuid4().hex
+    return tok
+
+
+def invalidate_token(obj: Any) -> None:
+    """Drop the instance token — the next :func:`instance_token` call mints
+    a fresh one — and evict the old token's executables from the default
+    cache (a dead config's closures pin its captured weights otherwise)."""
+    tok = obj.__dict__.pop(_TOKEN_SLOT, None)
+    if tok is not None:
+        get_compiled_cache().evict_instance(tok)
+
+
+def release_executables(stage: Any) -> None:
+    """Invalidate the tokens of ``stage`` and any nested stages (Pipeline /
+    PipelineModel ``stages`` param), evicting their cached executables —
+    the hot-swap path calls this on the REPLACED pipeline so serving
+    workers don't accumulate one dead model's weights per swap."""
+    seen: set[int] = set()
+
+    def walk(obj):
+        if obj is None or id(obj) in seen:
+            return
+        seen.add(id(obj))
+        invalidate_token(obj)
+        getter = getattr(obj, "get", None)
+        if callable(getter):
+            try:
+                children = getter("stages")
+            except Exception:  # noqa: BLE001 — not every stage has 'stages'
+                return
+            if isinstance(children, (list, tuple)):
+                for child in children:
+                    walk(child)
+
+    walk(stage)

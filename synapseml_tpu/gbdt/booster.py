@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import batching as cb
 from .binning import BinMapper
 from . import objectives as obj
 from . import trees as T
@@ -74,9 +75,15 @@ class TpuBooster:
         self._predict_cache = {}
 
     # ---------------- prediction ----------------
-    def _raw_fn(self, num_iters: int) -> Callable:
-        key = ("raw", num_iters)
-        if key not in self._predict_cache:
+    def _raw_fn(self, num_iters: int, bucket: int | None) -> Callable:
+        """Scoring executable per (iteration count, row bucket). Ladder
+        buckets go through the process-wide CompiledCache (serving-sized
+        request streams reuse ladder-many compiled forests instead of
+        retracing per batch size); ``bucket=None`` (beyond-ladder offline
+        scans) keeps ONE shape-polymorphic jit in the per-instance
+        ``_predict_cache`` — arbitrary large batch sizes must not churn the
+        shared LRU and evict other stages' warmed serving executables."""
+        def build():
             feat = jnp.asarray(self.feature[:num_iters])
             thr = jnp.asarray(self.threshold_value[:num_iters])
             val = jnp.asarray(self.leaf_value[:num_iters])
@@ -88,7 +95,6 @@ class TpuBooster:
 
             avg = 1.0 / num_iters if self.average_output else 1.0
 
-            @jax.jit
             def raw(x):
                 outs = [T.predict_raw_forest(
                     x, feat[:, k], thr[:, k], val[:, k], depth,
@@ -96,15 +102,32 @@ class TpuBooster:
                         for k in range(K)]
                 return jnp.stack(outs, axis=1) * avg + init[None, :]
 
-            self._predict_cache[key] = raw
-        return self._predict_cache[key]
+            return jax.jit(raw)
+
+        if bucket is None:
+            key = ("raw", num_iters)
+            if key not in self._predict_cache:
+                self._predict_cache[key] = build()
+            return self._predict_cache[key]
+        return cb.get_compiled_cache().get(
+            "gbdt_predict", (num_iters, bucket, self.num_features), build,
+            instance=cb.instance_token(self), dtype="float32")
 
     def raw_score(self, features: np.ndarray, num_iterations: int | None = None) -> np.ndarray:
-        """(N, K) raw margin scores."""
-        x = jnp.asarray(np.asarray(features, dtype=np.float32))
+        """(N, K) raw margin scores. Serving-sized batches pad up to the
+        bucket ladder (bounded compiles under a variable request stream);
+        batches past the ladder keep their exact shape — a 1M-row training
+        scan must not pad toward the next pow-2."""
+        x = np.asarray(features, dtype=np.float32)
         n_it = num_iterations or self.best_iteration or self.num_iterations
         n_it = min(n_it, self.num_iterations)
-        return np.asarray(self._raw_fn(n_it)(x))
+        n = x.shape[0]
+        bucketer = cb.default_bucketer()
+        if n > bucketer.max_bucket:
+            return np.asarray(self._raw_fn(n_it, None)(jnp.asarray(x)))
+        bucket = bucketer.bucket_for(n)
+        out = self._raw_fn(n_it, bucket)(jnp.asarray(cb.pad_rows(x, bucket)))
+        return cb.unpad_rows(out, n)
 
     def predict(self, features: np.ndarray, num_iterations: int | None = None) -> np.ndarray:
         """Objective-transformed predictions: probabilities for binary

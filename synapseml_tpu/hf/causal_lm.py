@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..core import batching as cb
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Transformer
@@ -86,7 +87,7 @@ class HuggingFaceCausalLM(Transformer):
         out = super().set(**kw)
         if self._CACHE_KEYS & kw.keys():
             self.__dict__.pop("_cache_model", None)
-            self.__dict__.pop("_cache_gen", None)
+            cb.invalidate_token(self)  # cached executables captured old state
         return out
 
     # ---- lazy model/tokenizer ----
@@ -151,13 +152,16 @@ class HuggingFaceCausalLM(Transformer):
         return eff
 
     def _generate_fn(self, B: int, P: int, eff: dict):
-        import jax
+        """Per-(batch bucket, prompt bucket, generation config) executable
+        through the CompiledCache — the jit population stays bounded by
+        ladder size x distinct configs, LRU-evicted, and its misses/trace
+        times are observable."""
+        eff_key = tuple(eff[k] for k in self._GEN_KEYS)
 
-        key = ("gen", B, P) + tuple(eff[k] for k in self._GEN_KEYS)
-        cache = self.__dict__.setdefault("_cache_gen", {})
-        if key not in cache:
+        def build():
+            import jax
+
             model, params, _, mesh = self._model_and_params()
-
             sampling = eff["do_sample"]
             temperature = float(eff["temperature"]) if sampling else 0.0
             top_k = eff["top_k"]
@@ -192,10 +196,12 @@ class HuggingFaceCausalLM(Transformer):
                         return _j(_m.shard_batch(ids), _m.shard_batch(mask),
                                   offset)
 
-                cache[key] = run
-            else:
-                cache[key] = jitted
-        return cache[key]
+                return run
+            return jitted
+
+        return cb.get_compiled_cache().get(
+            "hf_causal_lm", (B, P) + eff_key, build,
+            instance=cb.instance_token(self), dtype="int32")
 
     def _texts_of(self, p) -> list[str]:
         mc = self.get("messages_col")
@@ -211,6 +217,8 @@ class HuggingFaceCausalLM(Transformer):
         model, params, tok, _mesh = self._model_and_params()
         B = self.get("batch_size")
         bucket = self.get("prompt_bucket")
+        dp = _mesh.data_parallel_size() if _mesh is not None else 1
+        bucketer = cb.default_bucketer()
 
         pcol = self.get("generation_params_col")
 
@@ -243,16 +251,15 @@ class HuggingFaceCausalLM(Transformer):
                 ids = np.asarray(enc["input_ids"], np.int32)
                 mask = np.asarray(enc["attention_mask"], np.int32)
                 P = ids.shape[1]
-                fn = self._generate_fn(B, P, eff)
                 outs = []
                 m = len(ix)
-                for s in range(0, m, B):
-                    e = min(s + B, m)
-                    pad = B - (e - s)
-                    ib = np.pad(ids[s:e], ((0, pad), (0, 0)))
-                    mb = np.pad(mask[s:e], ((0, pad), (0, 0)), constant_values=1)
-                    gen = np.asarray(fn(ib, mb,
-                                        np.int32(part_offset + int(ix[s]))))[: e - s]
+                for s, e, row_bucket in bucketer.slices(m, B, multiple_of=dp):
+                    ib = cb.pad_rows(ids[s:e], row_bucket)
+                    mb = cb.pad_rows(mask[s:e], row_bucket,
+                                     mode="constant", constant=1)
+                    fn = self._generate_fn(row_bucket, P, eff)
+                    gen = cb.unpad_rows(
+                        fn(ib, mb, np.int32(part_offset + int(ix[s]))), e - s)
                     outs.append(gen[:, P:])                 # generated ids only
                 gen_ids = np.concatenate(outs, axis=0)
                 for j, i in enumerate(ix):

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..core import batching as cb
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Transformer
@@ -67,6 +68,7 @@ class HuggingFaceSentenceEmbedder(Transformer):
         out = super().set(**kw)
         if self._CACHE_KEYS & kw.keys():
             self.__dict__.pop("_cache_model", None)
+            cb.invalidate_token(self)  # cached executables captured old state
         return out
 
     def _setup(self):
@@ -126,20 +128,36 @@ class HuggingFaceSentenceEmbedder(Transformer):
                         jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
                 return pooled
 
+            self.__dict__["_cache_model"] = (embed_fn, tok, mesh)
+        return self.__dict__["_cache_model"]
+
+    def _embed_for(self, bucket: int, seq_len: int):
+        """Per-(batch bucket, seq len) executable via the CompiledCache —
+        a mixed request stream compiles at most ladder-many programs per
+        sequence shape instead of one per distinct batch size."""
+        embed_fn, _tok, mesh = self._setup()
+
+        def build():
+            import jax
+
             jitted = jax.jit(embed_fn)
             if mesh is not None:
-                def embed(ids, mask, _j=jitted, _m=mesh):
+                def sharded(ids, m, _j=jitted, _m=mesh):
                     with _m.mesh:
-                        return _j(_m.shard_batch(ids), _m.shard_batch(mask))
-            else:
-                embed = jitted
-            self.__dict__["_cache_model"] = (embed, tok)
-        return self.__dict__["_cache_model"]
+                        return _j(_m.shard_batch(ids), _m.shard_batch(m))
+                return sharded
+            return jitted
+
+        return cb.get_compiled_cache().get(
+            "hf_embedder", (bucket, seq_len), build,
+            instance=cb.instance_token(self), dtype="int32")
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("input_col"))
-        embed, tok = self._setup()
+        _embed_fn, tok, mesh = self._setup()
         B = self.get("batch_size")
+        dp = mesh.data_parallel_size() if mesh is not None else 1
+        bucketer = cb.default_bucketer()
 
         def per_part(p):
             texts = [str(t) for t in p[self.get("input_col")]]
@@ -152,12 +170,14 @@ class HuggingFaceSentenceEmbedder(Transformer):
             ids = np.asarray(enc["input_ids"], np.int32)
             mask = np.asarray(enc["attention_mask"], np.int32)
             chunks = []
-            for s in range(0, n, B):
-                e = min(s + B, n)
-                pad = B - (e - s)
-                ib = np.pad(ids[s:e], ((0, pad), (0, 0)))
-                mb = np.pad(mask[s:e], ((0, pad), (0, 0)), constant_values=1)
-                chunks.append(np.asarray(embed(ib, mb))[: e - s])
+            for s, e, bucket in bucketer.slices(n, B, multiple_of=dp):
+                ib = cb.pad_rows(ids[s:e], bucket)
+                # padded rows keep mask=1 so pooled denominators stay
+                # nonzero; their embeddings are sliced off below
+                mb = cb.pad_rows(mask[s:e], bucket, mode="constant",
+                                 constant=1)
+                embed = self._embed_for(bucket, ids.shape[1])
+                chunks.append(cb.unpad_rows(embed(ib, mb), e - s))
             q = dict(p)
             q[self.get("output_col")] = np.concatenate(chunks, axis=0)
             return q

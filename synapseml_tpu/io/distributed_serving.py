@@ -35,6 +35,7 @@ import urllib.request
 import weakref
 from http.server import BaseHTTPRequestHandler
 
+from ..core import batching as cb
 from ..core import faults as _faults
 from ..core import observability as obs
 from ..core.resilience import CircuitBreaker, resilience_measures
@@ -111,6 +112,19 @@ _ROUTE_METRICS = obs.HandleCache(lambda reg: {
         "synapseml_route_shadow_latency_delta_ms",
         "shadow latency minus primary latency for the same request",
         ("version",)),
+    # continuous-batching coalescer: how full the same-path groups run and
+    # how much padding the workers' bucket ladder will spend on them
+    "bucket_occupancy": reg.histogram(
+        "synapseml_route_bucket_occupancy",
+        "requests per coalesced same-path group released to one worker",
+        ("version",), buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)),
+    "padded_rows": reg.counter(
+        "synapseml_route_padded_rows_total",
+        "rows of bucket padding the released group sizes imply",
+        ("version",)),
+    "real_rows": reg.counter(
+        "synapseml_route_real_rows_total",
+        "real request rows released through the coalescer", ("version",)),
 })
 
 
@@ -299,6 +313,66 @@ def _pooled_request(pool: _ConnPool, key: tuple, method: str, path: str,
     raise ConnectionError(f"worker {key} failed on a fresh connection")
 
 
+class _CoalesceGroup:
+    """One batch-in-flight of same-path requests: all members forward to the
+    same candidate ordering, so the chosen worker's continuous-batching
+    scheduler drains them as one bucket-sized batch."""
+
+    __slots__ = ("path", "count", "closed", "release", "lock", "candidates",
+                 "desperate")
+
+    def __init__(self, path: str):
+        self.path = path
+        self.count = 0
+        self.closed = False
+        self.release = threading.Event()
+        self.lock = threading.Lock()
+        self.candidates = None
+        self.desperate = False
+
+
+class _RequestCoalescer:
+    """Groups same-path requests arriving within ``window_s`` so they land
+    on the SAME worker back-to-back instead of round-robining one row to
+    every worker in the fleet. The first joiner (leader) holds the group
+    open until a full bucket's worth (``max_group``) joins or the window
+    expires; followers ride the leader's release. Occupancy and the padding
+    the workers' bucket ladder will spend on each released group are
+    exported per version (``synapseml_route_bucket_occupancy`` /
+    ``_padded_rows_total`` / ``_real_rows_total``)."""
+
+    def __init__(self, window_s: float, max_group: int = 64):
+        self.window_s = float(window_s)
+        self.max_group = int(max_group)
+        self._lock = threading.Lock()
+        self._open: dict[str, _CoalesceGroup] = {}
+
+    def join(self, path: str) -> _CoalesceGroup:
+        with self._lock:
+            group = self._open.get(path)
+            leader = group is None or group.closed
+            if leader:
+                group = self._open[path] = _CoalesceGroup(path)
+            group.count += 1
+            if group.count >= self.max_group:
+                group.closed = True
+                if self._open.get(path) is group:
+                    del self._open[path]
+                group.release.set()
+        if leader:
+            group.release.wait(self.window_s)
+            with self._lock:
+                group.closed = True
+                if self._open.get(path) is group:
+                    del self._open[path]
+            group.release.set()
+        else:
+            # followers outwait the leader slightly; a lost wakeup degrades
+            # to forwarding solo, never to a dropped request
+            group.release.wait(self.window_s + 0.25)
+        return group
+
+
 class RoutingFront:
     """One public port; round-robin forwarding to live workers over
     PERSISTENT (keep-alive) worker connections; ``GET /routes`` returns the
@@ -343,9 +417,16 @@ class RoutingFront:
     def __init__(self, workers: list[dict] | None = None, port: int = 0,
                  timeout_s: float = 60.0, registry: "WorkerRegistry" = None,
                  resurrect_after_s: float = 2.0,
-                 max_inflight_shadows: int = 8):
+                 max_inflight_shadows: int = 8,
+                 coalesce_window_ms: float = 0.0,
+                 coalesce_max_group: int = 64):
         if workers is None and registry is None:
             raise ValueError("RoutingFront needs workers and/or a registry")
+        # same-path coalescing toward bucket-sized worker batches (0 = off,
+        # the latency-neutral default; enable for throughput-bound fleets)
+        self._coalescer = (_RequestCoalescer(coalesce_window_ms / 1000.0,
+                                             coalesce_max_group)
+                           if coalesce_window_ms > 0 else None)
         self._static_workers = list(workers or [])
         self._registry = registry
         self._resurrect_after_s = resurrect_after_s
@@ -433,8 +514,15 @@ class RoutingFront:
                 # stitch the forwarded hop to the route.request span: the
                 # worker's serving.request span becomes its child
                 obs.get_tracer().inject(hdrs)
-                t0 = time.perf_counter()
-                candidates, desperate = front._candidates()
+                if front._coalescer is not None and method == "POST":
+                    group = front._coalescer.join(self.path)
+                    # t0 starts AFTER the coalesce wait: pick_ms measures
+                    # pure worker-pick overhead, not the batching window
+                    t0 = time.perf_counter()
+                    candidates, desperate = front._group_candidates(group)
+                else:
+                    t0 = time.perf_counter()
+                    candidates, desperate = front._candidates()
                 tried = 0
                 for w in candidates:
                     key = (w.get("host"), w.get("port"))
@@ -561,6 +649,24 @@ class RoutingFront:
         stalest = min(table, key=lambda w: self._breaker(
             (w.get("host"), w.get("port"))).last_failure_at or 0.0)
         return [stalest], True
+
+    def _group_candidates(self, group: "_CoalesceGroup"):
+        """One candidate ordering per coalesced group — every member
+        forwards to the same worker first, so the worker's serve loop sees
+        the whole group as one micro-batch. The first member to arrive here
+        also accounts the group's occupancy/padding series."""
+        with group.lock:
+            if group.candidates is None:
+                group.candidates, group.desperate = self._candidates()
+                rm = _ROUTE_METRICS.get()
+                version = (_version_of(group.candidates[0])
+                           if group.candidates else "unversioned")
+                n = group.count
+                bucket = cb.default_bucketer().bucket_for(n)
+                rm["bucket_occupancy"].observe(n, version=version)
+                rm["real_rows"].inc(n, version=version)
+                rm["padded_rows"].inc(bucket - n, version=version)
+            return group.candidates, group.desperate
 
     # -- deployment plane: canary splits, shadow traffic, version stats ----
     def set_traffic_split(self, split: dict[str, float] | None) -> None:
@@ -966,11 +1072,25 @@ class DistributedServing:
 def serve_pipeline_distributed(pipeline, num_workers: int = 2,
                                batch_interval_ms: int = 0,
                                startup_timeout_s: float = 90.0,
-                               version: str | None = None) -> DistributedServing:
+                               version: str | None = None,
+                               coalesce_window_ms: float = 0.0) -> DistributedServing:
     """Serve a (picklable) Transformer across ``num_workers`` OS processes
     behind one routed public port — the DistributedHTTPSource analog.
     ``version`` labels the initial pipeline for the deployment plane
-    (canary splits + per-version metrics; see ``registry/deploy.py``)."""
+    (canary splits + per-version metrics; see ``registry/deploy.py``).
+    ``coalesce_window_ms`` > 0 groups same-path requests at the front so
+    they reach one worker as a bucket-sized batch (continuous batching
+    across the fleet) — padding-waste and occupancy land in the metrics
+    registry per version. Coalescing requires micro-batch workers
+    (``batch_interval_ms`` > 0): funneling a group at a continuous worker
+    that drains one row per loop would add the window's latency and
+    serialize the group on one process for zero batching gain."""
+    if coalesce_window_ms > 0 and batch_interval_ms == 0:
+        raise ValueError(
+            "coalesce_window_ms requires micro-batch workers: set "
+            "batch_interval_ms > 0 so the chosen worker drains the "
+            "coalesced group as one batch (continuous workers drain one "
+            "row per loop — the group would serialize for no gain)")
     import tempfile
 
     fd, path = tempfile.mkstemp(suffix=".pipeline.pkl")
@@ -1005,7 +1125,8 @@ def serve_pipeline_distributed(pipeline, num_workers: int = 2,
             p.terminate()
         registry.close()
         raise
-    front = RoutingFront(registry=registry)
+    front = RoutingFront(registry=registry,
+                         coalesce_window_ms=coalesce_window_ms)
     return DistributedServing(front, registry, procs, path, spawn=spawn)
 
 

@@ -27,11 +27,20 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..core import batching as cb
 from ..core import observability as obs
 from ..core.dataframe import DataFrame
 
 __all__ = ["ServingServer", "serve_pipeline", "NoDelayHTTPServer",
            "PipelineHolder"]
+
+# batch-size histogram rungs: one bucket per pow-2 occupancy up to the
+# serve-loop max (NOT latency buckets — these count rows per micro-batch)
+_BATCH_ROW_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+# default /admin/load warmup precompiles ladder rungs up to this many rows;
+# an explicit serve_pipeline(bucket_ladder=...) warms its full ladder
+_DEFAULT_WARMUP_CAP = 64
 
 # hot-path metric handles, re-resolved only when the registry is replaced
 _SERVING_METRICS = obs.HandleCache(lambda reg: {
@@ -47,6 +56,14 @@ _SERVING_METRICS = obs.HandleCache(lambda reg: {
     "swaps": reg.counter(
         "synapseml_serving_pipeline_swaps_total",
         "hot pipeline swaps on this worker, by outcome", ("outcome",)),
+    "batch_rows": reg.histogram(
+        "synapseml_serving_batch_rows",
+        "rows per drained serve-loop micro-batch (continuous batching "
+        "occupancy)", buckets=_BATCH_ROW_BUCKETS).labels(),
+    "expired": reg.counter(
+        "synapseml_serving_expired_requests_total",
+        "queued requests dropped because their reply deadline passed "
+        "before batch pickup").labels(),
 })
 
 
@@ -148,6 +165,14 @@ class ServingServer:
         # the serve loop does, or warmup success proves nothing)
         self.pipeline_holder: PipelineHolder | None = None
         self._loop_cfg = {"parse_json": True, "input_col": "body"}
+        # serve-loop bucket ladder (set by serve_pipeline): the adaptive
+        # scheduler's flush rungs. _warmup_buckets is the /admin/load
+        # precompile set — the full ladder when explicitly configured, the
+        # latency-sensitive small rungs otherwise (a default full-ladder
+        # warmup of a heavy model can outlast the deploy plane's load
+        # timeout; big batches amortize a compile stall anyway)
+        self._bucket_ladder: tuple | None = None
+        self._warmup_buckets: tuple = ()
         # bounded: a stalled pipeline sheds load with 503s instead of parking
         # unbounded connections (backpressure the round-1 loop lacked)
         self._queue: "queue.Queue[_Exchange]" = queue.Queue(maxsize=max_queue)
@@ -284,26 +309,39 @@ class ServingServer:
         pipeline, version = holder.get()
         return {"version": version, "pipeline": type(pipeline).__name__}
 
-    def _warmup(self, stage, rows: list) -> int:
+    def _warmup(self, stage, rows: list,
+                buckets: "list[int] | None" = None) -> int:
         """Run ``rows`` (JSON-able request bodies) through ``stage`` with
-        the SAME batch preparation the serve loop uses. Raises on any
-        transform failure — a pipeline that cannot serve its warmup batch
-        must never be swapped in."""
+        the SAME batch preparation the serve loop uses. When ``buckets`` is
+        set (or the server has a configured ladder), the rows are cycled up
+        to EACH bucket size and transformed once per bucket — every serve
+        rung's executable compiles through the CompiledCache before the
+        swap, so a hot-swap never pays first-request compile latency
+        (zero-compile-stall, extending PR-3's zero-drop guarantee). Raises
+        on any transform failure — a pipeline that cannot serve its warmup
+        batch must never be swapped in."""
         if not rows:
             return 0
         bodies = [r if isinstance(r, bytes)
                   else (r.encode() if isinstance(r, str)
                         else json.dumps(r).encode()) for r in rows]
-        batch = DataFrame([{
-            "id": np.asarray([f"warmup-{i}" for i in range(len(bodies))],
-                             dtype=object),
-            "method": np.asarray(["POST"] * len(bodies), dtype=object),
-            "path": np.asarray(["/"] * len(bodies), dtype=object),
-            "body": np.asarray(bodies, dtype=object),
-        }])
-        batch = _prepare_batch(batch, **self._loop_cfg)
-        stage.transform(batch)
-        return len(bodies)
+        if buckets is None:
+            buckets = list(self._warmup_buckets)
+        sizes = sorted({int(b) for b in buckets} | {len(bodies)})
+        total = 0
+        for size in sizes:
+            batch_bodies = [bodies[i % len(bodies)] for i in range(size)]
+            batch = DataFrame([{
+                "id": np.asarray([f"warmup-{i}" for i in range(size)],
+                                 dtype=object),
+                "method": np.asarray(["POST"] * size, dtype=object),
+                "path": np.asarray(["/"] * size, dtype=object),
+                "body": np.asarray(batch_bodies, dtype=object),
+            }])
+            batch = _prepare_batch(batch, **self._loop_cfg)
+            stage.transform(batch)
+            total += size
+        return total
 
     def _admin_load(self, body: bytes) -> tuple[int, dict]:
         """Load a new pipeline version side-by-side, warm it, atomically
@@ -311,7 +349,9 @@ class ServingServer:
         url>, "model": <name>, "ref": <version or alias>}``, plus optional
         ``"version"`` label and ``"warmup"`` (list of request bodies). The
         old pipeline keeps serving until the instant of the swap; a load or
-        warmup failure leaves it untouched (409)."""
+        warmup failure leaves it untouched (409). ``"warmup_buckets"``
+        overrides the precompile sizes (default: the server's configured
+        bucket ladder)."""
         holder = self.pipeline_holder
         if holder is None:
             return 409, {"error": "this server has no swappable pipeline "
@@ -340,19 +380,67 @@ class ServingServer:
             else:
                 return 400, {"error":
                              "body needs 'path' or 'registry'+'model'"}
-            warmed = self._warmup(stage, payload.get("warmup") or [])
+            warmed = self._warmup(stage, payload.get("warmup") or [],
+                                  payload.get("warmup_buckets"))
         except Exception as e:  # noqa: BLE001 - any failure must 409, not swap
             _SERVING_METRICS.get()["swaps"].inc(outcome="failed")
             return 409, {"error": f"{type(e).__name__}: {e}"}
+        replaced = holder.pipeline
         previous = holder.swap(stage, version)
+        # evict the replaced pipeline's executables: every swap would
+        # otherwise pin one more dead model's weights in the CompiledCache
+        # until LRU churn (in-flight batches on the old pipeline keep their
+        # callables; they just can't be re-acquired)
+        if replaced is not stage:
+            cb.release_executables(replaced)
         _SERVING_METRICS.get()["swaps"].inc(outcome="ok")
         return 200, {"ok": True, "version": version, "previous": previous,
                      "warmup_rows": warmed,
                      "load_ms": round((time.perf_counter() - t0) * 1e3, 2)}
 
     # ---- micro-batch source/sink API (HTTPMicroBatchReader / HTTPWriter) ----
+    def _empty_batch(self) -> DataFrame:
+        """The schema'd empty batch (not an empty-dict partition, which
+        breaks downstream schema checks). Built ONCE and reused — the serve
+        loop polls this on every idle tick, and four fresh numpy arrays per
+        poll was measurable allocator churn. Callers only read it."""
+        cached = self.__dict__.get("_empty_batch_cache")
+        if cached is None:
+            empty = np.empty(0, dtype=object)
+            cached = DataFrame([{"id": empty, "method": empty.copy(),
+                                 "path": empty.copy(), "body": empty.copy()}])
+            self.__dict__["_empty_batch_cache"] = cached
+        return cached
+
+    def _finish_batch(self, exchanges: list) -> DataFrame:
+        """Exchanges -> DataFrame, dropping requests whose reply deadline
+        already passed (their handler thread has 504'd and gone — feeding
+        them to the pipeline would burn compute a slow batch can't spare)
+        and recording queue-wait + occupancy."""
+        now = time.perf_counter()
+        live = [e for e in exchanges
+                if now - e.enqueued_at < self.reply_timeout_s]
+        m = _SERVING_METRICS.get()
+        if len(live) < len(exchanges):
+            m["expired"].inc(len(exchanges) - len(live))
+        if not live:
+            return self._empty_batch()
+        # queue wait = enqueue -> drained into a batch (the micro-batch
+        # scheduling delay, distinct from transform time)
+        qw = m["queue_wait"]
+        for e in live:
+            qw.observe((now - e.enqueued_at) * 1e3)
+        m["batch_rows"].observe(len(live))
+        return DataFrame([{
+            "id": np.asarray([e.request_id for e in live], dtype=object),
+            "method": np.asarray([e.method for e in live], dtype=object),
+            "path": np.asarray([e.path for e in live], dtype=object),
+            "body": np.asarray([e.body for e in live], dtype=object),
+        }])
+
     def read_batch(self, max_rows: int = 1024, timeout_s: float = 0.1) -> DataFrame:
-        """Drain queued requests into a DataFrame (id, method, path, body)."""
+        """Drain queued requests into a DataFrame (id, method, path, body) —
+        the fixed-timeout scheduler: returns as soon as anything is queued."""
         exchanges: list[_Exchange] = []
         try:
             exchanges.append(self._queue.get(timeout=timeout_s))
@@ -360,38 +448,70 @@ class ServingServer:
                 exchanges.append(self._queue.get_nowait())
         except queue.Empty:
             pass
-        if exchanges:
-            # queue wait = enqueue -> drained into a batch (the micro-batch
-            # scheduling delay, distinct from transform time)
-            qw = _SERVING_METRICS.get()["queue_wait"]
-            now = time.perf_counter()
-            for e in exchanges:
-                qw.observe((now - e.enqueued_at) * 1e3)
         if not exchanges:
-            # schema'd empty batch (not an empty-dict partition, which breaks
-            # downstream schema checks)
-            empty = np.empty(0, dtype=object)
-            return DataFrame([{"id": empty, "method": empty.copy(),
-                               "path": empty.copy(), "body": empty.copy()}])
-        ids = np.asarray([e.request_id for e in exchanges], dtype=object)
-        return DataFrame([{
-            "id": ids,
-            "method": np.asarray([e.method for e in exchanges], dtype=object),
-            "path": np.asarray([e.path for e in exchanges], dtype=object),
-            "body": np.asarray([e.body for e in exchanges], dtype=object),
-        }])
+            return self._empty_batch()
+        return self._finish_batch(exchanges)
+
+    def read_batch_adaptive(self, max_rows: int = 1024,
+                            latency_budget_s: float = 0.01,
+                            poll_timeout_s: float = 0.05,
+                            ladder: "tuple[int, ...] | None" = None,
+                            min_fill: int = 2) -> DataFrame:
+        """Continuous-batching scheduler: drain what's queued, then
+
+        * flush IMMEDIATELY when the batch exactly fills a ladder rung (a
+          full bucket's worth is queued — zero padding, no reason to wait),
+        * flush immediately when fewer than ``min_fill`` requests showed up
+          (an idle queue: waiting would only add latency at low load),
+        * otherwise wait for more — but never past the OLDEST queued
+          request's latency budget, so the per-request deadline bounds batch
+          assembly and a slow batch cannot starve the queue.
+
+        Expired requests (handler already 504'd) are dropped, not served."""
+        rungs = frozenset(ladder if ladder is not None
+                          else cb.default_bucketer().ladder)
+        try:
+            first = self._queue.get(timeout=poll_timeout_s)
+        except queue.Empty:
+            return self._empty_batch()
+        exchanges = [first]
+        deadline = first.enqueued_at + latency_budget_s
+        while len(exchanges) < max_rows:
+            try:
+                # drain the backlog greedily — a deep queue fills toward
+                # max_rows before any rung/budget decision
+                exchanges.append(self._queue.get_nowait())
+                continue
+            except queue.Empty:
+                pass
+            if len(exchanges) in rungs:
+                break  # a full bucket's worth is queued: flush early
+            if len(exchanges) < min_fill:
+                break  # idle queue: flush now, don't tax low-load latency
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break  # the oldest request's budget is spent
+            try:
+                exchanges.append(self._queue.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return self._finish_batch(exchanges)
 
     def reply_batch(self, df: DataFrame, id_col: str = "id",
                     reply_col: str = "reply", status: int = 200) -> int:
         """Route replies back by request id (``HTTPSinkV2`` / ``ServingUDFs``)."""
         if df.is_empty():
             return 0
-        n = 0
         ids = df.collect_column(id_col)
         replies = df.collect_column(reply_col)
-        for rid, reply in zip(ids, replies):
-            with self._lock:
-                ex = self._pending.get(str(rid))
+        # one lock acquisition for the whole batch (was once per row);
+        # respond() happens outside the lock — it only sets the handler's
+        # Event, and holding _lock across N wakeups would serialize them
+        with self._lock:
+            found = [(self._pending.get(str(rid)), reply)
+                     for rid, reply in zip(ids, replies)]
+        n = 0
+        for ex, reply in found:
             if ex is not None:
                 ex.respond(reply, status=status)
                 n += 1
@@ -421,29 +541,74 @@ def _prepare_batch(batch: DataFrame, parse_json: bool = True,
 def serve_pipeline(pipeline, port: int = 0, batch_interval_ms: int = 10,
                    input_col: str = "body", reply_col: str = "reply",
                    parse_json: bool = True, num_threads: int = 1,
-                   version: str | None = None) -> ServingServer:
+                   version: str | None = None,
+                   scheduler: str = "adaptive",
+                   latency_budget_ms: float | None = None,
+                   bucket_ladder=None,
+                   max_batch_rows: int = 1024) -> ServingServer:
     """Run a Transformer as an HTTP service: request body -> ``input_col`` ->
     pipeline.transform -> ``reply_col`` -> response body. ``batch_interval_ms=0``
     replies per-request (continuous mode); ``num_threads`` transform loops
     drain the queue concurrently (for pipelines that release the GIL or do
     IO — the reference's concurrent continuous path).
 
+    Micro-batch mode runs the CONTINUOUS-BATCHING scheduler by default
+    (``scheduler="adaptive"``): flush as soon as a full bucket ladder rung
+    is queued, wait up to ``latency_budget_ms`` (default: the batch
+    interval) otherwise, and never past the oldest request's budget.
+    ``scheduler="fixed"`` keeps the old fixed-timeout poll (the A/B
+    baseline the serving-microbatch bench compares against).
+    ``bucket_ladder`` pins the flush rungs AND the ``/admin/load`` warmup
+    precompile set; by default both resolve to the process-wide pow-2
+    ladder capped at ``max_batch_rows``, so a warmed hot swap never
+    compile-stalls at any rung the scheduler can flush.
+
     The pipeline lives in a :class:`PipelineHolder` (``version`` labels the
     initial one; pass a holder directly to share it), so ``POST /admin/load``
     can hot-swap a new version mid-serve: in-flight batches finish on the
     old pipeline, the next batch reads the new one — zero dropped requests."""
+    if scheduler not in ("adaptive", "fixed"):
+        raise ValueError(f"scheduler must be 'adaptive' or 'fixed', "
+                         f"got {scheduler!r}")
     server = ServingServer(port=port)
     holder = (pipeline if isinstance(pipeline, PipelineHolder)
               else PipelineHolder(pipeline, version))
     server.pipeline_holder = holder
     server._loop_cfg = {"parse_json": parse_json, "input_col": input_col}
+    if bucket_ladder is not None:
+        # explicit config: flush AND warm the full ladder (the caller opted
+        # into its warmup cost for the zero-compile-stall guarantee)
+        server._bucket_ladder = tuple(sorted({int(b) for b in bucket_ladder}))
+        server._warmup_buckets = server._bucket_ladder
+    elif batch_interval_ms != 0:
+        # default micro-batch mode: flush at the process-wide ladder, but
+        # precompile only the latency-sensitive small rungs — warming a
+        # heavy model at every rung up to 1024 rows can outlast the deploy
+        # plane's /admin/load timeout, and large batches amortize a compile
+        # stall across their rows anyway
+        server._bucket_ladder = tuple(
+            b for b in cb.default_bucketer().ladder if b <= max_batch_rows)
+        server._warmup_buckets = tuple(
+            b for b in server._bucket_ladder if b <= _DEFAULT_WARMUP_CAP)
+    budget_s = (batch_interval_ms if latency_budget_ms is None
+                else latency_budget_ms) / 1000.0
     server.start()
+
+    def read_next() -> DataFrame:
+        if batch_interval_ms == 0:  # continuous: one row, reply per request
+            return server.read_batch(max_rows=1, timeout_s=0.01)
+        if scheduler == "fixed":
+            return server.read_batch(
+                max_rows=max_batch_rows,
+                timeout_s=max(batch_interval_ms, 10) / 1000.0)
+        return server.read_batch_adaptive(
+            max_rows=max_batch_rows, latency_budget_s=budget_s,
+            poll_timeout_s=max(batch_interval_ms, 10) / 1000.0,
+            ladder=server._bucket_ladder)
 
     def loop():
         while server._running:
-            batch = server.read_batch(
-                max_rows=1 if batch_interval_ms == 0 else 1024,
-                timeout_s=max(batch_interval_ms, 10) / 1000.0)
+            batch = read_next()
             if batch.is_empty():
                 continue
             batch = _prepare_batch(batch, parse_json=parse_json,

@@ -16,8 +16,8 @@ import jax
 import numpy as np
 
 from ..core import DataFrame, Estimator, Model
+from ..core import batching as cb
 from ..core.params import ComplexParam, Param, TypeConverters
-from ..parallel.batching import batches
 from ..parallel.mesh import MeshConfig, MeshContext, create_mesh
 from .flax_nets.bert import BertClassifier, bert_base, bert_tiny
 from .tokenizer import resolve_tokenizer
@@ -197,6 +197,7 @@ class DeepTextModel(Model, _TextParams):
 
     def _post_load(self):
         self._apply_fn = None
+        cb.invalidate_token(self)
 
     _APPLY_KEYS = frozenset({"model_params", "arch_config", "tokenizer_config",
                              "checkpoint", "num_classes", "mesh_config"})
@@ -205,9 +206,13 @@ class DeepTextModel(Model, _TextParams):
         out = super().set(**kw)
         if self._APPLY_KEYS & kw.keys():
             self._apply_fn = None  # cached closure captured the old values
+            cb.invalidate_token(self)
         return out
 
     def _get_apply(self):
+        """Returns ``run_for(bucket, seq_len)`` — a per-bucket executable
+        factory backed by the process-wide CompiledCache, so a variable
+        scoring stream compiles at most ladder-many programs."""
         if self._apply_fn is None:
             import jax.numpy as jnp
 
@@ -231,28 +236,36 @@ class DeepTextModel(Model, _TextParams):
                              "attention_mask": jnp.ones((1, 8), jnp.int32)},
                     params, mesh)
 
-            @jax.jit
-            def apply(params, input_ids, attention_mask):
+            def apply_fn(params, input_ids, attention_mask):
                 logits = module.apply({"params": params}, input_ids, attention_mask)
                 return jax.nn.softmax(logits, axis=-1)
 
-            def run(ids, mask):
-                if mesh is not None:
-                    with mesh.mesh:
-                        return apply(params, mesh.shard_batch(ids),
-                                     mesh.shard_batch(mask))
-                return apply(params, ids, mask)
+            def run_for(bucket: int, seq_len: int):
+                def build():
+                    jitted = jax.jit(apply_fn)
+                    if mesh is not None:
+                        def run(ids, m, _j=jitted, _m=mesh):
+                            with _m.mesh:
+                                return _j(params, _m.shard_batch(ids),
+                                          _m.shard_batch(m))
+                        return run
+                    return lambda ids, m: jitted(params, ids, m)
+
+                return cb.get_compiled_cache().get(
+                    "deep_text_model", (bucket, seq_len), build,
+                    instance=cb.instance_token(self), dtype="int32")
 
             self._tok = tok
             self._mesh = mesh
-            self._apply_fn = run
+            self._apply_fn = run_for
         return self._apply_fn
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("text_col"))
-        run = self._get_apply()
+        run_for = self._get_apply()
         bs = self.get("batch_size")
         dp = self._mesh.data_parallel_size() if self._mesh is not None else 1
+        bucketer = cb.default_bucketer()
 
         def per_part(part):
             texts = list(part[self.get("text_col")])
@@ -263,10 +276,13 @@ class DeepTextModel(Model, _TextParams):
                 out[self.get("prediction_col")] = np.zeros(0, np.int32)
                 return out
             enc = self._tok(texts, max_len=self.get("max_token_len"))
+            ids = np.asarray(enc["input_ids"])
+            mask = np.asarray(enc["attention_mask"])
             probs_chunks = []
-            for b in batches(enc, bs, multiple_of=dp):
-                p = run(b.data["input_ids"], b.data["attention_mask"])
-                probs_chunks.append(np.asarray(p)[: b.n_valid])
+            for s, e, bucket in bucketer.slices(len(texts), bs, multiple_of=dp):
+                p = run_for(bucket, ids.shape[1])(
+                    cb.pad_rows(ids[s:e], bucket), cb.pad_rows(mask[s:e], bucket))
+                probs_chunks.append(cb.unpad_rows(p, e - s))
             probs = np.concatenate(probs_chunks, axis=0)
             out = dict(part)
             out[self.get("scores_col")] = probs

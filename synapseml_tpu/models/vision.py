@@ -14,8 +14,8 @@ import jax
 import numpy as np
 
 from ..core import DataFrame, Estimator, Model
+from ..core import batching as cb
 from ..core.params import ComplexParam, Param, TypeConverters
-from ..parallel.batching import batches
 from ..parallel.mesh import MeshConfig, create_mesh
 from .flax_nets.resnet import resnet18, resnet50, resnet_tiny
 from .flax_nets.vit import ViTClassifier, vit_b16, vit_tiny
@@ -156,6 +156,7 @@ class DeepVisionModel(Model, _VisionParams):
 
     def _post_load(self):
         self._apply_fn = None
+        cb.invalidate_token(self)
 
     _APPLY_KEYS = frozenset({"model_params", "batch_stats", "arch_spec",
                              "backbone", "num_classes", "mesh_config"})
@@ -164,9 +165,12 @@ class DeepVisionModel(Model, _VisionParams):
         out = super().set(**kw)
         if self._APPLY_KEYS & kw.keys():
             self._apply_fn = None  # cached closure captured the old values
+            cb.invalidate_token(self)
         return out
 
     def _get_apply(self):
+        """Returns ``run_for(bucket, img_shape)`` — per-bucket executables
+        via the process-wide CompiledCache."""
         if self._apply_fn is None:
             module, has_bn = _build_module(self.get("backbone"), self.get("num_classes"),
                                            self.get("arch_spec"))
@@ -182,27 +186,35 @@ class DeepVisionModel(Model, _VisionParams):
                     lambda v: jax.device_put(np.asarray(v), mesh.replicated()),
                     variables)
 
-            @jax.jit
-            def apply(variables, x):
+            def apply_fn(variables, x):
                 logits = module.apply(variables, x)
                 return jax.nn.softmax(logits, axis=-1)
 
-            def run(x):
-                if mesh is not None:
-                    with mesh.mesh:
-                        return apply(variables, mesh.shard_batch(x))
-                return apply(variables, x)
+            def run_for(bucket: int, img_shape: tuple):
+                def build():
+                    jitted = jax.jit(apply_fn)
+                    if mesh is not None:
+                        def run(x, _j=jitted, _m=mesh):
+                            with _m.mesh:
+                                return _j(variables, _m.shard_batch(x))
+                        return run
+                    return lambda x: jitted(variables, x)
+
+                return cb.get_compiled_cache().get(
+                    "deep_vision_model", (bucket,) + tuple(img_shape), build,
+                    instance=cb.instance_token(self), dtype="float32")
 
             self._module_has_bn = has_bn
             self._mesh = mesh
-            self._apply_fn = run
+            self._apply_fn = run_for
         return self._apply_fn
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("image_col"))
-        run = self._get_apply()
+        run_for = self._get_apply()
         bs = self.get("batch_size")
         dp = self._mesh.data_parallel_size() if self._mesh is not None else 1
+        bucketer = cb.default_bucketer()
 
         def per_part(part):
             imgs = part[self.get("image_col")]
@@ -214,9 +226,9 @@ class DeepVisionModel(Model, _VisionParams):
                 return out
             x = np.stack(list(imgs)).astype(np.float32)
             chunks = []
-            for b in batches({"x": x}, bs, multiple_of=dp):
-                p = run(b.data["x"])
-                chunks.append(np.asarray(p)[: b.n_valid])
+            for s, e, bucket in bucketer.slices(len(x), bs, multiple_of=dp):
+                p = run_for(bucket, x.shape[1:])(cb.pad_rows(x[s:e], bucket))
+                chunks.append(cb.unpad_rows(p, e - s))
             probs = np.concatenate(chunks, axis=0)
             out = dict(part)
             out[self.get("scores_col")] = probs

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..core import batching as cb
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Estimator, Model
@@ -62,11 +63,21 @@ class KNNModel(Model):
     query_batch = Param("query_batch", "padded query rows per device batch",
                         default=256, converter=TypeConverters.to_int)
 
-    def _topk_fn(self):
-        import jax
-        import jax.numpy as jnp
+    _CACHE_KEYS = frozenset({"index", "k"})
 
-        if self.__dict__.get("_cache_jitted") is None:
+    def set(self, **kw):
+        out = super().set(**kw)
+        if self._CACHE_KEYS & kw.keys():
+            cb.invalidate_token(self)  # cached executables captured old index
+        return out
+
+    def _topk_fn(self, bucket: int, conditional: bool):
+        """Per-query-bucket top-k executable via the CompiledCache (one
+        compile per ladder rung, not per distinct query-batch size)."""
+        def build():
+            import jax
+            import jax.numpy as jnp
+
             X = jnp.asarray(self.get("index"))           # [N, D]
             x_sq = jnp.sum(X * X, axis=1)                # [N]
             k = min(self.get("k"), X.shape[0])
@@ -80,9 +91,14 @@ class KNNModel(Model):
                 neg_d, idx = jax.lax.top_k(-d, k)
                 return -neg_d, idx
 
-            self.__dict__["_cache_jitted"] = (jax.jit(fn),
-                                              jax.jit(lambda Q, b: fn(Q, b)))
-        return self.__dict__["_cache_jitted"]
+            if conditional:
+                return jax.jit(lambda Q, b: fn(Q, b))
+            return jax.jit(fn)
+
+        variant = "bias" if conditional else "plain"
+        return cb.get_compiled_cache().get(
+            "knn", (bucket, variant), build,
+            instance=cb.instance_token(self), dtype="float32")
 
     def _match_bias(self, p, s: int, e: int) -> np.ndarray | None:
         """[e-s, N] additive bias (0 = allowed) for one query batch;
@@ -95,21 +111,20 @@ class KNNModel(Model):
         vals = self.get("values")
         labels = self.get("labels")
         B = self.get("query_batch")
-        fn_plain, fn_bias = self._topk_fn()
+        bucketer = cb.default_bucketer()
 
         def per_part(p):
             Q = _stack_features(p[self.get("features_col")])
             n = len(Q)
             matches = np.empty(n, dtype=object)
-            for s in range(0, n, B):
-                e = min(s + B, n)
-                pad = B - (e - s)
-                Qb = np.pad(Q[s:e], ((0, pad), (0, 0)))
+            for s, e, bucket in bucketer.slices(n, B):
+                Qb = cb.pad_rows(Q[s:e], bucket)
                 bias = self._match_bias(p, s, e)
                 if bias is None:
-                    out = fn_plain(Qb)
+                    out = self._topk_fn(bucket, conditional=False)(Qb)
                 else:
-                    out = fn_bias(Qb, np.pad(bias, ((0, pad), (0, 0))))
+                    out = self._topk_fn(bucket, conditional=True)(
+                        Qb, cb.pad_rows(bias, bucket))
                 dist, idx = (np.asarray(a) for a in out)
                 for i in range(e - s):
                     row = []

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..core import batching as cb
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Transformer
@@ -92,10 +93,9 @@ class ONNXModel(Transformer):
 
     # NOTE: stage deserialization constructs via cls.__new__ (serialization
     # .load_stage:168), bypassing __init__ — runtime caches therefore live
-    # behind lazy accessors, never as __init__-assigned attributes.
-    @property
-    def _jit_cache_map(self) -> dict:
-        return self.__dict__.setdefault("_cache_jit", {})
+    # behind lazy accessors, never as __init__-assigned attributes. Jitted
+    # programs live in the process-wide CompiledCache keyed by this stage's
+    # instance_token, not in a private per-stage dict.
 
     # -------- model management --------
     def set_model_location(self, path: str) -> "ONNXModel":
@@ -108,7 +108,7 @@ class ONNXModel(Transformer):
         self.set(model_payload=slice_model_at_outputs(self.get("model_payload"),
                                                       list(output_names)))
         self.__dict__.pop("_cache_converted", None)
-        self._jit_cache_map.clear()
+        cb.invalidate_token(self)  # orphan the old graph's executables
         return self
 
     @property
@@ -145,39 +145,44 @@ class ONNXModel(Transformer):
         return {f"out_{n}" if n in ("", None) else n: n
                 for n in self.model_output_names}
 
-    def _jitted(self, feeds: dict, fetches: dict):
-        """One jitted program: model + post softmax/argmax cols fused."""
-        import jax
-        import jax.numpy as jnp
-
-        key = (tuple(sorted(feeds.items())), tuple(sorted(fetches.items())))
-        if key in self._jit_cache_map:
-            return self._jit_cache_map[key]
-        conv = self.converted
+    def _jitted(self, feeds: dict, fetches: dict, bucket: int, dtypes: tuple):
+        """One jitted program per ladder bucket: model + post softmax/argmax
+        cols fused. Acquired through the process-wide CompiledCache so a
+        variable request stream compiles at most ladder-many executables."""
         soft = dict(self.get("softmax_dict") or {})
         arg = dict(self.get("argmax_dict") or {})
-        out_col_of = {v: k for k, v in fetches.items()}
 
-        def fn(*arrays):
-            outs = conv(**dict(zip(sorted(feeds), arrays)))
-            cols = {out_col_of[name]: val for name, val in outs.items()
-                    if name in out_col_of}
-            for src, dst in soft.items():
-                cols[dst] = jax.nn.softmax(cols[src], axis=-1)
-            for src, dst in arg.items():
-                cols[dst] = jnp.argmax(cols[src], axis=-1).astype(jnp.int32)
-            return cols
+        def build():
+            import jax
+            import jax.numpy as jnp
 
-        jitted = jax.jit(fn)
-        self._jit_cache_map[key] = jitted
-        return jitted
+            conv = self.converted
+            out_col_of = {v: k for k, v in fetches.items()}
+
+            def fn(*arrays):
+                outs = conv(**dict(zip(sorted(feeds), arrays)))
+                cols = {out_col_of[name]: val for name, val in outs.items()
+                        if name in out_col_of}
+                for src, dst in soft.items():
+                    cols[dst] = jax.nn.softmax(cols[src], axis=-1)
+                for src, dst in arg.items():
+                    cols[dst] = jnp.argmax(cols[src], axis=-1).astype(jnp.int32)
+                return cols
+
+            return jax.jit(fn)
+
+        key = (tuple(sorted(feeds.items())), tuple(sorted(fetches.items())),
+               tuple(sorted(soft.items())), tuple(sorted(arg.items())))
+        return cb.get_compiled_cache().get(
+            "onnx_model", (bucket,) + key, build,
+            instance=cb.instance_token(self), dtype=dtypes)
 
     def _transform(self, df: DataFrame) -> DataFrame:
         feeds = self._resolved_feeds()
         fetches = self._resolved_fetches()
         self.require_columns(df, *feeds.values())
         B = self.get("mini_batch_size")
-        jitted = self._jitted(feeds, fetches)
+        bucketer = cb.default_bucketer()
 
         soft = dict(self.get("softmax_dict") or {})
         arg = dict(self.get("argmax_dict") or {})
@@ -190,17 +195,18 @@ class ONNXModel(Transformer):
             cols_in = {name: np.asarray(np.stack(list(p[col])))
                        if p[col].dtype == object else np.asarray(p[col])
                        for name, col in feeds.items()}
+            dtypes = tuple(str(cols_in[k].dtype) for k in sorted(feeds))
             results: dict[str, list] = {}
-            for start in range(0, n, B):
-                stop = min(start + B, n)
-                batch = {k: v[start:stop] for k, v in cols_in.items()}
-                pad = B - (stop - start)
-                if pad:  # pad to the fixed batch size -> same compiled program
-                    batch = {k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
-                             for k, v in batch.items()}
+            for start, stop, bucket in bucketer.slices(n, B):
+                # pad to the chunk's ladder bucket -> same compiled program
+                # for every request size that maps to this rung (edge-repeat
+                # padding, the original fixed-B strategy)
+                batch = {k: cb.pad_rows(v[start:stop], bucket, mode="edge")
+                         for k, v in cols_in.items()}
+                jitted = self._jitted(feeds, fetches, bucket, dtypes)
                 out = jitted(*[batch[k] for k in sorted(feeds)])
                 for col, val in out.items():
-                    arr = np.asarray(val)[: stop - start]
+                    arr = cb.unpad_rows(val, stop - start)
                     results.setdefault(col, []).append(arr)
             q = dict(p)
             for col in out_cols:  # deterministic order (jit sorts dict keys)

@@ -6,6 +6,11 @@ small set of power-of-two bucket sizes so each bucket compiles exactly once,
 and carry a validity mask so padded rows never contaminate results. Sequence
 dims bucket the same way (reference truncates at max_token_len instead,
 ``dl/DeepTextClassifier.py:75``).
+
+This module is the TRAINING-side batcher (fit loops, feeders). The
+serve/predict hot path uses :mod:`synapseml_tpu.core.batching` — the same
+strategy plus the ladder-bounded CompiledCache; padding fixes usually need
+applying in both.
 """
 
 from __future__ import annotations
@@ -15,11 +20,9 @@ from typing import Any, Iterator, Sequence
 
 import numpy as np
 
+from ..core.batching import round_up_to_multiple
+
 __all__ = ["bucket_size", "pad_batch", "unpad", "PaddedBatch", "batches", "round_up_to_multiple"]
-
-
-def round_up_to_multiple(n: int, m: int) -> int:
-    return ((n + m - 1) // m) * m
 
 
 def bucket_size(n: int, buckets: Sequence[int] | None = None, min_bucket: int = 8) -> int:

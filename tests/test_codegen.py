@@ -106,6 +106,49 @@ def test_registry_compat_coverage():
             f"compat.registry.{name} is not the registry's own object")
 
 
+def test_no_inline_jit_in_stage_transform():
+    """Static guard for the continuous-batching plane: inference-stage
+    modules must acquire jitted programs through
+    ``core.batching.CompiledCache`` — any ``jax.jit`` reference may appear
+    ONLY inside a cache-builder function (named ``build``/``_build*``).
+    An inline ``jax.jit`` in a transform path re-traces per batch shape,
+    is invisible to the hit/miss/trace-time metrics, and dodges the
+    ``/admin/load`` warmup precompile. (``gbdt/booster.py`` training jits
+    are estimator-time — one trace per fit — and stay out of scope; its
+    predict path is behavior-tested in test_batching.py.)"""
+    import ast
+
+    modules = ["onnx/model.py", "hf/embedder.py", "hf/causal_lm.py",
+               "models/text.py", "models/vision.py", "nn/knn.py"]
+    pkg = pathlib.Path(st.__file__).parent
+    offenders = []
+    for rel in modules:
+        tree = ast.parse((pkg / rel).read_text())
+
+        class Visitor(ast.NodeVisitor):
+            def __init__(self):
+                self.stack = []
+
+            def visit_FunctionDef(self, node):
+                self.stack.append(node.name)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Attribute(self, node):
+                if node.attr == "jit" and not any(
+                        name == "build" or name.startswith("_build")
+                        for name in self.stack):
+                    offenders.append(f"{rel}:{node.lineno}")
+                self.generic_visit(node)
+
+        Visitor().visit(tree)
+    assert not offenders, (
+        "jax.jit outside a CompiledCache builder (route it through "
+        f"core.batching.CompiledCache.get): {offenders}")
+
+
 def test_wrapper_chaining_fit_transform():
     from synapseml_tpu.compat.lightgbm import (LightGBMClassificationModel,
                                                LightGBMClassifier)

@@ -399,11 +399,15 @@ def test_per_row_generation_params_two_configs():
     lm = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=5,
                              prompt_bucket=8, batch_size=2,
                              generation_params_col="gen")
+    from synapseml_tpu.core import batching as cb
+
+    misses0 = cb.get_compiled_cache().miss_count("hf_causal_lm")
     out = lm.transform(df).collect_column("completions")
     lengths = [len(np.asarray(g)) for g in out]
     assert lengths == [3, 6, 3, 5]
-    # two distinct configs + default -> exactly 3 compiled variants
-    assert len(lm.__dict__["_cache_gen"]) == 3
+    # two distinct configs + default -> exactly 3 compiled variants (the
+    # per-instance _cache_gen dict became the shared CompiledCache)
+    assert cb.get_compiled_cache().miss_count("hf_causal_lm") - misses0 == 3
     # deterministic under the per-row seed
     out2 = lm.transform(df).collect_column("completions")
     for a, b in zip(out, out2):
