@@ -1,11 +1,13 @@
-"""Benchmark rotation over EIGHT configs: the five BASELINE.md targets, two
-TPU-only decision benches, and the host-side serving-microbatch A/B.
+"""Benchmark rotation over NINE configs: the five BASELINE.md targets, two
+TPU-only decision benches, and the host-side serving-microbatch and
+data-pipeline A/Bs.
 
 Prints one JSON line per config — flagship (BERT-base fine-tune) LAST so a
 single-line consumer parses the flagship metric — and exits 0 regardless of
 TPU-relay state. Configs: ONNX ResNet-50, Llama decode, Higgs-1M GBDT,
 histogram-backend decision, attention-backend decision, serving-microbatch
-(continuous batching vs fixed-timeout, same round), flagship BERT,
+(continuous batching vs fixed-timeout, same round), data-pipeline (streamed
+fit_source vs eager fit_arrays, same round), flagship BERT,
 ViT-B/16 (BASELINE.md:23-29; measurement order rationale at CONFIGS). The
 summed TPU deadlines intentionally exceed GLOBAL_BUDGET_S — late configs
 are truncated by design when earlier ones consume a healthy window. Any
@@ -74,6 +76,10 @@ CONFIGS = [
     # host-side serving A/B (adaptive continuous batching vs fixed-timeout
     # baseline, same round) — cheap, runs fine on the CPU fallback
     ("serving-microbatch", "serving_microbatch", 240, 240),
+    # streamed fit_source vs eager fit_arrays over a multi-shard jsonl
+    # dataset (rows/sec + prefetch occupancy + stall fraction); host-driven,
+    # fine on the CPU fallback
+    ("data-pipeline", "data_pipeline", 240, 240),
     ("flagship", None, 420, 360),
     ("vit", "vit_finetune", 450, 300),
 ]

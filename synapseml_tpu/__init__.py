@@ -4,6 +4,7 @@ Pallas/pjit with a C++ native runtime for host-side hot paths.
 
 Subpackages mirror the reference's module layout:
   core/        data plane (DataFrame), params, pipeline API, logging, utils
+  data/        streaming plane: sharded sources, prefetching loader, resume
   parallel/    the one communication backend: mesh, collectives, checkpoint
   ops/         Pallas/XLA kernels (histogram, ring attention, quantize)
   models/      Flax model zoo + DeepText/DeepVision/CausalLM estimators

@@ -10,7 +10,11 @@ transport hook points consult it before touching the network:
   timeouts);
 * ``io/distributed_serving.py`` — ``_ConnPool.get`` calls
   ``plan.on_connect((host, port))`` (worker crash / blackhole / connect
-  refusal before any socket is opened).
+  refusal before any socket is opened);
+* ``data/source.py`` — every guarded shard read calls
+  ``plan.on_read(target)`` (slow / failing shard reads on the ``"data"``
+  plane; the source retries them under its ``RetryPolicy``). Target the
+  plane explicitly: ``FaultSpec(..., planes=("data",))``.
 
 Faults are matched in order against the target (URL or ``host:port``
 substring), gated by a per-spec remaining ``times`` count and a probability
@@ -134,6 +138,15 @@ class FaultPlan:
         out a (pooled or fresh) worker connection."""
         target = f"{key[0]}:{key[1]}"
         f = self._select("distributed_serving", target)
+        if f is not None:
+            self._raise_fault(f, target)
+
+    def on_read(self, target: str) -> None:
+        """Called by the streaming data plane before each physical shard
+        read (``data/source.py``). ``connection_error``/``blackhole``/
+        ``crash``/``latency`` model slow or failing storage; reads are
+        retried by the source's ``RetryPolicy``."""
+        f = self._select("data", target)
         if f is not None:
             self._raise_fault(f, target)
 

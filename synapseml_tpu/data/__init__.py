@@ -1,0 +1,28 @@
+"""Streaming data plane: out-of-core sharded sources, async device
+prefetch, and resumable epoch iterators.
+
+The Spark-streaming role of the reference (executors feed file splits to the
+compute engines) rebuilt TPU-natively:
+
+* :mod:`.source` — :class:`ShardedSource` over jsonl/csv/npy/image dirs with
+  byte-range shard splitting and per-host assignment aligned with the
+  ``parallel/mesh`` process topology; :class:`MemorySource` wraps in-memory
+  data so existing call sites ride the same plane.
+* :mod:`.loader` — :class:`DataLoader`: deterministic seeded shard + row
+  shuffles, batch assembly through the ``core/batching`` bucket ladder, and
+  a bounded-queue background prefetcher with backpressure and full
+  observability (``synapseml_data_*`` series, ``data.prefetch`` spans).
+* :mod:`.state` — :class:`IteratorState`: checkpointable iterator cursors
+  that serialize alongside ``parallel.checkpoint`` snapshots so a preempted
+  job resumes mid-epoch bit-identically.
+
+Training entry points: ``models.trainer.fit_source`` (and the thin
+``fit_arrays`` wrapper), ``gbdt.train_booster_from_source``.
+"""
+
+from .loader import DataLoader  # noqa: F401
+from .source import MemorySource, Shard, ShardedSource  # noqa: F401
+from .state import IteratorState, row_order, shard_order  # noqa: F401
+
+__all__ = ["DataLoader", "MemorySource", "Shard", "ShardedSource",
+           "IteratorState", "row_order", "shard_order"]

@@ -1,0 +1,393 @@
+"""Out-of-core sharded data sources.
+
+The Spark role being replaced (SURVEY.md §3.2): executors stream file splits
+to the compute engines, so no host ever materializes the full dataset. Here a
+:class:`ShardedSource` describes a dataset as a list of :class:`Shard`
+descriptors — byte ranges of jsonl/csv files, row ranges of ``.npy`` arrays,
+slices of an image directory listing — and ``read_shard`` materializes ONE
+shard as a columnar dict. Memory is bounded by the shard size, not the
+dataset size; the :mod:`~synapseml_tpu.data.loader` streams shards through a
+background prefetcher into the training loop.
+
+Per-host assignment follows the ``parallel/mesh`` process topology: every
+host computes the same seeded epoch order (``state.shard_order``) and takes
+the strided slice ``order[host_index::host_count]`` — disjoint coverage whose
+union is exactly the dataset, once per epoch (asserted by the determinism
+suite in ``tests/test_data.py``).
+
+Reads honor the resilience + fault-injection planes: each physical read
+consults ``core.faults.active_fault_plan().on_read(target)`` and retries
+transient ``OSError``/``TimeoutError`` failures under a
+``core.resilience.RetryPolicy``, counting retries on
+``resilience_measures("data")``.
+
+``MemorySource`` wraps an in-memory ``DataFrame`` or column dict in the same
+interface so every existing call site (``fit_arrays`` and friends) rides the
+one streaming plane unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io as _io
+import json as _json
+import os
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from ..core.resilience import RetryPolicy, resilience_measures
+
+__all__ = ["Shard", "ShardedSource", "MemorySource", "default_read_retry"]
+
+DEFAULT_SHARD_BYTES = 64 << 20
+DEFAULT_SHARD_ROWS = 65536
+
+
+def default_read_retry() -> RetryPolicy:
+    """Shard reads hit network filesystems in production; transient failures
+    retry on a short jittered schedule by default."""
+    return RetryPolicy(backoffs_ms=(50, 200, 500))
+
+
+@dataclasses.dataclass(frozen=True)
+class Shard:
+    """One independently readable slice of a dataset.
+
+    ``kind`` selects the reader; ``start``/``stop`` are byte offsets for
+    tabular files, row offsets for ``npy``/``memory`` shards, and listing
+    offsets for image shards."""
+
+    index: int
+    kind: str            # jsonl | csv | npy | image | memory
+    path: str            # file path ('' for memory shards)
+    start: int
+    stop: int
+
+    @property
+    def target(self) -> str:
+        """The fault-plan / span match target."""
+        return f"{self.path}[{self.start}:{self.stop}]"
+
+
+def _line_aligned_ranges(size: int, shard_bytes: int, origin: int = 0
+                         ) -> list[tuple[int, int]]:
+    """Byte ranges covering [origin, size); a LINE belongs to the range that
+    contains its first byte, so ranges need no alignment up front — the
+    reader seeks and skips the partial first line itself."""
+    shard_bytes = max(int(shard_bytes), 1)
+    out = []
+    pos = origin
+    while pos < size:
+        out.append((pos, min(pos + shard_bytes, size)))
+        pos += shard_bytes
+    return out  # empty when the file holds no body bytes (e.g. header-only)
+
+
+def _read_lines_in_range(path: str, start: int, stop: int,
+                         at_line_start: bool = False) -> list[bytes]:
+    """The byte-range line reader shared by the jsonl and csv shards: every
+    line whose first byte lands in [start, stop) belongs to this shard.
+    ``at_line_start`` marks ``start`` as a known line boundary (byte 0, or
+    the csv body origin right after the header) — no partial-line skip."""
+    out = []
+    with open(path, "rb") as f:
+        f.seek(start)
+        if start > 0 and not at_line_start:
+            # Position to the first line STARTING in-range: back up one byte
+            # and consume to the next newline — when byte start-1 is itself
+            # a newline this is a no-op skip (the line beginning exactly at
+            # ``start`` belongs to THIS shard and must not be dropped).
+            f.seek(start - 1)
+            f.readline()
+        while True:
+            line_start = f.tell()
+            if line_start >= stop:
+                break
+            line = f.readline()
+            if not line:
+                break
+            if line.strip():
+                out.append(line)
+    return out
+
+
+def _columnar(rows: list[dict]) -> dict[str, np.ndarray]:
+    """rows -> columnar dict over the union of keys (missing fields None),
+    matching ``io.files.read_jsonl`` semantics."""
+    keys: list = []
+    for r in rows:
+        keys += [k for k in r if k not in keys]
+    from ..core.dataframe import _as_column
+
+    n = len(rows)
+    return {k: _as_column([r.get(k) for r in rows], n) for k in keys}
+
+
+class ShardedSource:
+    """A dataset as independently readable shards (see module docstring).
+
+    Build with the classmethod constructors — :meth:`jsonl`, :meth:`csv`,
+    :meth:`npy`, :meth:`image_dir` — or wrap in-memory data with
+    :class:`MemorySource`.
+    """
+
+    kind = "sharded"
+
+    def __init__(self, shards: Sequence[Shard],
+                 reader: Callable[[Shard], dict],
+                 retry_policy: RetryPolicy | None = None,
+                 name: str = "source"):
+        if not shards:
+            raise ValueError("a ShardedSource needs at least one shard")
+        self._shards = list(shards)
+        self._reader = reader
+        self.retry_policy = retry_policy or default_read_retry()
+        self.name = name
+
+    # -- interface ----------------------------------------------------------
+    @property
+    def num_shards(self) -> int:
+        return len(self._shards)
+
+    def shards(self) -> list[Shard]:
+        return list(self._shards)
+
+    def read_shard(self, shard: Shard | int) -> dict[str, np.ndarray]:
+        """Materialize one shard as a columnar dict. Fault-injectable
+        (``FaultSpec(..., planes=("data",))``) and retried under the
+        source's ``RetryPolicy``."""
+        if isinstance(shard, int):
+            shard = self._shards[shard]
+        return self._guarded(lambda: self._reader(shard), shard.target)
+
+    def iter_shards(self):
+        """Sequential (unshuffled) pass over every shard — the fixed-memory
+        scan the streamed GBDT passes and stats accumulators use."""
+        for s in self._shards:
+            yield s, self.read_shard(s)
+
+    def total_rows(self) -> int:
+        """Total row count. Row-range shard kinds (npy/memory) answer from
+        shard metadata alone; byte-range formats (jsonl/csv) need ONE full
+        read pass — memoized, but on a huge remote corpus prefer tracking
+        counts as the loader discovers them (``IteratorState.shard_counts``)
+        instead of calling this up front."""
+        if not hasattr(self, "_total_rows"):
+            if all(s.kind in ("npy", "memory") for s in self._shards):
+                self._total_rows = sum(s.stop - s.start for s in self._shards)
+            else:
+                self._total_rows = sum(
+                    _n_rows(cols) for _, cols in self.iter_shards())
+        return self._total_rows
+
+    # -- read guard ---------------------------------------------------------
+    def _guarded(self, fn: Callable[[], dict], target: str) -> dict:
+        from ..core.faults import active_fault_plan
+
+        policy = self.retry_policy
+        measures = resilience_measures("data")
+        for attempt in range(policy.max_attempts):
+            try:
+                plan = active_fault_plan()
+                if plan is not None:
+                    plan.on_read(target)
+                out = fn()
+                policy.on_success(first_attempt=attempt == 0)
+                return out
+            except (OSError, TimeoutError):
+                if attempt + 1 >= policy.max_attempts \
+                        or not policy.acquire_retry():
+                    raise
+                measures.count("retry")
+                time.sleep(policy.backoff_ms(attempt) / 1000.0)
+        raise AssertionError("unreachable")
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def jsonl(cls, path: str, shard_bytes: int = DEFAULT_SHARD_BYTES,
+              retry_policy: RetryPolicy | None = None) -> "ShardedSource":
+        """JSON-lines file(s)/glob/dir -> byte-range shards. Heterogeneous
+        records union over all keys seen in the shard (like
+        ``io.files.read_jsonl``)."""
+        paths = _tabular_paths(path, "JSONL")
+        shards, idx = [], 0
+        for p in paths:
+            for start, stop in _line_aligned_ranges(os.path.getsize(p),
+                                                    shard_bytes):
+                shards.append(Shard(idx, "jsonl", p, start, stop))
+                idx += 1
+        if not shards:
+            raise ValueError(f"no data rows under {path!r} (the matched "
+                             "JSONL files are all empty)")
+
+        def read(shard: Shard) -> dict:
+            rows = [_json.loads(ln) for ln in _read_lines_in_range(
+                shard.path, shard.start, shard.stop)]
+            return _columnar(rows)
+
+        return cls(shards, read, retry_policy, name="jsonl")
+
+    @classmethod
+    def csv(cls, path: str, shard_bytes: int = DEFAULT_SHARD_BYTES,
+            retry_policy: RetryPolicy | None = None,
+            **pandas_kw) -> "ShardedSource":
+        """CSV file(s)/glob/dir -> byte-range shards; every shard re-reads
+        the file's header line so any byte range parses standalone."""
+        paths = _tabular_paths(path, "CSV")
+        shards, idx, headers = [], 0, {}
+        for p in paths:
+            with open(p, "rb") as f:
+                headers[p] = f.readline()
+            body = len(headers[p])
+            for start, stop in _line_aligned_ranges(os.path.getsize(p),
+                                                    shard_bytes, origin=body):
+                shards.append(Shard(idx, "csv", p, start, stop))
+                idx += 1
+        if not shards:
+            raise ValueError(f"no data rows under {path!r} (the matched "
+                             "CSV files hold headers only)")
+
+        def read(shard: Shard) -> dict:
+            import pandas as pd
+
+            lines = _read_lines_in_range(
+                shard.path, shard.start, shard.stop,
+                at_line_start=shard.start == len(headers[shard.path]))
+            body = b"".join(lines)
+            whole_file = (shard.start == len(headers[shard.path])
+                          and shard.stop >= os.path.getsize(shard.path))
+            # per-LINE parity, not whole-shard: a slice torn inside quoted
+            # fields at BOTH ends has even total quotes but its first and
+            # last fragment lines are each odd
+            if not whole_file and any(ln.count(b'"') % 2 for ln in lines):
+                # byte-range splitting assumes one record per physical line
+                # (the Spark splittable-CSV contract); an odd quote count in
+                # a strict slice of the file means a quoted field with an
+                # embedded newline (or a bare literal quote) straddles a
+                # shard boundary — fail LOUD instead of feeding a torn
+                # record fragment into training as a spurious row. A shard
+                # covering the whole file can hold no torn record, so it
+                # skips this check (lone literal quotes stay parseable).
+                raise ValueError(
+                    f"CSV shard {shard.target} cuts through a quoted "
+                    "multi-line field (or the file holds bare literal "
+                    "quotes); byte-range sharding needs one record per "
+                    "line — raise shard_bytes past the file size (one "
+                    "shard per file) or flatten embedded newlines")
+            pdf = pd.read_csv(_io.BytesIO(headers[shard.path] + body),
+                              **pandas_kw)
+            return {c: pdf[c].to_numpy() for c in pdf.columns}
+
+        return cls(shards, read, retry_policy, name="csv")
+
+    @classmethod
+    def npy(cls, path: str, column: str = "features",
+            shard_rows: int = DEFAULT_SHARD_ROWS,
+            retry_policy: RetryPolicy | None = None) -> "ShardedSource":
+        """``.npy`` file(s)/glob/dir -> row-range shards (mmap metadata only
+        at build time; each shard materializes its own row slice)."""
+        from ..io.files import resolve_input_paths
+
+        paths = resolve_input_paths(path, ".npy", exts=(".npy",))
+        shards, idx = [], 0
+        for p in paths:
+            n = np.load(p, mmap_mode="r").shape[0]
+            for start in range(0, n, max(int(shard_rows), 1)):
+                shards.append(Shard(idx, "npy", p, start,
+                                    min(start + shard_rows, n)))
+                idx += 1
+
+        def read(shard: Shard) -> dict:
+            mm = np.load(shard.path, mmap_mode="r")
+            return {column: np.asarray(mm[shard.start:shard.stop])}
+
+        return cls(shards, read, retry_policy, name="npy")
+
+    @classmethod
+    def image_dir(cls, path: str, recursive: bool = True,
+                  shard_files: int = 256, drop_invalid: bool = True,
+                  retry_policy: RetryPolicy | None = None) -> "ShardedSource":
+        """Image directory -> shards of ``shard_files`` files each, decoded
+        to the ``io.files.read_image_files`` schema (path/image/height/
+        width/channels)."""
+        from ..io.files import _IMAGE_EXTS, _resolve_paths, decode_image_bytes
+
+        files = _resolve_paths(path, recursive, _IMAGE_EXTS)
+        if not files:
+            raise FileNotFoundError(f"no image files under {path!r}")
+        shard_files = max(int(shard_files), 1)
+        shards = [Shard(i, "image", path, s, min(s + shard_files, len(files)))
+                  for i, s in enumerate(range(0, len(files), shard_files))]
+
+        def read(shard: Shard) -> dict:
+            rows = []
+            for p in files[shard.start:shard.stop]:
+                with open(p, "rb") as f:
+                    data = f.read()
+                try:
+                    arr = decode_image_bytes(data)
+                except Exception:
+                    if drop_invalid:
+                        continue
+                    rows.append({"path": os.path.abspath(p), "image": None,
+                                 "height": 0, "width": 0, "channels": 0})
+                    continue
+                rows.append({"path": os.path.abspath(p), "image": arr,
+                             "height": arr.shape[0], "width": arr.shape[1],
+                             "channels": arr.shape[2]})
+            return _columnar(rows)
+
+        return cls(shards, read, retry_policy, name="image")
+
+
+def _tabular_paths(path: str, what: str) -> list[str]:
+    """``io.files.resolve_input_paths`` (the ONE resolver both planes list
+    through) plus a streaming-only refinement: zero-byte files carry no
+    shards, so they drop here — the eager readers instead keep them as
+    empty partitions (the Spark file<->partition mapping)."""
+    from ..io.files import resolve_input_paths
+
+    paths = resolve_input_paths(path, what)
+    return [p for p in paths if os.path.getsize(p) > 0]
+
+
+def _n_rows(cols: dict) -> int:
+    return len(next(iter(cols.values()))) if cols else 0
+
+
+class MemorySource(ShardedSource):
+    """In-memory data behind the sharded interface — every current call site
+    (``fit_arrays``, DataFrame estimators) keeps working unchanged while
+    riding the one streaming plane.
+
+    Wraps a column dict or a ``core.DataFrame``. ``shard_rows=None`` keeps
+    one shard per DataFrame partition (dicts become a single shard);
+    passing ``shard_rows`` re-shards into fixed row windows — matching an
+    on-disk layout row-for-row makes the loader's batch stream bit-identical
+    to the on-disk source under the same seed (the equivalence the
+    acceptance test asserts)."""
+
+    def __init__(self, data: Any, shard_rows: int | None = None,
+                 retry_policy: RetryPolicy | None = None):
+        from ..core.dataframe import DataFrame
+
+        if isinstance(data, DataFrame):
+            parts = [dict(p) for p in data.partitions]
+        else:
+            parts = [dict(data)]
+        if shard_rows is not None:
+            whole = {k: np.concatenate([np.asarray(p[k]) for p in parts])
+                     for k in parts[0]} if parts else {}
+            n = _n_rows(whole)
+            parts = [{k: v[s:s + shard_rows] for k, v in whole.items()}
+                     for s in range(0, max(n, 1), max(int(shard_rows), 1))]
+        self._parts = [p for p in parts if _n_rows(p) > 0] or parts[:1]
+        shards = [Shard(i, "memory", "", 0, _n_rows(p))
+                  for i, p in enumerate(self._parts)]
+
+        def read(shard: Shard) -> dict:
+            return dict(self._parts[shard.index])
+
+        super().__init__(shards, read, retry_policy, name="memory")

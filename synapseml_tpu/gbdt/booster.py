@@ -26,7 +26,19 @@ from .binning import BinMapper
 from . import objectives as obj
 from . import trees as T
 
-__all__ = ["TpuBooster", "train_booster"]
+__all__ = ["TpuBooster", "train_booster", "train_booster_from_source"]
+
+
+def train_booster_from_source(source, **kwargs) -> "TpuBooster":
+    """Out-of-core training: histograms built from a streamed
+    :class:`synapseml_tpu.data.ShardedSource` in fixed-memory passes —
+    the entry point for datasets that do not fit in host RAM. All batch
+    consumption goes through the data plane (``source.iter_shards`` +
+    the binned spill); see :mod:`synapseml_tpu.gbdt.streaming` for the
+    pass structure and the supported parameter surface."""
+    from .streaming import train_booster_streamed
+
+    return train_booster_streamed(source, **kwargs)
 
 
 class TpuBooster:

@@ -1,0 +1,421 @@
+"""Out-of-core GBDT: histograms built from a streamed source in
+fixed-memory passes.
+
+The in-memory path (``booster.train_booster``) keeps the full binned matrix
+and running scores resident on device — the right call when the dataset
+fits. This module trains when it does NOT: features stream shard-by-shard
+from a :class:`synapseml_tpu.data.ShardedSource` and are never materialized
+whole. Host memory is bounded by O(shard) for features plus O(n) for the
+per-row vectors every out-of-core GBDT keeps (labels, scores, gradients,
+node assignment — the n×1 vectors, not the n×F matrix).
+
+Pass structure (the LightGBM out-of-core discipline):
+
+1. **stats pass** — stream shards once: count rows, collect labels, and
+   reservoir-sample rows for quantile bin-boundary fitting
+   (``BinMapper.fit`` on the sample — fixed memory regardless of n).
+2. **bin+spill pass** — stream shards again: bin each shard
+   (``BinMapper.transform``) and spill the compact bin codes (uint16, ~2
+   bytes/cell vs 4-8 for raw floats) to local ``.npy`` files. Iterations
+   then stream the local spill (mmap) instead of re-reading and re-binning
+   the source T times.
+3. **training** — per boosting iteration, per tree level: stream spilled
+   chunks, route each row from its previous-level node, and accumulate the
+   ``(nodes, features, bins, 3)`` level histogram on device through the
+   same ``trees._level_histogram`` kernel the in-memory engine uses (padded
+   fixed-size chunks, so compiles are bounded by tree depth, not data
+   size). Split decisions run on the aggregated histogram with the exact
+   ``trees.py`` gain/leaf-value math, so streamed and in-memory training
+   agree up to float-summation order.
+
+Supported surface (v1): gbdt boosting, numerical features, the scalar/
+multiclass objectives. Bagging/GOSS/DART, categorical splits and monotone
+constraints stay on the in-memory path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import shutil
+import tempfile
+import time
+from typing import Sequence
+
+import numpy as np
+
+from . import objectives as obj
+from .binning import BinMapper
+from .booster import TpuBooster
+
+__all__ = ["train_booster_streamed"]
+
+_CHUNK_ROWS = 16384
+
+
+class _GainCfg:
+    """Adapter handing the loose streamed hyper-params to the SHARED
+    ``trees.py`` gain/leaf-value formulas — one implementation, so a future
+    regularization tweak cannot silently diverge the two engines."""
+
+    def __init__(self, l1, l2, lr):
+        self.lambda_l1 = l1
+        self.lambda_l2 = l2
+        self.learning_rate = lr
+
+
+def _leaf_value(g, h, l1, l2, lr):
+    from .trees import _leaf_value as impl
+
+    return np.asarray(impl(np.asarray(g), np.asarray(h), _GainCfg(l1, l2, lr)))
+
+
+def _split_score(g, h, l1, l2):
+    from .trees import _split_score as impl
+
+    return np.asarray(impl(np.asarray(g), np.asarray(h), _GainCfg(l1, l2, 1.0)))
+
+
+@functools.lru_cache(maxsize=None)
+def _hist_fn(base: int, width: int, num_bins: int):
+    """Jitted level-histogram over one fixed-shape chunk — the same
+    ``_level_histogram`` kernel the in-memory engine uses; padded rows carry
+    ``node_of=-1`` so the in-range mask zeroes them."""
+    import jax
+
+    from .trees import _level_histogram
+
+    def f(bins, g, h, presence, node_of):
+        return _level_histogram(bins, g, h, presence, node_of, base, width,
+                                num_bins)
+
+    return jax.jit(f)
+
+
+class _Spill:
+    """The local binned cache: one .npy per source shard + row offsets."""
+
+    def __init__(self, directory: str, files: list[str], counts: list[int]):
+        self.directory = directory
+        self.files = files
+        self.offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+
+    @property
+    def n(self) -> int:
+        return int(self.offsets[-1])
+
+    def chunks(self, chunk_rows: int):
+        """Yield (global_start, bins_chunk) in fixed-memory slices."""
+        for f, off in zip(self.files, self.offsets[:-1]):
+            mm = np.load(f, mmap_mode="r")
+            for lo in range(0, mm.shape[0], chunk_rows):
+                hi = min(lo + chunk_rows, mm.shape[0])
+                yield int(off + lo), np.asarray(mm[lo:hi])
+
+    def close(self):
+        shutil.rmtree(self.directory, ignore_errors=True)
+
+
+def _feature_matrix(cols: dict, feature_cols: Sequence[str]) -> np.ndarray:
+    missing = [c for c in feature_cols if c not in cols]
+    if missing:
+        raise ValueError(f"shard is missing feature column(s) {missing} "
+                         f"(expected {list(feature_cols)}); streamed "
+                         "training needs a uniform schema across shards")
+    mats = []
+    for c in feature_cols:
+        v = np.asarray(cols[c])
+        mats.append(v[:, None] if v.ndim == 1 else v.reshape(v.shape[0], -1))
+    return np.concatenate(mats, axis=1).astype(np.float32, copy=False)
+
+
+def _grow_tree_streamed(spill: _Spill, g: np.ndarray, h: np.ndarray,
+                        node_of: np.ndarray, *, max_depth: int,
+                        num_leaves: int, num_bins: int, lambda_l1: float,
+                        lambda_l2: float, learning_rate: float,
+                        min_data_in_leaf: int, min_sum_hessian: float,
+                        min_gain_to_split: float, chunk_rows: int):
+    """One tree in heap layout from streamed binned chunks. ``node_of`` is
+    the in-memory (n,) row->node vector; on return it holds each row's final
+    resting node so the caller can update scores without another pass."""
+    import jax.numpy as jnp
+
+    M = 2 ** (max_depth + 1) - 1
+    B = num_bins
+    feature = np.full(M, -1, np.int32)
+    threshold_bin = np.zeros(M, np.int32)
+    leaf_value = np.zeros(M, np.float32)
+    node_gain = np.zeros(M, np.float32)
+    node_cover = np.zeros(M, np.float32)
+    leaf_count = 1
+    node_of[:] = 0
+
+    def route_chunk(bins_c, lo, hi, base, width):
+        """Move rows out of split nodes of level [base, base+width)."""
+        nc = node_of[lo:hi]
+        here = (nc >= base) & (nc < base + width)
+        if not here.any():
+            return
+        rel = np.where(here, nc - base, 0)
+        node_ids = base + rel
+        split = here & (feature[node_ids] >= 0)
+        f_of = np.maximum(feature[node_ids], 0)
+        row_bin = bins_c[np.arange(bins_c.shape[0]), f_of].astype(np.int32)
+        go_left = row_bin <= threshold_bin[node_ids]
+        node_of[lo:hi] = np.where(split, 2 * nc + np.where(go_left, 1, 2), nc)
+
+    pad_to = max(int(chunk_rows), 1)
+    for depth in range(max_depth):
+        base, width = 2 ** depth - 1, 2 ** depth
+        hist = np.zeros((width, spill_features(spill), B, 3), np.float32)
+        hfn = _hist_fn(base, width, B)
+        for lo, bins_c in spill.chunks(chunk_rows):
+            hi = lo + bins_c.shape[0]
+            if depth > 0:
+                route_chunk(bins_c, lo, hi, 2 ** (depth - 1) - 1,
+                            2 ** (depth - 1))
+            c = bins_c.shape[0]
+            pad = pad_to - c
+            bpad = np.pad(bins_c, ((0, pad), (0, 0))) if pad else bins_c
+            nof = np.pad(node_of[lo:hi], (0, pad), constant_values=-1) \
+                if pad else node_of[lo:hi]
+            gp = np.pad(g[lo:hi], (0, pad)) if pad else g[lo:hi]
+            hp = np.pad(h[lo:hi], (0, pad)) if pad else h[lo:hi]
+            pres = np.zeros(pad_to, np.float32)
+            pres[:c] = 1.0
+            hist += np.asarray(hfn(jnp.asarray(bpad.astype(np.int32)),
+                                   jnp.asarray(gp), jnp.asarray(hp),
+                                   jnp.asarray(pres), jnp.asarray(nof)))
+
+        # -- split decision on the aggregated histogram (trees.py math) ----
+        cum = hist.cumsum(axis=2)                      # (W, F, B, 3)
+        total = cum[:, 0, -1, :]                       # (W, 3)
+        g_tot, h_tot, c_tot = total[:, 0], total[:, 1], total[:, 2]
+        nt = B - 1  # thresholds 0..B-2 (NaN bin never a left-inclusive cut)
+        gl = cum[:, :, :nt, 0]
+        hl = cum[:, :, :nt, 1]
+        cl = cum[:, :, :nt, 2]
+        gr = g_tot[:, None, None] - gl
+        hr = h_tot[:, None, None] - hl
+        cr = c_tot[:, None, None] - cl
+        gain = (_split_score(gl, hl, lambda_l1, lambda_l2)
+                + _split_score(gr, hr, lambda_l1, lambda_l2)
+                - _split_score(g_tot, h_tot, lambda_l1,
+                               lambda_l2)[:, None, None])
+        ok = ((cl >= min_data_in_leaf) & (cr >= min_data_in_leaf)
+              & (hl >= min_sum_hessian) & (hr >= min_sum_hessian))
+        gain = np.where(ok, gain, -np.inf)
+        flat = gain.reshape(width, -1)
+        best_idx = np.argmax(flat, axis=1)
+        best_gain = flat[np.arange(width), best_idx]
+        best_feat = (best_idx // nt).astype(np.int32)
+        best_thr = (best_idx % nt).astype(np.int32)
+        active = c_tot > 0
+        can_split = active & (best_gain > min_gain_to_split)
+        budget = max(num_leaves - leaf_count, 0)
+        order = np.argsort(np.where(can_split, -best_gain, np.inf),
+                           kind="stable")
+        rank = np.zeros(width, np.int32)
+        rank[order] = np.arange(width, dtype=np.int32)
+        do_split = can_split & (rank < budget)
+
+        node_ids = base + np.arange(width)
+        feature[node_ids] = np.where(do_split, best_feat, -1)
+        threshold_bin[node_ids] = np.where(do_split, best_thr, 0)
+        value = _leaf_value(g_tot, h_tot, lambda_l1, lambda_l2, learning_rate)
+        leaf_value[node_ids] = np.where(active & ~do_split, value, 0.0)
+        node_gain[node_ids] = np.where(do_split, best_gain, 0.0)
+        node_cover[node_ids] = c_tot
+        leaf_count += int(do_split.sum())
+
+    # final routing pass (into level max_depth) + leaf totals, no bins needed
+    # beyond the routing read
+    last_base, last_width = 2 ** (max_depth - 1) - 1, 2 ** (max_depth - 1)
+    if max_depth > 0:
+        for lo, bins_c in spill.chunks(chunk_rows):
+            route_chunk(bins_c, lo, lo + bins_c.shape[0], last_base,
+                        last_width)
+    fbase, fwidth = 2 ** max_depth - 1, 2 ** max_depth
+    at_final = (node_of >= fbase)
+    if at_final.any():
+        rel = node_of[at_final] - fbase
+        gt = np.bincount(rel, weights=g[at_final], minlength=fwidth)
+        ht = np.bincount(rel, weights=h[at_final], minlength=fwidth)
+        ct = np.bincount(rel, minlength=fwidth).astype(np.float32)
+        ids = fbase + np.arange(fwidth)
+        vals = _leaf_value(gt, ht, lambda_l1, lambda_l2, learning_rate)
+        leaf_value[ids] = np.where(ct > 0, vals, 0.0).astype(np.float32)
+        node_cover[ids] = ct
+    return feature, threshold_bin, leaf_value, node_gain, node_cover
+
+
+def spill_features(spill: _Spill) -> int:
+    if not hasattr(spill, "_n_features"):
+        spill._n_features = np.load(spill.files[0], mmap_mode="r").shape[1]
+    return spill._n_features
+
+
+def train_booster_streamed(source, *, label_col: str = "label",
+                           feature_cols: Sequence[str] | None = None,
+                           objective: str = "regression", num_class: int = 1,
+                           num_iterations: int = 50,
+                           learning_rate: float = 0.1, num_leaves: int = 31,
+                           max_depth: int = 6, max_bin: int = 255,
+                           lambda_l1: float = 0.0, lambda_l2: float = 0.0,
+                           min_data_in_leaf: int = 20,
+                           min_sum_hessian: float = 1e-3,
+                           min_gain_to_split: float = 0.0, seed: int = 0,
+                           sample_rows: int = 200_000,
+                           spill_dir: str | None = None,
+                           chunk_rows: int = _CHUNK_ROWS,
+                           measures=None) -> TpuBooster:
+    """Train a :class:`TpuBooster` from a streamed source (see module
+    docstring for the pass structure and the supported surface)."""
+    import jax
+    import jax.numpy as jnp
+
+    if objective == "lambdarank":
+        raise ValueError("lambdarank needs group structure and stays on the "
+                         "in-memory path (train_booster)")
+    if measures is None:
+        from ..core.instrumentation import InstrumentationMeasures
+
+        measures = InstrumentationMeasures()
+    from ..core import observability as _obs
+
+    step_hist = _obs.get_registry().histogram(
+        "synapseml_train_step_duration_ms",
+        "training step (boosting iteration / optimizer step) wall time",
+        ("engine",)).labels(engine="gbdt_streamed")
+    if max_depth is None or int(max_depth) <= 0:
+        # the in-memory engine's convention: <=0 means derive a heap-layout
+        # bound deep enough for num_leaves (booster.py does the same) —
+        # clamping -1 to 1 would silently train depth-1 stumps
+        max_depth = max(int(np.ceil(np.log2(max(num_leaves, 2)))) + 1, 3)
+    max_depth = min(int(max_depth), 10)
+
+    # -- pass 1: row count, labels, reservoir sample ------------------------
+    rng = np.random.default_rng(seed)
+    reservoir: np.ndarray | None = None
+    labels: list[np.ndarray] = []
+    counts: list[int] = []
+    seen = 0
+    inferred_cols = feature_cols is None
+    with measures.measure("stats_pass"):
+        for shard, cols in source.iter_shards():
+            if not cols:
+                # degenerate byte-range shard (no complete line): both
+                # passes must agree it holds zero rows so spill files stay
+                # aligned with the recorded counts
+                counts.append(0)
+                continue
+            if label_col not in cols:
+                raise ValueError(
+                    f"shard {shard.target} has no label column "
+                    f"{label_col!r} (columns: {sorted(cols)}); pass "
+                    "label_col= for this dataset")
+            if feature_cols is None:
+                feature_cols = sorted(k for k in cols if k != label_col)
+            elif inferred_cols:
+                # inferred from shard 0: a LATER shard introducing extra
+                # keys would otherwise be silently excluded from the model
+                extra = sorted(k for k in cols
+                               if k != label_col and k not in feature_cols)
+                if extra:
+                    raise ValueError(
+                        f"shard {shard.target} carries column(s) {extra} "
+                        f"absent from the first shard (inferred features "
+                        f"{list(feature_cols)}); schema drifts across "
+                        "shards — pass feature_cols= explicitly")
+            feats = _feature_matrix(cols, feature_cols)
+            labels.append(np.asarray(cols[label_col], np.float32))
+            counts.append(feats.shape[0])
+            if reservoir is None:
+                reservoir = np.empty((0, feats.shape[1]), np.float32)
+            room = sample_rows - reservoir.shape[0]
+            if room > 0:
+                reservoir = np.concatenate([reservoir, feats[:room]])
+                feats = feats[room:]
+            if feats.shape[0]:
+                # Algorithm R (vectorized): row with global index i draws
+                # j ~ U[0, i]; j < capacity replaces slot j — uniform sample
+                # over every row seen so far
+                pos = sample_rows + seen + np.arange(feats.shape[0])
+                draw = rng.integers(0, pos + 1)
+                take = draw < sample_rows
+                reservoir[draw[take]] = feats[take]
+                seen += feats.shape[0]
+    y = np.concatenate(labels) if labels else np.empty(0, np.float32)
+    n = y.shape[0]
+    if n == 0:
+        raise ValueError("streamed training needs at least one row")
+
+    # -- pass 2: fit bins on the sample, spill binned shards ----------------
+    mapper = BinMapper(max_bin=max_bin, seed=seed)
+    with measures.measure("binning"):
+        mapper.fit(reservoir)
+        directory = spill_dir or tempfile.mkdtemp(prefix="synapseml_gbdt_")
+        os.makedirs(directory, exist_ok=True)
+        files = []
+        n_features = reservoir.shape[1] if reservoir is not None else 0
+        for i, (shard, cols) in enumerate(source.iter_shards()):
+            feats = (_feature_matrix(cols, feature_cols) if cols
+                     else np.empty((0, n_features), np.float32))
+            path = os.path.join(directory, f"binned_{i:05d}.npy")
+            np.save(path, mapper.transform(feats).astype(np.uint16))
+            files.append(path)
+    spill = _Spill(directory, files, counts)
+
+    # -- training -----------------------------------------------------------
+    o = obj.get_objective(objective, num_class=num_class)
+    K = o.num_model_out
+    init = np.asarray(jax.device_get(o.init_score(jnp.asarray(y))),
+                      np.float32).reshape(K)
+    scores = np.broadcast_to(init[None, :], (n, K)).copy()
+
+    grad_hess = jax.jit(lambda s, yv: o.grad_hess(s, yv))
+    node_of = np.zeros(n, np.int32)
+    M = 2 ** (max_depth + 1) - 1
+    acc_f = np.empty((num_iterations, K, M), np.int32)
+    acc_t = np.empty((num_iterations, K, M), np.int32)
+    acc_v = np.empty((num_iterations, K, M), np.float32)
+    acc_g = np.empty((num_iterations, K, M), np.float32)
+    acc_c = np.empty((num_iterations, K, M), np.float32)
+    grow_kw = dict(max_depth=max_depth, num_leaves=num_leaves,
+                   num_bins=mapper.num_bins, lambda_l1=lambda_l1,
+                   lambda_l2=lambda_l2, learning_rate=learning_rate,
+                   min_data_in_leaf=min_data_in_leaf,
+                   min_sum_hessian=min_sum_hessian,
+                   min_gain_to_split=min_gain_to_split,
+                   chunk_rows=chunk_rows)
+    try:
+        for it in range(num_iterations):
+            t_iter = time.perf_counter()
+            measures.count("iterations")
+            with measures.measure("training"):
+                gk, hk = (np.asarray(a, np.float32).reshape(n, -1)
+                          for a in grad_hess(jnp.asarray(scores),
+                                             jnp.asarray(y)))
+                for k in range(K):
+                    (acc_f[it, k], acc_t[it, k], acc_v[it, k], acc_g[it, k],
+                     acc_c[it, k]) = _grow_tree_streamed(
+                        spill, gk[:, k], hk[:, k], node_of, **grow_kw)
+                    scores[:, k] += acc_v[it, k][node_of]
+            step_hist.observe((time.perf_counter() - t_iter) * 1e3)
+    finally:
+        if spill_dir is None:
+            spill.close()
+
+    ub = mapper.upper_bound_values()
+    thr_val = np.where(acc_f >= 0, ub[np.maximum(acc_f, 0), acc_t],
+                       0.0).astype(np.float32)
+    booster = TpuBooster(
+        acc_f, thr_val, acc_v, acc_g, cover=acc_c, max_depth=max_depth,
+        num_model_out=K, objective=o.name, init_score=init,
+        num_features=int(reservoir.shape[1]),
+        params={"num_iterations": num_iterations,
+                "learning_rate": learning_rate, "num_leaves": num_leaves,
+                "max_bin": max_bin, "streamed": True})
+    booster.bin_mapper = mapper
+    booster.train_measures = measures.to_dict()
+    return booster

@@ -19,7 +19,7 @@ import numpy as np
 from ..core.dataframe import DataFrame
 
 __all__ = ["read_binary_files", "read_image_files", "read_csv", "write_csv",
-           "read_jsonl", "write_jsonl"]
+           "read_jsonl", "write_jsonl", "resolve_input_paths"]
 
 _IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tif", ".tiff", ".webp")
 
@@ -34,6 +34,20 @@ def _resolve_paths(path: str, recursive: bool, exts: tuple[str, ...] | None) -> 
     if exts is not None:
         out = [p for p in out if p.lower().endswith(exts)]
     return sorted(out)
+
+
+def resolve_input_paths(path: str, what: str,
+                        exts: tuple[str, ...] | None = None) -> list[str]:
+    """THE glob-or-literal input resolver: a glob pattern or directory lists
+    through ``_resolve_paths``; a literal filename passes through untouched.
+    Shared by the eager tabular readers below and the streaming plane's
+    ``data.ShardedSource``, so the two planes can never list differently."""
+    is_glob = any(ch in path for ch in "*?[")
+    paths = (_resolve_paths(path, recursive=True, exts=exts)
+             if is_glob or os.path.isdir(path) else [path])
+    if not paths:
+        raise FileNotFoundError(f"no {what} files match {path!r}")
+    return paths
 
 
 def _partitioned(rows: list[dict], num_partitions: int) -> DataFrame:
@@ -98,15 +112,27 @@ def read_image_files(path: str, recursive: bool = True, num_partitions: int = 1,
 # tabular file formats (the Spark csv/json DataSource roles)
 # ---------------------------------------------------------------------------
 
-def _read_tabular(path: str, what: str, loader, num_partitions: int | None) -> DataFrame:
+def _read_tabular(path: str, what: str, loader, num_partitions: int | None,
+                  max_rows: int | None = None) -> DataFrame:
     """Shared glob-or-literal resolution + one-DataFrame-partition-per-file
-    union fold for the tabular readers."""
-    is_glob = any(ch in path for ch in "*?[")
-    paths = (_resolve_paths(path, recursive=True, exts=None)
-             if is_glob or os.path.isdir(path) else [path])
-    if not paths:
-        raise FileNotFoundError(f"no {what} files match {path!r}")
-    parts = [p for p in (loader(f) for f in paths) if p is not None]
+    union fold for the tabular readers. The path listing lives in
+    ``_resolve_paths`` — the SAME resolver ``data.ShardedSource`` shards
+    over, so eager and streamed reads can never list differently.
+
+    ``max_rows`` is a fast path, not a post-filter: each file loads at most
+    the remaining budget and files past the budget are never opened."""
+    paths = resolve_input_paths(path, what)
+    parts = []
+    remaining = None if max_rows is None else max(int(max_rows), 0)
+    for f in paths:
+        if remaining is not None and remaining <= 0:
+            break
+        p = loader(f, remaining)
+        if p is None:
+            continue
+        if remaining is not None:
+            remaining -= p.count()
+        parts.append(p)
     if not parts:
         return DataFrame.from_rows([])
     df = parts[0]
@@ -115,18 +141,24 @@ def _read_tabular(path: str, what: str, loader, num_partitions: int | None) -> D
     return df.repartition(num_partitions) if num_partitions else df
 
 
-def read_csv(path: str, num_partitions: int | None = None, **pandas_kw) -> DataFrame:
+def read_csv(path: str, num_partitions: int | None = None,
+             max_rows: int | None = None, **pandas_kw) -> DataFrame:
     """CSV file(s)/glob/directory -> DataFrame; one PARTITION PER FILE
     (Spark's file-split model — header-only files stay as empty partitions
     so the file<->partition mapping holds), or repartitioned to
-    ``num_partitions``. Parsing is pandas' C engine (in-container); kwargs
-    pass through (``dtype=``, ``usecols=``...)."""
+    ``num_partitions``. ``max_rows`` caps the TOTAL row count without
+    parsing past the budget (pandas ``nrows`` per file; later files are
+    never opened). Parsing is pandas' C engine (in-container); kwargs pass
+    through (``dtype=``, ``usecols=``...)."""
     import pandas as pd
 
-    return _read_tabular(path, "CSV",
-                         lambda p: DataFrame.from_pandas(
-                             pd.read_csv(p, **pandas_kw)),
-                         num_partitions)
+    def load(p, budget):
+        kw = dict(pandas_kw)
+        if budget is not None:  # compose with a caller-supplied nrows=
+            kw["nrows"] = min(budget, kw["nrows"]) if "nrows" in kw else budget
+        return DataFrame.from_pandas(pd.read_csv(p, **kw))
+
+    return _read_tabular(path, "CSV", load, num_partitions, max_rows)
 
 
 def write_csv(df: DataFrame, path: str, partitioned: bool = False) -> list[str]:
@@ -151,17 +183,25 @@ def write_csv(df: DataFrame, path: str, partitioned: bool = False) -> list[str]:
     return [path]
 
 
-def read_jsonl(path: str, num_partitions: int | None = None) -> DataFrame:
+def read_jsonl(path: str, num_partitions: int | None = None,
+               max_rows: int | None = None) -> DataFrame:
     """JSON-lines file(s)/glob -> DataFrame (one partition per file).
 
     Heterogeneous records are unioned over ALL keys seen in the file
     (missing fields become None) — JSONL rows rarely share an exact schema.
+    ``max_rows`` caps the TOTAL row count and stops scanning (parsing AND
+    file reads) the moment the budget is filled.
     """
     import json as _json
 
-    def load(p):
+    def load(p, budget):
+        rows = []
         with open(p) as f:
-            rows = [_json.loads(line) for line in f if line.strip()]
+            for line in f:
+                if budget is not None and len(rows) >= budget:
+                    break
+                if line.strip():
+                    rows.append(_json.loads(line))
         if not rows:
             return None
         keys: list = []
@@ -169,7 +209,7 @@ def read_jsonl(path: str, num_partitions: int | None = None) -> DataFrame:
             keys += [k for k in r if k not in keys]
         return DataFrame.from_rows([{k: r.get(k) for k in keys} for r in rows])
 
-    return _read_tabular(path, "JSONL", load, num_partitions)
+    return _read_tabular(path, "JSONL", load, num_partitions, max_rows)
 
 
 def write_jsonl(df: DataFrame, path: str) -> str:

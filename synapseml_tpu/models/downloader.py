@@ -73,7 +73,10 @@ class ModelDownloader:
                 raise ValueError(f"index path must be relative: {r!r}")
         path = os.path.realpath(os.path.join(self.local_path, *rel))
         root = os.path.realpath(self.local_path)
-        if not (path == root or path.startswith(root + os.sep)):
+        # STRICTLY inside the root: a name of "", "." or "x/.." resolves to
+        # the cache root itself, and download_model's pre-replace rmtree
+        # would then delete the entire local model cache
+        if path == root or not path.startswith(root + os.sep):
             raise ValueError(f"index path escapes the cache dir: {rel!r}")
         return path
 

@@ -28,7 +28,8 @@ import optax
 
 from ..parallel.mesh import MeshContext, logical_axis_rules
 
-__all__ = ["TrainerConfig", "Trainer", "cross_entropy_loss", "TrainState"]
+__all__ = ["TrainerConfig", "Trainer", "cross_entropy_loss", "TrainState",
+           "fit_source", "fit_arrays"]
 
 
 @dataclasses.dataclass
@@ -624,49 +625,150 @@ def _fit_with_optional_checkpointing(stage, fit_fn):
         return fit_fn(ck, stage.get("checkpoint_every"))
 
 
+class _LoaderCheckpointer:
+    """Checkpointer shim that rides the loader's iterator state along with
+    every train-state snapshot: the saved tree gains a ``data_iter`` subtree
+    (see :mod:`synapseml_tpu.data.state`), so a restore resumes the batch
+    stream mid-epoch bit-identically — no replayed, no skipped rows. One
+    batch == one ``state.step`` increment, so the step number indexes the
+    loader's per-batch snapshots directly."""
+
+    def __init__(self, inner, loader):
+        self._inner = inner
+        self._loader = loader
+
+    def save(self, tree, step: int):
+        snap = self._loader.state_for_batch(int(step))
+        if snap is None:
+            # never save a checkpoint that LOOKS resumable but would restart
+            # the stream from epoch 0 — fit_source sizes the loader's
+            # snapshot history off scan_chunk/prefetch so this cannot
+            # happen unless that sizing drifts
+            raise RuntimeError(
+                f"loader state for batch {step} is no longer in the "
+                "snapshot history — checkpoint would lose its data_iter "
+                "subtree (resume guarantee broken); widen state_history")
+        tree = dict(tree)
+        tree["data_iter"] = snap.to_tree()
+        return self._inner.save(tree, step=step)
+
+    def wait(self):
+        return self._inner.wait()
+
+    def close(self):
+        return self._inner.close()
+
+
+def fit_source(trainer: "Trainer", source, *, batch_size: int, total_steps: int,
+               seed: int, init_params=None, init_batch_stats=None,
+               scan_chunk: int = 8, checkpointer=None, checkpoint_every: int = 0,
+               state: "TrainState | None" = None, data_state: dict | None = None,
+               epochs: int | None = None, drop_remainder: bool = True,
+               shuffle_rows: str = "full", shuffle_window: int = 4096,
+               prefetch: int = 2, device_prefetch: bool = False,
+               columns: list | None = None, host_index: int = 0,
+               host_count: int = 1) -> "TrainState":
+    """Streaming fit over a :class:`synapseml_tpu.data.ShardedSource`.
+
+    The data plane supplies seeded shard + row shuffles, bucket-ladder batch
+    shapes, and a bounded-queue background prefetcher; this function adds
+    mesh alignment (batches pad to a multiple of the data-parallel size),
+    state init from the first batch, and resumable checkpointing — when a
+    ``checkpointer`` is given, every snapshot carries the loader's iterator
+    state so ``restore_checkpoint`` + ``resume_state`` + ``fit_source(...,
+    state=..., data_state=tree["data_iter"])`` continues the exact batch
+    stream an uninterrupted run would have produced.
+
+    ``total_steps`` is the TOTAL optimizer-step target: resuming from step N
+    runs ``total_steps - N`` further steps. ``device_prefetch`` places the
+    next batch on the mesh inside the prefetch thread (double-buffered
+    ``jax.device_put``) — only engaged on the per-step path
+    (``scan_chunk<=1``); the chunked scan path stacks on host and already
+    overlaps assembly with device compute.
+
+    ``host_index``/``host_count`` default to 0/1 — ONE logical stream,
+    identical on every process, because ``mesh.shard_batch`` expects each
+    process to supply the same global batch (GSPMD splits it). Per-host
+    disjoint shard feeding is the ``data.DataLoader``-level feature for
+    custom multi-host input pipelines."""
+    from ..data import DataLoader, IteratorState
+
+    dp = trainer.mesh.data_parallel_size()
+    done = int(state.step) if state is not None else 0
+    remaining = total_steps - done
+    if state is not None and remaining <= 0:
+        return state
+    if state is not None and done > 0 and data_state is None:
+        raise ValueError(
+            f"resuming from step {done} without data_state= — the loader "
+            "would silently restart the stream from epoch 0. Pass "
+            "data_state=tree['data_iter'] from the restored checkpoint for "
+            "a bit-identical continuation, or data_state='fresh' to "
+            "deliberately restart the stream")
+    if isinstance(data_state, str):
+        if data_state != "fresh":
+            raise ValueError(f"data_state must be a restored data_iter "
+                             f"tree or 'fresh', got {data_state!r}")
+        # fresh stream, but keep the batch counter aligned with state.step
+        # so checkpoint snapshots stay addressable by step number
+        data_state = IteratorState(seed=int(seed),
+                                   batches_emitted=done).to_tree()
+    place = trainer.mesh.shard_batch if (device_prefetch and scan_chunk <= 1) \
+        else None
+    loader = DataLoader(
+        source, batch_size, seed=seed, epochs=epochs,
+        drop_remainder=drop_remainder, shuffle_rows=shuffle_rows,
+        shuffle_window=shuffle_window, multiple_of=dp, prefetch=prefetch,
+        place_fn=place, columns=columns,
+        # the chunked fit's producer consumes up to ~3 chunks ahead of the
+        # checkpointed step; the snapshot ring must outlive that lag or
+        # saves lose their data_iter subtree
+        state_history=max(64, 3 * max(scan_chunk, 1) + prefetch + 8),
+        host_index=host_index, host_count=host_count,
+        state=IteratorState.from_tree(data_state) if data_state is not None
+        else None)
+    it = iter(loader)
+    try:
+        if state is None:
+            first = next(it)
+            state = trainer.init_state(first, jax.random.PRNGKey(seed),
+                                       init_params=init_params,
+                                       init_batch_stats=init_batch_stats)
+
+            def chain():
+                yield first
+                yield from it
+
+            batch_iter: Iterator[dict] = chain()
+        else:
+            batch_iter = it
+        ck = _LoaderCheckpointer(checkpointer, loader) \
+            if checkpointer is not None else None
+        return trainer.fit(state, batch_iter, max_steps=remaining,
+                           scan_chunk=scan_chunk, checkpointer=ck,
+                           checkpoint_every=checkpoint_every)
+    finally:
+        loader.close()
+
+
 def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: int,
                seed: int, init_params=None, init_batch_stats=None,
                scan_chunk: int = 8, checkpointer=None,
-               checkpoint_every: int = 0) -> "TrainState":
-    """Shared estimator fit loop: shuffling epochs over host arrays with
-    mesh-aligned padded batches (one place for batch alignment, so any
-    (batch_size, n, #devices) combination shards — batches are padded to a
-    multiple of the mesh data-parallel size and carry a ``_valid`` mask).
-
-    Throughput design (SURVEY §7 step 4 — input pipeline is the hard part):
-    ``scan_chunk`` optimizer steps run in ONE ``lax.scan`` dispatch, and a
-    background thread assembles the NEXT stacked chunk while the device runs
-    the current one (double buffering) — host batch prep and device compute
-    overlap instead of alternating. ``scan_chunk=1`` falls back to the
-    per-step loop (needed for per-step callbacks)."""
-    from ..parallel.batching import batches
+               checkpoint_every: int = 0, shard_rows: int | None = None) -> "TrainState":
+    """Shared estimator fit loop over host arrays — a thin wrapper that puts
+    the arrays behind a :class:`synapseml_tpu.data.MemorySource` and
+    delegates to :func:`fit_source`, so in-memory and out-of-core training
+    share ONE batch-assembly/shuffle/prefetch plane. ``shard_rows`` controls
+    the virtual shard layout (None = one shard): matching an on-disk layout
+    row-for-row makes this stream bit-identical to ``fit_source`` over the
+    same rows under the same seed."""
+    from ..data.source import MemorySource
 
     n = next(iter(data.values())).shape[0]
-    dp = trainer.mesh.data_parallel_size()
-    rng = np.random.default_rng(seed)
-
-    def batch_iter():
-        while True:
-            perm = rng.permutation(n)
-            shuf = {k: v[perm] for k, v in data.items()}
-            for b in batches(shuf, batch_size, multiple_of=dp,
-                             drop_remainder=n >= batch_size):
-                yield {**b.data, "_valid": b.mask.astype(np.float32)}
-
-    it = batch_iter()
-    first = next(it)
-    state = trainer.init_state(first, jax.random.PRNGKey(seed),
-                               init_params=init_params,
-                               init_batch_stats=init_batch_stats)
-
-    def chain():
-        yield first
-        yield from it
-
-    # Trainer.fit carries the chunked + double-buffered scan loop for ANY
-    # iterator (same-shape batches stack into one lax.scan dispatch; the
-    # short tail runs per-step) — this wrapper only adds shuffling epochs,
-    # mesh-padded batches, and state init.
-    return trainer.fit(state, chain(), max_steps=total_steps,
-                       scan_chunk=scan_chunk, checkpointer=checkpointer,
-                       checkpoint_every=checkpoint_every)
+    return fit_source(trainer, MemorySource(data, shard_rows=shard_rows),
+                      batch_size=batch_size, total_steps=total_steps,
+                      seed=seed, init_params=init_params,
+                      init_batch_stats=init_batch_stats, scan_chunk=scan_chunk,
+                      checkpointer=checkpointer,
+                      checkpoint_every=checkpoint_every,
+                      drop_remainder=n >= batch_size)

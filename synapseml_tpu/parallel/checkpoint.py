@@ -47,14 +47,38 @@ def save_checkpoint(path: str, tree: Any, step: int = 0, use_orbax: bool | None 
     return target
 
 
-def latest_step(path: str) -> int | None:
+def _is_complete(target: str) -> bool:
+    """A step dir counts only when the DONE marker AND the state payload
+    both exist — a crash between payload write and marker (or a marker left
+    beside a vanished payload) must never be restorable as 'latest'."""
+    if not os.path.exists(os.path.join(target, "DONE")):
+        return False
+    return (os.path.exists(os.path.join(target, "state.npz"))
+            or os.path.isdir(os.path.join(target, "orbax")))
+
+
+def _completed_steps(path: str) -> list[int]:
+    """Steps with a fully written checkpoint. Partially-written dirs (no
+    DONE / no payload — a crash mid-save) and malformed names are ignored,
+    so ``latest_step``/``restore_checkpoint``/GC can never pick one up."""
     if not os.path.isdir(path):
-        return None
+        return []
     steps = []
     for d in os.listdir(path):
-        if d.startswith("step_") and os.path.exists(os.path.join(path, d, "DONE")):
-            steps.append(int(d.split("_")[1]))
-    return max(steps) if steps else None
+        if not d.startswith("step_"):
+            continue
+        try:
+            step = int(d.split("_", 1)[1])
+        except ValueError:
+            continue  # foreign dir that merely looks like a step
+        if _is_complete(os.path.join(path, d)):
+            steps.append(step)
+    return sorted(steps)
+
+
+def latest_step(path: str) -> int | None:
+    steps = _completed_steps(path)
+    return steps[-1] if steps else None
 
 
 def restore_checkpoint(path: str, step: int | None = None, sharding_fn=None) -> Any:
@@ -65,6 +89,10 @@ def restore_checkpoint(path: str, step: int | None = None, sharding_fn=None) -> 
         if step is None:
             raise FileNotFoundError(f"no completed checkpoint under {path}")
     target = _step_dir(path, step)
+    if not _is_complete(target):
+        raise FileNotFoundError(
+            f"checkpoint step {step} under {path} is incomplete (crash "
+            f"during save?) — latest completed: {latest_step(path)}")
     orbax_dir = os.path.join(target, "orbax")
     if os.path.isdir(orbax_dir):
         import orbax.checkpoint as ocp
@@ -140,12 +168,24 @@ class AsyncCheckpointer:
         return target
 
     def _gc(self) -> None:
-        done = sorted(
-            int(d.split("_")[1]) for d in os.listdir(self.path)
-            if d.startswith("step_")
-            and os.path.exists(os.path.join(self.path, d, "DONE")))
+        done = _completed_steps(self.path)
         for step in done[:-self.keep]:
             shutil.rmtree(_step_dir(self.path, step), ignore_errors=True)
+        if done:
+            # crash leftovers: partial dirs OLDER than the newest completed
+            # checkpoint can never complete (saves are ordered on one worker
+            # thread) — drop them so a restore tool listing the directory
+            # sees only restorable steps
+            for d in os.listdir(self.path):
+                if not d.startswith("step_"):
+                    continue
+                try:
+                    step = int(d.split("_", 1)[1])
+                except ValueError:
+                    continue
+                target = os.path.join(self.path, d)
+                if step < done[-1] and not _is_complete(target):
+                    shutil.rmtree(target, ignore_errors=True)
 
     def wait(self) -> None:
         """Block until the in-flight write (if any) finishes; re-raises its

@@ -149,6 +149,47 @@ def test_no_inline_jit_in_stage_transform():
         f"core.batching.CompiledCache.get): {offenders}")
 
 
+def test_fit_paths_consume_batches_through_data_plane():
+    """Static guard for the streaming data plane: ``models/trainer.py`` and
+    ``gbdt/booster.py`` must consume training batches only through the
+    ``data`` plane (``fit_source`` / ``train_booster_from_source``) or the
+    thin ``fit_arrays`` wrapper — no ad-hoc slicing loops or direct
+    ``parallel.batching`` minibatchers reintroduced. An inline slicing loop
+    would fork shuffle/padding/resume semantics off the one plane the
+    checkpointable-iterator guarantee rests on."""
+    import ast
+
+    pkg = pathlib.Path(st.__file__).parent
+    offenders = []
+    for rel in ("models/trainer.py", "gbdt/booster.py"):
+        src = (pkg / rel).read_text()
+        tree = ast.parse(src)
+        for node in ast.walk(tree):
+            # (a) the training-side minibatcher must not be imported here
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.endswith("parallel.batching"):
+                offenders.append(f"{rel}:{node.lineno} imports "
+                                 f"parallel.batching ({[a.name for a in node.names]})")
+            # (b) no 3-arg range() slicing loops (the ad-hoc batch pattern
+            # `for start in range(0, n, batch_size): x[start:...]`)
+            if isinstance(node, ast.For) and isinstance(node.iter, ast.Call) \
+                    and isinstance(node.iter.func, ast.Name) \
+                    and node.iter.func.id == "range" \
+                    and len(node.iter.args) == 3 \
+                    and any(isinstance(x, ast.Slice)
+                            for b in node.body for x in ast.walk(b)):
+                offenders.append(f"{rel}:{node.lineno} ad-hoc slicing loop")
+    assert not offenders, (
+        "batch consumption outside the data plane (route it through "
+        f"data.DataLoader / fit_source / fit_arrays): {offenders}")
+    # the positive side: the plane entry points exist and delegate
+    trainer_src = (pkg / "models/trainer.py").read_text()
+    assert "def fit_source(" in trainer_src
+    assert "MemorySource" in trainer_src  # fit_arrays delegates to the plane
+    booster_src = (pkg / "gbdt/booster.py").read_text()
+    assert "def train_booster_from_source(" in booster_src
+
+
 def test_wrapper_chaining_fit_transform():
     from synapseml_tpu.compat.lightgbm import (LightGBMClassificationModel,
                                                LightGBMClassifier)

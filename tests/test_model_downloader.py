@@ -148,3 +148,14 @@ def test_local_models_empty_cache(tmp_path):
     assert list(dl.local_models()) == []
     with pytest.raises(ValueError, match="server_url"):
         dl.remote_models()
+
+
+def test_safe_path_rejects_cache_root_itself(tmp_path):
+    """A remote index name of '', '.' or 'x/..' must not resolve to the
+    cache root — download_model's pre-replace rmtree would then delete the
+    ENTIRE local model cache (ADVICE r5 medium)."""
+    dl = ModelDownloader(str(tmp_path / "cache6"))
+    for name in ("", ".", "x/.."):
+        with pytest.raises(ValueError):
+            dl._safe_path(name)
+    assert dl._safe_path("gpt2-nano").endswith("gpt2-nano")
