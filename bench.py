@@ -69,6 +69,9 @@ GLOBAL_BUDGET_S = 1320      # stay under the driver's kill timeout (~25+ min)
 # flagship line still prints last for the single-line consumer.
 CONFIGS = [
     ("onnx-resnet", "onnx_resnet50", 300, 300),
+    # llama-decode also carries the continuous_ab record: run-to-completion
+    # generate vs paged continuous decode on a mixed-length stream (both
+    # arms in the same round, serving-microbatch discipline)
     ("llama-decode", "llama_decode", 300, 300),
     ("gbdt-higgs", "gbdt_higgs1m", 420, 300),
     ("gbdt-hist-backends", "gbdt_hist_backends", 420, 0),

@@ -4,7 +4,24 @@ tokens/sec). On the single v5e chip a 7B model doesn't fit (weights alone
 the TPU mode runs the largest single-chip Llama-shaped config (all the 7B
 structure at ~1.1B params) and reports tokens/sec/chip; the 7B multi-chip
 path itself is exercised (reduced width, tensor x fsdp mesh) in
-tests/test_hf_cyber.py::test_llama2_7b_code_path_reduced_width."""
+tests/test_hf_cyber.py::test_llama2_7b_code_path_reduced_width.
+
+A/B mode (same round, serving-microbatch discipline): a MIXED-LENGTH
+request stream — prompt lengths spanning three seq-ladder rungs, generation
+budgets 4..48 tokens — decoded two ways:
+
+  (a) rtc   — run-to-completion ``generate``: requests batched in arrival
+              order, the whole batch decodes until its LONGEST member
+              finishes (the lax.while_loop exits only when every row is
+              done), so short requests pay the group's worst case;
+  (b) paged — the token-granular paged-KV engine: decode slots refill the
+              moment a sequence finishes, sequences share one physical
+              page pool.
+
+Both arms run warmed (compile excluded) on identical token workloads and
+count only REQUESTED tokens as useful. Emits tokens/sec, per-token p50/p99
+per request, KV-block occupancy, and the paged compile counts (decode
+executables must stay <= the slot-ladder size)."""
 import json
 import sys
 import time
@@ -16,7 +33,9 @@ sys.path.insert(0, str(Path(__file__).parent))
 sys.path.insert(0, str(Path(__file__).parent.parent))
 
 
-def run(jax, platform, n_chips):
+def _legacy_throughput(jax, platform):
+    """The original single-config dense decode number (PERF_BASELINE
+    continuity: metric name and method unchanged)."""
     import jax.numpy as jnp
 
     from synapseml_tpu.models.flax_nets.llama import (LlamaLM, generate,
@@ -56,6 +75,198 @@ def run(jax, platform, n_chips):
         "platform": platform, "n_params": n_params, "batch": B,
         "prompt_len": P, "new_tokens": new,
         "decode_ms_per_token": round(dt / new * 1e3, 2)}
+
+
+def _mixed_stream(rng, n_requests: int, vocab: int):
+    """(prompt_ids, n_new) per request: prompt lengths span >= 3 seq-ladder
+    rungs (16/32/64); generation budgets are HEAVY-TAILED (mostly short
+    chat-style turns, ~20% long completions) — the real serving mix where
+    the run-to-completion barrier hurts, since most batches contain one
+    long member every short request must wait out."""
+    reqs = []
+    for _ in range(n_requests):
+        plen = int(rng.choice([6, 12, 20, 30, 44, 56]))
+        if rng.random() < 0.2:
+            n_new = int(rng.choice([48, 64]))
+        else:
+            n_new = int(rng.choice([4, 6, 8, 12, 16, 24]))
+        reqs.append((rng.integers(2, vocab, (plen,)).tolist(), n_new))
+    return reqs
+
+
+def _percentiles(values):
+    values = sorted(values)
+    return (round(values[len(values) // 2], 3),
+            round(values[int(len(values) * 0.99)], 3))
+
+
+def _run_rtc(jax, cfg, params, requests, slots: int, trials: int = 3):
+    """Run-to-completion arm: batches of ``slots`` in arrival order, prompts
+    padded to the group's seq-ladder rung, ONE ``generate`` call decoding
+    max(group budgets) steps — the whole-batch barrier the dense serving
+    path pays today. Per-request wall = its group's wall (a request is done
+    only when its batch returns)."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.core.batching import default_bucketer
+    from synapseml_tpu.models.flax_nets.llama import LlamaLM, generate
+
+    model = LlamaLM(cfg, decode=True)
+    bucketer = default_bucketer()
+    groups = [requests[i:i + slots] for i in range(0, len(requests), slots)]
+
+    compiled = {}
+
+    def fn_for(B, P, new):
+        key = (B, P, new)
+        if key not in compiled:
+            compiled[key] = jax.jit(
+                lambda ids, mask: generate(model, params, ids, new,
+                                           prompt_mask=mask))
+        return compiled[key]
+
+    def run_group(group, t0_stream=None, timed_lat=None):
+        B = len(group)
+        P = bucketer.seq_bucket_for(max(len(p) for p, _ in group),
+                                    cap=cfg.max_len)
+        new = max(n for _, n in group)
+        ids = np.zeros((B, P), np.int32)
+        mask = np.zeros((B, P), np.int32)
+        for i, (p, _) in enumerate(group):
+            ids[i, :len(p)] = p
+            mask[i, :len(p)] = 1
+        np.asarray(fn_for(B, P, new)(jnp.asarray(ids), jnp.asarray(mask)))
+        if timed_lat is not None:
+            # every request in the group completes when the GROUP returns;
+            # latency counts from stream start (queue wait included), same
+            # clock the paged arm is measured on
+            done = time.perf_counter()
+            for _, n in group:
+                timed_lat.append((done - t0_stream) * 1e3 / n)
+
+    for g in groups:  # warm every (B, P, new) combo
+        run_group(g)
+    best = None
+    for _ in range(trials):  # min-of-N: host contention hits both arms alike
+        lat = []
+        t0 = time.perf_counter()
+        for g in groups:
+            run_group(g, t0_stream=t0, timed_lat=lat)
+        wall = time.perf_counter() - t0
+        if best is None or wall < best[0]:
+            best = (wall, lat)
+    wall, lat = best
+    useful = sum(n for _, n in requests)
+    p50, p99 = _percentiles(lat)
+    return {"tokens_per_sec": round(useful / wall, 1),
+            "token_p50_ms": p50, "token_p99_ms": p99,
+            "useful_tokens": useful, "wall_s": round(wall, 3),
+            "executables": len(compiled)}
+
+
+def _run_paged(cfg, params, requests, slots: int, trials: int = 3):
+    """Continuous arm: every request runs exactly its budget; slots refill
+    the moment one finishes. Per-request wall = submit -> its own finish.
+    The warm pass runs the identical workload so every prefill/decode rung
+    compiles (through the shared CompiledCache) before timing."""
+    from synapseml_tpu.core.batching import get_compiled_cache
+    from synapseml_tpu.models.paged_engine import PagedDecodeEngine
+
+    engine = PagedDecodeEngine(cfg, params, block_len=16, max_slots=slots,
+                               prefill_batch=2)
+    cache = get_compiled_cache()
+    d0 = cache.miss_count("llama_paged_decode")
+    p0 = cache.miss_count("llama_paged_prefill")
+
+    def one_pass():
+        seqs = [engine.submit(p, n) for p, n in requests]
+        starts = {s.uid: time.perf_counter() for s in seqs}
+        lat, occ = [], []
+        t0 = time.perf_counter()
+        while any(not s.done for s in seqs):
+            done_events = engine.admit() + engine.step()
+            now = time.perf_counter()
+            occ.append(engine.stats()["occupancy"])
+            for ev in done_events:
+                if ev["done"]:
+                    s = ev["seq"]
+                    lat.append((now - starts[s.uid]) * 1e3
+                               / max(len(s.generated), 1))
+        return time.perf_counter() - t0, lat, occ
+
+    one_pass()              # warm: all compiles land here
+    wall, lat, occ = min((one_pass() for _ in range(trials)),
+                         key=lambda r: r[0])
+    useful = sum(n for _, n in requests)
+    p50, p99 = _percentiles(lat)
+    out = {"tokens_per_sec": round(useful / wall, 1),
+           "token_p50_ms": p50, "token_p99_ms": p99,
+           "useful_tokens": useful, "wall_s": round(wall, 3),
+           "kv_occupancy_mean": round(float(np.mean(occ)), 3),
+           "kv_occupancy_max": round(float(np.max(occ)), 3),
+           "slot_rungs": list(engine.slot_rungs),
+           "decode_executables":
+               int(cache.miss_count("llama_paged_decode") - d0),
+           "prefill_executables":
+               int(cache.miss_count("llama_paged_prefill") - p0)}
+    engine.release()
+    return out
+
+
+def _continuous_ab(jax, platform):
+    """Both arms in the same round on the same stream (the serving-microbatch
+    A/B discipline)."""
+    import jax.numpy as jnp
+
+    from synapseml_tpu.core.batching import default_bucketer
+    from synapseml_tpu.models.flax_nets.llama import LlamaLM, llama_tiny
+
+    # big enough that a decode step is device-dominated (per-call dispatch
+    # overhead under 20% of a step), small enough for the CPU budget
+    cfg = llama_tiny(hidden=320, n_layers=6, n_heads=8, n_kv_heads=4,
+                     mlp_dim=768, vocab_size=1024, max_len=128)
+    params = LlamaLM(cfg).init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))["params"]
+    from flax.core import meta
+    params = jax.tree.map(
+        lambda x: x.value if isinstance(x, meta.Partitioned) else x, params,
+        is_leaf=lambda x: isinstance(x, meta.Partitioned))
+    rng = np.random.default_rng(7)
+    # TPU runs through the (flaky, high-RTT) relay: a smaller stream and a
+    # single timed pass keep the A/B inside the config deadline — numbers
+    # land opportunistically, the CPU A/B is the gating one
+    on_tpu = platform == "tpu"
+    requests = _mixed_stream(rng, n_requests=24 if on_tpu else 48,
+                             vocab=cfg.vocab_size)
+    slots = 8
+    trials = 1 if on_tpu else 3
+    rtc = _run_rtc(jax, cfg, params, requests, slots, trials=trials)
+    paged = _run_paged(cfg, params, requests, slots, trials=trials)
+    ladder = default_bucketer()
+    return {
+        "stream": {"n_requests": len(requests), "slots": slots,
+                   "prompt_rungs": sorted({ladder.seq_bucket_for(
+                       len(p), cap=cfg.max_len) for p, _ in requests}),
+                   "total_tokens": sum(n for _, n in requests)},
+        "paged": paged,
+        "rtc_baseline": rtc,
+        "tokens_per_sec_vs_rtc": round(
+            paged["tokens_per_sec"] / rtc["tokens_per_sec"], 3)
+        if rtc["tokens_per_sec"] else None,
+        "token_p99_vs_rtc": round(
+            paged["token_p99_ms"] / rtc["token_p99_ms"], 3)
+        if rtc["token_p99_ms"] else None,
+        "decode_ladder_size": len(paged["slot_rungs"]),
+    }
+
+
+def run(jax, platform, n_chips):
+    result = _legacy_throughput(jax, platform)
+    try:
+        result["continuous_ab"] = _continuous_ab(jax, platform)
+    except Exception as e:  # noqa: BLE001 — A/B failure must not eat the
+        result["continuous_ab"] = {"error": repr(e)}  # legacy TPU number
+    return result
 
 
 def main():

@@ -19,11 +19,23 @@ class HuggingFaceCausalLM(WrapperBase):
     def getBatchSize(self):
         return self._get('batch_size')
 
+    def setDecodeSlots(self, value):
+        return self._set('decode_slots', value)
+
+    def getDecodeSlots(self):
+        return self._get('decode_slots')
+
     def setDoSample(self, value):
         return self._set('do_sample', value)
 
     def getDoSample(self):
         return self._get('do_sample')
+
+    def setEngine(self, value):
+        return self._set('engine', value)
+
+    def getEngine(self):
+        return self._get('engine')
 
     def setEosId(self, value):
         return self._set('eos_id', value)
@@ -42,6 +54,18 @@ class HuggingFaceCausalLM(WrapperBase):
 
     def getInputCol(self):
         return self._get('input_col')
+
+    def setKvBlockLen(self, value):
+        return self._set('kv_block_len', value)
+
+    def getKvBlockLen(self):
+        return self._get('kv_block_len')
+
+    def setKvBlocks(self, value):
+        return self._set('kv_blocks', value)
+
+    def getKvBlocks(self):
+        return self._get('kv_blocks')
 
     def setMaxNewTokens(self, value):
         return self._set('max_new_tokens', value)

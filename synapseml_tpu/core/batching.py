@@ -64,35 +64,67 @@ _CACHE_METRICS = obs.HandleCache(lambda reg: {
 })
 
 
-class ShapeBucketer:
-    """Pow-2 / configurable bucket ladder for batch (and sequence) dims.
+def _pow2_rungs(min_bucket: int, max_bucket: int, what: str) -> list[int]:
+    rungs, b = [], max(int(min_bucket), 1)
+    while b <= int(max_bucket):
+        rungs.append(b)
+        b *= 2
+    if not rungs:
+        raise ValueError(f"empty pow-2 {what} ladder: min={min_bucket} > "
+                         f"max={max_bucket}")
+    return rungs
 
-    ``bucket_for(n)`` returns the smallest ladder rung >= n, so any stream of
-    sizes compiles at most ``len(ladder)`` executables per function. ``cap``
-    arguments (a stage's ``batch_size``/``mini_batch_size``) bound memory:
-    :meth:`slices` chunks at the largest rung <= cap and pads only the final
-    partial chunk to its own rung — a 3-row request pays a rung-of-8
-    executable, not the full-cap one."""
+
+def _smallest_rung_geq(ladder: tuple, n: int) -> int:
+    """Smallest rung >= n; n itself past the top rung (beyond-ladder sizes
+    keep their exact shape). The one bucketing scan for BOTH the batch and
+    the sequence dimension."""
+    for rung in ladder:
+        if rung >= n:
+            return rung
+    return n
+
+
+class ShapeBucketer:
+    """Pow-2 / configurable bucket ladders for the batch AND sequence dims.
+
+    ``bucket_for(n)`` returns the smallest batch-ladder rung >= n, so any
+    stream of sizes compiles at most ``len(ladder)`` executables per
+    function. ``cap`` arguments (a stage's ``batch_size``/
+    ``mini_batch_size``) bound memory: :meth:`slices` chunks at the largest
+    rung <= cap and pads only the final partial chunk to its own rung — a
+    3-row request pays a rung-of-8 executable, not the full-cap one.
+
+    The SEQUENCE ladder (``seq_ladder``, pow-2 16..4096 by default) buckets
+    the token/page dimension the same way: a variable-length prompt pads up
+    to :meth:`seq_bucket_for` so the token-serving plane compiles at most
+    ladder-many prefill executables (bucketed prompt lens) and ladder-many
+    decode executables (bucketed active-slot counts), never one per distinct
+    length."""
 
     def __init__(self, ladder: Sequence[int] | None = None,
-                 min_bucket: int = 8, max_bucket: int = 1024):
+                 min_bucket: int = 8, max_bucket: int = 1024,
+                 seq_ladder: Sequence[int] | None = None,
+                 min_seq_bucket: int = 16, max_seq_bucket: int = 4096):
         if ladder is not None:
             rungs = sorted({int(b) for b in ladder})
             if not rungs or rungs[0] < 1:
                 raise ValueError(f"bucket ladder must be positive ints: {ladder}")
         else:
-            rungs, b = [], max(int(min_bucket), 1)
-            while b <= int(max_bucket):
-                rungs.append(b)
-                b *= 2
-            if not rungs:
-                raise ValueError(
-                    f"empty pow-2 ladder: min_bucket={min_bucket} > "
-                    f"max_bucket={max_bucket}")
+            rungs = _pow2_rungs(min_bucket, max_bucket, "batch")
         self.ladder: tuple[int, ...] = tuple(rungs)
+        if seq_ladder is not None:
+            seq_rungs = sorted({int(b) for b in seq_ladder})
+            if not seq_rungs or seq_rungs[0] < 1:
+                raise ValueError(
+                    f"seq ladder must be positive ints: {seq_ladder}")
+        else:
+            seq_rungs = _pow2_rungs(min_seq_bucket, max_seq_bucket, "seq")
+        self.seq_ladder: tuple[int, ...] = tuple(seq_rungs)
 
     def __repr__(self):
-        return f"ShapeBucketer(ladder={list(self.ladder)})"
+        return (f"ShapeBucketer(ladder={list(self.ladder)}, "
+                f"seq_ladder={list(self.seq_ladder)})")
 
     @property
     def max_bucket(self) -> int:
@@ -105,12 +137,7 @@ class ShapeBucketer:
         next pow-2 (up to 2x wasted compute); only serving-sized batches
         bucket."""
         n = max(int(n), 1)
-        bucket = n
-        for rung in self.ladder:
-            if rung >= n:
-                bucket = rung
-                break
-        return _round_up(bucket, multiple_of)
+        return _round_up(_smallest_rung_geq(self.ladder, n), multiple_of)
 
     def cap_for(self, max_rows: int, multiple_of: int = 1) -> int:
         """Chunking cap: the largest rung <= max_rows, EXCEPT when max_rows
@@ -133,6 +160,35 @@ class ShapeBucketer:
         cap = self.cap_for(max_rows, multiple_of)
         out = sorted({_round_up(r, multiple_of)
                       for r in self.ladder if r <= cap} | {cap})
+        return out
+
+    # ---- sequence/page dimension (token-serving plane) ----
+    def seq_bucket_for(self, n: int, multiple_of: int = 1,
+                       cap: int | None = None) -> int:
+        """Smallest seq-ladder rung >= n (rounded up to ``multiple_of``; KV
+        block lengths pass their block size so every prompt bucket tiles
+        whole pages). ``cap`` clamps at a model's max_len: lengths beyond
+        the ladder (or the cap) keep the cap's exact shape rather than
+        padding toward the next pow-2."""
+        n = max(int(n), 1)
+        bucket = _round_up(_smallest_rung_geq(self.seq_ladder, n),
+                           multiple_of)
+        if cap is not None:
+            cap = _round_up(int(cap), multiple_of)
+            if n > cap:
+                raise ValueError(f"sequence length {n} exceeds cap {cap}")
+            bucket = min(bucket, cap)
+        return bucket
+
+    def seq_buckets_upto(self, max_len: int, multiple_of: int = 1) -> list[int]:
+        """Every bucket :meth:`seq_bucket_for` can emit for lengths up to
+        ``max_len`` — the prefill warmup/precompile set and the prefill
+        compile-count bound for a variable-prompt-length stream."""
+        cap = _round_up(int(max_len), multiple_of)
+        out = sorted({_round_up(r, multiple_of)
+                      for r in self.seq_ladder if r <= cap})
+        if not out or out[-1] < cap:
+            out.append(cap)
         return out
 
     def slices(self, n: int, max_rows: int,

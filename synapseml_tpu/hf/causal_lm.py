@@ -3,6 +3,10 @@
 Batch LLM inference as a Transformer: prompts (or chat message lists) ->
 tokenize -> pad to a static prompt bucket -> jitted prefill+decode
 (``greedy_generate``: KV cache, lax.while_loop, early EOS exit) -> detokenize.
+``engine="paged"`` swaps the decode core for the token-granular paged-KV
+engine (``models/paged_engine.py``) — early-EOS rows free their pages and
+decode slots mid-batch — and ``serving_engine()`` exposes the SAME engine
+to ``io.serving.serve_llm`` for online token streaming.
 
 Model loading: ``set_params`` with a flax param pytree (e.g. restored from an
 orbax checkpoint), or random init from the architecture preset for smoke
@@ -19,7 +23,7 @@ from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Transformer
 from ..models.flax_nets.llama import LlamaLM, generate, llama2_7b, llama_tiny
-__all__ = ["HuggingFaceCausalLM"]
+__all__ = ["HuggingFaceCausalLM", "CausalLMServingEngine"]
 
 _ARCHS = {"llama2-7b": llama2_7b, "llama-tiny": llama_tiny}
 
@@ -77,16 +81,33 @@ class HuggingFaceCausalLM(Transformer):
         "(HuggingFaceCausalLMTransform.py:284-331). Rows are BUCKETED by "
         "identical config so the jit cache stays bounded by the number of "
         "distinct configs, not rows", default=None)
+    engine = Param(
+        "engine", "decode engine: 'dense' (run-to-completion lax.while_loop "
+        "generate) or 'paged' (token-granular paged-KV continuous batching "
+        "— models/paged_engine.py; greedy output is token-identical, "
+        "early-EOS rows free their KV pages and decode slots immediately). "
+        "Online serving (io.serving.serve_llm) always rides the paged "
+        "engine; this picks the offline transform() path", default="dense")
+    kv_block_len = Param("kv_block_len", "paged engine: tokens per KV page",
+                         default=16, converter=TypeConverters.to_int)
+    kv_blocks = Param("kv_blocks", "paged engine: physical KV pages in the "
+                      "pool (None = enough for decode_slots x max_len)",
+                      default=None)
+    decode_slots = Param("decode_slots", "paged engine: max concurrently "
+                         "decoding sequences (None = batch_size)",
+                         default=None)
 
     _CACHE_KEYS = frozenset({"model_name", "model_params", "tokenizer",
                              "mesh_config", "max_new_tokens", "eos_id",
                              "do_sample", "temperature", "top_k", "top_p",
-                             "seed"})
+                             "seed", "engine", "kv_block_len", "kv_blocks",
+                             "decode_slots"})
 
     def set(self, **kw):
         out = super().set(**kw)
         if self._CACHE_KEYS & kw.keys():
             self.__dict__.pop("_cache_model", None)
+            self.__dict__.pop("_cache_engines", None)
             cb.invalidate_token(self)  # cached executables captured old state
         return out
 
@@ -203,6 +224,55 @@ class HuggingFaceCausalLM(Transformer):
             "hf_causal_lm", (B, P) + eff_key, build,
             instance=cb.instance_token(self), dtype="int32")
 
+    def _paged_engine(self, eff: dict):
+        """The shared token-granular engine (one per distinct sampling
+        config; greedy — the default — shares one). Offline ``transform``
+        and online ``serve_llm`` both decode through THIS object: one page
+        pool, one set of prefill/decode executables in the CompiledCache,
+        keyed by this stage's instance token so ``set(...)`` invalidates
+        them with the rest of the stage's programs."""
+        key = (bool(eff["do_sample"]),
+               float(eff["temperature"]) if eff["do_sample"] else 0.0,
+               eff["top_k"], eff["top_p"], int(eff["seed"]), eff["eos_id"])
+        engines = self.__dict__.setdefault("_cache_engines", {})
+        eng = engines.get(key)
+        if eng is None or eng._released:
+            from ..models.paged_engine import PagedDecodeEngine
+
+            model, params, _tok, mesh = self._model_and_params()
+            if mesh is not None:
+                raise ValueError(
+                    "engine='paged' does not support mesh_config yet; "
+                    "sharded generation rides the dense path")
+            sampling = bool(eff["do_sample"])
+            slots = self.get("decode_slots") or max(int(self.get("batch_size")), 2)
+            eng = PagedDecodeEngine(
+                model.cfg, params,
+                block_len=int(self.get("kv_block_len")),
+                n_blocks=self.get("kv_blocks"), max_slots=int(slots),
+                temperature=float(eff["temperature"]) if sampling else 0.0,
+                top_k=None if eff["top_k"] is None else int(eff["top_k"]),
+                top_p=None if eff["top_p"] is None else float(eff["top_p"]),
+                seed=int(eff["seed"]), eos_id=eff["eos_id"],
+                instance=cb.instance_token(self))
+            engines[key] = eng
+            # each engine owns a full device page pool — per-row
+            # generation_params must not accumulate one multi-GB pool per
+            # distinct sampling config, so bound the cache and release the
+            # oldest IDLE engine (a released engine still decodes, it just
+            # recompiles; the cache never hands it out again)
+            if len(engines) > 4:
+                for k in list(engines):
+                    if k != key and not engines[k].has_work():
+                        engines.pop(k).release()
+                        break
+        return eng
+
+    def serving_engine(self) -> "CausalLMServingEngine":
+        """The text-level adapter ``io.serving.serve_llm`` schedules tokens
+        on (tokenize request -> paged engine -> detokenized chunks)."""
+        return CausalLMServingEngine(self)
+
     def _texts_of(self, p) -> list[str]:
         mc = self.get("messages_col")
         if mc:
@@ -214,6 +284,10 @@ class HuggingFaceCausalLM(Transformer):
         self.require_columns(df, mc if mc else self.get("input_col"))
         if self.get("generation_params_col"):
             self.require_columns(df, self.get("generation_params_col"))
+        engine_kind = self.get("engine")
+        if engine_kind not in ("dense", "paged"):
+            raise ValueError(f"engine must be 'dense' or 'paged', "
+                             f"got {engine_kind!r}")
         model, params, tok, _mesh = self._model_and_params()
         B = self.get("batch_size")
         bucket = self.get("prompt_bucket")
@@ -250,20 +324,40 @@ class HuggingFaceCausalLM(Transformer):
                           multiple_of=bucket)
                 ids = np.asarray(enc["input_ids"], np.int32)
                 mask = np.asarray(enc["attention_mask"], np.int32)
-                P = ids.shape[1]
-                outs = []
                 m = len(ix)
-                for s, e, row_bucket in bucketer.slices(m, B, multiple_of=dp):
-                    ib = cb.pad_rows(ids[s:e], row_bucket)
-                    mb = cb.pad_rows(mask[s:e], row_bucket,
-                                     mode="constant", constant=1)
-                    fn = self._generate_fn(row_bucket, P, eff)
-                    gen = cb.unpad_rows(
-                        fn(ib, mb, np.int32(part_offset + int(ix[s]))), e - s)
-                    outs.append(gen[:, P:])                 # generated ids only
-                gen_ids = np.concatenate(outs, axis=0)
+                if engine_kind == "paged":
+                    # token-granular continuous decode: early-EOS rows free
+                    # their pages/slots mid-batch instead of riding the
+                    # while_loop to the last row's finish
+                    prompts = [ids[j][mask[j] > 0].tolist() for j in range(m)]
+                    # a zero-token prompt has nothing to condition on: emit
+                    # an empty completion for that ROW instead of letting
+                    # engine.submit's ValueError fail the whole scan
+                    live = [j for j, pr in enumerate(prompts) if pr]
+                    gen_rows = [np.zeros(0, np.int32)] * m
+                    if live:
+                        for j, g in zip(live, self._paged_engine(eff).generate(
+                                [prompts[j] for j in live],
+                                eff["max_new_tokens"],
+                                uids=[part_offset + int(ix[j])
+                                      for j in live])):
+                            gen_rows[j] = g
+                else:
+                    P = ids.shape[1]
+                    outs = []
+                    for s, e, row_bucket in bucketer.slices(m, B,
+                                                            multiple_of=dp):
+                        ib = cb.pad_rows(ids[s:e], row_bucket)
+                        mb = cb.pad_rows(mask[s:e], row_bucket,
+                                         mode="constant", constant=1)
+                        fn = self._generate_fn(row_bucket, P, eff)
+                        gen = cb.unpad_rows(
+                            fn(ib, mb, np.int32(part_offset + int(ix[s]))),
+                            e - s)
+                        outs.append(gen[:, P:])             # generated ids only
+                    gen_rows = list(np.concatenate(outs, axis=0))
                 for j, i in enumerate(ix):
-                    toks = gen_ids[j]
+                    toks = np.asarray(gen_rows[j])
                     if eff["eos_id"] is not None:
                         stop = np.nonzero(toks == eff["eos_id"])[0]
                         if len(stop):
@@ -285,3 +379,115 @@ class HuggingFaceCausalLM(Transformer):
                 q[self.get("output_col")] = np.empty(0, dtype=object)
             out_parts.append(q)
         return DataFrame(out_parts)
+
+
+class CausalLMServingEngine:
+    """Text adapter between ``io.serving.serve_llm``'s token scheduler and
+    the stage's shared :class:`~..models.paged_engine.PagedDecodeEngine`:
+    parses request payloads (``{"prompt"| "input_ids", "max_new_tokens",
+    "stream"}``), tokenizes through the stage's tokenizer, and renders
+    per-token chunks / terminal replies (detokenized when the tokenizer can
+    decode, raw token ids otherwise)."""
+
+    def __init__(self, stage: "HuggingFaceCausalLM"):
+        model, _params, tok, mesh = stage._model_and_params()
+        if mesh is not None:
+            raise ValueError("serve_llm rides the paged engine, which does "
+                             "not support mesh_config yet")
+        self._tok = tok
+        self._decode = getattr(tok, "decode", None)
+        self._max_len = model.cfg.max_len
+        eff = stage._effective_gen_cfg()
+        self._default_max_new = int(eff["max_new_tokens"])
+        self._engine = stage._paged_engine(eff)
+
+    # -- scheduling delegation (the serve_llm protocol) --
+    def admit(self):
+        return self._engine.admit()
+
+    def step(self):
+        return self._engine.step()
+
+    def has_work(self) -> bool:
+        return self._engine.has_work()
+
+    @property
+    def waiting_count(self) -> int:
+        return self._engine.waiting_count
+
+    def warmup(self) -> int:
+        return self._engine.warmup()
+
+    def abort(self, seq):
+        return self._engine.abort(seq)
+
+    def abort_all(self):
+        return self._engine.abort_all()
+
+    def release(self) -> None:
+        self._engine.release()
+
+    def stats(self) -> dict:
+        return self._engine.stats()
+
+    # -- request surface --
+    def submit(self, payload, request_id: str,
+               max_new_cap: int = 1024):
+        if not isinstance(payload, dict):
+            raise ValueError("body must be a JSON object with 'prompt' or "
+                             "'input_ids'")
+        stream = bool(payload.get("stream", False))
+        max_new = int(payload.get("max_new_tokens", self._default_max_new))
+        max_new = max(1, min(max_new, int(max_new_cap)))
+        if "input_ids" in payload:
+            ids = [int(t) for t in payload["input_ids"]]
+        else:
+            prompt = payload.get("prompt")
+            if not isinstance(prompt, str) or not prompt:
+                raise ValueError("need 'prompt' (non-empty string) or "
+                                 "'input_ids'")
+            # keep the prompt whole (up to the model horizon); the engine
+            # clamps max_new to the remaining room and reports
+            # finish_reason='length' — a large max_new_tokens must not
+            # silently truncate the prompt out from under the request
+            enc = self._tok([prompt], max_len=self._max_len - 1,
+                            multiple_of=1)
+            row_ids = np.asarray(enc["input_ids"][0])
+            row_mask = np.asarray(enc["attention_mask"][0])
+            ids = row_ids[row_mask > 0].tolist()
+        return self._engine.submit(ids, max_new, request_id=request_id,
+                                   stream=stream)
+
+    def chunk_for(self, event: dict) -> dict:
+        out = {"token": event["token"]}
+        if self._decode is not None:
+            # byte-level BPE pieces are not independently decodable (a
+            # char split across tokens decodes per-token to U+FFFD): decode
+            # the cumulative ids and stream the text DELTA instead
+            seq = event["seq"]
+            full = self._decode(list(seq.generated))
+            prev = getattr(seq, "_emitted_text", "")
+            if full.endswith("�"):
+                # incomplete byte sequence at the tail: hold the text back
+                # until a later token completes it (the terminal record's
+                # full-sequence decode always carries the complete text)
+                out["text"] = ""
+            else:
+                out["text"] = (full[len(prev):] if full.startswith(prev)
+                               else full)
+                seq._emitted_text = full
+        return out
+
+    def result_for(self, seq) -> dict:
+        toks = list(seq.generated)
+        if (self._engine.eos_id is not None and toks
+                and toks[-1] == self._engine.eos_id):
+            toks = toks[:-1]
+        out = {"done": True, "n_tokens": len(toks),
+               "finish_reason": seq.finish_reason,
+               "output_ids": toks}
+        if self._decode is not None:
+            out["text"] = self._decode(toks)
+        if seq.preemptions:
+            out["preemptions"] = seq.preemptions
+        return out

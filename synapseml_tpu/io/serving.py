@@ -11,7 +11,10 @@ Here: a threaded stdlib HTTP server parks each connection on an Event;
 mode, ``HTTPMicroBatchReader`` analog); ``reply_batch`` completes the parked
 exchanges. ``serve_pipeline`` wires a Transformer into the loop — micro-batch
 with ``batch_interval_ms`` or per-request continuous mode (``interval=0``,
-the reference's sub-millisecond continuous path).
+the reference's sub-millisecond continuous path). ``serve_llm`` runs the
+TOKEN-granular scheduler instead: prefill between decode steps over the
+paged-KV engine, chunked streaming replies, immediate slot refill on EOS
+(docs/SERVING.md, "Token-level LLM serving").
 """
 
 from __future__ import annotations
@@ -31,8 +34,8 @@ from ..core import batching as cb
 from ..core import observability as obs
 from ..core.dataframe import DataFrame
 
-__all__ = ["ServingServer", "serve_pipeline", "NoDelayHTTPServer",
-           "PipelineHolder"]
+__all__ = ["ServingServer", "serve_pipeline", "serve_llm",
+           "NoDelayHTTPServer", "PipelineHolder"]
 
 # batch-size histogram rungs: one bucket per pow-2 occupancy up to the
 # serve-loop max (NOT latency buckets — these count rows per micro-batch)
@@ -128,6 +131,9 @@ class NoDelayHTTPServer(ThreadingHTTPServer):
         return sock, addr
 
 
+_STREAM_END = object()  # chunk-queue sentinel: close the chunked response
+
+
 class _Exchange:
     def __init__(self, request_id: str, method: str, path: str, headers: dict,
                  body: bytes):
@@ -141,8 +147,19 @@ class _Exchange:
         self.reply_body: bytes = b""
         self.reply_status: int = 200
         self.reply_headers: dict = {}
+        # token-streaming mode: the scheduler pushes chunks, the parked
+        # handler thread writes them out as HTTP/1.1 chunked encoding.
+        # The chunk queue is created lazily in stream_begin — the dominant
+        # non-streaming path must not pay a Queue (lock + 3 condvars) per
+        # request
+        self.streaming = False
+        self.chunks: "queue.Queue | None" = None
+        self._replied = False
 
     def respond(self, body, status: int = 200, headers: dict | None = None):
+        if self._replied:
+            return  # first terminal reply wins (drop-path vs handler races)
+        self._replied = True
         if isinstance(body, (dict, list)):
             body = json.dumps(body).encode()
             headers = {"Content-Type": "application/json", **(headers or {})}
@@ -152,6 +169,33 @@ class _Exchange:
         self.reply_status = status
         self.reply_headers = headers or {}
         self.reply_event.set()
+
+    def stream_begin(self, status: int = 200,
+                     headers: dict | None = None) -> None:
+        """Switch the parked handler into chunked-streaming mode; chunks
+        pushed via :meth:`stream_chunk` flush per token."""
+        if self._replied:
+            return
+        self._replied = True
+        self.chunks = queue.Queue()
+        self.streaming = True
+        self.reply_status = status
+        self.reply_headers = headers or {"Content-Type":
+                                         "application/x-ndjson"}
+        self.reply_event.set()
+
+    def stream_chunk(self, data) -> None:
+        if self.chunks is None:
+            return  # stream never began (or a buffered reply won the race)
+        if isinstance(data, (dict, list)):
+            data = (json.dumps(data) + "\n").encode()
+        elif isinstance(data, str):
+            data = data.encode()
+        self.chunks.put(data)
+
+    def stream_end(self) -> None:
+        if self.chunks is not None:
+            self.chunks.put(_STREAM_END)
 
 
 class ServingServer:
@@ -266,6 +310,8 @@ class ServingServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return 504
+                if ex.streaming:
+                    return self._stream_reply(ex)
                 self.send_response(ex.reply_status)
                 for k, v in ex.reply_headers.items():
                     if k.lower() != "content-length":  # we set the real one
@@ -273,6 +319,32 @@ class ServingServer:
                 self.send_header("Content-Length", str(len(ex.reply_body)))
                 self.end_headers()
                 self.wfile.write(ex.reply_body)
+                return ex.reply_status
+
+            def _stream_reply(self, ex) -> int:
+                """Incremental (token-streaming) reply: HTTP/1.1 chunked
+                encoding, one flush per pushed chunk. The handler thread
+                stays parked on the chunk queue; a scheduler that stops
+                feeding it past ``reply_timeout_s`` truncates the stream
+                cleanly rather than parking the connection forever."""
+                self.send_response(ex.reply_status)
+                for k, v in ex.reply_headers.items():
+                    if k.lower() not in ("content-length",
+                                         "transfer-encoding"):
+                        self.send_header(k, v)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                while True:
+                    try:
+                        chunk = ex.chunks.get(timeout=outer.reply_timeout_s)
+                    except queue.Empty:
+                        break  # stalled producer: close the stream
+                    if chunk is _STREAM_END:
+                        break
+                    if chunk:
+                        self.wfile.write(b"%x\r\n" % len(chunk) + chunk
+                                         + b"\r\n")
+                self.wfile.write(b"0\r\n\r\n")
                 return ex.reply_status
 
             def do_GET(self):
@@ -418,11 +490,20 @@ class ServingServer:
         them to the pipeline would burn compute a slow batch can't spare)
         and recording queue-wait + occupancy."""
         now = time.perf_counter()
-        live = [e for e in exchanges
-                if now - e.enqueued_at < self.reply_timeout_s]
+        live, dropped = [], []
+        for e in exchanges:
+            (live if now - e.enqueued_at < self.reply_timeout_s
+             else dropped).append(e)
         m = _SERVING_METRICS.get()
-        if len(live) < len(exchanges):
-            m["expired"].inc(len(exchanges) - len(live))
+        if dropped:
+            m["expired"].inc(len(dropped))
+            # terminal reply for every dropped exchange: a handler racing
+            # the deadline (clock skew, a just-under-the-wire dequeue) must
+            # wake NOW with an error, never park out its full timeout on a
+            # request the scheduler has already abandoned
+            for e in dropped:
+                e.respond({"error": "request expired in queue before "
+                                    "batch pickup"}, status=504)
         if not live:
             return self._empty_batch()
         # queue wait = enqueue -> drained into a batch (the micro-batch
@@ -496,6 +577,13 @@ class ServingServer:
             except queue.Empty:
                 break
         return self._finish_batch(exchanges)
+
+    def exchange_for(self, request_id: str) -> "_Exchange | None":
+        """The still-parked exchange for ``request_id`` (None once its
+        handler gave up) — the token scheduler uses it to stream chunks
+        back through the originating connection."""
+        with self._lock:
+            return self._pending.get(str(request_id))
 
     def reply_batch(self, df: DataFrame, id_col: str = "id",
                     reply_col: str = "reply", status: int = 200) -> int:
@@ -626,4 +714,170 @@ def serve_pipeline(pipeline, port: int = 0, batch_interval_ms: int = 10,
 
     for _ in range(max(num_threads, 1)):
         threading.Thread(target=loop, daemon=True).start()
+    return server
+
+
+def serve_llm(stage, port: int = 0, poll_ms: float = 20.0,
+              latency_budget_ms: float = 5.0, max_new_tokens_cap: int = 1024,
+              max_waiting: int = 256, version: str | None = None,
+              warmup: bool = True) -> ServingServer:
+    """Token-granular LLM serving: the continuous-batching TOKEN scheduler
+    over a paged-KV decode engine (``models/paged_engine.py``).
+
+    ``stage`` is a causal-LM transformer exposing ``serving_engine()``
+    (:class:`~synapseml_tpu.hf.HuggingFaceCausalLM`) or a
+    :class:`PipelineHolder` of one. Request body::
+
+        {"prompt": "...", "max_new_tokens": 32, "stream": false}
+
+    Unlike ``serve_pipeline`` (whole-request micro-batches), the loop
+    interleaves at token granularity: queued requests drain through
+    ``read_batch_adaptive`` and PREFILL between decode steps, every decode
+    step advances all active sequences one token, and a sequence that emits
+    EOS or exhausts its budget frees its KV pages and decode slot
+    immediately — no run-to-completion barrier, so short generations never
+    wait out a long neighbor's tail. ``stream: true`` replies are chunked
+    NDJSON (one ``{"token", "text"}`` object per token, then a terminal
+    ``{"done": true, ...}`` record); non-streaming requests get one final
+    JSON reply. Any request the scheduler dequeues but cannot serve (bad
+    payload, overload, engine swap) receives a TERMINAL error reply — a
+    client never blocks to its full timeout on a silently-dropped request.
+
+    ``POST /admin/load`` hot-swaps stay zero-compile-stall: the loop
+    rebuilds the engine from the swapped-in stage and ``warmup()``
+    precompiles every prefill rung (seq ladder) and decode rung (slot
+    ladder) BEFORE the new engine takes a request; the old engine's
+    executables are evicted."""
+    holder = (stage if isinstance(stage, PipelineHolder)
+              else PipelineHolder(stage, version))
+    if not hasattr(holder.pipeline, "serving_engine"):
+        raise TypeError(
+            f"serve_llm needs a stage exposing serving_engine() (e.g. "
+            f"HuggingFaceCausalLM); got {type(holder.pipeline).__name__} — "
+            f"use serve_pipeline for whole-request stages")
+    server = ServingServer(port=port)
+    server.pipeline_holder = holder
+    server._loop_cfg = {"parse_json": True, "input_col": "prompt"}
+    server.start()
+
+    def build_engine(st):
+        eng = st.serving_engine()
+        if warmup:
+            eng.warmup()
+        return eng
+
+    open_streams: dict[str, object] = {}  # request_id -> exchange
+
+    def dispatch(engine, events):
+        for ev in events:
+            seq = ev["seq"]
+            rid = seq.request_id
+            if rid is None:
+                continue
+            ex = open_streams.get(rid) or server.exchange_for(rid)
+            if ex is None:
+                # handler timed out / client gone: stop decoding into a
+                # dead connection — free the pages and slot NOW
+                if not ev["done"]:
+                    engine.abort(seq)
+                continue
+            if seq.stream:
+                if rid not in open_streams:
+                    ex.stream_begin()
+                    open_streams[rid] = ex
+                if ev["token"] is not None:
+                    ex.stream_chunk(engine.chunk_for(ev))
+                if ev["done"]:
+                    ex.stream_chunk(engine.result_for(seq))
+                    ex.stream_end()
+                    open_streams.pop(rid, None)
+            elif ev["done"]:
+                ex.respond(engine.result_for(seq))
+
+    def loop():
+        # ONE consistent snapshot: a hot-swap landing during this (long,
+        # warmup-heavy) build must still trip the v != current check below
+        stage0, current = holder.get()
+        engine = build_engine(stage0)
+        while server._running:
+            try:
+                engine, current = _iterate(engine, current)
+            except Exception as e:  # noqa: BLE001 — scheduler must survive
+                # an engine failure fails every in-flight request with a
+                # TERMINAL reply (never a silent stall to client timeout)
+                for rid, ex in list(open_streams.items()):
+                    ex.stream_chunk({"error": f"engine failure: {e}"})
+                    ex.stream_end()
+                    open_streams.pop(rid, None)
+                try:
+                    for seq in engine.abort_all():
+                        _reply_error(seq, f"engine failure: {e}")
+                except Exception:  # noqa: BLE001
+                    pass
+                # the failed call may have consumed the DONATED page-pool
+                # buffers mid-step, leaving the engine unusable — rebuild
+                # it rather than retrying into deleted buffers
+                try:
+                    engine.release()
+                    st, v = holder.get()
+                    engine = build_engine(st)
+                    current = v
+                except Exception:  # noqa: BLE001 — retry next iteration
+                    time.sleep(0.5)
+
+    def _iterate(engine, current):
+            stage_now, v = holder.get()
+            if v != current:
+                # hot swap: precompile the replacement's rungs, then cut
+                # over between steps; in-flight sequences finish... they
+                # cannot — the pages live in the old engine — so they get a
+                # terminal error instead of a silent stall
+                old, engine = engine, build_engine(stage_now)
+                current = v
+                for rid, ex in list(open_streams.items()):
+                    ex.stream_chunk({"error": "pipeline hot-swapped "
+                                              "mid-generation"})
+                    ex.stream_end()
+                    open_streams.pop(rid, None)
+                for seq in old.abort_all():
+                    _reply_error(seq, "pipeline hot-swapped mid-generation")
+                old.release()
+            busy = engine.has_work()
+            # busy: drain without blocking — a 1 ms queue wait would tax
+            # EVERY decode step of every active sequence; idle: block on
+            # the poll interval
+            batch = server.read_batch_adaptive(
+                max_rows=64, latency_budget_s=latency_budget_ms / 1e3,
+                poll_timeout_s=(0.0 if busy else max(poll_ms, 1.0) / 1e3))
+            if not batch.is_empty():
+                ids = batch.collect_column("id")
+                bodies = batch.collect_column("body")
+                for rid, body in zip(ids, bodies):
+                    rid = str(rid)
+                    ex = server.exchange_for(rid)
+                    if ex is None:
+                        continue
+                    if engine.waiting_count >= max_waiting:
+                        ex.respond({"error": "LLM queue full"}, status=503)
+                        continue
+                    try:
+                        payload = json.loads(body.decode() or "null")
+                        engine.submit(payload, rid,
+                                      max_new_cap=max_new_tokens_cap)
+                    except (ValueError, TypeError, KeyError, IndexError,
+                            UnicodeDecodeError) as e:
+                        # one malformed body is THAT client's 400, never an
+                        # engine failure that aborts everyone else
+                        ex.respond({"error": f"bad request: {e}"}, status=400)
+            dispatch(engine, engine.admit())
+            dispatch(engine, engine.step())
+            return engine, current
+
+    def _reply_error(seq, err):
+        if seq.request_id:
+            ex = server.exchange_for(seq.request_id)
+            if ex is not None:
+                ex.respond({"error": err}, status=503)
+
+    threading.Thread(target=loop, daemon=True).start()
     return server

@@ -12,9 +12,12 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from .transformer import Encoder, TransformerConfig
+from .transformer import (Encoder, MlpBlock, MoEBlock, TransformerConfig,
+                          _norm, apply_rope, make_causal_mask,
+                          rope_frequencies)
 
-__all__ = ["llama2_7b", "llama_tiny", "LlamaLM", "generate", "greedy_generate"]
+__all__ = ["llama2_7b", "llama_tiny", "LlamaLM", "generate", "greedy_generate",
+           "PagedLlamaLM", "paged_prefill", "paged_decode_step"]
 
 
 def llama2_7b(**kw) -> TransformerConfig:
@@ -195,3 +198,251 @@ def greedy_generate(model: LlamaLM, params, prompt_ids: jax.Array,
     name used by serving and tests)."""
     return generate(model, params, prompt_ids, max_new_tokens, eos_id=eos_id,
                     prompt_mask=prompt_mask, temperature=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Paged/block KV cache (token-granular continuous batching)
+# ---------------------------------------------------------------------------
+#
+# The dense decode path above allocates a [B, max_len] KV cache per batch
+# row, so a finished sequence's cache stays pinned until the whole batch
+# exits the while_loop (run-to-completion). The paged variant keys KV storage
+# off a fixed physical pool of (n_blocks, block_len, kv_heads, head_dim)
+# pages plus a per-sequence BLOCK TABLE of page indices: sequences of any
+# length share one pool, a finished sequence's pages free immediately, and
+# the decode step is a single-token program whose only batch dimension is
+# the number of ACTIVE SLOTS — the vLLM PagedAttention layout expressed as
+# pure gather/scatter XLA (no custom kernel), which is what the TPU/CPU
+# backends compile well today. Block id 0 is RESERVED as the trash page:
+# padded prompt positions and inactive slots write there, so live pages are
+# never aliased (property-tested in tests/test_paged_llm.py).
+#
+# The modules below mirror LlamaLM's module tree name-for-name (embed /
+# decoder.layer_i.{RMSNorm_0,RMSNorm_1,attn.{q,k,v,o},mlp} / lm_head), so
+# one param pytree drives both the dense and the paged path — a checkpoint
+# published for `LlamaLM` serves paged with zero conversion, and greedy
+# paged decode is token-for-token identical to `greedy_generate`.
+
+
+class PagedAttention(nn.Module):
+    """GQA attention over a paged KV pool.
+
+    ``mode='prefill'``: self-attention over the (padded) prompt with a
+    causal + pad mask, writing each REAL token's K/V into its page slot.
+    ``mode='decode'``: one query token per slot; K/V gathered from the pool
+    through the block table (pages in table order hold the sequence's
+    contiguous logical token stream).
+
+    Param tree is identical to :class:`~.transformer.Attention` (same
+    ``q/k/v/o`` DenseGeneral submodules, same init), so params are shared
+    with the dense path."""
+
+    cfg: TransformerConfig
+    block_len: int
+    mode: str  # 'prefill' | 'decode'
+
+    @nn.compact
+    def __call__(self, x, k_pages, v_pages, block_tables, positions,
+                 write_pos, kv_mask_len):
+        """x: [B,T,hidden] (T=1 in decode). positions: [B,T] RoPE positions.
+        write_pos: [B,T] page-slot index per token (-1 = don't write, goes
+        to the trash page). kv_mask_len: [B] number of attendable logical
+        positions (prefill: the padded prompt width with a pad mask handled
+        by caller-supplied write_pos; decode: seq_len+1 incl. this token).
+        Returns (out, k_pages, v_pages)."""
+        cfg = self.cfg
+        B, T, _ = x.shape
+        H, KV, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        bl = self.block_len
+        dense = lambda name, heads: nn.DenseGeneral(  # noqa: E731
+            features=(heads, D), axis=-1, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), ("embed", "heads", "kv")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("heads", "kv")),
+            name=name)
+        q = dense("q", H)(x)
+        k = dense("k", KV)(x)
+        v = dense("v", KV)(x)
+        if cfg.use_rope:
+            cos_np, sin_np = rope_frequencies(D, cfg.max_len, cfg.rope_theta)
+            cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+
+        # ---- scatter K/V into the pool (trash page 0 absorbs non-writes) --
+        n_blocks = k_pages.shape[0]
+        block_of = jnp.take_along_axis(
+            block_tables, jnp.maximum(write_pos, 0) // bl, axis=1)  # [B,T]
+        flat_idx = block_of * bl + jnp.maximum(write_pos, 0) % bl
+        flat_idx = jnp.where(write_pos >= 0, flat_idx, 0).reshape(-1)
+        k_flat = k_pages.reshape(n_blocks * bl, KV, D)
+        v_flat = v_pages.reshape(n_blocks * bl, KV, D)
+        k_flat = k_flat.at[flat_idx].set(k.reshape(B * T, KV, D)
+                                         .astype(k_flat.dtype))
+        v_flat = v_flat.at[flat_idx].set(v.reshape(B * T, KV, D)
+                                         .astype(v_flat.dtype))
+
+        if self.mode == "prefill":
+            # prompt is self-contained: attend over the in-flight K/V (not
+            # the pool), causal + pad mask. Pads carry write_pos=-1.
+            mask = (write_pos >= 0)[:, None, None, :]
+            causal = make_causal_mask(T, T)
+            mask = jnp.logical_and(mask, causal)
+            kk, vv = k, v
+        else:
+            # decode: gather this slot's logical KV stream from the pool
+            L = block_tables.shape[1] * bl
+            gather_idx = (block_tables[:, :, None] * bl
+                          + jnp.arange(bl)[None, None, :]).reshape(B, L)
+            kk = k_flat[gather_idx]                      # [B, L, KV, D]
+            vv = v_flat[gather_idx]
+            mask = (jnp.arange(L)[None, :]
+                    < kv_mask_len[:, None])[:, None, None, :]
+        if KV != H:
+            kk = jnp.repeat(kk, H // KV, axis=2)
+            vv = jnp.repeat(vv, H // KV, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) \
+            / jnp.sqrt(D).astype(cfg.dtype)
+        scores = jnp.where(mask, scores, jnp.finfo(cfg.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+        out = nn.DenseGeneral(
+            features=cfg.hidden, axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), ("heads", "kv", "embed")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)),
+            name="o")(out)
+        return out, k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape)
+
+
+class PagedBlock(nn.Module):
+    """Pre-norm Block with paged attention; param names match
+    :class:`~.transformer.Block` (two anonymous norms in the same creation
+    order, ``attn``, ``mlp``)."""
+
+    cfg: TransformerConfig
+    block_len: int
+    mode: str
+
+    @nn.compact
+    def __call__(self, x, k_pages, v_pages, block_tables, positions,
+                 write_pos, kv_mask_len):
+        cfg = self.cfg
+        mlp_cls = MoEBlock if cfg.moe_experts > 0 else MlpBlock
+        h = _norm(cfg)(x)
+        h, k_pages, v_pages = PagedAttention(
+            cfg, self.block_len, self.mode, name="attn")(
+                h, k_pages, v_pages, block_tables, positions, write_pos,
+                kv_mask_len)
+        x = x + h
+        h = _norm(cfg)(x)
+        h = mlp_cls(cfg, name="mlp")(h)
+        return x + h, k_pages, v_pages
+
+
+class PagedEncoder(nn.Module):
+    """Layer stack threading the page pool — a TUPLE of per-layer
+    ``[n_blocks, block_len, KV, D]`` arrays, NOT one stacked array: each
+    layer's scatter then updates only its own pool leaf, which XLA turns
+    into an in-place dynamic-update under buffer donation. A stacked pool
+    costs a full-stack copy per layer per step (measured 2.3x on the CPU
+    A/B)."""
+
+    cfg: TransformerConfig
+    block_len: int
+    mode: str
+
+    @nn.compact
+    def __call__(self, x, k_pages, v_pages, block_tables, positions,
+                 write_pos, kv_mask_len):
+        cfg = self.cfg
+        k_out, v_out = list(k_pages), list(v_pages)
+        for i in range(cfg.n_layers):
+            x, k_out[i], v_out[i] = PagedBlock(cfg, self.block_len, self.mode,
+                                               name=f"layer_{i}")(
+                x, k_pages[i], v_pages[i], block_tables, positions,
+                write_pos, kv_mask_len)
+        return _norm(cfg)(x), tuple(k_out), tuple(v_out)
+
+
+class PagedLlamaLM(nn.Module):
+    """[B,T] ids -> ([B,T,V] logits, updated page pool). ``k_pages`` /
+    ``v_pages`` are tuples of per-layer ``[n_blocks, block_len, KV, D]``
+    arrays. Same param pytree as :class:`LlamaLM` — one checkpoint drives
+    both engines."""
+
+    cfg: TransformerConfig
+    block_len: int
+    mode: str = "decode"
+
+    @nn.compact
+    def __call__(self, input_ids, k_pages, v_pages, block_tables, positions,
+                 write_pos, kv_mask_len):
+        cfg = self.cfg
+        if cfg.norm_position != "pre" or cfg.learned_pos:
+            raise ValueError("the paged engine supports pre-norm RoPE/causal "
+                             "decoder configs (the Llama family)")
+        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype,
+                     embedding_init=nn.with_logical_partitioning(
+                         nn.initializers.normal(0.02), ("vocab", "embed")),
+                     name="embed")(input_ids)
+        x, k_pages, v_pages = PagedEncoder(
+            cfg, self.block_len, self.mode, name="decoder")(
+                x, k_pages, v_pages, block_tables, positions, write_pos,
+                kv_mask_len)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                          param_dtype=cfg.param_dtype,
+                          kernel_init=nn.with_logical_partitioning(
+                              nn.initializers.normal(0.02), ("embed", "vocab")),
+                          name="lm_head")(x)
+        return logits, k_pages, v_pages
+
+
+def paged_prefill(cfg: TransformerConfig, block_len: int, params,
+                  prompt_ids: jax.Array, prompt_mask: jax.Array,
+                  block_tables: jax.Array, k_pages: jax.Array,
+                  v_pages: jax.Array):
+    """Prompt -> (last-real-token logits [B,V], updated pages).
+
+    ``prompt_ids``/``prompt_mask``: [B,P] right-padded to a seq-ladder
+    bucket; real token t writes K/V into page ``block_tables[b, t//bl]``
+    slot ``t%bl`` (pads go to the trash page), so each sequence's pages hold
+    its dense logical token stream with no pad holes."""
+    B, P = prompt_ids.shape
+    t_idx = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
+    write_pos = jnp.where(prompt_mask > 0, t_idx, -1)
+    lengths = jnp.sum(prompt_mask.astype(jnp.int32), axis=-1)
+    model = PagedLlamaLM(cfg, block_len, mode="prefill")
+    logits, k_pages, v_pages = model.apply(
+        {"params": params}, prompt_ids, k_pages, v_pages, block_tables,
+        t_idx, write_pos, lengths)
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)[:, 0]
+    return last, k_pages, v_pages
+
+
+def paged_decode_step(cfg: TransformerConfig, block_len: int, params,
+                      tokens: jax.Array, seq_lens: jax.Array,
+                      active: jax.Array, block_tables: jax.Array,
+                      k_pages: jax.Array, v_pages: jax.Array):
+    """One token per active slot -> (logits [S,V], updated pages).
+
+    ``tokens``: [S] current token per slot; ``seq_lens``: [S] tokens already
+    in the sequence BEFORE this one (= this token's logical position);
+    ``active``: [S] bool — padded slots write to the trash page and produce
+    garbage logits the scheduler ignores."""
+    S = tokens.shape[0]
+    positions = seq_lens[:, None].astype(jnp.int32)
+    write_pos = jnp.where(active[:, None], positions, -1)
+    kv_mask_len = jnp.where(active, seq_lens + 1, 1)
+    model = PagedLlamaLM(cfg, block_len, mode="decode")
+    logits, k_pages, v_pages = model.apply(
+        {"params": params}, tokens[:, None], k_pages, v_pages, block_tables,
+        positions, write_pos, kv_mask_len)
+    return logits[:, 0], k_pages, v_pages

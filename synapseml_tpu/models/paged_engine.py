@@ -1,0 +1,663 @@
+"""Token-granular paged-KV decode engine for the causal LM path.
+
+The dense ``generate`` path (``models/flax_nets/llama.py``) is
+run-to-completion: one ``lax.while_loop`` decodes a whole batch until every
+row finishes, so one long generation holds the batch hostage and a finished
+row's ``[max_len]`` KV cache stays pinned to the end. This engine is the
+vLLM-style alternative the serving plane schedules tokens on:
+
+* **Paged KV pool** — a fixed physical pool of
+  ``(n_blocks, block_len, kv_heads, head_dim)`` pages per layer plus a
+  per-sequence block table (``models/flax_nets/llama.py`` paged modules).
+  Sequences of any length share one pool; a finished sequence's pages free
+  the moment it emits EOS or exhausts ``max_new_tokens``. Block 0 is the
+  reserved trash page — never allocated, absorbing masked writes — so live
+  pages can never alias.
+* **Prefill/decode split** — a jitted prefill program per bucketed prompt
+  length (``ShapeBucketer.seq_bucket_for``) and a jitted single-step decode
+  program per bucketed active-slot count (``bucket_for``). Both are
+  acquired ONLY through the shared :class:`~..core.batching.CompiledCache`
+  (enforced statically in ``tests/test_codegen.py``), so a variable request
+  stream compiles at most ladder-many executables each, all warmable.
+* **Continuous batching** — :meth:`admit` prefills waiting sequences into
+  free slots between decode steps and :meth:`step` decodes one token for
+  every active slot; the scheduler in ``io/serving.py`` drives the loop.
+  When the pool runs dry mid-decode the youngest sequence is preempted
+  (pages freed, re-queued for re-prefill over prompt+generated — greedy
+  decode makes the recomputation token-identical).
+
+Greedy paged decode is token-for-token identical to ``greedy_generate``
+(parity-tested across prompt buckets in ``tests/test_paged_llm.py``); both
+paths read the same param pytree.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Sequence
+
+import numpy as np
+
+from ..core import batching as cb
+from ..core import observability as obs
+
+__all__ = ["BlockAllocator", "PagedDecodeEngine", "SequenceState"]
+
+
+_ENGINE_METRICS = obs.HandleCache(lambda reg: {
+    "step_ms": reg.histogram(
+        "synapseml_llm_step_ms",
+        "wall time of one engine step, split prefill vs decode", ("phase",)),
+    "token_ms": reg.histogram(
+        "synapseml_llm_token_latency_ms",
+        "decode wall time per emitted token (step time / tokens emitted)"),
+    "ttft_ms": reg.histogram(
+        "synapseml_llm_ttft_ms",
+        "submit -> first generated token (queue wait + prefill)"),
+    "tokens": reg.counter(
+        "synapseml_llm_tokens_total",
+        "generated tokens by phase (prefill = first token)", ("phase",)),
+    "occupancy": reg.gauge(
+        "synapseml_llm_kv_block_occupancy",
+        "fraction of the physical KV block pool allocated to live sequences"),
+    "fragmentation": reg.gauge(
+        "synapseml_llm_kv_fragmentation",
+        "unused token slots inside allocated blocks / allocated capacity "
+        "(tail waste of the page granularity)"),
+    "refilled": reg.counter(
+        "synapseml_llm_slots_refilled_total",
+        "decode slots handed to a waiting sequence after a finish freed "
+        "capacity (the no-run-to-completion-barrier counter)"),
+    "preempted": reg.counter(
+        "synapseml_llm_slots_preempted_total",
+        "sequences evicted mid-decode because the block pool ran dry "
+        "(re-queued for re-prefill)"),
+    "finished": reg.counter(
+        "synapseml_llm_sequences_finished_total",
+        "sequences completed, by finish reason", ("reason",)),
+})
+
+
+class BlockAllocator:
+    """Free-list allocator over the physical page pool. Block 0 is the
+    reserved trash page and is never handed out; double-free and
+    allocate-while-live are hard errors (the no-aliasing invariant the
+    property test leans on)."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (1 is the trash page), "
+                             f"got {n_blocks}")
+        self.n_blocks = int(n_blocks)
+        self._free: list[int] = list(range(self.n_blocks - 1, 0, -1))
+        self._live: set[int] = set()
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._live)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (pool minus the trash page)."""
+        return self.n_blocks - 1
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` blocks or None (never a partial allocation)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._live.update(out)
+        return out
+
+    def free(self, blocks: Iterable[int]) -> None:
+        for b in blocks:
+            if b not in self._live:
+                raise RuntimeError(
+                    f"freeing block {b} that is not live (double free or "
+                    f"trash-page free — an aliasing bug)")
+            self._live.remove(b)
+            self._free.append(b)
+
+
+@dataclass
+class SequenceState:
+    """One request's decode state (host side; device state is the pages)."""
+
+    uid: int
+    prompt_ids: list
+    max_new_tokens: int
+    request_id: str | None = None
+    stream: bool = False
+    generated: list = field(default_factory=list)
+    blocks: list = field(default_factory=list)
+    tokens_in_pages: int = 0       # prompt + generated tokens written to pages
+    preemptions: int = 0
+    submitted_at: float = field(default_factory=time.perf_counter)
+    first_token_at: float | None = None
+    finish_reason: str | None = None
+
+    @property
+    def context_ids(self) -> list:
+        """Tokens a (re-)prefill must process: prompt + generated so far."""
+        return list(self.prompt_ids) + list(self.generated)
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+
+class PagedDecodeEngine:
+    """Continuous-batching decode engine over a paged KV pool.
+
+    ``submit`` -> waiting queue; ``admit`` prefills waiting sequences into
+    capacity (bucketed prompt lengths, fixed prefill batch width);
+    ``step`` decodes ONE token for every active sequence (bucketed slot
+    count). Both return event dicts
+    ``{"seq", "token", "text"?, "done", "finish_reason"}`` the serving
+    scheduler turns into streamed chunks / terminal replies.
+
+    Sampling config (``temperature``/``top_k``/``top_p``/``seed``) is fixed
+    per engine — it is baked into the compiled programs' cache key; greedy
+    (the default) is what the parity guarantee covers. ``eos_id`` and
+    per-sequence ``max_new_tokens`` are host-side and never recompile.
+    """
+
+    def __init__(self, cfg, params, *, block_len: int = 16,
+                 n_blocks: int | None = None, max_slots: int = 8,
+                 max_len: int | None = None, prefill_batch: int = 4,
+                 temperature: float = 0.0, top_k: int | None = None,
+                 top_p: float | None = None, seed: int = 0,
+                 eos_id: int | None = None, bucketer=None,
+                 instance: Any = None, fn_prefix: str = "llama_paged",
+                 donate_pages: bool = True):
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.params = params
+        self.block_len = int(block_len)
+        self.max_len = int(max_len or cfg.max_len)
+        if self.max_len > cfg.max_len:
+            raise ValueError(f"max_len {self.max_len} exceeds the model's "
+                             f"RoPE/cache horizon {cfg.max_len}")
+        self.max_blocks = -(-self.max_len // self.block_len)
+        self.max_slots = int(max_slots)
+        self.prefill_batch = int(prefill_batch)
+        if n_blocks is None:
+            # default: every slot can run to max_len concurrently + trash
+            n_blocks = 1 + self.max_slots * self.max_blocks
+        self.allocator = BlockAllocator(n_blocks)
+        self.eos_id = eos_id
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = int(seed)
+        self.bucketer = bucketer or cb.default_bucketer()
+        self._fn_prefix = fn_prefix
+        self._instance = instance if instance is not None \
+            else cb.instance_token(self)
+        # decode slot rungs: ladder rungs <= max_slots, plus max_slots itself
+        rungs = [r for r in self.bucketer.ladder if r <= self.max_slots]
+        if not rungs or rungs[-1] < self.max_slots:
+            rungs.append(self.max_slots)
+        self.slot_rungs: tuple[int, ...] = tuple(rungs)
+        # physical pool: one [n_blocks, bl, KV, D] leaf per layer (a tuple,
+        # so each layer's page writes update one leaf in place — see
+        # PagedEncoder)
+        shape = (n_blocks, self.block_len, cfg.kv_heads, cfg.head_dim)
+        self._k_pages = tuple(jnp.zeros(shape, cfg.dtype)
+                              for _ in range(cfg.n_layers))
+        self._v_pages = tuple(jnp.zeros(shape, cfg.dtype)
+                              for _ in range(cfg.n_layers))
+        # page pools are DONATED into every prefill/decode call (each call
+        # returns the updated pools and the engine rebinds them), so a step
+        # updates pages in place instead of copying the whole pool — on the
+        # CPU backend this is the difference between winning and losing the
+        # continuous-vs-RTC A/B
+        self._donate = bool(donate_pages)
+        self._lock = threading.RLock()
+        self._waiting: deque[SequenceState] = deque()
+        self._active: list[SequenceState] = []
+        self._uid = 0
+        self._freed_since_admit = 0  # finish/preempt -> refill accounting
+        self._released = False
+        self._progress_ticks = 0  # engine-WIDE: any token emitted or
+        #                           sequence finished, by any caller
+
+    # ------------------------------------------------------------------
+    # compiled programs (CompiledCache is the only jit door)
+    # ------------------------------------------------------------------
+    def _cfg_key(self) -> tuple:
+        return (self.temperature, self.top_k, self.top_p, self.block_len)
+
+    def _selector(self):
+        """Per-row selector [S,V] logits + [S] uid + [S] step -> [S] ids —
+        the dense `_make_selector` vmapped over per-sequence fold_in keys so
+        each request's sample stream is a pure function of (seed, uid)."""
+        import jax
+
+        from .flax_nets.llama import _make_selector
+
+        base_select = _make_selector(self.temperature, self.top_k, self.top_p)
+        base_key = jax.random.PRNGKey(self.seed)
+
+        def select(logits, uids, steps):
+            def one(row, uid, step):
+                key = jax.random.fold_in(jax.random.fold_in(base_key, uid),
+                                         step)
+                return base_select(row[None], key)[0]
+            return jax.vmap(one)(logits, uids, steps)
+
+        return select
+
+    def _prefill_fn(self, B: int, P: int) -> Callable:
+        def _build():
+            import jax
+
+            from .flax_nets.llama import paged_prefill
+
+            cfg, bl = self.cfg, self.block_len
+            select = self._selector()
+
+            def fn(params, ids, mask, tables, kp, vp, uids, steps):
+                logits, kp, vp = paged_prefill(cfg, bl, params, ids, mask,
+                                               tables, kp, vp)
+                return select(logits, uids, steps), kp, vp
+
+            donate = (4, 5) if self._donate else ()
+            return jax.jit(fn, donate_argnums=donate)
+
+        return cb.get_compiled_cache().get(
+            f"{self._fn_prefix}_prefill",
+            (B, P, self.max_blocks) + self._cfg_key(), _build,
+            instance=self._instance, dtype="int32")
+
+    def _decode_fn(self, S: int) -> Callable:
+        def _build():
+            import jax
+
+            from .flax_nets.llama import paged_decode_step
+
+            cfg, bl = self.cfg, self.block_len
+            select = self._selector()
+
+            def fn(params, tokens, seq_lens, active, tables, kp, vp, uids,
+                   steps):
+                logits, kp, vp = paged_decode_step(cfg, bl, params, tokens,
+                                                   seq_lens, active, tables,
+                                                   kp, vp)
+                return select(logits, uids, steps), kp, vp
+
+            donate = (5, 6) if self._donate else ()
+            return jax.jit(fn, donate_argnums=donate)
+
+        return cb.get_compiled_cache().get(
+            f"{self._fn_prefix}_decode",
+            (S, self.max_blocks) + self._cfg_key(), _build,
+            instance=self._instance, dtype="int32")
+
+    # ------------------------------------------------------------------
+    # scheduling surface
+    # ------------------------------------------------------------------
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int,
+               request_id: str | None = None, stream: bool = False,
+               uid: int | None = None) -> SequenceState:
+        """Queue a tokenized prompt. ``uid`` seeds the sequence's sampling
+        key stream (auto-assigned when None); offline ``transform()`` passes
+        the global row offset so sampled generation is a deterministic
+        function of (seed, row), not of submission order."""
+        prompt_ids = [int(t) for t in prompt_ids]
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        if len(prompt_ids) >= self.max_len:
+            raise ValueError(f"prompt ({len(prompt_ids)} tokens) must leave "
+                             f"room to generate under max_len={self.max_len}")
+        # the engine horizon caps generation; the cap is reported as
+        # finish_reason='length' rather than rejecting the request
+        max_new = max(1, min(int(max_new_tokens),
+                             self.max_len - len(prompt_ids)))
+        with self._lock:
+            if uid is None:
+                self._uid += 1
+                uid = self._uid
+            seq = SequenceState(uid=int(uid), prompt_ids=prompt_ids,
+                                max_new_tokens=max_new,
+                                request_id=request_id, stream=stream)
+            self._waiting.append(seq)
+        return seq
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    @property
+    def waiting_count(self) -> int:
+        return len(self._waiting)
+
+    def has_work(self) -> bool:
+        return bool(self._active or self._waiting)
+
+    def _blocks_for(self, n_tokens: int) -> int:
+        return -(-max(n_tokens, 1) // self.block_len)
+
+    def _update_pool_gauges(self) -> None:
+        m = _ENGINE_METRICS.get()
+        cap = self.allocator.capacity
+        used = self.allocator.used_count
+        m["occupancy"].labels().set(used / cap if cap else 0.0)
+        live_tokens = sum(s.tokens_in_pages for s in self._active)
+        alloc_tokens = used * self.block_len
+        m["fragmentation"].labels().set(
+            (alloc_tokens - live_tokens) / alloc_tokens if alloc_tokens
+            else 0.0)
+
+    def _finish(self, seq: SequenceState, reason: str) -> None:
+        self._progress_ticks += 1
+        seq.finish_reason = reason
+        if seq.blocks:
+            self.allocator.free(seq.blocks)
+            seq.blocks = []
+        if seq in self._active:
+            self._active.remove(seq)
+            self._freed_since_admit += 1
+        _ENGINE_METRICS.get()["finished"].inc(reason=reason)
+        self._update_pool_gauges()
+
+    def _emit(self, seq: SequenceState, token: int) -> dict:
+        self._progress_ticks += 1
+        now = time.perf_counter()
+        m = _ENGINE_METRICS.get()
+        if seq.first_token_at is None:
+            seq.first_token_at = now
+            m["ttft_ms"].labels().observe((now - seq.submitted_at) * 1e3)
+        done = False
+        if self.eos_id is not None and token == self.eos_id:
+            done, reason = True, "eos"
+        elif len(seq.generated) >= seq.max_new_tokens:
+            done, reason = True, "length"
+        if done:
+            self._finish(seq, reason)
+        return {"seq": seq, "token": int(token), "done": done,
+                "finish_reason": seq.finish_reason}
+
+    def admit(self) -> list[dict]:
+        """Prefill waiting sequences into free capacity. Batches up to
+        ``prefill_batch`` sequences per program call, prompts padded to one
+        seq-ladder bucket — compile count stays <= len(seq ladder)."""
+        import jax.numpy as jnp
+
+        events: list[dict] = []
+        with self._lock:
+            while self._waiting and len(self._active) < self.max_slots:
+                group: list[SequenceState] = []
+                while (self._waiting and len(group) < self.prefill_batch
+                       and len(self._active) + len(group) < self.max_slots):
+                    seq = self._waiting[0]
+                    need = self._blocks_for(len(seq.context_ids))
+                    if need > self.allocator.capacity:
+                        # no amount of freeing can ever satisfy this
+                        # sequence — terminate it instead of wedging the
+                        # FIFO head forever
+                        self._waiting.popleft()
+                        self._finish(seq, "kv_capacity")
+                        events.append({"seq": seq, "token": None,
+                                       "done": True,
+                                       "finish_reason": "kv_capacity"})
+                        continue
+                    got = self.allocator.alloc(need)
+                    if got is None:
+                        break  # pool dry: decode must free pages first
+                    self._waiting.popleft()
+                    seq.blocks = got
+                    group.append(seq)
+                if not group:
+                    break
+                t0 = time.perf_counter()
+                B = self.prefill_batch
+                P = self.bucketer.seq_bucket_for(
+                    max(len(s.context_ids) for s in group), cap=self.max_len)
+                ids = np.zeros((B, P), np.int32)
+                mask = np.zeros((B, P), np.int32)
+                tables = np.zeros((B, self.max_blocks), np.int32)
+                uids = np.zeros((B,), np.int32)
+                steps = np.zeros((B,), np.int32)
+                for i, seq in enumerate(group):
+                    ctx = seq.context_ids
+                    ids[i, :len(ctx)] = ctx
+                    mask[i, :len(ctx)] = 1
+                    tables[i, :len(seq.blocks)] = seq.blocks
+                    uids[i] = seq.uid
+                    steps[i] = len(seq.generated)
+                fn = self._prefill_fn(B, P)
+                next_tok, self._k_pages, self._v_pages = fn(
+                    self.params, jnp.asarray(ids), jnp.asarray(mask),
+                    jnp.asarray(tables), self._k_pages, self._v_pages,
+                    jnp.asarray(uids), jnp.asarray(steps))
+                next_tok = np.asarray(next_tok)
+                m = _ENGINE_METRICS.get()
+                m["step_ms"].observe((time.perf_counter() - t0) * 1e3,
+                                     phase="prefill")
+                m["tokens"].inc(len(group), phase="prefill")
+                for i, seq in enumerate(group):
+                    seq.tokens_in_pages = len(seq.context_ids)
+                    seq.generated.append(int(next_tok[i]))
+                    self._active.append(seq)
+                    if self._freed_since_admit > 0:
+                        self._freed_since_admit -= 1
+                        m["refilled"].inc()
+                    events.append(self._emit(seq, int(next_tok[i])))
+                self._update_pool_gauges()
+        return events
+
+    def _preempt_youngest(self, keep: SequenceState) -> bool:
+        """Free the most recently admitted active sequence (other than
+        ``keep``) back to the waiting queue; its next prefill recomputes
+        prompt+generated (token-identical under greedy)."""
+        for victim in reversed(self._active):
+            if victim is keep:
+                continue
+            self._active.remove(victim)
+            self.allocator.free(victim.blocks)
+            victim.blocks = []
+            victim.tokens_in_pages = 0
+            victim.preemptions += 1
+            self._waiting.appendleft(victim)
+            self._freed_since_admit += 1
+            _ENGINE_METRICS.get()["preempted"].inc()
+            return True
+        return False
+
+    def step(self) -> list[dict]:
+        """One decode step for every active sequence (bucketed slot count);
+        returns per-sequence token events. Finished sequences free their
+        pages immediately — the next :meth:`admit` refills the capacity."""
+        import jax.numpy as jnp
+
+        events: list[dict] = []
+        with self._lock:
+            if not self._active:
+                return events
+            # grow block tables where the next token crosses a page boundary
+            for seq in list(self._active):
+                if seq.done or seq not in self._active:
+                    continue  # preempted/finished by an earlier iteration
+                pos = seq.tokens_in_pages
+                if pos // self.block_len >= len(seq.blocks):
+                    grown = self.allocator.alloc(1)
+                    while grown is None:
+                        if not self._preempt_youngest(keep=seq):
+                            # lone sequence exhausted the whole pool
+                            self._finish(seq, "kv_capacity")
+                            events.append({"seq": seq, "token": None,
+                                           "done": True,
+                                           "finish_reason": "kv_capacity"})
+                            break
+                        grown = self.allocator.alloc(1)
+                    if grown is not None:
+                        seq.blocks.extend(grown)
+            batch = list(self._active)
+            if not batch:
+                return events
+            t0 = time.perf_counter()
+            S_active = len(batch)
+            S = next(r for r in self.slot_rungs if r >= S_active)
+            tokens = np.zeros((S,), np.int32)
+            seq_lens = np.zeros((S,), np.int32)
+            active = np.zeros((S,), bool)
+            tables = np.zeros((S, self.max_blocks), np.int32)
+            uids = np.zeros((S,), np.int32)
+            steps = np.zeros((S,), np.int32)
+            for i, seq in enumerate(batch):
+                tokens[i] = seq.generated[-1]
+                seq_lens[i] = seq.tokens_in_pages
+                active[i] = True
+                tables[i, :len(seq.blocks)] = seq.blocks
+                uids[i] = seq.uid
+                steps[i] = len(seq.generated)
+            fn = self._decode_fn(S)
+            next_tok, self._k_pages, self._v_pages = fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
+                jnp.asarray(active), jnp.asarray(tables), self._k_pages,
+                self._v_pages, jnp.asarray(uids), jnp.asarray(steps))
+            next_tok = np.asarray(next_tok)
+            m = _ENGINE_METRICS.get()
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            m["step_ms"].observe(dt_ms, phase="decode")
+            m["token_ms"].labels().observe(dt_ms / max(S_active, 1))
+            m["tokens"].inc(S_active, phase="decode")
+            for i, seq in enumerate(batch):
+                seq.tokens_in_pages += 1
+                seq.generated.append(int(next_tok[i]))
+                events.append(self._emit(seq, int(next_tok[i])))
+            self._update_pool_gauges()
+        return events
+
+    # ------------------------------------------------------------------
+    # offline driver + warmup
+    # ------------------------------------------------------------------
+    def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens,
+                 uids: Sequence[int] | None = None) -> list[list[int]]:
+        """Run a list of tokenized prompts to completion through the
+        continuous scheduler; returns generated ids per prompt (EOS kept as
+        the final token when hit). ``max_new_tokens`` is an int or a
+        per-prompt sequence — the offline ``transform()`` surface of the
+        SAME engine serving uses online."""
+        if isinstance(max_new_tokens, (int, np.integer)):
+            max_new_tokens = [int(max_new_tokens)] * len(prompts)
+        seqs = [self.submit(p, n, uid=None if uids is None else int(u))
+                for p, n, u in zip(prompts, max_new_tokens,
+                                   uids if uids is not None
+                                   else range(len(prompts)))]
+        # progress = the ENGINE's tick counter, not our own calls returning
+        # events: when a live serve loop drives the same shared engine
+        # concurrently, ITS admit/step may do the work (and may hold every
+        # slot for many seconds) — only a wholly-stalled engine raises
+        last, idle = -1, 0
+        while any(not s.done for s in seqs):
+            self.admit()
+            self.step()
+            now = self._progress_ticks
+            if now == last:
+                idle += 1
+                if idle > 2000:
+                    stuck = [s.uid for s in seqs if not s.done]
+                    raise RuntimeError(
+                        f"paged engine stalled with sequences {stuck} "
+                        f"unfinished (pool too small for a single "
+                        f"sequence?)")
+                if idle > 10:
+                    time.sleep(0.001)  # another thread holds the work
+            else:
+                last, idle = now, 0
+        return [list(s.generated) for s in seqs]
+
+    def warmup(self, prompt_lens: Sequence[int] | None = None,
+               slot_counts: Sequence[int] | None = None) -> int:
+        """Precompile the prefill rungs (seq ladder up to ``max_len``) and
+        decode rungs (slot ladder) WITHOUT touching live state: warmup
+        programs run over all-trash block tables, so every write lands on
+        the reserved page and the returned pools are discarded. Called from
+        ``/admin/load`` so a hot-swapped LLM serves its first real request
+        with zero compile stalls. Returns the number of programs exercised."""
+        import jax.numpy as jnp
+
+        if prompt_lens is None:
+            prompt_lens = self.bucketer.seq_buckets_upto(self.max_len)
+        if slot_counts is None:
+            slot_counts = self.slot_rungs
+        n = 0
+        B = self.prefill_batch
+        with self._lock:
+            for P in sorted({self.bucketer.seq_bucket_for(int(p),
+                                                          cap=self.max_len)
+                             for p in prompt_lens}):
+                fn = self._prefill_fn(B, P)
+                ids = jnp.zeros((B, P), jnp.int32)
+                mask = jnp.zeros((B, P), jnp.int32).at[:, 0].set(1)
+                tables = jnp.zeros((B, self.max_blocks), jnp.int32)
+                zi = jnp.zeros((B,), jnp.int32)
+                # all writes land on the trash page, so reassigning the
+                # returned pools is a no-op for live pages — and REQUIRED
+                # under buffer donation (the input buffers are consumed)
+                _, self._k_pages, self._v_pages = fn(
+                    self.params, ids, mask, tables, self._k_pages,
+                    self._v_pages, zi, zi)
+                n += 1
+            for S in sorted({int(s) for s in slot_counts}):
+                fn = self._decode_fn(S)
+                zs = jnp.zeros((S,), jnp.int32)
+                tables = jnp.zeros((S, self.max_blocks), jnp.int32)
+                _, self._k_pages, self._v_pages = fn(
+                    self.params, zs, zs, jnp.zeros((S,), bool), tables,
+                    self._k_pages, self._v_pages, zs, zs)
+                n += 1
+        return n
+
+    def abort(self, seq: SequenceState) -> None:
+        """Terminate one sequence (client gone / stream broken), freeing its
+        pages and slot immediately so dead connections cannot pin decode
+        capacity."""
+        with self._lock:
+            if not seq.done:
+                if seq in self._waiting:
+                    self._waiting.remove(seq)
+                self._finish(seq, "aborted")
+
+    def abort_all(self) -> list[SequenceState]:
+        """Terminate every waiting and active sequence (reason
+        ``'aborted'``), freeing all pages — the hot-swap path drains the
+        outgoing engine through this so no request stalls silently."""
+        with self._lock:
+            doomed = list(self._active) + list(self._waiting)
+            self._waiting.clear()
+            for seq in doomed:
+                if not seq.done:
+                    self._finish(seq, "aborted")
+            return doomed
+
+    def stats(self) -> dict:
+        with self._lock:
+            cap = self.allocator.capacity
+            return {"active": len(self._active),
+                    "waiting": len(self._waiting),
+                    "blocks_used": self.allocator.used_count,
+                    "blocks_free": self.allocator.free_count,
+                    "occupancy": self.allocator.used_count / cap if cap
+                    else 0.0}
+
+    def release(self) -> None:
+        """Evict this engine's compiled programs from the shared cache and
+        mark the engine dead — a failed device call may have consumed the
+        donated page buffers, so a released engine must never be reused
+        (``HuggingFaceCausalLM._paged_engine`` rebuilds instead of
+        returning it from its cache)."""
+        self._released = True
+        cb.get_compiled_cache().evict_instance(self._instance)

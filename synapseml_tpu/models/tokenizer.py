@@ -71,6 +71,11 @@ class _HFTokenizerAdapter:
         return {"input_ids": enc["input_ids"].astype(np.int32),
                 "attention_mask": enc["attention_mask"].astype(np.int32)}
 
+    def decode(self, token_ids) -> str:
+        """Detokenize (the HF tokenizer can; the hashing one cannot) — the
+        causal-LM transform and token-streaming serving probe for this."""
+        return self._tok.decode(list(token_ids), skip_special_tokens=True)
+
     def to_config(self) -> dict:
         return {"kind": "huggingface", "name": self.name}
 

@@ -62,6 +62,32 @@ def test_bucketer_ladder_and_bucket_for():
     assert b.buckets_upto(48) == [8, 16, 32]
 
 
+def test_bucketer_seq_ladder():
+    """The sequence/page dimension buckets like the batch dimension: the
+    token-serving plane keys prefill executables off seq_bucket_for and
+    decode executables off bucket_for, so both stay ladder-bounded."""
+    b = cb.ShapeBucketer(min_bucket=8, max_bucket=64,
+                         min_seq_bucket=16, max_seq_bucket=128)
+    assert b.seq_ladder == (16, 32, 64, 128)
+    assert b.seq_bucket_for(1) == 16
+    assert b.seq_bucket_for(17) == 32
+    assert b.seq_bucket_for(128) == 128
+    # multiple_of: prompt buckets tile whole KV pages
+    assert b.seq_bucket_for(17, multiple_of=24) == 48
+    # cap clamps at a model horizon instead of padding past it
+    assert b.seq_bucket_for(100, cap=120) == 120
+    assert b.seq_bucket_for(100, cap=128) == 128
+    with pytest.raises(ValueError):
+        b.seq_bucket_for(130, cap=128)
+    # warmup/compile-bound set: every rung plus the cap bucket
+    assert b.seq_buckets_upto(128) == [16, 32, 64, 128]
+    assert b.seq_buckets_upto(100) == [16, 32, 64, 100]
+    # explicit seq ladder + validation
+    assert cb.ShapeBucketer(seq_ladder=[8, 80]).seq_ladder == (8, 80)
+    with pytest.raises(ValueError):
+        cb.ShapeBucketer(seq_ladder=[0, 8])
+
+
 def test_bucketer_slices_cover_and_bound():
     b = cb.ShapeBucketer(min_bucket=8, max_bucket=64)
     for n in (1, 7, 8, 9, 33, 64, 65, 200):

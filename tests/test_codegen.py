@@ -115,11 +115,19 @@ def test_no_inline_jit_in_stage_transform():
     is invisible to the hit/miss/trace-time metrics, and dodges the
     ``/admin/load`` warmup precompile. (``gbdt/booster.py`` training jits
     are estimator-time — one trace per fit — and stay out of scope; its
-    predict path is behavior-tested in test_batching.py.)"""
+    predict path is behavior-tested in test_batching.py.) The token-serving
+    plane is held to the same rule: the paged prefill/decode programs
+    (``models/paged_engine.py``, model code in ``models/flax_nets/llama.py``)
+    and the ``io/serving.py`` token scheduler acquire jits only through the
+    cache — that is what makes the decode-executable count bounded by the
+    slot ladder and the ``/admin/load`` warmup able to precompile every
+    rung."""
     import ast
 
     modules = ["onnx/model.py", "hf/embedder.py", "hf/causal_lm.py",
-               "models/text.py", "models/vision.py", "nn/knn.py"]
+               "models/text.py", "models/vision.py", "nn/knn.py",
+               "models/paged_engine.py", "models/flax_nets/llama.py",
+               "io/serving.py"]
     pkg = pathlib.Path(st.__file__).parent
     offenders = []
     for rel in modules:
