@@ -83,6 +83,10 @@ CONFIGS = [
     # dataset (rows/sec + prefetch occupancy + stall fraction); host-driven,
     # fine on the CPU fallback
     ("data-pipeline", "data_pipeline", 240, 240),
+    # HPO sweep A/B: serial thread-pool TuneHyperparameters vs ONE fused
+    # training array over the same 8-config space, both arms in-round from
+    # cold compile caches (the N-compiles-vs-one asymmetry IS the metric)
+    ("hpo-fused", "hpo_fused", 300, 300),
     ("flagship", None, 420, 360),
     ("vit", "vit_finetune", 450, 300),
 ]

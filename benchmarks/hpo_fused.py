@@ -1,0 +1,153 @@
+"""HPO fused-array A/B: serial thread-pool sweep vs ONE fused training array.
+
+The same N-config LightGBM sweep runs twice in the SAME round (the
+serving-microbatch / data-pipeline discipline — both arms share the process,
+the dataset, and the round's thermal/load conditions):
+
+  (a) serial — ``TuneHyperparameters(fuse_trials=False)``: the reference
+      port's thread pool, one fit per config, each distinct config compiling
+      its own level-step ladder while the device serializes the dispatches;
+  (b) fused  — ``TuneHyperparameters(fuse_trials=True)``: all N configs
+      train inside one jitted boosting iteration (per-trial scalars as
+      traced inputs), acquired ONCE through the shared ``CompiledCache``.
+
+Compile cost is part of the measurement ON PURPOSE: paying one trace
+instead of N is the fused array's claim (HFTA arXiv:2102.02344 + the TVM
+amortization lesson), so each arm starts from cold compile caches.
+
+Emits sweep wall-clock, trials/sec, executables compiled, and
+best-metric/per-config parity per arm. Acceptance (ISSUE 7): fused >= 2x
+serial trials/sec at N >= 8 fusable configs, fused executable count <= the
+trial-count ladder size, per-config metrics equal within f32 tolerance.
+Prints one JSON line.
+"""
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+N_ROWS = 4000
+N_FEATURES = 12
+NUM_ITERATIONS = 20
+SEED = 11
+
+
+def _dataset():
+    from synapseml_tpu.core import DataFrame
+
+    rs = np.random.default_rng(SEED)
+    X = rs.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    logit = X[:, 0] + 0.6 * X[:, 1] - 0.8 * X[:, 2] * X[:, 3] + 0.3 * X[:, 4]
+    y = (logit + 0.5 * rs.normal(size=N_ROWS) > 0).astype(np.int64)
+    return DataFrame.from_dict({"features": list(X), "label": y})
+
+
+def _space():
+    """8 fusable configs: scalar knobs only, one fused signature."""
+    from synapseml_tpu.automl import DiscreteHyperParam, HyperparamBuilder
+
+    return (HyperparamBuilder()
+            .add_hyperparam("learning_rate",
+                            DiscreteHyperParam([0.03, 0.06, 0.1, 0.2]))
+            .add_hyperparam("lambda_l2", DiscreteHyperParam([0.0, 0.5]))
+            .build())
+
+
+def _run_arm(df, fuse: bool) -> dict:
+    from synapseml_tpu.automl import TuneHyperparameters
+    from synapseml_tpu.core.batching import (get_compiled_cache,
+                                             reset_compiled_cache)
+    from synapseml_tpu.gbdt import LightGBMClassifier
+    from synapseml_tpu.gbdt import trees as T
+
+    # cold compile caches: each arm pays its own traces (that asymmetry IS
+    # the measurement — see the module docstring)
+    reset_compiled_cache()
+    T._level_steps.cache_clear()
+    fused_misses0 = get_compiled_cache().miss_count("gbdt_fused_iter")
+
+    tuner = TuneHyperparameters(
+        models=[LightGBMClassifier(num_iterations=NUM_ITERATIONS,
+                                   num_leaves=15)],
+        hyperparam_space=_space(), search_mode="grid",
+        evaluation_metric="accuracy", seed=SEED, fuse_trials=fuse,
+        parallelism=4)
+    t0 = time.perf_counter()
+    best = tuner.fit(df)
+    wall = time.perf_counter() - t0
+
+    results = best.get("all_results")
+    n_trials = len(results)
+    ladders = T._level_steps.cache_info().misses
+    # serial executables: one level ladder (max_depth + final level jits)
+    # per distinct GrowthConfig; fused: CompiledCache misses on the one
+    # fused-iteration fn_id
+    fused_execs = int(get_compiled_cache().miss_count("gbdt_fused_iter")
+                      - fused_misses0)
+    return {
+        "mode": "fused" if fuse else "serial",
+        "wall_s": round(wall, 3),
+        "n_trials": n_trials,
+        "trials_per_sec": round(n_trials / wall, 4),
+        "best_params": best.get("best_params"),
+        "best_metric": best.get("best_metric"),
+        "metrics_by_config": {
+            json.dumps(cfg, sort_keys=True): v for _n, cfg, v in results},
+        "serial_config_ladders_compiled": ladders,
+        "fused_executables_compiled": fused_execs,
+    }
+
+
+def run(jax, platform, n_chips):
+    from synapseml_tpu.core.batching import TRIAL_LADDER
+
+    df = _dataset()
+    jax.block_until_ready(jax.numpy.zeros(8))  # backend up before timing
+    serial = _run_arm(df, fuse=False)
+    fused = _run_arm(df, fuse=True)
+
+    speedup = (fused["trials_per_sec"] / serial["trials_per_sec"]
+               if serial["trials_per_sec"] else None)
+    deltas = [abs(fused["metrics_by_config"][k] -
+                  serial["metrics_by_config"][k])
+              for k in fused["metrics_by_config"]]
+    for arm in (serial, fused):
+        del arm["metrics_by_config"]  # folded into the parity summary
+    return {
+        "metric": "hpo fused-array sweep speedup (trials/sec vs serial "
+                  "thread-pool, same round)",
+        "value": round(speedup, 3) if speedup else None,
+        "unit": "x", "lower_is_better": False,
+        "platform": platform, "n_chips": n_chips,
+        "n_configs": fused["n_trials"],
+        "fused": fused,
+        "serial_baseline": serial,
+        "parity": {
+            "best_params_equal": fused["best_params"] ==
+            serial["best_params"],
+            "best_metric_delta": abs(fused["best_metric"] -
+                                     serial["best_metric"]),
+            "max_per_config_metric_delta": max(deltas) if deltas else None,
+        },
+        "compile_bound": {
+            "fused_executables": fused["fused_executables_compiled"],
+            "trial_ladder_size": len(TRIAL_LADDER),
+            "serial_config_ladders": serial["serial_config_ladders_compiled"],
+        },
+    }
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    print(json.dumps(run(jax, platform, n_chips)))
+
+
+if __name__ == "__main__":
+    main()

@@ -8,5 +8,6 @@ from .hyperparams import (  # noqa: F401
     HyperparamBuilder,
     RandomSpace,
     RangeHyperParam,
+    fusable_param_names,
 )
 from .tune import BestModel, FindBestModel, FindBestModelResult, TuneHyperparameters  # noqa: F401

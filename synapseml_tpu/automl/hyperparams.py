@@ -6,7 +6,37 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["DiscreteHyperParam", "RangeHyperParam", "HyperparamBuilder",
-           "GridSpace", "RandomSpace", "DefaultHyperparams"]
+           "GridSpace", "RandomSpace", "DefaultHyperparams",
+           "fusable_param_names"]
+
+
+def _learner_name(learner) -> str:
+    """Accepts a name, an estimator class, or an instance — the same three
+    forms every learner-keyed helper here takes."""
+    if isinstance(learner, str):
+        return learner
+    return learner.__name__ if isinstance(learner, type) \
+        else type(learner).__name__
+
+
+def fusable_param_names(learner) -> tuple[str, ...]:
+    """Sweep dimensions that can ride a horizontally fused training array
+    for this learner: the scalar, architecture-preserving knobs declared by
+    the estimator's ``_FUSED_SCALAR_PARAMS`` contract (see docs/AUTOML.md).
+    A space restricted to these keys partitions into one fused group per
+    candidate estimator (``num_leaves`` may still split groups by the tree
+    depth it derives when ``max_depth`` is unset). Returns ``()`` for
+    learners without a fused path."""
+    if isinstance(learner, str):
+        from .. import gbdt
+
+        cls = getattr(gbdt, learner, None)
+        if cls is None:
+            return ()
+    else:
+        cls = learner if isinstance(learner, type) else type(learner)
+    scalars = getattr(cls, "_FUSED_SCALAR_PARAMS", None)
+    return tuple(sorted(scalars)) if scalars else ()
 
 
 class DiscreteHyperParam:
@@ -87,7 +117,7 @@ class DefaultHyperparams:
 
     @staticmethod
     def default_range(learner) -> dict:
-        name = learner if isinstance(learner, str) else type(learner).__name__
+        name = _learner_name(learner)
         spaces = {
             "LightGBMClassifier": {
                 "num_leaves": RangeHyperParam(8, 63),
@@ -117,3 +147,16 @@ class DefaultHyperparams:
             raise ValueError(f"no default hyperparameter range for {name}; "
                              f"have {sorted(spaces)}")
         return spaces[name]
+
+    @staticmethod
+    def fused_range(learner) -> dict:
+        """The :meth:`default_range` restricted to dimensions that fuse into
+        one training array (:func:`fusable_param_names`) — the sweep space
+        to pick when you want ``TuneHyperparameters`` to train every config
+        in one jitted step instead of a thread pool of serial fits."""
+        fusable = set(fusable_param_names(learner))
+        if not fusable:
+            raise ValueError(f"{_learner_name(learner)} has no fused training "
+                             "path; use default_range and the serial sweep")
+        full = DefaultHyperparams.default_range(learner)
+        return {k: v for k, v in full.items() if k in fusable}

@@ -1,17 +1,28 @@
 """TuneHyperparameters / FindBestModel
 (reference ``automl/TuneHyperparameters.scala:38``, ``FindBestModel.scala:53``).
 
-Parallelism note: candidate fits run on a thread pool — each fit dispatches its
-own XLA programs, and the TPU runtime serializes device work while the host
-side (binning, featurization, data prep) overlaps, mirroring the reference's
-parallel fits across a Spark cluster."""
+Parallelism: candidates are first partitioned into **fusable groups** —
+same estimator class, architecture-identical configs (equal fused
+signatures, see ``_fused_plan`` on the estimator) — and each group trains
+inside ONE horizontally fused training array (HFTA, arXiv:2102.02344): one
+jitted step / boosting iteration drives every trial in the group, data is
+loaded and device-put once, and N configs share one compiled executable
+through the process-wide ``CompiledCache`` instead of N thread-pool fits
+serializing N dispatch streams (and N compiles) on the device. Candidates
+without a fused path — different architectures, bagging/DART, categorical
+splits, non-GBDT learners — fall back to the reference-style thread pool,
+where host-side prep overlaps while the device serializes fits.
+"""
 
 from __future__ import annotations
 
+import logging
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..core import observability as obs
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Estimator, Model
@@ -19,14 +30,47 @@ from ..train.statistics import ComputeModelStatistics
 
 __all__ = ["TuneHyperparameters", "BestModel", "FindBestModel", "FindBestModelResult"]
 
+_log = logging.getLogger("synapseml_tpu")
+
 _METRIC_DIRECTION = {"accuracy": 1, "precision": 1, "recall": 1, "AUC": 1, "R^2": 1,
                      "mean_squared_error": -1, "root_mean_squared_error": -1,
                      "mean_absolute_error": -1}
 
+_SWEEP_METRICS = obs.HandleCache(lambda reg: {
+    "trials": reg.counter(
+        "synapseml_hpo_trials_total",
+        "hyperparameter-sweep candidate fits", ("stage", "mode")),
+    "sweep_trials_per_sec": reg.gauge(
+        "synapseml_hpo_sweep_trials_per_sec",
+        "end-to-end candidate fits per second of the last sweep", ("stage",)),
+    "fused_groups": reg.counter(
+        "synapseml_hpo_fused_groups_total",
+        "fusable candidate groups trained as one fused array", ("stage",)),
+    "fused_fallbacks": reg.counter(
+        "synapseml_hpo_fused_fallbacks_total",
+        "fused groups demoted to the serial path by a group-level failure",
+        ("stage",)),
+})
+
 
 def _evaluate(model, df: DataFrame, metric: str, label_col: str) -> float:
     scored = model.transform(df)
-    pred_col = "prediction" if "prediction" in scored.columns else scored.columns[-1]
+    pred_col = None
+    has_param = getattr(model, "has_param", None)
+    if callable(has_param) and has_param("prediction_col"):
+        declared = model.get("prediction_col")
+        if declared in scored.columns:
+            pred_col = declared
+    if pred_col is None and "prediction" in scored.columns:
+        pred_col = "prediction"
+    if pred_col is None:
+        # never silently grab an arbitrary column — a wrong pick scores the
+        # sweep on garbage and crowns a random winner
+        raise ValueError(
+            f"cannot locate the prediction column on {type(model).__name__}'s "
+            f"scored output: no declared prediction_col or 'prediction' among "
+            f"columns {list(scored.columns)}; set the model's prediction_col "
+            "to its score column")
     kind = ("regression" if metric in ("mean_squared_error", "root_mean_squared_error",
                                        "mean_absolute_error", "R^2") else "classification")
     stats = ComputeModelStatistics(
@@ -41,12 +85,140 @@ def _evaluate(model, df: DataFrame, metric: str, label_col: str) -> float:
     return float(stats.collect_column(metric)[0])
 
 
+def _merged_cfg(est, cfg: dict) -> dict:
+    """The candidate's COMPLETE training config as an override dict: the
+    estimator's set values + the sweep overrides, with the estimator's
+    fusable scalar values pinned explicitly — so a fused group's base
+    estimator can reproduce any member via ``base.copy(merged)`` even when
+    members are distinct instances with different set values."""
+    merged = dict(est._param_values)
+    scalars = getattr(type(est), "_FUSED_SCALAR_PARAMS", None)
+    if scalars:
+        has_param = getattr(est, "has_param", lambda _n: False)
+        for name in scalars:
+            if has_param(name):
+                merged.setdefault(name, est.get(name))
+    merged.update(cfg)
+    return merged
+
+
+def _fusable_groups(candidates: list[tuple], enabled: bool = True
+                    ) -> tuple[list[tuple], list[tuple]]:
+    """Partition ``(idx, name, est, user_cfg, merged_cfg)`` candidates.
+
+    Returns ``(groups, singles)``: each group is ``(base_est, members)``
+    where every member shares the base's fused signature under its MERGED
+    config (estimator-set values + sweep overrides), so the group differs
+    only in traced scalar hyperparameters and trains as one fused array.
+    Signature-less candidates (no ``_fused_plan``, architecture-changing
+    overrides, unsupported modes) and singleton groups go to ``singles`` —
+    the serial thread-pool path."""
+    groups_map: dict = {}
+    singles: list[tuple] = []
+    if not enabled:
+        return [], list(candidates)
+    for cand in candidates:
+        _idx, _name, est, _cfg, merged = cand
+        plan = getattr(est, "_fused_plan", None)
+        sig = None
+        # fitted Transformers (FindBestModel candidates) inherit _fused_plan
+        # from their params mixin but have nothing to train — singles, not a
+        # doomed fused group that would count as a spurious fallback
+        if isinstance(est, Estimator) and callable(plan):
+            try:
+                sig = plan(merged)
+            except Exception:  # a broken plan must not sink the sweep
+                sig = None
+        if sig is None:
+            singles.append(cand)
+        else:
+            groups_map.setdefault(sig, []).append(cand)
+    groups = []
+    for members in groups_map.values():
+        if len(members) >= 2:
+            groups.append((members[0][2], members))
+        else:
+            singles.extend(members)
+    return groups, singles
+
+
+def _run_sweep(stage: str, candidates: list[tuple], fit_serial, fit_fused,
+               evaluate, fuse: bool, parallelism: int) -> list[tuple]:
+    """Shared sweep engine for TuneHyperparameters and FindBestModel.
+
+    ``candidates``: (idx, name, est, user_cfg, merged_cfg) tuples.
+    ``fit_serial(cand) -> model`` and ``fit_fused(base_est, merged_cfgs) ->
+    models`` may raise per candidate/group; ``evaluate(model) -> float`` may
+    raise per model. Returns results aligned with ``candidates``:
+    ``(name, user_cfg_with_error, model_or_None, metric)`` — a bad candidate
+    records ``__error__`` + NaN instead of sinking the sweep."""
+    m = _SWEEP_METRICS.get()
+    t0 = time.perf_counter()
+    results: dict[int, tuple] = {}
+    groups, singles = _fusable_groups(candidates, enabled=fuse)
+
+    def record(cand, model, metric, error=None):
+        idx, name, _est, cfg, _merged = cand
+        if error is not None:
+            cfg = dict(cfg, __error__=error)
+        results[idx] = (name, cfg, model, metric)
+
+    def eval_contained(cand, model, mode):
+        try:
+            metric = evaluate(model)
+        except Exception as e:  # noqa: BLE001 — containment by contract
+            record(cand, None, float("nan"), f"{type(e).__name__}: {e}")
+        else:
+            record(cand, model, metric)
+        m["trials"].inc(stage=stage, mode=mode)
+
+    def run_single(cand):
+        try:
+            model = fit_serial(cand)
+        except Exception as e:  # noqa: BLE001 — a bad config must not sink
+            record(cand, None, float("nan"), f"{type(e).__name__}: {e}")
+            m["trials"].inc(stage=stage, mode="serial")
+            return
+        eval_contained(cand, model, mode="serial")
+
+    with ThreadPoolExecutor(max_workers=max(parallelism, 1)) as pool:
+        # singles go to the pool FIRST so their host-side prep overlaps the
+        # device-bound fused-group training on this thread; fused members'
+        # (host-heavy) evaluation and any demoted group join the same pool
+        done = [pool.submit(run_single, cand) for cand in singles]
+        for base_est, members in groups:
+            try:
+                models = fit_fused(base_est, [c[4] for c in members])
+            except Exception as e:  # noqa: BLE001 — group demotes to serial
+                # the sweep survives on the thread pool, but a silent demotion
+                # would hide a fused-path regression behind an N-fold slowdown
+                _log.warning(
+                    "%s: fused group of %d %s candidates demoted to the "
+                    "serial path: %s: %s", stage, len(members),
+                    type(base_est).__name__, type(e).__name__, e)
+                m["fused_fallbacks"].inc(stage=stage)
+                done += [pool.submit(run_single, c) for c in members]
+                continue
+            m["fused_groups"].inc(stage=stage)
+            done += [pool.submit(eval_contained, cand, model, "fused")
+                     for cand, model in zip(members, models)]
+        for f in done:
+            f.result()
+
+    wall = max(time.perf_counter() - t0, 1e-9)
+    m["sweep_trials_per_sec"].set(len(candidates) / wall, stage=stage)
+    return [results[c[0]] for c in candidates]
+
+
 class BestModel(Model):
     best_model = ComplexParam("best_model", "winning fitted model")
     best_params = ComplexParam("best_params", "winning hyperparameter dict")
     best_metric = Param("best_metric", "winning validation metric value",
                         converter=TypeConverters.to_float)
-    all_results = ComplexParam("all_results", "list of (params, metric) tuples")
+    all_results = ComplexParam(
+        "all_results", "list of (estimator_name, params, metric) tuples — "
+        "estimator_name is 'ClassName[i]' for candidate i of the models "
+        "list, so multi-estimator sweeps keep model identity")
 
     def _transform(self, df: DataFrame) -> DataFrame:
         return self.get("best_model").transform(df)
@@ -54,7 +226,9 @@ class BestModel(Model):
 
 class TuneHyperparameters(Estimator):
     """Random/grid search over (possibly several) learners
-    (ref ``TuneHyperparameters.scala:38``)."""
+    (ref ``TuneHyperparameters.scala:38``). Architecture-identical configs
+    of the same learner train as ONE horizontally fused array (see the
+    module docstring); the rest ride the thread pool."""
 
     feature_name = "automl"
 
@@ -65,8 +239,12 @@ class TuneHyperparameters(Estimator):
                         validator=lambda v: v in ("random", "grid"))
     num_runs = Param("num_runs", "samples for random search", default=8,
                      converter=TypeConverters.to_int)
-    parallelism = Param("parallelism", "concurrent fits", default=4,
+    parallelism = Param("parallelism", "concurrent serial-path fits", default=4,
                         converter=TypeConverters.to_int)
+    fuse_trials = Param("fuse_trials", "train architecture-identical configs "
+                        "as one fused training array (serial fallback on "
+                        "group failure); False forces the thread pool",
+                        default=True, converter=TypeConverters.to_bool)
     evaluation_metric = Param("evaluation_metric", "metric name", default="accuracy")
     label_col = Param("label_col", "label column", default="label")
     validation_fraction = Param("validation_fraction", "holdout fraction", default=0.25,
@@ -88,33 +266,35 @@ class TuneHyperparameters(Estimator):
         metric = self.get("evaluation_metric")
         direction = _METRIC_DIRECTION.get(metric, 1)
 
-        candidates: list[tuple[Estimator, dict]] = []
+        candidates: list[tuple] = []
         for mi, (m, space) in enumerate(zip(models, spaces)):
             if self.get("search_mode") == "grid":
                 configs = GridSpace(space).configs()
             else:
                 configs = RandomSpace(space, seed=self.get("seed") + mi).configs(
                     self.get("num_runs"))
-            candidates.extend((m, c) for c in configs)
+            name = f"{type(m).__name__}[{mi}]"
+            for c in configs:
+                candidates.append((len(candidates), name, m, dict(c),
+                                   _merged_cfg(m, c)))
 
-        def run(pair):
-            est, cfg = pair
-            try:
-                model = est.copy(cfg).fit(train)
-                return model, cfg, _evaluate(model, valid, metric, self.get("label_col"))
-            except Exception as e:  # a bad config must not sink the sweep
-                return None, dict(cfg, __error__=f"{type(e).__name__}: {e}"), float("nan")
+        results = _run_sweep(
+            "TuneHyperparameters", candidates,
+            fit_serial=lambda cand: cand[2].copy(cand[3]).fit(train),
+            fit_fused=lambda base, cfgs: base._fit_fused(train, cfgs),
+            evaluate=lambda model: _evaluate(model, valid, metric,
+                                             self.get("label_col")),
+            fuse=self.get("fuse_trials"), parallelism=self.get("parallelism"))
 
-        with ThreadPoolExecutor(max_workers=self.get("parallelism")) as pool:
-            results = list(pool.map(run, candidates))
-        scored = [(m, c, v) for m, c, v in results if m is not None and np.isfinite(v)]
+        scored = [(nm, c, mdl, v) for nm, c, mdl, v in results
+                  if mdl is not None and np.isfinite(v)]
         if not scored:
-            errors = {c["__error__"] for _, c, _ in results if "__error__" in c}
+            errors = {c["__error__"] for _, c, _, _ in results if "__error__" in c}
             raise RuntimeError("TuneHyperparameters: every candidate failed; "
                                f"causes: {sorted(errors)}")
-        best = max(scored, key=lambda t: direction * t[2])
-        return BestModel(best_model=best[0], best_params=best[1], best_metric=best[2],
-                         all_results=[(c, v) for _, c, v in results])
+        best = max(scored, key=lambda t: direction * t[3])
+        return BestModel(best_model=best[2], best_params=best[1], best_metric=best[3],
+                         all_results=[(nm, c, v) for nm, c, _, v in results])
 
 
 class FindBestModelResult(Model):
@@ -129,22 +309,56 @@ class FindBestModelResult(Model):
 class FindBestModel(Estimator):
     """Pick the best among already-specified models by eval metric
     (ref ``FindBestModel.scala:53``). Models may be fitted Transformers
-    (evaluated directly) or Estimators (fitted first)."""
+    (evaluated directly) or Estimators (fitted first). Estimator candidates
+    ride the same fusable-group partitioning TuneHyperparameters uses —
+    same-class, architecture-identical candidates train as one fused array,
+    the rest fit on a thread pool — and a failing candidate records NaN
+    instead of sinking the comparison."""
 
     feature_name = "automl"
 
     models = ComplexParam("models", "candidate models")
     evaluation_metric = Param("evaluation_metric", "metric name", default="accuracy")
     label_col = Param("label_col", "label column", default="label")
+    parallelism = Param("parallelism", "concurrent serial-path fits", default=4,
+                        converter=TypeConverters.to_int)
+    fuse_trials = Param("fuse_trials", "train architecture-identical "
+                        "estimator candidates as one fused training array",
+                        default=True, converter=TypeConverters.to_bool)
 
     def _fit(self, df: DataFrame) -> FindBestModelResult:
         metric = self.get("evaluation_metric")
         direction = _METRIC_DIRECTION.get(metric, 1)
-        results = []
-        for m in self.get("models"):
-            fitted = m.fit(df) if isinstance(m, Estimator) else m
-            results.append((fitted, _evaluate(fitted, df, metric, self.get("label_col"))))
-        best = max(results, key=lambda t: direction * t[1])
+        candidates = []
+        for i, m in enumerate(self.get("models")):
+            merged = _merged_cfg(m, {}) if isinstance(m, Estimator) else {}
+            candidates.append((i, f"{type(m).__name__}[{i}]", m, {}, merged))
+
+        def fit_serial(cand):
+            m = cand[2]
+            return m.fit(df) if isinstance(m, Estimator) else m
+
+        def fit_fused(base, merged_cfgs):
+            if not isinstance(base, Estimator):
+                raise TypeError("fitted models have no fused path")
+            return base._fit_fused(df, merged_cfgs)
+
+        results = _run_sweep(
+            "FindBestModel", candidates, fit_serial=fit_serial,
+            fit_fused=fit_fused,
+            evaluate=lambda model: _evaluate(model, df, metric,
+                                             self.get("label_col")),
+            fuse=self.get("fuse_trials"), parallelism=self.get("parallelism"))
+
+        scored = [(nm, mdl, v) for nm, _c, mdl, v in results
+                  if mdl is not None and np.isfinite(v)]
+        if not scored:
+            errors = {c["__error__"] for _, c, _, _ in results if "__error__" in c}
+            raise RuntimeError("FindBestModel: every candidate failed; "
+                               f"causes: {sorted(errors)}")
+        best = max(scored, key=lambda t: direction * t[2])
+        # 'ClassName[i]' uniformly (success or failure) — the fitted model's
+        # class name would collapse duplicate-class candidates into one label
         return FindBestModelResult(
-            best_model=best[0], best_metric=best[1],
-            all_model_metrics=[(type(m).__name__, v) for m, v in results])
+            best_model=best[1], best_metric=best[2],
+            all_model_metrics=[(nm, v) for nm, _c, _mdl, v in results])

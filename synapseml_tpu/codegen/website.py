@@ -172,7 +172,7 @@ def emit_site(docs_dir: str | None = None, out_dir: str | None = None) -> list[s
     out_dir = out_dir or os.path.join(docs_dir, "site")
     os.makedirs(out_dir, exist_ok=True)
 
-    sections = {"": ["GETTING_STARTED.md", "ARCHITECTURE.md",
+    sections = {"": ["GETTING_STARTED.md", "ARCHITECTURE.md", "AUTOML.md",
                      "BENCHMARKS.md", "DATA.md", "OBSERVABILITY.md",
                      "REGISTRY.md", "RESILIENCE.md", "SERVING.md"],
                 "api": sorted(f for f in os.listdir(os.path.join(docs_dir, "api"))

@@ -49,6 +49,12 @@ class FindBestModel(WrapperBase):
     def getEvaluationMetric(self):
         return self._get('evaluation_metric')
 
+    def setFuseTrials(self, value):
+        return self._set('fuse_trials', value)
+
+    def getFuseTrials(self):
+        return self._get('fuse_trials')
+
     def setLabelCol(self, value):
         return self._set('label_col', value)
 
@@ -60,6 +66,12 @@ class FindBestModel(WrapperBase):
 
     def getModels(self):
         return self._get('models')
+
+    def setParallelism(self, value):
+        return self._set('parallelism', value)
+
+    def getParallelism(self):
+        return self._get('parallelism')
 
 
 class FindBestModelResult(WrapperBase):
@@ -96,6 +108,12 @@ class TuneHyperparameters(WrapperBase):
 
     def getEvaluationMetric(self):
         return self._get('evaluation_metric')
+
+    def setFuseTrials(self, value):
+        return self._set('fuse_trials', value)
+
+    def getFuseTrials(self):
+        return self._get('fuse_trials')
 
     def setHyperparamSpace(self, value):
         return self._set('hyperparam_space', value)

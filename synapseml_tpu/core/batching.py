@@ -40,6 +40,7 @@ __all__ = [
     "ShapeBucketer", "CompiledCache",
     "get_compiled_cache", "reset_compiled_cache",
     "default_bucketer", "set_default_bucketer",
+    "default_trial_bucketer", "set_default_trial_bucketer", "TRIAL_LADDER",
     "instance_token", "invalidate_token", "release_executables",
     "pad_rows", "unpad_rows", "round_up_to_multiple",
 ]
@@ -370,8 +371,14 @@ class CompiledCache:
 # process-wide defaults
 # ---------------------------------------------------------------------------
 
+# the TRIAL-count ladder for horizontally fused training arrays (HPO):
+# pow-2 from 1 so a compacting sweep (8 -> 5 -> 2 live trials) compiles at
+# most len(ladder) step executables, never one per distinct trial count
+TRIAL_LADDER: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
+
 _DEFAULT_CACHE = CompiledCache()
 _DEFAULT_BUCKETER = ShapeBucketer()
+_DEFAULT_TRIAL_BUCKETER = ShapeBucketer(ladder=TRIAL_LADDER)
 _DEFAULT_LOCK = threading.Lock()
 
 
@@ -402,6 +409,23 @@ def set_default_bucketer(bucketer: ShapeBucketer) -> ShapeBucketer:
     with _DEFAULT_LOCK:
         previous = _DEFAULT_BUCKETER
         _DEFAULT_BUCKETER = bucketer
+        return previous
+
+
+def default_trial_bucketer() -> ShapeBucketer:
+    """The process-wide TRIAL-count ladder shared by every fused training
+    array (``models.fused_trainer`` and the fused GBDT sweep): trial counts
+    bucket to :data:`TRIAL_LADDER` rungs, so compile counts are bounded by
+    the ladder size, not by how many distinct sweep widths a process runs."""
+    return _DEFAULT_TRIAL_BUCKETER
+
+
+def set_default_trial_bucketer(bucketer: ShapeBucketer) -> ShapeBucketer:
+    """Swap the process-wide trial ladder (tests); returns the previous."""
+    global _DEFAULT_TRIAL_BUCKETER
+    with _DEFAULT_LOCK:
+        previous = _DEFAULT_TRIAL_BUCKETER
+        _DEFAULT_TRIAL_BUCKETER = bucketer
         return previous
 
 

@@ -1,0 +1,36 @@
+"""Shared ``synapseml_hpo_*`` metric families for fused training arrays.
+
+Both fused sweep engines (``models.fused_trainer`` for NN trials,
+``gbdt.fused`` for boosters) emit the same series distinguished by an
+``engine`` label. The families live here — next to the trial-count ladder
+in :mod:`core.batching` — so the two emitters cannot drift into
+conflicting registrations (the registry raises on a spec mismatch for an
+existing family).
+"""
+
+from __future__ import annotations
+
+from . import observability as obs
+
+__all__ = ["HPO_ARRAY_METRICS"]
+
+HPO_ARRAY_METRICS = obs.HandleCache(lambda reg: {
+    "active": reg.gauge(
+        "synapseml_hpo_active_trials",
+        "live (not early-stopped) trials in the fused training array",
+        ("engine",)),
+    "step_ms": reg.histogram(
+        "synapseml_hpo_fused_step_ms",
+        "wall time of one fused train step (all live trials together)",
+        ("engine",)),
+    "trials_per_sec": reg.gauge(
+        "synapseml_hpo_trials_per_sec",
+        "trial-steps per second through the fused array "
+        "(live trials x steps / wall)", ("engine",)),
+    "steps": reg.counter(
+        "synapseml_hpo_fused_steps_total",
+        "fused optimizer steps executed", ("engine",)),
+    "compactions": reg.counter(
+        "synapseml_hpo_compactions_total",
+        "rung-boundary compactions of the trial axis", ("engine",)),
+})

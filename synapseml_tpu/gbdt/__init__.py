@@ -8,7 +8,7 @@ histogram (``segment_sum`` per depth level) with an ICI ``psum`` over the
 """
 
 from .binning import BinMapper
-from .booster import TpuBooster, train_booster_from_source
+from .booster import TpuBooster, train_booster_from_source, train_boosters_fused
 from .interop import ImportedBooster, parse_lightgbm_string, to_lightgbm_string
 from .estimators import (
     LightGBMClassificationModel,
@@ -32,4 +32,5 @@ __all__ = [
     "LightGBMRanker",
     "LightGBMRankerModel",
     "train_booster_from_source",
+    "train_boosters_fused",
 ]

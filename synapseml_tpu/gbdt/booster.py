@@ -26,7 +26,22 @@ from .binning import BinMapper
 from . import objectives as obj
 from . import trees as T
 
-__all__ = ["TpuBooster", "train_booster", "train_booster_from_source"]
+__all__ = ["TpuBooster", "train_booster", "train_booster_from_source",
+           "train_boosters_fused"]
+
+
+def train_boosters_fused(features, labels, trials, **kwargs) -> list:
+    """Horizontally fused hyperparameter sweep: N scalar-hyperparameter
+    trials (same binning, same effective depth) train inside ONE jitted
+    boosting iteration — one fused histogram build per level serves every
+    trial, and the executable is shared across arbitrary hyperparameter
+    values through the process-wide CompiledCache. Returns one
+    :class:`TpuBooster` per trial; see :mod:`synapseml_tpu.gbdt.fused` for
+    the fusability rules and :mod:`synapseml_tpu.automl.tune` for the
+    sweep-level entry point."""
+    from .fused import fused_train_boosters
+
+    return fused_train_boosters(features, labels, trials, **kwargs)
 
 
 def train_booster_from_source(source, **kwargs) -> "TpuBooster":
@@ -267,6 +282,26 @@ class TpuBooster:
         return "\n".join(lines)
 
 
+def fold_positive_class_weight(y: np.ndarray, w: np.ndarray, *,
+                               objective: str, is_unbalance: bool,
+                               scale_pos_weight: float) -> np.ndarray:
+    """Positive-class reweighting (reference scalePosWeight/isUnbalance),
+    folded into the sample-weight vector. The ONE copy of this formula:
+    serial ``train_booster`` and the fused sweep's ``_fit_fused`` both call
+    it, so fused-vs-serial parity on unbalanced data cannot drift."""
+    if is_unbalance and scale_pos_weight != 1.0:
+        # match LightGBM: the two knobs conflict
+        raise ValueError("set either is_unbalance or scale_pos_weight, not both")
+    if objective != "binary" or not (is_unbalance or scale_pos_weight != 1.0):
+        return w
+    pos = y > 0
+    spw = scale_pos_weight
+    if is_unbalance:
+        n_pos = max(int(pos.sum()), 1)
+        spw = (len(y) - n_pos) / n_pos
+    return np.where(pos, w * spw, w)
+
+
 def _checked_monotone(constraints, num_features: int) -> tuple:
     """Validate per-feature monotone constraints (silent broadcast/clamp under
     jit would misapply a wrong-length list)."""
@@ -345,10 +380,7 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     x = np.asarray(features)
     y = np.asarray(labels, dtype=np.float32)
     n, f = x.shape
-    if max_depth is None or max_depth <= 0:
-        # heap layout needs a depth bound; default deep enough for num_leaves
-        max_depth = max(int(np.ceil(np.log2(max(num_leaves, 2)))) + 1, 3)
-    max_depth = min(max_depth, 12)  # heap arrays are 2^(d+1); bound memory
+    max_depth = T.derive_max_depth(max_depth, num_leaves)
 
     cat_feats = tuple(sorted(int(i) for i in (categorical_features or ())))
     if cat_feats and not all(0 <= i < f for i in cat_feats):
@@ -371,17 +403,9 @@ def train_booster(features: np.ndarray, labels: np.ndarray, *,
     w_np = np.ones(n + pad, np.float32)
     if weights is not None:
         w_np[:n] = np.asarray(weights, dtype=np.float32)
-    if is_unbalance and scale_pos_weight != 1.0:
-        # match LightGBM: the two knobs conflict
-        raise ValueError("set either is_unbalance or scale_pos_weight, not both")
-    if objective == "binary" and (is_unbalance or scale_pos_weight != 1.0):
-        # positive-class reweighting (reference scalePosWeight/isUnbalance)
-        pos = y[:n] > 0
-        spw = scale_pos_weight
-        if is_unbalance:
-            n_pos = max(int(pos.sum()), 1)
-            spw = (n - n_pos) / n_pos
-        w_np[:n] = np.where(pos, w_np[:n] * spw, w_np[:n])
+    w_np[:n] = fold_positive_class_weight(
+        y[:n], w_np[:n], objective=objective, is_unbalance=is_unbalance,
+        scale_pos_weight=scale_pos_weight)
 
     obj_kw = {}
     if objective_alpha is not None:

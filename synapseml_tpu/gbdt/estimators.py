@@ -108,6 +108,56 @@ class _LightGBMParams:
     mesh_config = ComplexParam("mesh_config", "MeshConfig to shard rows over the "
                                "mesh data axis (multi-host training)", default=None)
 
+    # estimator param name -> fused_train_boosters trial key: the scalar,
+    # architecture-preserving knobs that ride a horizontally fused training
+    # array as traced per-trial inputs (one executable for any values)
+    _FUSED_SCALAR_PARAMS = {
+        "learning_rate": "learning_rate", "lambda_l1": "lambda_l1",
+        "lambda_l2": "lambda_l2", "num_leaves": "num_leaves",
+        "min_data_in_leaf": "min_data_in_leaf",
+        "min_sum_hessian_in_leaf": "min_sum_hessian",
+        "min_gain_to_split": "min_gain_to_split",
+        "num_iterations": "num_iterations",
+    }
+
+    def _fused_plan(self, cfg: dict):
+        """Fusability contract for ``automl.tune``: a hashable signature when
+        ``self.copy(cfg).fit(df)`` can train inside a fused GBDT array, else
+        ``None`` (serial path). Candidates with EQUAL signatures share one
+        array: the signature carries the estimator class, the effective tree
+        depth, and every non-scalar param value — so grouped trials differ
+        only in the traced scalars of ``_FUSED_SCALAR_PARAMS``."""
+        for k in cfg:
+            if not self.has_param(k):
+                return None
+
+        def val(name):
+            return cfg[name] if name in cfg else self.get(name)
+
+        if (val("boosting_type") != "gbdt"
+                or val("feature_fraction") < 1.0
+                or (val("bagging_fraction") < 1.0 and val("bagging_freq") > 0)
+                or val("early_stopping_round") > 0
+                or val("validation_indicator_col")
+                or val("categorical_slot_indexes")
+                or val("monotone_constraints")
+                or val("model_string") is not None
+                or val("mesh_config") is not None
+                # pallas histogram kernel is not vmappable over trials
+                or val("histogram_impl") not in ("segment", "onehot")):
+            return None
+        from .fused import derive_max_depth
+
+        depth = derive_max_depth(val("max_depth"), val("num_leaves"))
+        structural = tuple(sorted(
+            (name, repr(val(name))) for name in self._param_registry
+            if name not in self._FUSED_SCALAR_PARAMS))
+        return (type(self).__name__, depth, structural)
+
+    def _fused_trials(self, configs: list[dict]) -> list[dict]:
+        return [{fused: self.copy(cfg).get(name) for name, fused
+                 in self._FUSED_SCALAR_PARAMS.items()} for cfg in configs]
+
     # ---- shared helpers ----
     def _features(self, df: DataFrame) -> np.ndarray:
         # float32 sources KEEP float32: that is the multithreaded native
@@ -276,6 +326,46 @@ class LightGBMClassifier(Estimator, _LightGBMParams):
                      if model.has_param(k)})
         return model
 
+    def _fit_fused(self, df: DataFrame,
+                   configs: list[dict]) -> list["LightGBMClassificationModel"]:
+        """Fit ``len(configs)`` variants in ONE fused training array
+        (``automl.tune`` routes same-signature candidates here). Data is
+        featurized/binned once; models come back aligned with ``configs``."""
+        work = self.copy(configs[0])
+        x = work._features(df)
+        work.require_columns(df, work.get("label_col"))
+        y_raw = np.asarray(df.collect_column(work.get("label_col")))
+        classes, y = np.unique(y_raw, return_inverse=True)
+        num_class = len(classes)
+        objective = work.get("objective")
+        if objective == "auto":
+            objective = "binary" if num_class <= 2 else "multiclass"
+        n = x.shape[0]
+        w = (np.asarray(df.collect_column(work.get("weight_col")), np.float32)
+             if work.get("weight_col") else np.ones(n, np.float32))
+        from .booster import fold_positive_class_weight, train_boosters_fused
+
+        w = fold_positive_class_weight(
+            y.astype(np.float32), w, objective=objective,
+            is_unbalance=work.get("is_unbalance"),
+            scale_pos_weight=work.get("scale_pos_weight"))
+
+        boosters = train_boosters_fused(
+            x, y.astype(np.float32), self._fused_trials(configs),
+            objective=objective, num_class=num_class, weights=w,
+            max_depth=work.get("max_depth"), max_bin=work.get("max_bin"),
+            seed=work.get("seed"),
+            histogram_impl=work.get("histogram_impl"))
+        models = []
+        for cfg, booster in zip(configs, boosters):
+            trial_est = self.copy(cfg)
+            model = LightGBMClassificationModel(booster=booster,
+                                                classes=classes)
+            model.set(**{k: v for k, v in trial_est._param_values.items()
+                         if model.has_param(k)})
+            models.append(model)
+        return models
+
 
 class LightGBMClassificationModel(_LightGBMModelBase):
     feature_name = "lightgbm"
@@ -349,6 +439,36 @@ class LightGBMRegressor(Estimator, _LightGBMParams):
                      if model.has_param(k)})
         return model
 
+    def _fit_fused(self, df: DataFrame,
+                   configs: list[dict]) -> list["LightGBMRegressionModel"]:
+        """Fused-array twin of ``_fit`` for same-signature sweep candidates
+        (see ``LightGBMClassifier._fit_fused``)."""
+        work = self.copy(configs[0])
+        x = work._features(df)
+        work.require_columns(df, work.get("label_col"))
+        y = np.asarray(df.collect_column(work.get("label_col")), np.float32)
+        w = (np.asarray(df.collect_column(work.get("weight_col")), np.float32)
+             if work.get("weight_col") else None)
+
+        from .booster import train_boosters_fused
+
+        boosters = train_boosters_fused(
+            x, y, self._fused_trials(configs),
+            objective=work.get("objective"), weights=w,
+            objective_alpha=work.get("alpha"),
+            tweedie_variance_power=work.get("tweedie_variance_power"),
+            max_depth=work.get("max_depth"), max_bin=work.get("max_bin"),
+            seed=work.get("seed"),
+            histogram_impl=work.get("histogram_impl"))
+        models = []
+        for cfg, booster in zip(configs, boosters):
+            trial_est = self.copy(cfg)
+            model = LightGBMRegressionModel(booster=booster)
+            model.set(**{k: v for k, v in trial_est._param_values.items()
+                         if model.has_param(k)})
+            models.append(model)
+        return models
+
 
 class LightGBMRegressionModel(_LightGBMModelBase):
     feature_name = "lightgbm"
@@ -371,6 +491,12 @@ class LightGBMRegressionModel(_LightGBMModelBase):
 
 class LightGBMRanker(Estimator, _LightGBMParams):
     feature_name = "lightgbm"
+
+    def _fused_plan(self, cfg: dict):
+        return None  # lambdarank's grouped lambda computation is not fusable
+
+    # keep automl.fusable_param_names honest: no fused path, no fusable knobs
+    _FUSED_SCALAR_PARAMS: dict = {}
 
     group_col = Param("group_col", "query/group id column", default="group")
     eval_at = Param("eval_at", "NDCG@k cutoffs", default=(5,),

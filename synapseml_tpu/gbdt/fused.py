@@ -1,0 +1,316 @@
+"""Horizontally fused GBDT hyperparameter sweeps.
+
+The GBDT twin of :mod:`models.fused_trainer` (HFTA, arXiv:2102.02344):
+``N`` trials that share binning (same ``max_bin``) and tree shape (same
+effective ``max_depth``) train inside ONE jitted boosting iteration — the
+data is binned and device-put once, and each depth level runs ONE fused
+histogram build (the :func:`trees._level_histogram` kernel vmapped over the
+trial axis) that serves every trial, instead of each trial's own XLA
+programs serialized on the device.
+
+Per-trial scalar hyperparameters (``learning_rate``, ``lambda_l1/l2``,
+``num_leaves``, ``min_data_in_leaf``, ``min_sum_hessian``,
+``min_gain_to_split``) enter the step as traced ``(R,)`` arrays, so the
+iteration executable is shared across arbitrary values — the serial path
+bakes them into :class:`trees.GrowthConfig` constants and recompiles its
+whole level ladder per distinct config. Trial counts bucket to the shared
+trial-count ladder (:func:`core.batching.default_trial_bucketer`), padded
+slots replay trial 0 and are discarded, so compile counts stay bounded by
+the ladder, not by sweep width. Split/leaf math is shared with the serial
+path (:func:`trees.level_cum_tables` / :func:`trees.split_gain` /
+``trees._leaf_value``), which is what makes fused-vs-serial prediction
+parity hold to f32 rounding (``tests/test_fused_automl.py``).
+
+Out of scope (serial fallback in ``automl.tune``): bagging / GOSS / DART /
+rf, feature_fraction < 1, categorical features, monotone constraints,
+early stopping on a validation set, warm starts.
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import batching as cb
+from ..core.hpo_metrics import HPO_ARRAY_METRICS as _HPO_METRICS
+from .binning import BinMapper
+from . import objectives as obj
+from . import trees as T
+
+__all__ = ["FUSED_GBDT_SCALARS", "fused_train_boosters"]
+
+# per-trial scalars that become traced step inputs; everything else either
+# changes program structure (grouped on it) or is unsupported fused
+FUSED_GBDT_SCALARS = ("learning_rate", "lambda_l1", "lambda_l2", "num_leaves",
+                      "min_data_in_leaf", "min_sum_hessian",
+                      "min_gain_to_split")
+
+def _trial_defaults() -> dict:
+    """Unset trial keys fill from serial ``train_booster``'s OWN signature
+    defaults, so a direct ``train_boosters_fused`` caller can never get
+    silently different hyperparameters than the serial fit it is A/B'd
+    against."""
+    from .booster import train_booster
+
+    sig = inspect.signature(train_booster)
+    return {k: sig.parameters[k].default
+            for k in ("num_iterations", *FUSED_GBDT_SCALARS)}
+
+
+derive_max_depth = T.derive_max_depth
+
+
+def _level_pass(bins, grad, hess, presence, node_of_row, feature,
+                threshold_bin, leaf_value, node_gain, node_cover, leaf_count,
+                cfg_ns, base: int, width: int, B: int, hist_impl: str):
+    """One depth level for ONE trial (vmapped over trials by the caller):
+    the serial ``trees._make_level_step`` math with the per-config constants
+    replaced by the traced scalars in ``cfg_ns`` — no categorical /
+    monotone / feature-mask branches (those configs take the serial path).
+    Selection, budget, and row routing are the SHARED trees helpers, so the
+    two paths cannot diverge on them."""
+    num_thresholds = B - 1
+    hist = T._level_histogram(bins, grad, hess, presence, node_of_row, base,
+                              width, B, hist_impl=hist_impl)
+    g_tot, h_tot, c_tot, gl, hl, cl = T.level_cum_tables(hist, num_thresholds)
+    gr, hr, gain = T.split_gain(g_tot, h_tot, gl, hl, cfg_ns)
+    cr = c_tot[:, None, None] - cl
+    ok = T.split_ok_mask(cl, cr, hl, hr, cfg_ns)
+    gain = jnp.where(ok, gain, -jnp.inf)
+
+    (_best_idx, best_gain, best_feat, best_thr, active,
+     do_split) = T.select_level_splits(gain, c_tot, leaf_count, cfg_ns,
+                                       width, num_thresholds)
+
+    node_ids = base + jnp.arange(width, dtype=jnp.int32)
+    feature = feature.at[node_ids].set(jnp.where(do_split, best_feat, -1))
+    threshold_bin = threshold_bin.at[node_ids].set(
+        jnp.where(do_split, best_thr, 0))
+    value = T._leaf_value(g_tot, h_tot, cfg_ns)
+    leaf_value = leaf_value.at[node_ids].set(
+        jnp.where(active & ~do_split, value, 0.0))
+    node_gain = node_gain.at[node_ids].set(jnp.where(do_split, best_gain, 0.0))
+    node_cover = node_cover.at[node_ids].set(c_tot)
+    leaf_count = leaf_count + jnp.sum(do_split.astype(jnp.int32))
+
+    _rel, row_split, _f_of_row, _row_bin, go_left = T.level_row_partition(
+        bins, node_of_row, do_split, best_feat, best_thr, base, width)
+    node_of_row = T.route_rows(node_of_row, row_split, go_left)
+    return (node_of_row, feature, threshold_bin, leaf_value, node_gain,
+            node_cover, leaf_count)
+
+
+def _grow_tree_fused(bins, grad, hess, presence, hp: dict, max_depth: int,
+                     B: int, hist_impl: str) -> T.TreeArrays:
+    """One tree for ONE trial with traced scalar hyperparameters; levels are
+    unrolled in-trace (the serial path's per-level jit cache keys on a
+    hashable GrowthConfig, which traced scalars are not)."""
+    m = T.max_nodes(max_depth)
+    feature = jnp.full(m, -1, jnp.int32)
+    threshold_bin = jnp.zeros(m, jnp.int32)
+    leaf_value = jnp.zeros(m, jnp.float32)
+    node_gain = jnp.zeros(m, jnp.float32)
+    node_cover = jnp.zeros(m, jnp.float32)
+    node_of_row = jnp.zeros(bins.shape[0], jnp.int32)
+    leaf_count = jnp.asarray(1, jnp.int32)
+    cfg_ns = types.SimpleNamespace(
+        lambda_l1=hp["lambda_l1"], lambda_l2=hp["lambda_l2"],
+        learning_rate=hp["learning_rate"],
+        min_data_in_leaf=hp["min_data_in_leaf"],
+        min_sum_hessian=hp["min_sum_hessian"],
+        min_gain_to_split=hp["min_gain_to_split"],
+        num_leaves=hp["num_leaves"])
+    for d in range(max_depth):
+        (node_of_row, feature, threshold_bin, leaf_value, node_gain,
+         node_cover, leaf_count) = _level_pass(
+            bins, grad, hess, presence, node_of_row, feature, threshold_bin,
+            leaf_value, node_gain, node_cover, leaf_count, cfg_ns,
+            2 ** d - 1, 2 ** d, B, hist_impl)
+    # final level: every active node becomes a leaf (per-node totals only)
+    base, width = 2 ** max_depth - 1, 2 ** max_depth
+    valid = (node_of_row >= base) & (node_of_row < base + width)
+    rel = jnp.where(valid, node_of_row - base, 0)
+    zero = jnp.zeros_like(grad)
+    data = jnp.stack([jnp.where(valid, grad, zero),
+                      jnp.where(valid, hess, zero),
+                      jnp.where(valid, presence, zero)], axis=-1)
+    tot = jax.ops.segment_sum(data, rel, num_segments=width)
+    active = tot[:, 2] > 0
+    node_ids = base + jnp.arange(width, dtype=jnp.int32)
+    value = T._leaf_value(tot[:, 0], tot[:, 1], cfg_ns)
+    leaf_value = leaf_value.at[node_ids].set(jnp.where(active, value, 0.0))
+    node_cover = node_cover.at[node_ids].set(tot[:, 2])
+    return T.TreeArrays(feature, threshold_bin, leaf_value, node_gain,
+                        node_cover, jnp.zeros((m, 1), jnp.uint8))
+
+
+def _build_fused_iteration(o, K: int, max_depth: int, B: int,
+                           hist_impl: str):
+    """CompiledCache builder: ONE boosting iteration for every trial —
+    vmapped grad/hess + K fused trees + score updates in one program."""
+
+    def build():
+        def one_trial(scores_t, hp_t, bins, y, presence, w):
+            g, h = o.grad_hess(scores_t, y)
+            g = g.reshape(scores_t.shape[0], -1)
+            h = h.reshape(scores_t.shape[0], -1)
+            w_eff = (w * presence)[:, None]
+            g = g * w_eff
+            h = h * w_eff
+
+            def per_class(sc, gh_k):
+                gk, hk, k_idx = gh_k
+                tree = _grow_tree_fused(bins, gk, hk, presence, hp_t,
+                                        max_depth, B, hist_impl)
+                delta = T.traverse_binned(bins, tree, max_depth)
+                sc = jax.lax.dynamic_update_index_in_dim(
+                    sc, sc[:, k_idx] + delta, k_idx, axis=1)
+                return sc, tree
+
+            scores_t, trees = jax.lax.scan(
+                per_class, scores_t,
+                (jnp.swapaxes(g, 0, 1), jnp.swapaxes(h, 0, 1),
+                 jnp.arange(K, dtype=jnp.int32)))
+            return scores_t, trees
+
+        fused = jax.vmap(one_trial, in_axes=(0, 0, None, None, None, None))
+        return jax.jit(fused, donate_argnums=(0,))
+
+    return build
+
+
+def fused_train_boosters(features, labels, trials: list[dict], *,
+                         objective: str = "regression", num_class: int = 1,
+                         max_depth: int = -1, max_bin: int = 255,
+                         seed: int = 0, weights=None,
+                         objective_alpha: float | None = None,
+                         tweedie_variance_power: float | None = None,
+                         histogram_impl: str = "segment") -> list:
+    """Train ``len(trials)`` boosters in one fused array; returns one
+    :class:`booster.TpuBooster` per trial (same scoring surface the serial
+    ``train_booster`` produces, sharing one fitted :class:`BinMapper`).
+
+    ``trials``: per-trial overrides of :data:`FUSED_GBDT_SCALARS` plus
+    ``num_iterations`` (the array runs to the max; each trial keeps its own
+    first ``num_iterations`` trees). All trials must resolve to the same
+    effective ``max_depth`` — group by it upstream (``automl.tune`` does).
+    """
+    from .booster import TpuBooster
+
+    if not trials:
+        raise ValueError("fused_train_boosters needs at least one trial")
+    defaults = _trial_defaults()
+    allowed = set(defaults)
+    merged = []
+    for i, t in enumerate(trials):
+        unknown = set(t) - allowed
+        if unknown:
+            raise ValueError(
+                f"trial {i} has non-fusable keys {sorted(unknown)}; fusable: "
+                f"{sorted(allowed)} — route this config to the serial path")
+        merged.append({**defaults, **t})
+        if merged[-1]["num_iterations"] < 1:
+            raise ValueError(f"trial {i}: num_iterations must be >= 1, got "
+                             f"{merged[-1]['num_iterations']}")
+    depths = {derive_max_depth(max_depth, m["num_leaves"]) for m in merged}
+    if len(depths) > 1:
+        raise ValueError(
+            f"trials resolve to different effective max_depths {sorted(depths)}"
+            " — a fused array shares one heap layout; partition by depth "
+            "(automl.tune groups on it) or pass max_depth explicitly")
+    depth = depths.pop()
+
+    x = np.asarray(features)
+    y = np.asarray(labels, np.float32)
+    n, f = x.shape
+    mapper = BinMapper(max_bin=max_bin, seed=seed)
+    bins_np = mapper.fit_transform(x).astype(np.int32)
+    B = mapper.num_bins
+
+    obj_kw = {}
+    if objective_alpha is not None:
+        obj_kw["alpha"] = objective_alpha
+    if tweedie_variance_power is not None:
+        obj_kw["tweedie_variance_power"] = tweedie_variance_power
+    o = obj.get_objective(objective, num_class=num_class, **obj_kw)
+    if o.name == "lambdarank":
+        raise ValueError("lambdarank sweeps are not fusable (grouped "
+                         "lambda computation); use the serial path")
+    if o.name in ("poisson", "tweedie", "gamma") and np.any(y < 0):
+        raise ValueError(f"{o.name} objective requires non-negative labels")
+    K = o.num_model_out
+    init = np.asarray(jax.device_get(o.init_score(jnp.asarray(y))),
+                      np.float32).reshape(K)
+
+    R = cb.default_trial_bucketer().bucket_for(len(merged))
+    slot_trials = list(range(len(merged))) + [0] * (R - len(merged))
+    hp = {k: jnp.asarray([merged[t][k] for t in slot_trials],
+                         jnp.int32 if k == "num_leaves" else jnp.float32)
+          for k in FUSED_GBDT_SCALARS}
+
+    bins = jnp.asarray(bins_np)
+    yd = jnp.asarray(y)
+    presence = jnp.ones(n, jnp.float32)
+    w = jnp.asarray(np.ones(n, np.float32) if weights is None
+                    else np.asarray(weights, np.float32))
+    scores = jnp.broadcast_to(jnp.asarray(init)[None, None, :],
+                              (R, n, K)).astype(jnp.float32)
+    scores = jnp.array(scores)  # donation needs an owned buffer
+
+    step = cb.get_compiled_cache().get(
+        "gbdt_fused_iter",
+        (R, n, f, B, K, depth, histogram_impl, o.name,
+         objective_alpha, tweedie_variance_power),
+        _build_fused_iteration(o, K, depth, B, histogram_impl))
+
+    m = _HPO_METRICS.get()
+    m["active"].set(len(merged), engine="gbdt_fused")
+    iters = max(t["num_iterations"] for t in merged)
+    acc_f, acc_t, acc_v, acc_g, acc_c = [], [], [], [], []
+    t_start = time.perf_counter()
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        scores, trees = step(scores, hp, bins, yd, presence, w)
+        acc_f.append(trees.feature)
+        acc_t.append(trees.threshold_bin)
+        acc_v.append(trees.leaf_value)
+        acc_g.append(trees.gain)
+        acc_c.append(trees.cover)
+        m["step_ms"].observe((time.perf_counter() - t0) * 1e3,
+                             engine="gbdt_fused")
+        m["steps"].inc(engine="gbdt_fused")
+    jax.block_until_ready(acc_f[-1])
+    wall = max(time.perf_counter() - t_start, 1e-9)
+    m["trials_per_sec"].set(len(merged) * iters / wall, engine="gbdt_fused")
+
+    # ONE host transfer for the whole array: (iters, R, K, M) stacks
+    feat_h = np.asarray(jnp.stack(acc_f))
+    thr_bin_h = np.asarray(jnp.stack(acc_t))
+    val_h = np.asarray(jnp.stack(acc_v))
+    gain_h = np.asarray(jnp.stack(acc_g))
+    cover_h = np.asarray(jnp.stack(acc_c))
+    ub = mapper.upper_bound_values()
+    thr_val_h = np.where(feat_h >= 0,
+                         ub[np.maximum(feat_h, 0), thr_bin_h],
+                         0.0).astype(np.float32)
+
+    out = []
+    for i, t in enumerate(merged):
+        n_it = t["num_iterations"]
+        booster = TpuBooster(
+            feat_h[:n_it, i], thr_val_h[:n_it, i], val_h[:n_it, i],
+            gain_h[:n_it, i], cover=cover_h[:n_it, i], max_depth=depth,
+            num_model_out=K, objective=o.name, init_score=init,
+            num_features=f, best_iteration=None,
+            params={"num_iterations": n_it,
+                    "learning_rate": t["learning_rate"],
+                    "num_leaves": t["num_leaves"], "max_bin": max_bin,
+                    "boosting_type": "gbdt", "fused": True})
+        booster.bin_mapper = mapper
+        out.append(booster)
+    return out

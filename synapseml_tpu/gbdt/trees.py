@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["GrowthConfig", "TreeArrays", "grow_tree", "traverse_binned", "predict_raw_forest"]
+__all__ = ["GrowthConfig", "TreeArrays", "grow_tree", "traverse_binned",
+           "predict_raw_forest", "level_cum_tables", "split_gain"]
 
 
 class GrowthConfig(NamedTuple):
@@ -170,6 +171,99 @@ def _level_histogram(bins: jax.Array, g: jax.Array, h: jax.Array, presence: jax.
     return jnp.swapaxes(hists, 0, 1)  # (W, F, B, 3)
 
 
+def derive_max_depth(max_depth: int, num_leaves: int) -> int:
+    """Effective tree depth for a config: the ONE copy of the default-depth
+    formula (deep enough for ``num_leaves``, heap-bounded at 12). Serial
+    ``train_booster``, the fused sweep, and ``_fused_plan`` grouping all
+    call this — a private copy in any of them would let a fused trial train
+    a different tree shape than the serial fit of the same config."""
+    if max_depth is None or max_depth <= 0:
+        max_depth = max(int(np.ceil(np.log2(max(num_leaves, 2)))) + 1, 3)
+    return min(max_depth, 12)
+
+
+def level_cum_tables(hist: jax.Array, num_thresholds: int):
+    """Node totals + cumulative left-prefix channels from one level's
+    histograms: ``(g_tot, h_tot, c_tot, gl, hl, cl)`` with ``*_tot`` shaped
+    (W,) and the left tables (W, F, num_thresholds). Shared by the serial
+    per-config step and the fused multi-trial sweep (``gbdt/fused.py``) so
+    the two training paths cannot diverge on the prefix-scan math."""
+    cum = jnp.cumsum(hist, axis=2)  # (W, F, B, 3)
+    total = cum[:, 0, -1, :]  # (W, 3) — feature 0's full sum == node totals
+    left = cum[:, :, :num_thresholds, :]  # (W, F, B-1, 3)
+    return (total[:, 0], total[:, 1], total[:, 2],
+            left[..., 0], left[..., 1], left[..., 2])
+
+
+def split_gain(g_tot: jax.Array, h_tot: jax.Array, gl: jax.Array,
+               hl: jax.Array, cfg) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Candidate split gains (W, F, num_thresholds) plus the right-side
+    grad/hess tables. ``cfg`` only needs ``lambda_l1``/``lambda_l2`` — python
+    floats on the serial path, traced per-trial scalars on the fused one."""
+    gr = g_tot[:, None, None] - gl
+    hr = h_tot[:, None, None] - hl
+    gain = (_split_score(gl, hl, cfg) + _split_score(gr, hr, cfg)
+            - _split_score(g_tot, h_tot, cfg)[:, None, None])
+    return gr, hr, gain
+
+
+def split_ok_mask(cl, cr, hl, hr, cfg):
+    """Data-count / hessian-mass split validity (W, F, num_thresholds).
+    ``cfg`` needs ``min_data_in_leaf``/``min_sum_hessian`` — python floats
+    on the serial path, traced per-trial scalars on the fused one. Shared
+    so the two paths cannot diverge on the eligibility rule."""
+    return ((cl >= cfg.min_data_in_leaf) & (cr >= cfg.min_data_in_leaf)
+            & (hl >= cfg.min_sum_hessian) & (hr >= cfg.min_sum_hessian))
+
+
+def select_level_splits(gain, c_tot, leaf_count, cfg, width: int,
+                        num_thresholds: int):
+    """Best split per node + the level's leaf-budget decision: argmax over
+    (feature, threshold) — jnp.argmax's first-max tie-break IS part of the
+    contract — min_gain gate, and top-(remaining-budget) ranking by gain.
+    ``cfg`` needs ``min_gain_to_split``/``num_leaves``. One copy shared by
+    the serial level step and the fused sweep; returns
+    ``(best_idx, best_gain, best_feat, best_thr, active, do_split)``."""
+    flat = gain.reshape(width, -1)
+    best_idx = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
+    best_feat = (best_idx // num_thresholds).astype(jnp.int32)
+    best_thr = (best_idx % num_thresholds).astype(jnp.int32)
+    # a node is "active" at this level iff it actually holds rows
+    active = c_tot > 0
+    can_split = active & (best_gain > cfg.min_gain_to_split)
+    # leaf budget: each split nets +1 leaf; split the top-(budget) gains
+    budget = jnp.maximum(cfg.num_leaves - leaf_count, 0)
+    order = jnp.argsort(jnp.where(can_split, -best_gain, jnp.inf))
+    rank = jnp.zeros(width, jnp.int32).at[order].set(
+        jnp.arange(width, dtype=jnp.int32))
+    do_split = can_split & (rank < budget)
+    return best_idx, best_gain, best_feat, best_thr, active, do_split
+
+
+def level_row_partition(bins, node_of_row, do_split, best_feat, best_thr,
+                        base: int, width: int):
+    """Row→child routing ingredients for one level: which rows sit in a
+    splitting node, their winning feature's bin, and the numeric
+    left/right decision. Returns ``(rel, row_split, f_of_row, row_bin,
+    go_left)`` — callers may override ``go_left`` (categorical membership)
+    before applying :func:`route_rows`."""
+    here = (node_of_row >= base) & (node_of_row < base + width)
+    rel = jnp.where(here, node_of_row - base, 0)
+    row_split = do_split[rel] & here
+    f_of_row = best_feat[rel]
+    row_bin = jnp.take_along_axis(
+        bins, f_of_row[:, None].astype(jnp.int32), axis=1)[:, 0]
+    go_left = row_bin.astype(jnp.int32) <= best_thr[rel]
+    return rel, row_split, f_of_row, row_bin, go_left
+
+
+def route_rows(node_of_row, row_split, go_left):
+    """Move each splitting row to its heap child (left = 2i+1)."""
+    child = 2 * node_of_row + jnp.where(go_left, 1, 2)
+    return jnp.where(row_split, child, node_of_row)
+
+
 def _make_level_step(base: int, width: int, cfg: GrowthConfig):
     """One jitted level step: histogram → best splits → budget → update tree +
     row partition. Reused across trees/iterations (same shapes)."""
@@ -186,12 +280,8 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
              node_lo, node_hi, cat_mask_tree):
         hist = _level_histogram(bins, grad, hess, presence, node_of_row, base,
                                 width, B, hist_impl=cfg.hist_impl)
-        cum = jnp.cumsum(hist, axis=2)  # (W, F, B, 3)
-        total = cum[:, 0, -1, :]  # (W, 3) — feature 0's full sum == node totals
-        g_tot, h_tot, c_tot = total[:, 0], total[:, 1], total[:, 2]
-
-        left = cum[:, :, :num_thresholds, :]  # (W, F, B-1, 3)
-        gl, hl, cl = left[..., 0], left[..., 1], left[..., 2]
+        g_tot, h_tot, c_tot, gl, hl, cl = level_cum_tables(hist,
+                                                           num_thresholds)
 
         cat_order = None
         if cfg.categorical_features:
@@ -226,15 +316,9 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
             cat_pos = np.zeros(F, np.int32)
             cat_pos[cat_idx] = np.arange(len(cat_idx), dtype=np.int32)
 
-        gr = g_tot[:, None, None] - gl
-        hr = h_tot[:, None, None] - hl
+        gr, hr, gain = split_gain(g_tot, h_tot, gl, hl, cfg)
         cr = c_tot[:, None, None] - cl
-
-        gain = (_split_score(gl, hl, cfg) + _split_score(gr, hr, cfg)
-                - _split_score(g_tot, h_tot, cfg)[:, None, None])
-        ok = ((cl >= cfg.min_data_in_leaf) & (cr >= cfg.min_data_in_leaf)
-              & (hl >= cfg.min_sum_hessian) & (hr >= cfg.min_sum_hessian)
-              & feat_mask[None, :, None])
+        ok = split_ok_mask(cl, cr, hl, hr, cfg) & feat_mask[None, :, None]
         if cfg.categorical_features:
             ok = ok.at[:, cat_idx].set(ok[:, cat_idx] & valid_k)
         if mono is not None:
@@ -246,21 +330,9 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
             ok &= jnp.where(c > 0, vl <= vr, jnp.where(c < 0, vl >= vr, True))
         gain = jnp.where(ok, gain, -jnp.inf)
 
-        flat = gain.reshape(width, -1)
-        best_idx = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
-        best_feat = (best_idx // num_thresholds).astype(jnp.int32)
-        best_thr = (best_idx % num_thresholds).astype(jnp.int32)
-
-        # a node is "active" at this level iff it actually holds rows
-        active = c_tot > 0
-        can_split = active & (best_gain > cfg.min_gain_to_split)
-
-        # leaf budget: each split nets +1 leaf; split the top-(budget) gains
-        budget = jnp.maximum(cfg.num_leaves - leaf_count, 0)
-        order = jnp.argsort(jnp.where(can_split, -best_gain, jnp.inf))
-        rank = jnp.zeros(width, jnp.int32).at[order].set(jnp.arange(width, dtype=jnp.int32))
-        do_split = can_split & (rank < budget)
+        (best_idx, best_gain, best_feat, best_thr, active,
+         do_split) = select_level_splits(gain, c_tot, leaf_count, cfg,
+                                         width, num_thresholds)
 
         node_ids = base + jnp.arange(width, dtype=jnp.int32)
         feature = feature.at[node_ids].set(jnp.where(do_split, best_feat, -1))
@@ -313,19 +385,14 @@ def _make_level_step(base: int, width: int, cfg: GrowthConfig):
         node_hi = node_hi.at[right_ids].set(r_hi)
 
         # partition rows of split nodes to children
-        here = (node_of_row >= base) & (node_of_row < base + width)
-        rel = jnp.where(here, node_of_row - base, 0)
-        row_split = do_split[rel] & here
-        f_of_row = best_feat[rel]
-        row_bin = jnp.take_along_axis(bins, f_of_row[:, None].astype(jnp.int32), axis=1)[:, 0]
-        go_left = row_bin.astype(jnp.int32) <= best_thr[rel]
+        rel, row_split, f_of_row, row_bin, go_left = level_row_partition(
+            bins, node_of_row, do_split, best_feat, best_thr, base, width)
         if cfg.categorical_features:
             in_set = jnp.take_along_axis(
                 member[rel], row_bin[:, None].astype(jnp.int32), axis=1)[:, 0]
             go_left = jnp.where(jnp.asarray(is_cat_f)[f_of_row], in_set,
                                 go_left)
-        child = 2 * node_of_row + jnp.where(go_left, 1, 2)
-        node_of_row = jnp.where(row_split, child, node_of_row)
+        node_of_row = route_rows(node_of_row, row_split, go_left)
         return (node_of_row, feature, threshold_bin, leaf_value, node_gain,
                 node_cover, leaf_count, node_lo, node_hi, cat_mask_tree)
 

@@ -2,6 +2,7 @@ from .cntk import CNTKModel
 from .downloader import ModelDownloader, ModelSchema
 from .text import DeepTextClassifier, DeepTextModel
 from .tokenizer import HashingTokenizer, resolve_tokenizer
+from .fused_trainer import FusedTrainer, fused_fit_arrays, fused_fit_source
 from .trainer import Trainer, TrainerConfig, TrainState, cross_entropy_loss
 from .vision import DeepVisionClassifier, DeepVisionModel
 
@@ -13,4 +14,5 @@ __all__ = [
     "DeepVisionClassifier", "DeepVisionModel",
     "HashingTokenizer", "resolve_tokenizer",
     "Trainer", "TrainerConfig", "TrainState", "cross_entropy_loss",
+    "FusedTrainer", "fused_fit_source", "fused_fit_arrays",
 ]

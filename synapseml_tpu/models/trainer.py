@@ -29,7 +29,21 @@ import optax
 from ..parallel.mesh import MeshContext, logical_axis_rules
 
 __all__ = ["TrainerConfig", "Trainer", "cross_entropy_loss", "TrainState",
-           "fit_source", "fit_arrays"]
+           "fit_source", "fit_arrays",
+           # horizontally fused training arrays (HFTA): N hyperparameter
+           # trials inside ONE jitted step — implementation lives in
+           # .fused_trainer (kept importable from here; the module split
+           # lets the no-inline-jit static check cover the fused step)
+           "FusedTrainer", "fused_fit_source", "fused_fit_arrays"]
+
+
+def __getattr__(name):  # PEP 562: lazy, avoids a circular import at load
+    if name in ("FusedTrainer", "fused_fit_source", "fused_fit_arrays",
+                "FUSED_OPT_HPARAMS", "FUSED_LOSS_HPARAMS"):
+        from . import fused_trainer
+
+        return getattr(fused_trainer, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 @dataclasses.dataclass

@@ -121,13 +121,19 @@ def test_no_inline_jit_in_stage_transform():
     and the ``io/serving.py`` token scheduler acquire jits only through the
     cache — that is what makes the decode-executable count bounded by the
     slot ladder and the ``/admin/load`` warmup able to precompile every
-    rung."""
+    rung. The AutoML sweep plane (``automl/``, the fused training arrays in
+    ``models/fused_trainer.py`` and ``gbdt/fused.py``) is likewise bound:
+    its one-executable-per-trial-rung guarantee rests on every jit going
+    through the cache, where the miss counters the parity suite asserts on
+    can see them."""
     import ast
 
     modules = ["onnx/model.py", "hf/embedder.py", "hf/causal_lm.py",
                "models/text.py", "models/vision.py", "nn/knn.py",
                "models/paged_engine.py", "models/flax_nets/llama.py",
-               "io/serving.py"]
+               "io/serving.py",
+               "automl/tune.py", "automl/hyperparams.py",
+               "models/fused_trainer.py", "gbdt/fused.py"]
     pkg = pathlib.Path(st.__file__).parent
     offenders = []
     for rel in modules:

@@ -1,0 +1,477 @@
+"""Horizontally fused training arrays: fused-vs-serial parity, early-stop
+masking + compaction, compile-count bounds, and the rewired AutoML sweep
+(TuneHyperparameters / FindBestModel fusable-group partitioning)."""
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+
+from synapseml_tpu.core import batching as cb
+from synapseml_tpu.core.params import Param
+from synapseml_tpu.core.pipeline import Estimator, Model
+from synapseml_tpu.automl import (
+    DiscreteHyperParam,
+    FindBestModel,
+    HyperparamBuilder,
+    TuneHyperparameters,
+)
+from synapseml_tpu.automl.hyperparams import DefaultHyperparams, fusable_param_names
+from synapseml_tpu.automl.tune import _evaluate
+from synapseml_tpu.gbdt import LightGBMClassifier, LightGBMRegressor
+from synapseml_tpu.gbdt.booster import train_booster
+from synapseml_tpu.gbdt.fused import fused_train_boosters
+from synapseml_tpu.models.fused_trainer import FusedTrainer, fused_fit_arrays
+from synapseml_tpu.models.trainer import Trainer, TrainerConfig, fit_arrays
+from synapseml_tpu.parallel.mesh import MeshConfig, create_mesh
+
+pytestmark = pytest.mark.automl
+
+
+class _MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(3)(nn.tanh(nn.Dense(16)(x)))
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return create_mesh(MeshConfig())
+
+
+def _mlp_data(n=256, d=8, seed=0):
+    rs = np.random.default_rng(seed)
+    return {"x": rs.normal(size=(n, d)).astype(np.float32),
+            "labels": rs.integers(0, 3, n).astype(np.int32)}
+
+
+def _param_trees_close(a, b, rtol=2e-4, atol=1e-6):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# FusedTrainer: parity / masking / compaction / compile bounds
+# ---------------------------------------------------------------------------
+
+def test_fused_vs_serial_trainer_parity(mesh):
+    """N trials trained fused match N independent serial fits under f32:
+    same seeds, same data order via the deterministic DataLoader."""
+    data = _mlp_data()
+    trials = [{"learning_rate": 1e-2, "weight_decay": 0.0},
+              {"learning_rate": 3e-3, "weight_decay": 0.01},
+              {"learning_rate": 1e-3, "weight_decay": 0.1},
+              {"learning_rate": 3e-2, "weight_decay": 0.001}]
+    STEPS, BATCH, SEED = 12, 32, 5
+
+    ft = FusedTrainer(_MLP(), mesh, TrainerConfig(total_steps=STEPS),
+                      [dict(t) for t in trials])
+    state = fused_fit_arrays(ft, data, batch_size=BATCH, total_steps=STEPS,
+                             seed=SEED)
+    fused_states = ft.unstack(state)
+
+    for i, t in enumerate(trials):
+        serial = Trainer(_MLP(), mesh,
+                         TrainerConfig(total_steps=STEPS,
+                                       lr_schedule="constant", **t))
+        st = fit_arrays(serial, data, batch_size=BATCH, total_steps=STEPS,
+                        seed=SEED)
+        assert int(fused_states[i].step) == int(st.step) == STEPS
+        _param_trees_close(jax.device_get(st.params), fused_states[i].params)
+
+
+def test_fused_loss_metrics_match_serial(mesh):
+    """Per-trial fused step losses equal each serial fit's step losses."""
+    data = _mlp_data(n=128)
+    trials = [{"learning_rate": 1e-2}, {"learning_rate": 1e-3}]
+    STEPS, BATCH, SEED = 6, 32, 3
+    ft = FusedTrainer(_MLP(), mesh, TrainerConfig(), [dict(t) for t in trials])
+    losses = {0: [], 1: []}
+    orig_step = ft.train_step
+
+    def spy(state, batch):
+        state, metrics = orig_step(state, batch)
+        host = np.asarray(metrics["loss"])
+        for tid in losses:
+            losses[tid].append(float(host[tid]))
+        return state, metrics
+
+    ft.train_step = spy
+    fused_fit_arrays(ft, data, batch_size=BATCH, total_steps=STEPS, seed=SEED)
+
+    for i, t in enumerate(trials):
+        serial = Trainer(_MLP(), mesh,
+                         TrainerConfig(lr_schedule="constant", **t))
+        serial_losses = []
+        st = None
+        from synapseml_tpu.data.source import MemorySource
+        from synapseml_tpu.data import DataLoader
+
+        loader = DataLoader(MemorySource(data), BATCH, seed=SEED)
+        it = iter(loader)
+        first = next(it)
+        st = serial.init_state(first, jax.random.PRNGKey(SEED))
+        batch = first
+        for _ in range(STEPS):
+            st, m = serial.train_step(st, batch)
+            serial_losses.append(float(m["loss"]))
+            batch = next(it)
+        loader.close()
+        np.testing.assert_allclose(losses[i], serial_losses, rtol=1e-4,
+                                   atol=1e-6)
+
+
+def test_early_stop_mask_and_compact_identity(mesh):
+    """Deactivated trials freeze without recompiles; compact() gathers
+    survivors into a smaller rung and their trajectories are unchanged."""
+    data = _mlp_data(n=128)
+    batch = {k: v[:32] for k, v in data.items()}
+    trials = [{"learning_rate": 10 ** -(1 + 0.3 * i)} for i in range(6)]
+
+    def run(do_compact):
+        ft = FusedTrainer(_MLP(), mesh, TrainerConfig(),
+                          [dict(t) for t in trials])
+        st = ft.init_state(batch, default_seed=3)
+        for _ in range(4):
+            st, _ = ft.train_step(st, batch)
+        st = ft.deactivate(st, [0, 1, 4, 5])
+        frozen = {t: s.params for t, s in ft.unstack(st).items()
+                  if t in (0, 1)}
+        if do_compact:
+            st = ft.compact(st)
+            assert ft.rung == 2
+            assert ft.live_trials() == [2, 3]
+        for _ in range(4):
+            st, _ = ft.train_step(st, batch)
+        return ft, st, frozen
+
+    ft_a, st_a, frozen = run(False)
+    ft_b, st_b, _ = run(True)
+    out_a, out_b = ft_a.unstack(st_a), ft_b.unstack(st_b)
+    for tid in (2, 3):
+        _param_trees_close(out_a[tid].params, out_b[tid].params, rtol=2e-5)
+    # dead trials stay frozen through further steps (masked updates)
+    st2, _ = ft_a.train_step(st_a, batch)
+    out2 = ft_a.unstack(st2)
+    for tid in (0, 1):
+        for la, lb in zip(jax.tree.leaves(out2[tid].params),
+                          jax.tree.leaves(frozen[tid])):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        assert int(out2[tid].step) == int(out_a[tid].step)  # step frozen too
+
+
+def test_fused_step_compile_count_bounded_by_trial_ladder(mesh):
+    """One executable per trial-count RUNG (not per trial count, not per
+    sweep): the CompiledCache miss counter is the acceptance surface."""
+    batch = {k: v[:32] for k, v in _mlp_data(n=64).items()}
+    cache = cb.get_compiled_cache()
+    before = cache.miss_count("fused_train_step")
+    ft = FusedTrainer(_MLP(), mesh, TrainerConfig(),
+                      [{"learning_rate": 10 ** -(1 + i % 3)}
+                       for i in range(6)])  # 6 trials -> rung 8
+    st = ft.init_state(batch)
+    for _ in range(2):
+        st, _ = ft.train_step(st, batch)
+    st = ft.deactivate(st, [0, 1, 2, 3])
+    st = ft.compact(st)  # rung 8 -> 2
+    for _ in range(2):
+        st, _ = ft.train_step(st, batch)
+    # shrinking live-trial counts 6 -> 2 share the two rung executables;
+    # masking (deactivate) costs zero compiles
+    new_misses = cache.miss_count("fused_train_step") - before
+    assert new_misses == 2  # rungs {8, 2}; bounded by the trial ladder
+    assert new_misses <= len(cb.TRIAL_LADDER)
+
+
+def test_fused_trainer_rejects_unsupported_configs(mesh):
+    with pytest.raises(ValueError, match="constant learning rates"):
+        FusedTrainer(_MLP(), mesh, TrainerConfig(lr_schedule="cosine"),
+                     [{}, {}])
+    with pytest.raises(ValueError, match="grad_accum"):
+        FusedTrainer(_MLP(), mesh, TrainerConfig(grad_accum=4), [{}, {}])
+    with pytest.raises(ValueError, match="non-fusable keys"):
+        FusedTrainer(_MLP(), mesh, TrainerConfig(),
+                     [{"warmup_steps": 5}])
+
+
+def test_custom_loss_fn_rejects_loss_hparam_overrides(mesh):
+    """A custom loss_fn has no hyperparameter argument, so a per-trial
+    label_smoothing override would be silently discarded — reject it."""
+    def loss_fn(variables, batch):  # pragma: no cover - never reached
+        return 0.0
+
+    with pytest.raises(ValueError, match="custom.*loss_fn"):
+        FusedTrainer(_MLP(), mesh, TrainerConfig(),
+                     [{"label_smoothing": 0.0}, {"label_smoothing": 0.2}],
+                     loss_fn=loss_fn)
+    # without overrides a custom loss_fn is fine
+    FusedTrainer(_MLP(), mesh, TrainerConfig(),
+                 [{"learning_rate": 1e-2}], loss_fn=loss_fn)
+
+
+def test_fused_fit_arrays_accepts_drop_remainder_override(mesh):
+    """Explicit drop_remainder must override the size-derived default, not
+    collide with it (duplicate-kwarg TypeError)."""
+    data = _mlp_data(n=48)
+    ft = FusedTrainer(_MLP(), mesh, TrainerConfig(), [{}, {}])
+    state = fused_fit_arrays(ft, data, batch_size=32, total_steps=2, seed=0,
+                             drop_remainder=False)
+    assert all(int(s.step) == 2 for s in ft.unstack(state).values())
+
+
+def test_hpo_array_metrics_family_is_shared():
+    """The NN and GBDT fused engines must emit through ONE family definition
+    so the two cannot drift into conflicting registrations."""
+    from synapseml_tpu.core.hpo_metrics import HPO_ARRAY_METRICS
+    from synapseml_tpu.gbdt import fused as gbdt_fused
+    from synapseml_tpu.models import fused_trainer as nn_fused
+
+    assert nn_fused._HPO_METRICS is HPO_ARRAY_METRICS
+    assert gbdt_fused._HPO_METRICS is HPO_ARRAY_METRICS
+
+
+def test_hpo_metrics_emitted(mesh):
+    from synapseml_tpu.core.observability import get_registry
+
+    batch = {k: v[:32] for k, v in _mlp_data(n=32).items()}
+    ft = FusedTrainer(_MLP(), mesh, TrainerConfig(), [{}, {"learning_rate": 1e-3}])
+    st = ft.init_state(batch)
+    ft.fit(st, iter([batch, batch]), max_steps=2)
+    text = get_registry().exposition()
+    for series in ("synapseml_hpo_active_trials",
+                   "synapseml_hpo_fused_step_ms",
+                   "synapseml_hpo_trials_per_sec",
+                   "synapseml_hpo_fused_steps_total"):
+        assert series in text
+
+
+# ---------------------------------------------------------------------------
+# fused GBDT sweep
+# ---------------------------------------------------------------------------
+
+def _gbdt_data(n=300, seed=2):
+    rs = np.random.default_rng(seed)
+    X = rs.normal(size=(n, 6)).astype(np.float32)
+    y = ((X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2
+          + 0.1 * rs.normal(size=n)) > 0).astype(np.float32)
+    return X, y
+
+
+def test_fused_gbdt_matches_serial_boosters():
+    """Per-trial fused boosters score identically to serial train_booster
+    runs of the same configs (shared split math, shared binning)."""
+    X, y = _gbdt_data()
+    trials = [
+        {"learning_rate": 0.1, "num_leaves": 15, "num_iterations": 12},
+        {"learning_rate": 0.3, "num_leaves": 15, "lambda_l2": 0.5,
+         "num_iterations": 12},
+        {"learning_rate": 0.05, "num_leaves": 15, "lambda_l1": 0.1,
+         "min_data_in_leaf": 5, "num_iterations": 8},
+    ]
+    fused = fused_train_boosters(X, y, trials, objective="binary",
+                                 max_depth=5, seed=0)
+    for i, t in enumerate(trials):
+        kw = dict(t)
+        n_it = kw.pop("num_iterations")
+        serial = train_booster(X, y, objective="binary", num_iterations=n_it,
+                               max_depth=5, seed=0, **kw)
+        np.testing.assert_allclose(fused[i].raw_score(X), serial.raw_score(X),
+                                   rtol=1e-4, atol=1e-5)
+        assert fused[i].num_iterations == n_it
+
+
+def test_fused_gbdt_iteration_compiles_once_per_rung():
+    X, y = _gbdt_data(n=200)
+    cache = cb.get_compiled_cache()
+    before = cache.miss_count("gbdt_fused_iter")
+    # two sweeps, different hyperparameters, same rung -> ONE executable
+    for lr in (0.1, 0.2):
+        fused_train_boosters(
+            X, y, [{"learning_rate": lr, "num_iterations": 3},
+                   {"learning_rate": lr / 2, "num_iterations": 3},
+                   {"lambda_l2": 1.0, "num_iterations": 3}],
+            objective="binary", max_depth=4, seed=0)
+    assert cache.miss_count("gbdt_fused_iter") - before == 1
+
+
+def test_fused_gbdt_depth_mismatch_rejected():
+    X, y = _gbdt_data(n=100)
+    with pytest.raises(ValueError, match="effective max_depth"):
+        fused_train_boosters(X, y, [{"num_leaves": 4}, {"num_leaves": 63}],
+                             objective="binary", seed=0)
+
+
+# ---------------------------------------------------------------------------
+# TuneHyperparameters / FindBestModel rewiring
+# ---------------------------------------------------------------------------
+
+def test_tune_fused_matches_serial_sweep(tabular_df):
+    space = (HyperparamBuilder()
+             .add_hyperparam("learning_rate",
+                             DiscreteHyperParam([0.05, 0.1, 0.2, 0.3]))
+             .add_hyperparam("lambda_l2", DiscreteHyperParam([0.0, 0.5]))
+             .build())
+
+    def sweep(fuse):
+        return TuneHyperparameters(
+            models=[LightGBMClassifier(num_iterations=10, num_leaves=15)],
+            hyperparam_space=space, search_mode="grid",
+            evaluation_metric="accuracy", seed=7, fuse_trials=fuse,
+        ).fit(tabular_df)
+
+    cache = cb.get_compiled_cache()
+    before = cache.miss_count("gbdt_fused_iter")
+    fused, serial = sweep(True), sweep(False)
+    assert cache.miss_count("gbdt_fused_iter") - before >= 1  # fused ran
+    assert fused.get("best_params") == serial.get("best_params")
+    assert fused.get("best_metric") == pytest.approx(
+        serial.get("best_metric"), abs=1e-9)
+    for (na, ca, va), (nb, cbv, vb) in zip(fused.get("all_results"),
+                                           serial.get("all_results")):
+        assert (na, ca) == (nb, cbv)
+        assert va == pytest.approx(vb, abs=1e-9)
+    out = fused.transform(tabular_df)
+    assert "prediction" in out.columns
+
+
+def test_all_results_record_estimator_identity(tabular_df):
+    """Two candidate estimators: every result names which model its config
+    belonged to (the reference lost this, keeping only (config, metric))."""
+    space_a = {"num_iterations": DiscreteHyperParam([5, 10])}
+    space_b = {"num_iterations": DiscreteHyperParam([8])}
+    best = TuneHyperparameters(
+        models=[LightGBMClassifier(num_leaves=7),
+                LightGBMClassifier(num_leaves=31)],
+        hyperparam_space=[space_a, space_b], search_mode="grid",
+        evaluation_metric="accuracy", seed=1).fit(tabular_df)
+    results = best.get("all_results")
+    assert len(results) == 3
+    names = [r[0] for r in results]
+    assert names == ["LightGBMClassifier[0]", "LightGBMClassifier[0]",
+                     "LightGBMClassifier[1]"]
+    for name, cfg, metric in results:
+        assert isinstance(cfg, dict) and np.isfinite(metric)
+
+
+def test_tune_architecture_changing_params_fall_back_serial(tabular_df):
+    """max_bin changes binning (architecture): configs split into distinct
+    signatures and still sweep correctly via grouping/serial."""
+    space = {"max_bin": DiscreteHyperParam([15, 63]),
+             "learning_rate": DiscreteHyperParam([0.1, 0.3])}
+    best = TuneHyperparameters(
+        models=[LightGBMClassifier(num_iterations=8)], hyperparam_space=space,
+        search_mode="grid", evaluation_metric="accuracy", seed=3).fit(tabular_df)
+    assert best.get("best_metric") > 0.7
+    assert len(best.get("all_results")) == 4
+
+
+def test_tune_bad_candidate_does_not_sink_fused_sweep(tabular_df):
+    """A candidate whose config cannot even be applied records __error__ +
+    NaN while the fusable rest of the sweep still trains as one array."""
+    best = TuneHyperparameters(
+        models=[LightGBMClassifier(num_leaves=15), _FailingEstimator()],
+        hyperparam_space=[
+            {"num_iterations": DiscreteHyperParam([5, 9])},
+            {"no_such_param": DiscreteHyperParam([1])},
+        ],
+        search_mode="grid", evaluation_metric="accuracy",
+        seed=0).fit(tabular_df)
+    results = best.get("all_results")
+    assert len(results) == 3
+    bad = [r for r in results if not np.isfinite(r[2])]
+    assert len(bad) == 1 and "__error__" in bad[0][1]
+    assert bad[0][0] == "_FailingEstimator[1]"
+    assert best.get("best_metric") > 0.7
+
+
+class _FailingEstimator(Estimator):
+    def _fit(self, df):
+        raise RuntimeError("deliberately broken candidate")
+
+
+class _NoPredictionModel(Model):
+    out_col = Param("out_col", "output column", default="weird_scores")
+
+    def _transform(self, df):
+        return df.with_column(self.get("out_col"),
+                              np.zeros(df.count(), np.float64))
+
+
+class _DeclaredColModel(Model):
+    prediction_col = Param("prediction_col", "prediction output column",
+                           default="score")
+
+    def _transform(self, df):
+        return df.with_column(self.get("prediction_col"),
+                              np.asarray(df.collect_column("label"),
+                                         np.float64))
+
+
+def test_evaluate_prefers_declared_prediction_col(tabular_df):
+    v = _evaluate(_DeclaredColModel(), tabular_df, "accuracy", "label")
+    assert v == 1.0  # scored its own label column under the declared name
+
+
+def test_evaluate_errors_name_available_columns(tabular_df):
+    with pytest.raises(ValueError) as err:
+        _evaluate(_NoPredictionModel(), tabular_df, "accuracy", "label")
+    msg = str(err.value)
+    assert "weird_scores" in msg and "prediction_col" in msg
+
+
+def test_find_best_model_contains_failures_and_fuses(tabular_df):
+    cache = cb.get_compiled_cache()
+    before = cache.miss_count("gbdt_fused_iter")
+    res = FindBestModel(models=[
+        LightGBMClassifier(num_iterations=3, num_leaves=15),
+        LightGBMClassifier(num_iterations=25, num_leaves=15),
+        _FailingEstimator(),
+    ]).fit(tabular_df)
+    assert cache.miss_count("gbdt_fused_iter") - before >= 1  # pair fused
+    metrics = res.get("all_model_metrics")
+    assert len(metrics) == 3
+    assert sum(1 for _n, v in metrics if np.isfinite(v)) == 2
+    failed = [n for n, v in metrics if not np.isfinite(v)]
+    assert failed == ["_FailingEstimator[2]"]
+    # uniform 'ClassName[i]' labels keep duplicate-class candidates distinct
+    assert [n for n, v in metrics if np.isfinite(v)] == [
+        "LightGBMClassifier[0]", "LightGBMClassifier[1]"]
+    assert res.get("best_metric") >= 0.8
+
+
+def test_find_best_model_all_failures_raise(tabular_df):
+    with pytest.raises(RuntimeError, match="every candidate failed"):
+        FindBestModel(models=[_FailingEstimator(), _FailingEstimator()]
+                      ).fit(tabular_df)
+
+
+def test_fusable_param_names_and_fused_range():
+    names = fusable_param_names(LightGBMClassifier)
+    assert "learning_rate" in names and "num_leaves" in names
+    assert fusable_param_names("LightGBMRegressor") == \
+        fusable_param_names(LightGBMRegressor())
+    space = DefaultHyperparams.fused_range("LightGBMClassifier")
+    assert set(space) <= set(names)
+    # name, class, and instance are equivalent (class used to resolve to
+    # the metaclass name and raise)
+    assert set(DefaultHyperparams.fused_range(LightGBMClassifier)) \
+        == set(DefaultHyperparams.fused_range(LightGBMClassifier())) \
+        == set(space)
+    with pytest.raises(ValueError, match="no fused training path"):
+        DefaultHyperparams.fused_range("VowpalWabbitClassifier")
+    with pytest.raises(ValueError, match="VowpalWabbitClassifier"):
+        from synapseml_tpu.vw import VowpalWabbitClassifier
+        DefaultHyperparams.fused_range(VowpalWabbitClassifier)
+
+
+def test_fused_plan_signatures():
+    a = LightGBMClassifier(num_iterations=5, num_leaves=15)
+    b = LightGBMClassifier(num_iterations=50, num_leaves=15)
+    assert a._fused_plan({}) == b._fused_plan({})  # scalars don't split
+    assert a._fused_plan({"max_bin": 31}) != b._fused_plan({})  # structure does
+    assert a._fused_plan({"boosting_type": "dart"}) is None
+    assert a._fused_plan({"bagging_fraction": 0.5, "bagging_freq": 1}) is None
+    assert LightGBMClassifier(
+        categorical_slot_indexes=[0])._fused_plan({}) is None
