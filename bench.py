@@ -87,6 +87,11 @@ CONFIGS = [
     # training array over the same 8-config space, both arms in-round from
     # cold compile caches (the N-compiles-vs-one asymmetry IS the metric)
     ("hpo-fused", "hpo_fused", 300, 300),
+    # bulk-scoring A/B: in-memory transform vs streamed transform_source
+    # over a multi-shard jsonl corpus, both arms end-to-end (files in,
+    # scored files out) from cold compile caches, plus a simulated-2-host
+    # scan; host-driven, fine on the CPU fallback
+    ("bulk-scoring", "bulk_scoring", 240, 240),
     ("flagship", None, 420, 360),
     ("vit", "vit_finetune", 450, 300),
 ]

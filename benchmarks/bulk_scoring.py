@@ -1,0 +1,282 @@
+"""Bulk scoring: in-memory ``transform`` vs streamed ``transform_source``.
+
+Writes a multi-shard synthetic jsonl corpus (the stand-in for a >RAM
+dataset — the streamed path's in-flight bytes stay O(queued shards) no
+matter how large this is scaled), fits one LightGBM classifier, then scores
+the WHOLE corpus two ways in the SAME round, each arm end-to-end from files
+on disk to scored output on disk and each from a cold compile cache:
+
+  (a) in-memory — ``io.files.read_jsonl`` materializes every row, ONE
+                  ``model.transform`` over the full DataFrame (the exact
+                  shape-polymorphic jit path — no padding), ``write_jsonl``
+                  of the scored frame: the all-in-RAM baseline, paying the
+                  full parse before the first score;
+  (b) streamed  — ``ShardedSource.jsonl`` + ``JsonlSink`` through
+                  ``model.transform_source``: shard reads and sink writes
+                  overlap device compute on the bounded-queue pipeline,
+                  batches ride the bucket ladder through the shared
+                  ``CompiledCache`` (compile count <= ladder size).
+
+Then the distributed half: the same scan as two simulated hosts (two
+threads, ``host_index`` 0/1 of ``host_count=2``, one shared sink directory
+— the real multi-host layout) vs the 1-host wall clock.
+
+Reports rows/sec for both arms: one COLD streamed run first records the
+compile count (<= ladder bound — on a real corpus of millions of rows that
+one-time trace amortizes to nothing, so it stays out of the throughput
+wall), then min-of-3 warm walls per arm, interleaved — the llama_decode
+discipline; host-side json work makes single runs noisy on a shared box.
+Also: peak in-flight queue bytes vs the memory budget (dataset >>
+budget: the bounded-memory claim), an output-equality check (streamed rows
+== in-memory rows, id for id), and the 2-host wall. Acceptance bar (ISSUE
+8): streamed rows/sec >= 0.9x in-memory on CPU with compile count <=
+ladder size. Prints one JSON line.
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+N_SHARDS = 8
+ROWS_PER_SHARD = 8192
+N_FEATURES = 16
+N_TRAIN = 4096
+BATCH_ROWS = 1024  # the top default-ladder rung: 64 full batches
+NUM_ITERATIONS = 256  # a production-sized forest: device compute carries
+# real weight, so the streamed arm's read/compute/write overlap is the
+# thing being measured — jax releases the GIL during forest execution, so
+# shard parse and sink writes proceed under it (a small toy forest is all
+# GIL-bound json on both arms and measures nothing but thread coordination)
+# a scoring backfill emits ids + scores, not the raw features it read —
+# both arms project to the same output schema
+OUT_COLUMNS = ["id", "prediction", "probability"]
+# the configured memory budget the streamed arm must hold: far below the
+# materialized dataset (read_jsonl's object rows cost several x this on the
+# in-memory arm)
+MEMORY_BUDGET_BYTES = 8 << 20
+
+
+def _write_corpus(directory: str) -> tuple[int, int, np.ndarray]:
+    """One jsonl file per shard; rows carry a global ``id`` so the
+    equality check is exact. Returns (rows, bytes, true weight vector)."""
+    rs = np.random.default_rng(0)
+    w = rs.normal(size=N_FEATURES)
+    i, total = 0, 0
+    for s in range(N_SHARDS):
+        p = os.path.join(directory, f"part-{s:03d}.jsonl")
+        with open(p, "w") as f:
+            X = rs.normal(size=(ROWS_PER_SHARD, N_FEATURES))
+            for j in range(ROWS_PER_SHARD):
+                f.write(json.dumps({
+                    "features": [round(float(v), 5) for v in X[j]],
+                    "id": i}) + "\n")
+                i += 1
+        total += os.path.getsize(p)
+    return i, total, w
+
+
+def _fit_model(w: np.ndarray):
+    from synapseml_tpu.core.dataframe import DataFrame
+    from synapseml_tpu.gbdt import LightGBMClassifier
+
+    rs = np.random.default_rng(1)
+    X = rs.normal(size=(N_TRAIN, N_FEATURES)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int64)
+    df = DataFrame([{"features": X, "labels": y}])
+    return LightGBMClassifier(num_iterations=NUM_ITERATIONS, num_leaves=15,
+                              label_col="labels").fit(df)
+
+
+def _cold_cache(model=None):
+    """Cold-start compile state for ONE arm trial: the shared CompiledCache
+    (streamed arm's bucketed jits) AND the booster's private polymorphic
+    ``_predict_cache`` (the in-memory arm's beyond-ladder path) — otherwise
+    min-of-3 hands the in-memory arm warm executables the streamed arm
+    re-pays every trial."""
+    from synapseml_tpu.core.batching import (get_compiled_cache,
+                                             reset_compiled_cache)
+
+    reset_compiled_cache()
+    if model is not None:
+        model.get_booster()._predict_cache.clear()
+    c = get_compiled_cache()
+    return c.miss_count("gbdt_predict") + c.miss_count("gbdt_predict_scored")
+
+
+def _run_in_memory(model, directory: str, out_dir: str,
+                   n_rows: int) -> dict:
+    from synapseml_tpu.core.dataframe import DataFrame
+    from synapseml_tpu.io.files import read_jsonl, write_jsonl
+
+    os.makedirs(out_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    df = read_jsonl(os.path.join(directory, "*.jsonl"))
+    load_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    scored = model.transform(df)
+    score_s = time.perf_counter() - t1
+    t2 = time.perf_counter()
+    part = scored.collect()
+    write_jsonl(DataFrame([{c: part[c] for c in OUT_COLUMNS}]),
+                os.path.join(out_dir, "scored.jsonl"))
+    write_s = time.perf_counter() - t2
+    wall = time.perf_counter() - t0
+    return {"wall_s": round(wall, 3), "load_s": round(load_s, 3),
+            "score_s": round(score_s, 3), "write_s": round(write_s, 3),
+            "rows_per_sec": round(n_rows / wall, 1),
+            "_rows": {"id": np.asarray(part["id"]),
+                      "prediction": np.asarray(part["prediction"])}}
+
+
+def _sink(out_dir: str):
+    from synapseml_tpu.scoring import JsonlSink
+
+    return JsonlSink(out_dir, columns=OUT_COLUMNS)
+
+
+def _run_streamed(model, directory: str, out_dir: str,
+                  cold: bool = False) -> dict:
+    from synapseml_tpu.core.batching import get_compiled_cache
+    from synapseml_tpu.data import ShardedSource
+    from synapseml_tpu.scoring import plan_scan
+
+    misses0 = _cold_cache(model) if cold else 0
+    src = ShardedSource.jsonl(os.path.join(directory, "*.jsonl"))
+    plan = plan_scan(src, BATCH_ROWS, host_index=0, host_count=1)
+    sink = _sink(out_dir)
+    report = model.transform_source(src, sink, batch_rows=BATCH_ROWS,
+                                    host_index=0, host_count=1)
+    c = get_compiled_cache()
+    compiles = int(c.miss_count("gbdt_predict")
+                   + c.miss_count("gbdt_predict_scored") - misses0) \
+        if cold else None
+    rows = [json.loads(ln) for p in sink.part_files()
+            for ln in open(p) if ln.strip()]
+    return {"wall_s": round(report.wall_s, 3),
+            "rows_per_sec": round(report.rows_per_sec, 1),
+            "rows_written": report.rows_written,
+            "batches": report.batches,
+            "padded_rows": report.rows_padded,
+            "shards": report.shards_done,
+            "complete": report.complete,
+            "peak_inflight_bytes": report.peak_inflight_bytes,
+            "gbdt_predict_compiles": compiles,
+            "ladder_bound": len(plan.buckets),
+            "_rows": {"id": np.asarray([r["id"] for r in rows]),
+                      "prediction": np.asarray([r["prediction"]
+                                                for r in rows])}}
+
+
+def _run_two_hosts(model, directory: str, out_dir: str) -> dict:
+    """The same scan as two simulated hosts sharing one sink directory —
+    two threads so shard reads/writes genuinely interleave (on one CPU the
+    compute serializes under the GIL/device; the TPU upside is real
+    per-host devices)."""
+    from synapseml_tpu.data import ShardedSource
+
+    _cold_cache(model)
+    src = ShardedSource.jsonl(os.path.join(directory, "*.jsonl"))
+    reports: dict[int, object] = {}
+    errors: list = []
+
+    def host(idx: int) -> None:
+        try:
+            reports[idx] = model.transform_source(
+                src, _sink(out_dir), batch_rows=BATCH_ROWS,
+                host_index=idx, host_count=2)
+        except Exception as e:  # noqa: BLE001 — surfaced in the record
+            errors.append(repr(e))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=host, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        return {"error": errors[0], "wall_s": round(wall, 3)}
+    total = sum(r.rows_written for r in reports.values())
+    return {"wall_s": round(wall, 3),
+            "rows_per_sec": round(total / wall, 1) if wall > 0 else 0.0,
+            "rows_written": total,
+            "complete": any(r.complete for r in reports.values()),
+            "per_host_shards": {i: reports[i].shards_done for i in reports}}
+
+
+def run(jax, platform, n_chips):
+    directory = tempfile.mkdtemp(prefix="synapseml_bulkscore_")
+    try:
+        data_dir = os.path.join(directory, "data")
+        os.makedirs(data_dir)
+        n_rows, n_bytes, w = _write_corpus(data_dir)
+        model = _fit_model(w)
+        # one cold streamed run: the compile-count-vs-ladder record (and
+        # the warmup for both executables' shared forest tensors)
+        cold = _run_streamed(model, data_dir,
+                             os.path.join(directory, "out_cold"), cold=True)
+        # then min-of-3 WARM walls per arm, arms interleaved so a load
+        # spike on the shared box can't bias one side; each trial scans
+        # into a fresh sink dir
+        in_mem = streamed = None
+        for t in range(3):
+            im = _run_in_memory(model, data_dir,
+                                os.path.join(directory, f"out_mem{t}"),
+                                n_rows)
+            st = _run_streamed(model, data_dir,
+                               os.path.join(directory, f"out_stream{t}"))
+            if in_mem is None or im["wall_s"] < in_mem["wall_s"]:
+                in_mem = im
+            if streamed is None or st["wall_s"] < streamed["wall_s"]:
+                streamed = st
+        streamed["gbdt_predict_compiles"] = cold["gbdt_predict_compiles"]
+        streamed["cold_wall_s"] = cold["wall_s"]
+        two_host = _run_two_hosts(model, data_dir,
+                                  os.path.join(directory, "out_2host"))
+
+        a, b = in_mem.pop("_rows"), streamed.pop("_rows")
+        oa, ob = np.argsort(a["id"]), np.argsort(b["id"])
+        outputs_equal = bool(
+            a["id"].shape == b["id"].shape
+            and np.array_equal(a["id"][oa], b["id"][ob])
+            and np.allclose(a["prediction"][oa], b["prediction"][ob]))
+    finally:
+        shutil.rmtree(directory, ignore_errors=True)
+    return {
+        "metric": "bulk scoring streamed rows/sec "
+                  "(transform_source vs in-memory transform)",
+        "value": streamed["rows_per_sec"], "unit": "rows/sec",
+        "lower_is_better": False, "platform": platform,
+        "dataset_rows": n_rows, "dataset_bytes": n_bytes,
+        "memory_budget_bytes": MEMORY_BUDGET_BYTES,
+        "streamed": streamed, "in_memory_baseline": in_mem,
+        "two_host_simulated": two_host,
+        "throughput_vs_in_memory": round(
+            streamed["rows_per_sec"] / in_mem["rows_per_sec"], 3)
+        if in_mem["rows_per_sec"] else None,
+        "compile_count_within_ladder":
+            streamed["gbdt_predict_compiles"] <= streamed["ladder_bound"],
+        "peak_inflight_within_budget":
+            streamed["peak_inflight_bytes"] <= MEMORY_BUDGET_BYTES,
+        "outputs_equal": outputs_equal,
+    }
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    print(json.dumps(run(jax, platform, n_chips)))
+
+
+if __name__ == "__main__":
+    main()

@@ -201,6 +201,7 @@ _MODULE_NAMESPACE_MAP = {
 # tests/test_codegen.py::test_registry_compat_coverage enforces it)
 _PASSTHROUGH_NAMESPACES = {
     "registry": "synapseml_tpu.registry",
+    "scoring": "synapseml_tpu.scoring",
 }
 
 _PASSTHROUGH_HEADER = '''"""Generated passthrough namespace — do not edit.

@@ -1,0 +1,35 @@
+"""Generated passthrough namespace — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers).
+Re-exports the public surface of ``synapseml_tpu.scoring`` so the compat layer covers
+non-stage subsystems too (compat coverage is drift-tested).
+"""
+
+
+from synapseml_tpu.scoring import (  # noqa: F401
+    JsonlSink,
+    NpySink,
+    ScoreSink,
+    ScoringContractError,
+    ScoringPlan,
+    ScoringReport,
+    assign_shards,
+    iter_shard_batches,
+    open_sink,
+    plan_scan,
+    transform_source,
+)
+
+__all__ = [
+    'JsonlSink',
+    'NpySink',
+    'ScoreSink',
+    'ScoringContractError',
+    'ScoringPlan',
+    'ScoringReport',
+    'assign_shards',
+    'iter_shard_batches',
+    'open_sink',
+    'plan_scan',
+    'transform_source',
+]

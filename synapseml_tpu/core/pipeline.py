@@ -60,6 +60,18 @@ class Transformer(PipelineStage):
     def transform(self, df: DataFrame) -> DataFrame:
         return self.log_verb("transform", self._transform, df)
 
+    def transform_source(self, source, sink, **opts):
+        """Bulk-score an out-of-core ``data.ShardedSource`` into an
+        exactly-once sharded sink (``scoring.JsonlSink``/``NpySink``) — the
+        Spark transform-over-arbitrarily-large-DataFrames role. Streams
+        bucket-ladder batches through this transformer in bounded memory;
+        kill/resume emits each input row exactly once. See
+        :func:`synapseml_tpu.scoring.transform_source` for options and
+        ``docs/SCORING.md`` for the contract."""
+        from ..scoring.runner import transform_source as _transform_source
+
+        return _transform_source(self, source, sink, **opts)
+
     def __call__(self, df: DataFrame) -> DataFrame:
         return self.transform(df)
 

@@ -99,15 +99,10 @@ class DataLoader:
         self.bucketer = bucketer or cb.default_bucketer()
         self.place_fn = place_fn
         self.columns = list(columns) if columns else None
-        if host_index is None or host_count is None:
-            import jax
+        from .source import resolve_host
 
-            host_index = jax.process_index() if host_index is None else host_index
-            host_count = jax.process_count() if host_count is None else host_count
-        if not 0 <= host_index < host_count:
-            raise ValueError(f"host_index {host_index} outside "
-                             f"[0, {host_count})")
-        self.host_index, self.host_count = int(host_index), int(host_count)
+        self.host_index, self.host_count = resolve_host(host_index,
+                                                        host_count)
 
         st = state.copy() if state is not None else IteratorState(seed=int(seed))
         if state is not None and st.seed != int(seed):

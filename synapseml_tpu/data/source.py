@@ -39,7 +39,24 @@ import numpy as np
 
 from ..core.resilience import RetryPolicy, resilience_measures
 
-__all__ = ["Shard", "ShardedSource", "MemorySource", "default_read_retry"]
+__all__ = ["Shard", "ShardedSource", "MemorySource", "default_read_retry",
+           "resolve_host"]
+
+
+def resolve_host(host_index: int | None,
+                 host_count: int | None) -> tuple[int, int]:
+    """The ONE place per-host striding resolves its jax process-topology
+    defaults + validation — shared by ``DataLoader`` and the scoring
+    planner so the two planes' shard assignment can never drift."""
+    if host_index is None or host_count is None:
+        import jax
+
+        host_index = jax.process_index() if host_index is None else host_index
+        host_count = jax.process_count() if host_count is None else host_count
+    host_index, host_count = int(host_index), int(host_count)
+    if not 0 <= host_index < host_count:
+        raise ValueError(f"host_index {host_index} outside [0, {host_count})")
+    return host_index, host_count
 
 DEFAULT_SHARD_BYTES = 64 << 20
 DEFAULT_SHARD_ROWS = 65536
@@ -168,14 +185,65 @@ class ShardedSource:
         for s in self._shards:
             yield s, self.read_shard(s)
 
+    def estimate_rows(self, sample_bytes: int = 1 << 20,
+                      read_fallback: bool = True) -> int:
+        """Cheap row-count estimate — progress %/ETA without a full
+        pre-scan. Row-range shard kinds (npy/memory/image) answer exactly
+        from shard metadata; byte-range kinds (jsonl/csv) sample up to
+        ``sample_bytes`` from the first shard's file to get a bytes/row
+        ratio and scale it over the total sharded byte count. Unknown custom
+        readers fall back to reading ONE shard and scaling by shard count —
+        ``read_fallback=False`` raises instead (the scoring runner passes
+        it: a progress gauge must not cost a full shard read on remote
+        storage). Memoized; an exact ``total_rows`` computed earlier is
+        preferred."""
+        if hasattr(self, "_total_rows"):
+            return self._total_rows
+        if hasattr(self, "_estimated_rows"):
+            return self._estimated_rows
+        if all(s.kind in ("npy", "memory") for s in self._shards):
+            # start/stop are row offsets: exact.
+            return self.total_rows()
+        if all(s.kind in ("npy", "memory", "image") for s in self._shards):
+            # image start/stop are file-LISTING offsets: one row per file
+            # counted without decoding, so undecodable files the reader
+            # drops (drop_invalid) overcount slightly — fine for an
+            # estimate; exactness is total_rows()'s read pass
+            est = sum(s.stop - s.start for s in self._shards)
+            self._estimated_rows = est
+            return est
+        if all(s.kind in ("jsonl", "csv") for s in self._shards):
+            first = self._shards[0]
+            with open(first.path, "rb") as f:
+                f.seek(first.start)
+                buf = f.read(max(int(sample_bytes), 1))
+            cut = buf.rfind(b"\n")
+            sample = buf if cut < 0 else buf[:cut + 1]
+            n_lines = max(sum(1 for ln in sample.splitlines() if ln.strip()),
+                          1)
+            bytes_per_row = max(len(sample), 1) / n_lines
+            total_bytes = sum(s.stop - s.start for s in self._shards)
+            est = max(int(round(total_bytes / bytes_per_row)), 1)
+        else:
+            if not read_fallback:
+                raise ValueError(
+                    "estimate_rows for custom shard kinds needs a full "
+                    "shard read; call with read_fallback=True to allow it")
+            est = _n_rows(self.read_shard(self._shards[0])) * self.num_shards
+        self._estimated_rows = est
+        return est
+
     def total_rows(self) -> int:
-        """Total row count. Row-range shard kinds (npy/memory) answer from
-        shard metadata alone; byte-range formats (jsonl/csv) need ONE full
-        read pass — memoized, but on a huge remote corpus prefer tracking
-        counts as the loader discovers them (``IteratorState.shard_counts``)
-        instead of calling this up front."""
+        """Total EXACT row count. Row-range shard kinds (npy/memory)
+        answer from shard metadata alone; everything else — including
+        image dirs, whose reader drops undecodable files so the listing
+        count can overshoot — needs ONE full read pass. Memoized, but on a
+        huge remote corpus prefer tracking counts as the loader discovers
+        them (``IteratorState.shard_counts``) instead of calling this up
+        front; for a cheap approximation use :meth:`estimate_rows`."""
         if not hasattr(self, "_total_rows"):
-            if all(s.kind in ("npy", "memory") for s in self._shards):
+            if all(s.kind in ("npy", "memory")
+                   for s in self._shards):
                 self._total_rows = sum(s.stop - s.start for s in self._shards)
             else:
                 self._total_rows = sum(
@@ -223,8 +291,15 @@ class ShardedSource:
                              "JSONL files are all empty)")
 
         def read(shard: Shard) -> dict:
-            rows = [_json.loads(ln) for ln in _read_lines_in_range(
-                shard.path, shard.start, shard.stop)]
+            from ..io.files import loads_jsonl_line
+
+            # line numbers are unknowable inside a byte range without a
+            # scan from byte 0 — the error names the shard's byte window
+            # plus the line's ordinal within it instead
+            rows = [loads_jsonl_line(ln, f"{shard.path}[{shard.start}:"
+                                     f"{shard.stop}] line", k + 1)
+                    for k, ln in enumerate(_read_lines_in_range(
+                        shard.path, shard.start, shard.stop))]
             return _columnar(rows)
 
         return cls(shards, read, retry_policy, name="jsonl")

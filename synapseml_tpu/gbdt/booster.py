@@ -102,66 +102,106 @@ class TpuBooster:
         self._predict_cache = {}
 
     # ---------------- prediction ----------------
-    def _raw_fn(self, num_iters: int, bucket: int | None) -> Callable:
+    def _make_raw(self, num_iters: int) -> Callable:
+        """The traceable raw-margin forest function for ``num_iters``
+        trees (closure over device-resident tree tensors)."""
+        feat = jnp.asarray(self.feature[:num_iters])
+        thr = jnp.asarray(self.threshold_value[:num_iters])
+        val = jnp.asarray(self.leaf_value[:num_iters])
+        cm = (None if self.cat_mask is None
+              else jnp.asarray(self.cat_mask[:num_iters]))
+        init = jnp.asarray(self.init_score)
+        depth = self.max_depth
+        K = self.num_model_out
+
+        avg = 1.0 / num_iters if self.average_output else 1.0
+
+        def raw(x):
+            outs = [T.predict_raw_forest(
+                x, feat[:, k], thr[:, k], val[:, k], depth,
+                cat_masks=None if cm is None else cm[:, k])
+                    for k in range(K)]
+            return jnp.stack(outs, axis=1) * avg + init[None, :]
+
+        return raw
+
+    def _raw_fn(self, num_iters: int, bucket: int | None,
+                scored: bool = False) -> Callable:
         """Scoring executable per (iteration count, row bucket). Ladder
         buckets go through the process-wide CompiledCache (serving-sized
         request streams reuse ladder-many compiled forests instead of
         retracing per batch size); ``bucket=None`` (beyond-ladder offline
         scans) keeps ONE shape-polymorphic jit in the per-instance
         ``_predict_cache`` — arbitrary large batch sizes must not churn the
-        shared LRU and evict other stages' warmed serving executables."""
+        shared LRU and evict other stages' warmed serving executables.
+        ``scored=True`` fuses the objective transform into the SAME
+        program, returning ``(raw, prob)`` in one dispatch + one transfer —
+        the classifier serving/bulk-scoring hot path."""
         def build():
-            feat = jnp.asarray(self.feature[:num_iters])
-            thr = jnp.asarray(self.threshold_value[:num_iters])
-            val = jnp.asarray(self.leaf_value[:num_iters])
-            cm = (None if self.cat_mask is None
-                  else jnp.asarray(self.cat_mask[:num_iters]))
-            init = jnp.asarray(self.init_score)
-            depth = self.max_depth
-            K = self.num_model_out
+            raw = self._make_raw(num_iters)
+            if not scored:
+                return jax.jit(raw)
+            o = obj.get_objective(self.objective,
+                                  num_class=self.num_model_out)
 
-            avg = 1.0 / num_iters if self.average_output else 1.0
+            def raw_and_prob(x):
+                r = raw(x)
+                return r, o.transform(r)
 
-            def raw(x):
-                outs = [T.predict_raw_forest(
-                    x, feat[:, k], thr[:, k], val[:, k], depth,
-                    cat_masks=None if cm is None else cm[:, k])
-                        for k in range(K)]
-                return jnp.stack(outs, axis=1) * avg + init[None, :]
-
-            return jax.jit(raw)
+            return jax.jit(raw_and_prob)
 
         if bucket is None:
-            key = ("raw", num_iters)
+            key = ("scored" if scored else "raw", num_iters)
             if key not in self._predict_cache:
                 self._predict_cache[key] = build()
             return self._predict_cache[key]
         return cb.get_compiled_cache().get(
-            "gbdt_predict", (num_iters, bucket, self.num_features), build,
+            "gbdt_predict_scored" if scored else "gbdt_predict",
+            (num_iters, bucket, self.num_features), build,
             instance=cb.instance_token(self), dtype="float32")
 
-    def raw_score(self, features: np.ndarray, num_iterations: int | None = None) -> np.ndarray:
-        """(N, K) raw margin scores. Serving-sized batches pad up to the
-        bucket ladder (bounded compiles under a variable request stream);
-        batches past the ladder keep their exact shape — a 1M-row training
-        scan must not pad toward the next pow-2."""
+    def _dispatch_score(self, features: np.ndarray,
+                        num_iterations: int | None, scored: bool) -> tuple:
+        """The ONE cast/clamp/bucket/pad/unpad dispatch both scoring entry
+        points share. Serving-sized batches pad up to the bucket ladder
+        (bounded compiles under a variable request stream); batches past
+        the ladder keep their exact shape — a 1M-row training scan must not
+        pad toward the next pow-2."""
         x = np.asarray(features, dtype=np.float32)
         n_it = num_iterations or self.best_iteration or self.num_iterations
         n_it = min(n_it, self.num_iterations)
         n = x.shape[0]
         bucketer = cb.default_bucketer()
         if n > bucketer.max_bucket:
-            return np.asarray(self._raw_fn(n_it, None)(jnp.asarray(x)))
-        bucket = bucketer.bucket_for(n)
-        out = self._raw_fn(n_it, bucket)(jnp.asarray(cb.pad_rows(x, bucket)))
-        return cb.unpad_rows(out, n)
+            bucket, padded = None, x
+        else:
+            bucket = bucketer.bucket_for(n)
+            padded = cb.pad_rows(x, bucket)
+        out = self._raw_fn(n_it, bucket, scored=scored)(jnp.asarray(padded))
+        outs = out if isinstance(out, tuple) else (out,)
+        return tuple(cb.unpad_rows(np.asarray(o), n) for o in outs)
+
+    def raw_score(self, features: np.ndarray, num_iterations: int | None = None) -> np.ndarray:
+        """(N, K) raw margin scores (see ``_dispatch_score`` for the
+        bucket-ladder discipline)."""
+        return self._dispatch_score(features, num_iterations, scored=False)[0]
 
     def predict(self, features: np.ndarray, num_iterations: int | None = None) -> np.ndarray:
         """Objective-transformed predictions: probabilities for binary
         (N,), softmax (N, K) for multiclass, raw values for regression."""
-        s = self.raw_score(features, num_iterations)
-        o = obj.get_objective(self.objective, num_class=self.num_model_out)
-        return np.asarray(o.transform(jnp.asarray(s)))
+        return self.raw_score_and_predict(features, num_iterations)[1]
+
+    def raw_score_and_predict(self, features: np.ndarray,
+                              num_iterations: int | None = None
+                              ) -> tuple[np.ndarray, np.ndarray]:
+        """``(raw margins, objective-transformed predictions)`` from ONE
+        fused executable — one forest traversal, one dispatch, one
+        device→host transfer. The classifier transform (every
+        serving/bulk-scoring batch) needs both; calling ``raw_score`` then
+        ``predict`` walked the forest twice."""
+        raw, prob = self._dispatch_score(features, num_iterations,
+                                         scored=True)
+        return raw, prob
 
     def predict_contrib(self, features: np.ndarray) -> np.ndarray:
         """(N, K, F+1) exact TreeSHAP contributions + bias column (reference

@@ -12,14 +12,19 @@ from __future__ import annotations
 
 import glob as _glob
 import io as _io
+import json as _json
 import os
+import struct as _struct
+import threading as _threading
 
 import numpy as np
 
 from ..core.dataframe import DataFrame
 
 __all__ = ["read_binary_files", "read_image_files", "read_csv", "write_csv",
-           "read_jsonl", "write_jsonl", "resolve_input_paths"]
+           "read_jsonl", "write_jsonl", "resolve_input_paths",
+           "json_default", "jsonl_writer", "npy_writer", "write_npy",
+           "StreamedJsonlWriter", "StreamedNpyWriter"]
 
 _IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tif", ".tiff", ".webp")
 
@@ -190,18 +195,20 @@ def read_jsonl(path: str, num_partitions: int | None = None,
     Heterogeneous records are unioned over ALL keys seen in the file
     (missing fields become None) — JSONL rows rarely share an exact schema.
     ``max_rows`` caps the TOTAL row count and stops scanning (parsing AND
-    file reads) the moment the budget is filled.
+    file reads) the moment the budget is filled. A malformed record raises
+    ``ValueError`` naming the file and line number (a bare
+    ``json.JSONDecodeError`` pointed at nothing when the glob matched
+    thousands of part files).
     """
-    import json as _json
 
     def load(p, budget):
         rows = []
         with open(p) as f:
-            for line in f:
+            for lineno, line in enumerate(f, 1):
                 if budget is not None and len(rows) >= budget:
                     break
                 if line.strip():
-                    rows.append(_json.loads(line))
+                    rows.append(loads_jsonl_line(line, p, lineno))
         if not rows:
             return None
         keys: list = []
@@ -213,23 +220,204 @@ def read_jsonl(path: str, num_partitions: int | None = None,
 
 
 def write_jsonl(df: DataFrame, path: str) -> str:
-    """DataFrame -> one JSON-lines file (numpy scalars/arrays to plain JSON)."""
-    import json as _json
-
-    def default(o):
-        if isinstance(o, np.ndarray):
-            return o.tolist()
-        if isinstance(o, np.generic):
-            return o.item()
-        if isinstance(o, bytes):
-            return o.decode("utf-8", "replace")
-        raise TypeError(f"not JSON-serializable: {type(o)}")
-
-    with open(path, "w") as f:
+    """DataFrame -> one JSON-lines file (numpy scalars/arrays to plain JSON).
+    Atomic: readers see the previous file or the complete new one, never a
+    torn write (the streamed-writer temp + rename discipline)."""
+    with jsonl_writer(path) as w:
         for part in df.partitions:
-            cols = list(part.keys())
-            n = len(next(iter(part.values()))) if cols else 0
-            for i in range(n):
-                f.write(_json.dumps({c: part[c][i] for c in cols},
-                                    default=default) + "\n")
+            n = len(next(iter(part.values()))) if part else 0
+            w.write_columns(part, n)
+    return path
+
+
+def loads_jsonl_line(line: str | bytes, path: str, lineno: int) -> dict:
+    """``json.loads`` for one JSONL record that, on a malformed line, names
+    the file and line instead of raising a bare ``JSONDecodeError`` (shared
+    with the streaming plane's byte-range reader)."""
+    try:
+        return _json.loads(line)
+    except _json.JSONDecodeError as e:
+        snippet = line if isinstance(line, str) else \
+            line.decode("utf-8", "replace")
+        snippet = snippet.strip()
+        if len(snippet) > 120:
+            snippet = snippet[:120] + "..."
+        raise ValueError(
+            f"{path}:{lineno}: malformed JSONL record ({e.msg} at column "
+            f"{e.colno}): {snippet!r}") from e
+
+
+# ---------------------------------------------------------------------------
+# streamed atomic writers (shared with the scoring sink — scoring/sink.py)
+# ---------------------------------------------------------------------------
+
+def json_default(o):
+    """The one numpy/bytes -> plain-JSON coercion used by every JSONL
+    writer (DataFrame ``write_jsonl`` and the scoring sink part files)."""
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, bytes):
+        return o.decode("utf-8", "replace")
+    raise TypeError(f"not JSON-serializable: {type(o)}")
+
+
+def _tmp_path(path: str) -> str:
+    """Same-directory per-writer temp name (pid + thread id — the
+    ``registry/store`` atomic-write discipline: two threads writing the same
+    destination cannot interleave into one temp file)."""
+    return f"{path}.tmp.{os.getpid()}.{_threading.get_ident()}"
+
+
+class _StreamedWriterBase:
+    """Write-to-temp / rename-on-commit lifecycle shared by the streamed
+    writers: :meth:`commit` makes the destination appear atomically
+    (``os.replace``, after flush + fsync — a crashed writer can never leave
+    a torn file under the final name), :meth:`abort` removes the temp.
+    Context-manager use commits on a clean exit and aborts on exception."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._tmp = _tmp_path(path)
+        self._f = None
+        self.rows = 0
+
+    def _finish_payload(self) -> None:
+        """Subclass hook: last bytes before the fsync (e.g. the npy header
+        rewrite)."""
+
+    def commit(self) -> str:
+        if self._f is None:
+            raise RuntimeError(f"writer for {self.path!r} already closed")
+        self._finish_payload()
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        self._f = None
+        os.replace(self._tmp, self.path)
+        return self.path
+
+    def abort(self) -> None:
+        """Drop the temp file; the destination is untouched. Idempotent."""
+        if self._f is not None:
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+        try:
+            os.unlink(self._tmp)
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is None:
+            self.commit()
+        else:
+            self.abort()
+        return False
+
+
+class StreamedJsonlWriter(_StreamedWriterBase):
+    """Streamed JSONL writer: append rows (or columnar chunks) in bounded
+    memory; the destination file appears atomically on :meth:`commit`."""
+
+    def __init__(self, path: str):
+        super().__init__(path)
+        self._f = open(self._tmp, "w")
+
+    def write_row(self, row: dict) -> None:
+        self._f.write(_json.dumps(row, default=json_default) + "\n")
+        self.rows += 1
+
+    def write_columns(self, cols: dict, n: int | None = None) -> None:
+        """Append a columnar chunk as ``n`` rows (``n`` defaults to the
+        first column's length)."""
+        names = list(cols.keys())
+        if n is None:
+            n = len(next(iter(cols.values()))) if names else 0
+        for i in range(int(n)):
+            self.write_row({c: cols[c][i] for c in names})
+
+
+def jsonl_writer(path: str) -> StreamedJsonlWriter:
+    """Streamed atomic JSONL writer (see :class:`StreamedJsonlWriter`)."""
+    return StreamedJsonlWriter(path)
+
+
+_NPY_MAGIC = b"\x93NUMPY\x01\x00"
+_NPY_HEADER_LEN = 118  # dict bytes; total header = 10 + 118 = 128 (64-aligned)
+
+
+def _npy_header(dtype: np.dtype, shape: tuple) -> bytes:
+    """A fixed-length (128-byte) npy 1.0 header, so the shape can be
+    rewritten in place once the final row count is known — the standard
+    append-then-fixup trick for streaming ``.npy`` emission."""
+    from numpy.lib import format as _npfmt
+
+    body = ("{'descr': %r, 'fortran_order': False, 'shape': %r, }"
+            % (_npfmt.dtype_to_descr(dtype), tuple(int(d) for d in shape))
+            ).encode("latin1")
+    if len(body) > _NPY_HEADER_LEN - 1:
+        raise ValueError(f"npy header too large for the fixed slot: {body!r}")
+    body = body + b" " * (_NPY_HEADER_LEN - 1 - len(body)) + b"\n"
+    return _NPY_MAGIC + _struct.pack("<H", _NPY_HEADER_LEN) + body
+
+
+class StreamedNpyWriter(_StreamedWriterBase):
+    """Streamed ``.npy`` writer: append row-chunks of one array without
+    knowing the total row count up front. The header is written with a
+    placeholder shape on the first :meth:`append` (which pins dtype and
+    trailing shape) and rewritten in place at :meth:`commit`; the file then
+    appears atomically via rename. ``np.load`` reads the result like any
+    eagerly saved array."""
+
+    def __init__(self, path: str):
+        super().__init__(path)
+        self._f = open(self._tmp, "wb")
+        self._dtype: np.dtype | None = None
+        self._trailing: tuple | None = None
+
+    def append(self, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype == object:
+            raise TypeError("cannot stream an object-dtype column to .npy; "
+                            "featurize it into a rectangular array first")
+        if arr.ndim == 0:
+            raise ValueError("append needs rows along a leading dimension; "
+                             "got a 0-d scalar (np.atleast_1d it first)")
+        if self._dtype is None:
+            self._dtype = arr.dtype
+            self._trailing = tuple(arr.shape[1:])
+            self._f.write(_npy_header(self._dtype, (0,) + self._trailing))
+        elif arr.dtype != self._dtype or tuple(arr.shape[1:]) != self._trailing:
+            raise ValueError(
+                f"chunk dtype/shape {arr.dtype}{tuple(arr.shape[1:])} does "
+                f"not match the stream's {self._dtype}{self._trailing}")
+        self._f.write(arr.tobytes())
+        self.rows += int(arr.shape[0])
+
+    def _finish_payload(self) -> None:
+        if self._dtype is None:  # zero appends: a legal empty float64 array
+            self._dtype, self._trailing = np.dtype(np.float64), ()
+            self._f.write(_npy_header(self._dtype, (0,)))
+        self._f.seek(0)
+        self._f.write(_npy_header(self._dtype, (self.rows,) + self._trailing))
+        self._f.seek(0, os.SEEK_END)
+
+
+def npy_writer(path: str) -> StreamedNpyWriter:
+    """Streamed atomic ``.npy`` writer (see :class:`StreamedNpyWriter`)."""
+    return StreamedNpyWriter(path)
+
+
+def write_npy(path: str, array: np.ndarray) -> str:
+    """One array -> one ``.npy`` file, atomically (temp + rename).
+    Scalars save as shape ``(1,)`` (the streamed writer needs a leading
+    row dimension)."""
+    with npy_writer(path) as w:
+        w.append(np.atleast_1d(np.asarray(array)))
     return path

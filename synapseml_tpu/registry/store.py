@@ -64,10 +64,12 @@ def sha256_file(path: str) -> str:
 def _tmp_name(path: str) -> str:
     """Per-writer temp name: pid + thread id, so two THREADS of one process
     writing the same destination cannot interleave into one temp file and
-    rename corrupt bytes under a verified name."""
-    import threading
+    rename corrupt bytes under a verified name. ONE format, shared with the
+    streamed writers (``io/files._tmp_path``) — the scoring sink's
+    stale-temp sweep globs it."""
+    from ..io.files import _tmp_path
 
-    return f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    return _tmp_path(path)
 
 
 def atomic_write_bytes(path: str, data: bytes) -> None:

@@ -1,0 +1,37 @@
+"""Distributed bulk-scoring plane: out-of-core ``transform_source`` with
+exactly-once sharded sinks.
+
+The Spark ``transform()``-over-arbitrarily-large-DataFrames role rebuilt on
+the streaming data plane — the offline-batch workload class (backfills,
+embedding corpora, nightly scoring for millions of users) that
+request/response serving can't touch:
+
+* :mod:`.planner` — per-host shard assignment off the jax process topology
+  (strided disjoint exact cover) + bucket-ladder batch formation with
+  tail-rung padding, so a whole corpus scan compiles at most ladder-many
+  executables per stage fn through the shared ``core/batching``
+  ``CompiledCache``.
+* :mod:`.sink` — sharded jsonl/npy sinks with atomic write-then-rename part
+  files, per-shard DONE markers, an append-only per-host cursor, and a
+  quarantine errors sidecar: kill/resume emits each input row exactly once.
+* :mod:`.runner` — :func:`~synapseml_tpu.scoring.runner.transform_source`:
+  a bounded-queue pipeline overlapping shard read -> host prep -> device
+  compute -> sink write, with ``synapseml_scoring_*`` metrics, one span per
+  shard, retried reads, and poisoned-row/shard quarantine.
+
+Entry point: every fitted ``Transformer``/``PipelineModel`` carries
+``stage.transform_source(source, sink)`` (wired in ``core/pipeline.py``).
+See ``docs/SCORING.md``.
+"""
+
+from .planner import (ScoringPlan, assign_shards, iter_shard_batches,  # noqa: F401
+                      plan_scan)
+from .runner import (ScoringContractError, ScoringReport,  # noqa: F401
+                     transform_source)
+from .sink import JsonlSink, NpySink, ScoreSink, open_sink  # noqa: F401
+
+__all__ = [
+    "ScoringPlan", "assign_shards", "plan_scan", "iter_shard_batches",
+    "transform_source", "ScoringReport", "ScoringContractError",
+    "ScoreSink", "JsonlSink", "NpySink", "open_sink",
+]

@@ -1,0 +1,106 @@
+"""Bulk-scoring scan planning: per-host shard assignment + batch formation.
+
+The distributed half of ``transform_source``: every host derives the SAME
+plan from the jax process topology — the shard list in source order, each
+host taking the strided slice ``range(num_shards)[host_index::host_count]``
+(mirroring ``data.DataLoader``'s per-host striding, minus the seeded
+shuffle: a scoring scan is order-deterministic so kill/resume can prove
+byte-identical output). The slices are a disjoint exact cover of the
+dataset, asserted by ``tests/test_scoring.py``.
+
+Batch formation rides the ``core/batching`` bucket ladder: a shard's rows
+chunk at the largest ladder rung <= ``batch_rows`` and the final partial
+chunk pads to its OWN rung (``ShapeBucketer.slices``), so a whole corpus
+scan presents at most :attr:`ScoringPlan.buckets` distinct batch shapes to
+every stage — compile count <= ladder size per stage fn through the shared
+``CompiledCache``, enforced by the miss-counter test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+from ..core import batching as cb
+from ..data.source import ShardedSource, _n_rows, resolve_host
+
+__all__ = ["ScoringPlan", "assign_shards", "plan_scan", "iter_shard_batches"]
+
+
+def assign_shards(num_shards: int, host_index: int | None = None,
+                  host_count: int | None = None) -> list[int]:
+    """This host's shard indices: the strided slice
+    ``range(num_shards)[host_index::host_count]``. Defaults come from the
+    jax process topology; the slices across hosts partition the shard set
+    exactly (disjoint, union complete)."""
+    host_index, host_count = resolve_host(host_index, host_count)
+    return list(range(int(num_shards)))[host_index::host_count]
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoringPlan:
+    """One host's share of a corpus scan, plus the closed set of batch
+    shapes the scan can emit (the warmup/precompile set AND the compile
+    bound)."""
+
+    num_shards: int                 # whole dataset, all hosts
+    shard_indices: tuple[int, ...]  # this host's assignment, scan order
+    host_index: int
+    host_count: int
+    batch_rows: int                 # chunking cap (ladder-aligned by slices)
+    multiple_of: int
+    buckets: tuple[int, ...]        # every padded batch size the scan emits
+
+
+def plan_scan(source: ShardedSource, batch_rows: int = 256,
+              bucketer: cb.ShapeBucketer | None = None,
+              multiple_of: int = 1, host_index: int | None = None,
+              host_count: int | None = None) -> ScoringPlan:
+    """Derive this host's :class:`ScoringPlan` for ``source``."""
+    if batch_rows < 1:
+        raise ValueError(f"batch_rows must be >= 1, got {batch_rows}")
+    b = bucketer or cb.default_bucketer()
+    host_index, host_count = resolve_host(host_index, host_count)
+    mine = assign_shards(source.num_shards, host_index, host_count)
+    return ScoringPlan(
+        num_shards=source.num_shards, shard_indices=tuple(mine),
+        host_index=host_index, host_count=host_count,
+        batch_rows=int(batch_rows), multiple_of=max(int(multiple_of), 1),
+        buckets=tuple(b.buckets_upto(batch_rows, multiple_of)))
+
+
+def _pad_any(a: np.ndarray, bucket: int, mode: str) -> np.ndarray:
+    """``cb.pad_rows`` extended to non-numeric columns: scoring corpora
+    carry string ids/urls and heterogeneous-key (object) passthrough
+    columns, which always pad edge-style (repeat the last real row —
+    padded rows are stripped from the output, their content only has to
+    be shape-valid for the stage)."""
+    if a.dtype == object or a.dtype.kind in "US":
+        n = a.shape[0]
+        pad = int(bucket) - n
+        if pad <= 0 or not n:
+            return a
+        return np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
+    return cb.pad_rows(a, bucket, mode=mode)
+
+
+def iter_shard_batches(cols: dict, batch_rows: int,
+                       bucketer: cb.ShapeBucketer | None = None,
+                       multiple_of: int = 1, pad_mode: str = "edge"
+                       ) -> Iterator[tuple[dict, int, int, int]]:
+    """Chunk one shard's columnar dict into fixed-shape batches:
+    ``(padded_batch, n_valid, bucket, row_offset)`` per chunk. Full chunks
+    run at the ladder-aligned cap; the tail pads to its own rung
+    (``pad_mode='edge'`` repeats the last real row — the ONNXModel padding,
+    safe for models where an all-zero row hits a different numeric path;
+    string/object passthrough columns always edge-pad). Padded rows are
+    stripped from the transform OUTPUT by the runner, never written to the
+    sink."""
+    b = bucketer or cb.default_bucketer()
+    n = _n_rows(cols)
+    for start, stop, bucket in b.slices(n, batch_rows, multiple_of):
+        batch = {k: _pad_any(np.asarray(v)[start:stop], bucket, pad_mode)
+                 for k, v in cols.items()}
+        yield batch, stop - start, bucket, start

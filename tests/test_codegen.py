@@ -106,6 +106,28 @@ def test_registry_compat_coverage():
             f"compat.registry.{name} is not the registry's own object")
 
 
+def test_scoring_compat_coverage():
+    """Same compat coverage rule for the bulk-scoring subsystem: every
+    public ``synapseml_tpu.scoring`` symbol importable from the generated
+    ``compat.scoring`` passthrough, with no stale extras."""
+    import synapseml_tpu.compat.scoring as compat_scoring
+    import synapseml_tpu.scoring as scoring
+
+    public = set(scoring.__all__)
+    covered = set(compat_scoring.__all__)
+    missing = sorted(public - covered)
+    assert not missing, (
+        f"public scoring symbols missing compat coverage: {missing}; "
+        "run python -m synapseml_tpu.codegen")
+    stale = sorted(covered - public)
+    assert not stale, (
+        f"compat.scoring exports symbols the scoring plane no longer has: "
+        f"{stale}; run python -m synapseml_tpu.codegen")
+    for name in sorted(public):
+        assert getattr(compat_scoring, name) is getattr(scoring, name), (
+            f"compat.scoring.{name} is not the scoring plane's own object")
+
+
 def test_no_inline_jit_in_stage_transform():
     """Static guard for the continuous-batching plane: inference-stage
     modules must acquire jitted programs through
@@ -133,7 +155,8 @@ def test_no_inline_jit_in_stage_transform():
                "models/paged_engine.py", "models/flax_nets/llama.py",
                "io/serving.py",
                "automl/tune.py", "automl/hyperparams.py",
-               "models/fused_trainer.py", "gbdt/fused.py"]
+               "models/fused_trainer.py", "gbdt/fused.py",
+               "scoring/planner.py", "scoring/runner.py", "scoring/sink.py"]
     pkg = pathlib.Path(st.__file__).parent
     offenders = []
     for rel in modules:

@@ -140,6 +140,30 @@ class TestBoosterApi:
         assert leaves.shape == (50, 5)
         assert (leaves >= 0).all()
 
+    def test_raw_score_and_predict_matches_separate_calls(self, binary_data):
+        """The fused (raw, prob) executable — the classifier serving /
+        bulk-scoring hot path — must equal the independent raw-only
+        executable + an eager objective transform over it (predict() now
+        delegates to the fused path, so comparing against predict() would
+        be tautological), on a ladder bucket AND on the beyond-ladder
+        polymorphic path."""
+        import jax.numpy as jnp
+
+        from synapseml_tpu.gbdt import objectives as obj
+
+        x, y = binary_data
+        b = train_booster(x, y, objective="binary", num_iterations=10,
+                          learning_rate=0.2)
+        o = obj.get_objective(b.objective, num_class=b.num_model_out)
+        for n in (37, len(x)):  # padded ladder rung / polymorphic
+            raw, prob = b.raw_score_and_predict(x[:n])
+            ref_raw = b.raw_score(x[:n])
+            np.testing.assert_allclose(raw, ref_raw, rtol=1e-6)
+            np.testing.assert_allclose(
+                prob, np.asarray(o.transform(jnp.asarray(ref_raw))),
+                rtol=1e-6)
+            assert raw.shape[0] == n and prob.shape[0] == n
+
     def test_feature_importance(self, binary_data):
         x, y = binary_data
         b = train_booster(x, y, objective="binary", num_iterations=10, learning_rate=0.2)

@@ -1,0 +1,751 @@
+"""Distributed bulk-scoring plane (``synapseml_tpu/scoring/``).
+
+The acceptance surface of the exactly-once contract: kill/resume at three
+cut points produces byte-identical output to an uninterrupted run (zero
+duplicates, zero gaps), host shard partitions are a disjoint exact cover,
+a whole corpus scan compiles at most ladder-many executables per stage fn,
+poisoned rows/shards quarantine to the errors sidecar instead of killing
+the scan, sinks stay atomic under injected write faults, and memory stays
+bounded by the queue discipline on a dataset much larger than one shard.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.scoring
+
+from synapseml_tpu.core import batching as cb
+from synapseml_tpu.core.dataframe import DataFrame
+from synapseml_tpu.core.faults import FaultSpec, inject_faults
+from synapseml_tpu.core.pipeline import Model, PipelineModel
+from synapseml_tpu.core.resilience import RetryPolicy
+from synapseml_tpu.data import MemorySource, ShardedSource
+from synapseml_tpu.io import files as iofiles
+from synapseml_tpu.scoring import (JsonlSink, NpySink, ScoringContractError,
+                                   assign_shards, iter_shard_batches,
+                                   open_sink, plan_scan, transform_source)
+from synapseml_tpu.scoring.runner import ScoringReport
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a tiny jit-backed scorer + synthetic sharded corpora
+# ---------------------------------------------------------------------------
+
+class SquareModel(Model):
+    """CompiledCache-adopted toy scorer: score = sum(x^2) + 1."""
+
+    fn_id = "scoring_test_square"
+
+    def _transform(self, df):
+        part = df.collect()
+        x = np.asarray(np.stack(part["x"]), np.float32)
+
+        def build():
+            import jax
+
+            return jax.jit(lambda a: (a * a + 1.0).sum(axis=-1))
+
+        fn = cb.get_compiled_cache().get(self.fn_id, x.shape, build,
+                                         instance=cb.instance_token(self))
+        return df.with_column("score", np.asarray(fn(x)))
+
+
+class NumpyModel(Model):
+    """Pure-host scorer (no jit) for memory/atomicity tests."""
+
+    def _transform(self, df):
+        part = df.collect()
+        x = np.asarray(np.stack(part["x"]), np.float64)
+        return df.with_column("score", x.sum(axis=-1))
+
+
+class PoisonModel(NumpyModel):
+    """Raises on rows whose ``flag`` is set — the poisoned-row scenario."""
+
+    def _transform(self, df):
+        part = df.collect()
+        if np.any(np.asarray(part["flag"]) == 1):
+            raise ValueError("poisoned row in batch")
+        return super()._transform(df)
+
+
+class _Kill(BaseException):
+    """Out-of-band kill (a BaseException so quarantine containment — which
+    catches Exception — cannot swallow it; the process-kill stand-in)."""
+
+
+class KillAfter(Model):
+    """Delegates to an inner model, killing the scan after N batches."""
+
+    def __init__(self, inner, after, **kw):
+        super().__init__(**kw)
+        self._inner = inner
+        self._after = after
+        self._seen = 0
+
+    def _transform(self, df):
+        if self._seen >= self._after:
+            raise _Kill(f"killed after {self._seen} batches")
+        self._seen += 1
+        return self._inner._transform(df)
+
+
+def _write_corpus(directory, sizes, n_features=4, flag_rows=(), seed=0):
+    """One jsonl file per shard; rows carry a global id ``i`` so duplicate/
+    gap detection is exact."""
+    os.makedirs(directory, exist_ok=True)
+    rs = np.random.default_rng(seed)
+    i = 0
+    for s, n in enumerate(sizes):
+        with open(os.path.join(directory, f"in-{s:03d}.jsonl"), "w") as f:
+            for _ in range(n):
+                f.write(json.dumps({
+                    "x": [round(float(v), 5)
+                          for v in rs.normal(size=n_features)],
+                    "i": i, "flag": 1 if i in flag_rows else 0}) + "\n")
+                i += 1
+    return i
+
+
+def _source(directory):
+    return ShardedSource.jsonl(os.path.join(directory, "*.jsonl"))
+
+
+def _part_bytes(sink):
+    """Concatenated bytes of the completed parts in shard order — the
+    byte-identity surface of the exactly-once proof."""
+    return b"".join(open(p, "rb").read() for p in sink.part_files())
+
+
+def _ids(rows):
+    return sorted(r["i"] for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end + contract
+# ---------------------------------------------------------------------------
+
+def test_end_to_end_matches_in_memory_transform(tmp_path):
+    total = _write_corpus(tmp_path / "data", [37, 64, 5, 20])
+    src = _source(tmp_path / "data")
+    model = SquareModel()
+    sink = JsonlSink(tmp_path / "out")
+    report = model.transform_source(src, sink, batch_rows=16,
+                                    host_index=0, host_count=1)
+    assert report.rows_written == total
+    assert report.complete and sink.is_complete()
+    rows = sink.collect_rows()
+    assert _ids(rows) == list(range(total))
+
+    from synapseml_tpu.io.files import read_jsonl
+
+    eager = model.transform(read_jsonl(str(tmp_path / "data" / "*.jsonl")))
+    by_id_eager = dict(zip(eager.collect_column("i").tolist(),
+                           eager.collect_column("score").tolist()))
+    for r in rows:
+        assert r["score"] == pytest.approx(by_id_eager[r["i"]], rel=1e-6)
+
+
+def test_exactly_once_kill_resume_at_three_cut_points(tmp_path):
+    total = _write_corpus(tmp_path / "data", [30, 11, 42, 7, 25])
+    src = _source(tmp_path / "data")
+    clean_sink = JsonlSink(tmp_path / "clean")
+    SquareModel().transform_source(src, clean_sink, batch_rows=16,
+                                   host_index=0, host_count=1)
+    golden = _part_bytes(clean_sink)
+    assert golden
+
+    for cut in (1, 4, 7):  # batches before the kill: early / mid / late
+        out = tmp_path / f"out_cut{cut}"
+        killer = KillAfter(SquareModel(), cut)
+        with pytest.raises(_Kill):
+            transform_source(killer, src, JsonlSink(out), batch_rows=16,
+                             host_index=0, host_count=1)
+        # resume with a FRESH runner (new process stand-in)
+        sink = JsonlSink(out)
+        assert not sink.is_complete()
+        report = transform_source(SquareModel(), src, sink, batch_rows=16,
+                                  host_index=0, host_count=1)
+        assert report.complete
+        assert report.shards_skipped + report.shards_done == 5
+        rows = sink.collect_rows()
+        assert _ids(rows) == list(range(total))  # zero dups, zero gaps
+        assert _part_bytes(sink) == golden       # byte-identical output
+
+
+def test_resume_is_a_noop_when_complete(tmp_path):
+    _write_corpus(tmp_path / "data", [12, 12])
+    src = _source(tmp_path / "data")
+    sink = JsonlSink(tmp_path / "out")
+    transform_source(NumpyModel(), src, sink, batch_rows=8,
+                     host_index=0, host_count=1)
+    before = _part_bytes(sink)
+    report = transform_source(NumpyModel(), src, sink, batch_rows=8,
+                              host_index=0, host_count=1)
+    assert report.rows_written == 0 and report.shards_done == 0
+    assert report.shards_skipped == 2 and report.complete
+    assert _part_bytes(sink) == before
+
+
+# ---------------------------------------------------------------------------
+# distribution: host partitions
+# ---------------------------------------------------------------------------
+
+def test_host_shard_assignment_is_disjoint_exact_cover():
+    for n_shards in (1, 5, 16, 17):
+        for hosts in (1, 2, 3, 4, 16, 20):
+            slices = [assign_shards(n_shards, h, hosts)
+                      for h in range(hosts)]
+            flat = sorted(i for s in slices for i in s)
+            assert flat == list(range(n_shards)), (n_shards, hosts)
+            assert len(flat) == len(set(flat))
+    with pytest.raises(ValueError):
+        assign_shards(4, 2, 2)
+
+
+def test_two_host_scan_equals_one_host_scan(tmp_path):
+    total = _write_corpus(tmp_path / "data", [9, 21, 14, 3, 30])
+    src = _source(tmp_path / "data")
+    one = JsonlSink(tmp_path / "one")
+    transform_source(NumpyModel(), src, one, batch_rows=8,
+                     host_index=0, host_count=1)
+
+    two = JsonlSink(tmp_path / "two")
+    r0 = transform_source(NumpyModel(), src, two, batch_rows=8,
+                          host_index=0, host_count=2)
+    assert not r0.complete  # host 1's shards still missing
+    r1 = transform_source(NumpyModel(), src, JsonlSink(tmp_path / "two"),
+                          batch_rows=8, host_index=1, host_count=2)
+    assert r1.complete  # last host to finish writes _SUCCESS
+    assert r0.shards_done + r1.shards_done == 5
+    assert _part_bytes(JsonlSink(tmp_path / "two")) == _part_bytes(one)
+    assert _ids(JsonlSink(tmp_path / "two").collect_rows()) \
+        == list(range(total))
+
+
+# ---------------------------------------------------------------------------
+# compile bound + batch formation
+# ---------------------------------------------------------------------------
+
+def test_corpus_scan_compile_count_bounded_by_ladder(tmp_path):
+    # many shards with ragged sizes -> many distinct tail sizes, yet the
+    # padded batch shapes stay within plan.buckets and the per-fn compile
+    # count (CompiledCache miss counter) stays <= len(buckets)
+    _write_corpus(tmp_path / "data", [3, 17, 33, 64, 50, 7, 12, 31, 2, 29])
+    src = _source(tmp_path / "data")
+    model = SquareModel()
+    model.fn_id = "scoring_ladder_bound"
+    cache = cb.get_compiled_cache()
+    before = cache.miss_count(model.fn_id)
+    plan = plan_scan(src, batch_rows=32, host_index=0, host_count=1)
+    transform_source(model, src, JsonlSink(tmp_path / "out"), batch_rows=32,
+                     host_index=0, host_count=1)
+    misses = cache.miss_count(model.fn_id) - before
+    assert 0 < misses <= len(plan.buckets), (misses, plan.buckets)
+
+
+def test_tail_batches_pad_to_their_own_rung():
+    cols = {"x": np.arange(42, dtype=np.float32).reshape(21, 2),
+            "i": np.arange(21)}
+    batches = list(iter_shard_batches(cols, batch_rows=16))
+    assert [(n, b) for _, n, b, _ in batches] == [(16, 16), (5, 8)]
+    tail = batches[1][0]
+    assert tail["x"].shape == (8, 2)
+    # edge padding repeats the last real row
+    assert np.array_equal(tail["x"][5], tail["x"][4])
+
+
+def test_padded_rows_counted_and_never_written(tmp_path):
+    total = _write_corpus(tmp_path / "data", [13])
+    src = _source(tmp_path / "data")
+    sink = JsonlSink(tmp_path / "out")
+    report = transform_source(NumpyModel(), src, sink, batch_rows=8,
+                              host_index=0, host_count=1)
+    assert report.rows_written == total
+    assert report.rows_padded > 0
+    assert len(sink.collect_rows()) == total
+
+
+def test_row_count_changing_transform_is_a_contract_error(tmp_path):
+    class Dropper(Model):
+        def _transform(self, df):
+            return df.filter(lambda p: np.asarray(p["i"]) % 2 == 0)
+
+    _write_corpus(tmp_path / "data", [10])
+    with pytest.raises(ScoringContractError, match="row-preserving"):
+        transform_source(Dropper(), _source(tmp_path / "data"),
+                         JsonlSink(tmp_path / "out"), batch_rows=8,
+                         host_index=0, host_count=1)
+
+
+# ---------------------------------------------------------------------------
+# quarantine: poisoned rows and shards
+# ---------------------------------------------------------------------------
+
+def test_poisoned_rows_quarantined_scan_completes(tmp_path):
+    total = _write_corpus(tmp_path / "data", [20, 20], flag_rows=(5, 27))
+    src = _source(tmp_path / "data")
+    sink = JsonlSink(tmp_path / "out")
+    report = transform_source(PoisonModel(), src, sink, batch_rows=8,
+                              host_index=0, host_count=1)
+    assert report.complete
+    assert report.rows_quarantined == 2
+    assert report.rows_written == total - 2
+    assert _ids(sink.collect_rows()) == [i for i in range(total)
+                                         if i not in (5, 27)]
+    errs = sink.error_records()
+    assert len(errs) == 2 and all(e["kind"] == "row" for e in errs)
+    assert {e["data"]["i"] for e in errs} == {5, 27}
+
+
+def test_poisoned_rows_raise_when_on_error_raise(tmp_path):
+    _write_corpus(tmp_path / "data", [10], flag_rows=(3,))
+    with pytest.raises(ValueError, match="poisoned"):
+        transform_source(PoisonModel(), _source(tmp_path / "data"),
+                         JsonlSink(tmp_path / "out"), batch_rows=8,
+                         on_error="raise", host_index=0, host_count=1)
+
+
+def test_unreadable_shard_quarantined_after_retries(tmp_path):
+    total = _write_corpus(tmp_path / "data", [11, 13, 9])
+    src = ShardedSource.jsonl(str(tmp_path / "data" / "*.jsonl"),
+                              retry_policy=RetryPolicy(backoffs_ms=(1,)))
+    poisoned = src.shards()[1].target
+    sink = JsonlSink(tmp_path / "out")
+    with inject_faults([FaultSpec("connection_error", match=poisoned,
+                                  planes=("data",))]) as plan:
+        report = transform_source(NumpyModel(), src, sink, batch_rows=8,
+                                  host_index=0, host_count=1)
+    assert plan.injected  # the fault actually fired (and was retried)
+    assert report.shards_quarantined == 1 and report.shards_done == 2
+    assert report.complete  # quarantined shard carries a zero-row DONE
+    assert report.rows_written == total - 13
+    errs = sink.error_records()
+    assert any(e["kind"] == "shard" for e in errs)
+    done = sink.completed()
+    assert done[1]["quarantined"] and done[1]["rows"] == 0
+    # deliberate re-score: drop the marker, rerun without the fault
+    os.unlink(sink.done_path(1))
+    report2 = transform_source(NumpyModel(), src, JsonlSink(tmp_path / "out"),
+                               batch_rows=8, host_index=0, host_count=1)
+    assert report2.shards_done == 1 and report2.rows_written == 13
+    assert _ids(JsonlSink(tmp_path / "out").collect_rows()) \
+        == list(range(total))
+
+
+def test_string_and_object_columns_ride_through(tmp_path):
+    """Scoring corpora carry string ids/urls and heterogeneous-key
+    (object) passthrough columns — batch formation must pad them
+    edge-style, not die in ``cb.pad_rows``."""
+    os.makedirs(tmp_path / "data")
+    n = 11  # forces a padded tail rung
+    with open(tmp_path / "data" / "in-000.jsonl", "w") as f:
+        for i in range(n):
+            rec = {"x": [float(i), 1.0], "i": i, "url": f"https://r/{i}"}
+            if i % 3 == 0:
+                rec["extra"] = "only-sometimes"  # object column via None-fill
+            f.write(json.dumps(rec) + "\n")
+    sink = JsonlSink(tmp_path / "out")
+    report = transform_source(NumpyModel(), _source(tmp_path / "data"), sink,
+                              batch_rows=8, host_index=0, host_count=1)
+    assert report.complete and report.rows_written == n
+    rows = sink.collect_rows()
+    assert [r["url"] for r in sorted(rows, key=lambda r: r["i"])] \
+        == [f"https://r/{i}" for i in range(n)]
+
+
+def test_shard_level_failure_quarantines_not_kills(tmp_path, monkeypatch):
+    """A shard whose batch FORMATION fails (outside the per-batch row
+    containment) is quarantined — aborted part, zero-row DONE, sidecar
+    record, report rolled back to pre-shard — instead of killing the
+    scan."""
+    import synapseml_tpu.scoring.runner as runner_mod
+
+    total = _write_corpus(tmp_path / "data", [9, 9])
+    real_iter = runner_mod.iter_shard_batches
+
+    def exploding_iter(cols, *a, **kw):
+        if np.any(np.asarray(cols["i"]) == 12):  # second shard only
+            raise RuntimeError("synthetic batch-formation failure")
+        return real_iter(cols, *a, **kw)
+
+    monkeypatch.setattr(runner_mod, "iter_shard_batches", exploding_iter)
+    sink = JsonlSink(tmp_path / "out")
+    report = transform_source(NumpyModel(), _source(tmp_path / "data"), sink,
+                              batch_rows=16, host_index=0, host_count=1)
+    assert report.complete
+    assert report.shards_done == 1 and report.shards_quarantined == 1
+    assert report.rows_written == 9
+    done = sink.completed()
+    assert done[1]["quarantined"] and done[1]["rows"] == 0
+    assert _ids(sink.collect_rows()) == list(range(9))
+    assert any("batch-formation" in e.get("error", "")
+               for e in sink.error_records())
+    # and on_error='raise' propagates it
+    with pytest.raises(RuntimeError, match="batch-formation"):
+        transform_source(NumpyModel(), _source(tmp_path / "data"),
+                         JsonlSink(tmp_path / "out_raise"), batch_rows=16,
+                         on_error="raise", host_index=0, host_count=1)
+
+
+def test_npy_sink_skips_zero_row_parts(tmp_path):
+    """A shard whose every row quarantined commits a zero-append part;
+    ``collect_column`` must skip it rather than crash concatenating a
+    dtype-less ``(0,)`` placeholder with real 2-D chunks."""
+    from synapseml_tpu.io import files as f
+
+    p1 = f.npy_writer(str(tmp_path / "part-00000.c.npy"))
+    p1.append(np.ones((4, 3), np.float32))
+    p1.commit()
+    p0 = f.npy_writer(str(tmp_path / "part-00001.c.npy"))
+    p0.commit()  # zero appends: (0,) float64 placeholder
+    sink = NpySink(tmp_path, columns=["c"])
+    for shard, name in ((0, "part-00000.c.npy"), (1, "part-00001.c.npy")):
+        sink._mark_done({"shard": shard, "rows": 4 if shard == 0 else 0,
+                         "files": [name], "host": 0, "quarantined": False})
+    out = sink.collect_column("c")
+    assert out.shape == (4, 3) and out.dtype == np.float32
+
+
+def test_foreign_markers_and_torn_sidecar_lines_tolerated(tmp_path):
+    """A foreign/malformed DONE marker is treated as incomplete (not a
+    crash), and a torn trailing sidecar/cursor line (host killed
+    mid-append) still yields the intact prefix."""
+    total = _write_corpus(tmp_path / "data", [7, 7])
+    src = _source(tmp_path / "data")
+    sink = JsonlSink(tmp_path / "out")
+    transform_source(NumpyModel(), src, sink, batch_rows=8,
+                     host_index=0, host_count=1)
+    # valid JSON, wrong shapes: all must read as "incomplete", not raise
+    for name, body in (("part-00090.DONE", "{}"), ("part-00091.DONE", "null"),
+                       ("part-00092.DONE", '{"shard": "x", "files": []}')):
+        (tmp_path / "out" / name).write_text(body)
+    assert sorted(JsonlSink(tmp_path / "out").completed()) == [0, 1]
+    # resume still a no-op over the foreign markers
+    r = transform_source(NumpyModel(), src, JsonlSink(tmp_path / "out"),
+                         batch_rows=8, host_index=0, host_count=1)
+    assert r.complete and r.shards_skipped == 2
+    # torn tails: the intact prefix survives
+    with open(tmp_path / "out" / "cursor-00000.jsonl", "a") as f:
+        f.write('{"shard": 9, "rows"')
+    with open(tmp_path / "out" / "errors-00000.jsonl", "a") as f:
+        f.write('{"kind": "row", "half')
+    s2 = JsonlSink(tmp_path / "out")
+    assert len(s2.cursor_records()) >= 2
+    assert s2.error_records() == []
+
+
+def test_npy_collect_column_matches_exact_name(tmp_path):
+    """Column 'a' must not also collect a dotted-suffix column 'raw.a'."""
+    from synapseml_tpu.io import files as f
+
+    sink = NpySink(tmp_path, columns=["a", "raw.a"])
+    for name, fill in (("part-00000.a.npy", 1.0),
+                       ("part-00000.raw.a.npy", 2.0)):
+        w = f.npy_writer(str(tmp_path / name))
+        w.append(np.full((3,), fill, np.float32))
+        w.commit()
+    sink._mark_done({"shard": 0, "rows": 3,
+                     "files": ["part-00000.a.npy", "part-00000.raw.a.npy"],
+                     "host": 0, "quarantined": False})
+    assert np.array_equal(sink.collect_column("a"), np.full((3,), 1.0))
+    assert np.array_equal(sink.collect_column("raw.a"), np.full((3,), 2.0))
+
+
+def test_estimate_rows_custom_reader_gated_by_read_fallback(tmp_path):
+    """The runner's progress gauge must not cost a full shard read on a
+    custom-reader source: read_fallback=False raises, transform_source
+    just reports no estimate."""
+    from synapseml_tpu.data.source import Shard
+
+    reads = []
+
+    def read(shard):
+        reads.append(shard.index)
+        return {"x": np.ones((4, 2)), "i": np.arange(4)}
+
+    src = ShardedSource([Shard(0, "custom", "mem", 0, 4),
+                         Shard(1, "custom", "mem", 0, 4)], read)
+    with pytest.raises(ValueError, match="read_fallback"):
+        src.estimate_rows(read_fallback=False)
+    assert reads == []  # the gate kept the gauge free
+    report = transform_source(NumpyModel(), src,
+                              JsonlSink(tmp_path / "out"), batch_rows=8,
+                              host_index=0, host_count=1)
+    assert report.complete and report.estimated_rows is None
+    assert sorted(reads) == [0, 1]  # each shard read exactly once
+    assert src.estimate_rows() == 8  # explicit opt-in still works
+
+
+def test_estimate_rows_image_kind_is_metadata_cheap(tmp_path, monkeypatch):
+    """Image shards' start/stop are file-listing offsets (one row per
+    file): estimate_rows must answer from metadata without decoding a
+    single image. Exactness (minus undecodable files the reader drops)
+    stays total_rows()'s read pass."""
+    import synapseml_tpu.io.files as iof
+
+    d = tmp_path / "imgs"
+    os.makedirs(d)
+    for i in range(7):
+        (d / f"im-{i}.png").write_bytes(b"\x89PNG\r\n\x1a\nfake")
+    src = ShardedSource.image_dir(str(d), shard_files=3)
+    monkeypatch.setattr(iof, "decode_image_bytes", lambda *a, **k: (
+        (_ for _ in ()).throw(AssertionError("decoded an image"))))
+    assert src.estimate_rows() == 7  # no decode happened
+
+
+# ---------------------------------------------------------------------------
+# sink atomicity + write faults
+# ---------------------------------------------------------------------------
+
+def test_sink_atomic_under_injected_write_fault(tmp_path, monkeypatch):
+    total = _write_corpus(tmp_path / "data", [15, 15, 15])
+    src = _source(tmp_path / "data")
+    out = tmp_path / "out"
+
+    real_commit = iofiles.StreamedJsonlWriter.commit
+    state = {"fails": 1}
+
+    def flaky_commit(self):
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise OSError("injected write fault")
+        return real_commit(self)
+
+    monkeypatch.setattr(iofiles.StreamedJsonlWriter, "commit", flaky_commit)
+    with pytest.raises(OSError, match="injected write fault"):
+        transform_source(NumpyModel(), src, JsonlSink(out), batch_rows=8,
+                         host_index=0, host_count=1)
+    # a sink failure is never quarantined and never leaves torn state:
+    # no part without a DONE, no DONE without its payload, no temp litter
+    sink = JsonlSink(out)
+    listing = os.listdir(out)
+    assert not [n for n in listing if ".tmp." in n], listing
+    committed = {os.path.basename(p) for p in sink.part_files()}
+    stray = [n for n in listing if n.startswith("part-")
+             and not n.endswith(".DONE") and n not in committed]
+    assert not stray, stray
+    # resume completes and the merged output is exact
+    report = transform_source(NumpyModel(), src, JsonlSink(out), batch_rows=8,
+                              host_index=0, host_count=1)
+    assert report.complete
+    assert _ids(JsonlSink(out).collect_rows()) == list(range(total))
+
+
+def test_part_files_appear_atomically_with_done_after_payload(tmp_path):
+    _write_corpus(tmp_path / "data", [6])
+    src = _source(tmp_path / "data")
+    sink = JsonlSink(tmp_path / "out")
+    order = []
+    real_replace = os.replace
+
+    def spy_replace(a, b):
+        order.append(os.path.basename(b))
+        return real_replace(a, b)
+
+    try:
+        os.replace = spy_replace
+        transform_source(NumpyModel(), src, sink, batch_rows=8,
+                         host_index=0, host_count=1)
+    finally:
+        os.replace = real_replace
+    # payload rename strictly precedes its DONE marker, which precedes _SUCCESS
+    assert order.index("part-00000.jsonl") \
+        < order.index("part-00000.DONE") < order.index("_SUCCESS")
+
+
+# ---------------------------------------------------------------------------
+# bounded memory on a dataset >> one shard
+# ---------------------------------------------------------------------------
+
+def test_scan_memory_bounded_by_queue_not_dataset(tmp_path):
+    # ~6 MB corpus in 24 shards; the runner may hold only
+    # (prefetch + in-flight + write-queue) shards at once
+    directory = tmp_path / "data"
+    os.makedirs(directory)
+    rs = np.random.default_rng(0)
+    i = 0
+    for s in range(24):
+        with open(directory / f"in-{s:03d}.jsonl", "w") as f:
+            for _ in range(512):
+                f.write(json.dumps({"x": [round(float(v), 5)
+                                          for v in rs.normal(size=16)],
+                                    "i": i}) + "\n")
+                i += 1
+    dataset_bytes = sum(os.path.getsize(directory / n)
+                        for n in os.listdir(directory))
+    src = ShardedSource.jsonl(str(directory / "*.jsonl"))
+    assert src.num_shards == 24
+    report = transform_source(NumpyModel(), src, JsonlSink(tmp_path / "out"),
+                              batch_rows=64, prefetch=2,
+                              host_index=0, host_count=1)
+    assert report.rows_written == i
+    # peak buffered bytes stay a small multiple of one shard, far under
+    # the dataset — the out-of-core guarantee
+    shard_bytes = dataset_bytes / 24
+    assert report.peak_inflight_bytes < 8 * shard_bytes
+    assert report.peak_inflight_bytes < dataset_bytes / 3
+
+
+def test_estimated_rows_feed_progress(tmp_path):
+    total = _write_corpus(tmp_path / "data", [40, 40, 40])
+    src = _source(tmp_path / "data")
+    report = transform_source(NumpyModel(), src, JsonlSink(tmp_path / "out"),
+                              batch_rows=16, host_index=0, host_count=1)
+    assert report.estimated_rows is not None
+    assert abs(report.estimated_rows - total) / total < 0.35
+
+
+# ---------------------------------------------------------------------------
+# sinks + planner units
+# ---------------------------------------------------------------------------
+
+def test_npy_sink_round_trip_and_done_lists_files(tmp_path):
+    total = _write_corpus(tmp_path / "data", [10, 22])
+    src = _source(tmp_path / "data")
+    sink = NpySink(tmp_path / "out", columns=["score", "i"])
+    report = transform_source(NumpyModel(), src, sink, batch_rows=8,
+                              host_index=0, host_count=1)
+    assert report.complete
+    ids = sink.collect_column("i")
+    assert sorted(ids.tolist()) == list(range(total))
+    scores = sink.collect_column("score")
+    assert scores.shape == (total,) and scores.dtype == np.float64
+    done = sink.completed()
+    assert sorted(done) == [0, 1]
+    assert sorted(done[0]["files"]) == ["part-00000.i.npy",
+                                        "part-00000.score.npy"]
+
+
+def test_open_sink_factory(tmp_path):
+    assert isinstance(open_sink(tmp_path / "a"), JsonlSink)
+    assert isinstance(open_sink(tmp_path / "b", "npy", ["score"]), NpySink)
+    with pytest.raises(ValueError, match="columns"):
+        open_sink(tmp_path / "c", "npy")
+    with pytest.raises(ValueError, match="unknown sink format"):
+        open_sink(tmp_path / "d", "parquet")
+
+
+def test_jsonl_sink_column_projection(tmp_path):
+    _write_corpus(tmp_path / "data", [9])
+    sink = JsonlSink(tmp_path / "out", columns=["i", "score"])
+    transform_source(NumpyModel(), _source(tmp_path / "data"), sink,
+                     batch_rows=8, host_index=0, host_count=1)
+    rows = sink.collect_rows()
+    assert all(sorted(r) == ["i", "score"] for r in rows)
+
+
+def test_plan_buckets_are_the_warmup_set():
+    src = MemorySource({"x": np.zeros((100, 2))}, shard_rows=30)
+    plan = plan_scan(src, batch_rows=64, host_index=0, host_count=1)
+    assert plan.buckets == tuple(
+        cb.default_bucketer().buckets_upto(64))
+    assert plan.num_shards == src.num_shards
+
+
+def test_cursor_is_append_only_audit_trail(tmp_path):
+    _write_corpus(tmp_path / "data", [5, 5, 5])
+    src = _source(tmp_path / "data")
+    sink = JsonlSink(tmp_path / "out")
+    transform_source(NumpyModel(), src, sink, batch_rows=8,
+                     host_index=0, host_count=1)
+    recs = sink.cursor_records()
+    assert [r["shard"] for r in recs] == [0, 1, 2]
+    assert all(r["host"] == 0 and "ts" in r for r in recs)
+
+
+def test_pipeline_model_rides_the_scoring_plane(tmp_path):
+    total = _write_corpus(tmp_path / "data", [14])
+    pm = PipelineModel(stages=[NumpyModel()])
+    sink = JsonlSink(tmp_path / "out")
+    report = pm.transform_source(_source(tmp_path / "data"), sink,
+                                 batch_rows=8, host_index=0, host_count=1)
+    assert report.complete and report.rows_written == total
+    assert "score" in sink.collect_rows()[0]
+
+
+def test_scoring_metrics_series_emitted(tmp_path):
+    from synapseml_tpu.core import observability as obs
+
+    _write_corpus(tmp_path / "data", [13])
+    transform_source(NumpyModel(), _source(tmp_path / "data"),
+                     JsonlSink(tmp_path / "out"), batch_rows=8,
+                     host_index=0, host_count=1)
+    text = obs.get_registry().exposition()
+    for series in ("synapseml_scoring_rows_total",
+                   "synapseml_scoring_padded_rows_total",
+                   "synapseml_scoring_shards_total",
+                   "synapseml_scoring_batch_ms",
+                   "synapseml_scoring_rows_per_sec"):
+        assert series in text, series
+
+
+# ---------------------------------------------------------------------------
+# satellite: io/files streamed writers + jsonl error context
+# ---------------------------------------------------------------------------
+
+def test_streamed_jsonl_writer_atomic_commit_and_abort(tmp_path):
+    p = str(tmp_path / "x.jsonl")
+    w = iofiles.jsonl_writer(p)
+    w.write_row({"a": 1})
+    assert not os.path.exists(p)  # nothing visible before commit
+    w.commit()
+    assert os.path.exists(p)
+    w2 = iofiles.jsonl_writer(p)
+    w2.write_row({"a": 999})
+    w2.abort()
+    assert [json.loads(ln) for ln in open(p)] == [{"a": 1}]  # untouched
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+
+def test_streamed_npy_writer_header_fixup(tmp_path):
+    p = str(tmp_path / "x.npy")
+    with iofiles.npy_writer(p) as w:
+        w.append(np.arange(6, dtype=np.float32).reshape(3, 2))
+        w.append(np.full((4, 2), 7, np.float32))
+    arr = np.load(p)
+    assert arr.shape == (7, 2) and arr.dtype == np.float32
+    assert np.array_equal(arr[:3], np.arange(6).reshape(3, 2))
+    with pytest.raises(ValueError, match="does not match"):
+        with iofiles.npy_writer(str(tmp_path / "y.npy")) as w:
+            w.append(np.zeros((2, 2), np.float32))
+            w.append(np.zeros((2, 3), np.float32))
+
+
+def test_read_jsonl_names_file_and_line_on_malformed_record(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"a": 1}\n{"a": oops}\n{"a": 2}\n')
+    with pytest.raises(ValueError, match=r"bad\.jsonl:2"):
+        iofiles.read_jsonl(str(p))
+
+
+def test_write_jsonl_is_atomic(tmp_path):
+    df = DataFrame.from_dict({"a": np.arange(3)})
+    p = str(tmp_path / "out.jsonl")
+    iofiles.write_jsonl(df, p)
+    assert len(open(p).readlines()) == 3
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+
+# ---------------------------------------------------------------------------
+# satellite: estimate_rows
+# ---------------------------------------------------------------------------
+
+def test_estimate_rows_jsonl_within_tolerance(tmp_path):
+    total = _write_corpus(tmp_path / "data", [500, 500, 500])
+    src = _source(tmp_path / "data")
+    est = src.estimate_rows()
+    assert abs(est - total) / total < 0.25
+    # memoized
+    assert src.estimate_rows() == est
+
+
+def test_estimate_rows_exact_for_row_range_kinds(tmp_path):
+    src = MemorySource({"x": np.zeros((77, 2))}, shard_rows=10)
+    assert src.estimate_rows() == 77
+    np.save(tmp_path / "a.npy", np.zeros((33, 2), np.float32))
+    nsrc = ShardedSource.npy(str(tmp_path / "a.npy"), shard_rows=10)
+    assert nsrc.estimate_rows() == 33
