@@ -92,6 +92,11 @@ CONFIGS = [
     # scored files out) from cold compile caches, plus a simulated-2-host
     # scan; host-driven, fine on the CPU fallback
     ("bulk-scoring", "bulk_scoring", 240, 240),
+    # deploy cold-start A/B: publish-once AOT executable ladder vs JIT
+    # warmup, each arm a FRESH subprocess hot-swapping the same artifact
+    # (first-burst latency + swap wall + byte-identity gate); subprocess
+    # arms force CPU so fingerprints match — an honest CPU A/B either way
+    ("deploy-coldstart", "deploy_coldstart", 420, 420),
     ("flagship", None, 420, 360),
     ("vit", "vit_finetune", 450, 300),
 ]

@@ -151,6 +151,12 @@ class DeepTextModel(WrapperBase):
     def getArchConfig(self):
         return self._get('arch_config')
 
+    def setAttnImpl(self, value):
+        return self._set('attn_impl', value)
+
+    def getAttnImpl(self):
+        return self._get('attn_impl')
+
     def setBatchSize(self, value):
         return self._set('batch_size', value)
 

@@ -7,6 +7,8 @@ non-stage subsystems too (compat coverage is drift-tested).
 
 
 from synapseml_tpu.registry import (  # noqa: F401
+    AOTCapture,
+    AOTExecutableSet,
     ArtifactStore,
     CanaryController,
     Deployment,
@@ -16,13 +18,19 @@ from synapseml_tpu.registry import (  # noqa: F401
     RegistryReadOnlyError,
     ResolvedModel,
     admin_load,
+    aot_mechanism,
+    apply_autotune,
     atomic_write_bytes,
+    autotune_stage,
     param_schema_hash,
+    runtime_fingerprint,
     sha256_file,
     write_stream_verified,
 )
 
 __all__ = [
+    'AOTCapture',
+    'AOTExecutableSet',
     'ArtifactStore',
     'CanaryController',
     'Deployment',
@@ -32,8 +40,12 @@ __all__ = [
     'RegistryReadOnlyError',
     'ResolvedModel',
     'admin_load',
+    'aot_mechanism',
+    'apply_autotune',
     'atomic_write_bytes',
+    'autotune_stage',
     'param_schema_hash',
+    'runtime_fingerprint',
     'sha256_file',
     'write_stream_verified',
 ]

@@ -55,6 +55,10 @@ _CACHE_METRICS = obs.HandleCache(lambda reg: {
     "misses": reg.counter(
         "synapseml_compile_cache_misses_total",
         "CompiledCache lookups that built a new executable", ("fn",)),
+    "aot_hits": reg.counter(
+        "synapseml_compile_cache_aot_hits_total",
+        "CompiledCache lookups served by a precompiled AOT executable "
+        "blob instead of tracing", ("fn",)),
     "evictions": reg.counter(
         "synapseml_compile_cache_evictions_total",
         "CompiledCache LRU evictions", ("fn",)),
@@ -262,11 +266,18 @@ class CompiledCache:
         self.capacity = int(capacity)
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, Callable]" = OrderedDict()
+        # persistent second tier: AOT executable sets installed by the
+        # deploy plane (registry/aot.py) — a miss consults these blobs
+        # before tracing; capture is the publish-time recorder
+        self._aot_providers: list = []
+        self._capture = None
         # local mirrors of the registry counters: cheap to read in tests and
         # bench loops without parsing the exposition
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.aot_hits = 0
+        self.trace_ms_total = 0.0  # wall spent in first (tracing) calls
 
     def __len__(self) -> int:
         with self._lock:
@@ -275,7 +286,32 @@ class CompiledCache:
     def stats(self) -> dict:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
-                    "evictions": self.evictions, "size": len(self._entries)}
+                    "evictions": self.evictions, "size": len(self._entries),
+                    "aot_hits": self.aot_hits,
+                    "trace_ms_total": self.trace_ms_total}
+
+    # ---- AOT second tier (registry/aot.py) ----
+    def install_aot_provider(self, provider) -> None:
+        """Add an artifact's executable-blob set as a lookup tier: misses
+        consult it before tracing (the zero-cold-start deploy path)."""
+        with self._lock:
+            if provider not in self._aot_providers:
+                self._aot_providers.append(provider)
+
+    def remove_aot_provider(self, provider) -> None:
+        """Detach a swapped-out artifact's blob tier (its in-memory entries
+        stay until evicted with the pipeline's tokens)."""
+        with self._lock:
+            try:
+                self._aot_providers.remove(provider)
+            except ValueError:
+                pass
+
+    def set_capture(self, capture) -> None:
+        """Install/clear the publish-time miss recorder
+        (``registry.aot.AOTCapture``); capture itself is thread-scoped."""
+        with self._lock:
+            self._capture = capture
 
     def miss_count(self, fn_id: str) -> float:
         """Registry-backed per-function miss count (the acceptance surface:
@@ -298,10 +334,38 @@ class CompiledCache:
                 self.hits += 1
                 m["hits"].inc(fn=fn_id)
                 return fn
+            providers = tuple(self._aot_providers)
+            capture = self._capture
+        # second tier: the deploy plane's precompiled executable blobs — a
+        # hit maps in a ready executable (no trace, no compile) and does
+        # NOT count as a miss (the zero-cold-start acceptance surface)
+        for provider in providers:
+            try:
+                fn = provider.lookup(fn_id, instance, key[2], dtype)
+            except Exception:  # noqa: BLE001 - a broken provider must never
+                fn = None      # take down serving; it just demotes to JIT
+            if fn is not None:
+                with self._lock:
+                    existing = self._entries.get(key)
+                    if existing is not None:
+                        self._entries.move_to_end(key)
+                        self.hits += 1
+                        m["hits"].inc(fn=fn_id)
+                        return existing
+                    self._entries[key] = fn
+                    self.aot_hits += 1
+                    m["aot_hits"].inc(fn=fn_id)
+                    while len(self._entries) > self.capacity:
+                        evicted_key, _ = self._entries.popitem(last=False)
+                        self.evictions += 1
+                        m["evictions"].inc(fn=evicted_key[0])
+                return fn
         # build outside the lock: builders are cheap (a jax.jit wrapper) but
         # may import jax lazily; a concurrent duplicate build is harmless
         # (last writer wins, both callables compute the same thing)
         built = build()
+        if capture is not None:
+            built = capture.wrap(key, built)
         fn = self._traced_first_call(built, fn_id, key)
         with self._lock:
             existing = self._entries.get(key)
@@ -338,8 +402,11 @@ class CompiledCache:
                                 "compile",
                                 {"fn": fn_id, "shape": str(key[2])}):
                             out = fn(*args, **kwargs)
+                        dur_ms = (time.perf_counter() - t0) * 1e3
                         _CACHE_METRICS.get()["trace_ms"].observe(
-                            (time.perf_counter() - t0) * 1e3, fn=fn_id)
+                            dur_ms, fn=fn_id)
+                        with self._lock:
+                            self.trace_ms_total += dur_ms
                         state["first"] = False
                         return out
             return fn(*args, **kwargs)

@@ -984,6 +984,12 @@ def worker_main(pipeline_path: str, registry_address: str,
         info = {"host": server.host, "port": server.port,
                 "pid": os.getpid(),
                 "version": server.pipeline_holder.version}
+        # fleet-swap observability: whether this worker's last hot swap
+        # rode the AOT executable path (registry/aot.py) — the front's
+        # worker listing shows at a glance if a rollout was compile-bound
+        report = getattr(server, "last_swap_report", None)
+        if report:
+            info["aot"] = report.get("mode")
         urllib.request.urlopen(urllib.request.Request(
             registry_address, data=json.dumps(info).encode(), method="POST",
             headers={"Content-Type": "application/json"}), timeout=30).read()

@@ -35,7 +35,7 @@ from ..core import observability as obs
 from ..core.dataframe import DataFrame
 
 __all__ = ["ServingServer", "serve_pipeline", "serve_llm",
-           "NoDelayHTTPServer", "PipelineHolder"]
+           "NoDelayHTTPServer", "PipelineHolder", "run_warmup"]
 
 # batch-size histogram rungs: one bucket per pow-2 occupancy up to the
 # serve-loop max (NOT latency buckets — these count rows per micro-batch)
@@ -217,6 +217,10 @@ class ServingServer:
         # timeout; big batches amortize a compile stall anyway)
         self._bucket_ladder: tuple | None = None
         self._warmup_buckets: tuple = ()
+        # the live pipeline's AOT blob tier (registry/aot.py) + the last
+        # swap's warmup breakdown (operators + fleet registration read it)
+        self._aot_provider = None
+        self.last_swap_report: dict | None = None
         # bounded: a stalled pipeline sheds load with 503s instead of parking
         # unbounded connections (backpressure the round-1 loop lacked)
         self._queue: "queue.Queue[_Exchange]" = queue.Queue(maxsize=max_queue)
@@ -392,28 +396,9 @@ class ServingServer:
         (zero-compile-stall, extending PR-3's zero-drop guarantee). Raises
         on any transform failure — a pipeline that cannot serve its warmup
         batch must never be swapped in."""
-        if not rows:
-            return 0
-        bodies = [r if isinstance(r, bytes)
-                  else (r.encode() if isinstance(r, str)
-                        else json.dumps(r).encode()) for r in rows]
         if buckets is None:
             buckets = list(self._warmup_buckets)
-        sizes = sorted({int(b) for b in buckets} | {len(bodies)})
-        total = 0
-        for size in sizes:
-            batch_bodies = [bodies[i % len(bodies)] for i in range(size)]
-            batch = DataFrame([{
-                "id": np.asarray([f"warmup-{i}" for i in range(size)],
-                                 dtype=object),
-                "method": np.asarray(["POST"] * size, dtype=object),
-                "path": np.asarray(["/"] * size, dtype=object),
-                "body": np.asarray(batch_bodies, dtype=object),
-            }])
-            batch = _prepare_batch(batch, **self._loop_cfg)
-            stage.transform(batch)
-            total += size
-        return total
+        return run_warmup(stage, rows, buckets, self._loop_cfg)
 
     def _admin_load(self, body: bytes) -> tuple[int, dict]:
         """Load a new pipeline version side-by-side, warm it, atomically
@@ -423,7 +408,19 @@ class ServingServer:
         old pipeline keeps serving until the instant of the swap; a load or
         warmup failure leaves it untouched (409). ``"warmup_buckets"``
         overrides the precompile sizes (default: the server's configured
-        bucket ladder)."""
+        bucket ladder).
+
+        Registry artifacts published with AOT executable ladders load
+        through the zero-cold-start path: the manifest's blob set installs
+        as a CompiledCache second tier, the manifest-recorded warmup
+        replays at the FULL ladder (the PR-4 "rungs <= 64" default cap is
+        lifted — loading an executable is I/O, not compile), and the reply
+        carries a ``warmup`` breakdown (io_ms / compile_ms / aot_hits /
+        aot_misses / executables loaded vs traced). A runtime-fingerprint
+        mismatch or missing mechanism logs one structured warning and
+        falls back to JIT warmup — it never fails the swap. ``"aot":
+        false`` / ``"autotune": false`` opt out per load (the coldstart
+        bench's A/B switch)."""
         holder = self.pipeline_holder
         if holder is None:
             return 409, {"error": "this server has no swappable pipeline "
@@ -435,6 +432,12 @@ class ServingServer:
         if not isinstance(payload, dict):
             return 400, {"error": "body must be a JSON object"}
         t0 = time.perf_counter()
+        manifest = None
+        provider = None
+        stage = None
+        fallback_reason = None
+        autotune_applied = None
+        cache = cb.get_compiled_cache()
         try:
             if "path" in payload:
                 from ..core.serialization import load_stage
@@ -443,31 +446,114 @@ class ServingServer:
                 version = (payload.get("version")
                            or os.path.basename(
                                str(payload["path"]).rstrip("/")))
+                aot_dir = None
             elif "registry" in payload and "model" in payload:
                 from ..registry.registry import ModelRegistry
 
                 resolved = ModelRegistry(payload["registry"]).resolve(
                     payload["model"], payload.get("ref", "latest"))
                 stage, version = resolved.stage, resolved.version
+                manifest = resolved.manifest
+                aot_dir = os.path.join(os.path.dirname(resolved.path), "aot")
             else:
                 return 400, {"error":
                              "body needs 'path' or 'registry'+'model'"}
-            warmed = self._warmup(stage, payload.get("warmup") or [],
-                                  payload.get("warmup_buckets"))
+            resolve_ms = (time.perf_counter() - t0) * 1e3
+            from ..registry import aot as raot
+
+            # pin the artifact's autotuned backends before any warmup or
+            # ordinal binding (publish captured with the winners applied)
+            tune = (manifest or {}).get("autotune")
+            if tune and payload.get("autotune", True):
+                from ..registry.autotune import apply_autotune
+
+                autotune_applied = apply_autotune(stage, tune)
+            aot_cfg = (manifest or {}).get("aot") or {}
+            warmup_rows = payload.get("warmup") or []
+            warmup_buckets = payload.get("warmup_buckets")
+            if aot_cfg.get("entries"):
+                if not payload.get("aot", True):
+                    fallback_reason = "aot disabled by request"
+                elif tune and not payload.get("autotune", True):
+                    # the shipped executables were compiled with the tuned
+                    # backends baked in — serving them under saved-default
+                    # configs would silently run the tuned kernels anyway
+                    fallback_reason = ("autotune disabled by request but "
+                                       "the aot executables were compiled "
+                                       "with the tuned backends")
+                else:
+                    fallback_reason = raot.load_blocker(aot_cfg)
+                recorded = aot_cfg.get("warmup", {})
+                # the manifest-recorded rows drive warmup either way; a
+                # JIT fallback keeps the default small-rung cap — its
+                # compiles are real again
+                warmup_rows = warmup_rows or recorded.get("rows") or []
+                if fallback_reason is None:
+                    provider = raot.AOTExecutableSet(aot_cfg, aot_dir)
+                    # the rung cap lifts ONLY for true zero-compile loads:
+                    # 'export' blobs skip tracing but still XLA-compile at
+                    # load, so replaying the full ladder could outlast the
+                    # deploy-plane timeout exactly like JIT warmup would
+                    if warmup_buckets is None \
+                            and provider.mechanism == "xla":
+                        warmup_buckets = recorded.get("buckets")
+                else:
+                    raot.log_fallback(fallback_reason,
+                                      model=payload.get("model"),
+                                      version=version)
+            stats0 = cache.stats()
+            if provider is not None:
+                cache.install_aot_provider(provider)
+                provider.begin_binding()
+            try:
+                warmed = self._warmup(stage, warmup_rows, warmup_buckets)
+            finally:
+                if provider is not None:
+                    provider.freeze()
+            stats1 = cache.stats()
         except Exception as e:  # noqa: BLE001 - any failure must 409, not swap
+            if provider is not None:
+                cache.remove_aot_provider(provider)
+            if stage is not None:
+                # the discarded candidate's warmed entries would otherwise
+                # pin its weights in the cache with no owner to evict them
+                cb.release_executables(stage)
             _SERVING_METRICS.get()["swaps"].inc(outcome="failed")
             return 409, {"error": f"{type(e).__name__}: {e}"}
+        breakdown = {
+            "mode": "aot" if provider is not None else "jit",
+            "fallback_reason": fallback_reason,
+            "io_ms": round(resolve_ms
+                           + (provider.io_ms if provider else 0.0), 2),
+            "compile_ms": round(stats1["trace_ms_total"]
+                                - stats0["trace_ms_total"], 2),
+            "aot_hits": provider.hits if provider else 0,
+            "aot_misses": provider.misses if provider else 0,
+            "aot_errors": provider.errors if provider else 0,
+            "executables_loaded": provider.loaded if provider else 0,
+            "executables_traced": stats1["misses"] - stats0["misses"],
+            "rows": warmed,
+        }
+        if autotune_applied:
+            breakdown["autotune"] = autotune_applied
+        raot.emit_load_metrics(breakdown)
         replaced = holder.pipeline
         previous = holder.swap(stage, version)
         # evict the replaced pipeline's executables: every swap would
         # otherwise pin one more dead model's weights in the CompiledCache
         # until LRU churn (in-flight batches on the old pipeline keep their
-        # callables; they just can't be re-acquired)
+        # callables; they just can't be re-acquired) — and detach its AOT
+        # blob tier
         if replaced is not stage:
             cb.release_executables(replaced)
+        old_provider = self._aot_provider
+        if old_provider is not None and old_provider is not provider:
+            cache.remove_aot_provider(old_provider)
+        self._aot_provider = provider
+        self.last_swap_report = breakdown
         _SERVING_METRICS.get()["swaps"].inc(outcome="ok")
         return 200, {"ok": True, "version": version, "previous": previous,
-                     "warmup_rows": warmed,
+                     "warmup_rows": warmed, "warmup": breakdown,
                      "load_ms": round((time.perf_counter() - t0) * 1e3, 2)}
 
     # ---- micro-batch source/sink API (HTTPMicroBatchReader / HTTPWriter) ----
@@ -604,6 +690,36 @@ class ServingServer:
                 ex.respond(reply, status=status)
                 n += 1
         return n
+
+
+def run_warmup(stage, rows: list, buckets: list, loop_cfg: dict) -> int:
+    """The ONE warmup drive shared by ``/admin/load`` (precompile before a
+    hot swap) and publish-time AOT capture (``registry/aot.py``): cycle
+    ``rows`` (JSON-able request bodies) up to each bucket size and
+    transform once per bucket through the EXACT serve-loop batch
+    preparation. The two callers sharing this path is what makes AOT
+    ordinal binding sound — publish capture and load warmup replay the
+    same stage-execution order. Returns total rows driven."""
+    if not rows:
+        return 0
+    bodies = [r if isinstance(r, bytes)
+              else (r.encode() if isinstance(r, str)
+                    else json.dumps(r).encode()) for r in rows]
+    sizes = sorted({int(b) for b in buckets} | {len(bodies)})
+    total = 0
+    for size in sizes:
+        batch_bodies = [bodies[i % len(bodies)] for i in range(size)]
+        batch = DataFrame([{
+            "id": np.asarray([f"warmup-{i}" for i in range(size)],
+                             dtype=object),
+            "method": np.asarray(["POST"] * size, dtype=object),
+            "path": np.asarray(["/"] * size, dtype=object),
+            "body": np.asarray(batch_bodies, dtype=object),
+        }])
+        batch = _prepare_batch(batch, **loop_cfg)
+        stage.transform(batch)
+        total += size
+    return total
 
 
 def _prepare_batch(batch: DataFrame, parse_json: bool = True,

@@ -190,6 +190,19 @@ class DeepTextModel(Model, _TextParams):
                                "fits; None = resolve checkpoint preset)", default=None)
     tokenizer_config = ComplexParam("tokenizer_config", "tokenizer config dict")
     train_metrics = ComplexParam("train_metrics", "loss/throughput trace", default=None)
+    attn_impl = Param("attn_impl", "serve-time attention backend override: "
+                      "einsum | flash (None = the trained arch's choice); "
+                      "pure kernel selection — the param tree is unchanged",
+                      default=None,
+                      validator=lambda v: v in (None, "einsum", "flash"))
+
+    # publish-time backend search (registry/autotune.py): the single-chip
+    # attention impls the attn_backends decision bench compares — the
+    # fastest per platform is pinned into the artifact manifest at publish
+    # and re-applied at /admin/load. Declared on the MODEL (the class
+    # artifacts actually serve), not the estimator: ring/ulysses need a
+    # mesh topology and stay out of the serve-path search.
+    _AUTOTUNE_PARAMS = {"attn_impl": ("einsum", "flash")}
 
     def __init__(self, **kw):
         super().__init__(**kw)
@@ -200,7 +213,8 @@ class DeepTextModel(Model, _TextParams):
         cb.invalidate_token(self)
 
     _APPLY_KEYS = frozenset({"model_params", "arch_config", "tokenizer_config",
-                             "checkpoint", "num_classes", "mesh_config"})
+                             "checkpoint", "num_classes", "mesh_config",
+                             "attn_impl"})
 
     def set(self, **kw):
         out = super().set(**kw)
@@ -223,6 +237,13 @@ class DeepTextModel(Model, _TextParams):
 
                 cfg = _resolve_arch(self.get("checkpoint"))(vocab_size=tok.vocab_size)
                 cfg = legacy_prenorm_fixup(cfg, self.get("model_params"))
+            if self.get("attn_impl"):
+                import dataclasses
+
+                # serve-time kernel override (the autotune pin): same math,
+                # same param tree, different attention impl
+                cfg = dataclasses.replace(cfg,
+                                          attn_impl=self.get("attn_impl"))
             module = BertClassifier(cfg, num_classes=self.get("num_classes"))
 
             params = self.get("model_params")

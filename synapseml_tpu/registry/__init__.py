@@ -12,6 +12,9 @@ from .store import (ArtifactStore, IntegrityError, atomic_write_bytes,
 from .registry import (ModelRegistry, PublishedVersion, RegistryReadOnlyError,
                        ResolvedModel, param_schema_hash)
 from .deploy import CanaryController, Deployment, admin_load
+from .aot import (AOTCapture, AOTExecutableSet, aot_mechanism,
+                  runtime_fingerprint)
+from .autotune import apply_autotune, autotune_stage
 
 __all__ = [
     "ArtifactStore",
@@ -27,4 +30,10 @@ __all__ = [
     "sha256_file",
     "atomic_write_bytes",
     "write_stream_verified",
+    "AOTCapture",
+    "AOTExecutableSet",
+    "aot_mechanism",
+    "runtime_fingerprint",
+    "autotune_stage",
+    "apply_autotune",
 ]

@@ -64,17 +64,27 @@ def _post_json(url: str, payload: dict, timeout_s: float) -> dict:
 
 def admin_load(endpoint: str, registry_root: str, model: str, ref: str,
                warmup: list | None = None, version: str | None = None,
-               timeout_s: float = 120.0) -> dict:
+               timeout_s: float = 120.0, warmup_buckets: list | None = None,
+               use_aot: bool = True, use_autotune: bool = True) -> dict:
     """Hot-swap one worker (``endpoint`` = ``http://host:port``) to a
     registry version via its ``POST /admin/load``. Returns the worker's
-    reply (``{"ok": true, "version": ..., "previous": ...}``); raises with
-    the worker's error detail when the load or warmup failed (the worker
-    keeps serving its old pipeline in that case)."""
+    reply (``{"ok": true, "version": ..., "previous": ..., "warmup":
+    {<breakdown>}}``); raises with the worker's error detail when the load
+    or warmup failed (the worker keeps serving its old pipeline in that
+    case). ``use_aot=False`` / ``use_autotune=False`` force the JIT-warmup
+    / saved-defaults path even when the artifact ships AOT executables or
+    autotuned backend pins (the coldstart bench's A/B switches)."""
     payload: dict = {"registry": registry_root, "model": model, "ref": ref}
     if warmup:
         payload["warmup"] = list(warmup)
+    if warmup_buckets:
+        payload["warmup_buckets"] = [int(b) for b in warmup_buckets]
     if version:
         payload["version"] = version
+    if not use_aot:
+        payload["aot"] = False
+    if not use_autotune:
+        payload["autotune"] = False
     return _post_json(endpoint.rstrip("/") + "/admin/load", payload,
                       timeout_s)
 
@@ -90,13 +100,17 @@ class Deployment:
 
     def __init__(self, serving, registry, model: str,
                  warmup: list | None = None, alias: str = "prod",
-                 timeout_s: float = 120.0):
+                 timeout_s: float = 120.0, use_aot: bool = True):
         self.serving = serving
         self.registry = registry
         self.model = model
         self.alias = alias
         self.warmup = list(warmup or [])
         self.timeout_s = timeout_s
+        self.use_aot = use_aot
+        # per-rollout aggregate of the workers' warmup breakdowns — the
+        # operator's one-glance answer to "did this rollout ride AOT?"
+        self.last_rollout: dict | None = None
         self._controller: CanaryController | None = None
 
     # -- fleet introspection ----------------------------------------------
@@ -129,8 +143,35 @@ class Deployment:
         for w in targets:
             replies.append(admin_load(
                 self._endpoint(w), self.registry.root, self.model, ref,
-                warmup=self.warmup, timeout_s=self.timeout_s))
+                warmup=self.warmup, timeout_s=self.timeout_s,
+                use_aot=self.use_aot))
+        self.last_rollout = self._rollout_summary(ref, replies)
         return replies
+
+    @staticmethod
+    def _rollout_summary(ref: str, replies: list[dict]) -> dict:
+        """Aggregate the workers' /admin/load warmup breakdowns: total
+        swap wall, AOT hit/trace counts, and which workers fell back to
+        JIT (a mixed fleet is the signal an operator needs to see)."""
+        summary = {"ref": ref, "workers": len(replies),
+                   "total_load_ms": 0.0, "io_ms": 0.0, "compile_ms": 0.0,
+                   "aot_hits": 0, "executables_traced": 0,
+                   "modes": {}, "fallback_reasons": []}
+        for reply in replies:
+            summary["total_load_ms"] += float(reply.get("load_ms", 0.0))
+            wu = reply.get("warmup") or {}
+            summary["io_ms"] += float(wu.get("io_ms", 0.0))
+            summary["compile_ms"] += float(wu.get("compile_ms", 0.0))
+            summary["aot_hits"] += int(wu.get("aot_hits", 0))
+            summary["executables_traced"] += int(
+                wu.get("executables_traced", 0))
+            mode = wu.get("mode", "jit")
+            summary["modes"][mode] = summary["modes"].get(mode, 0) + 1
+            if wu.get("fallback_reason"):
+                summary["fallback_reasons"].append(wu["fallback_reason"])
+        for field in ("total_load_ms", "io_ms", "compile_ms"):
+            summary[field] = round(summary[field], 2)
+        return summary
 
     def _wait_registered(self, version: str, n: int,
                          timeout_s: float = 10.0) -> None:
@@ -214,7 +255,8 @@ class Deployment:
                 try:
                     admin_load(self._endpoint(w), self.registry.root,
                                self.model, stable, warmup=self.warmup,
-                               timeout_s=self.timeout_s)
+                               timeout_s=self.timeout_s,
+                               use_aot=self.use_aot)
                 except (RuntimeError, OSError):
                     # an unreachable canary worker stays excluded by the
                     # split; the supervisor/breaker planes own its health

@@ -220,11 +220,27 @@ class ModelRegistry:
     # -- publish -----------------------------------------------------------
     def publish(self, name: str, stage, version: str | None = None,
                 metrics: dict | None = None, extra: dict | None = None,
-                set_latest: bool = True) -> PublishedVersion:
+                set_latest: bool = True, aot: dict | None = None,
+                autotune: dict | None = None) -> PublishedVersion:
         """Save ``stage``, blobify its tree, and write the signed manifest.
         ``version`` defaults to the next ``v<N>``; ``metrics`` is the
         caller's evaluation snapshot at publish time (what the deployment
-        plane compares a canary against)."""
+        plane compares a canary against).
+
+        ``aot`` turns on publish-time AOT compilation of the serve ladder
+        (the TVM pay-compile-once discipline — ``registry/aot.py``):
+        ``{"rows": [<sample request bodies>], "buckets": [...],
+        "input_col": ..., "parse_json": ...}``. A fresh reload of the
+        saved artifact is driven through the serve-loop warmup at every
+        bucket, each compiled executable is serialized and stored
+        content-addressed next to the weights, and the manifest records
+        the entries + the runtime fingerprint (platform, jax/jaxlib,
+        XLA-flags sha) that gates their reuse. ``buckets`` defaults to the
+        process-wide ladder. ``autotune`` (same ``rows``-driven harness,
+        plus optional ``{"winners": {...}}`` overrides from the decision
+        benches) searches any stage-declared ``_AUTOTUNE_PARAMS`` backend
+        candidates and pins the fastest per platform into the manifest —
+        the AOT capture then compiles the winning kernels."""
         store = self._require_local("publish")
         _safe_component(name)
         version = _safe_component(version or self.next_version(name))
@@ -232,12 +248,16 @@ class ModelRegistry:
             raise FileExistsError(
                 f"{name}:{version} already published (versions are "
                 "immutable; pick a new version or alias)")
+        aot_section = tune_section = None
         with tempfile.TemporaryDirectory(prefix="synapseml_publish_") as tmp:
             stage_dir = os.path.join(tmp, "stage")
             serialization.save_stage(stage, stage_dir)
             files = store.ingest_tree(stage_dir)
             stages = _stage_classes(stage_dir)
             schema_hash = param_schema_hash(stage_dir)
+            if aot is not None or autotune is not None:
+                aot_section, tune_section = self._publish_compile(
+                    stage_dir, store, aot, autotune)
         manifest = {
             "name": name,
             "version": version,
@@ -249,12 +269,57 @@ class ModelRegistry:
             "files": files,
             "total_bytes": sum(e["bytes"] for e in files),
         }
+        if aot_section is not None:
+            manifest["aot"] = aot_section
+        if tune_section is not None:
+            manifest["autotune"] = tune_section
         if extra:
             manifest["extra"] = dict(extra)
         path = store.write_manifest(name, version, manifest)
         if set_latest:
             store.write_alias(name, "latest", version)
         return PublishedVersion(name, version, manifest, path)
+
+    def _publish_compile(self, stage_dir: str, store: ArtifactStore,
+                         aot: dict | None, autotune: dict | None):
+        """The offline compile pass: reload the JUST-SAVED artifact (fresh
+        instances — exactly what a worker will load, with no warm cache
+        entries hiding rungs from capture), autotune backends first (the
+        capture must compile the winners), then AOT the ladder."""
+        from ..core import batching as cb
+        from . import aot as raot
+
+        spec = dict(aot or {})
+        rows = spec.get("rows") or (autotune or {}).get("rows")
+        if not rows and aot is not None:
+            raise ValueError(
+                "publish(aot=...) needs sample request rows to drive the "
+                "ladder: aot={'rows': [<request bodies>], ...}")
+        loop_cfg = {"parse_json": spec.get("parse_json", True),
+                    "input_col": spec.get("input_col", "body")}
+        buckets = spec.get("buckets")
+        if buckets is None:
+            buckets = cb.default_bucketer().buckets_upto(
+                int(spec.get("max_rows", cb.default_bucketer().max_bucket)))
+        loaded = serialization.load_stage(stage_dir)
+        tune_section = None
+        if autotune is not None:
+            from .autotune import autotune_stage
+
+            tune_section = autotune_stage(
+                loaded, rows or [], buckets, loop_cfg,
+                trials=int(autotune.get("trials", 2)),
+                winners=autotune.get("winners"))
+            # the search drove every stage through the process cache —
+            # evict the tree's executables so the capture below sees
+            # FRESH misses (warm entries would hide whole rungs from the
+            # AOT artifact)
+            cb.release_executables(loaded)
+        aot_section = None
+        if aot is not None:
+            aot_section = raot.capture_stage_ladder(
+                loaded, rows, buckets, loop_cfg, store.put_blob_bytes)
+        return aot_section, tune_section
 
     # -- resolve -----------------------------------------------------------
     def resolve(self, name: str, ref: str = "latest") -> ResolvedModel:
@@ -277,6 +342,11 @@ class ModelRegistry:
                     self._materialize(name, version, manifest, dest)
                     with open(marker, "w") as f:
                         f.write(version)
+        else:
+            # marker present: the stage tree is complete, but AOT blobs
+            # that failed a transient fetch self-heal here (cheap isfile
+            # scan when everything is already on disk)
+            self._ensure_aot_blobs(manifest, dest)
         stage = serialization.load_stage(os.path.join(dest, "stage"))
         return ResolvedModel(stage=stage, name=name, version=version,
                              manifest=manifest,
@@ -302,6 +372,7 @@ class ModelRegistry:
         cache_store.materialize_tree(
             manifest["files"], stage_root,
             fetch=fetch if self.is_remote else None)
+        self._ensure_aot_blobs(manifest, dest)
         got = param_schema_hash(stage_root)
         want = manifest.get("param_schema_sha256")
         if want and got != want:
@@ -309,6 +380,39 @@ class ModelRegistry:
                 f"{name}:{version} param schema hash mismatch: manifest "
                 f"{want}, materialized {got} — artifact and manifest "
                 "disagree")
+
+    def _ensure_aot_blobs(self, manifest: dict, dest: str) -> None:
+        """Materialize the manifest's AOT executable blobs into
+        ``dest/aot/<sha256>``. Idempotent and SELF-HEALING: called on every
+        resolve (cheap ``isfile`` checks once present), so a transient
+        fetch failure is retried next resolve instead of becoming a
+        permanent per-worker JIT fallback behind the ``.complete`` marker.
+        A still-missing blob is skipped, never fatal — the load path
+        demotes that entry to tracing."""
+        entries = (manifest.get("aot") or {}).get("entries", ())
+        if not entries:
+            return
+        cache_store = ArtifactStore(self.cache_dir) if self.is_remote \
+            else self._store
+        for entry in entries:
+            digest = entry.get("sha256")
+            if not digest:
+                continue
+            blob_dest = os.path.join(dest, "aot", digest)
+            if os.path.isfile(blob_dest):
+                continue
+            try:
+                if self.is_remote:
+                    blob = cache_store.blob_path(digest)
+                    if not cache_store.has_blob(digest):
+                        os.makedirs(os.path.dirname(blob), exist_ok=True)
+                        with self._open_remote(f"blobs/{digest}") as r:
+                            write_stream_verified(r, blob, digest)
+                    cache_store.materialize_blob(digest, blob_dest)
+                else:
+                    cache_store.materialize_blob(digest, blob_dest)
+            except (OSError, RuntimeError, IntegrityError):
+                continue
 
     # -- pin (atomic alias swap) -------------------------------------------
     def pin(self, name: str, alias: str, ref: str) -> str:

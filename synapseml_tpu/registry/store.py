@@ -350,6 +350,76 @@ class ArtifactStore:
         except OSError:
             return []
 
+    # -- garbage collection ------------------------------------------------
+    def referenced_blobs(self) -> set[str]:
+        """Every blob digest any manifest references: the stage-tree
+        ``files`` plus AOT executable ``entries``. Aliases point at
+        versions, so their references are already covered by the version
+        manifests."""
+        refs: set[str] = set()
+        for name in self.list_models():
+            for version in self.list_versions(name):
+                try:
+                    manifest = self.read_manifest(name, version,
+                                                  verify=False)
+                except (OSError, json.JSONDecodeError):
+                    continue  # unreadable manifest: prune nothing it names
+                for entry in manifest.get("files", ()):
+                    refs.add(entry.get("sha256"))
+                for entry in (manifest.get("aot") or {}).get("entries", ()):
+                    refs.add(entry.get("sha256"))
+        refs.discard(None)
+        return refs
+
+    def gc(self, dry_run: bool = False, min_age_s: float = 3600.0) -> dict:
+        """Prune blobs unreferenced by any manifest (orphans from failed
+        publishes accumulate forever; AOT executable ladders multiply
+        store size, so dead versions now leave real garbage).
+
+        ``dry_run=True`` reports without deleting. ``min_age_s`` protects
+        blobs younger than the window — a concurrent publish writes blobs
+        BEFORE its manifest, and gc must never eat an in-flight publish's
+        blobs. Returns ``{"scanned", "referenced", "pruned",
+        "bytes_freed", "kept_young", "dry_run"}``."""
+        import time
+
+        blobs_dir = os.path.join(self.root, "blobs")
+        try:
+            names = sorted(os.listdir(blobs_dir))
+        except OSError:
+            names = []
+        refs = self.referenced_blobs()
+        now = time.time()
+        pruned: list[str] = []
+        bytes_freed = 0
+        kept_young = 0
+        scanned = 0
+        for fname in names:
+            if len(fname) != 64 or not all(c in "0123456789abcdef"
+                                           for c in fname):
+                continue  # temp files belong to the writers' cleanup
+            scanned += 1
+            if fname in refs:
+                continue
+            path = os.path.join(blobs_dir, fname)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            if now - st.st_mtime < min_age_s:
+                kept_young += 1
+                continue
+            pruned.append(fname)
+            bytes_freed += st.st_size
+            if not dry_run:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        return {"scanned": scanned, "referenced": len(refs),
+                "pruned": pruned, "bytes_freed": bytes_freed,
+                "kept_young": kept_young, "dry_run": dry_run}
+
     # -- aliases (atomically-swapped pointer files) ------------------------
     def write_alias(self, name: str, alias: str, version: str) -> None:
         path = self.alias_path(name, alias)

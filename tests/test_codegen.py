@@ -147,7 +147,11 @@ def test_no_inline_jit_in_stage_transform():
     ``models/fused_trainer.py`` and ``gbdt/fused.py``) is likewise bound:
     its one-executable-per-trial-rung guarantee rests on every jit going
     through the cache, where the miss counters the parity suite asserts on
-    can see them."""
+    can see them. The AOT deploy plane (``registry/aot.py`` capture/load,
+    ``registry/autotune.py`` search) is bound too: its jit touches live in
+    ``_build*`` helpers only, so publish-time capture and load-time
+    deserialization stay visible to the same counters the zero-trace
+    acceptance test reads."""
     import ast
 
     modules = ["onnx/model.py", "hf/embedder.py", "hf/causal_lm.py",
@@ -156,7 +160,8 @@ def test_no_inline_jit_in_stage_transform():
                "io/serving.py",
                "automl/tune.py", "automl/hyperparams.py",
                "models/fused_trainer.py", "gbdt/fused.py",
-               "scoring/planner.py", "scoring/runner.py", "scoring/sink.py"]
+               "scoring/planner.py", "scoring/runner.py", "scoring/sink.py",
+               "registry/aot.py", "registry/autotune.py"]
     pkg = pathlib.Path(st.__file__).parent
     offenders = []
     for rel in modules:
