@@ -97,6 +97,11 @@ CONFIGS = [
     # (first-burst latency + swap wall + byte-identity gate); subprocess
     # arms force CPU so fingerprints match — an honest CPU A/B either way
     ("deploy-coldstart", "deploy_coldstart", 420, 420),
+    # sharded-train A/B: replicated vs ZeRO-sharded weight update, each arm
+    # a FRESH subprocess on a 4-device CPU mesh (per-replica opt-state
+    # bytes <= 1/dp + eps, step-time >= 0.9x, f32 param parity); the
+    # fresh-arm subprocesses force CPU, honest on the fallback
+    ("sharded-train", "sharded_train", 300, 300),
     ("flagship", None, 420, 360),
     ("vit", "vit_finetune", 450, 300),
 ]

@@ -1,0 +1,188 @@
+"""Sharded-train A/B: replicated vs ZeRO-sharded weight update, same round.
+
+Two arms train the SAME MLP for the same optimizer steps over the same
+seeded :class:`~synapseml_tpu.data.DataLoader` stream, each in a FRESH
+subprocess forced onto a multi-device CPU mesh (4 virtual devices — the
+deploy-coldstart fresh-arm discipline, so neither arm inherits the other's
+compile cache and the parent backend's device count doesn't matter):
+
+  (a) replicated — the status-quo trainer: optimizer state replicated on
+      every data-parallel replica;
+  (b) zero       — ``TrainerConfig(partition_rules=..., zero_shard=True)``:
+      the optimizer state partitions over the ``('data','fsdp')`` replica
+      group inside the one jitted step (arXiv:2004.13336).
+
+Reports per arm: per-replica and total optimizer-state bytes (measured
+from the live shardings), warm per-step wall time, final loss; plus the
+cross-arm bars — per-replica opt-state bytes <= replicated/dp + epsilon,
+step-time ratio >= 0.9x, final-loss delta 0.0 and final-params max abs
+diff at f32. CPU A/B per the bench discipline; TPU numbers land
+opportunistically when the relay cooperates. Prints one JSON line.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+DEVICES = 4
+D_IN = 64
+HIDDEN = 512
+BATCH = 256
+STEPS = 40
+WARM_SKIP = 4  # steps excluded from the warm per-step wall (compiles)
+EPS_BYTES = 8192  # unshardable leaves: count scalar + small bias moments
+
+
+def _arm_main(arm: str, out_path: str) -> None:
+    """Runs inside the fresh subprocess: train one arm, dump the record +
+    final params."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import flax.linen as nn
+
+    from synapseml_tpu.data import DataLoader
+    from synapseml_tpu.data.source import MemorySource
+    from synapseml_tpu.models.trainer import Trainer, TrainerConfig
+    from synapseml_tpu.parallel import partition as pp
+    from synapseml_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.relu(nn.Dense(HIDDEN)(x))
+            h = nn.relu(nn.Dense(HIDDEN)(h))
+            return nn.Dense(2)(h)
+
+    rs = np.random.default_rng(0)
+    X = rs.normal(size=(4096, D_IN)).astype(np.float32)
+    data = {"x": X, "labels": (X[:, 0] > 0).astype(np.int32)}
+
+    mesh = create_mesh(MeshConfig(data=-1))
+    dp = mesh.data_parallel_size()
+    cfg = TrainerConfig(total_steps=STEPS, learning_rate=1e-2)
+    if arm == "zero":
+        cfg.partition_rules = pp.PartitionRules(
+            zero_axes=("data", "fsdp"), mesh=mesh.config)
+        cfg.zero_shard = True
+    trainer = Trainer(MLP(), mesh, cfg)
+    loader = DataLoader(MemorySource(data), BATCH, seed=13, multiple_of=dp)
+    it = iter(loader)
+    first = next(it)
+    state = trainer.init_state(first, jax.random.PRNGKey(3))
+
+    losses: list = []
+    step_walls: list = []
+    t_prev = [time.perf_counter()]
+
+    def cb(i, metrics):
+        losses.append(float(metrics["loss"]))
+        now = time.perf_counter()
+        step_walls.append(now - t_prev[0])
+        t_prev[0] = now
+
+    def chain():
+        yield first
+        yield from it
+
+    t0 = time.perf_counter()
+    state = trainer.fit(state, chain(), max_steps=STEPS, callback=cb)
+    wall = time.perf_counter() - t0
+    loader.close()
+
+    host_params = jax.tree.map(lambda x: np.asarray(x, np.float32),
+                               state.params)
+    np.savez(out_path + ".params.npz",
+             **{str(i): leaf for i, leaf in
+                enumerate(jax.tree.leaves(host_params))})
+    record = {
+        "arm": arm, "dp": dp, "steps": int(state.step),
+        "final_loss": losses[-1],
+        "wall_s": round(wall, 3),
+        "warm_step_ms": round(
+            1e3 * float(np.mean(step_walls[WARM_SKIP:])), 3),
+        "opt_bytes_total": pp.total_bytes(state.opt_state),
+        "opt_bytes_per_replica": pp.per_device_bytes(state.opt_state),
+        "param_bytes_total": pp.total_bytes(state.params),
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f)
+
+
+def _run_arm(arm: str, tmp: str) -> dict:
+    out_path = os.path.join(tmp, f"{arm}.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={DEVICES}"
+                        ).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--arm", arm, out_path],
+        env=env, capture_output=True, text=True, timeout=240)
+    if proc.returncode != 0:
+        raise RuntimeError(f"{arm} arm failed:\n{proc.stdout}\n{proc.stderr}")
+    with open(out_path) as f:
+        record = json.load(f)
+    params = np.load(out_path + ".params.npz")
+    record["_params"] = [params[k] for k in sorted(params, key=int)]
+    return record
+
+
+def run(jax, platform, n_chips):
+    tmp = tempfile.mkdtemp(prefix="synapseml_shardedtrain_")
+    try:
+        replicated = _run_arm("replicated", tmp)
+        zero = _run_arm("zero", tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    param_diff = max(
+        float(np.max(np.abs(a - b))) if a.size else 0.0
+        for a, b in zip(replicated.pop("_params"), zero.pop("_params")))
+    dp = zero["dp"]
+    opt_ratio = (zero["opt_bytes_per_replica"]
+                 / max(replicated["opt_bytes_per_replica"], 1))
+    step_ratio = (replicated["warm_step_ms"]
+                  / max(zero["warm_step_ms"], 1e-9))
+    loss_delta = abs(replicated["final_loss"] - zero["final_loss"])
+    bars = {
+        "opt_bytes_bound": zero["opt_bytes_per_replica"]
+        <= replicated["opt_bytes_per_replica"] / dp + EPS_BYTES,
+        "step_time_ratio_ge_0p9": step_ratio >= 0.9,
+        "loss_delta_zero": loss_delta <= 1e-5,
+        "param_parity_f32": param_diff <= 5e-6,
+    }
+    return {
+        "benchmark": "sharded_train", "platform": platform,
+        "mode": "cpu_ab" if platform != "tpu" else "tpu_ab",
+        "devices_per_arm": DEVICES, "dp": dp, "steps": STEPS,
+        "replicated": replicated, "zero": zero,
+        "opt_bytes_per_replica_ratio": round(opt_ratio, 4),
+        "step_time_ratio": round(step_ratio, 3),
+        "final_loss_delta": loss_delta,
+        "param_max_abs_diff": param_diff,
+        "bars": bars, "all_bars_pass": all(bars.values()),
+    }
+
+
+def main():
+    if len(sys.argv) >= 4 and sys.argv[1] == "--arm":
+        _arm_main(sys.argv[2], sys.argv[3])
+        return
+    from benchmarks._common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    print(json.dumps(run(jax, platform, n_chips)))
+
+
+if __name__ == "__main__":
+    main()

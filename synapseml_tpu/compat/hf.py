@@ -103,6 +103,12 @@ class HuggingFaceCausalLM(WrapperBase):
     def getOutputCol(self):
         return self._get('output_col')
 
+    def setPartitionRules(self, value):
+        return self._set('partition_rules', value)
+
+    def getPartitionRules(self):
+        return self._get('partition_rules')
+
     def setPromptBucket(self, value):
         return self._set('prompt_bucket', value)
 

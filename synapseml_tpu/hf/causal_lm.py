@@ -16,6 +16,8 @@ tests. Tokenization: a transformers tokenizer when available locally
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from ..core import batching as cb
@@ -71,8 +73,14 @@ class HuggingFaceCausalLM(Transformer):
                  converter=TypeConverters.to_int)
     mesh_config = ComplexParam(
         "mesh_config", "MeshConfig for sharded inference: params shard over "
-        "tensor/fsdp axes per the logical rules (the Llama-2-7B "
+        "tensor/fsdp axes per the partition rule table (the Llama-2-7B "
         "sharded-batch-inference BASELINE config)", default=None)
+    partition_rules = ComplexParam(
+        "partition_rules", "parallel.partition.PartitionRules regex table "
+        "placing the plain param pytree on the mesh (None = the default "
+        "Llama table). Rides registry manifests' `sharding` section so a "
+        "published sharded model re-applies its placement at /admin/load",
+        default=None)
     generation_params_col = Param(
         "generation_params_col", "optional column of per-row dicts of "
         "generate kwargs (max_new_tokens/do_sample/temperature/top_k/top_p/"
@@ -98,7 +106,8 @@ class HuggingFaceCausalLM(Transformer):
                          default=None)
 
     _CACHE_KEYS = frozenset({"model_name", "model_params", "tokenizer",
-                             "mesh_config", "max_new_tokens", "eos_id",
+                             "mesh_config", "partition_rules",
+                             "max_new_tokens", "eos_id",
                              "do_sample", "temperature", "top_k", "top_p",
                              "seed", "engine", "kv_block_len", "kv_blocks",
                              "decode_slots"})
@@ -137,21 +146,20 @@ class HuggingFaceCausalLM(Transformer):
             mesh = None
             if self.get("mesh_config") is not None:
                 # sharded batch inference: weights distribute over the mesh
-                # (tensor/fsdp per logical rules); XLA inserts the activation
-                # collectives during generate
+                # per the declarative partition rule table (plain pytree —
+                # no eval_shape rebox, no nn.Partitioned metadata needed);
+                # XLA inserts the activation collectives during generate
                 import jax
-                import jax.numpy as jnp
 
-                from ..parallel.mesh import create_mesh, shard_inference_params
+                from ..models.convert_hf import shard_pretrained_params
                 from flax.core import meta
 
-                mesh = create_mesh(self.get("mesh_config"))
                 plain = jax.tree.map(
                     lambda x: x.value if isinstance(x, meta.Partitioned) else x,
                     params, is_leaf=lambda x: isinstance(x, meta.Partitioned))
-                params = shard_inference_params(
-                    LlamaLM(cfg), {"input_ids": jnp.zeros((1, 8), jnp.int32)},
-                    plain, mesh)
+                mesh, params = shard_pretrained_params(
+                    plain, self.get("mesh_config"),
+                    self.get("partition_rules"))
             self.__dict__["_cache_model"] = (model, params, tok, mesh)
         return self.__dict__["_cache_model"]
 
@@ -189,12 +197,12 @@ class HuggingFaceCausalLM(Transformer):
             top_p = eff["top_p"]
             rng = jax.random.PRNGKey(int(eff["seed"])) if sampling else None
 
-            def fn(ids, mask, offset):
+            def fn(p, ids, mask, offset):
                 # fold the batch's global row offset into the stream so
                 # identical prompts in different batches draw different
                 # samples (same seed + same data stays reproducible)
                 r = None if rng is None else jax.random.fold_in(rng, offset)
-                return generate(model, params, ids,
+                return generate(model, p, ids,
                                 eff["max_new_tokens"],
                                 eos_id=eff["eos_id"],
                                 prompt_mask=mask,
@@ -203,21 +211,26 @@ class HuggingFaceCausalLM(Transformer):
                                 top_p=None if top_p is None else float(top_p),
                                 rng=r)
 
-            jitted = jax.jit(fn)
             if mesh is not None:
                 dp = mesh.data_parallel_size()
                 if B % dp:
                     raise ValueError(
                         f"batch_size ({B}) must be a multiple of the mesh "
                         f"data-parallel size ({dp}) for sharded generation")
+                # params ride as a jit ARGUMENT (a closure over weights
+                # that span other processes is rejected) and outputs pin
+                # replicated, so every process holds the full generated
+                # ids even when the weights span hosts
+                jitted = jax.jit(fn, out_shardings=mesh.replicated())
 
                 def run(ids, mask, offset, _j=jitted, _m=mesh):
                     with _m.mesh:
                         # batch shards over data/fsdp; params already placed
-                        return _j(_m.shard_batch(ids), _m.shard_batch(mask),
-                                  offset)
+                        return _j(params, _m.shard_batch(ids),
+                                  _m.shard_batch(mask), offset)
 
                 return run
+            jitted = jax.jit(functools.partial(fn, params))
             return jitted
 
         return cb.get_compiled_cache().get(

@@ -468,6 +468,22 @@ class ServingServer:
                 from ..registry.autotune import apply_autotune
 
                 autotune_applied = apply_autotune(stage, tune)
+            # re-apply the artifact's declarative sharding BEFORE warmup
+            # (warmup must compile the programs the serve loop will run —
+            # sharded placement changes them). A mesh this host cannot
+            # build demotes to a replicated load with one structured
+            # warning; the swap itself never fails on topology.
+            sharding_note = None
+            shard_sec = (manifest or {}).get("sharding")
+            if shard_sec:
+                from ..parallel import partition as pshard
+
+                applied, reason = pshard.apply_manifest_sharding(
+                    stage, shard_sec,
+                    enabled=payload.get("sharding", True),
+                    model=payload.get("model"), version=version)
+                sharding_note = "applied" if applied \
+                    else f"replicated ({reason})"
             aot_cfg = (manifest or {}).get("aot") or {}
             warmup_rows = payload.get("warmup") or []
             warmup_buckets = payload.get("warmup_buckets")
@@ -536,6 +552,8 @@ class ServingServer:
         }
         if autotune_applied:
             breakdown["autotune"] = autotune_applied
+        if sharding_note is not None:
+            breakdown["sharding"] = sharding_note
         raot.emit_load_metrics(breakdown)
         replaced = holder.pipeline
         previous = holder.swap(stage, version)

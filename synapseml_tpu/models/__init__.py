@@ -3,6 +3,7 @@ from .downloader import ModelDownloader, ModelSchema
 from .text import DeepTextClassifier, DeepTextModel
 from .tokenizer import HashingTokenizer, resolve_tokenizer
 from .fused_trainer import FusedTrainer, fused_fit_arrays, fused_fit_source
+from .pipeline_trainer import PipelineTrainer
 from .trainer import Trainer, TrainerConfig, TrainState, cross_entropy_loss
 from .vision import DeepVisionClassifier, DeepVisionModel
 
@@ -15,4 +16,5 @@ __all__ = [
     "HashingTokenizer", "resolve_tokenizer",
     "Trainer", "TrainerConfig", "TrainState", "cross_entropy_loss",
     "FusedTrainer", "fused_fit_source", "fused_fit_arrays",
+    "PipelineTrainer",
 ]

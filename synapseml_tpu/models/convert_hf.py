@@ -38,6 +38,7 @@ __all__ = [
     "resnet_variables_from_torch", "resnet_arch_from_hf_config",
     "pretrained_text_classifier", "pretrained_encoder",
     "pretrained_vision", "pretrained_causal_lm",
+    "shard_pretrained_params",
 ]
 
 
@@ -696,3 +697,20 @@ def pretrained_causal_lm(ckpt_dir: str, **cfg_overrides):
         return cfg, gpt2_params_from_hf(sd, n_heads=cfg.n_heads)
     cfg = llama_config_from_hf(config, **cfg_overrides)
     return cfg, llama_params_from_hf(sd, n_heads=cfg.n_heads)
+
+
+def shard_pretrained_params(params, mesh_config, partition_rules=None):
+    """Place a converted plain param pytree on a mesh via the declarative
+    rule table (``parallel.partition``) — the sharding plane's replacement
+    for the ``eval_shape``-rebox path: no module init, no
+    ``nn.Partitioned`` metadata, works for ANY tree this module emits.
+    Returns ``(mesh_ctx, placed_params)``; ``partition_rules`` defaults to
+    the Llama table (which also covers the GPT-2 mapping's param names).
+    """
+    from ..parallel.mesh import create_mesh
+    from ..parallel.partition import default_llama_rules, shard_tree
+
+    mesh_ctx = create_mesh(mesh_config)
+    rules = partition_rules if partition_rules is not None \
+        else default_llama_rules(mesh=mesh_ctx.config)
+    return mesh_ctx, shard_tree(params, mesh_ctx, rules)

@@ -15,9 +15,15 @@ from .batching import (
     round_up_to_multiple,
     unpad,
 )
-from .checkpoint import (AsyncCheckpointer, latest_step,
+from .checkpoint import (AsyncCheckpointer, checkpoint_sharding, latest_step,
                          restore_checkpoint, save_checkpoint)
 from .mesh import MeshConfig, MeshContext, P, create_mesh, logical_axis_rules, shard_params
+from .partition import (PartitionRules, apply_manifest_sharding,
+                        checkpoint_sharding_fn, default_llama_rules,
+                        default_transformer_rules, emit_shard_metrics,
+                        match_partition_rules, opt_state_specs,
+                        shard_tree, sharding_manifest_section,
+                        split_stage_params, stack_stages)
 from .pipeline import pipeline_apply, pipeline_sharded, stack_stage_params
 
 __all__ = [
@@ -25,7 +31,12 @@ __all__ = [
     "worker_rendezvous",
     "DoubleBufferedFeeder", "PaddedBatch", "batches", "bucket_size", "pad_batch",
     "pad_sequences", "round_up_to_multiple", "unpad",
-    "AsyncCheckpointer", "latest_step", "restore_checkpoint", "save_checkpoint",
+    "AsyncCheckpointer", "checkpoint_sharding", "latest_step",
+    "restore_checkpoint", "save_checkpoint",
     "MeshConfig", "MeshContext", "P", "create_mesh", "logical_axis_rules", "shard_params",
+    "PartitionRules", "apply_manifest_sharding", "checkpoint_sharding_fn",
+    "default_llama_rules", "default_transformer_rules", "emit_shard_metrics",
+    "match_partition_rules", "opt_state_specs", "shard_tree",
+    "sharding_manifest_section", "split_stage_params", "stack_stages",
     "pipeline_apply", "pipeline_sharded", "stack_stage_params",
 ]

@@ -20,19 +20,44 @@ import numpy as np
 from ..core import serialization
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
-           "AsyncCheckpointer"]
+           "checkpoint_sharding", "AsyncCheckpointer"]
 
 
 def _step_dir(path: str, step: int) -> str:
     return os.path.join(path, f"step_{step:010d}")
 
 
-def save_checkpoint(path: str, tree: Any, step: int = 0, use_orbax: bool | None = None) -> str:
+def _to_host(keypath, x):
+    """Host-side numpy for one leaf. A leaf spanning other processes
+    cannot be fetched by this npz checkpointer (no host holds the full
+    value) — raise an actionable error naming the leaf instead of
+    surfacing jax's generic non-addressable fetch failure mid-write."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from .partition import tree_path_name
+
+        raise ValueError(
+            f"checkpoint leaf {tree_path_name(keypath)!r} is sharded "
+            "across processes — the npz checkpointer writes one "
+            "host-side artifact and cannot gather it. Gather the state "
+            "explicitly (or checkpoint with use_orbax=True on a backend "
+            "with cross-process collectives); the RESTORE side of a "
+            "sharded mesh works from any replicated artifact via "
+            "restore_checkpoint(sharding_fn=...)")
+    return np.asarray(x)
+
+
+def save_checkpoint(path: str, tree: Any, step: int = 0, use_orbax: bool | None = None,
+                    sharding: dict | None = None) -> str:
     """Save a pytree (params/opt state). Device arrays are fetched host-side
-    first so the artifact is topology-independent."""
+    first so the artifact is topology-independent. ``sharding`` (the
+    partition-plane manifest section: rule table + mesh config) is written
+    as ``sharding.json`` beside the state, so a restore on ANY topology
+    knows the placement the run declared (``checkpoint_sharding`` reads
+    it back; ``parallel.partition.checkpoint_sharding_fn`` turns it into
+    per-leaf shard-slice restores)."""
     target = _step_dir(path, step)
     os.makedirs(target, exist_ok=True)
-    host_tree = jax.tree.map(lambda x: np.asarray(x), tree)
+    host_tree = jax.tree_util.tree_map_with_path(_to_host, tree)
     if use_orbax is None:
         use_orbax = False  # npz path is deterministic + dependency-light; orbax opt-in
     if use_orbax:
@@ -42,9 +67,30 @@ def save_checkpoint(path: str, tree: Any, step: int = 0, use_orbax: bool | None 
         ckptr.save(os.path.join(target, "orbax"), host_tree, force=True)
     else:
         serialization.save_pytree(host_tree, os.path.join(target, "state"))
+    if sharding:
+        import json
+
+        with open(os.path.join(target, "sharding.json"), "w") as f:
+            json.dump(sharding, f, indent=2, sort_keys=True)
     with open(os.path.join(target, "DONE"), "w") as f:
         f.write(str(step))
     return target
+
+
+def checkpoint_sharding(path: str, step: int | None = None) -> dict | None:
+    """The ``sharding`` section saved with a checkpoint (None when the run
+    declared no rule table, or for pre-sharding-plane checkpoints)."""
+    if step is None:
+        step = latest_step(path)
+        if step is None:
+            return None
+    target = os.path.join(_step_dir(path, step), "sharding.json")
+    if not os.path.isfile(target):
+        return None
+    import json
+
+    with open(target) as f:
+        return json.load(f)
 
 
 def _is_complete(target: str) -> bool:
@@ -82,8 +128,21 @@ def latest_step(path: str) -> int | None:
 
 
 def restore_checkpoint(path: str, step: int | None = None, sharding_fn=None) -> Any:
-    """Restore; `sharding_fn(leaf_path) -> Sharding` re-places leaves on the
-    current mesh (None = host numpy)."""
+    """Restore a checkpoint, optionally placing leaves as they load.
+
+    ``sharding_fn`` re-places leaves on the current mesh and accepts
+    either signature:
+
+    * ``fn(leaf) -> Sharding`` (legacy), or
+    * ``fn(path_name, leaf) -> Sharding | None`` (path-aware — what
+      ``parallel.partition.checkpoint_sharding_fn`` builds from a rule
+      table; ``path_name`` is the slash-joined tree path, and returning
+      None keeps that leaf host-side numpy, e.g. the loader's
+      ``data_iter`` state).
+
+    With a sharded target each ``device_put`` transfers only that
+    device's shard slices — no host materializes a device-resident full
+    copy of any leaf."""
     if step is None:
         step = latest_step(path)
         if step is None:
@@ -101,7 +160,31 @@ def restore_checkpoint(path: str, step: int | None = None, sharding_fn=None) -> 
     else:
         tree = serialization.load_pytree(os.path.join(target, "state"))
     if sharding_fn is not None:
-        tree = jax.tree.map(lambda x: jax.device_put(x, sharding_fn(x)), tree)
+        import inspect
+
+        try:
+            # path-aware iff the callable REQUIRES two positional args —
+            # a legacy one-leaf callback with extra defaulted params
+            # (lambda leaf, mesh=m: ...) must keep its old contract
+            sig = inspect.signature(sharding_fn)
+            required = [p for p in sig.parameters.values()
+                        if p.kind in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD)
+                        and p.default is p.empty]
+            path_aware = len(required) >= 2
+        except (TypeError, ValueError):
+            path_aware = False
+        if path_aware:
+            from .partition import place_leaf, tree_path_name
+
+            def place(keypath, x):
+                sh = sharding_fn(tree_path_name(keypath), x)
+                return x if sh is None else place_leaf(x, sh)
+
+            tree = jax.tree_util.tree_map_with_path(place, tree)
+        else:
+            tree = jax.tree.map(
+                lambda x: jax.device_put(x, sharding_fn(x)), tree)
     return tree
 
 
@@ -126,12 +209,16 @@ class AsyncCheckpointer:
     or exiting — the last write's errors surface there.
     """
 
-    def __init__(self, path: str, keep: int = 3, use_orbax: bool = False):
+    def __init__(self, path: str, keep: int = 3, use_orbax: bool = False,
+                 sharding: dict | None = None):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
         self.path = path
         self.keep = keep
         self.use_orbax = use_orbax
+        # the partition-plane manifest section written beside every step
+        # (fit_source fills this in from the trainer's rule table)
+        self.sharding = sharding
         self._exec = concurrent.futures.ThreadPoolExecutor(max_workers=1)
         self._inflight: concurrent.futures.Future | None = None
 
@@ -161,9 +248,10 @@ class AsyncCheckpointer:
 
     def _write(self, snapshot: Any, step: int) -> str:
         # the blocking device→host fetch happens HERE, off the train loop
-        host_tree = jax.tree.map(lambda x: np.asarray(x), snapshot)
+        host_tree = jax.tree_util.tree_map_with_path(_to_host, snapshot)
         target = save_checkpoint(self.path, host_tree, step,
-                                 use_orbax=self.use_orbax)
+                                 use_orbax=self.use_orbax,
+                                 sharding=self.sharding)
         self._gc()
         return target
 

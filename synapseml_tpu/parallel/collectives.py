@@ -14,14 +14,40 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-try:
-    from jax import shard_map  # jax >= 0.8
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 
 from .mesh import MeshContext
 
-__all__ = ["psum_over", "pmean_over", "all_gather_over", "data_parallel_map", "ring_permute"]
+__all__ = ["compat_shard_map", "psum_over", "pmean_over", "all_gather_over",
+           "data_parallel_map", "ring_permute"]
+
+
+def compat_shard_map(fn, mesh, in_specs, out_specs,
+                     check_vma: bool | None = None):
+    """``shard_map`` across the jax range the framework supports: the
+    top-level ``jax.shard_map`` (with its ``check_vma`` kwarg when it
+    exists) on new versions, ``jax.experimental.shard_map`` (whose
+    equivalent knob is ``check_rep``) on older ones."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        try:
+            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
+        except TypeError:
+            # a jax.shard_map generation without the check_vma kwarg
+            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return legacy_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, **kw)
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check_vma: bool | None = None):
+    """Backwards-compatible alias of :func:`compat_shard_map` (this module
+    historically re-exported the jax symbol)."""
+    return compat_shard_map(fn, mesh, in_specs, out_specs,
+                            check_vma=check_vma)
 
 
 def psum_over(mesh_ctx: MeshContext, axis: str | Sequence[str] = "data"):

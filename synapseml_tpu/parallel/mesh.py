@@ -92,15 +92,21 @@ class MeshContext:
         return s.get("data", 1) * s.get("fsdp", 1)
 
     def shard_batch(self, batch: Any) -> Any:
-        """Place a host pytree of arrays onto the mesh, batch-dim sharded."""
+        """Place a host pytree of arrays onto the mesh, batch-dim sharded.
+        Cross-process meshes build from local slices (``place_leaf``) —
+        every process supplies the same global batch."""
+        from .partition import place_leaf
+
         sh = self.batch_sharding()
-        return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+        return jax.tree.map(lambda x: place_leaf(x, sh), batch)
 
     def shard_stacked_batch(self, batch: Any) -> Any:
         """Place [K, batch, ...] step-stacked arrays: K replicated (scan axis),
         batch dim sharded over the data axes."""
+        from .partition import place_leaf
+
         sh = self.sharding(None, ("data", "fsdp"))
-        return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+        return jax.tree.map(lambda x: place_leaf(x, sh), batch)
 
     def __enter__(self):
         self._ctx = self.mesh.__enter__()

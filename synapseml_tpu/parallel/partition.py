@@ -1,0 +1,768 @@
+"""Declarative sharding plane: regex partition rules over PLAIN pytrees.
+
+The mesh module places Flax params that carry ``nn.Partitioned`` metadata
+(``shard_params``) or reboxes plain trees through an ``eval_shape`` of the
+module (``shard_inference_params`` — inference-side only). This module is
+the third, declarative path (fmengine-style, SNIPPETS.md [1]/[3]): an
+ordered table of ``(regex, PartitionSpec)`` rules matched against
+slash-joined param-path names, so ANY plain pytree — trainer params,
+``models.convert_hf`` checkpoints, optax optimizer state (whose tree paths
+embed the param names: ``1/0/mu/dense/kernel``) — gets mesh placement
+without module metadata. One table serves four consumers:
+
+* training (``models/trainer.py``): param placement + ZeRO sharding of the
+  optimizer state over the data-parallel replica axes (arXiv:2004.13336 —
+  the weight update is sharded, gradients/params stay data-parallel);
+* inference (``hf/causal_lm.py``): pretrained plain pytrees placed without
+  the eval_shape rebox;
+* pipeline stage splits (``models/pipeline_trainer.py``): the table's
+  ``stage_regex`` names the cut points that partition a flat param tree
+  into GPipe stages over the ``pipe`` axis;
+* artifacts: the table serializes to JSON, rides registry manifests
+  (``sharding`` section) and checkpoints, and re-applies at
+  ``/admin/load`` — a mesh that cannot be built on the loading host
+  demotes to a replicated load with ONE structured warning, never a
+  failed swap.
+
+Rules are first-match-wins; scalar / single-element leaves always
+replicate (never worth a collective); unmatched leaves follow the table's
+``unmatched`` policy (``replicate`` | ``error``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import math
+import re
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import AXES, MeshConfig, MeshContext
+
+__all__ = ["PartitionRules", "match_partition_rules", "tree_path_name",
+           "shard_tree", "tree_shardings", "place_tree", "place_leaf",
+           "opt_state_specs",
+           "zero_shard_spec", "split_stage_params", "stack_stages",
+           "pipeline_param_specs", "pipeline_opt_specs",
+           "checkpoint_sharding_fn", "spec_digests",
+           "sharding_manifest_section", "apply_manifest_sharding",
+           "sharding_target",
+           "emit_shard_metrics", "per_device_bytes", "total_bytes",
+           "default_llama_rules", "default_transformer_rules"]
+
+logger = logging.getLogger("synapseml_tpu.parallel.partition")
+
+
+def tree_path_name(path: Sequence) -> str:
+    """A ``tree_flatten_with_path`` key path -> slash-joined name
+    (``DictKey`` -> key, ``SequenceKey`` -> index, ``GetAttrKey`` ->
+    attribute — so optax NamedTuple states read ``1/0/mu/dense/kernel``).
+    The ``value`` attribute component of a flax ``nn.Partitioned`` box is
+    dropped (attribute access only — a dict key named ``value`` survives),
+    so one rule table matches boxed init trees and the plain checkpoint
+    pytrees they round-trip to."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            part = str(k.key)
+        elif hasattr(k, "idx"):
+            part = str(k.idx)
+        elif hasattr(k, "name"):
+            if str(k.name) == "value":
+                continue  # flax nn.Partitioned box around the array
+            part = str(k.name)
+        else:
+            part = str(k)
+        if not part.startswith("."):
+            parts.append(part)
+    return "/".join(parts)
+
+
+def _spec_entry_to_json(entry) -> Any:
+    if entry is None or isinstance(entry, str):
+        return entry
+    return list(entry)
+
+
+def _spec_entry_from_json(entry) -> Any:
+    if entry is None or isinstance(entry, str):
+        return entry
+    return tuple(entry)
+
+
+def _spec_axes(spec: P) -> set:
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, str):
+            used.add(entry)
+        else:
+            used.update(entry)
+    return used
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionRules:
+    """The serializable rule table.
+
+    ``rules``: ordered ``(pattern, spec entries)`` pairs — ``pattern`` is a
+    Python regex ``re.search``-ed against the slash-joined leaf path;
+    ``spec entries`` is one mesh-axis entry per array dim (``None`` |
+    ``"axis"`` | ``("axis", "axis")``), exactly ``PartitionSpec``'s
+    constructor arguments. First match wins.
+
+    ``unmatched``: ``"replicate"`` (default) or ``"error"`` — what happens
+    to a non-scalar leaf no rule matches.
+
+    ``zero_axes``: the replica axes the ZeRO weight-update sharding
+    partitions optimizer state over (default: the data-parallel group).
+
+    ``stage_regex``: optional regex with ONE capture group (the stage
+    index) naming the pipeline cut points — see :func:`split_stage_params`.
+
+    ``mesh``: optional :class:`~synapseml_tpu.parallel.mesh.MeshConfig`
+    recorded so manifests/checkpoints can rebuild the intended topology.
+    """
+
+    rules: tuple = ()
+    unmatched: str = "replicate"
+    zero_axes: tuple = ("data", "fsdp")
+    stage_regex: str | None = None
+    mesh: MeshConfig | None = None
+
+    def __post_init__(self):
+        norm = []
+        for pattern, entries in self.rules:
+            re.compile(pattern)  # fail fast on a bad regex, at table build
+            norm.append((str(pattern),
+                         tuple(_spec_entry_from_json(e) for e in entries)))
+        object.__setattr__(self, "rules", tuple(norm))
+        object.__setattr__(self, "zero_axes", tuple(self.zero_axes))
+        if self.unmatched not in ("replicate", "error"):
+            raise ValueError(f"unmatched must be 'replicate' or 'error', "
+                             f"got {self.unmatched!r}")
+        if self.stage_regex is not None:
+            rx = re.compile(self.stage_regex)
+            if rx.groups != 1:
+                raise ValueError(
+                    f"stage_regex needs exactly ONE capture group (the "
+                    f"stage index), got {rx.groups} in {self.stage_regex!r}")
+        if self.mesh is not None and not isinstance(self.mesh, MeshConfig):
+            object.__setattr__(self, "mesh", MeshConfig(**dict(self.mesh)))
+
+    def spec_for(self, name: str, shape: Sequence[int]) -> P:
+        """First-match-wins spec for one leaf. Scalars / single-element
+        leaves replicate unconditionally."""
+        shape = tuple(int(s) for s in shape)
+        if len(shape) == 0 or math.prod(shape) == 1:
+            return P()
+        for pattern, entries in self.rules:
+            if re.search(pattern, name) is not None:
+                if len(entries) > len(shape):
+                    raise ValueError(
+                        f"partition rule {pattern!r} has {len(entries)} dim "
+                        f"entries but {name!r} has rank {len(shape)}")
+                return P(*entries)
+        if self.unmatched == "replicate":
+            return P()
+        raise ValueError(f"no partition rule matches {name!r} "
+                         f"(unmatched='error'); rules: "
+                         f"{[p for p, _ in self.rules]}")
+
+    # -- wire format (manifests, checkpoints, /admin/load) -----------------
+    def to_json(self) -> dict:
+        out = {"rules": [[p, [_spec_entry_to_json(e) for e in entries]]
+                         for p, entries in self.rules],
+               "unmatched": self.unmatched,
+               "zero_axes": list(self.zero_axes)}
+        if self.stage_regex is not None:
+            out["stage_regex"] = self.stage_regex
+        if self.mesh is not None:
+            out["mesh"] = dataclasses.asdict(self.mesh)
+        return out
+
+    @classmethod
+    def from_json(cls, data: dict) -> "PartitionRules":
+        mesh = data.get("mesh")
+        return cls(rules=tuple((p, tuple(_spec_entry_from_json(e)
+                                         for e in entries))
+                               for p, entries in data.get("rules", ())),
+                   unmatched=data.get("unmatched", "replicate"),
+                   zero_axes=tuple(data.get("zero_axes",
+                                            ("data", "fsdp"))),
+                   stage_regex=data.get("stage_regex"),
+                   mesh=MeshConfig(**mesh) if mesh else None)
+
+    def digest(self) -> str:
+        blob = json.dumps(self.to_json(), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def _leaf_shape(leaf) -> tuple:
+    shape = getattr(leaf, "shape", None)
+    return tuple(shape) if shape is not None else tuple(np.shape(leaf))
+
+
+def match_partition_rules(rules: PartitionRules, tree: Any) -> Any:
+    """Pytree of :class:`PartitionSpec`, one per leaf of ``tree`` (arrays
+    or ``ShapeDtypeStruct`` skeletons — only ``.shape`` is read)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: rules.spec_for(tree_path_name(path),
+                                          _leaf_shape(leaf)), tree)
+
+
+def _validate_spec(name: str, shape: tuple, spec: P, sizes: dict) -> None:
+    for d, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        for a in axes:
+            if a not in sizes:
+                raise ValueError(f"{name}: spec axis {a!r} is not a mesh "
+                                 f"axis (have {sorted(sizes)})")
+        div = math.prod(sizes[a] for a in axes)
+        if shape[d] % div:
+            raise ValueError(
+                f"{name}: dim {d} of shape {shape} is not divisible by "
+                f"the {axes} axis product {div}")
+
+
+def tree_shardings(mesh_ctx: MeshContext, spec_tree: Any,
+                   value_tree: Any | None = None) -> Any:
+    """Spec pytree -> ``NamedSharding`` pytree on the context's mesh.
+    ``value_tree`` (same structure) enables divisibility validation with
+    the failing leaf path in the error."""
+    sizes = mesh_ctx.axis_sizes
+    if value_tree is not None:
+        def check(path, leaf, spec):
+            _validate_spec(tree_path_name(path), _leaf_shape(leaf), spec,
+                           sizes)
+            return NamedSharding(mesh_ctx.mesh, spec)
+
+        return jax.tree_util.tree_map_with_path(check, value_tree, spec_tree)
+    return jax.tree.map(lambda s: NamedSharding(mesh_ctx.mesh, s), spec_tree,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def place_leaf(x: Any, sharding) -> Any:
+    """Place ONE leaf onto a sharding. Host arrays destined for a sharding
+    that spans other processes go through ``make_array_from_callback`` —
+    each process materializes only its addressable shard slices (the
+    multi-host "no host holds the full tree on device" path);
+    ``device_put`` covers everything fully addressable."""
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        # already a cross-process array: re-placing onto the SAME layout
+        # is a no-op; a different layout would need a collective reshard
+        # (no host holds the full value to slice from)
+        if x.sharding == sharding:
+            return x
+        raise ValueError(
+            "cannot re-place a cross-process array onto a different "
+            f"sharding ({x.sharding} -> {sharding}) without a collective "
+            "reshard; restore/supply the leaf host-side instead")
+    # cross-process sharding: device_put would need a collective equality
+    # check (unavailable on some backends); build from local slices — the
+    # callback reads ONLY this process's shard index ranges
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
+def place_tree(tree: Any, sharding_tree: Any) -> Any:
+    """Place every leaf onto its sharding — per-device transfers move only
+    that device's shard slices of a host array."""
+    return jax.tree.map(place_leaf, tree, sharding_tree)
+
+
+def shard_tree(tree: Any, mesh_ctx: MeshContext,
+               rules: PartitionRules) -> Any:
+    """Match + validate + place a plain pytree in one call (the
+    ``shard_inference_params`` replacement for rule-table consumers)."""
+    specs = match_partition_rules(rules, tree)
+    return place_tree(tree, tree_shardings(mesh_ctx, specs, tree))
+
+
+# ---- ZeRO: optimizer-state sharding over the replica group ---------------
+
+def zero_shard_spec(spec: P, shape: Sequence[int], sizes: dict,
+                    zero_axes: Sequence[str]) -> P:
+    """Extend a leaf's spec with the ZeRO partitioning: shard the FIRST
+    unsharded dim divisible by the replica-group size over the zero axes
+    not already used by the spec. Leaves with no divisible free dim keep
+    their spec (small biases etc. stay replicated — the epsilon in the
+    per-replica byte bound)."""
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 0 or math.prod(shape) == 1:
+        return spec
+    used = _spec_axes(spec)
+    free = tuple(a for a in zero_axes if a not in used
+                 and sizes.get(a, 1) > 1)
+    if not free:
+        return spec
+    group = math.prod(sizes[a] for a in free)
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for d, entry in enumerate(entries):
+        if entry is None and shape[d] % group == 0:
+            entries[d] = free[0] if len(free) == 1 else free
+            return P(*entries)
+    return spec
+
+
+def opt_state_specs(rules: PartitionRules, opt_state: Any,
+                    mesh_ctx: MeshContext, zero: bool = False) -> Any:
+    """Spec pytree for an optimizer state (or its ``eval_shape`` skeleton).
+    The SAME rule table applies — optax state paths embed the param names
+    (``1/0/mu/dense/kernel``), so a param's rule carries to its moments;
+    ``count`` and other scalars replicate. ``zero=True`` adds the
+    weight-update sharding over ``rules.zero_axes`` on top."""
+    sizes = mesh_ctx.axis_sizes
+
+    def pick(path, leaf):
+        name = tree_path_name(path)
+        shape = _leaf_shape(leaf)
+        spec = rules.spec_for(name, shape)
+        if zero:
+            spec = zero_shard_spec(spec, shape, sizes, rules.zero_axes)
+        return spec
+
+    return jax.tree_util.tree_map_with_path(pick, opt_state)
+
+
+# ---- pipeline stage splits (GPipe cut points from the rule table) --------
+
+def _insert(tree: dict, parts: list, leaf) -> None:
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = leaf
+
+
+def split_stage_params(params: Any, stage_regex: str
+                       ) -> tuple[dict, list[dict]]:
+    """Partition a flat param tree into pipeline stages by the declared cut
+    regex (ONE capture group = the stage index, e.g. ``layer_(\\d+)``).
+    Returns ``(shared, stages)``: ``shared`` holds every unmatched leaf
+    (embeddings, heads — they run outside the pipeline ring), ``stages[i]``
+    the i-th stage's subtree with the stage index normalized out of the
+    path so every stage is structurally identical (the GPipe chainable
+    requirement — validated here, with the offending paths named)."""
+    rx = re.compile(stage_regex)
+    if rx.groups != 1:
+        raise ValueError(f"stage_regex needs exactly ONE capture group, "
+                         f"got {rx.groups} in {stage_regex!r}")
+    shared: dict = {}
+    staged: dict[int, dict] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = tree_path_name(path)
+        m = rx.search(name)
+        if m is None:
+            _insert(shared, name.split("/"), leaf)
+            continue
+        try:
+            idx = int(m.group(1))
+        except ValueError as e:
+            raise ValueError(f"stage_regex capture on {name!r} is not an "
+                             f"integer stage index: {m.group(1)!r}") from e
+        norm = name[:m.start(1)] + "#" + name[m.end(1):]
+        _insert(staged.setdefault(idx, {}), norm.split("/"), leaf)
+    indices = sorted(staged)
+    if indices != list(range(len(indices))):
+        raise ValueError(f"stage indices must be contiguous from 0, got "
+                         f"{indices}")
+    if not indices:
+        raise ValueError(f"stage_regex {stage_regex!r} matched no params")
+    stages = [staged[i] for i in indices]
+    ref = {tree_path_name(p): _leaf_shape(x) for p, x in
+           jax.tree_util.tree_flatten_with_path(stages[0])[0]}
+    for i, st in enumerate(stages[1:], start=1):
+        got = {tree_path_name(p): _leaf_shape(x) for p, x in
+               jax.tree_util.tree_flatten_with_path(st)[0]}
+        if got != ref:
+            raise ValueError(
+                f"stage {i} structure differs from stage 0 (stages must be "
+                f"chainable): {sorted(set(got) ^ set(ref)) or 'shape drift'}")
+    return shared, stages
+
+
+def stack_stages(params: Any, stage_regex: str) -> tuple[dict, Any]:
+    """``split_stage_params`` + stack into the leading-stage-axis layout
+    ``parallel.pipeline`` consumes (shard that axis over ``pipe``)."""
+    from .pipeline import stack_stage_params
+
+    shared, stages = split_stage_params(params, stage_regex)
+    return shared, stack_stage_params(stages)
+
+
+# ---- pipeline placement (stage-stacked trees) ----------------------------
+
+def _is_stage_leaf(name: str) -> bool:
+    """Leaf of the pipeline-stacked ``stages`` subtree (works for params
+    — ``stages/...`` — and optimizer state — ``1/0/mu/stages/...``)."""
+    return name.startswith("stages/") or "/stages/" in name
+
+
+def pipeline_param_specs(rules: PartitionRules | None, params: Any,
+                         axis_name: str = "pipe") -> Any:
+    """Spec tree for a pipeline trainer's ``{"shared": ..., "stages":
+    <leading-stage-axis stack>}`` param tree: stage leaves shard their
+    leading axis over ``axis_name`` (per-device weights = ONE stage's),
+    shared leaves (embeddings/heads) follow the rule table."""
+    rules = rules or PartitionRules()
+
+    def pick(path, leaf):
+        name = tree_path_name(path)
+        shape = _leaf_shape(leaf)
+        if _is_stage_leaf(name) and len(shape) >= 1:
+            return P(axis_name)
+        return rules.spec_for(name, shape)
+
+    return jax.tree_util.tree_map_with_path(pick, params)
+
+
+def pipeline_opt_specs(rules: PartitionRules | None, opt_state: Any,
+                       mesh_ctx: MeshContext, zero: bool = False,
+                       axis_name: str = "pipe") -> Any:
+    """Optimizer-state specs mirroring :func:`pipeline_param_specs` (the
+    moments of a stage's weights live only on that stage's pipe
+    coordinate), with the ZeRO weight-update sharding over the replica
+    axes on top when enabled."""
+    rules = rules or PartitionRules()
+    sizes = mesh_ctx.axis_sizes
+
+    def pick(path, leaf):
+        name = tree_path_name(path)
+        shape = _leaf_shape(leaf)
+        if len(shape) == 0 or math.prod(shape) == 1:
+            return P()
+        if _is_stage_leaf(name):
+            spec = P(axis_name)
+        else:
+            spec = rules.spec_for(name, shape)
+        if zero:
+            spec = zero_shard_spec(spec, shape, sizes, rules.zero_axes)
+        return spec
+
+    return jax.tree_util.tree_map_with_path(pick, opt_state)
+
+
+# ---- checkpoint restore placement ----------------------------------------
+
+def checkpoint_sharding_fn(rules: PartitionRules, mesh_ctx: MeshContext,
+                           zero: bool = False,
+                           pipeline_axis: str | None = None):
+    """A path-aware ``sharding_fn`` for ``restore_checkpoint``: each leaf
+    of a full train-state tree (``params``/``opt_state``/``step``/
+    ``batch_stats``/``data_iter``) restores DIRECTLY onto its rule-table
+    placement — per-device transfers move only that device's shard slices,
+    so no host materializes a device-resident full copy. ``data_iter``
+    (the loader's iterator state) stays host-side numpy (returns None).
+    ``pipeline_axis`` routes stage-stacked ``stages`` subtrees (a
+    :class:`~synapseml_tpu.models.pipeline_trainer.PipelineTrainer`
+    state) onto their pipe-coordinate placement."""
+    sizes = mesh_ctx.axis_sizes
+
+    def fn(name: str, leaf):
+        root, _, rest = name.partition("/")
+        if root == "data_iter":
+            return None  # IteratorState is host-side bookkeeping
+        shape = _leaf_shape(leaf)
+        if len(shape) == 0 or math.prod(shape) == 1:
+            return NamedSharding(mesh_ctx.mesh, P())
+        # strip the train-state root so rules match the SAME names live
+        # placement saw ('params/w' -> 'w', 'opt_state/1/0/mu/...' ->
+        # '1/0/mu/...') — an anchored rule must not silently replicate
+        # on restore
+        local = rest if root in ("params", "opt_state",
+                                 "batch_stats") and rest else name
+        if pipeline_axis is not None and _is_stage_leaf(local):
+            spec = P(pipeline_axis)
+        else:
+            spec = rules.spec_for(local, shape)
+        if zero and root == "opt_state":
+            spec = zero_shard_spec(spec, shape, sizes, rules.zero_axes)
+        return NamedSharding(mesh_ctx.mesh, spec)
+
+    return fn
+
+
+# ---- manifests (registry `sharding` section) -----------------------------
+
+def spec_digests(rules: PartitionRules, tree: Any) -> dict:
+    """Per-leaf spec digests for the manifest: ``{path: sha256(path +
+    spec)[:12]}`` — a loader can detect a rule-table edit that re-places
+    any leaf without shipping the spec tree itself."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = tree_path_name(path)
+        spec = rules.spec_for(name, _leaf_shape(leaf))
+        blob = json.dumps([name, [_spec_entry_to_json(e) for e in spec]],
+                          sort_keys=True).encode()
+        out[name] = hashlib.sha256(blob).hexdigest()[:12]
+    return out
+
+
+def sharding_manifest_section(rules: PartitionRules,
+                              params: Any | None = None) -> dict:
+    """The registry manifest's ``sharding`` section: rule table + the mesh
+    topology it targets + per-leaf spec digests (when the param tree is
+    available at publish time)."""
+    section = {"rules": rules.to_json(), "digest": rules.digest()}
+    if rules.mesh is not None:
+        section["mesh"] = dataclasses.asdict(rules.mesh)
+    if params is not None:
+        section["spec_digests"] = spec_digests(rules, params)
+    return section
+
+
+def _log_demote(reason: str, **context) -> None:
+    payload = {"event": "sharding_demoted_to_replicated", "reason": reason}
+    payload.update({k: v for k, v in context.items() if v is not None})
+    logger.warning(json.dumps(payload, sort_keys=True, default=str))
+
+
+def _has_param(stage, name: str) -> bool:
+    has = getattr(stage, "has_param", None)
+    return bool(has(name)) if callable(has) else False
+
+
+def sharding_target(stage):
+    """The stage the rule table applies to: the stage itself when it
+    declares both ``partition_rules`` and ``mesh_config`` params, else the
+    first nested stage of a pipeline that does (depth-first). None when
+    nothing in the tree is rule-table-capable."""
+    if _has_param(stage, "partition_rules") and _has_param(stage,
+                                                          "mesh_config"):
+        return stage
+    if _has_param(stage, "stages"):
+        for child in (stage.get("stages") or []):
+            found = sharding_target(child)
+            if found is not None:
+                return found
+    return None
+
+
+def apply_manifest_sharding(stage, section: dict, enabled: bool = True,
+                            **context) -> tuple[bool, str | None]:
+    """Apply a manifest's ``sharding`` section to a just-loaded stage
+    BEFORE warmup (nested pipeline stages are searched for the first
+    rule-table-capable stage). Returns ``(applied, reason)`` — any
+    mismatch (mesh that cannot be built from this host's devices, stage
+    without the rule-table params) demotes to a REPLICATED load: the
+    stage's ``mesh_config``/``partition_rules`` params are cleared, one
+    structured warning is logged, and the swap proceeds. Never raises for
+    topology reasons."""
+    target = sharding_target(stage)
+    if target is not None:
+        stage = target
+    has_rules = _has_param(stage, "partition_rules")
+    has_mesh = _has_param(stage, "mesh_config")
+
+    def demote(reason: str, warn: bool = True) -> tuple[bool, str]:
+        clear = {}
+        if has_rules and stage.get("partition_rules") is not None:
+            clear["partition_rules"] = None
+        if has_mesh and stage.get("mesh_config") is not None:
+            clear["mesh_config"] = None
+        if clear:
+            stage.set(**clear)
+        if warn:
+            _log_demote(reason, **context)
+        return False, reason
+
+    if not enabled:
+        # a deliberate per-load opt-out, not a mismatch — no warning
+        return demote("sharding disabled by request", warn=False)
+    try:
+        rules = PartitionRules.from_json(section.get("rules") or {})
+    except (TypeError, ValueError) as e:
+        return demote(f"unreadable rule table: {e}")
+    mesh_sizes = section.get("mesh") or (dataclasses.asdict(rules.mesh)
+                                         if rules.mesh else None)
+    if not has_rules or not has_mesh:
+        return demote(f"stage {type(stage).__name__} has no "
+                      "partition_rules/mesh_config params")
+    if mesh_sizes is None:
+        return demote("manifest sharding section carries no mesh topology")
+    try:
+        cfg = MeshConfig(**{k: int(v) for k, v in mesh_sizes.items()
+                            if k in AXES})
+        cfg.resolve(len(jax.devices()))
+    except (TypeError, ValueError) as e:
+        return demote(f"mesh {mesh_sizes} does not fit this host's "
+                      f"{len(jax.devices())} devices: {e}")
+    stage.set(mesh_config=cfg, partition_rules=rules)
+    return True, None
+
+
+# ---- observability: the synapseml_shard_* gauge family -------------------
+
+def total_bytes(tree: Any) -> int:
+    return sum(int(np.prod(_leaf_shape(x)) or 1)
+               * int(np.dtype(getattr(x, "dtype", np.float32)).itemsize)
+               for x in jax.tree.leaves(tree))
+
+
+def per_device_bytes(tree: Any) -> int:
+    """Bytes ONE device holds for a placed tree (sharded leaves count one
+    shard; host / unplaced leaves count whole — they replicate on use)."""
+    out = 0
+    for x in jax.tree.leaves(tree):
+        shape = _leaf_shape(x)
+        item = int(np.dtype(getattr(x, "dtype", np.float32)).itemsize)
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and shape:
+            try:
+                shape = sharding.shard_shape(tuple(shape))
+            except Exception:  # noqa: BLE001 - odd shardings count whole
+                pass
+        out += int(np.prod(shape) or 1) * item
+    return out
+
+
+def _axis_bytes(tree: Any, sizes: dict) -> dict:
+    """Total bytes of leaves whose placement uses each mesh axis."""
+    out = {a: 0 for a in sizes if sizes[a] > 1}
+    for x in jax.tree.leaves(tree):
+        spec = getattr(getattr(x, "sharding", None), "spec", None)
+        if spec is None:
+            continue
+        nbytes = (int(np.prod(_leaf_shape(x)) or 1)
+                  * int(np.dtype(getattr(x, "dtype", np.float32)).itemsize))
+        for a in _spec_axes(spec):
+            if a in out:
+                out[a] += nbytes
+    return out
+
+
+def emit_shard_metrics(params: Any, opt_state: Any | None = None,
+                       mesh_ctx: MeshContext | None = None,
+                       engine: str = "trainer") -> dict:
+    """Publish the ``synapseml_shard_*`` gauge family to the PR-2 registry:
+    total vs per-device bytes per tree kind, per-axis placed bytes, and
+    HBM headroom after params + optimizer state (device ``memory_stats``
+    when the backend exposes them — TPU does, CPU typically not).
+    Returns the snapshot dict (the bench reads it)."""
+    from ..core import observability as obs
+
+    reg = obs.get_registry()
+    sizes = mesh_ctx.axis_sizes if mesh_ctx is not None else {}
+    snapshot: dict = {}
+    per_dev_total = 0
+    for kind, tree in (("params", params), ("opt_state", opt_state)):
+        if tree is None:
+            continue
+        tot = total_bytes(tree)
+        dev = per_device_bytes(tree)
+        per_dev_total += dev
+        reg.gauge("synapseml_shard_total_bytes",
+                  "global bytes of the placed tree", ("kind", "engine")
+                  ).set(tot, kind=kind, engine=engine)
+        reg.gauge("synapseml_shard_bytes_per_device",
+                  "bytes ONE device holds for the placed tree (the ZeRO "
+                  "denominator)", ("kind", "engine")
+                  ).set(dev, kind=kind, engine=engine)
+        snapshot[kind] = {"total_bytes": tot, "bytes_per_device": dev}
+        for axis, nbytes in _axis_bytes(tree, sizes).items():
+            reg.gauge("synapseml_shard_axis_bytes",
+                      "bytes of leaves sharded over each mesh axis",
+                      ("kind", "axis", "engine")
+                      ).set(nbytes, kind=kind, axis=axis, engine=engine)
+            snapshot[kind].setdefault("axis_bytes", {})[axis] = nbytes
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+    except Exception:  # noqa: BLE001 - CPU backends have no memory_stats
+        limit = 0
+    if limit:
+        headroom = limit - per_dev_total
+        reg.gauge("synapseml_shard_hbm_headroom_bytes",
+                  "device memory limit minus per-device params+opt bytes",
+                  ("engine",)).set(headroom, engine=engine)
+        snapshot["hbm_headroom_bytes"] = headroom
+    return snapshot
+
+
+# ---- default rule tables --------------------------------------------------
+
+def _model_axis_for(mesh: MeshConfig | None) -> str:
+    """The model-parallel axis the default tables shard over: ``tensor``
+    normally, ``fsdp`` when the mesh declares no tensor parallelism but
+    does have an fsdp group — the fsdp-only sharded-inference layout the
+    pre-rule-table logical rules supported must keep working."""
+    if mesh is not None and mesh.tensor == 1 and mesh.fsdp != 1:
+        return "fsdp"
+    return "tensor"
+
+
+def default_llama_rules(mesh: MeshConfig | None = None,
+                        **overrides) -> PartitionRules:
+    """Megatron-style table for the :class:`LlamaLM` param tree (and its
+    GPT-2 cousin): embeddings/vocab over the model-parallel axis
+    (``tensor``, or ``fsdp`` on a tensor-less mesh — see
+    :func:`_model_axis_for`), attention heads likewise, MLP in-dim on the
+    output projection, norms replicated. ``stage_regex`` names the
+    decoder-layer cut points for pipeline splits."""
+    mp = _model_axis_for(mesh)
+    if mp == "fsdp":
+        # tensor-less mesh: shard the HIDDEN dim of every projection (the
+        # layout the pre-rule-table logical rules produced — head/kv dims
+        # stay whole, so small-head models divide on any fsdp size)
+        rules = (
+            (r"embed/embedding$", (None, "fsdp")),
+            (r"wpe/embedding$", (None, None)),
+            (r"lm_head/kernel$", ("fsdp", None)),
+            (r"attn/(q|k|v)/kernel$", ("fsdp", None, None)),
+            (r"attn/o/kernel$", (None, None, "fsdp")),
+            (r"mlp/(wi|wi_0|wi_1|gate|up)/kernel$", ("fsdp", None)),
+            (r"mlp/(wo|down)/kernel$", (None, "fsdp")),
+            (r"(norm|ln|scale)", (None,)),
+        )
+    else:
+        rules = (
+            (r"embed/embedding$", (mp, None)),
+            (r"wpe/embedding$", (None, None)),
+            (r"lm_head/kernel$", (None, mp)),
+            # fused QKV/attention projections: (hidden, heads, head_dim)
+            (r"attn/(q|k|v)/kernel$", (None, mp, None)),
+            (r"attn/o/kernel$", (mp, None, None)),
+            (r"mlp/(wi|wi_0|wi_1|gate|up)/kernel$", (None, mp)),
+            (r"mlp/(wo|down)/kernel$", (mp, None)),
+            (r"(norm|ln|scale)", (None,)),
+        )
+    kw: dict = dict(rules=rules, stage_regex=r"layer_(\d+)", mesh=mesh)
+    kw.update(overrides)
+    return PartitionRules(**kw)
+
+
+def default_transformer_rules(mesh: MeshConfig | None = None,
+                              **overrides) -> PartitionRules:
+    """Generic encoder table (BERT/ViT classifiers): dense kernels split
+    their output dim over the model-parallel axis, output projections
+    their input dim, embeddings the vocab dim."""
+    mp = _model_axis_for(mesh)
+    if mp == "fsdp":
+        rules = (
+            (r"embedding$", (None, "fsdp")),
+            (r"kernel$", ("fsdp", None)),
+            (r"(bias|scale)$", (None,)),
+        )
+    else:
+        rules = (
+            (r"embedding$", (mp, None)),
+            (r"(out|output|o|wo|down)/kernel$", (mp, None)),
+            (r"kernel$", (None, mp)),
+            (r"(bias|scale)$", (None,)),
+        )
+    kw: dict = dict(rules=rules, stage_regex=r"layer_(\d+)", mesh=mesh)
+    kw.update(overrides)
+    return PartitionRules(**kw)

@@ -34,9 +34,25 @@ import functools
 import jax
 import jax.numpy as jnp
 
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """``jax.shard_map`` moved out of ``jax.experimental`` in newer
+    versions; the version-spanning shim lives in ``.collectives``."""
+    from .collectives import compat_shard_map
+
+    return compat_shard_map(fn, mesh, in_specs, out_specs)
+
 __all__ = ["pipeline_apply", "pipeline_apply_interleaved",
            "pipeline_apply_scattered", "pipeline_sharded",
            "stack_stage_params"]
+
+
+def _axis_size(axis_name):
+    """``jax.lax.axis_size`` is missing on older jax; ``psum(1, axis)``
+    constant-folds to the same static int inside shard_map."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
 
 
 def _pvary(x, axis_name):
@@ -72,7 +88,7 @@ def _stage_preamble(stage_fn, stacked_params, axis_name, remat):
         # recompute stage activations in the backward scan instead of saving
         # every tick's outputs — the GPipe memory trade
         stage_fn = jax.checkpoint(stage_fn)
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     shard = jax.tree.leaves(stacked_params)[0].shape[0]
     if shard != 1:
@@ -226,7 +242,7 @@ def pipeline_apply_interleaved(stage_fn, stacked_params, x_micro,
     """
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     v = jax.tree.leaves(stacked_params)[0].shape[0]
     n_micro = jax.tree.leaves(x_micro)[0].shape[0]
@@ -359,10 +375,9 @@ def pipeline_sharded(mesh_ctx, stage_fn, stacked_params, x_micro,
         fn = functools.partial(pipeline_apply, stage_fn, axis_name=axis_name,
                                remat=remat)
         micro_spec = jax.tree.map(lambda _: P(), x_micro)
-    mapped = jax.shard_map(
-        fn, mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: P(axis_name), stacked_params),
-                  micro_spec),
-        out_specs=micro_spec,
+    mapped = _shard_map(
+        fn, mesh,
+        (jax.tree.map(lambda _: P(axis_name), stacked_params), micro_spec),
+        micro_spec,
     )
     return mapped(stacked_params, x_micro)

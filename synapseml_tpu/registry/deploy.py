@@ -65,7 +65,8 @@ def _post_json(url: str, payload: dict, timeout_s: float) -> dict:
 def admin_load(endpoint: str, registry_root: str, model: str, ref: str,
                warmup: list | None = None, version: str | None = None,
                timeout_s: float = 120.0, warmup_buckets: list | None = None,
-               use_aot: bool = True, use_autotune: bool = True) -> dict:
+               use_aot: bool = True, use_autotune: bool = True,
+               use_sharding: bool = True) -> dict:
     """Hot-swap one worker (``endpoint`` = ``http://host:port``) to a
     registry version via its ``POST /admin/load``. Returns the worker's
     reply (``{"ok": true, "version": ..., "previous": ..., "warmup":
@@ -73,7 +74,10 @@ def admin_load(endpoint: str, registry_root: str, model: str, ref: str,
     or warmup failed (the worker keeps serving its old pipeline in that
     case). ``use_aot=False`` / ``use_autotune=False`` force the JIT-warmup
     / saved-defaults path even when the artifact ships AOT executables or
-    autotuned backend pins (the coldstart bench's A/B switches)."""
+    autotuned backend pins (the coldstart bench's A/B switches).
+    ``use_sharding=False`` forces a replicated load even when the
+    manifest carries a ``sharding`` section (the worker otherwise
+    re-applies the rule table + mesh before warmup)."""
     payload: dict = {"registry": registry_root, "model": model, "ref": ref}
     if warmup:
         payload["warmup"] = list(warmup)
@@ -85,6 +89,8 @@ def admin_load(endpoint: str, registry_root: str, model: str, ref: str,
         payload["aot"] = False
     if not use_autotune:
         payload["autotune"] = False
+    if not use_sharding:
+        payload["sharding"] = False
     return _post_json(endpoint.rstrip("/") + "/admin/load", payload,
                       timeout_s)
 
@@ -100,7 +106,8 @@ class Deployment:
 
     def __init__(self, serving, registry, model: str,
                  warmup: list | None = None, alias: str = "prod",
-                 timeout_s: float = 120.0, use_aot: bool = True):
+                 timeout_s: float = 120.0, use_aot: bool = True,
+                 use_sharding: bool = True):
         self.serving = serving
         self.registry = registry
         self.model = model
@@ -108,6 +115,7 @@ class Deployment:
         self.warmup = list(warmup or [])
         self.timeout_s = timeout_s
         self.use_aot = use_aot
+        self.use_sharding = use_sharding
         # per-rollout aggregate of the workers' warmup breakdowns — the
         # operator's one-glance answer to "did this rollout ride AOT?"
         self.last_rollout: dict | None = None
@@ -144,7 +152,7 @@ class Deployment:
             replies.append(admin_load(
                 self._endpoint(w), self.registry.root, self.model, ref,
                 warmup=self.warmup, timeout_s=self.timeout_s,
-                use_aot=self.use_aot))
+                use_aot=self.use_aot, use_sharding=self.use_sharding))
         self.last_rollout = self._rollout_summary(ref, replies)
         return replies
 
@@ -256,7 +264,8 @@ class Deployment:
                     admin_load(self._endpoint(w), self.registry.root,
                                self.model, stable, warmup=self.warmup,
                                timeout_s=self.timeout_s,
-                               use_aot=self.use_aot)
+                               use_aot=self.use_aot,
+                               use_sharding=self.use_sharding)
                 except (RuntimeError, OSError):
                     # an unreachable canary worker stays excluded by the
                     # split; the supervisor/breaker planes own its health

@@ -221,7 +221,8 @@ class ModelRegistry:
     def publish(self, name: str, stage, version: str | None = None,
                 metrics: dict | None = None, extra: dict | None = None,
                 set_latest: bool = True, aot: dict | None = None,
-                autotune: dict | None = None) -> PublishedVersion:
+                autotune: dict | None = None,
+                sharding=None) -> PublishedVersion:
         """Save ``stage``, blobify its tree, and write the signed manifest.
         ``version`` defaults to the next ``v<N>``; ``metrics`` is the
         caller's evaluation snapshot at publish time (what the deployment
@@ -240,7 +241,16 @@ class ModelRegistry:
         plus optional ``{"winners": {...}}`` overrides from the decision
         benches) searches any stage-declared ``_AUTOTUNE_PARAMS`` backend
         candidates and pins the fastest per platform into the manifest —
-        the AOT capture then compiles the winning kernels."""
+        the AOT capture then compiles the winning kernels.
+
+        ``sharding`` records the declarative sharding plane in the
+        manifest: a ``parallel.partition.PartitionRules`` (its ``mesh``
+        field names the target topology), a prebuilt section dict, or
+        ``"auto"`` to lift the stage's own ``partition_rules``/
+        ``mesh_config`` params. ``/admin/load`` re-applies the section
+        BEFORE warmup — a loading host whose devices cannot build the
+        recorded mesh demotes to a replicated load with one structured
+        warning instead of a failed swap."""
         store = self._require_local("publish")
         _safe_component(name)
         version = _safe_component(version or self.next_version(name))
@@ -273,12 +283,65 @@ class ModelRegistry:
             manifest["aot"] = aot_section
         if tune_section is not None:
             manifest["autotune"] = tune_section
+        shard_section = self._sharding_section(stage, sharding)
+        if shard_section is not None:
+            manifest["sharding"] = shard_section
         if extra:
             manifest["extra"] = dict(extra)
         path = store.write_manifest(name, version, manifest)
         if set_latest:
             store.write_alias(name, "latest", version)
         return PublishedVersion(name, version, manifest, path)
+
+    def _sharding_section(self, stage, sharding) -> dict | None:
+        """Build the manifest's ``sharding`` section. Accepts a
+        ``PartitionRules``, a prebuilt section dict (``{"rules": ...}``),
+        or ``"auto"`` (lift the stage's own ``partition_rules`` +
+        ``mesh_config`` params — the publish path for a stage already
+        configured for sharded serving). Per-leaf spec digests are added
+        when the stage exposes a ``model_params`` pytree."""
+        if sharding is None:
+            return None
+        from ..parallel import partition as pp
+        from ..parallel.mesh import MeshConfig
+
+        if isinstance(sharding, dict) and "rules" in sharding:
+            return dict(sharding)
+        target = pp.sharding_target(stage)
+        if sharding == "auto":
+            if target is None:
+                raise ValueError(
+                    f"publish(sharding='auto'): stage "
+                    f"{type(stage).__name__} has no partition_rules/"
+                    "mesh_config params to lift (nested stages searched)")
+            mesh_cfg = target.get("mesh_config")
+            rules = target.get("partition_rules") \
+                or pp.default_llama_rules(mesh=mesh_cfg)
+            if mesh_cfg is None:
+                raise ValueError(
+                    "publish(sharding='auto'): stage has no mesh_config "
+                    "set — there is no topology to record")
+            if rules.mesh is None:
+                import dataclasses as dc
+
+                rules = dc.replace(rules, mesh=mesh_cfg)
+            sharding = rules
+        if not isinstance(sharding, pp.PartitionRules):
+            raise TypeError(
+                f"sharding must be a PartitionRules, a section dict or "
+                f"'auto', got {type(sharding).__name__}")
+        if sharding.mesh is None:
+            raise ValueError(
+                "publish(sharding=...): the rule table must carry its "
+                "target mesh (PartitionRules(mesh=MeshConfig(...))) so "
+                "/admin/load can rebuild the topology")
+        assert isinstance(sharding.mesh, MeshConfig)
+        params = None
+        if target is not None and callable(getattr(target, "has_param",
+                                                   None)) \
+                and target.has_param("model_params"):
+            params = target.get("model_params")
+        return pp.sharding_manifest_section(sharding, params)
 
     def _publish_compile(self, stage_dir: str, store: ArtifactStore,
                          aot: dict | None, autotune: dict | None):

@@ -1,0 +1,60 @@
+"""Shared servable sharded-LM pipeline for the sharding-plane tests and
+the sharded publish->load->serve round trips: JSON request bodies ->
+prompt column -> HuggingFaceCausalLM (llama-tiny; ``model_params=None``
+random-inits from ``PRNGKey(0)``, so every fresh load of the artifact
+holds byte-identical weights) -> reply dicts. Module-level classes so
+publish/load round-trips by class reference across processes."""
+
+import numpy as np
+
+from synapseml_tpu.core.pipeline import PipelineModel, Transformer
+
+
+class BodyToPrompt(Transformer):
+    """Parsed request bodies (``{"prompt": "..."}``) -> a ``prompt``
+    column."""
+
+    def _transform(self, df):
+        def per_part(p):
+            out = dict(p)
+            out["prompt"] = np.asarray(
+                [b.get("prompt", "") if isinstance(b, dict) else str(b)
+                 for b in p["body"]], dtype=object)
+            return out
+
+        return df.map_partitions(per_part)
+
+
+class CompletionToReply(Transformer):
+    """Generated token-id rows -> one JSON-able reply dict per request."""
+
+    def _transform(self, df):
+        def per_part(p):
+            out = dict(p)
+            out["reply"] = np.asarray(
+                [{"tokens": [int(t) for t in np.asarray(c).ravel()]}
+                 for c in p["completions"]], dtype=object)
+            return out
+
+        return df.map_partitions(per_part)
+
+
+def make_lm_pipeline(mesh_config=None, partition_rules=None,
+                     max_new_tokens=4):
+    from synapseml_tpu.hf import HuggingFaceCausalLM
+
+    lm = HuggingFaceCausalLM(model_name="llama-tiny",
+                             max_new_tokens=max_new_tokens,
+                             prompt_bucket=8, batch_size=4)
+    if mesh_config is not None:
+        lm.set(mesh_config=mesh_config)
+    if partition_rules is not None:
+        lm.set(partition_rules=partition_rules)
+    return PipelineModel([BodyToPrompt(), lm, CompletionToReply()])
+
+
+def prompt_rows(n, seed=0):
+    rs = np.random.default_rng(seed)
+    words = ["alpha", "beta", "gamma", "delta", "omega", "zeta"]
+    return [{"prompt": " ".join(rs.choice(words, size=3))}
+            for _ in range(n)]

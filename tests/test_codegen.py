@@ -161,7 +161,10 @@ def test_no_inline_jit_in_stage_transform():
                "automl/tune.py", "automl/hyperparams.py",
                "models/fused_trainer.py", "gbdt/fused.py",
                "scoring/planner.py", "scoring/runner.py", "scoring/sink.py",
-               "registry/aot.py", "registry/autotune.py"]
+               "registry/aot.py", "registry/autotune.py",
+               # the sharding plane: placement is declarative data, never
+               # an ad-hoc jit (the trainer's jits stay estimator-time)
+               "parallel/partition.py", "models/pipeline_trainer.py"]
     pkg = pathlib.Path(st.__file__).parent
     offenders = []
     for rel in modules:
@@ -189,6 +192,43 @@ def test_no_inline_jit_in_stage_transform():
     assert not offenders, (
         "jax.jit outside a CompiledCache builder (route it through "
         f"core.batching.CompiledCache.get): {offenders}")
+
+
+def test_shardings_only_through_rule_table():
+    """Static guard for the sharding plane: trainer/estimator/conversion
+    modules must acquire shardings ONLY through the declarative rule table
+    (``parallel.partition``) or the mesh context's helpers — no inline
+    ``NamedSharding`` construction outside ``parallel/``. An inline
+    sharding would fork placement off the one table that checkpoints,
+    registry manifests and ``/admin/load`` round-trip, so a published
+    model could silently serve with a layout its manifest does not
+    record. (``gbdt/booster.py``'s row-scatter helper predates the plane
+    and shards BATCHES, not params — out of scope.)"""
+    import ast
+
+    modules = ["models/trainer.py", "models/pipeline_trainer.py",
+               "models/fused_trainer.py", "models/convert_hf.py",
+               "hf/causal_lm.py", "hf/embedder.py", "io/serving.py",
+               "registry/registry.py", "registry/deploy.py"]
+    pkg = pathlib.Path(st.__file__).parent
+    offenders = []
+    for rel in modules:
+        tree = ast.parse((pkg / rel).read_text())
+        for node in ast.walk(tree):
+            name = node.id if isinstance(node, ast.Name) else (
+                node.attr if isinstance(node, ast.Attribute) else None)
+            if name == "NamedSharding":
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "inline NamedSharding outside parallel/ (route placement through "
+        f"parallel.partition's rule table): {offenders}")
+    # the positive side: the rule-table entry points are what these
+    # modules consume
+    trainer_src = (pkg / "models/trainer.py").read_text()
+    assert "_rule_place_params" in trainer_src
+    assert "partition" in trainer_src
+    lm_src = (pkg / "hf/causal_lm.py").read_text()
+    assert "shard_pretrained_params" in lm_src
 
 
 def test_fit_paths_consume_batches_through_data_plane():

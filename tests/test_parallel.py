@@ -223,9 +223,10 @@ def test_async_checkpointer_nonblocking_save_and_backpressure(tmp_path, monkeypa
     real_save = cp.save_checkpoint
     delay = 0.4
 
-    def slow_save(path, tree, step=0, use_orbax=None):
+    def slow_save(path, tree, step=0, use_orbax=None, sharding=None):
         time.sleep(delay)
-        return real_save(path, tree, step, use_orbax=use_orbax)
+        return real_save(path, tree, step, use_orbax=use_orbax,
+                         sharding=sharding)
 
     monkeypatch.setattr(cp, "save_checkpoint", slow_save)
 

@@ -116,12 +116,14 @@ def test_pipeline_inside_shard_map_direct():
     stacked = stack_stage_params(stages)
     x = jnp.asarray(np.random.default_rng(9).normal(size=(n_micro, mb, d)),
                     jnp.float32)
+    from synapseml_tpu.parallel.pipeline import _shard_map
+
     mesh = create_mesh(MeshConfig(data=1, pipe=8))
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         lambda p, xx: pipeline_apply(mlp_stage, p, xx),
-        mesh=mesh.mesh,
-        in_specs=(jax.tree.map(lambda _: P("pipe"), stacked), P()),
-        out_specs=P(),
+        mesh.mesh,
+        (jax.tree.map(lambda _: P("pipe"), stacked), P()),
+        P(),
     )
     np.testing.assert_allclose(np.asarray(mapped(stacked, x)),
                                np.asarray(sequential(stages, x)),
