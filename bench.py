@@ -102,6 +102,12 @@ CONFIGS = [
     # bytes <= 1/dp + eps, step-time >= 0.9x, f32 param parity); the
     # fresh-arm subprocesses force CPU, honest on the fallback
     ("sharded-train", "sharded_train", 300, 300),
+    # fleet-elastic A/B: static (3 fixed) vs autoscaled (1..8) subprocess
+    # fleets under the same 1x->8x->1x closed-loop step load, same round —
+    # SLO-violation seconds + worker-seconds + zero-new-traces AOT gate on
+    # every scale-up worker; host-driven (workers force CPU), honest on
+    # the fallback
+    ("fleet-elastic", "fleet_elastic", 360, 360),
     ("flagship", None, 420, 360),
     ("vit", "vit_finetune", 450, 300),
 ]

@@ -1,0 +1,51 @@
+"""Generated passthrough namespace — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers).
+Re-exports the public surface of ``synapseml_tpu.fleet`` so the compat layer covers
+non-stage subsystems too (compat coverage is drift-tested).
+"""
+
+
+from synapseml_tpu.fleet import (  # noqa: F401
+    AdmissionController,
+    AdmissionDecision,
+    AdmissionPolicy,
+    FleetAutoscaler,
+    FleetSignals,
+    FleetSpec,
+    ModelSLO,
+    ResidencyManager,
+    SubprocessWorkerLauncher,
+    ThreadWorkerLauncher,
+    TokenBucket,
+    WorkerHandle,
+    WorkerLauncher,
+    artifact_nbytes,
+    fleet_worker_main,
+    model_from_path,
+    model_path,
+    priority_of,
+    serve_multi_model,
+)
+
+__all__ = [
+    'AdmissionController',
+    'AdmissionDecision',
+    'AdmissionPolicy',
+    'FleetAutoscaler',
+    'FleetSignals',
+    'FleetSpec',
+    'ModelSLO',
+    'ResidencyManager',
+    'SubprocessWorkerLauncher',
+    'ThreadWorkerLauncher',
+    'TokenBucket',
+    'WorkerHandle',
+    'WorkerLauncher',
+    'artifact_nbytes',
+    'fleet_worker_main',
+    'model_from_path',
+    'model_path',
+    'priority_of',
+    'serve_multi_model',
+]

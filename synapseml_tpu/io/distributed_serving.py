@@ -39,11 +39,38 @@ from ..core import batching as cb
 from ..core import faults as _faults
 from ..core import observability as obs
 from ..core.resilience import CircuitBreaker, resilience_measures
+# the fleet plane owns the model-path and priority-class conventions; one
+# definition each (fleet modules import io lazily, so no cycle)
+from ..fleet.admission import priority_of as _priority_of
+from ..fleet.residency import model_from_path as _model_of_path
 from .serving import NoDelayHTTPServer
 
 __all__ = ["WorkerRegistry", "RoutingFront", "RoutingClient",
            "serve_pipeline_distributed", "worker_main",
-           "collect_distributed_trace"]
+           "deregister_worker", "collect_distributed_trace"]
+
+
+def deregister_worker(registry_address: str, info: dict,
+                      timeout_s: float = 10.0) -> bool:
+    """POST a worker's registration info to the registry's ``/deregister``
+    endpoint — the ONE graceful-removal call both worker entrypoints
+    (``worker_main`` here, ``fleet_worker_main``) and the in-process fleet
+    launcher share, so the deregister contract cannot drift between them.
+    ``registry_address`` may be the ``/register`` URL or the bare registry
+    address (the handler only branches on a ``deregister`` suffix).
+    Best-effort: an unreachable registry returns False, never raises —
+    the caller is about to exit either way."""
+    base = str(registry_address).rstrip("/")
+    dereg = (base[:-len("/register")] if base.endswith("/register")
+             else base) + "/deregister"
+    try:
+        urllib.request.urlopen(urllib.request.Request(
+            dereg, data=json.dumps(info).encode(), method="POST",
+            headers={"Content-Type": "application/json"}),
+            timeout=timeout_s).read()
+        return True
+    except (urllib.error.URLError, OSError):
+        return False
 
 _BREAKER_STATE_NUM = {CircuitBreaker.CLOSED: 0.0,
                       CircuitBreaker.HALF_OPEN: 1.0,
@@ -55,13 +82,17 @@ _BREAKER_STATE_NUM = {CircuitBreaker.CLOSED: 0.0,
 _BREAKER_OWNER_IDS = itertools.count(1)
 
 
-def _register_breaker_gauge(owner, plane: str) -> None:
+def _register_breaker_gauge(owner, plane: str,
+                            instance: str | None = None) -> None:
     """Pull-time ``synapseml_breaker_state`` gauge per worker endpoint
     (0=closed, 1=half-open, 2=open) for a RoutingFront/RoutingClient.
-    Weakref'd: a collected owner silently stops exporting."""
+    Weakref'd: a collected owner silently stops exporting. ``instance``
+    lets one owner share ITS id across several collectors (the front's
+    breaker + split gauges must correlate on a dashboard)."""
     ref = weakref.ref(owner)
     reg = obs.get_registry()
-    instance = str(next(_BREAKER_OWNER_IDS))
+    if instance is None:
+        instance = str(next(_BREAKER_OWNER_IDS))
 
     def collect():
         o = ref()
@@ -131,9 +162,14 @@ _ROUTE_METRICS = obs.HandleCache(lambda reg: {
 class _VersionStats:
     """Monotonic per-version counters + a bounded latency window, kept by
     the RoutingFront so the auto-rollback controller (registry/deploy.py)
-    can diff outcomes without scraping the Prometheus text format."""
+    and the fleet autoscaler can diff outcomes without scraping the
+    Prometheus text format. The fleet plane adds per-PRIORITY state: how
+    many requests of each class are in flight through the front right now
+    (the front-side queue depth) and how many the admission controller
+    shed (monotonic, reconcilable with client-observed 429s)."""
 
-    __slots__ = ("ok", "err", "shadow_ok", "shadow_err", "latencies_ms")
+    __slots__ = ("ok", "err", "shadow_ok", "shadow_err", "latencies_ms",
+                 "inflight", "shed")
 
     def __init__(self):
         self.ok = 0
@@ -141,12 +177,15 @@ class _VersionStats:
         self.shadow_ok = 0
         self.shadow_err = 0
         self.latencies_ms = collections.deque(maxlen=256)
+        self.inflight = {"interactive": 0, "bulk": 0}
+        self.shed = {"interactive": 0, "bulk": 0}
 
     def snapshot(self) -> dict:
         lat = list(self.latencies_ms)
         out = {"ok": self.ok, "err": self.err,
                "shadow_ok": self.shadow_ok, "shadow_err": self.shadow_err,
-               "n_latencies": len(lat)}
+               "n_latencies": len(lat),
+               "inflight": dict(self.inflight), "shed": dict(self.shed)}
         if lat:
             lat.sort()
             out["p50_ms"] = round(lat[len(lat) // 2], 3)
@@ -159,6 +198,65 @@ def _version_of(w: dict) -> str:
     """A worker registration's pipeline version label (canary routing /
     per-version metrics); unlabeled fleets collapse to one series."""
     return str(w.get("version") or "unversioned")
+
+
+def _hosts_model(w: dict, model: str) -> bool:
+    """Does this worker registration advertise ``model``? (Single-model
+    fleet workers register ``model``; multi-model residency workers may
+    register a ``models`` list.)"""
+    if w.get("model") == model:
+        return True
+    models = w.get("models")
+    return isinstance(models, (list, tuple)) and model in models
+
+
+def _model_aware(w: dict) -> bool:
+    """Does this registration carry ANY model info (single-model ``model``
+    or multi-model ``models``)?"""
+    return w.get("model") is not None \
+        or isinstance(w.get("models"), (list, tuple))
+
+
+def _eligible_for_model(w: dict, model: str, fleet_labeled: bool) -> bool:
+    """Can this worker SERVE ``model`` at all? A single-model worker
+    registered for a DIFFERENT model is ineligible — forwarding a /m/B
+    request to model A's pipeline would return A's prediction with a 200,
+    a silent wrong answer worse than a 503. Multi-model residency workers
+    (a ``models`` list, even empty — they load on demand) stay eligible.
+    Model-less legacy registrations are eligible ONLY on an unlabeled
+    fleet (``fleet_labeled`` False — pre-fleet deployments that happen to
+    use /m/ paths keep working); once any worker advertises model info,
+    an unlabeled worker serving who-knows-what must not catch model
+    traffic the labeled workers dropped."""
+    if _hosts_model(w, model):
+        return True
+    if isinstance(w.get("models"), (list, tuple)):
+        return True
+    return w.get("model") is None and not fleet_labeled
+
+
+def _register_split_gauge(front, instance: str) -> None:
+    """Pull-time ``synapseml_route_split_weight`` gauge per version: the
+    active canary/traffic split, visible on ``/metrics`` so dashboards see
+    rollout state without scraping admin endpoints. Weakref'd like the
+    breaker gauge; a cleared split simply stops exporting. ``instance``
+    is the owning front's id — the same label its breaker gauge carries."""
+    ref = weakref.ref(front)
+    reg = obs.get_registry()
+
+    def collect():
+        o = ref()
+        if o is None:
+            reg.unregister_collector(collect)
+            return
+        for version, weight in (o.traffic_split() or {}).items():
+            yield obs.Sample(
+                "synapseml_route_split_weight",
+                {"version": version, "instance": instance}, weight,
+                help="active traffic-split weight per pipeline version "
+                     "(normalized; absent = no split active)")
+
+    reg.register_collector(collect)
 
 
 def _nodelay_connection(host: str, port: int,
@@ -175,7 +273,10 @@ class WorkerRegistry:
     """Driver-side worker registration (DriverServiceUtils analog): workers
     POST {host, port, pid}; the routing table is the registered list. A
     re-registration from the same (host, port) replaces the old entry, so a
-    restarted worker rejoins cleanly."""
+    restarted worker rejoins cleanly. ``POST .../deregister`` removes the
+    entry — a gracefully DRAINED worker (fleet plane, ``/admin/drain``)
+    leaves the table deliberately, so its disappearance is no longer
+    indistinguishable from a crash."""
 
     def __init__(self):
         self._workers: list[dict] = []
@@ -194,7 +295,8 @@ class WorkerRegistry:
                     registry._workers = [
                         w for w in registry._workers
                         if (w.get("host"), w.get("port")) != key]
-                    registry._workers.append(info)
+                    if not self.path.rstrip("/").endswith("deregister"):
+                        registry._workers.append(info)
                 body = b"{}"
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))
@@ -419,7 +521,9 @@ class RoutingFront:
                  resurrect_after_s: float = 2.0,
                  max_inflight_shadows: int = 8,
                  coalesce_window_ms: float = 0.0,
-                 coalesce_max_group: int = 64):
+                 coalesce_max_group: int = 64,
+                 admission=None,
+                 route_by_model: bool = False):
         if workers is None and registry is None:
             raise ValueError("RoutingFront needs workers and/or a registry")
         # same-path coalescing toward bucket-sized worker batches (0 = off,
@@ -443,6 +547,14 @@ class RoutingFront:
         self._split_rng = random.Random()
         self._version_stats: dict[str, _VersionStats] = {}
         self._shadow_sem = threading.Semaphore(max_inflight_shadows)
+        # fleet plane: the admission controller (per-model token buckets,
+        # priority classes, p99 shedding — fleet/admission.py) consulted
+        # BEFORE any worker is picked, and model-segment routing: a
+        # ``/m/<model>`` path prefers workers advertising that model
+        # (rendezvous-ordered when none do, so multi-model residency
+        # workers pack stably instead of thrashing their LRU)
+        self._admission = admission
+        self.route_by_model = bool(route_by_model)
         front = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -472,13 +584,16 @@ class RoutingFront:
                                 {"Content-Type": "application/json"})
                     return
                 if self.path == "/stats":  # resilience counters + breakers
+                    adm = front._admission
                     stats = json.dumps({
                         "resilience": resilience_measures(
                             "distributed_serving").to_dict(),
                         "breakers": front.breaker_states(),
                         "traffic_split": front.traffic_split(),
                         "shadow": front.shadow(),
-                        "versions": front.version_stats()}).encode()
+                        "versions": front.version_stats(),
+                        "admission": (adm.stats()
+                                      if adm is not None else None)}).encode()
                     self._reply(200, stats,
                                 {"Content-Type": "application/json"})
                     return
@@ -508,6 +623,32 @@ class RoutingFront:
 
             def _route(self, method: str, body) -> None:
                 rm = _ROUTE_METRICS.get()
+                model = _model_of_path(self.path)
+                label = model or "unversioned"
+                priority = _priority_of(self.headers)
+                adm = front._admission
+                if adm is not None:
+                    decision = adm.admit(model or "default", priority)
+                    if not decision.admitted:
+                        # shed AT the front: a terminal 429 + Retry-After,
+                        # before the request costs a worker queue slot
+                        front._record_shed(label, priority)
+                        payload = json.dumps(
+                            {"error": "admission shed",
+                             "reason": decision.reason}).encode()
+                        self._reply(decision.status or 429, payload, {
+                            "Content-Type": "application/json",
+                            "Retry-After": str(max(
+                                1, int(-(-decision.retry_after_s // 1))))})
+                        return
+                front._record_inflight(label, priority, +1)
+                try:
+                    self._route_admitted(method, body, rm, model, priority)
+                finally:
+                    front._record_inflight(label, priority, -1)
+
+            def _route_admitted(self, method: str, body, rm,
+                                model, priority) -> None:
                 hdrs = {k: v for k, v in self.headers.items()
                         if k.lower() not in ("host", "connection",
                                              "traceparent")}
@@ -522,22 +663,27 @@ class RoutingFront:
                     candidates, desperate = front._group_candidates(group)
                 else:
                     t0 = time.perf_counter()
-                    candidates, desperate = front._candidates()
-                tried = 0
+                    candidates, desperate = front._candidates(model=model)
+                picked = False
+                pending_retry = False  # set by a REAL failure only: the
+                # next attempt after one counts as a retry; a drain skip
+                # does not arm it, so routine scale-down never shows up
+                # in the retry counters
                 for w in candidates:
                     key = (w.get("host"), w.get("port"))
                     breaker = front._breaker(key)
                     if not desperate and not breaker.allow():
                         continue  # raced shut since the candidate list
-                    if tried:  # rerouting after a failure = one retry
+                    if pending_retry:
                         resilience_measures("distributed_serving").count("retry")
                         rm["retries"].inc()
-                    else:
+                        pending_retry = False
+                    if not picked:
                         # worker pick = table refresh + breaker filtering +
                         # rotation, before the first byte is forwarded
                         rm["pick_ms"].observe(
                             (time.perf_counter() - t0) * 1e3)
-                    tried += 1
+                        picked = True
                     endpoint = f"{key[0]}:{key[1]}"
                     version = _version_of(w)
                     fwd0 = time.perf_counter()
@@ -551,13 +697,30 @@ class RoutingFront:
                         front._record_version(version, ok=False)
                         rm["version_requests"].inc(version=version,
                                                    status="error")
+                        pending_retry = True
                         continue
                     status, payload = got
                     breaker.record_success()  # proven alive
+                    if status == 503 \
+                            and payload == b'{"error": "worker draining"}':
+                        # a DRAINING worker is healthy but leaving (fleet
+                        # plane /admin/drain): reroute to the rest of the
+                        # fleet instead of surfacing its refusal — scale-
+                        # down stays invisible to clients. Not a breaker
+                        # failure AND not a retry in the resilience
+                        # counters (routine scale-down must not read as
+                        # worker failures on a dashboard); the EXACT-body
+                        # match cannot false-positive on an application
+                        # 503 that merely mentions the phrase. The
+                        # registry table drops the worker when its drain
+                        # completes.
+                        continue
                     elapsed_ms = (time.perf_counter() - fwd0) * 1e3
                     rm["request_ms"].observe(elapsed_ms, worker=endpoint)
                     front._record_version(version, ok=status < 500,
                                           latency_ms=elapsed_ms)
+                    front._observe_admission(model, elapsed_ms,
+                                             ok=status < 500)
                     rm["version_requests"].inc(
                         version=version,
                         status=f"{status // 100}xx")
@@ -579,7 +742,12 @@ class RoutingFront:
 
         self._server = NoDelayHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._server.server_address[1]
-        _register_breaker_gauge(self, plane="front")
+        # ONE instance id per front, shared by every collector it owns —
+        # dashboards correlate its series by this label
+        self._instance = str(next(_BREAKER_OWNER_IDS))
+        _register_breaker_gauge(self, plane="front",
+                                instance=self._instance)
+        _register_split_gauge(self, self._instance)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -613,24 +781,42 @@ class RoutingFront:
             return {f"{h}:{p}": br.state
                     for (h, p), br in self._breakers.items()}
 
-    def _candidates(self) -> tuple[list[dict], bool]:
+    def _candidates(self, model: str | None = None) -> tuple[list[dict], bool]:
         """(routing order for one request, desperate): breaker-available
         (closed or probe-due) workers round-robin rotated; if none, the
         least-recently-failed worker as a desperation probe. With a traffic
         split active, a version is drawn by weight and its workers are
         ordered FIRST; every other live worker follows as fallback — a
         canary whose workers all failed degrades to the stable fleet
-        instead of dropping the request."""
-        table = self._table()
-        if not table:
-            return [], False
-        live_keys = {(w.get("host"), w.get("port")) for w in table}
+        instead of dropping the request.
+
+        ``model`` (a ``/m/<model>`` path segment, fleet plane) adds model
+        affinity ON TOP: workers advertising the model order first; when
+        NONE advertise it and ``route_by_model`` is set, candidates order
+        by a stable rendezvous hash of (model, endpoint) instead of the
+        rotation — every request for one model lands on the same worker
+        first, so multi-model residency workers pack a consistent subset
+        instead of thrashing their LRU across the fleet."""
+        full_table = self._table()
+        # breaker pruning keys off the FULL table — a model-filtered view
+        # must not evict other models' workers' breakers
+        live_keys = {(w.get("host"), w.get("port")) for w in full_table}
         with self._lock:
             # prune breakers for departed workers (respawns land on fresh
             # ephemeral ports; without this the map grows forever)
             if len(self._breakers) > len(live_keys):
                 self._breakers = {k: b for k, b in self._breakers.items()
                                   if k in live_keys}
+        table = full_table
+        if model is not None:
+            # a request that NAMES a model must never be answered by a
+            # different model's pipeline: drop ineligible workers outright
+            # (no eligible worker = honest 503, not a wrong 200)
+            labeled = any(_model_aware(w) for w in full_table)
+            table = [w for w in full_table
+                     if _eligible_for_model(w, model, labeled)]
+        if not table:
+            return [], False
         alive = [w for w in table
                  if self._breaker((w.get("host"), w.get("port"))).available()]
         with self._lock:
@@ -644,6 +830,21 @@ class RoutingFront:
                              if _version_of(w) == chosen]
                 ordered = preferred + [w for w in ordered
                                        if _version_of(w) != chosen]
+            if model is not None:
+                hosting = [w for w in ordered if _hosts_model(w, model)]
+                if hosting:
+                    ordered = hosting + [w for w in ordered
+                                         if not _hosts_model(w, model)]
+                elif self.route_by_model:
+                    # rendezvous: stable per-model order (hash, not the
+                    # rotation) so on-demand residency stays sticky
+                    import hashlib
+
+                    def rank(w):
+                        key = f"{model}|{w.get('host')}:{w.get('port')}"
+                        return hashlib.md5(key.encode()).hexdigest()
+
+                    ordered = sorted(ordered, key=rank)
             return ordered, False
         # everything recently failed: probe the stalest failure anyway
         stalest = min(table, key=lambda w: self._breaker(
@@ -657,7 +858,8 @@ class RoutingFront:
         also accounts the group's occupancy/padding series."""
         with group.lock:
             if group.candidates is None:
-                group.candidates, group.desperate = self._candidates()
+                group.candidates, group.desperate = self._candidates(
+                    model=_model_of_path(group.path))
                 rm = _ROUTE_METRICS.get()
                 version = (_version_of(group.candidates[0])
                            if group.candidates else "unversioned")
@@ -729,13 +931,60 @@ class RoutingFront:
                 break
         return chosen
 
+    # -- fleet plane: admission control + per-priority accounting ----------
+    def set_admission(self, controller) -> None:
+        """Install/replace/clear (``None``) the admission controller
+        (:class:`~synapseml_tpu.fleet.admission.AdmissionController`)
+        consulted before every routed request."""
+        self._admission = controller
+
+    def admission(self):
+        return self._admission
+
+    # per-label stats entries are created on demand and never evicted, and
+    # the /m/<model> label is CLIENT-controlled — without a cap, a scanner
+    # spraying random model paths would grow _version_stats (and /stats
+    # output) forever on a long-lived front
+    _MAX_TRACKED_LABELS = 512
+
+    def _stats_for(self, label: str, trusted: bool = False) -> _VersionStats:
+        """Get-or-create a label's stats. ``trusted`` labels (worker
+        registrations' VERSION labels — server-side data the canary
+        rollback controller keys on) always get their own entry; untrusted
+        labels (client-derived /m/<model> path segments) overflow into
+        ``"other"`` past the cap, so a path scanner can fill the cap
+        without ever blinding ``version_stats()[canary]``."""
+        stats = self._version_stats.get(label)
+        if stats is None:
+            if not trusted \
+                    and len(self._version_stats) >= self._MAX_TRACKED_LABELS \
+                    and "other" != label:
+                return self._stats_for("other")
+            stats = self._version_stats[label] = _VersionStats()
+        return stats
+
+    def _record_shed(self, label: str, priority: str) -> None:
+        with self._deploy_lock:
+            stats = self._stats_for(label)
+            stats.shed[priority] = stats.shed.get(priority, 0) + 1
+
+    def _record_inflight(self, label: str, priority: str,
+                         delta: int) -> None:
+        with self._deploy_lock:
+            stats = self._stats_for(label)
+            stats.inflight[priority] = max(
+                stats.inflight.get(priority, 0) + delta, 0)
+
+    def _observe_admission(self, model: str | None, latency_ms: float,
+                           ok: bool) -> None:
+        if self._admission is not None:
+            self._admission.observe(model or "default", latency_ms, ok=ok)
+
     def _record_version(self, version: str, ok: bool,
                         latency_ms: float | None = None,
                         shadow: bool = False) -> None:
         with self._deploy_lock:
-            stats = self._version_stats.get(version)
-            if stats is None:
-                stats = self._version_stats[version] = _VersionStats()
+            stats = self._stats_for(version, trusted=True)
             if shadow:
                 if ok:
                     stats.shadow_ok += 1
@@ -997,8 +1246,17 @@ def worker_main(pipeline_path: str, registry_address: str,
 
     server.pipeline_holder.subscribe(register)
     info = register()
+
+    def on_drained(_report) -> None:
+        # graceful removal (fleet plane): deregister BEFORE exiting so the
+        # front's routing table reflects the drain, then leave — the
+        # supervisor (if any) sees a clean exit, not a crash to respawn
+        deregister_worker(registry_address, info)
+        os._exit(0)
+
+    server.on_drained = on_drained
     print(f"worker ready {info}", flush=True)
-    while True:  # killed by the parent
+    while True:  # killed by the parent, or exits via /admin/drain
         time.sleep(1.0)
 
 

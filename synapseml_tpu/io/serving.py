@@ -67,6 +67,9 @@ _SERVING_METRICS = obs.HandleCache(lambda reg: {
         "synapseml_serving_expired_requests_total",
         "queued requests dropped because their reply deadline passed "
         "before batch pickup").labels(),
+    "drains": reg.counter(
+        "synapseml_serving_drains_total",
+        "graceful worker drains, by outcome", ("outcome",)),
 })
 
 
@@ -221,6 +224,21 @@ class ServingServer:
         # swap's warmup breakdown (operators + fleet registration read it)
         self._aot_provider = None
         self.last_swap_report: dict | None = None
+        # graceful-drain state (fleet plane, POST /admin/drain): a draining
+        # worker refuses NEW requests with terminal 503s, finishes the
+        # queued backlog, then fires on_drained (worker entrypoints
+        # deregister + exit there; in-process launchers stop the server) —
+        # a scale-down is now distinguishable from a crash
+        self.draining = False
+        self.on_drained = None  # fn(report: dict), called once, off-thread
+        self._drain_thread: threading.Thread | None = None
+        # handlers between their draining check and their queue insert: the
+        # drain waiter must not conclude "empty" while an admission is in
+        # flight (guarded by _lock)
+        self._admitting = 0
+        self.started_at = time.monotonic()
+        # set by serve_multi_model: the residency manager /admin/stats reads
+        self.residency = None
         # bounded: a stalled pipeline sheds load with 503s instead of parking
         # unbounded connections (backpressure the round-1 loop lacked)
         self._queue: "queue.Queue[_Exchange]" = queue.Queue(maxsize=max_queue)
@@ -267,8 +285,18 @@ class ServingServer:
                         200, json.dumps(outer._admin_version()).encode(),
                         "application/json")
                     return
+                if method == "GET" and self.path == "/admin/stats":
+                    self._reply_bytes(
+                        200, json.dumps(outer._admin_stats()).encode(),
+                        "application/json")
+                    return
                 if method == "POST" and self.path == "/admin/load":
                     status, reply = outer._admin_load(body)
+                    self._reply_bytes(status, json.dumps(reply).encode(),
+                                      "application/json")
+                    return
+                if method == "POST" and self.path == "/admin/drain":
+                    status, reply = outer._admin_drain(body)
                     self._reply_bytes(status, json.dumps(reply).encode(),
                                       "application/json")
                     return
@@ -293,6 +321,30 @@ class ServingServer:
                                 else "error"))
 
             def _exchange(self, method: str, body: bytes) -> int:
+                # the admitting count brackets the draining check and the
+                # queue insert, so the drain waiter can never observe an
+                # empty queue while this handler is between the two (the
+                # accepted-then-abandoned race)
+                with outer._lock:
+                    outer._admitting += 1
+                    draining = outer.draining
+                if draining:
+                    with outer._lock:
+                        outer._admitting -= 1
+                    # a draining worker refuses NEW work with a terminal
+                    # reply (never a queued request it would then abandon);
+                    # Retry-After points clients at the rest of the fleet.
+                    # NOTE: the RoutingFront reroutes on an EXACT match of
+                    # this payload — change both together.
+                    payload = json.dumps(
+                        {"error": "worker draining"}).encode()
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return 503
                 ex = _Exchange(uuid.uuid4().hex, method, self.path,
                                dict(self.headers), body)
                 with outer._lock:
@@ -302,10 +354,13 @@ class ServingServer:
                 except queue.Full:
                     with outer._lock:
                         outer._pending.pop(ex.request_id, None)
+                        outer._admitting -= 1
                     self.send_response(503)  # shed load under backpressure
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return 503
+                with outer._lock:
+                    outer._admitting -= 1
                 ok = ex.reply_event.wait(outer.reply_timeout_s)
                 with outer._lock:
                     outer._pending.pop(ex.request_id, None)
@@ -384,6 +439,101 @@ class ServingServer:
             return {"version": None, "pipeline": None}
         pipeline, version = holder.get()
         return {"version": version, "pipeline": type(pipeline).__name__}
+
+    def _admin_stats(self) -> dict:
+        """Worker-local load snapshot (``GET /admin/stats``) — the fleet
+        autoscaler's queue-depth signal, plus the last swap's warmup
+        breakdown (the zero-cold-start evidence a scale-up must show) and
+        the resident model set on multi-model workers."""
+        out = {
+            **self._admin_version(),
+            "queue_depth": self._queue.qsize(),
+            "pending": len(self._pending),
+            "draining": self.draining,
+            "uptime_s": round(time.monotonic() - self.started_at, 3),
+            "swap": self.last_swap_report,
+        }
+        if self.residency is not None:
+            out["resident"] = self.residency.resident()
+            out["resident_bytes"] = self.residency.resident_bytes()
+        return out
+
+    def _admin_drain(self, body: bytes) -> tuple[int, dict]:
+        """``POST /admin/drain``: stop accepting new requests (terminal
+        503s, never queued-then-abandoned), let the serve loop finish the
+        queued backlog so every already-accepted exchange gets its real
+        reply (zero dropped exchanges — the PR-6 terminal-reply
+        discipline), then fire ``on_drained`` (worker entrypoints
+        deregister from the WorkerRegistry and exit there). The reply
+        returns immediately with the backlog size; drain completes
+        asynchronously — poll ``/admin/stats`` or the registry table for
+        completion. Body: ``{"timeout_s": <backlog deadline, default 30>}``
+        — exchanges still unfinished at the deadline receive terminal 503s
+        rather than holding the drain open forever."""
+        try:
+            payload = json.loads(body.decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            timeout_s = float(payload.get("timeout_s", 30.0))
+        except (json.JSONDecodeError, UnicodeDecodeError, TypeError,
+                ValueError) as e:
+            return 400, {"error": f"bad drain body: {e}"}
+        with self._lock:  # two racing drains must start ONE waiter (and
+            already = self.draining  # fire on_drained once)
+            self.draining = True
+        backlog = self._queue.qsize()
+        pending = len(self._pending)
+        if not already:
+            self._drain_thread = threading.Thread(
+                target=self._drain_and_finish, args=(timeout_s,),
+                daemon=True)
+            self._drain_thread.start()
+        return 200, {"ok": True, "draining": True, "backlog": backlog,
+                     "pending": pending, "already_draining": already}
+
+    def _drain_and_finish(self, timeout_s: float) -> None:
+        # the /admin/drain handler writes its 200 AFTER starting this
+        # thread — on an empty backlog the waiter would otherwise complete
+        # instantly and on_drained (server stop / process exit) could cut
+        # the drain reply itself off mid-write
+        time.sleep(0.1)
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while time.monotonic() < deadline:
+            with self._lock:
+                settled = not self._pending and not self._admitting
+            if settled and self._queue.qsize() == 0:
+                break
+            time.sleep(0.02)
+        # anything STILL parked past the deadline gets a terminal reply —
+        # a drain may time a slow pipeline out, but it never silently
+        # abandons an accepted exchange
+        with self._lock:
+            stuck = list(self._pending.values())
+        for ex in stuck:
+            ex.respond({"error": "worker drained before this request "
+                                 "finished"}, status=503)
+        if stuck:
+            # the responds above only WAKE the parked handler threads; give
+            # them a bounded window to actually write the 503s before
+            # on_drained (which may os._exit) can cut the sockets off
+            flush_deadline = time.monotonic() + 5.0
+            while time.monotonic() < flush_deadline:
+                with self._lock:
+                    if not self._pending:
+                        break
+                time.sleep(0.02)
+            # handlers pop _pending BEFORE writing the response bytes; a
+            # short grace covers the final socket writes
+            time.sleep(0.25)
+        outcome = "ok" if not stuck else "timeout"
+        _SERVING_METRICS.get()["drains"].inc(outcome=outcome)
+        report = {"outcome": outcome, "stuck": len(stuck)}
+        callback = self.on_drained
+        if callback is not None:
+            try:
+                callback(report)
+            except Exception:  # noqa: BLE001 — a callback bug must not
+                pass           # leave the worker half-drained
 
     def _warmup(self, stage, rows: list,
                 buckets: "list[int] | None" = None) -> int:

@@ -128,6 +128,28 @@ def test_scoring_compat_coverage():
             f"compat.scoring.{name} is not the scoring plane's own object")
 
 
+def test_fleet_compat_coverage():
+    """Same compat coverage rule for the fleet control plane: every public
+    ``synapseml_tpu.fleet`` symbol importable from the generated
+    ``compat.fleet`` passthrough, with no stale extras."""
+    import synapseml_tpu.compat.fleet as compat_fleet
+    import synapseml_tpu.fleet as fleet
+
+    public = set(fleet.__all__)
+    covered = set(compat_fleet.__all__)
+    missing = sorted(public - covered)
+    assert not missing, (
+        f"public fleet symbols missing compat coverage: {missing}; "
+        "run python -m synapseml_tpu.codegen")
+    stale = sorted(covered - public)
+    assert not stale, (
+        f"compat.fleet exports symbols the fleet plane no longer has: "
+        f"{stale}; run python -m synapseml_tpu.codegen")
+    for name in sorted(public):
+        assert getattr(compat_fleet, name) is getattr(fleet, name), (
+            f"compat.fleet.{name} is not the fleet plane's own object")
+
+
 def test_no_inline_jit_in_stage_transform():
     """Static guard for the continuous-batching plane: inference-stage
     modules must acquire jitted programs through
@@ -164,7 +186,14 @@ def test_no_inline_jit_in_stage_transform():
                "registry/aot.py", "registry/autotune.py",
                # the sharding plane: placement is declarative data, never
                # an ad-hoc jit (the trainer's jits stay estimator-time)
-               "parallel/partition.py", "models/pipeline_trainer.py"]
+               "parallel/partition.py", "models/pipeline_trainer.py",
+               # the fleet control plane: reconcile/residency/admission
+               # code must never acquire executables outside the shared
+               # CompiledCache — a control loop that traced privately
+               # would dodge the warmup precompile and the AOT second
+               # tier its own scale-up guarantee rests on
+               "fleet/autoscaler.py", "fleet/residency.py",
+               "fleet/admission.py", "fleet/spec.py"]
     pkg = pathlib.Path(st.__file__).parent
     offenders = []
     for rel in modules:

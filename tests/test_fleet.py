@@ -1,0 +1,891 @@
+"""Fleet control plane: elastic autoscaling, multi-model residency,
+admission control (ISSUE 13).
+
+Offline throughout: registries in tmp dirs, in-process thread-launcher
+workers on real HTTP ports, deterministic clocks for every policy unit.
+The acceptance surfaces:
+
+* admission under concurrent mixed-priority fire — bulk shed first,
+  interactive protected, 429 + Retry-After, counters reconcile with
+  client-observed outcomes;
+* residency E2E — 4 published versions on 2 workers under a byte budget
+  that fits only 3: LRU eviction fires, executables release, every model
+  answers correctly throughout with zero failed requests;
+* chaos — a worker killed mid-reconcile under a FaultPlan is replaced
+  within one reconcile pass with no silently-dropped request.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from _aot_pipeline import build_pipeline, sample_rows
+from synapseml_tpu.core import observability as obs
+from synapseml_tpu.fleet import (AdmissionController, AdmissionPolicy,
+                                 FleetAutoscaler, FleetSignals, FleetSpec,
+                                 ModelSLO, ResidencyManager, ThreadWorkerLauncher,
+                                 TokenBucket, WorkerHandle, WorkerLauncher,
+                                 model_from_path, model_path,
+                                 serve_multi_model)
+from synapseml_tpu.io.distributed_serving import RoutingFront, WorkerRegistry
+from synapseml_tpu.registry import ModelRegistry
+
+pytestmark = pytest.mark.fleet
+
+
+# ---------------------------------------------------------------------------
+# shared fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_store(tmp_path_factory):
+    """One registry with a single-model pipeline and four distinct small
+    versions (m0..m3) for the residency tests."""
+    root = str(tmp_path_factory.mktemp("fleet_store") / "store")
+    registry = ModelRegistry(root)
+    registry.publish("mlp", build_pipeline(), version="v1")
+    for i in range(4):
+        registry.publish(f"m{i}", build_pipeline(seed=10 + i), version="v1")
+    return root
+
+
+def _post(url: str, body: bytes, headers: dict | None = None,
+          timeout: float = 30.0):
+    """(status, parsed-json, headers) — HTTPErrors become statuses."""
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"{}"), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+# ---------------------------------------------------------------------------
+# units: token bucket, admission policy, spec
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_rate_refill_and_reserve_floor():
+    t = [0.0]
+    bucket = TokenBucket(10.0, 5.0, clock=lambda: t[0])
+    assert all(bucket.try_take() for _ in range(5))
+    assert not bucket.try_take()
+    assert bucket.wait_time_s() == pytest.approx(0.1)
+    t[0] += 0.35
+    assert bucket.try_take() and bucket.try_take() and bucket.try_take()
+    assert not bucket.try_take()
+    # the floor (priority reserve): takes refuse while they'd dip below it
+    t[0] += 0.2
+    assert not bucket.try_take(floor=4.0)
+    assert bucket.try_take(floor=1.0)
+    with pytest.raises(ValueError):
+        TokenBucket(0.0, 5.0)
+
+
+def test_admission_bulk_sheds_before_interactive():
+    t = [0.0]
+    ctrl = AdmissionController(
+        {"m": AdmissionPolicy(rate_rps=10.0, burst=10.0,
+                              interactive_reserve=0.3)},
+        clock=lambda: t[0])
+    # bulk may spend down to the 3-token reserve: 7 admits
+    bulk = [ctrl.admit("m", "bulk").admitted for _ in range(9)]
+    assert bulk == [True] * 7 + [False] * 2
+    # interactive still has the reserve
+    assert all(ctrl.admit("m", "interactive").admitted for _ in range(3))
+    assert not ctrl.admit("m", "interactive").admitted
+    stats = ctrl.stats()["m"]
+    assert stats["admitted"] == {"interactive": 3, "bulk": 7}
+    assert stats["shed"] == {"interactive": 1, "bulk": 2}
+
+
+def test_admission_p99_budget_sheds_newest_first_by_class():
+    ctrl = AdmissionController(
+        {"m": AdmissionPolicy(p99_budget_ms=100.0, hard_shed_factor=3.0,
+                              retry_after_s=2.0)})
+    assert ctrl.admit("m", "bulk").admitted  # no latency data yet
+    for _ in range(50):
+        ctrl.observe("m", 150.0)  # budget blown, under the 3x hard line
+    shed = ctrl.admit("m", "bulk")
+    assert not shed.admitted and shed.reason == "p99_budget" \
+        and shed.status == 429 and shed.retry_after_s == 2.0
+    # interactive rides through until the HARD line
+    assert ctrl.admit("m", "interactive").admitted
+    for _ in range(50):
+        ctrl.observe("m", 400.0)  # > 3x budget: total overload
+    assert not ctrl.admit("m", "interactive").admitted
+    # unknown models pass (no policy, no default)
+    assert ctrl.admit("other", "bulk").admitted
+
+
+def test_admission_p99_shed_cannot_lock_out_forever():
+    """Shed requests never reach a worker, so they never feed the latency
+    window — once no observation has landed for retry_after_s, the next
+    request admits as a PROBE (at ~1/retry_after_s cadence) instead of the
+    model shedding 429s forever on a stale p99."""
+    t = [0.0]
+    ctrl = AdmissionController(
+        {"m": AdmissionPolicy(p99_budget_ms=100.0, hard_shed_factor=1.5,
+                              retry_after_s=1.0)},
+        clock=lambda: t[0])
+    for _ in range(50):
+        ctrl.observe("m", 500.0)  # blown past the hard line
+    assert not ctrl.admit("m", "interactive").admitted  # fresh: shed
+    t[0] = 1.5  # past retry_after_s with zero observations: probe admits
+    assert ctrl.admit("m", "interactive").admitted
+    # ONE probe per window: the grant stamps the window, so the rest of
+    # the offered load sheds while the (possibly slow) probe is in flight
+    assert not ctrl.admit("m", "interactive").admitted
+    ctrl.observe("m", 500.0)  # the probe came back (still slow)
+    assert not ctrl.admit("m", "bulk").admitted  # fresh again: shed
+    t[0] = 3.0
+    assert ctrl.admit("m", "bulk").admitted  # next probe window
+
+
+def test_fleet_spec_json_round_trip_and_validation():
+    spec = FleetSpec(
+        models=[ModelSLO(model="a", ref="prod", min_workers=1,
+                         max_workers=8, p95_slo_ms=50.0,
+                         admission=AdmissionPolicy(rate_rps=100.0),
+                         serve={"batch_interval_ms": 10})],
+        reconcile_interval_s=0.5, byte_budget=1 << 20)
+    again = FleetSpec.from_json(spec.to_json())
+    assert again.models[0].admission.burst == 200.0  # 2x rate default
+    assert again.models[0].serve == {"batch_interval_ms": 10}
+    assert again.slo_for("a").max_workers == 8
+    assert again.slo_for("missing") is None
+    with pytest.raises(ValueError, match="min_workers"):
+        ModelSLO(model="x", min_workers=3, max_workers=2)
+    with pytest.raises(ValueError, match="duplicate"):
+        FleetSpec(models=[ModelSLO(model="a"), ModelSLO(model="a")])
+    with pytest.raises(ValueError, match="interactive_reserve"):
+        AdmissionPolicy(interactive_reserve=1.5)
+    # a config where bulk could NEVER take a token (reserve + 1 > burst)
+    # is a silent permanent blackhole — refused at construction
+    with pytest.raises(ValueError, match="never be admitted"):
+        AdmissionPolicy(rate_rps=0.5)  # default burst 1.0, reserve 0.2
+
+
+def test_model_path_round_trip():
+    assert model_path("mlp") == "/m/mlp"
+    assert model_from_path("/m/mlp") == "mlp"
+    assert model_from_path("/m/mlp/extra") == "mlp"
+    # query/fragment suffixes must not leak into the model key (they
+    # would defeat eligibility routing and mint bogus admission labels)
+    assert model_from_path("/m/mlp?debug=1") == "mlp"
+    assert model_from_path("/m/mlp/x?k=v#frag") == "mlp"
+    assert model_from_path("/") is None
+    assert model_from_path("/stats") is None
+    assert model_from_path("/m/") is None
+    assert model_from_path("/m/?k=v") is None
+
+
+# ---------------------------------------------------------------------------
+# unit: autoscaler policy against a fake launcher + scripted signals
+# ---------------------------------------------------------------------------
+
+class FakeLauncher(WorkerLauncher):
+    def __init__(self):
+        self.n = 0
+        self.dead: set[int] = set()
+        self.drained: list[int] = []
+
+    def spawn(self, slo):
+        self.n += 1
+        return WorkerHandle(model=slo.model, token=self.n, pid=-self.n,
+                            host="127.0.0.1", port=self.n,
+                            spawned_at=self.n, state="ready")
+
+    def alive(self, h):
+        return h.token not in self.dead
+
+    def drain(self, h, timeout_s=30.0):
+        self.drained.append(h.token)
+        self.dead.add(h.token)  # a fake drain completes instantly
+        return True
+
+    def kill(self, h):
+        self.dead.add(h.token)
+
+    def reap(self, h):
+        pass
+
+
+def test_autoscaler_policy_doubling_cooldown_streaks_and_replacement():
+    t = [0.0]
+    sig = [FleetSignals()]
+    slo = ModelSLO(model="m", min_workers=1, max_workers=8,
+                   target_queue_depth=4.0, scale_down_after=2,
+                   up_cooldown_s=10.0, down_cooldown_s=5.0)
+    spec = FleetSpec(models=[slo], reconcile_interval_s=1.0)
+    launcher = FakeLauncher()
+    asc = FleetAutoscaler(spec, launcher, clock=lambda: t[0],
+                          signals_fn=lambda s, live: sig[0])
+    events = asc.reconcile_once()
+    assert [e["event"] for e in events] == ["spawn"]  # to min_workers
+    assert asc.actual("m") == asc.desired("m") == 1
+
+    # overload: desired doubles...
+    t[0] = 1.0
+    sig[0] = FleetSignals(queue_per_worker=10.0)
+    events = asc.reconcile_once()
+    assert {e["event"] for e in events} == {"up", "spawn"}
+    assert asc.desired("m") == 2 and asc.actual("m") == 2
+    # ...but not inside the up-cooldown
+    t[0] = 2.0
+    assert asc.reconcile_once() == []
+    assert asc.desired("m") == 2
+    t[0] = 12.0
+    asc.reconcile_once()
+    assert asc.desired("m") == 4
+    t[0] = 23.0
+    asc.reconcile_once()
+    assert asc.desired("m") == 8  # clamped at max
+    t[0] = 34.0
+    assert not [e for e in asc.reconcile_once() if e["event"] == "up"]
+
+    # p95 SLO breach alone also counts as overload
+    slo95 = ModelSLO(model="p", min_workers=1, max_workers=4,
+                     p95_slo_ms=50.0, up_cooldown_s=0.0)
+    asc95 = FleetAutoscaler(FleetSpec(models=[slo95]), FakeLauncher(),
+                            clock=lambda: t[0],
+                            signals_fn=lambda s, live: FleetSignals(
+                                queue_per_worker=0.0, p95_ms=80.0))
+    asc95.reconcile_once()
+    assert asc95.desired("p") == 2  # p95 breach alone scaled it
+
+    # crash replacement happens within the SAME reconcile pass
+    victims = asc.live_handles("m")[:2]
+    for h in victims:
+        launcher.kill(h)
+    t[0] = 35.0
+    events = asc.reconcile_once()
+    assert [e["event"] for e in events].count("lost") == 2
+    assert [e["event"] for e in events].count("spawn") == 2
+    assert asc.actual("m") == 8
+
+    # scale-down needs a sustained underload streak, then drains by ONE
+    t[0] = 36.0
+    sig[0] = FleetSignals(queue_per_worker=0.0)
+    assert not [e for e in asc.reconcile_once() if e["event"] == "down"]
+    t[0] = 37.0
+    events = asc.reconcile_once()
+    assert [e["event"] for e in events] == ["down", "drain"]
+    assert asc.desired("m") == 7
+    # the NEWEST worker was picked: the most recently spawned token
+    assert launcher.drained == [launcher.n]
+    # the drained worker reaps on the next pass
+    t[0] = 38.0
+    events = asc.reconcile_once()
+    assert "drained" in [e["event"] for e in events]
+    # worker-seconds integrated over the whole run
+    assert asc.worker_seconds["m"] > 0.0
+
+
+def test_autoscaler_spawn_failure_does_not_kill_the_loop():
+    class FailingLauncher(FakeLauncher):
+        def spawn(self, slo):
+            raise RuntimeError("no capacity")
+
+    asc = FleetAutoscaler(
+        FleetSpec(models=[ModelSLO(model="m")]), FailingLauncher(),
+        signals_fn=lambda s, live: FleetSignals())
+    events = asc.reconcile_once()
+    assert [e["event"] for e in events] == ["spawn_failed"]
+    assert asc.actual("m") == 0
+    # and the next pass retries
+    assert [e["event"] for e in asc.reconcile_once()] == ["spawn_failed"]
+
+
+# ---------------------------------------------------------------------------
+# integration: thread-launcher workers on real ports
+# ---------------------------------------------------------------------------
+
+def _mk_fleet(store, spec, admission=None, front_timeout_s=30.0):
+    wreg = WorkerRegistry()
+    launcher = ThreadWorkerLauncher(store, wreg)
+    front = RoutingFront(registry=wreg, admission=admission,
+                         timeout_s=front_timeout_s)
+    asc = FleetAutoscaler(spec, launcher, front=front, worker_registry=wreg)
+    return wreg, launcher, front, asc
+
+
+def _teardown(wreg, front, asc):
+    asc.stop()
+    front.close()
+    wreg.close()
+
+
+def test_worker_admin_stats_and_graceful_drain_zero_drops(fleet_store):
+    spec = FleetSpec(models=[ModelSLO(model="mlp", ref="v1")])
+    wreg, launcher, front, asc = _mk_fleet(fleet_store, spec)
+    try:
+        asc.reconcile_once()
+        asc.wait_ready("mlp", 1, timeout_s=30)
+        w = wreg.workers()[0]
+        endpoint = f"http://{w['host']}:{w['port']}"
+        with urllib.request.urlopen(endpoint + "/admin/stats",
+                                    timeout=10) as r:
+            stats = json.loads(r.read())
+        assert stats["draining"] is False and stats["queue_depth"] == 0
+        assert stats["swap"]["mode"] in ("jit", "aot")
+
+        # concurrent fire, drain mid-stream: every accepted request gets a
+        # REAL reply; requests arriving after the drain get terminal 503s
+        body = json.dumps(sample_rows(1)[0]).encode()
+        outcomes: list = []
+        lock = threading.Lock()
+
+        def client(n):
+            for _ in range(n):
+                try:
+                    status, payload, _ = _post(endpoint + "/", body)
+                except OSError as e:  # URLError / ConnectionResetError
+                    # post-exit TCP refusal/reset: a TERMINAL transport
+                    # outcome on a connection the worker never ACCEPTED a
+                    # request from (accepted exchanges always reply before
+                    # the drained server exits) — only a TIMEOUT would be
+                    # a silently-dropped exchange
+                    reason = getattr(e, "reason", e)
+                    assert "timed out" not in str(reason).lower()
+                    status, payload = "refused", str(reason)
+                with lock:
+                    outcomes.append((status, payload))
+
+        threads = [threading.Thread(target=client, args=(10,))
+                   for _ in range(4)]
+        for th in threads:
+            th.start()
+        status, reply, _ = _post(endpoint + "/admin/drain", b"{}")
+        assert status == 200 and reply["draining"] is True
+        for th in threads:
+            th.join(timeout=60)
+        # zero dropped exchanges: every request has a terminal outcome —
+        # a 200 with a prediction, a 503 naming the drain, or (after the
+        # drained worker exited) a clean TCP refusal
+        assert len(outcomes) == 40
+        for status, payload in outcomes:
+            assert status in (200, 503, "refused"), (status, payload)
+            if status == 503:
+                assert "drain" in json.dumps(payload)
+        assert any(status in (503, "refused") for status, _ in outcomes)
+        # the worker deregistered itself (drain != crash)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and wreg.workers():
+            time.sleep(0.05)
+        assert wreg.workers() == []
+    finally:
+        _teardown(wreg, front, asc)
+
+
+def test_drain_endpoint_robustness_and_label_cap(fleet_store):
+    spec = FleetSpec(models=[ModelSLO(model="mlp", ref="v1")])
+    wreg, launcher, front, asc = _mk_fleet(fleet_store, spec)
+    try:
+        asc.reconcile_once()
+        asc.wait_ready("mlp", 1, timeout_s=30)
+        w = wreg.workers()[0]
+        endpoint = f"http://{w['host']}:{w['port']}"
+        # valid-JSON non-object drain body is a 400, never a raw 500
+        status, reply, _ = _post(endpoint + "/admin/drain", b"[1]")
+        assert status == 400 and "JSON object" in reply["error"]
+        # two racing drains fire on_drained ONCE (one deregistration, one
+        # waiter) — the second reply reports already_draining
+        drained = []
+        launcher._handles[0].token.on_drained = \
+            (lambda cb: lambda r: (drained.append(r), cb(r)))(
+                launcher._handles[0].token.on_drained)
+        s1, r1, _ = _post(endpoint + "/admin/drain", b"{}")
+        assert s1 == 200 and r1["already_draining"] is False
+        try:
+            s2, r2, _ = _post(endpoint + "/admin/drain", b"{}")
+        except OSError:
+            s2, r2 = None, None  # the first drain already stopped the
+        if s2 is not None:       # server: a clean refusal, not a hang
+            assert s2 == 200 and r2["already_draining"] is True
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not drained:
+            time.sleep(0.05)
+        time.sleep(0.3)
+        assert len(drained) == 1
+        # client-controlled /m/<model> labels cannot grow the front's stats
+        # without bound: past the cap, new labels collapse to "other"
+        for i in range(RoutingFront._MAX_TRACKED_LABELS + 20):
+            front._record_shed(f"scan-{i}", "bulk")
+        stats = front.version_stats()
+        assert len(stats) <= RoutingFront._MAX_TRACKED_LABELS + 1
+        assert stats["other"]["shed"]["bulk"] >= 20
+    finally:
+        _teardown(wreg, front, asc)
+
+
+def test_elastic_scale_up_and_drain_down_over_http(fleet_store):
+    sig = [FleetSignals(queue_per_worker=0.0)]
+    spec = FleetSpec(models=[ModelSLO(
+        model="mlp", ref="v1", min_workers=1, max_workers=3,
+        target_queue_depth=2.0, scale_down_after=1,
+        up_cooldown_s=0.0, down_cooldown_s=0.0)])
+    wreg, launcher, front, asc = _mk_fleet(fleet_store, spec)
+    asc._signals_fn = lambda slo, live: sig[0]
+    try:
+        asc.reconcile_once()
+        asc.wait_ready("mlp", 1, timeout_s=30)
+        sig[0] = FleetSignals(queue_per_worker=10.0)
+        asc.reconcile_once()
+        asc.wait_ready("mlp", 2, timeout_s=30)  # REAL second worker, routable
+        body = json.dumps(sample_rows(1)[0]).encode()
+        served_by = set()
+        for _ in range(16):
+            req = urllib.request.Request(front.address + "/m/mlp",
+                                         data=body, method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+                served_by.add(r.headers.get("X-Served-By"))
+        assert len(served_by) == 2  # round-robin spreads over both
+        # underload: drain back down — the drained worker leaves the table
+        sig[0] = FleetSignals(queue_per_worker=0.0)
+        asc.reconcile_once()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and len(wreg.workers()) > 1:
+            time.sleep(0.05)
+        assert len(wreg.workers()) == 1
+        assert asc.desired("mlp") == 1
+    finally:
+        _teardown(wreg, front, asc)
+
+
+def test_model_routing_never_answers_with_the_wrong_model(fleet_store):
+    """A request naming /m/<B> must never be served by model A's pipeline:
+    when every B-capable worker is gone, the front answers an honest 503
+    instead of forwarding to an ineligible single-model worker."""
+    spec = FleetSpec(models=[ModelSLO(model="m0", ref="v1"),
+                             ModelSLO(model="m1", ref="v1")])
+    wreg, launcher, front, asc = _mk_fleet(fleet_store, spec,
+                                           front_timeout_s=5.0)
+    try:
+        asc.reconcile_once()
+        asc.wait_ready("m0", 1, timeout_s=30)
+        asc.wait_ready("m1", 1, timeout_s=30)
+        row = sample_rows(1, seed=3)[0]
+        status, payload, _ = _post(front.address + model_path("m1"),
+                                   json.dumps(row).encode())
+        assert status == 200
+        victim = asc.live_handles("m1")[0]
+        launcher.kill(victim)
+        # a stopped thread-worker closes its LISTENER instantly but its
+        # serve loop drains one final poll (~15 ms) reachable through the
+        # front's pooled keep-alive connection — wait for refusal AND the
+        # final-poll window before asserting
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                _post(victim.endpoint + "/", json.dumps(row).encode(),
+                      timeout=2)
+            except OSError:
+                break
+            time.sleep(0.05)
+        time.sleep(0.3)
+        # m0's worker stays healthy, but it is INELIGIBLE for /m/m1 —
+        # the reply must be a 503, never m0's prediction
+        for _ in range(4):
+            status, payload, _ = _post(front.address + model_path("m1"),
+                                       json.dumps(row).encode())
+            assert status == 503, (status, payload)
+        # m0 itself keeps serving
+        status, _p, _ = _post(front.address + model_path("m0"),
+                              json.dumps(row).encode())
+        assert status == 200
+    finally:
+        _teardown(wreg, front, asc)
+
+
+def test_admission_default_policy_state_is_bounded():
+    ctrl = AdmissionController(
+        default=AdmissionPolicy(rate_rps=100000.0, burst=100000.0))
+    for i in range(AdmissionController._MAX_DEFAULT_MODELS + 50):
+        assert ctrl.admit(f"scan-{i}").admitted
+    # past the cap, random model strings share one overflow state — and
+    # mint NO fresh Prometheus label (registry children live forever)
+    assert len(ctrl.stats()) <= AdmissionController._MAX_DEFAULT_MODELS + 1
+    assert "_overflow" in ctrl.stats()
+    family = obs.get_registry().counter(
+        "synapseml_fleet_admitted_total",
+        "requests admitted by the fleet admission controller",
+        ("model", "priority"))
+    labels = {dict(k)["model"] for k, _ in family._child_items()}
+    assert not any(lbl.startswith("scan-5")
+                   and int(lbl.split("-")[1])
+                   >= AdmissionController._MAX_DEFAULT_MODELS
+                   for lbl in labels if lbl.startswith("scan-"))
+    assert "_overflow" in labels
+
+
+def test_admission_failed_replies_do_not_dilute_the_p99_window():
+    """Fast failure replies (queue-full 503s during overload) must not
+    pull the p99 down and reopen admission into a saturated fleet."""
+    t = [0.0]
+    ctrl = AdmissionController(
+        {"m": AdmissionPolicy(p99_budget_ms=100.0, hard_shed_factor=1.5,
+                              retry_after_s=10.0)},
+        clock=lambda: t[0])
+    for _ in range(50):
+        ctrl.observe("m", 500.0)
+    assert not ctrl.admit("m", "interactive").admitted
+    for _ in range(300):  # a flood of fast 503s
+        ctrl.observe("m", 2.0, ok=False)
+    assert ctrl.p99_ms("m") == 500.0  # window undiluted
+    assert not ctrl.admit("m", "interactive").admitted  # still shedding
+
+
+def test_admission_under_concurrent_mixed_priority_fire(fleet_store):
+    """ISSUE satellite: mixed interactive/bulk clients against one
+    throttled model — bulk shed first, interactive p99 within budget, 429
+    + Retry-After on the wire, controller counters reconcile with
+    client-observed outcomes."""
+    p99_budget_ms = 2000.0
+    # rate sized so the PACED interactive stream (2 clients x 10 at 100 ms
+    # ~ 18 rps) sits well under rate + reserve, while the unpaced bulk
+    # flood must blow through the bucket
+    policy = AdmissionPolicy(rate_rps=40.0, burst=16.0,
+                             interactive_reserve=0.25,
+                             p99_budget_ms=p99_budget_ms,
+                             retry_after_s=0.5)
+    spec = FleetSpec(models=[ModelSLO(model="mlp", ref="v1",
+                                      admission=policy)])
+    ctrl = AdmissionController.from_spec(spec)
+    wreg, launcher, front, asc = _mk_fleet(fleet_store, spec,
+                                           admission=ctrl)
+    try:
+        asc.reconcile_once()
+        asc.wait_ready("mlp", 1, timeout_s=30)
+        body = json.dumps(sample_rows(1)[0]).encode()
+        url = front.address + model_path("mlp")
+        results: dict[str, list] = {"interactive": [], "bulk": []}
+        lock = threading.Lock()
+
+        def fire(priority: str, n: int, pace_s: float):
+            headers = ({"X-Priority": "bulk"} if priority == "bulk" else {})
+            for _ in range(n):
+                t0 = time.perf_counter()
+                status, _payload, hdrs = _post(url, body, headers)
+                lat_ms = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    results[priority].append((status, lat_ms, hdrs))
+                if pace_s:
+                    time.sleep(pace_s)
+
+        threads = (
+            [threading.Thread(target=fire, args=("interactive", 10, 0.1))
+             for _ in range(2)]
+            + [threading.Thread(target=fire, args=("bulk", 25, 0.0))
+               for _ in range(4)])
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+
+        i_status = [s for s, _, _ in results["interactive"]]
+        b_status = [s for s, _, _ in results["bulk"]]
+        assert set(i_status) | set(b_status) <= {200, 429}
+        # bulk is shed FIRST: the unpaced flood mostly bounces, while the
+        # paced interactive stream (within rate+reserve) is untouched
+        assert b_status.count(429) > len(b_status) // 2
+        assert i_status.count(429) == 0
+        # every shed reply carried Retry-After
+        for status, _, hdrs in results["interactive"] + results["bulk"]:
+            if status == 429:
+                assert int(hdrs.get("Retry-After")) >= 1
+        # interactive p99 stays within the declared budget
+        i_lat = sorted(lat for _, lat, _ in results["interactive"])
+        assert i_lat[int(len(i_lat) * 0.99)] < p99_budget_ms
+        # counters reconcile EXACTLY with client-observed outcomes
+        stats = ctrl.stats()["mlp"]
+        assert stats["admitted"]["interactive"] == i_status.count(200)
+        assert stats["admitted"]["bulk"] == b_status.count(200)
+        assert stats["shed"]["bulk"] == b_status.count(429)
+        assert stats["shed"]["interactive"] == 0
+        # ...and with the front's per-priority version stats (satellite)
+        vstats = front.version_stats()["mlp"]
+        assert vstats["shed"]["bulk"] == b_status.count(429)
+        assert vstats["inflight"] == {"interactive": 0, "bulk": 0}
+        # /stats exposes the admission snapshot
+        with urllib.request.urlopen(front.address + "/stats",
+                                    timeout=10) as r:
+            front_stats = json.loads(r.read())
+        assert front_stats["admission"]["mlp"]["shed"]["bulk"] \
+            == b_status.count(429)
+    finally:
+        _teardown(wreg, front, asc)
+
+
+def test_split_weights_exported_as_gauges(fleet_store):
+    wreg = WorkerRegistry()
+
+    def split_lines():
+        return {ln for ln in obs.get_registry().exposition().splitlines()
+                if ln.startswith("synapseml_route_split_weight{")}
+
+    before = split_lines()
+    front = RoutingFront(registry=wreg)
+    try:
+        front.set_traffic_split({"va": 0.75, "vb": 0.25})
+        ours = split_lines() - before  # the instance label isolates us
+        weights = {}
+        for ln in ours:
+            if 'version="va"' in ln:
+                weights["va"] = float(ln.rsplit(" ", 1)[1])
+            if 'version="vb"' in ln:
+                weights["vb"] = float(ln.rsplit(" ", 1)[1])
+        assert weights == {"va": 0.75, "vb": 0.25}
+        # a cleared split stops exporting
+        front.set_traffic_split(None)
+        assert split_lines() - before == set()
+    finally:
+        front.close()
+        wreg.close()
+
+
+# ---------------------------------------------------------------------------
+# residency E2E (acceptance): 4 models, 2 workers, budget fits 3
+# ---------------------------------------------------------------------------
+
+def _expected_reply(seed: int, row: dict) -> dict:
+    """The ground-truth reply for one request row, computed by driving a
+    locally-built copy of the published pipeline through the EXACT
+    serve-loop batch preparation."""
+    from synapseml_tpu.core.dataframe import DataFrame
+    from synapseml_tpu.io.serving import _prepare_batch
+
+    batch = DataFrame([{
+        "id": np.asarray(["x"], dtype=object),
+        "method": np.asarray(["POST"], dtype=object),
+        "path": np.asarray(["/"], dtype=object),
+        "body": np.asarray([json.dumps(row).encode()], dtype=object),
+    }])
+    out = build_pipeline(seed=seed).transform(
+        _prepare_batch(batch, parse_json=True, input_col="body"))
+    return out.collect_column("reply")[0]
+
+
+def test_residency_e2e_four_models_two_workers_budget_fits_three(fleet_store):
+    # measure one artifact, then budget for 3.5 of them per worker
+    probe = ResidencyManager(fleet_store, byte_budget=1 << 30)
+    probe.acquire("m0")
+    per_model = probe.resident()["m0"]["nbytes"]
+    probe.release_all()
+    budget = int(per_model * 3.5)
+
+    wreg = WorkerRegistry()
+    servers = []
+    for pid in (1, 2):
+        res = ResidencyManager(fleet_store, byte_budget=budget)
+        srv = serve_multi_model(res, batch_interval_ms=2)
+        servers.append(srv)
+        urllib.request.urlopen(urllib.request.Request(
+            wreg.address + "/register",
+            data=json.dumps({"host": srv.host, "port": srv.port,
+                             "pid": -pid, "models": []}).encode(),
+            method="POST"), timeout=10).read()
+    front = RoutingFront(registry=wreg)
+    rows = {i: sample_rows(1, seed=100 + i)[0] for i in range(4)}
+    expected = {i: _expected_reply(10 + i, rows[i]) for i in range(4)}
+    # the four models answer DIFFERENTLY (seeds differ), so a routing or
+    # residency mix-up cannot pass the correctness check by accident
+    assert len({json.dumps(e["probs"]) for e in expected.values()}) == 4
+
+    reg = obs.get_registry()
+    evictions = reg.counter("synapseml_fleet_evictions_total",
+                            "residency LRU evictions", ("model",))
+    loads = reg.counter("synapseml_fleet_model_loads_total",
+                        "residency slot lookups", ("model", "outcome"))
+    ev0 = sum(evictions.labels(model=f"m{i}").value for i in range(4))
+    miss0 = sum(loads.labels(model=f"m{i}", outcome="miss").value
+                for i in range(4))
+    try:
+        failures = []
+        # cycle all four models with an ODD number of requests per round:
+        # the front's round-robin parity shifts every round, so BOTH
+        # workers see all 4 models over the run and each worker's 3-slot
+        # LRU must churn (an even cycle would pin each model to one
+        # worker and never evict)
+        for round_i in range(8):
+            for i in [0, 1, 2, 3, round_i % 4]:
+                status, payload, _ = _post(
+                    front.address + model_path(f"m{i}"),
+                    json.dumps(rows[i]).encode())
+                if status != 200 or payload != expected[i]:
+                    failures.append((round_i, i, status, payload))
+        assert not failures, failures[:3]  # zero failed requests, all exact
+        ev1 = sum(evictions.labels(model=f"m{i}").value for i in range(4))
+        miss1 = sum(loads.labels(model=f"m{i}", outcome="miss").value
+                    for i in range(4))
+        assert ev1 - ev0 > 0  # the budget forced LRU evictions
+        # every eviction's re-load is a residency MISS (retrace/AOT-rehit
+        # visible in the loads counter), and each worker holds <= 3
+        assert miss1 - miss0 >= (ev1 - ev0)
+        for srv in servers:
+            resident = srv.residency.resident()
+            assert len(resident) <= 3
+            assert srv.residency.resident_bytes() <= budget
+    finally:
+        front.close()
+        wreg.close()
+        for srv in servers:
+            srv.residency.release_all()
+            srv.stop()
+
+
+def test_residency_refuses_an_artifact_larger_than_the_budget(fleet_store):
+    res = ResidencyManager(fleet_store, byte_budget=16)
+    with pytest.raises(ValueError, match="exceeds the whole"):
+        res.acquire("m0")
+    with pytest.raises(KeyError, match="neither a version nor an alias"):
+        ResidencyManager(fleet_store, byte_budget=1 << 30).acquire("ghost")
+
+
+def test_residency_failed_load_never_evicts_healthy_neighbors(fleet_store):
+    """A broken model (unresolvable ref here; failed warmup behaves the
+    same — eviction runs only AFTER a successful load) must fail its own
+    request without tearing down the working set."""
+    res = ResidencyManager(fleet_store, byte_budget=1 << 30,
+                           refs={"m3": "ghost-ref"})
+    for m in ("m0", "m1", "m2"):
+        res.acquire(m)
+    before = res.resident()
+    assert set(before) == {"m0", "m1", "m2"}
+    for _ in range(3):  # every retry fails, neighbors stay intact
+        with pytest.raises(KeyError):
+            res.acquire("m3")
+    assert res.resident() == before
+    res.release_all()
+
+
+def test_trusted_version_labels_bypass_the_client_label_cap(fleet_store):
+    """A path scanner filling the label cap must not blind the canary
+    rollback controller: worker VERSION labels (trusted, server-side)
+    always get their own version_stats entry."""
+    wreg = WorkerRegistry()
+    front = RoutingFront(registry=wreg)
+    try:
+        for i in range(RoutingFront._MAX_TRACKED_LABELS + 5):
+            front._record_shed(f"scan-{i}", "bulk")
+        front._record_version("canary-v2", ok=False, latency_ms=9.0)
+        stats = front.version_stats()
+        assert "canary-v2" in stats and stats["canary-v2"]["err"] == 1
+    finally:
+        front.close()
+        wreg.close()
+
+
+def test_admission_observe_reaches_the_overflow_state():
+    ctrl = AdmissionController(
+        default=AdmissionPolicy(rate_rps=100000.0, burst=100000.0,
+                                p99_budget_ms=100.0))
+    for i in range(AdmissionController._MAX_DEFAULT_MODELS + 5):
+        ctrl.admit(f"scan-{i}")
+    over_cap = f"scan-{AdmissionController._MAX_DEFAULT_MODELS + 1}"
+    for _ in range(50):
+        ctrl.observe(over_cap, 500.0)  # must land in _overflow
+    assert ctrl.stats()["_overflow"]["p99_ms"] == 500.0
+    # ...so p99 shedding engages for over-cap models too
+    assert not ctrl.admit(over_cap, "bulk").admitted
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill a worker mid-reconcile under a FaultPlan
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_worker_kill_mid_reconcile_replaced_no_silent_drops(fleet_store):
+    from synapseml_tpu.core.faults import FaultSpec, inject_faults
+
+    spec = FleetSpec(models=[ModelSLO(model="mlp", ref="v1", min_workers=2,
+                                      max_workers=2)],
+                     reconcile_interval_s=0.25)
+    # short front timeout: a blackholed/killed worker costs one bounded
+    # stall, then the breaker + reroute take over
+    wreg, launcher, front, asc = _mk_fleet(fleet_store, spec,
+                                           front_timeout_s=5.0)
+    body = json.dumps(sample_rows(1)[0]).encode()
+    outcomes: list = []
+    stop_fire = threading.Event()
+    lock = threading.Lock()
+
+    def fire():
+        while not stop_fire.is_set():
+            try:
+                status, _payload, _ = _post(front.address + "/m/mlp", body,
+                                            timeout=20)
+            except OSError as e:  # a TRANSPORT failure would be a drop
+                status = f"transport:{e}"
+            with lock:
+                outcomes.append(status)
+            time.sleep(0.01)
+
+    try:
+        asc.reconcile_once()
+        asc.wait_ready("mlp", 2, timeout_s=30)
+        asc.start()  # the live reconcile loop the kill lands inside
+        threads = [threading.Thread(target=fire) for _ in range(3)]
+        with inject_faults([FaultSpec("connection_error", times=3,
+                                      planes=("distributed_serving",))]):
+            for th in threads:
+                th.start()
+            time.sleep(0.5)
+            victim = asc.live_handles("mlp")[0]
+            launcher.kill(victim)  # SIGKILL analog: socket slams shut
+            t_kill = time.monotonic()
+            # replaced within one reconcile interval: a NEW live worker
+            # appears (the loop reaps the corpse and respawns in one pass)
+            deadline = t_kill + 10.0
+            while time.monotonic() < deadline:
+                handles = asc.live_handles("mlp")
+                if len(handles) == 2 and victim not in handles:
+                    break
+                time.sleep(0.05)
+            replaced_after = time.monotonic() - t_kill
+            assert len(asc.live_handles("mlp")) == 2
+            time.sleep(0.5)  # serve through the replacement under fire
+            stop_fire.set()
+            for th in threads:
+                th.join(timeout=30)
+        # every request got a TERMINAL HTTP outcome — the front's breakers
+        # and reroute contain the blast radius; nothing hangs, nothing is
+        # silently dropped
+        assert outcomes
+        assert all(isinstance(s, int) for s in outcomes), \
+            [s for s in outcomes if not isinstance(s, int)][:3]
+        assert outcomes.count(200) > len(outcomes) * 0.8
+        events = [e["event"] for e in asc.events if e["model"] == "mlp"]
+        assert "lost" in events and events.count("spawn") >= 3
+        # "within one reconcile interval": generous wall bound — the pass
+        # after the kill replaces it (spawn itself takes a moment)
+        assert replaced_after < 8.0
+    finally:
+        stop_fire.set()
+        _teardown(wreg, front, asc)
+
+
+# ---------------------------------------------------------------------------
+# compat + metric hygiene
+# ---------------------------------------------------------------------------
+
+def test_fleet_reconcile_emits_span_and_gauges():
+    asc = FleetAutoscaler(
+        FleetSpec(models=[ModelSLO(model="m", min_workers=1)]),
+        FakeLauncher(), signals_fn=lambda s, live: FleetSignals())
+    asc.reconcile_once()
+    spans = [s for s in obs.get_tracer().finished_spans()
+             if s.name == "fleet.reconcile"]
+    assert spans
+    snap = obs.get_registry().snapshot()
+    assert snap.get('synapseml_fleet_desired_workers{model="m"}') == 1.0
+    assert snap.get('synapseml_fleet_actual_workers{model="m"}') == 1.0
+    assert snap.get(
+        'synapseml_fleet_scale_events_total{direction="spawn",model="m"}',
+        0) >= 1.0

@@ -499,9 +499,22 @@ def test_distributed_trace_stitches_across_processes():
                 assert json.loads(r.read())["ok"] is True
 
         # ---- stitched trace ----
-        spans = collect_distributed_trace(handle.address)
-        ours = [s for s in spans if s["trace_id"] == cs.trace_id]
-        names = {s["name"] for s in ours}
+        # the front's route.request span finishes a hair AFTER the reply
+        # bytes reach the client (post-reply accounting runs inside the
+        # span), so /trace polled immediately can race it on a loaded
+        # machine — poll briefly for the settled span set
+        import time as _time
+
+        deadline = _time.monotonic() + 5.0
+        while True:
+            spans = collect_distributed_trace(handle.address)
+            ours = [s for s in spans if s["trace_id"] == cs.trace_id]
+            names = {s["name"] for s in ours}
+            if {"client.request", "route.request",
+                    "serving.request"} <= names \
+                    or _time.monotonic() >= deadline:
+                break
+            _time.sleep(0.05)
         assert {"client.request", "route.request", "serving.request"} <= names
         assert len(ours) >= 3
         assert len({s["pid"] for s in ours}) >= 2  # multi-process
