@@ -200,6 +200,7 @@ _MODULE_NAMESPACE_MAP = {
 # importable from synapseml_tpu.compat.<ns>" does, and
 # tests/test_codegen.py::test_registry_compat_coverage enforces it)
 _PASSTHROUGH_NAMESPACES = {
+    "continual": "synapseml_tpu.continual",
     "fleet": "synapseml_tpu.fleet",
     "registry": "synapseml_tpu.registry",
     "scoring": "synapseml_tpu.scoring",

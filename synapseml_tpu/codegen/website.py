@@ -173,7 +173,7 @@ def emit_site(docs_dir: str | None = None, out_dir: str | None = None) -> list[s
     os.makedirs(out_dir, exist_ok=True)
 
     sections = {"": ["GETTING_STARTED.md", "ARCHITECTURE.md", "AUTOML.md",
-                     "BENCHMARKS.md", "DATA.md", "FLEET.md",
+                     "BENCHMARKS.md", "CONTINUAL.md", "DATA.md", "FLEET.md",
                      "OBSERVABILITY.md", "REGISTRY.md", "RESILIENCE.md",
                      "SCORING.md", "SERVING.md", "SHARDING.md"],
                 "api": sorted(f for f in os.listdir(os.path.join(docs_dir, "api"))

@@ -1,0 +1,27 @@
+"""Generated passthrough namespace — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers).
+Re-exports the public surface of ``synapseml_tpu.continual`` so the compat layer covers
+non-stage subsystems too (compat coverage is drift-tested).
+"""
+
+
+from synapseml_tpu.continual import (  # noqa: F401
+    ContinualLoop,
+    ContinualSpec,
+    LoopAborted,
+    RequestLogger,
+    TrainAttempt,
+    TrainSupervisor,
+    logged_request_source,
+)
+
+__all__ = [
+    'ContinualLoop',
+    'ContinualSpec',
+    'LoopAborted',
+    'RequestLogger',
+    'TrainAttempt',
+    'TrainSupervisor',
+    'logged_request_source',
+]

@@ -1,0 +1,53 @@
+"""Closed-loop continual training: the serve→log→retrain→canary flywheel.
+
+The five planes this package wires together already exist —
+
+* serving (``io/serving.py`` workers, ``io/distributed_serving.py`` front
+  with canary splits + shadow traffic),
+* the streaming data plane (``data/source.py`` sharded sources,
+  ``models/trainer.fit_source`` with checkpointable iterators),
+* the registry/deploy plane (``registry/`` publish with AOT + autotune,
+  ``Deployment`` canary with auto-rollback),
+* the resilience plane (``core/resilience.py`` + seeded ``core/faults.py``
+  injection),
+* the observability plane (``core/observability.py`` metric series).
+
+What was missing is the LOOP: production traffic was measured then
+discarded, and retraining was a manual offline act that could silently
+ship a corrupted model. This package closes it with fault containment as
+the headline contract — a fault injected at ANY seam (bad data, killed
+trainer, torn checkpoint, regressing canary) leaves ``prod`` untouched
+and the loop able to resume:
+
+* :class:`RequestLogger` (``logger.py``) — a sampled, SLO-safe,
+  PII-scrubbed request/response logger hooked into ``RoutingFront`` /
+  ``ServingServer`` that appends jsonl shards in ``ShardedSource`` layout
+  with atomic part/DONE commits, turning production traffic into a
+  first-class training source;
+* :class:`TrainSupervisor` (``supervisor.py``) — crash-safe long fits:
+  hang watchdog keyed off step progress, bounded restarts resuming from
+  the latest *verified* checkpoint, and a non-finite-loss rewind that
+  skips past the poisoned batch window instead of letting NaN poison the
+  params;
+* :class:`ContinualLoop` (``loop.py``) — one declarative
+  :class:`ContinualSpec` driving watch → warm-started ``fit_source`` →
+  eval gate vs prod on a held-out slice → ``registry.publish`` → canary
+  with auto-rollback, every seam consulting the active ``FaultPlan`` and
+  every outcome landing on the ``synapseml_continual_*`` series.
+
+See ``docs/CONTINUAL.md`` for the seam-by-seam degradation contract.
+"""
+
+from .logger import RequestLogger, logged_request_source
+from .loop import ContinualLoop, ContinualSpec, LoopAborted
+from .supervisor import TrainAttempt, TrainSupervisor
+
+__all__ = [
+    "ContinualLoop",
+    "ContinualSpec",
+    "LoopAborted",
+    "RequestLogger",
+    "TrainAttempt",
+    "TrainSupervisor",
+    "logged_request_source",
+]

@@ -1,0 +1,232 @@
+"""Crash-safe long training runs: restarts, hang watchdog, NaN rewind.
+
+:class:`TrainSupervisor` wraps a ``fit_source``-shaped training attempt so
+the three ways a long fit dies become bounded, observable degradations
+instead of a corrupted (or lost) run:
+
+* **crash** — the attempt raises (a killed subprocess, an injected
+  ``FaultSpec(..., planes=("training",))``, an OOM): the supervisor
+  restarts it under a bounded :class:`~synapseml_tpu.core.resilience.
+  RetryPolicy` (each restart counts into ``resilience_measures
+  ("training")`` and ``synapseml_continual_supervisor_restarts_total``);
+  the attempt resumes from the latest *verified* checkpoint
+  (``parallel.checkpoint.latest_verified_step`` — a torn newest payload
+  demotes one step instead of resuming garbage);
+* **hang** — subprocess mode (:meth:`TrainSupervisor.run_subprocess`)
+  watches step progress through the checkpoint directory; no new
+  completed step within ``hang_timeout_s`` ⇒ SIGKILL + restart (a hung
+  trainer is indistinguishable from a dead one to the loop above);
+* **NaN** — the trainer (``TrainerConfig.nonfinite_action="raise"``)
+  aborts with :class:`~synapseml_tpu.models.trainer.NonFiniteLossError`;
+  the supervisor REWINDS: the next attempt resumes from the latest
+  verified checkpoint and ``skip_fn`` skips the batch window from that
+  checkpoint through the poisoned step — the stream stays aligned, the
+  params never train on the offending batches, and
+  ``synapseml_continual_rewinds_total`` moves.
+
+In-process mode cannot preempt a hung Python thread — hang detection is
+subprocess-mode only (documented contract; the loop's cadence bounds an
+in-process wedge at the iteration level).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from typing import Callable
+
+from ..core import observability as obs
+from ..core.faults import active_fault_plan
+from ..core.resilience import RetryPolicy, resilience_measures
+from ..models.trainer import NonFiniteLossError
+from ..parallel.checkpoint import latest_step, latest_verified_step
+
+__all__ = ["TrainSupervisor", "TrainAttempt"]
+
+_SUP_METRICS = obs.HandleCache(lambda reg: {
+    "restarts": reg.counter(
+        "synapseml_continual_supervisor_restarts_total",
+        "supervised training attempts restarted after a crash/hang",
+        ("mode",)),
+    "rewinds": reg.counter(
+        "synapseml_continual_rewinds_total",
+        "NaN rewinds: resume from the last verified checkpoint, skip the "
+        "poisoned batch window", ()),
+})
+
+
+class TrainAttempt:
+    """One supervised attempt's context, handed to the attempt callable.
+
+    * ``index`` — 0 for the first attempt, +1 per restart/rewind;
+    * ``resume`` — True when a previous attempt made checkpoint progress
+      (the attempt should ``fit_source(resume_from=checkpoint_dir)``);
+    * ``skip_fn`` — the accumulated NaN-rewind skip predicate (None when
+      no rewind happened); pass it straight to ``fit_source(skip_fn=)``;
+    * ``heartbeat(step)`` — call once per optimizer step: feeds the fault
+      plane's ``training`` hook (``step:<n>`` targets, so a seeded plan
+      can kill the trainer at an exact step) and records progress.
+    """
+
+    def __init__(self, supervisor: "TrainSupervisor", index: int,
+                 skip_windows: list):
+        self.supervisor = supervisor
+        self.index = index
+        self.skip_windows = list(skip_windows)
+        self.resume = index > 0 or supervisor.checkpoint_progress() is not None
+        self.last_step: int | None = None
+
+    @property
+    def skip_fn(self) -> Callable[[int], bool] | None:
+        if not self.skip_windows:
+            return None
+        windows = tuple(self.skip_windows)
+
+        def skip(batch_index: int) -> bool:
+            return any(lo <= batch_index < hi for lo, hi in windows)
+
+        return skip
+
+    def heartbeat(self, step: int) -> None:
+        self.last_step = int(step)
+        plan = active_fault_plan()
+        if plan is not None:
+            plan.on_training(f"step:{step}")
+
+
+class TrainSupervisor:
+    """Supervise training attempts against one checkpoint directory.
+
+    ``max_restarts`` bounds crash/hang restarts; ``max_rewinds`` bounds
+    NaN rewinds (each rewind widens the skip set — an input stream that is
+    ALL poison must eventually surface, not spin). ``retry_policy``
+    optionally rate-limits restarts with a shared
+    :class:`~synapseml_tpu.core.resilience.RetryBudget` and supplies the
+    jittered backoff between attempts."""
+
+    def __init__(self, checkpoint_dir: str, max_restarts: int = 3,
+                 max_rewinds: int = 2, hang_timeout_s: float = 60.0,
+                 poll_s: float = 0.25,
+                 retry_policy: RetryPolicy | None = None):
+        self.checkpoint_dir = str(checkpoint_dir)
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        self.max_restarts = int(max_restarts)
+        self.max_rewinds = int(max_rewinds)
+        self.hang_timeout_s = float(hang_timeout_s)
+        self.poll_s = float(poll_s)
+        self.retry_policy = retry_policy or RetryPolicy(
+            backoffs_ms=(50, 200, 500))
+        self.restarts = 0
+        self.rewinds = 0
+        self.skip_windows: list[tuple[int, int]] = []
+        self.current_pid: int | None = None  # subprocess mode
+
+    def checkpoint_progress(self) -> int | None:
+        """Newest VERIFIED checkpoint step (the resume point)."""
+        return latest_verified_step(self.checkpoint_dir)
+
+    def _backoff(self) -> None:
+        time.sleep(self.retry_policy.backoff_ms(
+            max(self.restarts - 1, 0)) / 1000.0)
+
+    def _on_restart(self, mode: str) -> bool:
+        """Account one restart; False when the budget is exhausted."""
+        if self.restarts >= self.max_restarts \
+                or not self.retry_policy.acquire_retry():
+            return False
+        self.restarts += 1
+        resilience_measures("training").count("retry")
+        _SUP_METRICS.get()["restarts"].inc(mode=mode)
+        return True
+
+    def _on_rewind(self, err: NonFiniteLossError) -> bool:
+        """Account one NaN rewind and extend the skip set: the next attempt
+        resumes from the latest verified checkpoint and skips every batch
+        from there THROUGH the poisoned step."""
+        if self.rewinds >= self.max_rewinds:
+            return False
+        self.rewinds += 1
+        _SUP_METRICS.get()["rewinds"].inc()
+        lo = self.checkpoint_progress() or 0
+        self.skip_windows.append((lo, err.step))
+        return True
+
+    # -- in-process mode ----------------------------------------------------
+    def run(self, attempt_fn: Callable[[TrainAttempt], object]):
+        """Drive ``attempt_fn(attempt)`` to completion. The attempt MUST
+        checkpoint into ``checkpoint_dir`` and honor ``attempt.resume`` /
+        ``attempt.skip_fn`` (i.e. call ``fit_source(resume_from=
+        checkpoint_dir, skip_fn=attempt.skip_fn)``) — that is what makes a
+        restart bit-identical to an uninterrupted run. Returns the
+        attempt's result; raises the final error when budgets run out."""
+        index = 0
+        while True:
+            attempt = TrainAttempt(self, index, self.skip_windows)
+            try:
+                plan = active_fault_plan()
+                if plan is not None:
+                    plan.on_training(f"attempt:{index}")
+                return attempt_fn(attempt)
+            except NonFiniteLossError as e:
+                if not self._on_rewind(e):
+                    raise
+            except Exception:
+                if not self._on_restart("inprocess"):
+                    raise
+                self._backoff()
+            index += 1
+
+    # -- subprocess mode ----------------------------------------------------
+    def run_subprocess(self, argv: list[str], env: dict | None = None,
+                       timeout_s: float = 600.0) -> int:
+        """Run ``argv`` as the training process; restart it (bounded) when
+        it dies, SIGKILL + restart when it hangs (no new completed
+        checkpoint step within ``hang_timeout_s``). The child is expected
+        to resume from ``checkpoint_dir`` on its own (``fit_source(
+        resume_from=...)``) and exit 0 when the run is complete. Returns
+        the number of attempts it took."""
+        deadline = time.monotonic() + timeout_s
+        attempts = 0
+        while True:
+            attempts += 1
+            proc = subprocess.Popen(argv, env=env)
+            self.current_pid = proc.pid
+            last_progress = time.monotonic()
+            # progress polling uses the DONE-marker scan (latest_step),
+            # not the verified scan — re-hashing a multi-GB payload 4x/s
+            # for the whole run would be the watchdog DoS'ing the trainer;
+            # verification happens once, at restore time
+            last_step = latest_step(self.checkpoint_dir)
+            hung = False
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    break
+                now = time.monotonic()
+                step = latest_step(self.checkpoint_dir)
+                if step != last_step:
+                    last_step, last_progress = step, now
+                if now - last_progress > self.hang_timeout_s:
+                    hung = True
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait(timeout=30)
+                    rc = proc.returncode
+                    break
+                if now > deadline:
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait(timeout=30)
+                    raise TimeoutError(
+                        f"supervised subprocess run exceeded {timeout_s}s")
+                time.sleep(self.poll_s)
+            self.current_pid = None
+            if rc == 0 and not hung:
+                return attempts
+            if not self._on_restart("hang" if hung else "subprocess"):
+                raise RuntimeError(
+                    f"supervised trainer failed after {attempts} attempt(s) "
+                    f"(last exit code {rc}"
+                    f"{', hang-killed' if hung else ''}) — restart budget "
+                    "exhausted")
+            self._backoff()

@@ -14,7 +14,17 @@ transport hook points consult it before touching the network:
 * ``data/source.py`` — every guarded shard read calls
   ``plan.on_read(target)`` (slow / failing shard reads on the ``"data"``
   plane; the source retries them under its ``RetryPolicy``). Target the
-  plane explicitly: ``FaultSpec(..., planes=("data",))``.
+  plane explicitly: ``FaultSpec(..., planes=("data",))``;
+* ``continual/supervisor.py`` — the supervised train loop calls
+  ``plan.on_training(target)`` at every attempt start and heartbeat
+  (``target`` is ``attempt:<n>`` / ``step:<n>``), so a trainer crash at
+  any step is one seeded ``FaultSpec(..., planes=("training",))`` away;
+* ``continual/loop.py`` + ``continual/logger.py`` — every flywheel seam
+  (watch / snapshot / train / eval / publish / canary / promote, and the
+  request logger's shard commits) calls ``plan.on_continual(target)``.
+  The loop contains the injected failure as one aborted iteration with
+  ``prod`` untouched — the degradation contract ``tests/test_continual.py``
+  drives seam by seam.
 
 Faults are matched in order against the target (URL or ``host:port``
 substring), gated by a per-spec remaining ``times`` count and a probability
@@ -147,6 +157,23 @@ class FaultPlan:
         ``crash``/``latency`` model slow or failing storage; reads are
         retried by the source's ``RetryPolicy``."""
         f = self._select("data", target)
+        if f is not None:
+            self._raise_fault(f, target)
+
+    def on_training(self, target: str) -> None:
+        """Called by the training supervisor (``continual/supervisor.py``)
+        at attempt starts and step heartbeats — ``crash`` models a dying
+        trainer process; the supervisor restarts it under its
+        ``RetryPolicy`` from the latest verified checkpoint."""
+        f = self._select("training", target)
+        if f is not None:
+            self._raise_fault(f, target)
+
+    def on_continual(self, target: str) -> None:
+        """Called by the continual-training flywheel at every seam
+        (``continual/loop.py`` / ``logger.py``) — an injected fault must
+        abort ONE loop iteration without touching ``prod``."""
+        f = self._select("continual", target)
         if f is not None:
             self._raise_fault(f, target)
 

@@ -28,16 +28,111 @@ _SECRET_PAT = re.compile(
 _JSON_SECRET_PAT = re.compile(
     rf"(?i)(\"[^\"]*{_SECRET_WORDS}[^\"]*\"\s*:\s*)(\"(?:[^\"\\]|\\.)*\"|[^,}}\]\s]+)")
 _BEARER_PAT = re.compile(r"(?i)bearer\s+[a-z0-9\-_\.=]+")
+# Free-text PII the request logs the continual plane trains on actually
+# carry — the key=value/JSON patterns above only catch NAMED secrets:
+#   * email addresses,
+#   * standalone JWT-shaped tokens (three dot-joined base64url segments,
+#     the `eyJ` header prefix — bearer-less Authorization payloads),
+#   * long digit runs (12+ digits, separators allowed: card/account/phone
+#     numbers). Epoch-millisecond timestamps are 13 digits and DO scrub —
+#     deliberate: over-scrubbing is visible in the counter, a leaked card
+#     number is not.
+_EMAIL_PAT = re.compile(r"[\w.+%-]+@[\w-]+\.[\w.-]{2,}")
+_JWT_PAT = re.compile(r"eyJ[A-Za-z0-9_-]{6,}\.[A-Za-z0-9_-]{4,}"
+                      r"\.[A-Za-z0-9_-]*")
+_DIGITS_PAT = re.compile(r"\d(?:[ \-]?\d){11,}")
+
+_SCRUB_KINDS = (("keyvalue", None), ("json", None), ("bearer", None),
+                ("email", None), ("jwt", None), ("digits", None))
 
 
-def scrub(text: str) -> str:
-    """Strip secrets out of log payloads (reference ``SASScrubber``):
-    query-string pairs (``sig=...``), JSON pairs (``"apiKey": "..."``,
-    ``"Ocp-Apim-Subscription-Key": ...``) and bearer tokens."""
-    text = _SECRET_PAT.sub(
-        lambda m: m.group(0).split("=", 1)[0] + "=####", text)
-    text = _JSON_SECRET_PAT.sub(lambda m: m.group(1) + '"####"', text)
-    return _BEARER_PAT.sub("Bearer ####", text)
+def _count_scrubs(counts: dict[str, int]) -> None:
+    """Publish per-kind substitution counts on the observability plane
+    (``synapseml_scrub_fields_total{kind}``) — silent over/under-scrubbing
+    of the training logs becomes a visible series instead of a guess.
+    Lazy import: core.logging must stay importable before observability."""
+    if not counts:
+        return
+    try:
+        from . import observability as obs
+
+        counter = obs.get_registry().counter(
+            "synapseml_scrub_fields_total",
+            "fields masked by the log scrubber, by pattern kind", ("kind",))
+        for kind, n in counts.items():
+            counter.inc(n, kind=kind)
+    except Exception:  # noqa: BLE001 — scrubbing must never fail a log call
+        logger.debug("scrub counter emission failed", exc_info=True)
+
+
+def scrub(text: str, counts: dict[str, int] | None = None) -> str:
+    """Strip secrets AND free-text PII out of log payloads (reference
+    ``SASScrubber``): query-string pairs (``sig=...``), JSON pairs
+    (``"apiKey": "..."``), bearer tokens, emails, JWT-shaped tokens and
+    long digit runs. Every substitution counts into
+    ``synapseml_scrub_fields_total{kind}``; pass ``counts`` (mutated in
+    place) to ALSO receive the per-kind tally — the request logger stamps
+    it into each shard's DONE marker."""
+    tally: dict[str, int] = {}
+
+    def _sub(kind: str, pat: re.Pattern, repl, s: str) -> str:
+        out, n = pat.subn(repl, s)
+        if n:
+            tally[kind] = tally.get(kind, 0) + n
+        return out
+
+    text = _sub("keyvalue", _SECRET_PAT,
+                lambda m: m.group(0).split("=", 1)[0] + "=####", text)
+    text = _sub("json", _JSON_SECRET_PAT,
+                lambda m: m.group(1) + '"####"', text)
+    text = _sub("bearer", _BEARER_PAT, "Bearer ####", text)
+    text = _sub("jwt", _JWT_PAT, "####", text)
+    text = _sub("email", _EMAIL_PAT, "####@####", text)
+    text = _sub("digits", _DIGITS_PAT, "####", text)
+    _count_scrubs(tally)
+    if counts is not None:
+        for kind, n in tally.items():
+            counts[kind] = counts.get(kind, 0) + n
+    return text
+
+
+_SECRET_KEY_PAT = re.compile(rf"(?i){_SECRET_WORDS}")
+
+
+def scrub_json(value, counts: dict[str, int] | None = None):
+    """Scrub a decoded JSON value IN STRUCTURE (vs :func:`scrub`'s
+    serialized-text patterns): secret-worded dict keys mask their scalar
+    value, string values go through :func:`scrub`, and card-shaped
+    numerics (12+ digits stored as a JSON number — invisible to the text
+    patterns, and masking them textually would break the JSON) become
+    ``"####"``. Always returns a JSON-serializable structure — what
+    :func:`log_stage_event` and the continual plane's request logger
+    write. ``counts`` (mutated in place) receives the per-kind tally."""
+    if isinstance(value, str):
+        return scrub(value, counts)
+    if isinstance(value, bool) or value is None:
+        return value
+    if isinstance(value, int) and len(str(abs(value))) >= 12:
+        if counts is not None:
+            counts["digits"] = counts.get("digits", 0) + 1
+        _count_scrubs({"digits": 1})
+        return "####"
+    if isinstance(value, dict):
+        out = {}
+        for k, v in value.items():
+            if isinstance(k, str) and _SECRET_KEY_PAT.search(k) \
+                    and isinstance(v, (str, int, float)) \
+                    and not isinstance(v, bool):
+                if counts is not None:
+                    counts["json"] = counts.get("json", 0) + 1
+                _count_scrubs({"json": 1})
+                out[k] = "####"
+            else:
+                out[k] = scrub_json(v, counts)
+        return out
+    if isinstance(value, (list, tuple)):
+        return [scrub_json(v, counts) for v in value]
+    return value
 
 
 _TELEMETRY_SINKS: list = []
@@ -56,17 +151,19 @@ def remove_telemetry_sink(fn) -> None:
 
 
 def log_stage_event(payload: dict) -> None:
-    text = scrub(json.dumps(payload, default=str))
-    logger.info(text)
-    if _TELEMETRY_SINKS:
-        # sinks get the SCRUBBED payload — they forward off-box (certified
-        # events), so the secret-stripping must cover the fan-out path too
-        sanitized = json.loads(text)
-        for sink in _TELEMETRY_SINKS:
-            try:
-                sink(sanitized)
-            except Exception:  # noqa: BLE001 — telemetry must never break a stage
-                logger.debug("telemetry sink failed", exc_info=True)
+    # normalize (objects stringified) THEN scrub structurally: the masked
+    # payload is valid JSON by construction — a textual digit-run mask on
+    # a bare numeric token would have broken the sink round trip
+    normalized = json.loads(json.dumps(payload, default=str))
+    sanitized = scrub_json(normalized)
+    logger.info(json.dumps(sanitized))
+    # sinks get the SCRUBBED payload — they forward off-box (certified
+    # events), so the secret-stripping must cover the fan-out path too
+    for sink in _TELEMETRY_SINKS:
+        try:
+            sink(sanitized)
+        except Exception:  # noqa: BLE001 — telemetry must never break a stage
+            logger.debug("telemetry sink failed", exc_info=True)
 
 
 class StageTelemetry:

@@ -274,10 +274,12 @@ class ShardedSource:
 
     # -- constructors -------------------------------------------------------
     @classmethod
-    def jsonl(cls, path: str, shard_bytes: int = DEFAULT_SHARD_BYTES,
+    def jsonl(cls, path, shard_bytes: int = DEFAULT_SHARD_BYTES,
               retry_policy: RetryPolicy | None = None) -> "ShardedSource":
-        """JSON-lines file(s)/glob/dir -> byte-range shards. Heterogeneous
-        records union over all keys seen in the shard (like
+        """JSON-lines file(s)/glob/dir — or an explicit LIST of file paths
+        (what ``continual.logged_request_source`` passes for its
+        DONE-committed parts) -> byte-range shards. Heterogeneous records
+        union over all keys seen in the shard (like
         ``io.files.read_jsonl``)."""
         paths = _tabular_paths(path, "JSONL")
         shards, idx = [], 0
@@ -417,14 +419,22 @@ class ShardedSource:
         return cls(shards, read, retry_policy, name="image")
 
 
-def _tabular_paths(path: str, what: str) -> list[str]:
+def _tabular_paths(path, what: str) -> list[str]:
     """``io.files.resolve_input_paths`` (the ONE resolver both planes list
     through) plus a streaming-only refinement: zero-byte files carry no
     shards, so they drop here — the eager readers instead keep them as
-    empty partitions (the Spark file<->partition mapping)."""
+    empty partitions (the Spark file<->partition mapping). An explicit
+    LIST of file paths bypasses globbing — the continual plane's request
+    logger selects exactly its DONE-committed parts this way."""
     from ..io.files import resolve_input_paths
 
-    paths = resolve_input_paths(path, what)
+    if isinstance(path, (list, tuple)):
+        missing = [p for p in path if not os.path.isfile(p)]
+        if missing:
+            raise FileNotFoundError(f"no such {what} file(s): {missing}")
+        paths = [str(p) for p in path]
+    else:
+        paths = resolve_input_paths(path, what)
     return [p for p in paths if os.path.getsize(p) > 0]
 
 

@@ -555,6 +555,10 @@ class RoutingFront:
         # workers pack stably instead of thrashing their LRU)
         self._admission = admission
         self.route_by_model = bool(route_by_model)
+        # continual plane: a RequestLogger attached via set_request_logger
+        # records every forwarded exchange AFTER the reply is written —
+        # sampled + bounded (shed-before-delay), the flywheel's feedstock
+        self._request_logger = None
         front = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -728,6 +732,14 @@ class RoutingFront:
                     self._reply(status, payload,
                                 {"X-Served-By": str(w.get("pid", "")),
                                  "X-Served-Version": version})
+                    logger = front._request_logger
+                    if logger is not None:
+                        # after _reply: the client already has its bytes —
+                        # a sampled log insert cannot delay the exchange
+                        logger.log(method=method, path=self.path,
+                                   body=body or b"", reply=payload,
+                                   status=status, latency_ms=elapsed_ms,
+                                   version=version)
                     front._maybe_shadow(method, self.path, body, hdrs,
                                         version, elapsed_ms)
                     return
@@ -932,6 +944,14 @@ class RoutingFront:
         return chosen
 
     # -- fleet plane: admission control + per-priority accounting ----------
+    def set_request_logger(self, logger) -> None:
+        """Attach/detach (None) a ``continual.RequestLogger``: every
+        forwarded request/response pair is offered to it post-reply."""
+        self._request_logger = logger
+
+    def request_logger(self):
+        return self._request_logger
+
     def set_admission(self, controller) -> None:
         """Install/replace/clear (``None``) the admission controller
         (:class:`~synapseml_tpu.fleet.admission.AdmissionController`)

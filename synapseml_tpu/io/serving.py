@@ -239,6 +239,10 @@ class ServingServer:
         self.started_at = time.monotonic()
         # set by serve_multi_model: the residency manager /admin/stats reads
         self.residency = None
+        # continual plane (continual/logger.py): a RequestLogger attached
+        # here records every batched exchange at reply time — sampled,
+        # bounded, shed-before-delay, so serving latency never pays for it
+        self.request_logger = None
         # bounded: a stalled pipeline sheds load with 503s instead of parking
         # unbounded connections (backpressure the round-1 loop lacked)
         self._queue: "queue.Queue[_Exchange]" = queue.Queue(maxsize=max_queue)
@@ -853,10 +857,21 @@ class ServingServer:
             found = [(self._pending.get(str(rid)), reply)
                      for rid, reply in zip(ids, replies)]
         n = 0
+        logger = self.request_logger
+        now = time.perf_counter()
+        holder = self.pipeline_holder
+        version = holder.version if holder is not None else None
         for ex, reply in found:
             if ex is not None:
                 ex.respond(reply, status=status)
                 n += 1
+                if logger is not None:
+                    # after respond(): the handler thread is already awake,
+                    # the log call cannot add to its latency
+                    logger.log(method=ex.method, path=ex.path, body=ex.body,
+                               reply=reply, status=status,
+                               latency_ms=(now - ex.enqueued_at) * 1e3,
+                               version=version)
         return n
 
 

@@ -29,6 +29,7 @@ import optax
 from ..parallel.mesh import MeshContext, logical_axis_rules
 
 __all__ = ["TrainerConfig", "Trainer", "cross_entropy_loss", "TrainState",
+           "NonFiniteLossError",
            "fit_source", "fit_arrays",
            # horizontally fused training arrays (HFTA): N hyperparameter
            # trials inside ONE jitted step — implementation lives in
@@ -84,6 +85,31 @@ class TrainerConfig:
     # (arXiv:2004.13336), cutting per-replica opt-state memory to ~1/dp.
     partition_rules: Any | None = None
     zero_shard: bool = False
+    # non-finite loss guard: every loss value materialized host-side by the
+    # fit loops is checked; non-finite steps count into
+    # synapseml_train_nonfinite_total and the last finite step lands on the
+    # synapseml_train_last_finite_step gauge (the supervisor's rewind
+    # trigger is a metric read, not a log grep). "count" only observes;
+    # "raise" aborts the fit with NonFiniteLossError naming the poisoned
+    # step — what continual.TrainSupervisor rewinds on.
+    nonfinite_action: str = "count"  # count | raise
+
+
+_GUARD_METRICS = None  # lazy obs.HandleCache for the non-finite guard
+
+
+class NonFiniteLossError(RuntimeError):
+    """The fit loop saw a non-finite loss at ``step`` (the optimizer step
+    the poisoned batch trained). ``last_finite_step`` is the newest step
+    whose loss was still finite — rewind past the window between them."""
+
+    def __init__(self, step: int, last_finite_step: int):
+        super().__init__(
+            f"non-finite loss at step {step} (last finite step: "
+            f"{last_finite_step}) — rewind to a checkpoint at or before "
+            f"{last_finite_step} and skip the offending batch window")
+        self.step = int(step)
+        self.last_finite_step = int(last_finite_step)
 
 
 def _graft_params(boxed, values):
@@ -218,6 +244,11 @@ class Trainer:
         self._loss_fn = loss_fn
         self._train_step = None
         self._metrics: list[dict] = []
+        # newest optimizer step whose loss was finite (post-step numbering,
+        # comparable to checkpoint step numbers); -1 until the first loss
+        # lands. Mirrored on the synapseml_train_last_finite_step gauge so
+        # the rewind trigger is a metric read.
+        self.last_finite_step: int = -1
 
     # ---- sharding helpers ----
     def _unbox_with_sharding(self, tree):
@@ -470,6 +501,48 @@ class Trainer:
         return (TrainState(params=sd["params"], opt_state=sd["opt_state"], step=sd["step"],
                            batch_stats=sd.get("batch_stats")), metrics)
 
+    # ---- non-finite loss guard ----
+    @staticmethod
+    def _guard_metrics():
+        global _GUARD_METRICS
+        from ..core import observability as obs
+
+        if _GUARD_METRICS is None:
+            _GUARD_METRICS = obs.HandleCache(lambda reg: {
+                "nonfinite": reg.counter(
+                    "synapseml_train_nonfinite_total",
+                    "optimizer steps whose loss was NaN/Inf", ("engine",)),
+                "last_finite": reg.gauge(
+                    "synapseml_train_last_finite_step",
+                    "newest optimizer step with a finite loss"),
+            })
+        return _GUARD_METRICS.get()
+
+    def _observe_losses(self, losses, last_step: int) -> None:
+        """Check host-side per-step losses ending at post-step number
+        ``last_step``: advance ``last_finite_step``, count non-finite steps
+        into ``synapseml_train_nonfinite_total``, and (under
+        ``cfg.nonfinite_action='raise'``) abort with
+        :class:`NonFiniteLossError` naming the first poisoned step."""
+        arr = np.asarray(losses, dtype=np.float64).reshape(-1)
+        if arr.size == 0:
+            return
+        finite = np.isfinite(arr)
+        m = self._guard_metrics()
+        if bool(finite.all()):
+            self.last_finite_step = max(self.last_finite_step, int(last_step))
+        else:
+            first_bad = int(np.argmax(~finite))
+            bad_step = last_step - arr.size + 1 + first_bad
+            if first_bad > 0:
+                self.last_finite_step = max(self.last_finite_step,
+                                            int(bad_step - 1))
+            m["nonfinite"].inc(int((~finite).sum()), engine="trainer")
+            if self.cfg.nonfinite_action == "raise":
+                m["last_finite"].set(self.last_finite_step)
+                raise NonFiniteLossError(bad_step, self.last_finite_step)
+        m["last_finite"].set(self.last_finite_step)
+
     # ---- loop ----
     def _flops_per_token(self, params) -> int:
         n_params = sum(int(np.prod(np.shape(x))) for x in jax.tree.leaves(params))
@@ -478,7 +551,8 @@ class Trainer:
     def fit(self, state: TrainState, batch_iter: Iterator[dict], max_steps: int,
             log_every: int = 50, callback: Callable[[int, dict], None] | None = None,
             scan_chunk: int = 8, checkpointer=None,
-            checkpoint_every: int = 0) -> TrainState:
+            checkpoint_every: int = 0,
+            skip_fn: Callable[[int], bool] | None = None) -> TrainState:
         """Streaming fit over ANY batch iterator.
 
         Default path: ``scan_chunk`` same-shape batches are stacked into ONE
@@ -495,6 +569,15 @@ class Trainer:
         checkpointer's background thread — training never stalls on disk.
         The final state is always saved; resume via
         ``restore_checkpoint`` + ``Trainer.resume_state``.
+
+        ``skip_fn(batch_index)`` (batch_index = the global pre-step
+        counter, i.e. the ``state.step`` value the batch would train from)
+        marks batches to CONSUME BUT NOT TRAIN: the batch is pulled from
+        the iterator (keeping the deterministic stream position and the
+        checkpointable step↔batch alignment) and ``state.step`` advances
+        with params untouched. This is the supervisor's NaN-rewind
+        mechanism — skip past a poisoned batch window instead of training
+        on it again. Forces the per-step path.
         """
         it = iter(batch_iter)
         if checkpointer is not None and 0 < checkpoint_every < scan_chunk:
@@ -502,8 +585,16 @@ class Trainer:
             # requested durability by shrinking the fused chunk
             scan_chunk = checkpoint_every
         ckpt_due = self._ckpt_writer(checkpointer, checkpoint_every)
-        if callback is not None or scan_chunk <= 1 or max_steps <= 1:
+        if callback is not None or skip_fn is not None or scan_chunk <= 1 \
+                or max_steps <= 1:
             meter = _ThroughputMeter(self, state.params)
+            base = int(state.step)
+            # per-step host materialization of the loss blocks async
+            # dispatch — only the "raise" guard (the supervised continual
+            # path, which needs prompt NaN detection for its rewind) pays
+            # it; "count" mode samples the losses already pulled at the
+            # log windows, keeping the default path's overlap intact
+            eager_guard = self.cfg.nonfinite_action == "raise"
             i = -1
             for i in range(max_steps):
                 try:
@@ -511,17 +602,41 @@ class Trainer:
                 except StopIteration:
                     i -= 1
                     break
+                if skip_fn is not None and skip_fn(base + i):
+                    # consumed, not trained: the stream stays aligned with
+                    # the step counter, the params stay at the checkpoint
+                    state = dataclasses.replace(state,
+                                                step=state.step + 1)
+                    self._count_skipped()
+                    ckpt_due(state, i + 1)
+                    continue
                 state, metrics = self.train_step(state, batch)
                 meter.observe(batch, steps=1)
+                if eager_guard:
+                    self._observe_losses(
+                        [float(np.asarray(metrics["loss"]))],
+                        last_step=base + i + 1)
                 if callback is not None:
                     callback(i, metrics)
                 if (i + 1) % log_every == 0:
-                    self._metrics.append(meter.entry(float(metrics["loss"])))
+                    lf = float(metrics["loss"])
+                    if not eager_guard:
+                        self._observe_losses([lf], last_step=base + i + 1)
+                    self._metrics.append(meter.entry(lf))
                 ckpt_due(state, i + 1)
             ckpt_due(state, i + 1, final=True)
             return state
         return self._fit_chunked(state, it, max_steps, scan_chunk, log_every,
                                  ckpt_due)
+
+    @staticmethod
+    def _count_skipped() -> None:
+        from ..core import observability as obs
+
+        obs.get_registry().counter(
+            "synapseml_train_skipped_steps_total",
+            "batches consumed but not trained (NaN-rewind skip windows)",
+            ("engine",)).inc(engine="trainer")
 
     def _ckpt_writer(self, checkpointer, every: int):
         """Periodic full-state async snapshots (no-op without a checkpointer)."""
@@ -606,6 +721,7 @@ class Trainer:
         threading.Thread(target=producer, daemon=True).start()
         meter = _ThroughputMeter(self, state.params)
         steps_done = logged_at = 0
+        base = int(state.step)
         try:
             while True:
                 item = q.get()
@@ -618,13 +734,17 @@ class Trainer:
                     state, metrics = self.train_steps_scan(state, payload)
                     meter.observe(payload, steps=scan_chunk)
                     steps_done += scan_chunk
-                    loss = float(np.asarray(metrics["loss"])[-1])
+                    losses = np.asarray(metrics["loss"])
+                    loss = float(losses[-1])
                 else:
+                    losses = []
                     for b in payload:
                         state, metrics = self.train_step(state, b)
                         meter.observe(b, steps=1)
+                        losses.append(float(np.asarray(metrics["loss"])))
                     steps_done += len(payload)
-                    loss = float(metrics["loss"])
+                    loss = losses[-1]
+                self._observe_losses(losses, last_step=base + steps_done)
                 if steps_done - logged_at >= log_every or steps_done >= max_steps:
                     self._metrics.append(meter.entry(loss))
                     logged_at = steps_done
@@ -774,7 +894,10 @@ def fit_source(trainer: "Trainer", source, *, batch_size: int, total_steps: int,
                prefetch: int = 2, device_prefetch: bool = False,
                columns: list | None = None, host_index: int = 0,
                host_count: int = 1,
-               resume_from: str | None = None) -> "TrainState":
+               resume_from: str | None = None,
+               skip_fn: Callable[[int], bool] | None = None,
+               callback: Callable[[int, dict], None] | None = None
+               ) -> "TrainState":
     """Streaming fit over a :class:`synapseml_tpu.data.ShardedSource`.
 
     The data plane supplies seeded shard + row shuffles, bucket-ladder batch
@@ -809,10 +932,12 @@ def fit_source(trainer: "Trainer", source, *, batch_size: int, total_steps: int,
     from ..data import DataLoader, IteratorState
 
     if state is None and resume_from is not None:
-        from ..parallel.checkpoint import latest_step as _latest_step
+        from ..parallel.checkpoint import latest_verified_step
         from ..parallel.checkpoint import restore_checkpoint
 
-        last = _latest_step(resume_from)
+        # VERIFIED latest: a torn/corrupted newest checkpoint demotes to
+        # the previous completed step instead of resuming garbage params
+        last = latest_verified_step(resume_from)
         if last is not None:
             tree = restore_checkpoint(
                 resume_from, last,
@@ -883,7 +1008,8 @@ def fit_source(trainer: "Trainer", source, *, batch_size: int, total_steps: int,
             if checkpointer is not None else None
         return trainer.fit(state, batch_iter, max_steps=remaining,
                            scan_chunk=scan_chunk, checkpointer=ck,
-                           checkpoint_every=checkpoint_every)
+                           checkpoint_every=checkpoint_every,
+                           skip_fn=skip_fn, callback=callback)
     finally:
         loader.close()
 

@@ -15,8 +15,10 @@ from .batching import (
     round_up_to_multiple,
     unpad,
 )
-from .checkpoint import (AsyncCheckpointer, checkpoint_sharding, latest_step,
-                         restore_checkpoint, save_checkpoint)
+from .checkpoint import (AsyncCheckpointer, CheckpointCorrupt,
+                         checkpoint_sharding, latest_step,
+                         latest_verified_step, restore_checkpoint,
+                         save_checkpoint, verify_checkpoint)
 from .mesh import MeshConfig, MeshContext, P, create_mesh, logical_axis_rules, shard_params
 from .partition import (PartitionRules, apply_manifest_sharding,
                         checkpoint_sharding_fn, default_llama_rules,
@@ -31,8 +33,9 @@ __all__ = [
     "worker_rendezvous",
     "DoubleBufferedFeeder", "PaddedBatch", "batches", "bucket_size", "pad_batch",
     "pad_sequences", "round_up_to_multiple", "unpad",
-    "AsyncCheckpointer", "checkpoint_sharding", "latest_step",
-    "restore_checkpoint", "save_checkpoint",
+    "AsyncCheckpointer", "CheckpointCorrupt", "checkpoint_sharding",
+    "latest_step", "latest_verified_step", "restore_checkpoint",
+    "save_checkpoint", "verify_checkpoint",
     "MeshConfig", "MeshContext", "P", "create_mesh", "logical_axis_rules", "shard_params",
     "PartitionRules", "apply_manifest_sharding", "checkpoint_sharding_fn",
     "default_llama_rules", "default_transformer_rules", "emit_shard_metrics",

@@ -10,6 +10,9 @@ is unavailable or the target is single-process).
 from __future__ import annotations
 
 import concurrent.futures
+import hashlib
+import json
+import logging
 import os
 import shutil
 from typing import Any
@@ -20,7 +23,15 @@ import numpy as np
 from ..core import serialization
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
-           "checkpoint_sharding", "AsyncCheckpointer"]
+           "latest_verified_step", "verify_checkpoint",
+           "CheckpointCorrupt", "checkpoint_sharding", "AsyncCheckpointer"]
+
+_logger = logging.getLogger("synapseml_tpu.parallel.checkpoint")
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint payload failed its sha256 sidecar verification — the
+    file is torn or bit-rotted, not merely incomplete."""
 
 
 def _step_dir(path: str, step: int) -> str:
@@ -68,13 +79,85 @@ def save_checkpoint(path: str, tree: Any, step: int = 0, use_orbax: bool | None 
     else:
         serialization.save_pytree(host_tree, os.path.join(target, "state"))
     if sharding:
-        import json
-
         with open(os.path.join(target, "sharding.json"), "w") as f:
             json.dump(sharding, f, indent=2, sort_keys=True)
+    if not use_orbax:
+        # sha256 sidecar per payload (npz AND the tree/sharding JSON —
+        # save_pytree writes both, and a torn tree.json would otherwise
+        # pass verification then die as an opaque JSONDecodeError),
+        # written BEFORE the DONE marker: restore verifies against them
+        # and demotes a torn step to the previous completed one
+        for payload in ("state.npz", "state.tree.json", "sharding.json"):
+            _write_digest_sidecar(os.path.join(target, payload))
     with open(os.path.join(target, "DONE"), "w") as f:
         f.write(str(step))
     return target
+
+
+def _sidecar_path(payload_path: str) -> str:
+    return payload_path + ".sha256"
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_digest_sidecar(payload_path: str) -> None:
+    if not os.path.isfile(payload_path):
+        return
+    with open(_sidecar_path(payload_path), "w") as f:
+        f.write(_sha256_file(payload_path))
+
+
+def verify_checkpoint(path: str, step: int) -> bool:
+    """True iff every payload with a sha256 sidecar matches it. Payloads
+    WITHOUT a sidecar (pre-sidecar checkpoints, orbax dirs) verify
+    vacuously — verification tightens the contract, it must not brick
+    every existing checkpoint on disk."""
+    target = _step_dir(path, step)
+    for name in os.listdir(target) if os.path.isdir(target) else ():
+        if not name.endswith(".sha256"):
+            continue
+        payload = os.path.join(target, name[:-len(".sha256")])
+        if not os.path.isfile(payload):
+            return False
+        with open(os.path.join(target, name)) as f:
+            expected = f.read().strip()
+        if _sha256_file(payload) != expected:
+            return False
+    return True
+
+
+def latest_verified_step(path: str) -> int | None:
+    """The newest completed step whose payloads pass sidecar verification —
+    what a crash-safe resume (``continual.TrainSupervisor``) restores from.
+    A failing step demotes to the previous completed one with ONE
+    structured warning per corrupt step."""
+    for step in reversed(_completed_steps(path)):
+        if verify_checkpoint(path, step):
+            return step
+        _warn_corrupt(path, step)
+    return None
+
+
+_warned_corrupt: set = set()
+
+
+def _warn_corrupt(path: str, step: int) -> None:
+    """ONE structured warning per corrupt (path, step) per process — the
+    supervisor and loop re-scan frequently and must not spam the log."""
+    key = (os.path.abspath(path), int(step))
+    if key in _warned_corrupt:
+        return
+    _warned_corrupt.add(key)
+    _logger.warning(json.dumps({
+        "event": "checkpoint_verification_failed",
+        "path": path, "step": int(step),
+        "action": "demoted to previous completed step"}))
 
 
 def checkpoint_sharding(path: str, step: int | None = None) -> dict | None:
@@ -127,8 +210,16 @@ def latest_step(path: str) -> int | None:
     return steps[-1] if steps else None
 
 
-def restore_checkpoint(path: str, step: int | None = None, sharding_fn=None) -> Any:
+def restore_checkpoint(path: str, step: int | None = None, sharding_fn=None,
+                       verify: bool = True) -> Any:
     """Restore a checkpoint, optionally placing leaves as they load.
+
+    ``verify`` (default on) checks every payload against its sha256
+    sidecar first: with ``step=None`` a corrupt newest checkpoint demotes
+    to the previous completed step (one structured warning — the "latest
+    verified checkpoint" contract the training supervisor resumes on); an
+    EXPLICITLY requested corrupt step raises :class:`CheckpointCorrupt`
+    instead of returning garbage params.
 
     ``sharding_fn`` re-places leaves on the current mesh and accepts
     either signature:
@@ -143,8 +234,13 @@ def restore_checkpoint(path: str, step: int | None = None, sharding_fn=None) -> 
     With a sharded target each ``device_put`` transfers only that
     device's shard slices — no host materializes a device-resident full
     copy of any leaf."""
+    verified_already = False
     if step is None:
-        step = latest_step(path)
+        if verify:
+            step = latest_verified_step(path)
+            verified_already = True  # don't re-hash the same payloads
+        else:
+            step = latest_step(path)
         if step is None:
             raise FileNotFoundError(f"no completed checkpoint under {path}")
     target = _step_dir(path, step)
@@ -152,6 +248,11 @@ def restore_checkpoint(path: str, step: int | None = None, sharding_fn=None) -> 
         raise FileNotFoundError(
             f"checkpoint step {step} under {path} is incomplete (crash "
             f"during save?) — latest completed: {latest_step(path)}")
+    if verify and not verified_already and not verify_checkpoint(path, step):
+        raise CheckpointCorrupt(
+            f"checkpoint step {step} under {path} fails its sha256 sidecar "
+            f"verification (torn or bit-rotted payload) — latest verified: "
+            f"{latest_verified_step(path)}")
     orbax_dir = os.path.join(target, "orbax")
     if os.path.isdir(orbax_dir):
         import orbax.checkpoint as ocp

@@ -150,6 +150,29 @@ def test_fleet_compat_coverage():
             f"compat.fleet.{name} is not the fleet plane's own object")
 
 
+def test_continual_compat_coverage():
+    """Same compat coverage rule for the continual-training flywheel:
+    every public ``synapseml_tpu.continual`` symbol importable from the
+    generated ``compat.continual`` passthrough, with no stale extras."""
+    import synapseml_tpu.compat.continual as compat_continual
+    import synapseml_tpu.continual as continual
+
+    public = set(continual.__all__)
+    covered = set(compat_continual.__all__)
+    missing = sorted(public - covered)
+    assert not missing, (
+        f"public continual symbols missing compat coverage: {missing}; "
+        "run python -m synapseml_tpu.codegen")
+    stale = sorted(covered - public)
+    assert not stale, (
+        f"compat.continual exports symbols the continual plane no longer "
+        f"has: {stale}; run python -m synapseml_tpu.codegen")
+    for name in sorted(public):
+        assert getattr(compat_continual, name) is getattr(continual, name), (
+            f"compat.continual.{name} is not the continual plane's own "
+            "object")
+
+
 def test_no_inline_jit_in_stage_transform():
     """Static guard for the continuous-batching plane: inference-stage
     modules must acquire jitted programs through
@@ -193,7 +216,13 @@ def test_no_inline_jit_in_stage_transform():
                # would dodge the warmup precompile and the AOT second
                # tier its own scale-up guarantee rests on
                "fleet/autoscaler.py", "fleet/residency.py",
-               "fleet/admission.py", "fleet/spec.py"]
+               "fleet/admission.py", "fleet/spec.py",
+               # the continual flywheel: orchestration/logging code must
+               # never acquire executables outside the shared cache — a
+               # loop that traced privately would dodge the publish-time
+               # AOT capture its own zero-cold-start canaries ride
+               "continual/logger.py", "continual/supervisor.py",
+               "continual/loop.py"]
     pkg = pathlib.Path(st.__file__).parent
     offenders = []
     for rel in modules:
