@@ -98,6 +98,10 @@ class ContinualSpec:
     holdout_fraction: float = 0.25   # fraction of PARTS held out for eval
     max_restarts: int = 3
     max_rewinds: int = 2
+    # preemption/resize resumes (elastic gang exits) get their OWN budget:
+    # a preempted retraining iteration RESUMES from its coordinated
+    # checkpoint instead of aborting, without eating the crash budget
+    max_preempts: int = 16
     hang_timeout_s: float = 60.0
     # -- eval gate ---------------------------------------------------------
     gate_metric: str = "loss"        # label on the published metrics
@@ -439,12 +443,15 @@ class ContinualLoop:
             supervisor = TrainSupervisor(
                 ckpt_dir, max_restarts=spec.max_restarts,
                 max_rewinds=spec.max_rewinds,
+                max_preempts=spec.max_preempts,
                 hang_timeout_s=spec.hang_timeout_s)
-            record["supervisor"] = {"restarts": 0, "rewinds": 0}
+            record["supervisor"] = {"restarts": 0, "rewinds": 0,
+                                    "preempts": 0}
             stage = supervisor.run(
                 lambda attempt: self.train_fn(ctx, attempt))
             record["supervisor"] = {"restarts": supervisor.restarts,
-                                    "rewinds": supervisor.rewinds}
+                                    "rewinds": supervisor.rewinds,
+                                    "preempts": supervisor.preempts}
             # the data is consumed whatever the gate says — retraining on
             # the same poisoned shards next tick would loop forever
             self.state.setdefault("consumed", []).extend(parts)

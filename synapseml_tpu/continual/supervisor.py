@@ -22,7 +22,16 @@ instead of a corrupted (or lost) run:
   verified checkpoint and ``skip_fn`` skips the batch window from that
   checkpoint through the poisoned step — the stream stays aligned, the
   params never train on the offending batches, and
-  ``synapseml_continual_rewinds_total`` moves.
+  ``synapseml_continual_rewinds_total`` moves;
+* **preemption / gang resize** — a gang-trained attempt exits with
+  :data:`~synapseml_tpu.parallel.gang.EXIT_PREEMPTED` /
+  :data:`~synapseml_tpu.parallel.gang.EXIT_RESIZE` (subprocess mode) or
+  raises :class:`~synapseml_tpu.parallel.gang.Preempted` /
+  :class:`~synapseml_tpu.parallel.gang.GangAborted` (in-process): these
+  are EXPECTED elastic events, not crashes — the supervisor resumes them
+  under a SEPARATE ``max_preempts`` budget (a preempted flywheel
+  iteration continues instead of aborting, and a crash-loop bug cannot
+  hide behind the preemption budget).
 
 In-process mode cannot preempt a hung Python thread — hang detection is
 subprocess-mode only (documented contract; the loop's cadence bounds an
@@ -42,6 +51,8 @@ from ..core.faults import active_fault_plan
 from ..core.resilience import RetryPolicy, resilience_measures
 from ..models.trainer import NonFiniteLossError
 from ..parallel.checkpoint import latest_step, latest_verified_step
+from ..parallel.gang import (EXIT_PREEMPTED, EXIT_RESIZE, GangAborted,
+                             Preempted)
 
 __all__ = ["TrainSupervisor", "TrainAttempt"]
 
@@ -109,17 +120,20 @@ class TrainSupervisor:
     def __init__(self, checkpoint_dir: str, max_restarts: int = 3,
                  max_rewinds: int = 2, hang_timeout_s: float = 60.0,
                  poll_s: float = 0.25,
-                 retry_policy: RetryPolicy | None = None):
+                 retry_policy: RetryPolicy | None = None,
+                 max_preempts: int = 16):
         self.checkpoint_dir = str(checkpoint_dir)
         os.makedirs(self.checkpoint_dir, exist_ok=True)
         self.max_restarts = int(max_restarts)
         self.max_rewinds = int(max_rewinds)
+        self.max_preempts = int(max_preempts)
         self.hang_timeout_s = float(hang_timeout_s)
         self.poll_s = float(poll_s)
         self.retry_policy = retry_policy or RetryPolicy(
             backoffs_ms=(50, 200, 500))
         self.restarts = 0
         self.rewinds = 0
+        self.preempts = 0
         self.skip_windows: list[tuple[int, int]] = []
         self.current_pid: int | None = None  # subprocess mode
 
@@ -138,6 +152,17 @@ class TrainSupervisor:
             return False
         self.restarts += 1
         resilience_measures("training").count("retry")
+        _SUP_METRICS.get()["restarts"].inc(mode=mode)
+        return True
+
+    def _on_preempt(self, mode: str) -> bool:
+        """Account one elastic resume (preemption / gang resize) on its own
+        budget: an emergency-checkpointed exit is bounded lost work, not a
+        crash — it must neither abort the run nor eat the crash budget."""
+        if self.preempts >= self.max_preempts:
+            return False
+        self.preempts += 1
+        resilience_measures("training").count("preempt_resume")
         _SUP_METRICS.get()["restarts"].inc(mode=mode)
         return True
 
@@ -171,6 +196,14 @@ class TrainSupervisor:
                 return attempt_fn(attempt)
             except NonFiniteLossError as e:
                 if not self._on_rewind(e):
+                    raise
+            except Preempted:
+                # an emergency checkpoint COMMITTED — resume the iteration
+                # from it instead of aborting the flywheel
+                if not self._on_preempt("preempt"):
+                    raise
+            except GangAborted:
+                if not self._on_preempt("resize"):
                     raise
             except Exception:
                 if not self._on_restart("inprocess"):
@@ -223,6 +256,16 @@ class TrainSupervisor:
             self.current_pid = None
             if rc == 0 and not hung:
                 return attempts
+            if not hung and rc in (EXIT_PREEMPTED, EXIT_RESIZE):
+                # elastic gang exits: the child either committed an
+                # emergency checkpoint (preempt) or lost a member (resize)
+                # — resume it on the preemption budget, no crash counted
+                mode = "preempt" if rc == EXIT_PREEMPTED else "resize"
+                if not self._on_preempt(mode):
+                    raise RuntimeError(
+                        f"supervised trainer preempted {self.preempts} "
+                        f"time(s) — preemption budget exhausted")
+                continue
             if not self._on_restart("hang" if hung else "subprocess"):
                 raise RuntimeError(
                     f"supervised trainer failed after {attempts} attempt(s) "

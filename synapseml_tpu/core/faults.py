@@ -19,6 +19,11 @@ transport hook points consult it before touching the network:
   ``plan.on_training(target)`` at every attempt start and heartbeat
   (``target`` is ``attempt:<n>`` / ``step:<n>``), so a trainer crash at
   any step is one seeded ``FaultSpec(..., planes=("training",))`` away;
+* ``parallel/gang.py`` — every worker heartbeat send calls
+  ``plan.on_gang("beat:rank=<r>:step=<n>")`` on the ``"gang"`` plane:
+  ``drop`` suppresses the beat (the driver's missed-beat detector fires),
+  ``latency`` delays it (straggler), ``crash`` kills the worker at an
+  exact step — chaos runs stay seeded-deterministic;
 * ``continual/loop.py`` + ``continual/logger.py`` — every flywheel seam
   (watch / snapshot / train / eval / publish / canary / promote, and the
   request logger's shard commits) calls ``plan.on_continual(target)``.
@@ -50,7 +55,8 @@ from .resilience import resilience_measures
 
 __all__ = ["FaultSpec", "FaultPlan", "inject_faults", "active_fault_plan"]
 
-FAULT_KINDS = ("connection_error", "status", "latency", "blackhole", "crash")
+FAULT_KINDS = ("connection_error", "status", "latency", "blackhole", "crash",
+               "drop")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,7 +69,10 @@ class FaultSpec:
     * ``latency`` — sleep ``latency_ms`` then proceed normally;
     * ``blackhole`` — sleep ``latency_ms`` then raise ``TimeoutError`` (the
       worker accepts nothing, the client's timeout fires);
-    * ``crash`` — raise ``ConnectionResetError`` (the worker died mid-flight).
+    * ``crash`` — raise ``ConnectionResetError`` (the worker died mid-flight);
+    * ``drop`` — silently suppress the guarded action (``gang`` plane:
+      the heartbeat is not sent, modeling a lost datagram/partition —
+      after ``latency_ms``, if set).
     """
 
     kind: str
@@ -120,7 +129,11 @@ class FaultPlan:
     def _raise_fault(f: FaultSpec, target: str) -> None:
         if f.latency_ms > 0:
             time.sleep(f.latency_ms / 1000.0)
-        if f.kind == "latency":
+        if f.kind in ("latency", "drop"):
+            # 'drop' only SUPPRESSES on hooks that can express it (the
+            # gang plane returns True before reaching here); on a
+            # raise-only hook it degrades to a recorded no-op rather than
+            # falling through to a nonsense HTTP status
             return
         if f.kind == "connection_error":
             raise ConnectionRefusedError(f"injected connection error: {target}")
@@ -176,6 +189,24 @@ class FaultPlan:
         f = self._select("continual", target)
         if f is not None:
             self._raise_fault(f, target)
+
+    def on_gang(self, target: str) -> bool:
+        """Called by the elastic gang channel (``parallel/gang.py``) before
+        each worker heartbeat send — ``target`` is
+        ``beat:rank=<r>:step=<n>``, so a seeded plan can drop or delay a
+        specific host's beats (``drop``/``latency``) or kill the worker at
+        an exact step (``crash`` → the heartbeat raises, the training
+        process dies, the gang's failure detector takes over). Returns
+        True when the beat must be SUPPRESSED (``drop``)."""
+        f = self._select("gang", target)
+        if f is None:
+            return False
+        if f.kind == "drop":
+            if f.latency_ms > 0:
+                time.sleep(f.latency_ms / 1000.0)
+            return True
+        self._raise_fault(f, target)
+        return False
 
 
 _ACTIVE: FaultPlan | None = None

@@ -17,7 +17,8 @@ from typing import Any
 
 import numpy as np
 
-__all__ = ["save_stage", "load_stage", "prepare_dir", "save_pytree", "load_pytree"]
+__all__ = ["save_stage", "load_stage", "prepare_dir", "save_pytree",
+           "load_pytree", "flatten_pytree", "tree_structure", "rebuild_pytree"]
 
 
 def prepare_dir(path: str, overwrite: bool = True) -> None:
@@ -28,17 +29,32 @@ def prepare_dir(path: str, overwrite: bool = True) -> None:
     os.makedirs(path, exist_ok=True)
 
 
-def _flatten_pytree(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
+def _flatten_pytree(tree: Any, prefix: str = "",
+                    leaf_fn=np.asarray) -> dict[str, np.ndarray]:
     out = {}
     if isinstance(tree, dict):
         for k, v in tree.items():
-            out.update(_flatten_pytree(v, f"{prefix}{k}/"))
+            out.update(_flatten_pytree(v, f"{prefix}{k}/", leaf_fn))
     elif isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
-            out.update(_flatten_pytree(v, f"{prefix}{i}/"))
+            out.update(_flatten_pytree(v, f"{prefix}{i}/", leaf_fn))
     else:
-        out[prefix.rstrip("/")] = np.asarray(tree)
+        out[prefix.rstrip("/")] = leaf_fn(tree)
     return out
+
+
+# public aliases: the sharded checkpointer flattens each host's shard with
+# the SAME naming/structure scheme as the single-file format, so an N-shard
+# assembly and a plain save_pytree round-trip are byte-interchangeable.
+# ``leaf_fn`` lets that caller keep RAW leaves (cross-process jax arrays
+# cannot survive np.asarray) while sharing this one traversal/naming codec.
+def flatten_pytree(tree: Any, prefix: str = "",
+                   leaf_fn=np.asarray) -> dict[str, np.ndarray]:
+    return _flatten_pytree(tree, prefix, leaf_fn)
+
+
+def tree_structure(tree: Any) -> Any:
+    return _tree_structure(tree)
 
 
 def save_pytree(tree: Any, path: str) -> None:
@@ -59,10 +75,11 @@ def _tree_structure(tree: Any) -> Any:
     return {"__kind__": "leaf"}
 
 
-def load_pytree(path: str) -> Any:
-    data = np.load(path + ".npz", allow_pickle=False)
-    with open(path + ".tree.json") as f:
-        structure = json.load(f)
+def rebuild_pytree(structure: Any, flat: Any) -> Any:
+    """Inverse of :func:`flatten_pytree` + :func:`tree_structure`:
+    ``flat`` is any mapping of slash-joined leaf path -> array (an open
+    npz works). The sharded-checkpoint assembler reuses this so its
+    multi-shard reconstruction cannot drift from the single-file format."""
 
     def rebuild(node, prefix=""):
         kind = node["__kind__"]
@@ -71,9 +88,16 @@ def load_pytree(path: str) -> Any:
         if kind in ("list", "tuple"):
             seq = [rebuild(v, f"{prefix}{i}/") for i, v in enumerate(node["items"])]
             return seq if kind == "list" else tuple(seq)
-        return data[prefix.rstrip("/")]
+        return flat[prefix.rstrip("/")]
 
     return rebuild(structure)
+
+
+def load_pytree(path: str) -> Any:
+    data = np.load(path + ".npz", allow_pickle=False)
+    with open(path + ".tree.json") as f:
+        structure = json.load(f)
+    return rebuild_pytree(structure, data)
 
 
 def _is_array_pytree(v: Any) -> bool:

@@ -20,9 +20,11 @@ Training entry points: ``models.trainer.fit_source`` (and the thin
 ``fit_arrays`` wrapper), ``gbdt.train_booster_from_source``.
 """
 
-from .loader import DataLoader  # noqa: F401
+from .loader import DataLoader, ElasticStreamSet  # noqa: F401
 from .source import MemorySource, Shard, ShardedSource  # noqa: F401
-from .state import IteratorState, row_order, shard_order  # noqa: F401
+from .state import (ElasticPlan, IteratorState, row_order,  # noqa: F401
+                    shard_order)
 
-__all__ = ["DataLoader", "MemorySource", "Shard", "ShardedSource",
-           "IteratorState", "row_order", "shard_order"]
+__all__ = ["DataLoader", "ElasticStreamSet", "MemorySource", "Shard",
+           "ShardedSource", "ElasticPlan", "IteratorState", "row_order",
+           "shard_order"]

@@ -33,9 +33,9 @@ import numpy as np
 from ..core import batching as cb
 from ..core import observability as obs
 from .source import ShardedSource, _n_rows
-from .state import IteratorState, row_order, shard_order
+from .state import ElasticPlan, IteratorState, row_order, shard_order
 
-__all__ = ["DataLoader"]
+__all__ = ["DataLoader", "ElasticStreamSet"]
 
 _END = object()
 
@@ -400,6 +400,116 @@ class DataLoader:
             st.epoch += 1
             st.rows_emitted = 0
         self._put(_END)
+
+
+class ElasticStreamSet:
+    """One gang member's view of an elastic run's batch streams.
+
+    An :class:`~synapseml_tpu.data.state.ElasticPlan` freezes the run as
+    ``orig_world`` virtual streams; this set owns the streams assigned to
+    ``rank`` of ``world`` survivors — one :class:`DataLoader` per stream,
+    each pinned to ``host_index=stream_id, host_count=orig_world`` and
+    resumed from that stream's checkpointed cursor. Each step draws from
+    the LEAST-consumed assigned stream (ties to the lowest stream id):
+    with equal cursors this is plain round-robin, and because the choice
+    is a function of the checkpointed cursors — never of a host-local
+    cycle position — a resume landing mid-cycle continues the exact
+    interleaving an uninterrupted run would have produced. The batch
+    sequence is a pure function of ``(plan, rank, world)``.
+
+    ``state_for_batch(k)`` returns the per-stream cursor dict after this
+    host's k-th emitted batch — the ``data_iter`` payload a coordinated
+    checkpoint stores per host (keys are stream ids, so
+    ``ElasticPlan.from_host_states`` can reunite all N across ranks).
+    """
+
+    def __init__(self, source, batch_size: int, plan: ElasticPlan,
+                 rank: int, world: int, *, prefetch: int = 2,
+                 state_history: int = 64, **loader_kwargs):
+        if not 0 <= int(rank) < int(world):
+            raise ValueError(f"rank {rank} outside world {world}")
+        self.plan = plan
+        self.rank, self.world = int(rank), int(world)
+        self.streams = plan.assignment(world)[self.rank]
+        if not self.streams:
+            raise ValueError(
+                f"rank {rank} of {world} has no virtual streams — the run "
+                f"was launched with orig_world={plan.orig_world} and only "
+                "that many hosts can be fed; clamp world to <= orig_world "
+                "in the launcher (fit_gang_source rejects this earlier "
+                "with the same guidance)")
+        loader_kwargs.pop("host_index", None)
+        loader_kwargs.pop("host_count", None)
+        self.loaders = []
+        self._counts = []
+        for sid in self.streams:
+            st = IteratorState.from_tree(plan.states[sid])
+            self.loaders.append(DataLoader(
+                source, batch_size, seed=st.seed, state=st,
+                host_index=sid, host_count=plan.orig_world,
+                prefetch=prefetch, state_history=state_history,
+                **loader_kwargs))
+            self._counts.append(st.batches_emitted)
+        self.emitted = 0
+        self._exhausted: set[int] = set()
+        self._snaps: dict[int, dict] = {}
+        self._last_snap: dict | None = None
+        self._history = max(int(state_history), 1)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        # A finite-epoch run's streams need not exhaust together (odd
+        # shard counts): a dry stream leaves the rotation and the set
+        # ends only when EVERY assigned stream is dry — ending on the
+        # first StopIteration would silently drop the longer streams'
+        # tail batches, breaking the zero-skipped-rows guarantee.
+        # Exhaustion is a function of plan + source content, so the
+        # interleaving stays the one an uninterrupted run produces.
+        while True:
+            live = [j for j in range(len(self.loaders))
+                    if j not in self._exhausted]
+            if not live:
+                raise StopIteration
+            i = min(live, key=lambda j: (self._counts[j], self.streams[j]))
+            try:
+                batch = next(self.loaders[i])
+                break
+            except StopIteration:
+                self._exhausted.add(i)
+        self._counts[i] += 1
+        self.emitted += 1
+
+        def cursor(j, sid):
+            st = self.loaders[j].state_for_batch(self._counts[j])
+            if st is None:  # stream not stepped yet this run: plan cursor
+                return dict(self.plan.states[sid])
+            return st.to_tree()
+
+        if self._last_snap is None:  # first emit: all streams, once
+            snap = {str(sid): cursor(j, sid)
+                    for j, sid in enumerate(self.streams)}
+        else:
+            # only stream i advanced since the previous snapshot — a lone
+            # survivor serving all N virtual streams must pay ONE cursor
+            # serialization per optimizer step, not N
+            snap = dict(self._last_snap)
+            snap[str(self.streams[i])] = cursor(i, self.streams[i])
+        self._last_snap = snap
+        self._snaps[self.emitted] = snap
+        while len(self._snaps) > self._history:
+            self._snaps.pop(next(iter(self._snaps)))
+        return batch
+
+    def state_for_batch(self, emitted: int) -> dict | None:
+        """Per-stream cursors after this host's ``emitted``-th post-resume
+        batch (``{stream_id: IteratorState tree}``)."""
+        return self._snaps.get(int(emitted))
+
+    def close(self) -> None:
+        for ld in self.loaders:
+            ld.close()
 
 
 def _carry(buffers: list[dict], consumed: int, buffered: int

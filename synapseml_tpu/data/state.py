@@ -22,7 +22,7 @@ import dataclasses
 
 import numpy as np
 
-__all__ = ["IteratorState", "shard_order", "row_order"]
+__all__ = ["IteratorState", "ElasticPlan", "shard_order", "row_order"]
 
 
 def shard_order(seed: int, epoch: int, n_shards: int,
@@ -121,3 +121,88 @@ class IteratorState:
                    batches_emitted=int(tree["batches_emitted"]),
                    seed=int(tree["seed"]),
                    shard_counts=counts if counts.size else None)
+
+
+@dataclasses.dataclass
+class ElasticPlan:
+    """N→M elastic redistribution of a gang's per-host batch streams.
+
+    The per-host stream partitioning is FROZEN at the gang's first launch:
+    a run started on N hosts is, forever, N *virtual streams* (stream *s*
+    reads shard slice ``order[s::N]`` — the exact per-host assignment a
+    static N-host run uses, see :func:`shard_order`). Each virtual stream
+    carries its own :class:`IteratorState` cursor; a coordinated
+    checkpoint stores one cursor per stream (the writer's ``host_tree``).
+
+    Resuming on M survivors multiplexes the N streams over M hosts —
+    ``assignment(M)[j] = [j, j+M, j+2M, ...]`` — and each host round-robins
+    its assigned streams, every stream continuing from ITS cursor. Because
+    every row still flows through exactly the stream that owned it at
+    launch, the union of emitted rows is exactly the dataset with **zero
+    replayed and zero skipped rows**, for any N→M (including M=N: each
+    host keeps one stream, i.e. the static layout).
+    """
+
+    orig_world: int
+    states: list  # one IteratorState tree (``to_tree`` dict) per stream
+
+    def __post_init__(self):
+        if self.orig_world < 1:
+            raise ValueError(f"orig_world must be >= 1, got {self.orig_world}")
+        if len(self.states) != self.orig_world:
+            raise ValueError(
+                f"elastic plan needs one cursor per virtual stream: "
+                f"{len(self.states)} state(s) for orig_world="
+                f"{self.orig_world}")
+
+    @classmethod
+    def fresh(cls, world: int, seed: int) -> "ElasticPlan":
+        return cls(orig_world=int(world),
+                   states=[IteratorState(seed=int(seed)).to_tree()
+                           for _ in range(int(world))])
+
+    @classmethod
+    def from_host_states(cls, orig_world: int, host_states: dict,
+                         key: str = "data_iter") -> "ElasticPlan":
+        """Rebuild the plan from a coordinated checkpoint's per-rank host
+        payloads (``parallel.checkpoint.restore_host_states``). Each rank
+        stored the cursors of the streams it was serving as
+        ``{key: {stream_id: IteratorState tree}}``; their union must cover
+        every virtual stream EXACTLY — a gap means a rank's shard
+        vanished, and a cursor beyond ``orig_world`` means the caller's
+        ``orig_world`` undercounts the run's frozen world (silently
+        dropping it would skip that stream's remaining rows forever)."""
+        states: dict[int, dict] = {}
+        for rank, tree in host_states.items():
+            cursors = tree.get(key) if isinstance(tree, dict) else None
+            if cursors is None:
+                continue
+            for sid, st in cursors.items():
+                states[int(sid)] = st
+        missing = sorted(set(range(int(orig_world))) - set(states))
+        if missing:
+            raise ValueError(
+                f"elastic resume is missing cursors for virtual stream(s) "
+                f"{missing} (have {sorted(states)}) — the checkpoint does "
+                f"not cover the original world of {orig_world}")
+        extra = sorted(set(states) - set(range(int(orig_world))))
+        if extra:
+            raise ValueError(
+                f"elastic resume found cursors for virtual stream(s) "
+                f"{extra} beyond orig_world={orig_world} — the declared "
+                f"original world undercounts the run's frozen world; "
+                f"resuming would permanently skip those streams' rows")
+        return cls(orig_world=int(orig_world),
+                   states=[states[s] for s in range(int(orig_world))])
+
+    def assignment(self, new_world: int) -> list[list[int]]:
+        """Virtual streams per surviving host: strided, deterministic, and
+        exhaustive — every stream lands on exactly one of the M hosts.
+        M > N leaves hosts beyond N with an empty list; a training gang
+        must clamp world to <= orig_world (an assignment-less member has
+        no shard to ACK, so no checkpoint could ever commit)."""
+        m = int(new_world)
+        if m < 1:
+            raise ValueError(f"new_world must be >= 1, got {m}")
+        return [list(range(self.orig_world))[j::m] for j in range(m)]
+

@@ -30,7 +30,7 @@ from ..parallel.mesh import MeshContext, logical_axis_rules
 
 __all__ = ["TrainerConfig", "Trainer", "cross_entropy_loss", "TrainState",
            "NonFiniteLossError",
-           "fit_source", "fit_arrays",
+           "fit_source", "fit_arrays", "fit_gang_source",
            # horizontally fused training arrays (HFTA): N hyperparameter
            # trials inside ONE jitted step — implementation lives in
            # .fused_trainer (kept importable from here; the module split
@@ -552,7 +552,8 @@ class Trainer:
             log_every: int = 50, callback: Callable[[int, dict], None] | None = None,
             scan_chunk: int = 8, checkpointer=None,
             checkpoint_every: int = 0,
-            skip_fn: Callable[[int], bool] | None = None) -> TrainState:
+            skip_fn: Callable[[int], bool] | None = None,
+            gang=None) -> TrainState:
         """Streaming fit over ANY batch iterator.
 
         Default path: ``scan_chunk`` same-shape batches are stacked into ONE
@@ -578,6 +579,16 @@ class Trainer:
         with params untouched. This is the supervisor's NaN-rewind
         mechanism — skip past a poisoned batch window instead of training
         on it again. Forces the per-step path.
+
+        ``gang`` (a :class:`~synapseml_tpu.parallel.gang.GangWorker`)
+        makes this fit a gang member: one heartbeat per optimizer step, a
+        verdict poll at every step boundary — a ``resize`` verdict raises
+        :class:`~synapseml_tpu.parallel.gang.GangAborted` (a member died;
+        exit and resume from the last committed checkpoint), an
+        ``abort_and_checkpoint`` verdict runs the emergency-checkpoint
+        dance (train to the gang's sync step, force a checkpoint, ack,
+        wait for the driver's commit) and raises :class:`~synapseml_tpu.
+        parallel.gang.Preempted`. Forces the per-step path.
         """
         it = iter(batch_iter)
         if checkpointer is not None and 0 < checkpoint_every < scan_chunk:
@@ -586,7 +597,7 @@ class Trainer:
             scan_chunk = checkpoint_every
         ckpt_due = self._ckpt_writer(checkpointer, checkpoint_every)
         if callback is not None or skip_fn is not None or scan_chunk <= 1 \
-                or max_steps <= 1:
+                or max_steps <= 1 or gang is not None:
             meter = _ThroughputMeter(self, state.params)
             base = int(state.step)
             # per-step host materialization of the loss blocks async
@@ -595,6 +606,9 @@ class Trainer:
             # it; "count" mode samples the losses already pulled at the
             # log windows, keeping the default path's overlap intact
             eager_guard = self.cfg.nonfinite_action == "raise"
+            if gang is not None:
+                gang.heartbeat(base)  # alive before the first (slow) compile
+            sync_at: int | None = None
             i = -1
             for i in range(max_steps):
                 try:
@@ -609,21 +623,51 @@ class Trainer:
                                                 step=state.step + 1)
                     self._count_skipped()
                     ckpt_due(state, i + 1)
-                    continue
-                state, metrics = self.train_step(state, batch)
-                meter.observe(batch, steps=1)
-                if eager_guard:
-                    self._observe_losses(
-                        [float(np.asarray(metrics["loss"]))],
-                        last_step=base + i + 1)
-                if callback is not None:
-                    callback(i, metrics)
-                if (i + 1) % log_every == 0:
-                    lf = float(metrics["loss"])
-                    if not eager_guard:
-                        self._observe_losses([lf], last_step=base + i + 1)
-                    self._metrics.append(meter.entry(lf))
-                ckpt_due(state, i + 1)
+                else:
+                    state, metrics = self.train_step(state, batch)
+                    meter.observe(batch, steps=1)
+                    if eager_guard:
+                        self._observe_losses(
+                            [float(np.asarray(metrics["loss"]))],
+                            last_step=base + i + 1)
+                    if callback is not None:
+                        callback(i, metrics)
+                    if (i + 1) % log_every == 0:
+                        lf = float(metrics["loss"])
+                        if not eager_guard:
+                            self._observe_losses([lf],
+                                                 last_step=base + i + 1)
+                        self._metrics.append(meter.entry(lf))
+                    ckpt_due(state, i + 1)
+                if gang is not None:
+                    step_now = base + i + 1
+                    gang.heartbeat(step_now)
+                    if sync_at is None:
+                        v = gang.check(step_now)
+                        if v == "resize":
+                            from ..parallel.gang import GangAborted
+
+                            raise GangAborted(
+                                "gang verdict: resize — a member failed; "
+                                "exit and resume from the last committed "
+                                "checkpoint")
+                        if isinstance(v, tuple):  # ("sync", S)
+                            sync_at = int(v[1])
+                    if sync_at is not None and step_now >= sync_at:
+                        # emergency coordinated checkpoint at the gang's
+                        # sync step: force the write, flush it, phase-2 ack
+                        from ..parallel.gang import GangAborted, Preempted
+
+                        ckpt_due(state, i + 1, final=True)
+                        if checkpointer is not None:
+                            checkpointer.wait()
+                        if checkpointer is not None \
+                                and gang.ack_and_wait_commit(step_now):
+                            raise Preempted(step_now)
+                        raise GangAborted(
+                            "emergency checkpoint did not commit inside "
+                            "the grace window — resume from the last "
+                            "committed step")
             ckpt_due(state, i + 1, final=True)
             return state
         return self._fit_chunked(state, it, max_steps, scan_chunk, log_every,
@@ -1012,6 +1056,169 @@ def fit_source(trainer: "Trainer", source, *, batch_size: int, total_steps: int,
                            skip_fn=skip_fn, callback=callback)
     finally:
         loader.close()
+
+
+class _ElasticLoaderCheckpointer:
+    """The gang-mode counterpart of :class:`_LoaderCheckpointer`: every
+    snapshot carries THIS host's per-stream cursors (an
+    ``ElasticStreamSet.state_for_batch`` dict keyed by virtual-stream id);
+    the multi-host :class:`~synapseml_tpu.parallel.AsyncCheckpointer`
+    moves that subtree into the per-host shard payload, so the union of
+    all ranks' shards always covers every stream of the
+    :class:`~synapseml_tpu.data.ElasticPlan`."""
+
+    def __init__(self, inner, stream, base_step: int):
+        self._inner = inner
+        self._stream = stream
+        self._base = int(base_step)
+
+    def save(self, tree, step: int):
+        snap = self._stream.state_for_batch(int(step) - self._base)
+        if snap is None:
+            raise RuntimeError(
+                f"elastic stream state for batch {int(step) - self._base} "
+                f"(checkpoint step {step}) is no longer in the snapshot "
+                "history — widen state_history")
+        tree = dict(tree)
+        tree["data_iter"] = snap
+        return self._inner.save(tree, step=step)
+
+    def wait(self):
+        return self._inner.wait()
+
+    def close(self):
+        return self._inner.close()
+
+
+def fit_gang_source(trainer: "Trainer", source, *, batch_size: int,
+                    total_steps: int, seed: int, gang, checkpoint_dir: str,
+                    rank: int, world: int, checkpoint_every: int = 10,
+                    epochs: int | None = None,
+                    drop_remainder: bool = True, shuffle_rows: str = "full",
+                    shuffle_window: int = 4096, columns: list | None = None,
+                    init_params=None, log_every: int = 50,
+                    callback: Callable[[int, dict], None] | None = None
+                    ) -> "TrainState":
+    """One gang member's preemption-tolerant streaming fit.
+
+    The elastic counterpart of :func:`fit_source`: the run is
+    ``orig_world`` frozen virtual streams (an
+    :class:`~synapseml_tpu.data.ElasticPlan`); this host serves the
+    streams the plan assigns to ``rank`` of ``world`` and trains with the
+    gang seams live — per-step heartbeats, verdict polling, coordinated
+    per-host shard checkpoints every ``checkpoint_every`` steps. The
+    DRIVER commits once every rank's ACK lands and owns the keep-last-K
+    verified retention (``GangCoordinator(keep=...)``) — workers never
+    commit or prune, so a lone survivor can't publish or destroy a
+    world-N checkpoint on its own. A commit needs EVERY rank's ACK, so a
+    finite-``epochs`` run whose streams exhaust a rank before
+    ``total_steps`` stops committing at that rank's last ACK (a
+    structured warning fires; size ``total_steps`` to the dataset or use
+    the default ``epochs=None`` infinite cycling).
+
+    On entry the checkpoint dir decides everything: a committed checkpoint
+    ⇒ **N→M elastic resume** — the global tree reassembles from the N
+    shards, params/optimizer state re-place via the trainer's rule table,
+    and every virtual stream continues from its committed cursor (zero
+    replayed, zero skipped rows — ``world`` may differ from the world that
+    wrote the checkpoint); an empty dir ⇒ fresh start with
+    ``orig_world = world``.
+
+    Raises :class:`~synapseml_tpu.parallel.gang.Preempted` (exit
+    ``EXIT_PREEMPTED``: an emergency checkpoint committed) or
+    :class:`~synapseml_tpu.parallel.gang.GangAborted` (exit
+    ``EXIT_RESIZE``: resume from the last commit)."""
+    from ..data import ElasticPlan, ElasticStreamSet
+    from ..parallel.checkpoint import AsyncCheckpointer
+    from ..parallel.gang import elastic_restore
+
+    resume = elastic_restore(checkpoint_dir)
+    if resume is not None:
+        if resume.plan is None:
+            raise ValueError(
+                f"checkpoint dir {checkpoint_dir} holds a single-host "
+                "checkpoint — fit_gang_source resumes only coordinated "
+                "(per-host shard) checkpoints; use fit_source(resume_from=)")
+        plan = resume.plan
+        done = resume.step
+        tree = resume.tree
+        state = trainer.resume_state(
+            tree["params"], tree.get("opt_state"),
+            step=int(np.asarray(tree["step"])),
+            batch_stats=tree.get("batch_stats"))
+    else:
+        plan = ElasticPlan.fresh(world, seed)
+        done, state = 0, None
+    if world > plan.orig_world:
+        raise ValueError(
+            f"world={world} exceeds the run's frozen stream count "
+            f"(orig_world={plan.orig_world}): extra hosts would have no "
+            "virtual stream to serve and no shard to ACK, wedging every "
+            "commit — relaunch the gang with world <= orig_world (clamp "
+            "in the launcher)")
+    remaining = total_steps - done
+    if state is not None and remaining <= 0:
+        return state
+    dp = trainer.mesh.data_parallel_size()
+    stream = ElasticStreamSet(
+        source, batch_size, plan, rank, world, epochs=epochs,
+        drop_remainder=drop_remainder, shuffle_rows=shuffle_rows,
+        shuffle_window=shuffle_window, multiple_of=dp, columns=columns,
+        state_history=max(64, checkpoint_every + 8))
+    ck = AsyncCheckpointer(
+        checkpoint_dir, process_index=rank, process_count=world,
+        coordinated=True, sharding=trainer.sharding_manifest(),
+        meta={"orig_world": plan.orig_world, "seed": int(seed)},
+        run_id=getattr(gang, "run_id", None))
+    shim = _ElasticLoaderCheckpointer(ck, stream, base_step=done)
+    it = iter(stream)
+    try:
+        if state is None:
+            first = next(it)
+            state = trainer.init_state(first, jax.random.PRNGKey(seed),
+                                       init_params=init_params)
+
+            def chain(head, rest):
+                yield head
+                yield from rest
+
+            batch_iter: Iterator[dict] = chain(first, it)
+        else:
+            batch_iter = it
+        out = trainer.fit(state, batch_iter, max_steps=remaining,
+                          scan_chunk=1, log_every=log_every,
+                          checkpointer=shim,
+                          checkpoint_every=checkpoint_every, gang=gang,
+                          callback=callback)
+    except BaseException:
+        # a Preempted/GangAborted (or crash) exit wins over any pending
+        # background-write error — but still release the writer thread
+        stream.close()
+        try:
+            ck.close()
+        except Exception:  # noqa: BLE001
+            pass
+        raise
+    # clean completion: close() surfaces a failed final shard write — the
+    # caller must NOT believe the last checkpoint landed when it didn't
+    stream.close()
+    ck.close()
+    if int(out.step) < total_steps:
+        # finite-epochs stream dried before total_steps: THIS rank sends
+        # no further ACKs, so no commit past its last one can ever form —
+        # the other ranks' later steps are unrestorable. Loud, not silent.
+        import json as _json
+        import logging as _logging
+
+        _logging.getLogger("synapseml_tpu.models.trainer").warning(
+            _json.dumps({
+                "event": "gang_stream_exhausted_early",
+                "rank": int(rank), "step": int(out.step),
+                "total_steps": int(total_steps),
+                "hint": "commits beyond this rank's last ACK cannot "
+                        "complete; size total_steps to the dataset or use "
+                        "epochs=None"}))
+    return out
 
 
 def fit_arrays(trainer: "Trainer", data: dict, *, batch_size: int, total_steps: int,

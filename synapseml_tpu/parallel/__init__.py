@@ -16,9 +16,15 @@ from .batching import (
     unpad,
 )
 from .checkpoint import (AsyncCheckpointer, CheckpointCorrupt,
-                         checkpoint_sharding, latest_step,
-                         latest_verified_step, restore_checkpoint,
-                         save_checkpoint, verify_checkpoint)
+                         checkpoint_meta, checkpoint_sharding,
+                         checkpoint_world, commit_checkpoint, gc_checkpoints,
+                         latest_step, latest_verified_step,
+                         restore_checkpoint, restore_host_states,
+                         save_checkpoint, save_checkpoint_shard,
+                         verify_checkpoint)
+from .gang import (EXIT_PREEMPTED, EXIT_RESIZE, ElasticResume, GangAborted,
+                   GangCoordinator, GangWorker, Preempted, elastic_restore,
+                   run_gang_member)
 from .mesh import MeshConfig, MeshContext, P, create_mesh, logical_axis_rules, shard_params
 from .partition import (PartitionRules, apply_manifest_sharding,
                         checkpoint_sharding_fn, default_llama_rules,
@@ -33,9 +39,14 @@ __all__ = [
     "worker_rendezvous",
     "DoubleBufferedFeeder", "PaddedBatch", "batches", "bucket_size", "pad_batch",
     "pad_sequences", "round_up_to_multiple", "unpad",
-    "AsyncCheckpointer", "CheckpointCorrupt", "checkpoint_sharding",
-    "latest_step", "latest_verified_step", "restore_checkpoint",
-    "save_checkpoint", "verify_checkpoint",
+    "AsyncCheckpointer", "CheckpointCorrupt", "checkpoint_meta",
+    "checkpoint_sharding", "checkpoint_world", "commit_checkpoint",
+    "gc_checkpoints", "latest_step", "latest_verified_step",
+    "restore_checkpoint", "restore_host_states", "save_checkpoint",
+    "save_checkpoint_shard", "verify_checkpoint",
+    "EXIT_PREEMPTED", "EXIT_RESIZE", "ElasticResume", "GangAborted",
+    "GangCoordinator", "GangWorker", "Preempted", "elastic_restore",
+    "run_gang_member",
     "MeshConfig", "MeshContext", "P", "create_mesh", "logical_axis_rules", "shard_params",
     "PartitionRules", "apply_manifest_sharding", "checkpoint_sharding_fn",
     "default_llama_rules", "default_transformer_rules", "emit_shard_metrics",

@@ -21,6 +21,7 @@ import os
 import socket
 import threading
 import time
+import uuid
 from dataclasses import dataclass
 
 __all__ = ["DriverRendezvous", "worker_rendezvous", "DistributedBackend", "initialize_backend"]
@@ -37,11 +38,26 @@ class DriverRendezvous:
     """Driver side: collect `world_size` worker registrations, assign ranks by
     (min partition id, executor id) — the reference's deterministic ordering
     (``NetworkManager.waitForAllTasksToReport:354-425``) — and reply with
-    {coordinator, rank, world}."""
+    {coordinator, rank, world}.
 
-    def __init__(self, world_size: int, coordinator_port: int = 9377, bind: str = "0.0.0.0"):
+    ``keep_alive=True`` keeps every worker connection OPEN after the rank
+    reply: the same TCP channel then serves as the gang-membership /
+    failure-detector plane — hand the sockets to
+    :meth:`gang` (a :class:`~synapseml_tpu.parallel.gang.GangCoordinator`)
+    and pair it with ``worker_rendezvous(..., keep_alive=True)`` on the
+    worker side."""
+
+    def __init__(self, world_size: int, coordinator_port: int = 9377,
+                 bind: str = "0.0.0.0", keep_alive: bool = False):
         self.world_size = world_size
         self.coordinator_port = coordinator_port
+        self.keep_alive = bool(keep_alive)
+        # one id per LAUNCH incarnation, handed to every worker in the
+        # rank reply: coordinated-checkpoint ACKs carry it, and the gang
+        # driver's commit fences on it so a relaunch over a torn step dir
+        # can never combine stale acks with fresh ones
+        self.run_id = uuid.uuid4().hex[:12]
+        self.conns: dict[int, socket.socket] = {}  # rank -> live socket
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((bind, 0))
@@ -72,10 +88,14 @@ class DriverRendezvous:
             coord_host = infos[order[0]].host
             coordinator = f"{coord_host}:{self.coordinator_port}"
             for rank, i in enumerate(order):
-                reply = {"coordinator": coordinator, "rank": rank, "world": self.world_size}
+                reply = {"coordinator": coordinator, "rank": rank,
+                         "world": self.world_size, "run_id": self.run_id}
                 conns[i].sendall((json.dumps(reply) + "\n").encode())
-            for c in conns:
-                c.close()
+                if self.keep_alive:
+                    self.conns[rank] = conns[i]
+            if not self.keep_alive:
+                for c in conns:
+                    c.close()
         except BaseException as e:  # surfaced via .error for the driver loop
             self.error = e
         finally:
@@ -87,16 +107,38 @@ class DriverRendezvous:
         if self.error:
             raise self.error
 
+    def gang(self, **kwargs):
+        """The bootstrap channel, promoted to the gang plane: a started
+        :class:`~synapseml_tpu.parallel.gang.GangCoordinator` over the
+        kept-alive worker sockets. Call after :meth:`join`; requires
+        ``keep_alive=True``."""
+        if not self.keep_alive:
+            raise RuntimeError("DriverRendezvous(keep_alive=True) is "
+                               "required for a gang channel")
+        if len(self.conns) != self.world_size:
+            raise RuntimeError("rendezvous incomplete: "
+                               f"{len(self.conns)}/{self.world_size} "
+                               "workers connected")
+        from .gang import GangCoordinator
+
+        kwargs.setdefault("run_id", self.run_id)
+        return GangCoordinator(self.conns, **kwargs).start()
+
 
 def worker_rendezvous(driver_address: str, executor_id: str, partition_id: int,
                       timeout_s: float = 120.0, retry_interval_s: float = 0.25,
-                      policy=None, deadline=None) -> dict:
+                      policy=None, deadline=None, keep_alive: bool = False):
     """Worker side: register with the driver, receive (coordinator, rank, world).
     Retries with jittered backoff like ``NetworkManager.initLightGBMNetwork:195-218``,
     bounded by a ``core.resilience.Deadline`` — every connect attempt's
     timeout is capped by the remaining budget, so a hung coordinator can
     never stall a worker past ``timeout_s`` total. Retries and expiries are
-    counted on ``resilience_measures("parallel")``."""
+    counted on ``resilience_measures("parallel")``.
+
+    ``keep_alive=True`` returns ``(info, socket)`` with the rendezvous
+    connection still open — the gang-membership channel a
+    :class:`~synapseml_tpu.parallel.gang.GangWorker` runs heartbeats and
+    verdicts over for the rest of the run."""
     from ..core import observability as obs
 
     with obs.get_tracer().span("parallel.rendezvous",
@@ -106,7 +148,8 @@ def worker_rendezvous(driver_address: str, executor_id: str, partition_id: int,
         try:
             info = _worker_rendezvous(driver_address, executor_id,
                                       partition_id, timeout_s,
-                                      retry_interval_s, policy, deadline)
+                                      retry_interval_s, policy, deadline,
+                                      keep_alive)
         finally:
             # rendezvous wall time — connect retries included — is the
             # startup tax every MPMD/DP launch pays before step 0
@@ -119,7 +162,8 @@ def worker_rendezvous(driver_address: str, executor_id: str, partition_id: int,
 
 def _worker_rendezvous(driver_address: str, executor_id: str,
                        partition_id: int, timeout_s: float,
-                       retry_interval_s: float, policy, deadline) -> dict:
+                       retry_interval_s: float, policy, deadline,
+                       keep_alive: bool = False):
     from ..core.resilience import Deadline, DeadlineExpired, RetryPolicy, \
         resilience_measures
 
@@ -145,12 +189,33 @@ def _worker_rendezvous(driver_address: str, executor_id: str,
             raise TimeoutError(
                 f"rendezvous with {driver_address} failed: {last}") from last
         try:
-            with socket.create_connection((host, int(port)),
-                                          timeout=connect_timeout) as s:
+            s = socket.create_connection((host, int(port)),
+                                         timeout=connect_timeout)
+            try:
                 payload = {"host": socket.gethostname(), "executor_id": executor_id,
                            "partition_id": partition_id}
                 s.sendall((json.dumps(payload) + "\n").encode())
-                return json.loads(s.makefile("r").readline())
+                if keep_alive:
+                    # read the reply UNBUFFERED (byte-at-a-time up to the
+                    # newline): a buffered makefile could pull gang bytes
+                    # already behind the reply (e.g. an instant verdict)
+                    # into a reader this function then discards
+                    line = b""
+                    while not line.endswith(b"\n"):
+                        ch = s.recv(1)
+                        if not ch:
+                            raise OSError("rendezvous connection closed "
+                                          "before the rank reply")
+                        line += ch
+                    info = json.loads(line)
+                    s.settimeout(None)  # the gang channel blocks on reads
+                    return info, s
+                info = json.loads(s.makefile("r").readline())
+                s.close()
+                return info
+            except BaseException:
+                s.close()
+                raise
         except OSError as e:
             last = e
             wait_s = policy.backoff_ms(attempt) / 1000.0

@@ -208,8 +208,11 @@ def test_no_inline_jit_in_stage_transform():
                "scoring/planner.py", "scoring/runner.py", "scoring/sink.py",
                "registry/aot.py", "registry/autotune.py",
                # the sharding plane: placement is declarative data, never
-               # an ad-hoc jit (the trainer's jits stay estimator-time)
+               # an ad-hoc jit (the trainer's jits stay estimator-time);
+               # the gang channel is pure protocol — a jit anywhere in it
+               # would put tracing on the failure-detection path
                "parallel/partition.py", "models/pipeline_trainer.py",
+               "parallel/gang.py", "parallel/checkpoint.py",
                # the fleet control plane: reconcile/residency/admission
                # code must never acquire executables outside the shared
                # CompiledCache — a control loop that traced privately
