@@ -213,6 +213,92 @@ def _run_paged(cfg, params, requests, slots: int, trials: int = 3):
     return out
 
 
+def _run_kill_mid_decode(cfg, params, requests, slots: int):
+    """Survivable-serving arm: the same stream, but the engine is "killed"
+    at t=50% of the token budget (its KV pool abandoned, nothing exported
+    — a SIGKILL, not a drain) and every unfinished sequence resubmits to a
+    survivor engine through the crash path the RoutingFront journal uses:
+    re-prefill over prompt + already-emitted ids, emitting only NEW
+    tokens. Reports recovery latency (kill -> first resumed token) and
+    duplicate / lost token counts against an uninterrupted reference —
+    the bar for both is zero."""
+    from synapseml_tpu.models.paged_engine import PagedDecodeEngine
+
+    kw = dict(block_len=16, max_slots=slots, prefill_batch=2)
+    ref_eng = PagedDecodeEngine(cfg, params, **kw)
+    refs = ref_eng.generate([p for p, _ in requests],
+                            [n for _, n in requests])
+    ref_eng.release()
+
+    victim = PagedDecodeEngine(cfg, params, **kw)
+    seqs = [victim.submit(p, n, request_id=str(i), stream=True)
+            for i, (p, n) in enumerate(requests)]
+    by_uid = {s.uid: i for i, s in enumerate(seqs)}
+    total = sum(n for _, n in requests)
+    # every emission as (request, global token index, token id): the same
+    # monotonic chunk numbering the serving plane dedups on
+    emissions = [[] for _ in requests]
+    t0 = time.perf_counter()
+
+    def drain(events):
+        for ev in events:
+            if ev.get("token") is not None:
+                i = by_uid[ev["seq"].uid]
+                emissions[i].append((len(ev["seq"].generated) - 1,
+                                     int(ev["token"])))
+
+    emitted = 0
+    while emitted < total // 2:
+        # drain each phase separately: global index = len(generated) - 1
+        # is only correct if events are consumed before the NEXT phase
+        # appends another token (same discipline as serve_llm's dispatch)
+        drain(victim.admit())
+        drain(victim.step())
+        emitted = sum(len(e) for e in emissions)
+    t_kill = time.perf_counter()
+    unfinished = [s for s in seqs if not s.done]
+    victim.release()  # SIGKILL analog: pages gone, no export ran
+
+    survivor = PagedDecodeEngine(cfg, params, **kw)
+    moved = []
+    for s in unfinished:
+        # the front's __resume__ wire form: manifest only, no KV payload,
+        # foreign digest -> deterministic re-prefill over prompt+emitted
+        moved.append(survivor.import_sequence({"manifest": {
+            "uid": s.uid, "prompt_ids": list(s.prompt_ids),
+            "generated": list(s.generated),
+            "max_new_tokens": s.max_new_tokens, "request_id": s.request_id,
+            "stream": True, "tokens_in_pages": 0,
+            "model_digest": "crashed-worker"}}))
+    first_resumed = None
+    while any(not s.done for s in moved):
+        for phase in (survivor.admit, survivor.step):
+            events = phase()  # drain before the next phase appends tokens
+            if first_resumed is None and any(
+                    ev.get("token") is not None for ev in events):
+                first_resumed = time.perf_counter()
+            drain(events)
+    wall = time.perf_counter() - t0
+    leaked = survivor.allocator.used_count
+    survivor.release()
+
+    dup = lost = mismatched = 0
+    for i, ems in enumerate(emissions):
+        idxs = [ix for ix, _ in ems]
+        dup += len(idxs) - len(set(idxs))
+        got = [t for _, t in sorted(dict(ems).items())]
+        lost += max(len(refs[i]) - len(set(idxs)), 0)
+        if got != refs[i]:
+            mismatched += 1
+    return {"tokens_per_sec": round(total / wall, 1),
+            "recovery_ms": (round((first_resumed - t_kill) * 1e3, 1)
+                            if first_resumed else None),
+            "resumed_sequences": len(moved),
+            "duplicate_tokens": dup, "lost_tokens": lost,
+            "mismatched_sequences": mismatched,
+            "survivor_leaked_blocks": int(leaked)}
+
+
 def _continuous_ab(jax, platform):
     """Both arms in the same round on the same stream (the serving-microbatch
     A/B discipline)."""
@@ -242,6 +328,10 @@ def _continuous_ab(jax, platform):
     trials = 1 if on_tpu else 3
     rtc = _run_rtc(jax, cfg, params, requests, slots, trials=trials)
     paged = _run_paged(cfg, params, requests, slots, trials=trials)
+    # the survivable-serving arm stays off the (deadline-bound) TPU relay:
+    # recovery latency and dup/lost accounting are platform-independent
+    kill = None if on_tpu else _run_kill_mid_decode(
+        cfg, params, requests, slots)
     ladder = default_bucketer()
     return {
         "stream": {"n_requests": len(requests), "slots": slots,
@@ -257,6 +347,7 @@ def _continuous_ab(jax, platform):
             paged["token_p99_ms"] / rtc["token_p99_ms"], 3)
         if rtc["token_p99_ms"] else None,
         "decode_ladder_size": len(paged["slot_rungs"]),
+        "kill_mid_decode": kill,
     }
 
 

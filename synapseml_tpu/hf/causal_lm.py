@@ -431,11 +431,14 @@ class CausalLMServingEngine:
     def warmup(self) -> int:
         return self._engine.warmup()
 
-    def abort(self, seq):
-        return self._engine.abort(seq)
+    def abort(self, seq, reason: str = "aborted"):
+        return self._engine.abort(seq, reason=reason)
 
-    def abort_all(self):
-        return self._engine.abort_all()
+    def abort_all(self, reason: str = "aborted"):
+        return self._engine.abort_all(reason=reason)
+
+    def live_requests(self):
+        return self._engine.live_sequences()
 
     def release(self) -> None:
         self._engine.release()
@@ -444,32 +447,120 @@ class CausalLMServingEngine:
         return self._engine.stats()
 
     # -- request surface --
-    def submit(self, payload, request_id: str,
-               max_new_cap: int = 1024):
+    def _prompt_ids(self, payload) -> list:
+        if "input_ids" in payload:
+            return [int(t) for t in payload["input_ids"]]
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            raise ValueError("need 'prompt' (non-empty string) or "
+                             "'input_ids'")
+        # keep the prompt whole (up to the model horizon); the engine
+        # clamps max_new to the remaining room and reports
+        # finish_reason='length' — a large max_new_tokens must not
+        # silently truncate the prompt out from under the request
+        enc = self._tok([prompt], max_len=self._max_len - 1,
+                        multiple_of=1)
+        row_ids = np.asarray(enc["input_ids"][0])
+        row_mask = np.asarray(enc["attention_mask"][0])
+        return row_ids[row_mask > 0].tolist()
+
+    def submit(self, payload, request_id: str, max_new_cap: int = 1024,
+               deadline: float | None = None,
+               journal_key: str | None = None):
         if not isinstance(payload, dict):
             raise ValueError("body must be a JSON object with 'prompt' or "
                              "'input_ids'")
         stream = bool(payload.get("stream", False))
         max_new = int(payload.get("max_new_tokens", self._default_max_new))
         max_new = max(1, min(max_new, int(max_new_cap)))
-        if "input_ids" in payload:
-            ids = [int(t) for t in payload["input_ids"]]
-        else:
-            prompt = payload.get("prompt")
-            if not isinstance(prompt, str) or not prompt:
-                raise ValueError("need 'prompt' (non-empty string) or "
-                                 "'input_ids'")
-            # keep the prompt whole (up to the model horizon); the engine
-            # clamps max_new to the remaining room and reports
-            # finish_reason='length' — a large max_new_tokens must not
-            # silently truncate the prompt out from under the request
-            enc = self._tok([prompt], max_len=self._max_len - 1,
-                            multiple_of=1)
-            row_ids = np.asarray(enc["input_ids"][0])
-            row_mask = np.asarray(enc["attention_mask"][0])
-            ids = row_ids[row_mask > 0].tolist()
+        ids = self._prompt_ids(payload)
         return self._engine.submit(ids, max_new, request_id=request_id,
-                                   stream=stream)
+                                   stream=stream, deadline=deadline,
+                                   journal_key=journal_key)
+
+    # -- live migration surface (serve_llm drain / front resubmit) --
+    def export(self, uid: int) -> "dict | None":
+        """JSON-able snapshot of one live sequence (the engine's binary
+        npz payload rides base64) — the wire form of
+        ``PagedDecodeEngine.export_sequence``."""
+        import base64
+
+        snap = self._engine.export_sequence(uid)
+        if snap is None:
+            return None
+        return {"manifest": snap["manifest"],
+                "payload_b64": base64.b64encode(snap["payload"]).decode(),
+                "digests": snap["digests"]}
+
+    def _seed_emitted_text(self, seq) -> None:
+        # the client already received the text of every emitted token —
+        # prime the cumulative-decode cursor so the next chunk streams only
+        # the NEW delta, never a replay of the whole prefix
+        if self._decode is not None and seq.generated:
+            full = self._decode(list(seq.generated))
+            if not full.endswith("�"):
+                seq._emitted_text = full
+
+    def import_snapshot(self, obj, request_id: str,
+                        deadline: float | None = None,
+                        journal_key: str | None = None):
+        """Readmit an exported sequence under THIS worker's exchange: the
+        continuation always streams (the front owns client-facing framing)
+        and keeps the origin's uid so sampled token streams stay
+        deterministic across the migration."""
+        import base64
+
+        if not isinstance(obj, dict) or "manifest" not in obj:
+            raise ValueError("__import__ needs a snapshot with 'manifest'")
+        man = dict(obj["manifest"])
+        man["request_id"] = request_id
+        man["stream"] = True
+        if journal_key is not None:
+            man["journal_key"] = journal_key
+        if deadline is not None:
+            import time as _time
+
+            man["deadline_ms_left"] = (deadline
+                                       - _time.perf_counter()) * 1e3
+        payload = base64.b64decode(obj.get("payload_b64") or "") \
+            if obj.get("payload_b64") else (obj.get("payload") or b"")
+        seq = self._engine.import_sequence(
+            {"manifest": man, "payload": payload,
+             "digests": obj.get("digests") or {}})
+        self._seed_emitted_text(seq)
+        return seq
+
+    def resume(self, obj, request_id: str, max_new_cap: int = 1024,
+               deadline: float | None = None,
+               journal_key: str | None = None):
+        """Crash-path resubmit (no KV snapshot survived): re-tokenize the
+        original request body and re-prefill over prompt + the tokens the
+        front already relayed — token-identical under greedy, and
+        sample-identical too when the origin uid rides along."""
+        if not isinstance(obj, dict) or not isinstance(obj.get("body"),
+                                                       dict):
+            raise ValueError("__resume__ needs {'body': <original "
+                             "request>, 'emitted_ids': [...]}")
+        body = obj["body"]
+        ids = self._prompt_ids(body)
+        emitted = [int(t) for t in obj.get("emitted_ids") or []]
+        max_new = int(body.get("max_new_tokens", self._default_max_new))
+        max_new = max(1, min(max_new, int(max_new_cap)))
+        man = {"uid": int(obj["uid"]) if obj.get("uid") is not None
+               else hash(request_id) & 0x7FFFFFFF,
+               "prompt_ids": ids, "generated": emitted,
+               "max_new_tokens": max_new, "request_id": request_id,
+               "stream": True, "journal_key": journal_key,
+               "tokens_in_pages": 0}
+        if deadline is not None:
+            import time as _time
+
+            man["deadline_ms_left"] = (deadline
+                                       - _time.perf_counter()) * 1e3
+        seq = self._engine.import_sequence({"manifest": man,
+                                            "payload": b"", "digests": {}})
+        self._seed_emitted_text(seq)
+        return seq
 
     def chunk_for(self, event: dict) -> dict:
         out = {"token": event["token"]}

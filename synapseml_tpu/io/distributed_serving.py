@@ -19,10 +19,12 @@ the driver service collecting worker endpoints
 from __future__ import annotations
 
 import collections
+import hashlib
 import http.client
 import json
 import os
 import pickle
+import queue
 import random
 import socket
 import subprocess
@@ -32,6 +34,7 @@ import time
 import itertools
 import urllib.error
 import urllib.request
+import uuid
 import weakref
 from http.server import BaseHTTPRequestHandler
 
@@ -46,7 +49,7 @@ from ..fleet.residency import model_from_path as _model_of_path
 from .serving import NoDelayHTTPServer
 
 __all__ = ["WorkerRegistry", "RoutingFront", "RoutingClient",
-           "serve_pipeline_distributed", "worker_main",
+           "serve_pipeline_distributed", "worker_main", "llm_worker_main",
            "deregister_worker", "collect_distributed_trace"]
 
 
@@ -475,6 +478,200 @@ class _RequestCoalescer:
         return group
 
 
+# survivable-LLM plane: journal/migration/hedging metric handles
+_JOURNAL_METRICS = obs.HandleCache(lambda reg: {
+    "resubmits": reg.counter(
+        "synapseml_llm_resubmits_total",
+        "journaled generations resubmitted to another worker (mode: "
+        "import = adopted a migrated KV snapshot, resume = re-prefilled "
+        "over prompt + already-relayed tokens after a crash)", ("mode",)),
+    "replays": reg.counter(
+        "synapseml_llm_journal_replays_total",
+        "terminal results replayed from the front journal for a retried "
+        "idempotency key — the dedup that makes a retried non-streaming "
+        "request generate at most once").labels(),
+    "hedges": reg.counter(
+        "synapseml_llm_hedges_total",
+        "hedged generation attempts fired after a stuck prefill, by "
+        "arbitration outcome (won = the hedge produced the stream, "
+        "lost = the primary recovered first)", ("outcome",)),
+})
+
+
+class _ClientGone(Exception):
+    """The front->client socket died while relaying a journaled stream."""
+
+
+class _JournalEntry:
+    """One journaled generation: everything the RoutingFront needs to
+    splice a migrated stream or re-create a crashed one on another worker
+    without the client noticing. ``relayed`` is the next expected GLOBAL
+    token index — worker chunks carry ``seq`` (the token's global index),
+    so any chunk below ``relayed`` is a duplicate from a resume overlap
+    and is dropped before it reaches the client."""
+
+    __slots__ = ("key", "digest", "body", "client_stream", "relayed",
+                 "emitted_ids", "uid", "worker", "done", "result", "status",
+                 "mailbox", "deadline", "lock", "inflight", "winner")
+
+    def __init__(self, key: str, digest: str, body: dict,
+                 client_stream: bool, deadline: float | None):
+        self.key = key
+        self.digest = digest              # sha256 of the client body
+        self.body = body                  # original client payload
+        self.client_stream = client_stream
+        self.relayed = 0
+        self.emitted_ids: list[int] = []  # every token id relayed so far
+        self.uid = None                   # origin engine uid (sampling
+        #                                   streams fold on it)
+        self.worker = None                # endpoint currently assigned
+        self.done = False
+        self.result = None                # terminal record, replayable
+        self.status = 200
+        self.mailbox = None               # migrated KV snapshot, if any
+        self.deadline = deadline          # absolute monotonic, or None
+        self.lock = threading.Lock()
+        self.inflight = False
+        self.winner = None                # hedge arbitration: attempt id
+
+
+class _StreamJournal:
+    """Bounded per-request journal keyed by idempotency key. DONE entries
+    evict LRU-first past ``max_entries``; live entries are never evicted
+    (evicting one would orphan a client mid-stream)."""
+
+    def __init__(self, max_entries: int = 1024):
+        self._entries: "collections.OrderedDict[str, _JournalEntry]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._max = int(max_entries)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, key: str) -> "_JournalEntry | None":
+        with self._lock:
+            return self._entries.get(key)
+
+    def admit(self, key: str, digest: str, body: dict, client_stream: bool,
+              deadline: float | None):
+        """(entry, verdict) — verdict ``new`` starts a generation,
+        ``replay`` returns the recorded terminal result (retried key, same
+        prompt), ``conflict`` rejects a key that is still in flight (a
+        concurrent duplicate must not race the original's stream)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                if not e.done and e.inflight:
+                    return e, "conflict"
+                if e.digest == digest and e.done:
+                    self._entries.move_to_end(key)
+                    return e, "replay"
+                # same key, different prompt (or a dead unfinished entry):
+                # the reuse is a NEW request — replace the record
+            e = _JournalEntry(key, digest, body, client_stream, deadline)
+            self._entries[key] = e
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._max:
+                victim = next((k for k, v in self._entries.items()
+                               if v.done and k != key), None)
+                if victim is None:
+                    break
+                del self._entries[victim]
+            return e, "new"
+
+
+def _register_journal_gauge(front, instance: str) -> None:
+    """Pull-time ``synapseml_llm_journal_depth`` gauge (weakref'd like the
+    breaker gauge: a collected front silently stops exporting)."""
+    ref = weakref.ref(front)
+    reg = obs.get_registry()
+
+    def collect():
+        o = ref()
+        if o is None:
+            reg.unregister_collector(collect)
+            return
+        j = o._journal
+        if j is not None:
+            yield obs.Sample(
+                "synapseml_llm_journal_depth", {"instance": instance},
+                float(j.depth()),
+                help="journaled generations held by the routing front "
+                     "(bounded; done entries evict LRU-first)")
+
+    reg.register_collector(collect)
+
+
+class _StreamWriter:
+    """Relays worker chunks to ONE client with seq-dedup and hedge
+    arbitration. Every delivery runs under the entry lock: the first
+    attempt to land a chunk claims the stream (first-writer-wins); the
+    losing attempt is told so and closes its worker connection. Dedup is
+    by global token index, so interleaved writes from a resumed attempt
+    overlapping a dying one still reach the client exactly once, in
+    order."""
+
+    def __init__(self, handler, entry: _JournalEntry):
+        self._h = handler
+        self.entry = entry
+        self.began = False
+
+    def _begin(self) -> None:
+        h = self._h
+        h.send_response(200)
+        h.send_header("Content-Type", "application/x-ndjson")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+        self.began = True
+
+    def _write(self, obj) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        self._h.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self._h.wfile.flush()
+
+    def deliver(self, chunk: dict, attempt_id: int) -> str:
+        """'ok' | 'dup' | 'lost'; raises _ClientGone on a dead client."""
+        e = self.entry
+        with e.lock:
+            if e.winner is None:
+                e.winner = attempt_id
+            elif e.winner != attempt_id:
+                return "lost"
+            if chunk.get("uid") is not None:
+                e.uid = chunk["uid"]
+            done = bool(chunk.get("done"))
+            seq = chunk.get("seq")
+            if not done and "token" in chunk:
+                if seq is not None and seq < e.relayed:
+                    return "dup"
+                e.emitted_ids.append(chunk["token"])
+                e.relayed = (seq + 1) if seq is not None else e.relayed + 1
+            elif done:
+                if e.done:
+                    return "dup"
+                e.done = True
+                e.result = chunk
+            if e.client_stream:
+                if not self.began:
+                    self._begin()
+                try:
+                    self._write(chunk)
+                except OSError:
+                    raise _ClientGone from None
+            return "ok"
+
+    def finish_stream(self) -> None:
+        if not self.began:
+            return
+        try:
+            self._h.wfile.write(b"0\r\n\r\n")
+            self._h.wfile.flush()
+        except OSError:
+            pass
+
+
 class RoutingFront:
     """One public port; round-robin forwarding to live workers over
     PERSISTENT (keep-alive) worker connections; ``GET /routes`` returns the
@@ -523,9 +720,24 @@ class RoutingFront:
                  coalesce_window_ms: float = 0.0,
                  coalesce_max_group: int = 64,
                  admission=None,
-                 route_by_model: bool = False):
+                 route_by_model: bool = False,
+                 journal: bool = False,
+                 journal_max_entries: int = 1024,
+                 hedge_after_s: float | None = None,
+                 max_stream_attempts: int = 4):
         if workers is None and registry is None:
             raise ValueError("RoutingFront needs workers and/or a registry")
+        # survivable-LLM plane (opt in for LLM fleets): a bounded
+        # per-request journal makes every generation resumable — worker
+        # death mid-stream resubmits to a healthy worker (re-prefill over
+        # prompt + relayed tokens), a live drain splices the migrated KV
+        # snapshot in via /admin/migrate, retried idempotency keys replay
+        # the recorded terminal instead of generating twice, and a stuck
+        # prefill hedges to a second worker (first-writer-wins)
+        self._journal = (_StreamJournal(journal_max_entries)
+                         if journal else None)
+        self._hedge_after_s = hedge_after_s
+        self._max_stream_attempts = int(max_stream_attempts)
         # same-path coalescing toward bucket-sized worker batches (0 = off,
         # the latency-neutral default; enable for throughput-bound fleets)
         self._coalescer = (_RequestCoalescer(coalesce_window_ms / 1000.0,
@@ -606,6 +818,11 @@ class RoutingFront:
                     self._reply(status, json.dumps(reply).encode(),
                                 {"Content-Type": "application/json"})
                     return
+                if self.path == "/admin/migrate":  # drain handoff mailbox
+                    status, reply = front._admin_migrate(body)
+                    self._reply(status, json.dumps(reply).encode(),
+                                {"Content-Type": "application/json"})
+                    return
                 # GET-gated like io/serving.py: a POST to a pipeline path
                 # that happens to be named /metrics still forwards
                 if method == "GET" and self.path == "/metrics":
@@ -653,6 +870,13 @@ class RoutingFront:
 
             def _route_admitted(self, method: str, body, rm,
                                 model, priority) -> None:
+                if front._journal is not None and method == "POST" \
+                        and not self.path.startswith("/admin"):
+                    # survivable-LLM plane: generation requests relay
+                    # through the journal (chunk-level dedup + resubmit);
+                    # everything else falls through to plain forwarding
+                    if front._journal_route(self, body, rm, model):
+                        return
                 hdrs = {k: v for k, v in self.headers.items()
                         if k.lower() not in ("host", "connection",
                                              "traceparent")}
@@ -760,6 +984,8 @@ class RoutingFront:
         _register_breaker_gauge(self, plane="front",
                                 instance=self._instance)
         _register_split_gauge(self, self._instance)
+        if self._journal is not None:
+            _register_journal_gauge(self, self._instance)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -1066,6 +1292,353 @@ class RoutingFront:
 
         threading.Thread(target=run, daemon=True).start()
 
+    # -- survivable-LLM plane: journaled streams, migration, hedging -------
+    def _admin_migrate(self, body: bytes) -> tuple[int, dict]:
+        """Drain-handoff mailbox: a draining worker POSTs ``{"key":
+        <journal key>, "snapshot": <exported sequence>}`` here; the relay
+        loop for that key picks the snapshot up when the worker's
+        ``__migrated__`` marker arrives and resubmits it to a healthy
+        worker. A non-2xx tells the worker the handoff failed — it
+        re-imports the snapshot locally instead of dropping the request."""
+        if self._journal is None:
+            return 404, {"error": "journal disabled on this front"}
+        try:
+            payload = json.loads(body or b"{}")
+            key = payload["key"]
+            snap = payload["snapshot"]
+            if not isinstance(key, str) or not isinstance(snap, dict):
+                raise ValueError("key must be a string, snapshot an object")
+        except (ValueError, KeyError, TypeError) as e:
+            return 400, {"error": str(e)}
+        entry = self._journal.get(key)
+        if entry is None or entry.done:
+            return 404, {"error": f"no live journal entry for {key!r}"}
+        with entry.lock:
+            entry.mailbox = snap
+        return 200, {"ok": True}
+
+    def _journal_route(self, handler, body, rm, model) -> bool:
+        """Journaled relay for generation requests; False = not a
+        generation body, fall through to plain forwarding."""
+        try:
+            payload = json.loads(body or b"null")
+        except ValueError:
+            return False
+        if not isinstance(payload, dict) or \
+                ("prompt" not in payload and "input_ids" not in payload):
+            return False
+        jm = _JOURNAL_METRICS.get()
+        # idempotency key: client-supplied (retry-safe) or generated
+        key = handler.headers.get("X-Request-Key") or uuid.uuid4().hex
+        digest = hashlib.sha256(body or b"").hexdigest()
+        deadline = None
+        dl = handler.headers.get("X-Deadline-Ms")
+        if dl:
+            try:
+                deadline = time.monotonic() + float(dl) / 1e3
+            except ValueError:
+                pass
+        entry, verdict = self._journal.admit(
+            key, digest, payload, bool(payload.get("stream")), deadline)
+        if verdict == "replay":
+            jm["replays"].inc()
+            res = entry.result if entry.result is not None \
+                else {"error": "no terminal result recorded"}
+            handler._reply(entry.status, json.dumps(res).encode(),
+                           {"Content-Type": "application/json",
+                            "X-Journal-Replay": "1"})
+            return True
+        if verdict == "conflict":
+            handler._reply(409, json.dumps(
+                {"error": "request key already in flight",
+                 "key": key}).encode(),
+                {"Content-Type": "application/json"})
+            return True
+        entry.inflight = True
+        try:
+            self._journal_run(handler, entry, rm, model)
+        finally:
+            entry.inflight = False
+        return True
+
+    def _journal_run(self, handler, entry, rm, model) -> None:
+        """Attempt loop for one journaled generation: pick a worker,
+        relay its stream, and on failure/migration resubmit until the
+        terminal record lands or the attempt budget runs out."""
+        writer = _StreamWriter(handler, entry)
+        hdrs = {k: v for k, v in handler.headers.items()
+                if k.lower() not in ("host", "connection", "traceparent",
+                                     "content-length", "x-request-key",
+                                     "x-deadline-ms")}
+        obs.get_tracer().inject(hdrs)
+        attempts = 0
+        attempt_seq = 0
+        tried: set[str] = set()
+        attempt_log: list[str] = []
+        while attempts < self._max_stream_attempts:
+            if entry.deadline is not None \
+                    and time.monotonic() >= entry.deadline:
+                self._journal_terminal(handler, writer, entry, {
+                    "error": "deadline exceeded", "done": True,
+                    "finish_reason": "deadline"}, status=504)
+                return
+            candidates, _ = self._candidates(model=model)
+            # don't hand the resubmit straight back to the endpoint that
+            # just failed — unless it is the only one left
+            fresh = [w for w in candidates
+                     if f"{w.get('host')}:{w.get('port')}" not in tried]
+            pick_from = fresh or candidates
+            if not pick_from:
+                break
+            attempts += 1
+            w = pick_from[0]
+            tried.add(f"{w.get('host')}:{w.get('port')}")
+            outcome = self._run_hedged(handler.path, w, pick_from[1:],
+                                       entry, writer, hdrs, attempt_seq)
+            attempt_seq += 2  # primary + potential hedge ids
+            tag = outcome[0]
+            attempt_log.append(
+                f"{w.get('host')}:{w.get('port')}={':'.join(str(p) for p in outcome)}")
+            if tag == "done":
+                self._journal_finish(handler, writer, entry)
+                return
+            if tag == "migrated":
+                # the worker posts the snapshot to /admin/migrate BEFORE
+                # the marker, so the mailbox is nearly always filled
+                # already; the grace wait covers reordering
+                wait_until = time.monotonic() + 5.0
+                while time.monotonic() < wait_until:
+                    with entry.lock:
+                        if entry.mailbox is not None:
+                            break
+                    time.sleep(0.01)
+                # the drained worker's attempt has returned (it produced
+                # the marker): release arbitration, or the import attempt's
+                # chunks would all be rejected as hedge losers
+                with entry.lock:
+                    entry.winner = None
+                # the drained worker stays in `tried`: it self-rejects new
+                # work with its drain 503 anyway, so prefer the others
+                continue
+            if tag == "status":
+                _, status, payload = outcome
+                try:
+                    rec = json.loads(payload or b"null")
+                except ValueError:
+                    rec = {"error": payload.decode("utf-8", "replace")}
+                if not isinstance(rec, dict):
+                    rec = {"result": rec}
+                rec.setdefault("done", True)
+                self._journal_terminal(handler, writer, entry, rec, status)
+                return
+            if tag == "client_gone":
+                with entry.lock:
+                    entry.done = True
+                    entry.result = {"error": "client disconnected",
+                                    "done": True}
+                return
+            # 'failed' / 'draining': release arbitration so the next
+            # attempt may claim the stream, then rotate on
+            with entry.lock:
+                entry.winner = None
+            if tag == "failed":
+                resilience_measures("distributed_serving").count("retry")
+                rm["retries"].inc()
+        self._journal_terminal(handler, writer, entry, {
+            "error": "no worker could complete the generation",
+            "attempts": attempt_log, "done": True}, status=503)
+
+    def _run_hedged(self, path, primary, alternates, entry, writer, hdrs,
+                    base_id):
+        """One attempt, hedged: the primary streams in a thread; if no
+        first chunk lands within ``hedge_after_s`` (stuck prefill) and an
+        alternate worker exists, a second attempt races it — the first to
+        deliver a chunk wins the client stream, the loser is closed."""
+        outq: "queue.Queue" = queue.Queue()
+        first_evt = threading.Event()
+
+        def run(w, aid, evt):
+            out = self._stream_attempt(path, w, entry, writer, aid, hdrs,
+                                       evt)
+            if evt is not None:
+                evt.set()  # a fast failure must not stall the hedge gate
+            outq.put(out)
+
+        threading.Thread(target=run, args=(primary, base_id, first_evt),
+                         daemon=True).start()
+        hedged = False
+        if self._hedge_after_s is not None and alternates:
+            first_evt.wait(self._hedge_after_s)
+            if not first_evt.is_set():
+                hedged = True
+                threading.Thread(
+                    target=run, args=(alternates[0], base_id + 1, None),
+                    daemon=True).start()
+        results = []
+        terminal = None
+        while len(results) < (2 if hedged else 1):
+            out = outq.get()
+            results.append(out)
+            if out[0] in ("done", "migrated", "status", "client_gone"):
+                terminal = out
+                break
+        if hedged:
+            with entry.lock:
+                win = entry.winner
+            _JOURNAL_METRICS.get()["hedges"].inc(
+                outcome="won" if win == base_id + 1 else "lost")
+        if terminal is not None:
+            return terminal
+        for out in results:
+            if out[0] == "failed":
+                return out
+        return results[0]
+
+    def _stream_attempt(self, path, w, entry, writer, attempt_id, hdrs,
+                        first_evt):
+        """Stream one worker's attempt at a journaled generation, relaying
+        chunks through ``writer``. Returns ('done',) | ('migrated',) |
+        ('draining',) | ('status', code, payload) | ('failed', err) |
+        ('lost',) | ('client_gone',)."""
+        key = (w.get("host"), w.get("port"))
+        endpoint = f"{key[0]}:{key[1]}"
+        breaker = self._breaker(key)
+        rm = _ROUTE_METRICS.get()
+        jm = _JOURNAL_METRICS.get()
+        with entry.lock:
+            snap = entry.mailbox
+            entry.mailbox = None
+            emitted = list(entry.emitted_ids)
+            uid = entry.uid
+        if snap is not None:
+            # migrated KV pages: splice the sequence in wholesale
+            body_obj = {"__import__": snap}
+            jm["resubmits"].inc(mode="import")
+        elif emitted or uid is not None:
+            # crash path: deterministic re-prefill over prompt + relayed
+            # tokens, keeping the origin uid so sampling stays identical
+            body_obj = {"__resume__": {"body": entry.body,
+                                       "emitted_ids": emitted,
+                                       "uid": uid}}
+            jm["resubmits"].inc(mode="resume")
+        else:
+            body_obj = dict(entry.body)
+            body_obj["stream"] = True  # the front owns client framing
+        send_hdrs = dict(hdrs)
+        send_hdrs["X-Request-Key"] = entry.key
+        if entry.deadline is not None:
+            left_ms = (entry.deadline - time.monotonic()) * 1e3
+            if left_ms <= 0:
+                return ("failed", "deadline expired")
+            send_hdrs["X-Deadline-Ms"] = str(max(int(left_ms), 1))
+        conn = None
+        accepted = False  # worker took the body: the snapshot is spent
+        try:
+            try:
+                conn, _fresh = self._pool.get(key)  # fault hook fires here
+                conn.request("POST", path,
+                             body=json.dumps(body_obj).encode(),
+                             headers=send_hdrs)
+                resp = conn.getresponse()
+            except (http.client.HTTPException, OSError) as e:
+                breaker.record_failure()
+                self._pool.clear(key)
+                rm["worker_failures"].inc(worker=endpoint)
+                return ("failed", str(e))
+            if resp.status != 200:
+                payload = resp.read()
+                breaker.record_success()  # it answered: alive
+                if resp.status == 503 \
+                        and payload == b'{"error": "worker draining"}':
+                    return ("draining",)
+                return ("status", resp.status, payload)
+            accepted = True
+            breaker.record_success()
+            with entry.lock:
+                entry.worker = endpoint
+            while True:
+                try:
+                    line = resp.readline()
+                except (http.client.HTTPException, OSError,
+                        ValueError) as e:
+                    breaker.record_failure()
+                    self._pool.clear(key)
+                    rm["worker_failures"].inc(worker=endpoint)
+                    return ("failed", f"stream broke: {e}")
+                if not line:
+                    # ended without a terminal record: the worker died
+                    # between chunks
+                    breaker.record_failure()
+                    rm["worker_failures"].inc(worker=endpoint)
+                    return ("failed", "stream ended without terminal")
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    chunk = json.loads(line)
+                except ValueError:
+                    continue
+                if first_evt is not None:
+                    first_evt.set()
+                if isinstance(chunk, dict) and chunk.get("__migrated__"):
+                    return ("migrated",)
+                if isinstance(chunk, dict) and "error" in chunk \
+                        and "token" not in chunk:
+                    # worker-side terminal error (hot swap, engine
+                    # failure): resubmittable — the journal can rebuild
+                    # the sequence elsewhere
+                    return ("failed", str(chunk.get("error")))
+                try:
+                    verdict = writer.deliver(chunk, attempt_id)
+                except _ClientGone:
+                    return ("client_gone",)
+                if verdict == "lost":
+                    return ("lost",)
+                if isinstance(chunk, dict) and chunk.get("done"):
+                    return ("done",)
+        finally:
+            if snap is not None and not accepted:
+                # the worker never took the migrated snapshot (refused /
+                # unreachable): put it back so the NEXT attempt can still
+                # splice the KV pages instead of re-prefilling
+                with entry.lock:
+                    if entry.mailbox is None:
+                        entry.mailbox = snap
+            if conn is not None:
+                conn.close()
+
+    def _journal_finish(self, handler, writer, entry) -> None:
+        with entry.lock:
+            res = entry.result if entry.result is not None else {}
+            if isinstance(res, dict) \
+                    and res.get("finish_reason") == "deadline":
+                entry.status = 504
+            status = entry.status
+        if entry.client_stream:
+            writer.finish_stream()  # terminal chunk already relayed
+        else:
+            handler._reply(status, json.dumps(res).encode(),
+                           {"Content-Type": "application/json"})
+
+    def _journal_terminal(self, handler, writer, entry, record: dict,
+                          status: int) -> None:
+        """Front-originated terminal (deadline, attempt exhaustion): the
+        client ALWAYS gets a terminal reply — an error chunk + end on a
+        begun stream, a plain status reply otherwise."""
+        with entry.lock:
+            entry.done = True
+            entry.result = record
+            entry.status = status
+        if writer.began:
+            try:
+                writer._write(record)
+            except OSError:
+                pass
+            writer.finish_stream()
+        else:
+            handler._reply(status, json.dumps(record).encode(),
+                           {"Content-Type": "application/json"})
+
     def _admin_split(self, method: str, body: bytes) -> tuple[int, dict]:
         """``GET /admin/split`` reads, ``POST /admin/split`` applies
         ``{"split": {...}|null, "shadow": {"version": v, "fraction": f}
@@ -1277,6 +1850,38 @@ def worker_main(pipeline_path: str, registry_address: str,
     server.on_drained = on_drained
     print(f"worker ready {info}", flush=True)
     while True:  # killed by the parent, or exits via /admin/drain
+        time.sleep(1.0)
+
+
+def llm_worker_main(model_name: str, registry_address: str,
+                    max_new_tokens: int = 64, engine: str = "paged",
+                    warmup: bool = True) -> None:
+    """LLM decode-worker process entry: build the named causal LM, serve
+    it with the token scheduler (``serve_llm``), register with the driver
+    registry, then park. The survivable-serving chaos tests SIGKILL these
+    processes mid-decode; a drain (``/admin/drain`` with ``migrate_to``)
+    deregisters and exits cleanly instead."""
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    from ..hf import HuggingFaceCausalLM
+    from .serving import serve_llm
+
+    lm = HuggingFaceCausalLM(model_name=model_name,
+                             max_new_tokens=max_new_tokens, engine=engine)
+    server = serve_llm(lm, warmup=warmup)
+    info = {"host": server.host, "port": server.port, "pid": os.getpid()}
+    urllib.request.urlopen(urllib.request.Request(
+        registry_address, data=json.dumps(info).encode(), method="POST",
+        headers={"Content-Type": "application/json"}), timeout=30).read()
+
+    def on_drained(_report) -> None:
+        deregister_worker(registry_address, info)
+        os._exit(0)
+
+    server.on_drained = on_drained
+    print(f"llm worker ready {info}", flush=True)
+    while True:  # killed by the parent/chaos, or exits via /admin/drain
         time.sleep(1.0)
 
 

@@ -70,6 +70,16 @@ _SERVING_METRICS = obs.HandleCache(lambda reg: {
     "drains": reg.counter(
         "synapseml_serving_drains_total",
         "graceful worker drains, by outcome", ("outcome",)),
+    "migrations": reg.counter(
+        "synapseml_llm_migrations_total",
+        "live LLM sequence migrations off this worker, by reason "
+        "(drain/...) and outcome (ok = peer accepted the KV snapshot, "
+        "error = handoff failed and the sequence resumed locally)",
+        ("reason", "outcome")),
+    "migration_ms": reg.histogram(
+        "synapseml_llm_migration_ms",
+        "per-sequence live-migration latency: KV export -> peer "
+        "acceptance via the front").labels(),
 })
 
 
@@ -158,6 +168,10 @@ class _Exchange:
         self.streaming = False
         self.chunks: "queue.Queue | None" = None
         self._replied = False
+        # set by the handler when a stream write hits a dead socket: the
+        # token scheduler checks it and aborts the sequence (reason
+        # 'client_gone') instead of decoding to max_new into nothing
+        self.client_gone = False
 
     def respond(self, body, status: int = 200, headers: dict | None = None):
         if self._replied:
@@ -188,8 +202,8 @@ class _Exchange:
         self.reply_event.set()
 
     def stream_chunk(self, data) -> None:
-        if self.chunks is None:
-            return  # stream never began (or a buffered reply won the race)
+        if self.chunks is None or self.client_gone:
+            return  # stream never began (or the peer socket is dead)
         if isinstance(data, (dict, list)):
             data = (json.dumps(data) + "\n").encode()
         elif isinstance(data, str):
@@ -199,6 +213,35 @@ class _Exchange:
     def stream_end(self) -> None:
         if self.chunks is not None:
             self.chunks.put(_STREAM_END)
+
+
+def _header(headers: dict, name: str) -> str | None:
+    """Case-insensitive header lookup on a plain-dict header map."""
+    want = name.lower()
+    for k, v in headers.items():
+        if str(k).lower() == want:
+            return v
+    return None
+
+
+def _post_json(url: str, obj, timeout: float = 10.0) -> bool:
+    """Best-effort JSON POST; True iff the peer replied 2xx."""
+    import http.client
+    from urllib.parse import urlsplit
+    parts = urlsplit(url)
+    conn = http.client.HTTPConnection(parts.hostname,
+                                      parts.port or 80, timeout=timeout)
+    try:
+        body = json.dumps(obj).encode()
+        conn.request("POST", parts.path or "/", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        return 200 <= resp.status < 300
+    except OSError:
+        return False
+    finally:
+        conn.close()
 
 
 class ServingServer:
@@ -232,6 +275,13 @@ class ServingServer:
         self.draining = False
         self.on_drained = None  # fn(report: dict), called once, off-thread
         self._drain_thread: threading.Thread | None = None
+        # live-drain handoff (serve_llm): /admin/drain may name a front to
+        # migrate active sequences to; drain_barrier (set by the token
+        # scheduler) holds the drain waiter open until every live sequence
+        # has migrated or finished — streaming exchanges are NOT in
+        # _pending, so the settle loop alone would conclude too early
+        self.migrate_to: str | None = None
+        self.drain_barrier = None  # fn(budget_s), blocks until quiesced
         # handlers between their draining check and their queue insert: the
         # drain waiter must not conclude "empty" while an admission is in
         # flight (guarded by _lock)
@@ -397,17 +447,26 @@ class ServingServer:
                         self.send_header(k, v)
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
-                while True:
-                    try:
-                        chunk = ex.chunks.get(timeout=outer.reply_timeout_s)
-                    except queue.Empty:
-                        break  # stalled producer: close the stream
-                    if chunk is _STREAM_END:
-                        break
-                    if chunk:
-                        self.wfile.write(b"%x\r\n" % len(chunk) + chunk
-                                         + b"\r\n")
-                self.wfile.write(b"0\r\n\r\n")
+                try:
+                    while True:
+                        try:
+                            chunk = ex.chunks.get(
+                                timeout=outer.reply_timeout_s)
+                        except queue.Empty:
+                            break  # stalled producer: close the stream
+                        if chunk is _STREAM_END:
+                            break
+                        if chunk:
+                            self.wfile.write(b"%x\r\n" % len(chunk) + chunk
+                                             + b"\r\n")
+                            self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    # the client hung up mid-stream: flag the exchange so
+                    # the scheduler reaps the sequence (pages freed NOW)
+                    # rather than decoding the rest into a dead socket
+                    ex.client_gone = True
+                    self.close_connection = True
                 return ex.reply_status
 
             def do_GET(self):
@@ -479,12 +538,20 @@ class ServingServer:
             if not isinstance(payload, dict):
                 raise ValueError("body must be a JSON object")
             timeout_s = float(payload.get("timeout_s", 30.0))
+            migrate_to = payload.get("migrate_to")
+            if migrate_to is not None and not isinstance(migrate_to, str):
+                raise ValueError("migrate_to must be a URL string")
         except (json.JSONDecodeError, UnicodeDecodeError, TypeError,
                 ValueError) as e:
             return 400, {"error": f"bad drain body: {e}"}
         with self._lock:  # two racing drains must start ONE waiter (and
             already = self.draining  # fire on_drained once)
             self.draining = True
+            if migrate_to:
+                # live drain: the token scheduler exports active sequences
+                # and hands them to peers through this front instead of
+                # running them to completion
+                self.migrate_to = migrate_to
         backlog = self._queue.qsize()
         pending = len(self._pending)
         if not already:
@@ -502,6 +569,15 @@ class ServingServer:
         # the drain reply itself off mid-write
         time.sleep(0.1)
         deadline = time.monotonic() + max(timeout_s, 0.0)
+        barrier = self.drain_barrier
+        if barrier is not None:
+            # the token scheduler's sequences live OUTSIDE _pending (their
+            # streaming handlers already dequeued) — wait for it to migrate
+            # or finish every live sequence before declaring settled
+            try:
+                barrier(max(deadline - time.monotonic(), 0.0))
+            except Exception:  # noqa: BLE001 — a barrier bug must not
+                pass           # wedge the drain
         while time.monotonic() < deadline:
             with self._lock:
                 settled = not self._pending and not self._admitting
@@ -1066,6 +1142,7 @@ def serve_llm(stage, port: int = 0, poll_ms: float = 20.0,
         return eng
 
     open_streams: dict[str, object] = {}  # request_id -> exchange
+    state = {"engine": None}  # the drain barrier reads the live engine
 
     def dispatch(engine, events):
         for ev in events:
@@ -1074,45 +1151,138 @@ def serve_llm(stage, port: int = 0, poll_ms: float = 20.0,
             if rid is None:
                 continue
             ex = open_streams.get(rid) or server.exchange_for(rid)
-            if ex is None:
-                # handler timed out / client gone: stop decoding into a
-                # dead connection — free the pages and slot NOW
+            if ex is None or getattr(ex, "client_gone", False):
+                # handler timed out or the socket died mid-stream: stop
+                # decoding into a dead connection — free pages + slot NOW
                 if not ev["done"]:
-                    engine.abort(seq)
+                    engine.abort(seq, reason="client_gone")
+                open_streams.pop(rid, None)
                 continue
             if seq.stream:
                 if rid not in open_streams:
                     ex.stream_begin()
                     open_streams[rid] = ex
                 if ev["token"] is not None:
-                    ex.stream_chunk(engine.chunk_for(ev))
+                    ch = engine.chunk_for(ev)
+                    if isinstance(ch, dict):
+                        # monotonic per-request chunk number = the GLOBAL
+                        # token index, so a migrated/resumed continuation
+                        # keeps counting where the origin stopped and the
+                        # front's journal dedups across handoffs exactly;
+                        # uid lets a crash resubmit keep the origin's
+                        # sampling stream
+                        ch.setdefault("seq", len(seq.generated) - 1)
+                        ch.setdefault("uid", seq.uid)
+                    ex.stream_chunk(ch)
                 if ev["done"]:
-                    ex.stream_chunk(engine.result_for(seq))
+                    res = engine.result_for(seq)
+                    if isinstance(res, dict):
+                        res.setdefault("seq", len(seq.generated))
+                    ex.stream_chunk(res)
                     ex.stream_end()
                     open_streams.pop(rid, None)
             elif ev["done"]:
-                ex.respond(engine.result_for(seq))
+                # a deadline expiry is the worker's fault-containment 504,
+                # not a successful generation
+                status = (504 if ev.get("finish_reason") == "deadline"
+                          else 200)
+                ex.respond(engine.result_for(seq), status=status)
+
+    def fail_inflight(engine, err, status=503):
+        """TERMINAL replies for EVERY in-flight request when the engine
+        goes away (hot swap, device failure): live streaming exchanges get
+        an error chunk + stream end — never a silent hang to client
+        timeout — and parked buffered exchanges get an error reply."""
+        for rid, ex in list(open_streams.items()):
+            ex.stream_chunk({"error": err, "done": True})
+            ex.stream_end()
+            open_streams.pop(rid, None)
+        try:
+            doomed = engine.abort_all()
+        except Exception:  # noqa: BLE001 — a dead engine must not block
+            doomed = []    # the terminal replies
+        for seq in doomed:
+            if not seq.request_id:
+                continue
+            ex = server.exchange_for(seq.request_id)
+            if ex is None:
+                continue
+            if ex.streaming:
+                # stream_begin happened but the handler hasn't dequeued
+                # yet — terminal error rides the chunk channel
+                ex.stream_chunk({"error": err, "done": True})
+                ex.stream_end()
+            else:
+                ex.respond({"error": err}, status=status)
+
+    def migrate_out(engine):
+        """Live drain: export every front-relayed sequence and hand it to
+        a peer via the front's /admin/migrate mailbox. On handoff failure
+        the snapshot re-imports locally (the sequence finishes here under
+        the drain barrier) — a failed migration degrades to the old
+        run-to-completion drain, never to a lost request."""
+        target = server.migrate_to
+        if target is None or not hasattr(engine, "export"):
+            return
+        m = _SERVING_METRICS.get()
+        for seq in list(engine.live_requests()):
+            rid = seq.request_id
+            key = getattr(seq, "journal_key", None)
+            if rid is None or key is None or not seq.stream:
+                continue  # not front-relayed: no peer can splice its
+                #           stream — it finishes locally instead
+            t0 = time.perf_counter()
+            n_emitted = len(seq.generated)
+            snap = engine.export(seq.uid)
+            if snap is None:
+                continue
+            ok = _post_json(target.rstrip("/") + "/admin/migrate",
+                            {"key": key, "snapshot": snap})
+            if ok:
+                m["migrations"].inc(reason="drain", outcome="ok")
+                m["migration_ms"].observe((time.perf_counter() - t0) * 1e3)
+                ex = open_streams.pop(rid, None) \
+                    or server.exchange_for(rid)
+                if ex is not None:
+                    # in-band handoff marker: the front stops reading this
+                    # stream and splices the peer's continuation; seq-
+                    # numbered chunks make the cutover dup/loss-free
+                    ex.stream_chunk({"__migrated__": True,
+                                     "seq": n_emitted})
+                    ex.stream_end()
+            else:
+                m["migrations"].inc(reason="drain", outcome="error")
+                try:
+                    engine.import_snapshot(snap, rid, journal_key=key)
+                except Exception:  # noqa: BLE001 — local re-import of a
+                    pass           # just-exported snapshot
+        # non-migratable work keeps decoding while draining; the drain
+        # barrier holds on_drained until it finishes or times out
+
+    def drain_barrier(budget_s: float) -> None:
+        deadline = time.monotonic() + max(float(budget_s), 0.0)
+        while time.monotonic() < deadline:
+            eng = state["engine"]
+            if eng is None or (not eng.has_work() and not open_streams):
+                return
+            time.sleep(0.02)
+
+    server.drain_barrier = drain_barrier
 
     def loop():
         # ONE consistent snapshot: a hot-swap landing during this (long,
         # warmup-heavy) build must still trip the v != current check below
         stage0, current = holder.get()
         engine = build_engine(stage0)
+        state["engine"] = engine
         while server._running:
             try:
                 engine, current = _iterate(engine, current)
+                state["engine"] = engine
             except Exception as e:  # noqa: BLE001 — scheduler must survive
                 # an engine failure fails every in-flight request with a
                 # TERMINAL reply (never a silent stall to client timeout)
-                for rid, ex in list(open_streams.items()):
-                    ex.stream_chunk({"error": f"engine failure: {e}"})
-                    ex.stream_end()
-                    open_streams.pop(rid, None)
-                try:
-                    for seq in engine.abort_all():
-                        _reply_error(seq, f"engine failure: {e}")
-                except Exception:  # noqa: BLE001
-                    pass
+                fail_inflight(engine, f"engine failure: {e}")
                 # the failed call may have consumed the DONATED page-pool
                 # buffers mid-step, leaving the engine unusable — rebuild
                 # it rather than retrying into deleted buffers
@@ -1120,6 +1290,7 @@ def serve_llm(stage, port: int = 0, poll_ms: float = 20.0,
                     engine.release()
                     st, v = holder.get()
                     engine = build_engine(st)
+                    state["engine"] = engine
                     current = v
                 except Exception:  # noqa: BLE001 — retry next iteration
                     time.sleep(0.5)
@@ -1129,18 +1300,16 @@ def serve_llm(stage, port: int = 0, poll_ms: float = 20.0,
             if v != current:
                 # hot swap: precompile the replacement's rungs, then cut
                 # over between steps; in-flight sequences finish... they
-                # cannot — the pages live in the old engine — so they get a
-                # terminal error instead of a silent stall
+                # cannot — the pages live in the old engine — so every one
+                # of them (streaming AND buffered) gets a terminal error
+                # instead of a silent stall
                 old, engine = engine, build_engine(stage_now)
+                state["engine"] = engine
                 current = v
-                for rid, ex in list(open_streams.items()):
-                    ex.stream_chunk({"error": "pipeline hot-swapped "
-                                              "mid-generation"})
-                    ex.stream_end()
-                    open_streams.pop(rid, None)
-                for seq in old.abort_all():
-                    _reply_error(seq, "pipeline hot-swapped mid-generation")
+                fail_inflight(old, "pipeline hot-swapped mid-generation")
                 old.release()
+            if server.draining:
+                migrate_out(engine)
             busy = engine.has_work()
             # busy: drain without blocking — a 1 ms queue wait would tax
             # EVERY decode step of every active sequence; idle: block on
@@ -1161,8 +1330,35 @@ def serve_llm(stage, port: int = 0, poll_ms: float = 20.0,
                         continue
                     try:
                         payload = json.loads(body.decode() or "null")
-                        engine.submit(payload, rid,
-                                      max_new_cap=max_new_tokens_cap)
+                        deadline = None
+                        dl = _header(ex.headers, "X-Deadline-Ms")
+                        if dl is not None:
+                            # client deadline propagates front -> worker as
+                            # a remaining-budget header; the engine expires
+                            # the sequence past it (pages freed, 504)
+                            deadline = (time.perf_counter()
+                                        + float(dl) / 1e3)
+                        jkey = _header(ex.headers, "X-Request-Key")
+                        if isinstance(payload, dict) \
+                                and "__import__" in payload:
+                            # live-migration continuation: adopt the peer's
+                            # exported KV pages (or re-prefill on mismatch)
+                            engine.import_snapshot(
+                                payload["__import__"], rid,
+                                deadline=deadline, journal_key=jkey)
+                        elif isinstance(payload, dict) \
+                                and "__resume__" in payload:
+                            # crash-path resubmit from the front's journal:
+                            # re-prefill over prompt + already-relayed ids
+                            engine.resume(payload["__resume__"], rid,
+                                          max_new_cap=max_new_tokens_cap,
+                                          deadline=deadline,
+                                          journal_key=jkey)
+                        else:
+                            engine.submit(payload, rid,
+                                          max_new_cap=max_new_tokens_cap,
+                                          deadline=deadline,
+                                          journal_key=jkey)
                     except (ValueError, TypeError, KeyError, IndexError,
                             UnicodeDecodeError) as e:
                         # one malformed body is THAT client's 400, never an
@@ -1171,12 +1367,6 @@ def serve_llm(stage, port: int = 0, poll_ms: float = 20.0,
             dispatch(engine, engine.admit())
             dispatch(engine, engine.step())
             return engine, current
-
-    def _reply_error(seq, err):
-        if seq.request_id:
-            ex = server.exchange_for(seq.request_id)
-            if ex is not None:
-                ex.respond({"error": err}, status=503)
 
     threading.Thread(target=loop, daemon=True).start()
     return server

@@ -33,6 +33,8 @@ paths read the same param pytree.
 
 from __future__ import annotations
 
+import hashlib
+import io
 import threading
 import time
 from collections import deque
@@ -43,6 +45,7 @@ import numpy as np
 
 from ..core import batching as cb
 from ..core import observability as obs
+from ..core import serialization
 
 __all__ = ["BlockAllocator", "PagedDecodeEngine", "SequenceState"]
 
@@ -79,6 +82,16 @@ _ENGINE_METRICS = obs.HandleCache(lambda reg: {
         "synapseml_llm_sequences_finished_total",
         "sequences completed, by finish reason", ("reason",)),
 })
+
+
+def _npz_safe(arr: np.ndarray) -> np.ndarray:
+    """npz-writable view of one KV chunk: numpy's format cannot serialize
+    extension dtypes (bf16), so those ride as raw uint8 bytes and the
+    manifest's recorded dtype restores them on import."""
+    arr = np.ascontiguousarray(arr)
+    if np.dtype(arr.dtype).isbuiltin == 1:  # 2 = extension dtype (bf16)
+        return arr
+    return np.frombuffer(arr.tobytes(), np.uint8)
 
 
 class BlockAllocator:
@@ -144,6 +157,13 @@ class SequenceState:
     submitted_at: float = field(default_factory=time.perf_counter)
     first_token_at: float | None = None
     finish_reason: str | None = None
+    deadline: float | None = None  # perf_counter instant; past it the
+    #                                engine frees the pages and finishes
+    #                                with reason='deadline'
+    journal_key: str | None = None  # the RoutingFront's idempotency key —
+    #                                 rides exports so a drained worker's
+    #                                 handoff can find the front's journal
+    #                                 entry (worker request_ids are local)
 
     @property
     def context_ids(self) -> list:
@@ -309,11 +329,15 @@ class PagedDecodeEngine:
     # ------------------------------------------------------------------
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int,
                request_id: str | None = None, stream: bool = False,
-               uid: int | None = None) -> SequenceState:
+               uid: int | None = None, deadline: float | None = None,
+               journal_key: str | None = None) -> SequenceState:
         """Queue a tokenized prompt. ``uid`` seeds the sequence's sampling
         key stream (auto-assigned when None); offline ``transform()`` passes
         the global row offset so sampled generation is a deterministic
-        function of (seed, row), not of submission order."""
+        function of (seed, row), not of submission order. ``deadline`` is a
+        ``time.perf_counter()`` instant past which the sequence expires with
+        ``finish_reason='deadline'`` instead of holding pages for a client
+        that stopped waiting."""
         prompt_ids = [int(t) for t in prompt_ids]
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -330,7 +354,8 @@ class PagedDecodeEngine:
                 uid = self._uid
             seq = SequenceState(uid=int(uid), prompt_ids=prompt_ids,
                                 max_new_tokens=max_new,
-                                request_id=request_id, stream=stream)
+                                request_id=request_id, stream=stream,
+                                deadline=deadline, journal_key=journal_key)
             self._waiting.append(seq)
         return seq
 
@@ -394,7 +419,7 @@ class PagedDecodeEngine:
         seq-ladder bucket — compile count stays <= len(seq ladder)."""
         import jax.numpy as jnp
 
-        events: list[dict] = []
+        events: list[dict] = self.expire_deadlines()
         with self._lock:
             while self._waiting and len(self._active) < self.max_slots:
                 group: list[SequenceState] = []
@@ -481,7 +506,7 @@ class PagedDecodeEngine:
         pages immediately — the next :meth:`admit` refills the capacity."""
         import jax.numpy as jnp
 
-        events: list[dict] = []
+        events: list[dict] = self.expire_deadlines()
         with self._lock:
             if not self._active:
                 return events
@@ -621,17 +646,251 @@ class PagedDecodeEngine:
                 n += 1
         return n
 
-    def abort(self, seq: SequenceState) -> None:
+    # ------------------------------------------------------------------
+    # sequence migration (live drain / crash handoff)
+    # ------------------------------------------------------------------
+    def model_digest(self) -> str:
+        """sha256 over the param tree (leaf names, shapes, dtypes, bytes)
+        plus the generation-determinism knobs (sampling config, seed,
+        eos) — two engines with equal digests emit identical token streams
+        for the same ``(uid, prompt, generated)``, which is exactly the
+        contract :meth:`import_sequence` needs to resume a migrated
+        sequence without recompute. Computed once per engine."""
+        if getattr(self, "_model_digest_v", None) is None:
+            h = hashlib.sha256()
+            for name, leaf in sorted(
+                    serialization.flatten_pytree(self.params).items()):
+                arr = np.ascontiguousarray(np.asarray(leaf))
+                h.update(name.encode())
+                h.update(repr((arr.shape, str(arr.dtype))).encode())
+                h.update(arr.tobytes())
+            h.update(repr((self.temperature, self.top_k, self.top_p,
+                           self.seed, self.eos_id)).encode())
+            self._model_digest_v = h.hexdigest()
+        return self._model_digest_v
+
+    def export_sequence(self, uid: int) -> dict | None:
+        """Snapshot one live (active or waiting) sequence as a migratable
+        artifact and remove it from this engine (pages freed, finish
+        reason ``'migrated'``). Returns None for an unknown/finished uid.
+
+        The snapshot is self-contained and wire-friendly::
+
+            {"manifest": <JSON-able>, "payload": <npz bytes>,
+             "digests": {"payload": <sha256 hex>}}
+
+        The manifest carries the host state (prompt ids, emitted ids,
+        sampling config, model digest) plus a ``chunks`` section in the
+        PR-13 index-range format (``parallel/checkpoint.py``): per layer,
+        ``kv/{k,v}/NNN`` maps to ``{"shape", "dtype", "parts": [{"key",
+        "start", "stop"}]}`` where each part is one KV page's worth of
+        token rows and ``payload`` npz key ``c:<name>#<k>`` holds the
+        array. Ranges are TOKEN-indexed, not block-indexed, so an engine
+        with a different ``block_len`` can still scatter them. The
+        ``digests`` entry is the sha256 sidecar: import verifies it and
+        falls back to re-prefill on mismatch rather than decoding over a
+        torn payload."""
+        with self._lock:
+            seq = next((s for s in self._active if s.uid == int(uid)), None)
+            was_waiting = False
+            if seq is None:
+                seq = next((s for s in self._waiting
+                            if s.uid == int(uid)), None)
+                if seq is None:
+                    return None
+                was_waiting = True
+            T = 0 if was_waiting else int(seq.tokens_in_pages)
+            manifest: dict = {
+                "version": 1,
+                "uid": int(seq.uid),
+                "prompt_ids": [int(t) for t in seq.prompt_ids],
+                "generated": [int(t) for t in seq.generated],
+                "max_new_tokens": int(seq.max_new_tokens),
+                "request_id": seq.request_id,
+                "stream": bool(seq.stream),
+                "preemptions": int(seq.preemptions),
+                "tokens_in_pages": T,
+                "journal_key": seq.journal_key,
+                # deadlines are perf_counter instants, meaningless across
+                # processes — ship the REMAINING budget instead
+                "deadline_ms_left": (
+                    None if seq.deadline is None
+                    else (seq.deadline - time.perf_counter()) * 1e3),
+                "sampling": {"temperature": self.temperature,
+                             "top_k": self.top_k, "top_p": self.top_p,
+                             "seed": self.seed, "eos_id": self.eos_id},
+                "model_digest": self.model_digest(),
+                "chunks": {},
+            }
+            payload: dict[str, np.ndarray] = {}
+            if T > 0:
+                rows = np.asarray(seq.blocks, np.int64)
+                for axis, pool in (("k", self._k_pages),
+                                   ("v", self._v_pages)):
+                    for L, pages in enumerate(pool):
+                        name = f"kv/{axis}/{L:03d}"
+                        kvh, hd = int(pages.shape[2]), int(pages.shape[3])
+                        flat = np.asarray(pages[rows]).reshape(
+                            -1, kvh, hd)[:T]
+                        parts = []
+                        for k in range(len(seq.blocks)):
+                            start = k * self.block_len
+                            stop = min(start + self.block_len, T)
+                            if start >= stop:
+                                break
+                            key = f"c:{name}#{k}"
+                            payload[key] = _npz_safe(flat[start:stop])
+                            parts.append({"key": key,
+                                          "start": [start, 0, 0],
+                                          "stop": [stop, kvh, hd]})
+                        manifest["chunks"][name] = {
+                            "shape": [T, kvh, hd],
+                            "dtype": str(flat.dtype),
+                            "parts": parts}
+            buf = io.BytesIO()
+            np.savez(buf, **payload)
+            blob = buf.getvalue()
+            if was_waiting:
+                self._waiting.remove(seq)
+            self._finish(seq, "migrated")
+            return {"manifest": manifest, "payload": blob,
+                    "digests": {
+                        "payload": hashlib.sha256(blob).hexdigest()}}
+
+    def import_sequence(self, snapshot: dict) -> SequenceState:
+        """Readmit an exported sequence. Fast path: verify the model
+        digest and the payload's sha256 sidecar, allocate pages, scatter
+        the KV chunks in, and resume decode with ZERO recompute. On digest
+        mismatch, sidecar mismatch, torn chunks, slot pressure, or page
+        exhaustion: deterministic re-prefill over prompt+generated (the
+        PR-6 preemption path — token-identical under greedy). Either way
+        the next ``admit()``/``step()`` emits only NEW tokens; previously
+        emitted ids ride in ``generated`` and are never re-surfaced."""
+        import jax.numpy as jnp
+
+        man = snapshot["manifest"]
+        blob = snapshot.get("payload") or b""
+        want = (snapshot.get("digests") or {}).get("payload")
+        intact = man.get("model_digest") == self.model_digest()
+        if intact and want is not None \
+                and hashlib.sha256(blob).hexdigest() != want:
+            intact = False  # torn payload: recompute, never decode garbage
+        T = int(man.get("tokens_in_pages") or 0)
+        left = man.get("deadline_ms_left")
+        seq = SequenceState(
+            uid=int(man["uid"]),
+            prompt_ids=[int(t) for t in man["prompt_ids"]],
+            max_new_tokens=int(man["max_new_tokens"]),
+            request_id=man.get("request_id"),
+            stream=bool(man.get("stream")),
+            generated=[int(t) for t in man.get("generated") or []],
+            preemptions=int(man.get("preemptions") or 0),
+            journal_key=man.get("journal_key"),
+            deadline=(None if left is None
+                      else time.perf_counter() + float(left) / 1e3))
+        if seq.generated:
+            # ttft was observed at the origin engine; don't double-count
+            seq.first_token_at = time.perf_counter()
+
+        def _fallback():
+            seq.tokens_in_pages = 0
+            seq.preemptions += 1
+            self._waiting.appendleft(seq)
+            _ENGINE_METRICS.get()["preempted"].inc()
+            return seq
+
+        with self._lock:
+            self._uid = max(self._uid, seq.uid)
+            # invariant of an active sequence: pages hold every context
+            # token except the newest generated one (which rides as the
+            # next decode step's input token)
+            resumable = (intact and T > 0 and seq.generated
+                         and T == len(seq.context_ids) - 1
+                         and len(self._active) < self.max_slots
+                         and T < self.max_len)
+            if not resumable:
+                return _fallback()
+            blocks = self.allocator.alloc(self._blocks_for(T))
+            if blocks is None:
+                return _fallback()  # import-side page exhaustion
+            try:
+                data = np.load(io.BytesIO(blob), allow_pickle=False)
+                for axis in ("k", "v"):
+                    pool = self._k_pages if axis == "k" else self._v_pages
+                    new_pool = []
+                    for L, pages in enumerate(pool):
+                        name = f"kv/{axis}/{L:03d}"
+                        entry = man["chunks"][name]
+                        kvh, hd = int(pages.shape[2]), int(pages.shape[3])
+                        dt = np.dtype(entry["dtype"])
+                        staged = np.zeros(
+                            (len(blocks) * self.block_len, kvh, hd), dt)
+                        for part in entry["parts"]:
+                            arr = np.asarray(data[part["key"]])
+                            if arr.dtype == np.uint8 and dt != np.uint8:
+                                arr = np.frombuffer(arr.tobytes(), dt)
+                            lo, hi = part["start"][0], part["stop"][0]
+                            staged[lo:hi] = arr.reshape(hi - lo, kvh, hd)
+                        staged = staged.reshape(
+                            len(blocks), self.block_len, kvh, hd)
+                        new_pool.append(pages.at[jnp.asarray(blocks)].set(
+                            jnp.asarray(staged)))
+                    if axis == "k":
+                        self._k_pages = tuple(new_pool)
+                    else:
+                        self._v_pages = tuple(new_pool)
+            except Exception:
+                # torn/incomplete chunk set — the freed blocks may hold
+                # partial writes, but pages are only read below a live
+                # sequence's seq_len and every (re-)prefill overwrites its
+                # pages first, so stale rows can never leak into attention
+                self.allocator.free(blocks)
+                return _fallback()
+            seq.blocks = list(blocks)
+            seq.tokens_in_pages = T
+            self._active.append(seq)
+            self._update_pool_gauges()
+            return seq
+
+    def live_sequences(self) -> list[SequenceState]:
+        """Every active + waiting sequence (a consistent snapshot) — the
+        drain path iterates this to export each one."""
+        with self._lock:
+            return list(self._active) + list(self._waiting)
+
+    def expire_deadlines(self, now: float | None = None) -> list[dict]:
+        """Finish every sequence whose client deadline has passed (pages
+        freed immediately, ``finish_reason='deadline'``); returns terminal
+        events for the serving layer to 504. Runs at the top of every
+        :meth:`admit`/:meth:`step`, so an expired sequence never costs
+        another device step."""
+        now = time.perf_counter() if now is None else now
+        events: list[dict] = []
+        with self._lock:
+            doomed = [s for s in self._active
+                      if s.deadline is not None and now >= s.deadline]
+            doomed += [s for s in self._waiting
+                       if s.deadline is not None and now >= s.deadline]
+            for seq in doomed:
+                if seq in self._waiting:
+                    self._waiting.remove(seq)
+                self._finish(seq, "deadline")
+                events.append({"seq": seq, "token": None, "done": True,
+                               "finish_reason": "deadline"})
+        return events
+
+    def abort(self, seq: SequenceState, reason: str = "aborted") -> None:
         """Terminate one sequence (client gone / stream broken), freeing its
         pages and slot immediately so dead connections cannot pin decode
-        capacity."""
+        capacity. ``reason`` distinguishes ``'client_gone'`` (disconnect
+        reaping) from a generic ``'aborted'`` in the finished counter."""
         with self._lock:
             if not seq.done:
                 if seq in self._waiting:
                     self._waiting.remove(seq)
-                self._finish(seq, "aborted")
+                self._finish(seq, reason)
 
-    def abort_all(self) -> list[SequenceState]:
+    def abort_all(self, reason: str = "aborted") -> list[SequenceState]:
         """Terminate every waiting and active sequence (reason
         ``'aborted'``), freeing all pages — the hot-swap path drains the
         outgoing engine through this so no request stalls silently."""
@@ -640,7 +899,7 @@ class PagedDecodeEngine:
             self._waiting.clear()
             for seq in doomed:
                 if not seq.done:
-                    self._finish(seq, "aborted")
+                    self._finish(seq, reason)
             return doomed
 
     def stats(self) -> dict:

@@ -373,3 +373,86 @@ def test_wrapper_namespaces_cover_reference_families():
                    "recommendation", "nn", "isolationforest", "cyber",
                    "services", "causal"):
         assert expect in names, f"missing wrapper namespace {expect}"
+
+
+def test_exchange_paths_reach_terminal_reply():
+    """Static guard for the survivable-serving plane: every function in
+    io/serving.py and io/distributed_serving.py that ACQUIRES an
+    ``_Exchange`` (constructs one or looks one up via ``exchange_for``)
+    must contain a terminal-reply operation — ``respond``/``stream_end``
+    (or a raw ``send_response``), or delegate to the audited
+    ``fail_inflight`` helper — and any ``except`` handler in such a
+    function that touches the exchange must terminally reply, re-raise,
+    or bail the iteration. A dropped exchange is a client blocked to full
+    timeout; this makes that regression fail at commit time instead of in
+    a chaos run."""
+    import ast
+
+    TERMINAL_ATTRS = {"respond", "stream_end", "send_response"}
+    TERMINAL_FUNCS = {"fail_inflight"}
+
+    def own_nodes(fn):
+        # nodes of fn itself, nested function defs excluded (each nested
+        # def is audited as its own scope)
+        out, stack = [], list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            out.append(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def is_acquisition(node):
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        return (isinstance(f, ast.Attribute) and f.attr == "exchange_for") \
+            or (isinstance(f, ast.Name) and f.id == "_Exchange")
+
+    def is_terminal(node):
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in TERMINAL_ATTRS:
+            return True
+        return isinstance(f, ast.Name) and f.id in TERMINAL_FUNCS
+
+    pkg = pathlib.Path(st.__file__).parent
+    offenders = []
+    for rel in ("io/serving.py", "io/distributed_serving.py"):
+        tree = ast.parse((pkg / rel).read_text())
+        for fn in [n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            nodes = own_nodes(fn)
+            acquired = [n for n in nodes if is_acquisition(n)]
+            if not acquired or fn.name == "exchange_for":
+                continue
+            bound = set()
+            for n in nodes:
+                if isinstance(n, ast.Assign) and any(
+                        is_acquisition(c) for c in ast.walk(n.value)):
+                    bound |= {t.id for t in n.targets
+                              if isinstance(t, ast.Name)}
+            if not any(is_terminal(n) for n in nodes):
+                offenders.append(
+                    f"{rel}:{fn.lineno} {fn.name}: acquires an _Exchange "
+                    f"but never reaches respond/stream_end/fail_inflight")
+            # a swallowed exception that references the exchange must still
+            # terminally reply (or re-raise / bail the loop iteration)
+            for n in nodes:
+                if not isinstance(n, ast.Try):
+                    continue
+                for handler in n.handlers:
+                    hnodes = [x for b in handler.body for x in ast.walk(b)]
+                    touches = any(isinstance(x, ast.Name) and x.id in bound
+                                  for x in hnodes)
+                    safe = any(is_terminal(x) for x in hnodes) or any(
+                        isinstance(x, (ast.Raise, ast.Continue))
+                        for x in hnodes)
+                    if touches and not safe:
+                        offenders.append(
+                            f"{rel}:{handler.lineno} {fn.name}: except "
+                            f"handler touches an _Exchange without a "
+                            f"terminal reply or re-raise")
+    assert not offenders, "dropped-exchange paths:\n" + "\n".join(offenders)

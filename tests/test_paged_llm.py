@@ -684,3 +684,680 @@ def test_dropped_exchange_gets_terminal_504():
         assert stale.reply_status == 504
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# survivable serving: live KV migration, journaled streams, deadlines
+# ---------------------------------------------------------------------------
+
+def _prom_value(name: str, label_sub: str = "") -> float:
+    """Current value of one metric series from the process registry's
+    exposition text (0.0 when the series does not exist yet)."""
+    from synapseml_tpu.core.observability import prometheus_exposition
+
+    for line in prometheus_exposition()[0].decode().splitlines():
+        if line.startswith("#"):
+            continue
+        if line.startswith(name) and label_sub in line:
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def _run_to_done(eng, seq):
+    """Drive admit/step until ``seq`` finishes; returns its token ids."""
+    deadline = time.perf_counter() + 120
+    while not seq.done and time.perf_counter() < deadline:
+        eng.admit()
+        eng.step()
+    assert seq.done, "sequence never finished"
+    return list(seq.generated)
+
+
+def test_export_import_greedy_token_identity(tiny_lm):
+    """The tentpole contract: a sequence exported mid-decode and imported
+    on a SECOND engine (same params) finishes with exactly the tokens the
+    unmigrated run produces — and both allocators account to zero."""
+    cfg, params = tiny_lm
+    rng = np.random.default_rng(21)
+    prompt = rng.integers(2, cfg.vocab_size, (11,)).tolist()
+    max_new = 12
+    reference = _dense_greedy(cfg, params, prompt, max_new)
+
+    src = PagedDecodeEngine(cfg, params, block_len=8, max_slots=2)
+    dst = PagedDecodeEngine(cfg, params, block_len=8, max_slots=2)
+    try:
+        seq = src.submit(prompt, max_new, request_id="mig", stream=True)
+        while len(seq.generated) < 4:  # decode a few tokens on the source
+            src.admit()
+            src.step()
+        snap = src.export_sequence(seq.uid)
+        assert snap is not None
+        assert src.allocator.used_count == 0, "export leaked source pages"
+        assert snap["manifest"]["model_digest"] == dst.model_digest()
+        moved = dst.import_sequence(snap)
+        assert list(moved.generated) == list(seq.generated)
+        got = _run_to_done(dst, moved)
+        assert got == reference, "migrated decode diverged from unmigrated"
+        assert dst.allocator.used_count == 0, "import leaked dest pages"
+    finally:
+        src.release()
+        dst.release()
+
+
+def test_import_digest_mismatch_falls_back_to_reprefill(tiny_lm):
+    """A snapshot whose model digest does not match the importing engine
+    must NOT splice foreign KV pages in — it re-prefills over
+    prompt + emitted instead, which is still token-identical under
+    greedy."""
+    cfg, params = tiny_lm
+    rng = np.random.default_rng(22)
+    prompt = rng.integers(2, cfg.vocab_size, (9,)).tolist()
+    max_new = 10
+    reference = _dense_greedy(cfg, params, prompt, max_new)
+    src = PagedDecodeEngine(cfg, params, block_len=8, max_slots=2)
+    dst = PagedDecodeEngine(cfg, params, block_len=8, max_slots=2)
+    try:
+        seq = src.submit(prompt, max_new, request_id="mig2", stream=True)
+        while len(seq.generated) < 3:
+            src.admit()
+            src.step()
+        snap = src.export_sequence(seq.uid)
+        snap["manifest"]["model_digest"] = "not-the-same-model"
+        preempt0 = _prom_value("synapseml_llm_slots_preempted_total")
+        moved = dst.import_sequence(snap)
+        assert moved.tokens_in_pages == 0, "mismatched digest spliced KV"
+        assert _run_to_done(dst, moved) == reference
+        assert _prom_value("synapseml_llm_slots_preempted_total") > preempt0
+        assert dst.allocator.used_count == 0
+    finally:
+        src.release()
+        dst.release()
+
+
+def test_export_import_sampled_identity(tiny_lm):
+    """Sampling folds (seed, uid, step): a migrated SAMPLED sequence keeps
+    its uid, so the continuation draws the same tokens the unmigrated run
+    draws on an engine with the same seed."""
+    cfg, params = tiny_lm
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(2, cfg.vocab_size, (9,)).tolist()
+    kw = dict(block_len=8, max_slots=2, temperature=0.9, top_p=0.95, seed=5)
+    ref_eng = PagedDecodeEngine(cfg, params, **kw)
+    src = PagedDecodeEngine(cfg, params, **kw)
+    dst = PagedDecodeEngine(cfg, params, **kw)
+    try:
+        reference = ref_eng.generate([prompt], 10, uids=[77])[0]
+        seq = src.submit(prompt, 10, request_id="smp", stream=True, uid=77)
+        while len(seq.generated) < 4:
+            src.admit()
+            src.step()
+        moved = dst.import_sequence(src.export_sequence(seq.uid))
+        assert moved.uid == 77
+        assert _run_to_done(dst, moved) == reference
+    finally:
+        ref_eng.release()
+        src.release()
+        dst.release()
+
+
+def test_deadline_expires_sequence_with_504(tiny_lm):
+    """A client deadline propagates as ``X-Deadline-Ms`` and the engine
+    expires the sequence: pages freed, terminal 504 with
+    ``finish_reason=deadline``."""
+    import http.client
+
+    from synapseml_tpu.hf import HuggingFaceCausalLM
+    from synapseml_tpu.io.serving import serve_llm
+
+    lm = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=64,
+                             engine="paged")
+    srv = serve_llm(lm, warmup=False)
+    try:
+        host, port = srv.address.split("//")[1].split(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=60)
+        conn.request("POST", "/",
+                     body=json.dumps({"prompt": "too slow"}).encode(),
+                     headers={"X-Deadline-Ms": "1"})
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        conn.close()
+        assert r.status == 504
+        assert body["finish_reason"] == "deadline"
+        assert _prom_value("synapseml_llm_sequences_finished_total",
+                           'reason="deadline"') >= 1
+    finally:
+        srv.stop()
+
+
+def test_client_disconnect_reaps_sequence():
+    """Satellite: a client that walks away after 3 chunks must not leave
+    the sequence decoding to max_new while holding KV pages — the dead
+    exchange is detected and the sequence aborts with
+    ``finish_reason=client_gone``."""
+    import socket
+
+    from synapseml_tpu.hf import HuggingFaceCausalLM
+    from synapseml_tpu.io.serving import serve_llm
+
+    lm = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=64,
+                             engine="paged")
+    srv = serve_llm(lm, warmup=False)
+    try:
+        host, port = srv.address.split("//")[1].split(":")
+        before = _prom_value("synapseml_llm_sequences_finished_total",
+                             'reason="client_gone"')
+        raw = socket.create_connection((host, int(port)), timeout=60)
+        payload = json.dumps({"prompt": "walk away", "stream": True,
+                              "max_new_tokens": 500}).encode()
+        raw.sendall(b"POST / HTTP/1.1\r\nHost: t\r\nContent-Length: "
+                    + str(len(payload)).encode() + b"\r\n\r\n" + payload)
+        got = b""
+        while got.count(b"\n") < 10:  # headers + ~3 chunks
+            got += raw.recv(4096)
+        raw.close()  # client gone, sequence still decoding
+        deadline = time.perf_counter() + 60
+        while time.perf_counter() < deadline:
+            if _prom_value("synapseml_llm_sequences_finished_total",
+                           'reason="client_gone"') > before:
+                break
+            time.sleep(0.2)
+        assert _prom_value("synapseml_llm_sequences_finished_total",
+                           'reason="client_gone"') > before, \
+            "disconnected client's sequence was never reaped"
+    finally:
+        srv.stop()
+
+
+def test_hot_swap_terminates_live_streams():
+    """Satellite: a hot swap must send a TERMINAL error chunk to every
+    live streaming exchange of the replaced engine — never a silent hang
+    to client timeout."""
+    import http.client
+    import threading
+
+    from synapseml_tpu.hf import HuggingFaceCausalLM
+    from synapseml_tpu.io.serving import PipelineHolder, serve_llm
+
+    lm_a = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=500,
+                               engine="paged")
+    lm_b = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=4,
+                               engine="paged")
+    holder = PipelineHolder(lm_a, "v1")
+    srv = serve_llm(holder, warmup=False)
+    try:
+        host, port = srv.address.split("//")[1].split(":")
+        out = {}
+
+        def run():
+            conn = http.client.HTTPConnection(host, int(port), timeout=120)
+            conn.request("POST", "/", body=json.dumps(
+                {"prompt": "long running", "stream": True,
+                 "max_new_tokens": 500}).encode())
+            r = conn.getresponse()
+            out["chunks"] = [json.loads(l) for l in iter(r.readline, b"")
+                             if l.strip()]
+            conn.close()
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.perf_counter() + 60
+        while time.perf_counter() < deadline:  # wait for live decode
+            if _prom_value("synapseml_llm_kv_block_occupancy") > 0:
+                break
+            time.sleep(0.1)
+        holder.swap(lm_b, "v2")
+        t.join(90)
+        assert not t.is_alive(), "stream hung through the hot swap"
+        chunks = out["chunks"]
+        assert chunks, "no chunks before the swap terminal"
+        last = chunks[-1]
+        assert last.get("done") and "error" in last, \
+            f"expected terminal error chunk, got {last}"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# journaled streams through the RoutingFront (survivable serving plane)
+# ---------------------------------------------------------------------------
+
+def _start_llm_worker(max_new=64, warmup=False):
+    from synapseml_tpu.hf import HuggingFaceCausalLM
+    from synapseml_tpu.io.serving import serve_llm
+
+    lm = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=max_new,
+                             engine="paged")
+    return serve_llm(lm, warmup=warmup)
+
+
+def _request(address, payload, headers=None, timeout=120, path="/"):
+    """POST ``payload`` and collect the reply: non-stream -> (status,
+    body-dict, headers); stream -> (status, [chunk, ...], headers)."""
+    import http.client
+
+    host, port = address.split("//")[1].split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request("POST", path, body=json.dumps(payload).encode(),
+                 headers=headers or {})
+    r = conn.getresponse()
+    try:
+        if payload.get("stream"):
+            out = [json.loads(l) for l in iter(r.readline, b"") if l.strip()]
+        else:
+            out = json.loads(r.read() or b"null")
+    finally:
+        conn.close()
+    return r.status, out, dict(r.getheaders())
+
+
+def _assert_contiguous_seqs(chunks):
+    """Zero duplicate + zero lost tokens: token chunks carry seq 0..n-1
+    with no gaps or repeats, terminal carries seq == n."""
+    toks = [c for c in chunks if "token" in c and not c.get("done")]
+    seqs = [c["seq"] for c in toks]
+    assert seqs == list(range(len(seqs))), f"dup/lost chunk seqs: {seqs}"
+    term = chunks[-1]
+    assert term.get("done"), f"stream not terminated: {term}"
+    assert "error" not in term, f"terminal error: {term}"
+    assert term["seq"] == len(seqs)
+    return [c["token"] for c in toks]
+
+
+def test_front_journal_stream_seq_and_terminal_dedup():
+    """Layer-3 contract: journaled streams number every chunk, and a
+    retried non-streaming request with the same idempotency key replays
+    the recorded terminal instead of generating twice."""
+    from synapseml_tpu.io.distributed_serving import RoutingFront
+
+    srv = _start_llm_worker()
+    front = RoutingFront([{"host": srv.host, "port": srv.port, "pid": 1}],
+                         timeout_s=60, journal=True)
+    try:
+        prompt = {"input_ids": [5, 9, 17, 4], "max_new_tokens": 6}
+        st, chunks, _ = _request(front.address, dict(prompt, stream=True),
+                                 headers={"X-Request-Key": "k-stream"})
+        assert st == 200
+        ids = _assert_contiguous_seqs(chunks)
+        assert len(ids) == 6
+
+        replays0 = _prom_value("synapseml_llm_journal_replays_total")
+        st1, body1, h1 = _request(front.address, prompt,
+                                  headers={"X-Request-Key": "k-once"})
+        st2, body2, h2 = _request(front.address, prompt,
+                                  headers={"X-Request-Key": "k-once"})
+        assert st1 == st2 == 200
+        assert body1["output_ids"] == body2["output_ids"] == ids
+        assert h1.get("X-Journal-Replay") is None
+        assert h2.get("X-Journal-Replay") == "1"
+        assert _prom_value("synapseml_llm_journal_replays_total") \
+            == replays0 + 1
+        assert _prom_value("synapseml_llm_journal_depth") >= 1
+    finally:
+        front.close()
+        srv.stop()
+
+
+def test_front_hedges_stuck_prefill_first_writer_wins():
+    """Layer-4: a prefill with no first token within the hedging budget
+    races a second worker; the client sees one winner's stream,
+    token-identical and well before the slow path clears."""
+    from synapseml_tpu.core.faults import FaultPlan, FaultSpec, inject_faults
+    from synapseml_tpu.io.distributed_serving import RoutingFront
+
+    srv_a = _start_llm_worker()
+    srv_b = _start_llm_worker()
+    front = RoutingFront([{"host": srv_a.host, "port": srv_a.port, "pid": 1},
+                          {"host": srv_b.host, "port": srv_b.port, "pid": 2}],
+                         timeout_s=60, journal=True, hedge_after_s=1.0)
+    payload = {"input_ids": [3, 11, 7], "max_new_tokens": 5, "stream": True}
+    try:
+        # warm both workers so decode speed, not compile, dominates timing
+        for srv in (srv_a, srv_b):
+            st, ref, _ = _request(srv.address, payload)
+            assert st == 200
+        ref_ids = _assert_contiguous_seqs(ref)
+
+        won0 = _prom_value("synapseml_llm_hedges_total", 'outcome="won"')
+        # no match filter: whichever worker the rotation picks as PRIMARY
+        # eats the one-shot stall; the hedge connect (second) is clean
+        plan = FaultPlan([FaultSpec(kind="latency", latency_ms=8000,
+                                    times=1,
+                                    planes=("distributed_serving",))],
+                         seed=7)
+        with inject_faults(plan):
+            t0 = time.perf_counter()
+            st, chunks, _ = _request(front.address, payload,
+                                     headers={"X-Request-Key": "k-hedge"})
+            took = time.perf_counter() - t0
+        assert st == 200
+        assert _assert_contiguous_seqs(chunks) == ref_ids
+        assert len(plan.injected) == 1, "latency fault never fired"
+        assert took < 7.0, f"hedge never cut the slow path short ({took:.1f}s)"
+        assert _prom_value("synapseml_llm_hedges_total",
+                           'outcome="won"') == won0 + 1
+    finally:
+        front.close()
+        srv_a.stop()
+        srv_b.stop()
+
+
+@pytest.mark.chaos
+def test_llmchaos_connection_faults_streams_all_terminate():
+    """Satellite chaos scenario: seeded connection faults between front
+    and decode worker during streaming — every exchange terminates with a
+    complete, greedy-identical generation and the fault log reconciles
+    with what clients observed (zero error terminals, zero dup chunks)."""
+    import threading
+
+    from synapseml_tpu.core.faults import FaultPlan, FaultSpec, inject_faults
+    from synapseml_tpu.io.distributed_serving import RoutingFront
+
+    srv_a = _start_llm_worker()
+    srv_b = _start_llm_worker()
+    front = RoutingFront([{"host": srv_a.host, "port": srv_a.port, "pid": 1},
+                          {"host": srv_b.host, "port": srv_b.port, "pid": 2}],
+                         timeout_s=60, journal=True)
+    n_streams, n_faults = 6, 4
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(2, 200, (5,)).tolist() for _ in range(n_streams)]
+    try:
+        refs = []
+        for p in prompts:  # references + warmup, direct to one worker
+            st, chunks, _ = _request(srv_b.address,
+                                     {"input_ids": p, "max_new_tokens": 8,
+                                      "stream": True})
+            assert st == 200
+            refs.append(_assert_contiguous_seqs(chunks))
+
+        import urllib.request
+
+        def _retry_count():
+            with urllib.request.urlopen(front.address + "/stats",
+                                        timeout=10) as r:
+                return json.loads(r.read())["resilience"]["retry_count"]
+
+        plan = FaultPlan([FaultSpec(kind="connection_error",
+                                    match=f":{srv_a.port}", times=n_faults,
+                                    planes=("distributed_serving",))],
+                         seed=13)
+        retries0 = _retry_count()
+        results = [None] * n_streams
+
+        def run(i):
+            results[i] = _request(
+                front.address,
+                {"input_ids": prompts[i], "max_new_tokens": 8,
+                 "stream": True},
+                headers={"X-Request-Key": f"k-chaos-{i}"})
+
+        with inject_faults(plan):
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(n_streams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+        assert all(t is not None for t in results), "a client never returned"
+        # counters reconcile with client-observed outcomes: at least one
+        # fault fired (the breaker may shield A after the first, which IS
+        # the containment working) and every one became a front-side
+        # retry, never a client-visible failure
+        assert len(plan.injected) >= 1, "no fault ever fired"
+        assert _retry_count() - retries0 >= len(plan.injected)
+        for i, (st, chunks, _) in enumerate(results):
+            assert st == 200
+            assert _assert_contiguous_seqs(chunks) == refs[i], \
+                f"stream {i} diverged after faulted rerouting"
+    finally:
+        front.close()
+        srv_a.stop()
+        srv_b.stop()
+
+
+@pytest.mark.chaos
+def test_live_drain_migrates_every_active_sequence():
+    """The acceptance bar for layer 2: /admin/drain with a migrate_to
+    front hands EVERY active sequence to a peer — migrations ok == active
+    count, zero client-visible errors, and each migrated stream is
+    byte-equal (token ids AND text deltas) to an unmigrated run."""
+    import threading
+    import urllib.request
+
+    from synapseml_tpu.io.distributed_serving import RoutingFront, \
+        WorkerRegistry
+
+    srv_a = _start_llm_worker()
+    srv_b = _start_llm_worker()
+    registry = WorkerRegistry()
+    front = RoutingFront(registry=registry, timeout_s=60, journal=True)
+    n_streams, max_new = 3, 24
+    rng = np.random.default_rng(33)
+    prompts = [rng.integers(2, 200, (6,)).tolist() for _ in range(n_streams)]
+    try:
+        refs = []
+        for p in prompts:  # unmigrated references; also warms BOTH workers
+            ref_by_worker = []
+            for srv in (srv_a, srv_b):
+                st, chunks, _ = _request(
+                    srv.address, {"input_ids": p, "max_new_tokens": max_new,
+                                  "stream": True})
+                assert st == 200
+                ref_by_worker.append(chunks)
+            a, b = ref_by_worker
+            assert [c.get("token") for c in a] == \
+                [c.get("token") for c in b], "workers disagree undrained"
+            refs.append(a)
+
+        # only A registered: all streams land there
+        urllib.request.urlopen(urllib.request.Request(
+            registry.address + "/register",
+            data=json.dumps({"host": srv_a.host, "port": srv_a.port,
+                             "pid": 1}).encode(), method="POST"),
+            timeout=10).read()
+
+        results = [None] * n_streams
+        progress = [0] * n_streams
+
+        def run(i):
+            import http.client
+
+            host, port = front.address.split("//")[1].split(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=120)
+            conn.request("POST", "/", body=json.dumps(
+                {"input_ids": prompts[i], "max_new_tokens": max_new,
+                 "stream": True}).encode(),
+                headers={"X-Request-Key": f"k-drain-{i}"})
+            r = conn.getresponse()
+            chunks = []
+            for line in iter(r.readline, b""):
+                if line.strip():
+                    chunks.append(json.loads(line))
+                    progress[i] = len(chunks)
+            conn.close()
+            results[i] = (r.status, chunks)
+
+        mig0 = _prom_value("synapseml_llm_migrations_total", 'outcome="ok"')
+        err0 = _prom_value("synapseml_llm_migrations_total",
+                           'outcome="error"')
+        imp0 = _prom_value("synapseml_llm_resubmits_total", 'mode="import"')
+        res0 = _prom_value("synapseml_llm_resubmits_total", 'mode="resume"')
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n_streams)]
+        for t in threads:
+            t.start()
+        deadline = time.perf_counter() + 60
+        while time.perf_counter() < deadline:  # all streams mid-decode on A
+            if all(p >= 2 for p in progress):
+                break
+            time.sleep(0.05)
+        assert all(p >= 2 for p in progress), "streams never got going"
+
+        # peer B joins, then A live-drains: every active sequence must move
+        urllib.request.urlopen(urllib.request.Request(
+            registry.address + "/register",
+            data=json.dumps({"host": srv_b.host, "port": srv_b.port,
+                             "pid": 2}).encode(), method="POST"),
+            timeout=10).read()
+        st, body, _ = _request(srv_a.address,
+                               {"migrate_to": front.address},
+                               path="/admin/drain")
+        assert st < 300, body
+        for t in threads:
+            t.join(120)
+        assert all(r is not None for r in results), "a stream never finished"
+
+        for i, (st, chunks) in enumerate(results):
+            assert st == 200
+            got = _assert_contiguous_seqs(chunks)
+            want = _assert_contiguous_seqs(list(refs[i]))
+            assert got == want, f"stream {i} tokens diverged after migration"
+            text = "".join(c.get("text") or "" for c in chunks)
+            ref_text = "".join(c.get("text") or "" for c in refs[i])
+            assert text == ref_text, f"stream {i} text not byte-equal"
+        assert _prom_value("synapseml_llm_migrations_total",
+                           'outcome="ok"') == mig0 + n_streams
+        assert _prom_value("synapseml_llm_migrations_total",
+                           'outcome="error"') == err0
+        assert _prom_value("synapseml_llm_resubmits_total",
+                           'mode="import"') == imp0 + n_streams
+        # the KV splice itself served every stream: no re-prefill fallback
+        assert _prom_value("synapseml_llm_resubmits_total",
+                           'mode="resume"') == res0
+    finally:
+        front.close()
+        registry.close()
+        srv_a.stop()
+        srv_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL acceptance: crash-transparent decode across real worker processes
+# ---------------------------------------------------------------------------
+
+def _worker_metric(address, name):
+    import urllib.request
+
+    with urllib.request.urlopen(address + "/metrics", timeout=10) as r:
+        for line in r.read().decode().splitlines():
+            if line.startswith(name):
+                return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+@pytest.mark.chaos(timeout_s=480)
+def test_sigkill_one_of_two_workers_mid_decode_16_streams():
+    """THE chaos acceptance bar: SIGKILL 1 of 2 decode-worker PROCESSES
+    with 16 concurrent streams in flight. Every client still receives a
+    complete generation, greedy-token-identical to an uninterrupted
+    single-worker reference, with zero duplicate chunks; the survivor
+    ends with zero KV pages in use (allocator accounting exact)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import threading
+
+    from synapseml_tpu.io.distributed_serving import RoutingFront, \
+        WorkerRegistry
+
+    registry = WorkerRegistry()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root, env.get("PYTHONPATH", "")])
+    code = ("from synapseml_tpu.io.distributed_serving import "
+            "llm_worker_main; "
+            f"llm_worker_main('llama-tiny', "
+            f"{registry.address + '/register'!r}, max_new_tokens=64)")
+    procs = [subprocess.Popen([sys.executable, "-c", code], env=env)
+             for _ in range(2)]
+    front = None
+    n_streams, max_new = 16, 24
+    rng = np.random.default_rng(35)
+    prompts = [rng.integers(2, 200, (6,)).tolist() for _ in range(n_streams)]
+    try:
+        workers = registry.wait_for(2, timeout_s=240)
+        by_pid = {w["pid"]: w for w in workers}
+        victim = procs[0]
+        survivor_info = next(w for w in workers
+                             if w["pid"] != victim.pid)
+        survivor_addr = f"http://{survivor_info['host']}:" \
+                        f"{survivor_info['port']}"
+        assert victim.pid in by_pid, "victim worker never registered"
+
+        # uninterrupted single-worker reference (greedy): ask the SURVIVOR
+        # directly; this also warms its prefill/decode executables
+        refs = []
+        for p in prompts:
+            st, body, _ = _request(survivor_addr,
+                                   {"input_ids": p,
+                                    "max_new_tokens": max_new}, timeout=240)
+            assert st == 200, body
+            refs.append(body["output_ids"])
+
+        front = RoutingFront(registry=registry, timeout_s=60, journal=True)
+        results = [None] * n_streams
+        progress = [0] * n_streams
+
+        def run(i):
+            import http.client
+
+            host, port = front.address.split("//")[1].split(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=300)
+            conn.request("POST", "/", body=json.dumps(
+                {"input_ids": prompts[i], "max_new_tokens": max_new,
+                 "stream": True}).encode(),
+                headers={"X-Request-Key": f"k-kill-{i}"})
+            r = conn.getresponse()
+            chunks = []
+            for line in iter(r.readline, b""):
+                if line.strip():
+                    chunks.append(json.loads(line))
+                    progress[i] = len(chunks)
+            conn.close()
+            results[i] = (r.status, chunks)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n_streams)]
+        for t in threads:
+            t.start()
+        deadline = time.perf_counter() + 120
+        while time.perf_counter() < deadline:  # decode genuinely in flight
+            if sum(progress) >= 2 * n_streams:
+                break
+            time.sleep(0.02)
+        assert sum(progress) >= 2 * n_streams, "streams never got going"
+        os.kill(victim.pid, signal.SIGKILL)  # mid-decode, no goodbye
+        victim.wait(30)
+        for t in threads:
+            t.join(240)
+        assert all(r is not None for r in results), "a client hung forever"
+
+        for i, (st, chunks) in enumerate(results):
+            assert st == 200
+            got = _assert_contiguous_seqs(chunks)  # zero dup / zero lost
+            want = refs[i]
+            assert got == want, \
+                f"stream {i}: crash recovery diverged from reference"
+
+        # the survivor must hold ZERO kv pages once every stream is done
+        deadline = time.perf_counter() + 30
+        occ = None
+        while time.perf_counter() < deadline:
+            occ = _worker_metric(survivor_addr,
+                                 "synapseml_llm_kv_block_occupancy")
+            if occ == 0.0:
+                break
+            time.sleep(0.25)
+        assert occ == 0.0, f"survivor leaked KV pages (occupancy={occ})"
+        # the front actually exercised the crash path
+        assert _prom_value("synapseml_llm_resubmits_total") > 0
+    finally:
+        if front is not None:
+            front.close()
+        registry.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(30)
