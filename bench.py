@@ -108,6 +108,11 @@ CONFIGS = [
     # every scale-up worker; host-driven (workers force CPU), honest on
     # the fallback
     ("fleet-elastic", "fleet_elastic", 360, 360),
+    # retrieval-serve A/B: 2-worker shard fan-out through the RoutingFront
+    # vs in-process brute force over the SAME published shard bytes, then
+    # a live delta ingest — recall@10 >= 0.99, served QPS >= 0.9x brute,
+    # fresh docs queryable with zero downtime; workers force CPU
+    ("retrieval-serve", "retrieval_serve", 300, 300),
     ("flagship", None, 420, 360),
     ("vit", "vit_finetune", 450, 300),
 ]

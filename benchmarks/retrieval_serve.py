@@ -1,0 +1,254 @@
+"""Retrieval serving A/B: sharded fan-out serve vs in-process brute force.
+
+The ISSUE-18 acceptance measurement, both arms over the SAME corpus and
+query workload in the same round:
+
+  (a) brute — one in-process [N, D] matrix scored through the shared
+      ``retrieval/scorer.py`` kernel (the exact-search speed-of-light on
+      this host; its top-10 ids are also the recall truth set);
+  (b) served — the corpus built into a multi-shard index, published to a
+      registry, and served by 2 subprocess workers (each advertising half
+      the shards) behind a ``RoutingFront`` ``/retrieval/<index>``
+      fan-out; the client POSTs the same query batches over HTTP.
+
+Embeddings are integer-valued hash-trick vectors, so distances are exact
+in float32 and recall@10 compares true id lists, not approximations.
+After the serve A/B, the continual-ingest leg logs fresh documents
+through the flywheel ``RequestLogger``, runs ``ingest_deltas``, and
+measures (i) the reported log-to-publish freshness lag and (ii) the wall
+from publish to the FIRST fan-out answer containing a fresh doc — with
+every poll required to answer 200 (the zero-downtime contract).
+
+Gates: recall@10 >= 0.99, served QPS >= 0.9x brute force (the fan-out
+parallelism must at least pay for the HTTP hop), fresh docs queryable
+with zero downtime and full coverage (no partials while both workers
+live). Workers force ``JAX_PLATFORMS=cpu``; the brute arm runs on the
+session backend (on the CPU fallback both arms are CPU — an honest A/B).
+Prints one JSON line.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+N_DOCS = 300_000       # large enough that per-shard scoring, not the
+DIM = 128              # per-request HTTP/JSON hop, dominates the serve arm
+N_FILES = 4            # corpus files -> source shards -> index shards
+QUERY_BATCH = 64
+N_REQUESTS = 8
+K = 10
+N_FRESH = 64
+
+
+def _texts(n, start=0):
+    return [f"doc{start + i} alpha{i % 11} beta{i % 29} gamma{i % 97}"
+            for i in range(n)]
+
+
+def _write_corpus(directory, texts):
+    os.makedirs(directory, exist_ok=True)
+    per = (len(texts) + N_FILES - 1) // N_FILES
+    for f_i in range(N_FILES):
+        with open(os.path.join(directory, f"corpus-{f_i:03d}.jsonl"),
+                  "w") as f:
+            for i in range(f_i * per, min((f_i + 1) * per, len(texts))):
+                f.write(json.dumps({"id": i, "text": texts[i]}) + "\n")
+
+
+def _post(url, payload, timeout=120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _spawn_worker(store, reg_url, shards):
+    code = ("import synapseml_tpu.retrieval.serve as s\n"
+            f"s.retrieval_worker_main({store!r}, 'docs', {reg_url!r}, "
+            f"shards={shards!r}, refresh_s=0.2)\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(Path(__file__).parent.parent))
+    return subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT)
+
+
+def _brute_arm(E, ids, batches):
+    """In-process exact search through the shared kernel: per-request
+    top-k over the ONE full-corpus shard, with the plane's (distance, id)
+    tie-break. Returns (qps, truth id lists per request)."""
+    from synapseml_tpu.retrieval import score_batches
+
+    x_sq = np.sum(E * E, axis=1, dtype=np.float32)
+    score_batches(batches[0], E, K, x_sq=x_sq)  # warm the ladder
+    truth = []
+    t0 = time.perf_counter()
+    for Q in batches:
+        dist, idx = score_batches(Q, E, K, x_sq=x_sq)
+        rows = []
+        for i in range(len(Q)):
+            order = sorted(zip(dist[i], idx[i]),
+                           key=lambda t: (t[0], ids[t[1]]))
+            rows.append([int(ids[j]) for _, j in order])
+        truth.append(rows)
+    wall = time.perf_counter() - t0
+    return len(batches) * len(batches[0]) / wall, truth
+
+
+def run(jax, platform, n_chips):
+    from synapseml_tpu.continual import RequestLogger
+    from synapseml_tpu.io.distributed_serving import (RoutingFront,
+                                                      WorkerRegistry)
+    from synapseml_tpu.registry import ModelRegistry
+    from synapseml_tpu.data.source import ShardedSource
+    from synapseml_tpu.retrieval import (HashEmbedder, build_index,
+                                         ingest_deltas)
+
+    directory = tempfile.mkdtemp(prefix="synapseml_retrieval_serve_")
+    store = os.path.join(directory, "store")
+    texts = _texts(N_DOCS)
+    emb = HashEmbedder(dim=DIM)
+    procs, front, wreg = [], None, None
+    try:
+        _write_corpus(os.path.join(directory, "corpus"), texts)
+        registry = ModelRegistry(store)
+        t0 = time.perf_counter()
+        published, _report = build_index(
+            registry, "docs", HashEmbedder(dim=DIM),
+            ShardedSource.jsonl(os.path.join(directory, "corpus",
+                                             "*.jsonl")),
+            os.path.join(directory, "build"), k=K, batch_rows=2048)
+        build_s = time.perf_counter() - t0
+        resolved = registry.resolve("docs", "latest")
+        roster = [s["name"] for s in
+                  resolved.manifest["extra"]["retrieval"]["shards"]]
+
+        # the brute-force corpus matrix comes back OUT of the published
+        # shards — one embed pass total, and the arms provably score the
+        # same bytes
+        from synapseml_tpu.retrieval import list_shards
+        committed = list_shards(os.path.join(resolved.path, "shards"))
+        E = np.concatenate([s.vectors() for s in committed])
+        ids = np.concatenate([s.ids() for s in committed])
+        rs = np.random.default_rng(0)
+        batches = [E[rs.integers(0, N_DOCS, size=QUERY_BATCH)]
+                   for _ in range(N_REQUESTS)]
+
+        brute_qps, truth = _brute_arm(E, ids, batches)
+
+        wreg = WorkerRegistry()
+        front = RoutingFront(registry=wreg)
+        reg_url = wreg.address + "/register"
+        half = (len(roster) + 1) // 2
+        procs = [_spawn_worker(store, reg_url, roster[:half]),
+                 _spawn_worker(store, reg_url, roster[half:])]
+        wreg.wait_for(2, timeout_s=180)
+        url = front.address + "/retrieval/docs"
+        _post(url, {"queries": batches[0][:4].tolist(), "k": K})  # warm
+
+        hits = total = 0
+        t0 = time.perf_counter()
+        for r_i, Q in enumerate(batches):
+            status, reply, hdrs = _post(url, {"queries": Q.tolist(),
+                                              "k": K})
+            assert status == 200 and not reply["missing"]
+            for got, want in zip(reply["matches"], truth[r_i]):
+                hits += len(set(m["id"] for m in got) & set(want))
+                total += K
+        serve_wall = time.perf_counter() - t0
+        served_qps = N_REQUESTS * QUERY_BATCH / serve_wall
+        recall = hits / total
+
+        # --- continual ingest: freshness + zero-downtime ------------------
+        fresh = [f"freshdoc{i} delta{i} live" for i in range(N_FRESH)]
+        with RequestLogger(os.path.join(directory, "logs"),
+                           shard_rows=32) as lg:
+            for t in fresh:
+                lg.log(method="POST", path="/ingest/docs",
+                       body=json.dumps({"doc": t}).encode(), reply=b"ok",
+                       status=200, latency_ms=1.0)
+            lg.flush()
+        t_pub = time.perf_counter()
+        report = ingest_deltas(registry, "docs",
+                               os.path.join(directory, "logs"),
+                               HashEmbedder(dim=DIM),
+                               os.path.join(directory, "ingest"))
+        probe = np.asarray(emb.embed([fresh[3]]), np.float32)[0].tolist()
+        want_id = N_DOCS + 3
+        serve_lag_s = None
+        downtime_free = True
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                status, reply, hdrs = _post(url, {"query": probe, "k": 3})
+            except Exception:  # noqa: BLE001 — any failed poll = downtime
+                downtime_free = False
+                break
+            if status != 200:
+                downtime_free = False
+                break
+            top = reply["matches"][0]
+            if (top and top[0]["id"] == want_id and not reply["missing"]):
+                serve_lag_s = time.perf_counter() - t_pub
+                break
+            time.sleep(0.1)
+
+        result = {
+            "metric": "retrieval-serve QPS (2-worker shard fan-out, "
+                      f"{N_DOCS} docs x {DIM}d, k={K})",
+            "value": round(served_qps, 1),
+            "unit": "queries/s", "lower_is_better": False,
+            "platform": "cpu host (workers force CPU; brute arm on "
+                        f"{platform})",
+            "brute_force_qps": round(brute_qps, 1),
+            "qps_vs_brute": round(served_qps / brute_qps, 3),
+            "recall_at_10": round(recall, 5),
+            "index": {"docs": N_DOCS, "dim": DIM, "shards": len(roster),
+                      "build_s": round(build_s, 2),
+                      "version": published.version},
+            "ingest": {"docs": N_FRESH,
+                       "version": report["version"],
+                       "freshness_lag_s": round(
+                           report["freshness_lag_s"], 2),
+                       "publish_to_queryable_s": (
+                           round(serve_lag_s, 2)
+                           if serve_lag_s is not None else None)},
+            "bars": {
+                "recall_at_10_geq_0_99": recall >= 0.99,
+                "qps_geq_0_9x_brute": served_qps >= 0.9 * brute_qps,
+                "fresh_docs_queryable": serve_lag_s is not None,
+                "zero_downtime_through_swap": downtime_free,
+            },
+        }
+        return result
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if front is not None:
+            front.close()
+        if wreg is not None:
+            wreg.close()
+        shutil.rmtree(directory, ignore_errors=True)
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    print(json.dumps(run(jax, platform, n_chips)))
+
+
+if __name__ == "__main__":
+    main()

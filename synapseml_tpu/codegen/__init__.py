@@ -185,6 +185,10 @@ _NAMESPACE_MAP = {
     "image": "opencv",
     "models": "dl",
     "io": "io",
+    # retrieval STAGES wrap beside the KNN family (they share the scorer
+    # kernel); the package's full surface rides the retrieval passthrough
+    # below — a same-named wrapper module would collide with it
+    "retrieval": "nn",
 }
 
 # module-granular overrides where the reference splits one of our packages
@@ -203,6 +207,7 @@ _PASSTHROUGH_NAMESPACES = {
     "continual": "synapseml_tpu.continual",
     "fleet": "synapseml_tpu.fleet",
     "registry": "synapseml_tpu.registry",
+    "retrieval": "synapseml_tpu.retrieval",
     "scoring": "synapseml_tpu.scoring",
 }
 

@@ -199,3 +199,93 @@ class KNNModel(WrapperBase):
     def getValues(self):
         return self._get('values')
 
+
+class HashEmbedder(WrapperBase):
+    """Deterministic feature-hashing text embedder (pure numpy, zero model (wraps ``synapseml_tpu.retrieval.build.HashEmbedder``)."""
+
+    _target = 'synapseml_tpu.retrieval.build.HashEmbedder'
+
+    def setDim(self, value):
+        return self._set('dim', value)
+
+    def getDim(self):
+        return self._get('dim')
+
+    def setNormalize(self, value):
+        return self._set('normalize', value)
+
+    def getNormalize(self):
+        return self._get('normalize')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setSeed(self, value):
+        return self._set('seed', value)
+
+    def getSeed(self):
+        return self._get('seed')
+
+    def setTextCol(self, value):
+        return self._set('text_col', value)
+
+    def getTextCol(self):
+        return self._get('text_col')
+
+
+class VectorIndexModel(WrapperBase):
+    """Top-k search over a roster of immutable :class:`IndexShard`s. (wraps ``synapseml_tpu.retrieval.model.VectorIndexModel``)."""
+
+    _target = 'synapseml_tpu.retrieval.model.VectorIndexModel'
+
+    def setDim(self, value):
+        return self._set('dim', value)
+
+    def getDim(self):
+        return self._get('dim')
+
+    def setIndexName(self, value):
+        return self._set('index_name', value)
+
+    def getIndexName(self):
+        return self._get('index_name')
+
+    def setInlineShards(self, value):
+        return self._set('inline_shards', value)
+
+    def getInlineShards(self):
+        return self._get('inline_shards')
+
+    def setK(self, value):
+        return self._set('k', value)
+
+    def getK(self):
+        return self._get('k')
+
+    def setMetric(self, value):
+        return self._set('metric', value)
+
+    def getMetric(self):
+        return self._get('metric')
+
+    def setOutputCol(self, value):
+        return self._set('output_col', value)
+
+    def getOutputCol(self):
+        return self._get('output_col')
+
+    def setQueryBatch(self, value):
+        return self._set('query_batch', value)
+
+    def getQueryBatch(self):
+        return self._get('query_batch')
+
+    def setShardNames(self, value):
+        return self._set('shard_names', value)
+
+    def getShardNames(self):
+        return self._get('shard_names')
+

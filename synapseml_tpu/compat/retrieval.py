@@ -1,0 +1,53 @@
+"""Generated passthrough namespace — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers).
+Re-exports the public surface of ``synapseml_tpu.retrieval`` so the compat layer covers
+non-stage subsystems too (compat coverage is drift-tested).
+"""
+
+
+from synapseml_tpu.retrieval import (  # noqa: F401
+    HashEmbedder,
+    INF,
+    IndexShard,
+    SHARD_MANIFEST,
+    VectorIndexModel,
+    build_index,
+    compact_index,
+    embed_corpus,
+    extract_documents,
+    index_model_for,
+    ingest_deltas,
+    list_shards,
+    open_shard,
+    publish_index,
+    retrieval_metrics,
+    retrieval_worker_main,
+    score_batches,
+    score_shard,
+    shards_from_parts,
+    write_shard,
+)
+
+__all__ = [
+    'HashEmbedder',
+    'INF',
+    'IndexShard',
+    'SHARD_MANIFEST',
+    'VectorIndexModel',
+    'build_index',
+    'compact_index',
+    'embed_corpus',
+    'extract_documents',
+    'index_model_for',
+    'ingest_deltas',
+    'list_shards',
+    'open_shard',
+    'publish_index',
+    'retrieval_metrics',
+    'retrieval_worker_main',
+    'score_batches',
+    'score_shard',
+    'shards_from_parts',
+    'write_shard',
+]

@@ -207,6 +207,11 @@ def load_stage(path: str):
             with open(target + ".pkl", "rb") as f:
                 value = pickle.load(f)
         stage.set(**{name: value})
+    # where this stage was loaded FROM: stages whose artifact carries
+    # sidecar trees next to metadata.json (retrieval index shards,
+    # published via ``ModelRegistry.publish(extra_tree=...)``) resolve
+    # them lazily through this attribute
+    stage._artifact_dir = os.path.abspath(path)
     if hasattr(stage, "_post_load"):
         stage._post_load()
     return stage

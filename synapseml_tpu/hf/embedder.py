@@ -51,7 +51,9 @@ class HuggingFaceSentenceEmbedder(Transformer):
     output_col = Param("output_col", "embedding column", default="embeddings")
     pooling = Param("pooling", "mean | cls", default="mean",
                     validator=lambda v: v in ("mean", "cls"))
-    normalize = Param("normalize", "L2-normalize embeddings", default=True,
+    normalize = Param("normalize", "L2-normalize embeddings (opt in for "
+                      "cosine indexes; raw pooled vectors by default so "
+                      "callers stop re-normalizing per batch)", default=False,
                       converter=TypeConverters.to_bool)
     max_token_len = Param("max_token_len", "truncation length", default=128,
                           converter=TypeConverters.to_int)

@@ -823,6 +823,15 @@ class RoutingFront:
                     self._reply(status, json.dumps(reply).encode(),
                                 {"Content-Type": "application/json"})
                     return
+                if method == "POST" and self.path.startswith("/retrieval/"):
+                    # retrieval plane: shard fan-out + top-k merge AT the
+                    # front (a /m/<index> request would land on ONE holder;
+                    # /retrieval/<index> queries every shard's holder)
+                    status, reply, hdrs = front._retrieval_fanout(
+                        self.path, body)
+                    hdrs["Content-Type"] = "application/json"
+                    self._reply(status, json.dumps(reply).encode(), hdrs)
+                    return
                 # GET-gated like io/serving.py: a POST to a pipeline path
                 # that happens to be named /metrics still forwards
                 if method == "GET" and self.path == "/metrics":
@@ -1107,6 +1116,141 @@ class RoutingFront:
                 rm["real_rows"].inc(n, version=version)
                 rm["padded_rows"].inc(bucket - n, version=version)
             return group.candidates, group.desperate
+
+    # -- retrieval plane: shard fan-out + global top-k merge ---------------
+    def _retrieval_fanout(self, path: str, body) -> tuple[int, dict, dict]:
+        """``POST /retrieval/<index>`` with ``{"queries": [[...], ...],
+        "k": 10}``: fan the query batch to the workers ADVERTISING each of
+        the index's shards (registration ``shards`` lists), score
+        per-shard top-k in parallel over the pooled keep-alive
+        connections, and merge into global top-k at the front.
+
+        Degradation contract: shards with no reachable holder are SKIPPED
+        and named in the ``X-Retrieval-Partial`` response header — a
+        partial result with explicit provenance, never a 500 (recall-proxy
+        coverage lands in ``synapseml_retrieval_shard_coverage``). A
+        worker failure mid-fan-out trips its breaker (same any-failure
+        semantics as routed traffic) and retries its shards once on
+        another advertising holder before degrading."""
+        from ..retrieval.metrics import retrieval_metrics
+
+        index = path.split("?", 1)[0].split("/")[2] if len(
+            path.split("/")) >= 3 else ""
+        if not index:
+            return 404, {"error": "path must be /retrieval/<index>"}, {}
+        try:
+            req = json.loads(body) if body else {}
+        except (ValueError, TypeError):
+            return 400, {"error": "body must be JSON"}, {}
+        queries = req.get("queries")
+        if queries is None and "query" in req:
+            queries = [req["query"]]
+        if not queries:
+            return 400, {"error": "body needs 'queries' or 'query'"}, {}
+        k = int(req.get("k") or 10)
+        holders = [w for w in self._table()
+                   if _hosts_model(w, index) and w.get("shards")]
+        if not holders:
+            return 503, {"error": f"no workers advertise index "
+                                  f"{index!r} shards"}, {}
+        # the EXPECTED shard set is the union of advertisements (a downed
+        # worker's registration persists until deregister/reap, so its
+        # shards stay expected — that is what makes the result honestly
+        # partial instead of silently narrower)
+        expected = sorted({s for w in holders for s in w["shards"]})
+        avail = [w for w in holders
+                 if self._breaker((w.get("host"), w.get("port"))).available()]
+        plan: dict[tuple, list[str]] = {}
+        by_key = {}
+        missing = []
+        for shard in expected:
+            cands = [w for w in avail if shard in w["shards"]]
+            if not cands:
+                missing.append(shard)
+                continue
+            w = min(cands, key=lambda c: len(
+                plan.get((c.get("host"), c.get("port")), ())))
+            key = (w.get("host"), w.get("port"))
+            plan.setdefault(key, []).append(shard)
+            by_key[key] = w
+        t0 = time.perf_counter()
+        merged: list[list] = [[] for _ in queries]
+        scored: list[str] = []
+        lock = threading.Lock()
+
+        def _ask(key, shard_names) -> list[str]:
+            """One worker's sub-query; returns the shards it FAILED."""
+            breaker = self._breaker(key)
+            payload = json.dumps({"queries": queries, "k": k,
+                                  "shards": shard_names}).encode()
+            try:
+                status, raw = _pooled_request(
+                    self._pool, key, "POST", f"/m/{index}", payload,
+                    {"Content-Type": "application/json"})
+                if status != 200:
+                    raise ConnectionError(f"worker {key} -> {status}")
+                reply = json.loads(raw)
+                matches = reply["matches"]
+            except Exception:  # noqa: BLE001 — any failure = these shards
+                breaker.record_failure()
+                return list(shard_names)
+            breaker.record_success()
+            with lock:
+                scored.extend(shard_names)
+                for i, row in enumerate(matches):
+                    merged[i].extend(row)
+            return []
+
+        def _fan(assignments) -> list[str]:
+            failed: list[list[str]] = [[] for _ in assignments]
+
+            def run(i, key, names):
+                failed[i] = _ask(key, names)
+
+            threads = [threading.Thread(target=run, args=(i, key, names))
+                       for i, (key, names) in enumerate(assignments)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return [s for f in failed for s in f]
+
+        lost = _fan(list(plan.items()))
+        if lost:
+            # one failover round: reassign a failed worker's shards to any
+            # OTHER still-available advertising holder
+            retry: dict[tuple, list[str]] = {}
+            still = []
+            for shard in lost:
+                cands = [w for w in holders
+                         if shard in w["shards"]
+                         and self._breaker((w.get("host"),
+                                            w.get("port"))).available()]
+                if not cands:
+                    still.append(shard)
+                    continue
+                w = min(cands, key=lambda c: len(
+                    retry.get((c.get("host"), c.get("port")), ())))
+                retry.setdefault((w.get("host"), w.get("port")),
+                                 []).append(shard)
+            still += _fan(list(retry.items()))
+            missing += still
+        for i, row in enumerate(merged):
+            row.sort(key=lambda m: (m.get("distance", 0.0), m.get("id", 0)))
+            merged[i] = row[:k]
+        missing = sorted(set(missing))
+        m = retrieval_metrics()
+        m["merge_ms"].observe((time.perf_counter() - t0) * 1000.0,
+                              index=index)
+        m["coverage"].observe(
+            len(set(scored)) / max(len(expected), 1), index=index)
+        hdrs = {}
+        if missing:
+            m["partial"].inc(index=index)
+            hdrs["X-Retrieval-Partial"] = ",".join(missing)
+        reply = {"matches": merged, "k": k, "shards": sorted(set(scored)),
+                 "missing": missing}
+        return 200, reply, hdrs
 
     # -- deployment plane: canary splits, shadow traffic, version stats ----
     def set_traffic_split(self, split: dict[str, float] | None) -> None:

@@ -9,6 +9,12 @@ TPU design: squared L2 distance decomposes as |q|^2 - 2 q·x + |x|^2, so the
 hot loop is ONE [Q, N] matmul (MXU) + top_k; queries stream through in fixed
 padded batches so every batch reuses the same executable. Conditional
 filtering is a mask added to the distance matrix, not a tree walk.
+
+Scoring is the SHARED per-shard kernel in ``retrieval/scorer.py`` (the
+retrieval serving plane's engine) — seed KNN and the sharded
+``VectorIndexModel`` cannot drift, and because the index matrix is a traced
+ARGUMENT there, swapping a model's ``index`` param never leaves stale
+executables behind (nothing instance-specific is captured).
 """
 
 from __future__ import annotations
@@ -20,10 +26,10 @@ from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Estimator, Model
 from ..core.utils import stack_vector_column as _stack_features
+from ..retrieval.scorer import INF as _INF
+from ..retrieval.scorer import score_batches as _score_shard
 
 __all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]
-
-_INF = np.float32(3.0e38)
 
 
 class _KNNBase(Estimator):
@@ -63,43 +69,6 @@ class KNNModel(Model):
     query_batch = Param("query_batch", "padded query rows per device batch",
                         default=256, converter=TypeConverters.to_int)
 
-    _CACHE_KEYS = frozenset({"index", "k"})
-
-    def set(self, **kw):
-        out = super().set(**kw)
-        if self._CACHE_KEYS & kw.keys():
-            cb.invalidate_token(self)  # cached executables captured old index
-        return out
-
-    def _topk_fn(self, bucket: int, conditional: bool):
-        """Per-query-bucket top-k executable via the CompiledCache (one
-        compile per ladder rung, not per distinct query-batch size)."""
-        def build():
-            import jax
-            import jax.numpy as jnp
-
-            X = jnp.asarray(self.get("index"))           # [N, D]
-            x_sq = jnp.sum(X * X, axis=1)                # [N]
-            k = min(self.get("k"), X.shape[0])
-
-            def fn(Q, mask_bias=None):
-                # [Q, N] squared distances via one MXU matmul
-                d = (jnp.sum(Q * Q, axis=1, keepdims=True)
-                     - 2.0 * Q @ X.T + x_sq[None, :])
-                if mask_bias is not None:
-                    d = d + mask_bias
-                neg_d, idx = jax.lax.top_k(-d, k)
-                return -neg_d, idx
-
-            if conditional:
-                return jax.jit(lambda Q, b: fn(Q, b))
-            return jax.jit(fn)
-
-        variant = "bias" if conditional else "plain"
-        return cb.get_compiled_cache().get(
-            "knn", (bucket, variant), build,
-            instance=cb.instance_token(self), dtype="float32")
-
     def _match_bias(self, p, s: int, e: int) -> np.ndarray | None:
         """[e-s, N] additive bias (0 = allowed) for one query batch;
         None (plain KNN) means everything is allowed — no bias matrix is
@@ -108,35 +77,34 @@ class KNNModel(Model):
 
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("features_col"))
+        X = np.ascontiguousarray(self.get("index"), np.float32)
+        x_sq = np.sum(X * X, axis=1, dtype=np.float32)
         vals = self.get("values")
         labels = self.get("labels")
         B = self.get("query_batch")
-        bucketer = cb.default_bucketer()
+        k = self.get("k")
 
         def per_part(p):
             Q = _stack_features(p[self.get("features_col")])
             n = len(Q)
             matches = np.empty(n, dtype=object)
-            for s, e, bucket in bucketer.slices(n, B):
-                Qb = cb.pad_rows(Q[s:e], bucket)
-                bias = self._match_bias(p, s, e)
-                if bias is None:
-                    out = self._topk_fn(bucket, conditional=False)(Qb)
-                else:
-                    out = self._topk_fn(bucket, conditional=True)(
-                        Qb, cb.pad_rows(bias, bucket))
-                dist, idx = (np.asarray(a) for a in out)
-                for i in range(e - s):
-                    row = []
-                    for d, j in zip(dist[i], idx[i]):
-                        if d >= _INF / 2:  # filtered out (conditional)
-                            continue
-                        match = {"value": vals[j], "distance": float(np.sqrt(max(d, 0.0))),
-                                 "index": int(j)}
-                        if labels is not None:
-                            match["label"] = labels[j]
-                        row.append(match)
-                    matches[s + i] = row
+            # the shared retrieval kernel: ladder-bucketed query batches,
+            # ONE executable per (bucket, index-shape) across the process
+            dist, idx = _score_shard(
+                Q, X, k, x_sq=x_sq, query_batch=B,
+                bias_fn=lambda s, e: self._match_bias(p, s, e))
+            for i in range(n):
+                row = []
+                for d, j in zip(dist[i], idx[i]):
+                    if d >= _INF / 2:  # filtered out (conditional)
+                        continue
+                    match = {"value": vals[j],
+                             "distance": float(np.sqrt(max(d, 0.0))),
+                             "index": int(j)}
+                    if labels is not None:
+                        match["label"] = labels[j]
+                    row.append(match)
+                matches[i] = row
             q = dict(p)
             q[self.get("output_col")] = matches
             return q

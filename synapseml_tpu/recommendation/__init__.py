@@ -7,10 +7,12 @@ TPU design: SAR's score = userAffinity @ itemSimilarity is a single [U, I] x
 the host and scored via a jitted top_k per user batch.
 """
 
-from .indexer import RecommendationIndexer, RecommendationIndexerModel
+from .indexer import (RecommendationIndexer, RecommendationIndexerModel,
+                      export_item_index)
 from .sar import SAR, SARModel
 from .evaluator import RankingEvaluator
 from .adapter import RankingAdapter, RankingTrainValidationSplit
 
 __all__ = ["SAR", "SARModel", "RecommendationIndexer", "RecommendationIndexerModel",
-           "RankingEvaluator", "RankingAdapter", "RankingTrainValidationSplit"]
+           "RankingEvaluator", "RankingAdapter", "RankingTrainValidationSplit",
+           "export_item_index"]

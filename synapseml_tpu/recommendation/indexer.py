@@ -3,13 +3,48 @@ string/arbitrary user+item ids -> contiguous integer indices (and back)."""
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param
 from ..core.pipeline import Estimator, Model
 
-__all__ = ["RecommendationIndexer", "RecommendationIndexerModel"]
+__all__ = ["RecommendationIndexer", "RecommendationIndexerModel",
+           "export_item_index"]
+
+
+def export_item_index(model, index_dir: str, *, indexer=None,
+                      shard_name: str = "items-00000",
+                      normalize: bool = False):
+    """Materialize a fitted recommender's item-embedding table as a
+    retrieval :class:`~synapseml_tpu.retrieval.shards.IndexShard`, making
+    "similar items" queries servable on the retrieval plane (fan-out,
+    registry versioning, partial degradation) instead of a bespoke path.
+
+    ``model`` is any stage exposing an ``item_data_frame`` complex param
+    (SARModel: the [I, I] item-item similarity matrix — row i IS item i's
+    embedding in similarity space). ``indexer`` (an optional fitted
+    :class:`RecommendationIndexerModel`) recovers raw item ids into the
+    shard's payload sidecar. Returns the committed shard."""
+    from ..retrieval.shards import write_shard
+
+    table = np.ascontiguousarray(model.get("item_data_frame"), np.float32)
+    if table.ndim != 2:
+        raise ValueError(f"item_data_frame must be 2-D, got {table.shape}")
+    if normalize:
+        table = table / np.maximum(
+            np.linalg.norm(table, axis=1, keepdims=True), 1e-9)
+    n = table.shape[0]
+    payloads = None
+    if indexer is not None:
+        raw = indexer.recover_item(np.arange(n))
+        payloads = [{"item": it.item() if hasattr(it, "item") else it}
+                    for it in raw]
+    return write_shard(os.path.join(index_dir, "shards"), shard_name,
+                       table, ids=np.arange(n, dtype=np.int64),
+                       payloads=payloads, kind="base")
 
 
 class RecommendationIndexer(Estimator):

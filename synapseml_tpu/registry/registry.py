@@ -222,11 +222,14 @@ class ModelRegistry:
                 metrics: dict | None = None, extra: dict | None = None,
                 set_latest: bool = True, aot: dict | None = None,
                 autotune: dict | None = None,
-                sharding=None) -> PublishedVersion:
+                sharding=None, extra_tree: str | None = None) -> PublishedVersion:
         """Save ``stage``, blobify its tree, and write the signed manifest.
         ``version`` defaults to the next ``v<N>``; ``metrics`` is the
         caller's evaluation snapshot at publish time (what the deployment
-        plane compares a canary against).
+        plane compares a canary against). ``extra_tree`` names a directory
+        whose contents are merged into the artifact tree before blobify —
+        sidecar data (e.g. retrieval index shards) that must version, GC
+        and materialize with the stage.
 
         ``aot`` turns on publish-time AOT compilation of the serve ladder
         (the TVM pay-compile-once discipline — ``registry/aot.py``):
@@ -262,6 +265,15 @@ class ModelRegistry:
         with tempfile.TemporaryDirectory(prefix="synapseml_publish_") as tmp:
             stage_dir = os.path.join(tmp, "stage")
             serialization.save_stage(stage, stage_dir)
+            if extra_tree is not None:
+                # sidecar data riding the artifact (retrieval index shards):
+                # merged into the stage tree BEFORE ingest, so the files are
+                # content-addressed blobs on the manifest ``files`` list —
+                # deduped across versions, GC-protected, materialized under
+                # ``resolve().path`` like any other artifact byte
+                import shutil
+
+                shutil.copytree(extra_tree, stage_dir, dirs_exist_ok=True)
             files = store.ingest_tree(stage_dir)
             stages = _stage_classes(stage_dir)
             schema_hash = param_schema_hash(stage_dir)

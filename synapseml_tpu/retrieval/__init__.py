@@ -1,0 +1,67 @@
+"""Retrieval serving plane: sharded vector index with continual ingest.
+
+The plane composes existing seams instead of inventing new ones:
+
+* **build** — embedding backfill as a ``scoring.transform_source`` job;
+  DONE-gated ``NpySink`` parts become immutable :class:`IndexShard`s;
+  ``publish_index`` rides the registry's content-addressed blob store so
+  indexes are pinned/aliased/canaried/GC'd exactly like model weights;
+* **serve** — :class:`VectorIndexModel` scores each shard through the ONE
+  shared matmul+top_k kernel (:mod:`.scorer`, also the engine behind
+  ``nn/knn.py``) on the bucket ladder; workers are byte-budgeted
+  ``ResidencyManager`` holders behind ``/m/<index>``; the ``RoutingFront``
+  fans a query to the workers advertising the index's shards and merges
+  per-shard top-k into global top-k (missing shards degrade to partial
+  results with ``X-Retrieval-Partial``, never 500s);
+* **ingest** — freshly logged documents (the continual-flywheel request
+  log) embed and commit as NEW delta shards under the next version, no
+  rebuild; ``compact_index`` folds deltas past a threshold; freshness lag
+  is a measured metric;
+* **observe** — ``synapseml_retrieval_*`` series (:mod:`.metrics`).
+
+Submodules import lazily (PEP 562): ``nn/knn.py`` pulls only the scorer
+without dragging the fleet/registry serve chain into every KNN import.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+__all__ = [
+    "INF", "score_shard", "score_batches",
+    "IndexShard", "SHARD_MANIFEST", "write_shard", "open_shard",
+    "list_shards",
+    "VectorIndexModel",
+    "HashEmbedder", "embed_corpus", "shards_from_parts", "index_model_for",
+    "publish_index", "build_index",
+    "ingest_deltas", "compact_index", "extract_documents",
+    "retrieval_worker_main",
+    "retrieval_metrics",
+]
+
+_LOCATIONS = {
+    "INF": "scorer", "score_shard": "scorer", "score_batches": "scorer",
+    "IndexShard": "shards", "SHARD_MANIFEST": "shards",
+    "write_shard": "shards", "open_shard": "shards", "list_shards": "shards",
+    "VectorIndexModel": "model",
+    "HashEmbedder": "build", "embed_corpus": "build",
+    "shards_from_parts": "build", "index_model_for": "build",
+    "publish_index": "build", "build_index": "build",
+    "ingest_deltas": "ingest", "compact_index": "ingest",
+    "extract_documents": "ingest",
+    "retrieval_worker_main": "serve",
+    "retrieval_metrics": "metrics",
+}
+
+
+def __getattr__(name: str):
+    submodule = _LOCATIONS.get(name)
+    if submodule is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(f".{submodule}", __name__), name)
+    globals()[name] = value  # cache: one import, stable identity
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,103 @@
+"""Shared per-shard top-k scoring kernel (the ONE |q|^2 - 2 q.x + |x|^2
+matmul + ``top_k`` program behind both ``nn/knn.py`` and the retrieval
+serving plane).
+
+TVM's pay-compile-once lesson applied to ANN serving: shard scoring is one
+[Q, N] MXU matmul + ``jax.lax.top_k`` vmapped over query batches, so every
+shard of the same (rows, dim) shape shares ONE executable per query-ladder
+rung. Unlike the seed ``KNNModel._topk_fn``, the index matrix is a TRACED
+ARGUMENT rather than a closure capture — executables are keyed by shard
+SHAPE, not shard identity, so an N-shard index compiles ladder-many
+programs total instead of ladder-many per shard.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import batching as cb
+
+__all__ = ["INF", "FN_ID", "score_shard", "score_batches"]
+
+# sentinel distance for masked-out candidates (conditional KNN bias); kept
+# below float32 max so the additive mask cannot overflow to inf
+INF = np.float32(3.0e38)
+
+FN_ID = "retrieval_score_shard"
+
+
+def _shard_fn(bucket: int, n: int, d: int, k: int, variant: str):
+    """The compiled (Q, X, x_sq[, bias]) -> (dist, idx) executable for one
+    static shape, via the shared CompiledCache. ``instance`` stays None on
+    purpose: nothing instance-specific is captured, so every caller in the
+    process (seed KNN, VectorIndexModel, the bench arms) shares the same
+    ladder of executables."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        def fn(Q, X, x_sq, bias=None):
+            # [Q, N] squared L2 distances via one MXU matmul
+            dist = (jnp.sum(Q * Q, axis=1, keepdims=True)
+                    - 2.0 * Q @ X.T + x_sq[None, :])
+            if bias is not None:
+                dist = dist + bias
+            neg_d, idx = jax.lax.top_k(-dist, k)
+            return -neg_d, idx
+
+        if variant == "bias":
+            return jax.jit(lambda Q, X, x_sq, b: fn(Q, X, x_sq, b))
+        return jax.jit(lambda Q, X, x_sq: fn(Q, X, x_sq))
+
+    return cb.get_compiled_cache().get(
+        FN_ID, (bucket, n, d, k, variant), build, dtype="float32")
+
+
+def score_shard(Qb: np.ndarray, X: np.ndarray, x_sq: np.ndarray, k: int,
+                bias: np.ndarray | None = None):
+    """Top-k of one PADDED query bucket ``Qb`` [B, D] against one shard
+    ``X`` [N, D] (``x_sq`` = per-row squared norms, precomputed once per
+    shard). Returns numpy ``(dist [B, k'], idx [B, k'])`` with squared L2
+    distances, ``k' = min(k, N)``. ``bias`` [B, N] is an additive mask
+    (0 = allowed, :data:`INF` = excluded — the conditional-KNN contract)."""
+    Qb = np.ascontiguousarray(Qb, np.float32)
+    n, d = X.shape
+    kk = min(int(k), n)
+    variant = "bias" if bias is not None else "plain"
+    fn = _shard_fn(Qb.shape[0], n, d, kk, variant)
+    if bias is None:
+        dist, idx = fn(Qb, X, x_sq)
+    else:
+        dist, idx = fn(Qb, X, x_sq, np.ascontiguousarray(bias, np.float32))
+    return np.asarray(dist), np.asarray(idx)
+
+
+def score_batches(Q: np.ndarray, X: np.ndarray, k: int, *,
+                  x_sq: np.ndarray | None = None, bias_fn=None,
+                  bucketer: cb.ShapeBucketer | None = None,
+                  query_batch: int = 256):
+    """Score EVERY query row against one shard, streaming queries through
+    ladder-bucketed padded batches (``bucketer.slices``), so a mixed-size
+    query stream compiles at most ladder-many executables per shard shape.
+
+    ``bias_fn(s, e)`` (optional) returns the [e-s, N] additive mask for one
+    query slice, or None. Returns ``(dist [n, k'], idx [n, k'])`` numpy
+    arrays of squared L2 distances (callers take sqrt for reporting)."""
+    Q = np.asarray(Q, np.float32)
+    X = np.ascontiguousarray(X, np.float32)
+    if x_sq is None:
+        x_sq = np.sum(X * X, axis=1, dtype=np.float32)
+    n = len(Q)
+    kk = min(int(k), X.shape[0])
+    dist = np.empty((n, kk), np.float32)
+    idx = np.empty((n, kk), np.int64)
+    bucketer = bucketer or cb.default_bucketer()
+    for s, e, bucket in bucketer.slices(n, query_batch):
+        Qb = cb.pad_rows(Q[s:e], bucket)
+        bias = bias_fn(s, e) if bias_fn is not None else None
+        if bias is not None:
+            bias = cb.pad_rows(np.asarray(bias, np.float32), bucket)
+        db, ib = score_shard(Qb, X, x_sq, kk, bias)
+        dist[s:e] = db[:e - s]
+        idx[s:e] = ib[:e - s]
+    return dist, idx

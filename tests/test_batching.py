@@ -352,6 +352,30 @@ def test_hf_embedder_bucketed_matches_unpadded(fresh_cache):
     _padded_vs_exact(transform, compare)
 
 
+def test_hf_embedder_bucketed_matches_unpadded_normalized(fresh_cache):
+    """The explicit L2-normalize param (cosine indexes) must not break
+    pad-row invariance: padded == unpadded with normalize on, and the
+    outputs actually ARE unit-norm."""
+    from synapseml_tpu.hf import HuggingFaceSentenceEmbedder
+
+    st = HuggingFaceSentenceEmbedder(model_name="bert-tiny", batch_size=8,
+                                     max_token_len=16, normalize=True)
+
+    def transform(n):
+        df = DataFrame.from_dict({"text": np.asarray(
+            [f"sentence number {i} with a few words" for i in range(n)],
+            dtype=object)})
+        return np.asarray(
+            list(st.transform(df).collect_column("embeddings")))
+
+    def compare(padded, exact, n):
+        np.testing.assert_allclose(padded, exact, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.linalg.norm(padded, axis=-1), 1.0,
+                                   atol=1e-5)
+
+    _padded_vs_exact(transform, compare)
+
+
 def test_hf_causal_lm_bucketed_matches_unpadded(fresh_cache):
     from synapseml_tpu.hf import HuggingFaceCausalLM
 

@@ -430,7 +430,8 @@ def test_sentence_embedder_from_checkpoint_dir(bert_ckpt):
     from synapseml_tpu.models.tokenizer import HashingTokenizer
 
     emb = HuggingFaceSentenceEmbedder(model_name=d, max_token_len=16,
-                                      tokenizer=HashingTokenizer(vocab_size=97))
+                                      tokenizer=HashingTokenizer(vocab_size=97),
+                                      normalize=True)
     df = st.DataFrame.from_rows([{"text": "alpha beta"}, {"text": "gamma"}])
     out = np.asarray(list(emb.transform(df).collect_column("embeddings")))
     assert out.shape == (2, 48)

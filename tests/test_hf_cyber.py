@@ -47,7 +47,7 @@ def test_sentence_embedder():
     df = DataFrame.from_dict({"text": ["alpha beta", "alpha beta", "zzz qqq xxx"]},
                              num_partitions=2)
     emb = HuggingFaceSentenceEmbedder(model_name="bert-tiny", batch_size=2,
-                                      max_token_len=16)
+                                      max_token_len=16, normalize=True)
     out = emb.transform(df)
     E = np.stack(list(out.collect_column("embeddings")))
     assert E.shape[0] == 3
