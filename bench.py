@@ -236,13 +236,33 @@ def _probe_main() -> None:
     print("PROBE_OK " + json.dumps({"platform": plat, "n": n}), flush=True)
 
 
-def _probe_backend(timeout_s: float = BACKEND_UP_TIMEOUT_S) -> tuple[bool, str]:
-    """(tpu_usable, reason): probe the JAX backend in a subprocess with a
-    HARD timeout before the rotation spends any per-config budget. A hung
-    relay (the round-2 failure mode: jax.devices() never returns) is killed
-    at the deadline and the whole rotation falls back to CPU immediately —
-    every config still emits its BENCH line instead of each one separately
-    burning its backend-up window against a dead relay."""
+def _probe_timeout_s() -> float:
+    """Probe deadline: ``SYNAPSEML_PROBE_TIMEOUT_S`` when set (slow pods
+    need longer than the default; CI smoke wants shorter), else
+    BACKEND_UP_TIMEOUT_S."""
+    raw = os.environ.get("SYNAPSEML_PROBE_TIMEOUT_S", "").strip()
+    if raw:
+        try:
+            return max(1.0, float(raw))
+        except ValueError:
+            pass
+    return float(BACKEND_UP_TIMEOUT_S)
+
+
+def _probe_backend(timeout_s: float | None = None) -> tuple[bool, dict]:
+    """(tpu_usable, probe record): probe the JAX backend in a subprocess
+    with a HARD timeout before the rotation spends any per-config budget. A
+    hung relay (the round-2 failure mode: jax.devices() never returns) is
+    killed at the deadline and the whole rotation falls back to CPU
+    immediately — every config still emits its BENCH line instead of each
+    one separately burning its backend-up window against a dead relay.
+
+    The record distinguishes WHY: ``kind`` is ``up`` | ``timeout`` |
+    ``no_tpu`` | ``error``, with the child's merged stdout/stderr tail —
+    so a CPU-only BENCH round carries diagnosable evidence instead of the
+    bare "cpu fallback" caveat."""
+    if timeout_s is None:
+        timeout_s = _probe_timeout_s()
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--probe"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=REPO)
@@ -250,8 +270,15 @@ def _probe_backend(timeout_s: float = BACKEND_UP_TIMEOUT_S) -> tuple[bool, str]:
         out, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         proc.kill()
-        proc.wait()
-        return False, f"backend probe hung past {timeout_s:.0f}s (relay hang)"
+        # second communicate() collects whatever the child buffered before
+        # the kill — the last thing it printed is usually the hang site
+        out, _ = proc.communicate()
+        tail = " | ".join((out or "").splitlines()[-4:])
+        return False, {
+            "kind": "timeout", "timeout_s": timeout_s,
+            "reason": f"backend probe hung past {timeout_s:.0f}s "
+                      "(relay hang)",
+            "stderr_tail": tail[-300:]}
     for line in (out or "").splitlines():
         if line.startswith("PROBE_OK "):
             try:
@@ -259,10 +286,20 @@ def _probe_backend(timeout_s: float = BACKEND_UP_TIMEOUT_S) -> tuple[bool, str]:
             except json.JSONDecodeError:
                 continue
             if info.get("platform") not in ("cpu",):
-                return True, f"backend up: {info}"
-            return False, f"probe came up on {info.get('platform')} (no TPU)"
+                return True, {"kind": "up", "timeout_s": timeout_s,
+                              "reason": f"backend up: {info}",
+                              "stderr_tail": ""}
+            tail = " | ".join((out or "").splitlines()[-4:])
+            return False, {
+                "kind": "no_tpu", "timeout_s": timeout_s,
+                "reason": f"probe came up on {info.get('platform')} "
+                          "(no TPU)",
+                "stderr_tail": tail[-300:]}
     tail = " | ".join((out or "").splitlines()[-4:])
-    return False, f"probe died rc={proc.returncode}: {tail[-300:]}"
+    return False, {
+        "kind": "error", "timeout_s": timeout_s,
+        "reason": f"probe died rc={proc.returncode}: {tail[-300:]}",
+        "stderr_tail": tail[-300:]}
 
 
 def _child_main(platform: str, config: str) -> None:
@@ -481,15 +518,22 @@ def main() -> None:
 
     recorded = _load_recorded()
     tpu_ok = True
+    probe_info = None  # attached to every BENCH record when the probe failed
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         _log("JAX_PLATFORMS=cpu requested; skipping all TPU attempts")
         tpu_ok = False
+        probe_info = {"kind": "skipped", "timeout_s": 0.0,
+                      "reason": "JAX_PLATFORMS=cpu requested",
+                      "stderr_tail": ""}
     if tpu_ok:
         # one hard-deadline subprocess probe up front: a hung relay demotes
         # the WHOLE rotation to CPU now, instead of every config separately
         # discovering the hang against its own backend-up window
-        tpu_ok, why = _probe_backend()
-        _log(f"backend probe: {why}" + ("" if tpu_ok else "; cpu fallback"))
+        tpu_ok, probe = _probe_backend()
+        _log(f"backend probe: {probe['reason']}"
+             + ("" if tpu_ok else "; cpu fallback"))
+        if not tpu_ok:
+            probe_info = probe
 
     # BENCH_CONFIGS=flagship,vit restricts the rotation (CI smoke, manual
     # single-config runs); unset = all configs
@@ -532,6 +576,13 @@ def main() -> None:
                 _log(reason)
                 if hang:
                     tpu_ok = False
+                    if probe_info is None:
+                        probe_info = {
+                            "kind": "timeout", "timeout_s": float(
+                                BACKEND_UP_TIMEOUT_S),
+                            "reason": f"relay hang during {name} (killed "
+                                      "before backend-up)",
+                            "stderr_tail": str(err or "")[-300:]}
                     break
                 if not (transient and attempt + 1 < attempts):
                     break
@@ -564,6 +615,10 @@ def main() -> None:
             _log(f"seeded PERF_BASELINE.json with {result['metric']}")
         if reason:
             result["reason"] = reason
+        if probe_info is not None:
+            # the round went CPU-only (or degraded mid-rotation): every
+            # record says WHY the TPU probe failed, not just that it did
+            result["probe"] = probe_info
         lines.append((name, result))
 
     # flagship line last so a single-JSON-line consumer parses the flagship

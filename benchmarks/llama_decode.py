@@ -213,7 +213,8 @@ def _run_paged(cfg, params, requests, slots: int, trials: int = 3):
     return out
 
 
-def _run_kill_mid_decode(cfg, params, requests, slots: int):
+def _run_kill_mid_decode(cfg, params, requests, slots: int,
+                         engine_kw: dict | None = None):
     """Survivable-serving arm: the same stream, but the engine is "killed"
     at t=50% of the token budget (its KV pool abandoned, nothing exported
     — a SIGKILL, not a drain) and every unfinished sequence resubmits to a
@@ -221,10 +222,12 @@ def _run_kill_mid_decode(cfg, params, requests, slots: int):
     re-prefill over prompt + already-emitted ids, emitting only NEW
     tokens. Reports recovery latency (kill -> first resumed token) and
     duplicate / lost token counts against an uninterrupted reference —
-    the bar for both is zero."""
+    the bar for both is zero. ``engine_kw`` overlays engine knobs (the
+    both-features-on rerun: prefix_cache + draft_tokens)."""
     from synapseml_tpu.models.paged_engine import PagedDecodeEngine
 
-    kw = dict(block_len=16, max_slots=slots, prefill_batch=2)
+    kw = dict(block_len=16, max_slots=slots, prefill_batch=2,
+              **(engine_kw or {}))
     ref_eng = PagedDecodeEngine(cfg, params, **kw)
     refs = ref_eng.generate([p for p, _ in requests],
                             [n for _, n in requests])
@@ -243,15 +246,16 @@ def _run_kill_mid_decode(cfg, params, requests, slots: int):
     def drain(events):
         for ev in events:
             if ev.get("token") is not None:
+                # ev["index"] is stamped at emission time, so it stays
+                # exact when a speculative step emits several tokens for
+                # one sequence in one events batch
                 i = by_uid[ev["seq"].uid]
-                emissions[i].append((len(ev["seq"].generated) - 1,
-                                     int(ev["token"])))
+                emissions[i].append((int(ev["index"]), int(ev["token"])))
 
     emitted = 0
     while emitted < total // 2:
-        # drain each phase separately: global index = len(generated) - 1
-        # is only correct if events are consumed before the NEXT phase
-        # appends another token (same discipline as serve_llm's dispatch)
+        # drain each phase separately (same discipline as serve_llm's
+        # dispatch loop)
         drain(victim.admit())
         drain(victim.step())
         emitted = sum(len(e) for e in emissions)
@@ -280,6 +284,11 @@ def _run_kill_mid_decode(cfg, params, requests, slots: int):
             drain(events)
     wall = time.perf_counter() - t0
     leaked = survivor.allocator.used_count
+    pc = getattr(survivor, "prefix_cache", None)
+    if pc is not None:
+        # cache-pinned pages are RESIDENT by design (the cache holds its
+        # own refs), not leaks — only blocks nothing accounts for count
+        leaked -= len(pc.block_ids())
     survivor.release()
 
     dup = lost = mismatched = 0
@@ -299,24 +308,31 @@ def _run_kill_mid_decode(cfg, params, requests, slots: int):
             "survivor_leaked_blocks": int(leaked)}
 
 
-def _continuous_ab(jax, platform):
-    """Both arms in the same round on the same stream (the serving-microbatch
-    A/B discipline)."""
+def _tiny_model(jax):
+    """The shared A/B model: big enough that a decode step is
+    device-dominated (per-call dispatch overhead under 20% of a step),
+    small enough for the CPU budget."""
     import jax.numpy as jnp
+    from flax.core import meta
 
-    from synapseml_tpu.core.batching import default_bucketer
     from synapseml_tpu.models.flax_nets.llama import LlamaLM, llama_tiny
 
-    # big enough that a decode step is device-dominated (per-call dispatch
-    # overhead under 20% of a step), small enough for the CPU budget
     cfg = llama_tiny(hidden=320, n_layers=6, n_heads=8, n_kv_heads=4,
                      mlp_dim=768, vocab_size=1024, max_len=128)
     params = LlamaLM(cfg).init(jax.random.PRNGKey(0),
                                jnp.zeros((1, 8), jnp.int32))["params"]
-    from flax.core import meta
     params = jax.tree.map(
         lambda x: x.value if isinstance(x, meta.Partitioned) else x, params,
         is_leaf=lambda x: isinstance(x, meta.Partitioned))
+    return cfg, params
+
+
+def _continuous_ab(jax, platform):
+    """Both arms in the same round on the same stream (the serving-microbatch
+    A/B discipline)."""
+    from synapseml_tpu.core.batching import default_bucketer
+
+    cfg, params = _tiny_model(jax)
     rng = np.random.default_rng(7)
     # TPU runs through the (flaky, high-RTT) relay: a smaller stream and a
     # single timed pass keep the A/B inside the config deadline — numbers
@@ -351,12 +367,217 @@ def _continuous_ab(jax, platform):
     }
 
 
+def _shared_prefix_stream(rng, n_requests: int, vocab: int, prefix):
+    """Heavy-tailed shared-prefix stream: every request starts with the
+    same ``prefix`` (a system/RAG/few-shot head, ~80% of each prompt's
+    tokens) followed by a unique suffix — mostly short (chat turns), ~20%
+    longer. Generation budgets are tiny: this arm measures TTFT, which is
+    prefill-dominated."""
+    reqs = []
+    for _ in range(n_requests):
+        if rng.random() < 0.2:
+            slen = int(rng.choice([24, 32]))
+        else:
+            slen = int(rng.choice([8, 12, 16]))
+        suffix = rng.integers(2, vocab, (slen,)).tolist()
+        reqs.append((list(prefix) + suffix, 4))
+    return reqs
+
+
+def _run_prefix_arm(cfg, params, passes, slots: int, prefix_cache: bool):
+    """One prefix-cache arm over per-pass request streams. TTFT per request
+    is submit (= pass start; all requests are queued up front) -> its first
+    emitted token, the same clock both arms use. The warm pass lands every
+    compile AND (cache on) seeds the shared prefix; each timed pass uses
+    FRESH suffixes, so cache reuse comes from the shared head only — never
+    from replaying a previous pass's full prompts."""
+    from synapseml_tpu.models.paged_engine import PagedDecodeEngine
+
+    engine = PagedDecodeEngine(cfg, params, block_len=16, max_slots=slots,
+                               prefill_batch=2, prefix_cache=prefix_cache)
+
+    def one_pass(requests):
+        seqs = [engine.submit(p, n) for p, n in requests]
+        first: dict = {}
+        t0 = time.perf_counter()
+        while any(not s.done for s in seqs):
+            events = engine.admit() + engine.step()
+            now = time.perf_counter()
+            for ev in events:
+                if ev.get("token") is not None:
+                    first.setdefault(ev["seq"].uid, (now - t0) * 1e3)
+        return time.perf_counter() - t0, list(first.values())
+
+    one_pass(passes[0])
+    pc0 = (engine.stats().get("prefix_cache") or {})
+    reused0 = pc0.get("tokens_reused", 0)
+    timed = [one_pass(reqs) for reqs in passes[1:]]
+    wall, ttft = min(timed, key=lambda r: r[0])
+    pc = engine.stats().get("prefix_cache") or {}
+    prompt_tokens = sum(len(p) for reqs in passes[1:] for p, _ in reqs)
+    reused = int(pc.get("tokens_reused", 0)) - int(reused0)
+    engine.release()
+    p50, p99 = _percentiles(ttft)
+    out = {"ttft_mean_ms": round(float(np.mean(ttft)), 3),
+           "ttft_p50_ms": p50, "ttft_p99_ms": p99,
+           "wall_s": round(wall, 3),
+           # prefill work across ALL timed passes (reuse accumulates per
+           # pass; wall/TTFT above are the best single pass)
+           "prompt_tokens": int(prompt_tokens),
+           "prefill_tokens_computed": int(prompt_tokens - reused)}
+    if prefix_cache:
+        out["prefix_cache"] = {k: pc.get(k) for k in (
+            "hits", "misses", "hit_rate", "tokens_reused", "entries",
+            "evictions")}
+    return out
+
+
+def _shared_prefix_ab(jax, platform):
+    """Prefix-cache A/B (same round, same per-pass streams, min-of-3):
+    cache OFF prefills every prompt whole; cache ON prefills only the
+    uncached suffix once the shared head's pages are resident. The bar:
+    >= 2x TTFT improvement at ~80% prefix share, with prefill tokens
+    computed dropping superlinearly relative to the prefix share."""
+    cfg, params = _tiny_model(jax)
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(2, cfg.vocab_size, (64,)).tolist()  # 4 KV blocks
+    on_tpu = platform == "tpu"
+    n_req = 16 if on_tpu else 32
+    trials = 1 if on_tpu else 3
+    passes = [_shared_prefix_stream(rng, n_req, cfg.vocab_size, prefix)
+              for _ in range(trials + 1)]
+    slots = 8
+    off = _run_prefix_arm(cfg, params, passes, slots, prefix_cache=False)
+    on = _run_prefix_arm(cfg, params, passes, slots, prefix_cache=True)
+    share = len(prefix) * sum(len(reqs) for reqs in passes[1:]) \
+        / max(sum(len(p) for reqs in passes[1:] for p, _ in reqs), 1)
+    return {
+        "stream": {"n_requests_per_pass": n_req, "passes": trials,
+                   "slots": slots, "prefix_len": len(prefix),
+                   "prefix_share": round(share, 3)},
+        "cache_off": off,
+        "cache_on": on,
+        "ttft_improvement": round(
+            off["ttft_mean_ms"] / on["ttft_mean_ms"], 3)
+        if on["ttft_mean_ms"] else None,
+        "prefill_tokens_ratio": round(
+            on["prefill_tokens_computed"]
+            / max(off["prefill_tokens_computed"], 1), 3),
+    }
+
+
+def _zero_late_layers(jax, params, keep: int):
+    """Draft-friendly weights: layers >= ``keep`` become EXACT identities
+    (attention o-proj and mlp down-proj zeroed, so both residual branches
+    contribute nothing). Early-exit at ``keep`` layers then equals the full
+    model — greedy speculation accepts every draft by construction, which
+    makes the A/B a clean measurement of the spec step's mechanics instead
+    of a bet on a random drafter's luck."""
+    import jax.numpy as jnp
+
+    zero = lambda t: jax.tree.map(jnp.zeros_like, t)  # noqa: E731
+    dec = dict(params["decoder"])
+    for name in list(dec.keys()):
+        if name.startswith("layer_") \
+                and int(name.split("_", 1)[1]) >= keep:
+            layer = dict(dec[name])
+            attn = dict(layer["attn"])
+            attn["o"] = zero(attn["o"])
+            mlp = dict(layer["mlp"])
+            mlp["down"] = zero(mlp["down"])
+            layer["attn"], layer["mlp"] = attn, mlp
+            dec[name] = layer
+    out = {k: v for k, v in params.items() if k != "decoder"}
+    out["decoder"] = dec
+    return out
+
+
+def _run_spec_arm(cfg, params, requests, slots: int, trials: int,
+                  **engine_kw):
+    from synapseml_tpu.models.paged_engine import PagedDecodeEngine
+
+    engine = PagedDecodeEngine(cfg, params, block_len=16, max_slots=slots,
+                               prefill_batch=2, **engine_kw)
+
+    def one_pass():
+        seqs = [engine.submit(p, n) for p, n in requests]
+        t0 = time.perf_counter()
+        while any(not s.done for s in seqs):
+            engine.admit()
+            engine.step()
+        return time.perf_counter() - t0, [list(s.generated) for s in seqs]
+
+    one_pass()  # warm: prefill + decode + (spec) draft/verify rungs
+    results = [one_pass() for _ in range(trials)]
+    wall = min(r[0] for r in results)
+    gen = results[0][1]
+    stats = engine.stats()
+    engine.release()
+    useful = sum(len(g) for g in gen)
+    return {"tokens_per_sec": round(useful / wall, 1),
+            "useful_tokens": useful, "wall_s": round(wall, 3)}, gen, stats
+
+
+def _spec_decode_ab(jax, platform):
+    """Speculative-decoding A/B (same round, same stream, min-of-3) on a
+    DRAFT-FRIENDLY model: late layers zeroed to identities so the early-
+    exit drafter is exact and acceptance is ~1.0 — the bar is tokens/sec
+    >= 1.2x plain decode with tokens identical. A second rerun drives the
+    kill-mid-decode arm with BOTH features on (prefix cache + speculation):
+    the zero-dup / zero-loss bar must hold through a crash resume."""
+    cfg, params = _tiny_model(jax)
+    K, E = 6, 1
+    friendly = _zero_late_layers(jax, params, E)
+    rng = np.random.default_rng(13)
+    reqs = []
+    n_req = 16 if platform == "tpu" else 32
+    for _ in range(n_req):  # decode-heavy: speculation pays on decode steps
+        plen = int(rng.choice([6, 12, 20, 30]))
+        n_new = int(rng.choice([16, 24, 32, 48]))
+        reqs.append((rng.integers(2, cfg.vocab_size, (plen,)).tolist(),
+                     n_new))
+    slots = 8
+    trials = 1 if platform == "tpu" else 3
+    plain, gen_plain, _ = _run_spec_arm(cfg, friendly, reqs, slots, trials)
+    spec, gen_spec, stats = _run_spec_arm(
+        cfg, friendly, reqs, slots, trials, draft_tokens=K, draft_layers=E)
+    sp = stats.get("speculation") or {}
+    kill = None
+    if platform != "tpu":
+        kill = _run_kill_mid_decode(
+            cfg, friendly, reqs, slots,
+            engine_kw=dict(prefix_cache=True, draft_tokens=K,
+                           draft_layers=E))
+    return {
+        "stream": {"n_requests": n_req, "slots": slots,
+                   "draft_tokens": K, "draft_layers": E,
+                   "total_tokens": sum(n for _, n in reqs)},
+        "plain": plain,
+        "spec": spec,
+        "tokens_per_sec_vs_plain": round(
+            spec["tokens_per_sec"] / plain["tokens_per_sec"], 3)
+        if plain["tokens_per_sec"] else None,
+        "acceptance_rate": sp.get("acceptance_rate"),
+        "spec_steps": sp.get("steps"), "spec_fallbacks": sp.get("fallbacks"),
+        "tokens_identical": gen_spec == gen_plain,
+        "kill_mid_decode_both_on": kill,
+    }
+
+
 def run(jax, platform, n_chips):
     result = _legacy_throughput(jax, platform)
     try:
         result["continuous_ab"] = _continuous_ab(jax, platform)
     except Exception as e:  # noqa: BLE001 — A/B failure must not eat the
         result["continuous_ab"] = {"error": repr(e)}  # legacy TPU number
+    try:
+        result["shared_prefix_ab"] = _shared_prefix_ab(jax, platform)
+    except Exception as e:  # noqa: BLE001
+        result["shared_prefix_ab"] = {"error": repr(e)}
+    try:
+        result["spec_decode_ab"] = _spec_decode_ab(jax, platform)
+    except Exception as e:  # noqa: BLE001
+        result["spec_decode_ab"] = {"error": repr(e)}
     return result
 
 

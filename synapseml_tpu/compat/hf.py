@@ -31,6 +31,18 @@ class HuggingFaceCausalLM(WrapperBase):
     def getDoSample(self):
         return self._get('do_sample')
 
+    def setDraftTokens(self, value):
+        return self._set('draft_tokens', value)
+
+    def getDraftTokens(self):
+        return self._get('draft_tokens')
+
+    def setDrafterRef(self, value):
+        return self._set('drafter_ref', value)
+
+    def getDrafterRef(self):
+        return self._get('drafter_ref')
+
     def setEngine(self, value):
         return self._set('engine', value)
 
@@ -108,6 +120,12 @@ class HuggingFaceCausalLM(WrapperBase):
 
     def getPartitionRules(self):
         return self._get('partition_rules')
+
+    def setPrefixCache(self, value):
+        return self._set('prefix_cache', value)
+
+    def getPrefixCache(self):
+        return self._get('prefix_cache')
 
     def setPromptBucket(self, value):
         return self._set('prompt_bucket', value)

@@ -378,6 +378,12 @@ class FleetSignals:
     queue_per_worker: float | None = None  # mean /admin/stats queue depth
     p95_ms: float | None = None            # routed p95 (version_stats)
     workers_polled: int = 0
+    # mean engine prefix-cache hit rate across polled workers (LLM fleets
+    # with ``prefix_cache`` on; None elsewhere) — the
+    # ``synapseml_llm_prefix_hit_rate`` series as /admin/stats exposes it.
+    # Observability for now: a high hit rate means routed stickiness is
+    # working and effective per-worker capacity is above the cold number.
+    prefix_hit_rate: float | None = None
 
 
 class _ModelState:
@@ -530,6 +536,7 @@ class FleetAutoscaler:
                          live: list[WorkerHandle]) -> FleetSignals:
         self._backfill_endpoints(slo.model, live)
         depths = []
+        hit_rates = []
         for h in live:
             if h.endpoint is None:
                 continue
@@ -542,13 +549,24 @@ class FleetAutoscaler:
                 h.state = "ready"
             except (urllib.error.URLError, OSError, ValueError):
                 continue  # unreachable mid-poll: the breaker plane's job
+            # LLM workers surface engine stats under "llm" (serve_llm sets
+            # server.llm_stats_fn); absent/odd shapes just skip the signal
+            try:
+                rate = ((stats.get("llm") or {}).get("prefix_cache")
+                        or {}).get("hit_rate")
+                if rate is not None:
+                    hit_rates.append(float(rate))
+            except (AttributeError, TypeError, ValueError):
+                pass
         p95 = None
         if self.front is not None:
             p95 = (self.front.version_stats().get(slo.model) or {}) \
                 .get("p95_ms")
         return FleetSignals(
             queue_per_worker=(sum(depths) / len(depths)) if depths else None,
-            p95_ms=p95, workers_polled=len(depths))
+            p95_ms=p95, workers_polled=len(depths),
+            prefix_hit_rate=(sum(hit_rates) / len(hit_rates))
+            if hit_rates else None)
 
     def _backfill_endpoints(self, model: str,
                             live: list[WorkerHandle]) -> None:

@@ -104,13 +104,32 @@ class HuggingFaceCausalLM(Transformer):
     decode_slots = Param("decode_slots", "paged engine: max concurrently "
                          "decoding sequences (None = batch_size)",
                          default=None)
+    prefix_cache = Param(
+        "prefix_cache", "paged engine: content-hash full KV pages so "
+        "sequences sharing a prompt prefix (chat system prompts, RAG "
+        "templates) reuse resident pages and prefill only the uncached "
+        "suffix (models/prefix_cache.py; token-identical output)",
+        default=False, converter=TypeConverters.to_bool)
+    draft_tokens = Param(
+        "draft_tokens", "paged engine: greedy speculative decoding — draft "
+        "this many tokens per step and verify them in ONE paged forward "
+        "(0 = off; requires greedy decode, and accepted tokens are "
+        "token-identical to plain decode)", default=0,
+        converter=TypeConverters.to_int)
+    drafter_ref = Param(
+        "drafter_ref", "paged engine: who drafts when draft_tokens > 0 — "
+        "None/'self' self-drafts via early exit at half the layers, "
+        "'self:<n>' picks the exit layer, any other value resolves a small "
+        "drafter model like model_name (architecture preset or local "
+        "checkpoint dir)", default=None)
 
     _CACHE_KEYS = frozenset({"model_name", "model_params", "tokenizer",
                              "mesh_config", "partition_rules",
                              "max_new_tokens", "eos_id",
                              "do_sample", "temperature", "top_k", "top_p",
                              "seed", "engine", "kv_block_len", "kv_blocks",
-                             "decode_slots"})
+                             "decode_slots", "prefix_cache", "draft_tokens",
+                             "drafter_ref"})
 
     def set(self, **kw):
         out = super().set(**kw)
@@ -237,6 +256,32 @@ class HuggingFaceCausalLM(Transformer):
             "hf_causal_lm", (B, P) + eff_key, build,
             instance=cb.instance_token(self), dtype="int32")
 
+    def _resolve_drafter(self, cfg):
+        """Resolve ``drafter_ref`` into engine knobs: (draft_layers,
+        drafter). ``None``/``'self'`` self-drafts at half the layers,
+        ``'self:<n>'`` picks the early-exit layer, anything else loads a
+        small drafter model through the same source-resolution path as
+        ``model_name``."""
+        if int(self.get("draft_tokens") or 0) <= 0:
+            return None, None
+        ref = self.get("drafter_ref")
+        if ref is None or ref == "self":
+            return None, None  # engine default: early exit at n_layers // 2
+        if isinstance(ref, str) and ref.startswith("self:"):
+            return int(ref.split(":", 1)[1]), None
+        from ..models.convert_hf import (pretrained_causal_lm,
+                                         resolve_model_source)
+
+        d_cfg, d_params, _tok = resolve_model_source(
+            ref, _ARCHS, self.get("tokenizer"), pretrained_causal_lm)
+        if d_params is None:
+            import jax
+            import jax.numpy as jnp
+
+            d_params = LlamaLM(d_cfg).init(
+                jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]
+        return None, (d_cfg, d_params)
+
     def _paged_engine(self, eff: dict):
         """The shared token-granular engine (one per distinct sampling
         config; greedy — the default — shares one). Offline ``transform``
@@ -259,6 +304,7 @@ class HuggingFaceCausalLM(Transformer):
                     "sharded generation rides the dense path")
             sampling = bool(eff["do_sample"])
             slots = self.get("decode_slots") or max(int(self.get("batch_size")), 2)
+            draft_layers, drafter = self._resolve_drafter(model.cfg)
             eng = PagedDecodeEngine(
                 model.cfg, params,
                 block_len=int(self.get("kv_block_len")),
@@ -267,7 +313,10 @@ class HuggingFaceCausalLM(Transformer):
                 top_k=None if eff["top_k"] is None else int(eff["top_k"]),
                 top_p=None if eff["top_p"] is None else float(eff["top_p"]),
                 seed=int(eff["seed"]), eos_id=eff["eos_id"],
-                instance=cb.instance_token(self))
+                instance=cb.instance_token(self),
+                prefix_cache=bool(self.get("prefix_cache")),
+                draft_tokens=int(self.get("draft_tokens") or 0),
+                draft_layers=draft_layers, drafter=drafter)
             engines[key] = eng
             # each engine owns a full device page pool — per-row
             # generation_params must not accumulate one multi-GB pool per

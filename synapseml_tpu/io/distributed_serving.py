@@ -238,6 +238,43 @@ def _eligible_for_model(w: dict, model: str, fleet_labeled: bool) -> bool:
     return w.get("model") is None and not fleet_labeled
 
 
+_PREFIX_SIG_TOKENS = 16   # token-id requests: sig over the first 16 ids
+# (one default KV block's worth — long enough to separate unrelated
+# prompts, short enough that family members diverging after a shared
+# system-prompt head still hash to the SAME worker)
+_PREFIX_SIG_CHARS = 256   # text requests: sig over the first 256 chars
+
+
+def _prefix_sig(body) -> "str | None":
+    """Stable signature of a generation request's prompt HEAD — the
+    rendezvous key for prefix-affinity routing. Hashing only the head (a
+    block's worth of tokens / a system-prompt's worth of text) is the
+    point: requests that SHARE a prefix but diverge later must map to the
+    same worker, so the divergent tail stays out of the key. Non-JSON and
+    non-generation bodies return None (no affinity, plain rotation)."""
+    if body is None:
+        return None
+    if isinstance(body, (bytes, bytearray)):
+        try:
+            body = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return None
+    if not isinstance(body, dict):
+        return None
+    ids = body.get("input_ids")
+    if isinstance(ids, (list, tuple)) and ids:
+        try:
+            head = ",".join(str(int(t)) for t in ids[:_PREFIX_SIG_TOKENS])
+        except (TypeError, ValueError):
+            return None
+        return hashlib.md5(f"ids|{head}".encode()).hexdigest()
+    prompt = body.get("prompt")
+    if isinstance(prompt, str) and prompt:
+        return hashlib.md5(
+            f"txt|{prompt[:_PREFIX_SIG_CHARS]}".encode()).hexdigest()
+    return None
+
+
 def _register_split_gauge(front, instance: str) -> None:
     """Pull-time ``synapseml_route_split_weight`` gauge per version: the
     active canary/traffic split, visible on ``/metrics`` so dashboards see
@@ -721,6 +758,7 @@ class RoutingFront:
                  coalesce_max_group: int = 64,
                  admission=None,
                  route_by_model: bool = False,
+                 route_by_prefix: bool = False,
                  journal: bool = False,
                  journal_max_entries: int = 1024,
                  hedge_after_s: float | None = None,
@@ -767,6 +805,13 @@ class RoutingFront:
         # workers pack stably instead of thrashing their LRU)
         self._admission = admission
         self.route_by_model = bool(route_by_model)
+        # prefix-affinity routing (LLM fleets with the engine prefix cache):
+        # generation requests rendezvous-order workers by a hash of the
+        # prompt HEAD, so requests sharing a system/RAG/few-shot prefix
+        # pack onto the same worker and hit its cached KV pages instead of
+        # spreading the prefix across the fleet. Composes UNDER model
+        # affinity (a worker hosting the named model still wins).
+        self.route_by_prefix = bool(route_by_prefix)
         # continual plane: a RequestLogger attached via set_request_logger
         # records every forwarded exchange AFTER the reply is written —
         # sampled + bounded (shed-before-delay), the flywheel's feedstock
@@ -900,7 +945,10 @@ class RoutingFront:
                     candidates, desperate = front._group_candidates(group)
                 else:
                     t0 = time.perf_counter()
-                    candidates, desperate = front._candidates(model=model)
+                    sig = (_prefix_sig(body) if front.route_by_prefix
+                           and method == "POST" else None)
+                    candidates, desperate = front._candidates(
+                        model=model, prefix_sig=sig)
                 picked = False
                 pending_retry = False  # set by a REAL failure only: the
                 # next attempt after one counts as a retry; a drain skip
@@ -1028,7 +1076,8 @@ class RoutingFront:
             return {f"{h}:{p}": br.state
                     for (h, p), br in self._breakers.items()}
 
-    def _candidates(self, model: str | None = None) -> tuple[list[dict], bool]:
+    def _candidates(self, model: str | None = None,
+                    prefix_sig: str | None = None) -> tuple[list[dict], bool]:
         """(routing order for one request, desperate): breaker-available
         (closed or probe-due) workers round-robin rotated; if none, the
         least-recently-failed worker as a desperation probe. With a traffic
@@ -1043,7 +1092,13 @@ class RoutingFront:
         by a stable rendezvous hash of (model, endpoint) instead of the
         rotation — every request for one model lands on the same worker
         first, so multi-model residency workers pack a consistent subset
-        instead of thrashing their LRU across the fleet."""
+        instead of thrashing their LRU across the fleet.
+
+        ``prefix_sig`` (``route_by_prefix`` fleets, the engine prefix-cache
+        plane) rendezvous-orders workers by hash of (sig, endpoint) BELOW
+        the model/version preferences: requests sharing a prompt head land
+        on the same worker first, so its prefix cache accumulates hits
+        instead of every worker cold-prefilling the same system prompt."""
         full_table = self._table()
         # breaker pruning keys off the FULL table — a model-filtered view
         # must not evict other models' workers' breakers
@@ -1071,6 +1126,15 @@ class RoutingFront:
             rot = self._rr % max(len(alive), 1)
         if alive:
             ordered = alive[rot:] + alive[:rot]
+            if prefix_sig is not None and self.route_by_prefix:
+                # applied FIRST so the stable version/model partitions
+                # below preserve the prefix order within each tier —
+                # affinity composes as model > version > prefix
+                def prank(w):
+                    key = f"{prefix_sig}|{w.get('host')}:{w.get('port')}"
+                    return hashlib.md5(key.encode()).hexdigest()
+
+                ordered = sorted(ordered, key=prank)
             chosen = self._draw_version()
             if chosen is not None:
                 preferred = [w for w in ordered
@@ -1085,8 +1149,6 @@ class RoutingFront:
                 elif self.route_by_model:
                     # rendezvous: stable per-model order (hash, not the
                     # rotation) so on-demand residency stays sticky
-                    import hashlib
-
                     def rank(w):
                         key = f"{model}|{w.get('host')}:{w.get('port')}"
                         return hashlib.md5(key.encode()).hexdigest()
@@ -1515,6 +1577,7 @@ class RoutingFront:
                                      "content-length", "x-request-key",
                                      "x-deadline-ms")}
         obs.get_tracer().inject(hdrs)
+        sig = _prefix_sig(entry.body) if self.route_by_prefix else None
         attempts = 0
         attempt_seq = 0
         tried: set[str] = set()
@@ -1526,7 +1589,7 @@ class RoutingFront:
                     "error": "deadline exceeded", "done": True,
                     "finish_reason": "deadline"}, status=504)
                 return
-            candidates, _ = self._candidates(model=model)
+            candidates, _ = self._candidates(model=model, prefix_sig=sig)
             # don't hand the resubmit straight back to the endpoint that
             # just failed — unless it is the only one left
             fresh = [w for w in candidates

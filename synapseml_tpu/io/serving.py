@@ -289,6 +289,10 @@ class ServingServer:
         self.started_at = time.monotonic()
         # set by serve_multi_model: the residency manager /admin/stats reads
         self.residency = None
+        # set by serve_llm: engine-level stats (prefix-cache occupancy /
+        # hit-rate, speculation acceptance) surface on /admin/stats so the
+        # routing front and autoscaler read them without scraping /metrics
+        self.llm_stats_fn = None
         # continual plane (continual/logger.py): a RequestLogger attached
         # here records every batched exchange at reply time — sampled,
         # bounded, shed-before-delay, so serving latency never pays for it
@@ -519,6 +523,11 @@ class ServingServer:
         if self.residency is not None:
             out["resident"] = self.residency.resident()
             out["resident_bytes"] = self.residency.resident_bytes()
+        if self.llm_stats_fn is not None:
+            try:
+                out["llm"] = self.llm_stats_fn()
+            except Exception:  # noqa: BLE001 — stats must not fail /admin
+                out["llm"] = None
         return out
 
     def _admin_drain(self, body: bytes) -> tuple[int, dict]:
@@ -1268,6 +1277,16 @@ def serve_llm(stage, port: int = 0, poll_ms: float = 20.0,
             time.sleep(0.02)
 
     server.drain_barrier = drain_barrier
+
+    def llm_stats():
+        # reads the LIVE engine (hot-swaps rebuild it), so /admin/stats
+        # always reflects the serving engine, not the one at boot
+        eng = state["engine"]
+        if eng is None or not hasattr(eng, "stats"):
+            return None
+        return eng.stats()
+
+    server.llm_stats_fn = llm_stats
 
     def loop():
         # ONE consistent snapshot: a hot-swap landing during this (long,

@@ -17,7 +17,8 @@ from .transformer import (Encoder, MlpBlock, MoEBlock, TransformerConfig,
                           rope_frequencies)
 
 __all__ = ["llama2_7b", "llama_tiny", "LlamaLM", "generate", "greedy_generate",
-           "PagedLlamaLM", "paged_prefill", "paged_decode_step"]
+           "PagedLlamaLM", "paged_prefill", "paged_decode_step",
+           "paged_extend", "paged_verify", "early_exit_params"]
 
 
 def llama2_7b(**kw) -> TransformerConfig:
@@ -248,8 +249,10 @@ class PagedAttention(nn.Module):
         write_pos: [B,T] page-slot index per token (-1 = don't write, goes
         to the trash page). kv_mask_len: [B] number of attendable logical
         positions (prefill: the padded prompt width with a pad mask handled
-        by caller-supplied write_pos; decode: seq_len+1 incl. this token).
-        Returns (out, k_pages, v_pages)."""
+        by caller-supplied write_pos; decode: seq_len+1 incl. this token),
+        or [B,T] per-token visibility horizons for multi-token decode-mode
+        windows (suffix-extend prefill over a cached prefix, speculative
+        verify). Returns (out, k_pages, v_pages)."""
         cfg = self.cfg
         B, T, _ = x.shape
         H, KV, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
@@ -298,8 +301,16 @@ class PagedAttention(nn.Module):
                           + jnp.arange(bl)[None, None, :]).reshape(B, L)
             kk = k_flat[gather_idx]                      # [B, L, KV, D]
             vv = v_flat[gather_idx]
-            mask = (jnp.arange(L)[None, :]
-                    < kv_mask_len[:, None])[:, None, None, :]
+            if kv_mask_len.ndim == 2:
+                # per-token horizon [B,T]: the scatter above runs BEFORE this
+                # gather, so an in-window token already sees earlier window
+                # tokens through the pool — a growing horizon per token is
+                # exactly intra-window causality
+                mask = (jnp.arange(L)[None, None, :]
+                        < kv_mask_len[:, :, None])[:, None, :, :]
+            else:
+                mask = (jnp.arange(L)[None, :]
+                        < kv_mask_len[:, None])[:, None, None, :]
         if KV != H:
             kk = jnp.repeat(kk, H // KV, axis=2)
             vv = jnp.repeat(vv, H // KV, axis=2)
@@ -446,3 +457,77 @@ def paged_decode_step(cfg: TransformerConfig, block_len: int, params,
         {"params": params}, tokens[:, None], k_pages, v_pages, block_tables,
         positions, write_pos, kv_mask_len)
     return logits[:, 0], k_pages, v_pages
+
+
+def paged_extend(cfg: TransformerConfig, block_len: int, params,
+                 suffix_ids: jax.Array, suffix_mask: jax.Array,
+                 start_pos: jax.Array, block_tables: jax.Array,
+                 k_pages: jax.Array, v_pages: jax.Array):
+    """Suffix prefill over a PREFIX-CACHED sequence -> (last-real logits
+    [B,V], updated pages).
+
+    ``suffix_ids``/``suffix_mask``: [B,Q] right-padded uncached tail of the
+    prompt; ``start_pos``: [B] logical position of the suffix's first token
+    (= tokens already resident in the sequence's pages from the prefix
+    cache). Runs in decode mode so every suffix token attends over the
+    POOLED prefix K/V through the block table; the per-token ``kv_mask_len``
+    horizon keeps the window causal while the prompt-style ``write_pos``
+    lands each real suffix token in its page slot."""
+    B, Q = suffix_ids.shape
+    t_idx = jnp.broadcast_to(jnp.arange(Q)[None, :], (B, Q))
+    positions = start_pos[:, None].astype(jnp.int32) + t_idx
+    write_pos = jnp.where(suffix_mask > 0, positions, -1)
+    kv_mask_len = jnp.where(suffix_mask > 0, positions + 1, 1)
+    lengths = jnp.sum(suffix_mask.astype(jnp.int32), axis=-1)
+    model = PagedLlamaLM(cfg, block_len, mode="decode")
+    logits, k_pages, v_pages = model.apply(
+        {"params": params}, suffix_ids, k_pages, v_pages, block_tables,
+        positions, write_pos, kv_mask_len)
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)[:, 0]
+    return last, k_pages, v_pages
+
+
+def paged_verify(cfg: TransformerConfig, block_len: int, params,
+                 tokens: jax.Array, seq_lens: jax.Array, active: jax.Array,
+                 block_tables: jax.Array, k_pages: jax.Array,
+                 v_pages: jax.Array):
+    """Speculative verify window -> (logits [S,W,V], updated pages).
+
+    ``tokens``: [S,W] per slot — the last committed token followed by W-1
+    draft tokens; ``seq_lens``: [S] tokens already in the pages BEFORE this
+    window (= the first window token's logical position); ``active``: [S].
+    One forward scores every draft position (logits[s,t] predicts the token
+    AFTER tokens[s,t]); rejected drafts' page writes sit past the sequence's
+    committed ``tokens_in_pages`` and are overwritten by later steps, so no
+    rollback scatter is needed."""
+    S, W = tokens.shape
+    t_idx = jnp.broadcast_to(jnp.arange(W)[None, :], (S, W))
+    positions = seq_lens[:, None].astype(jnp.int32) + t_idx
+    write_pos = jnp.where(active[:, None], positions, -1)
+    kv_mask_len = jnp.where(active[:, None], positions + 1, 1)
+    model = PagedLlamaLM(cfg, block_len, mode="decode")
+    logits, k_pages, v_pages = model.apply(
+        {"params": params}, tokens, k_pages, v_pages, block_tables,
+        positions, write_pos, kv_mask_len)
+    return logits, k_pages, v_pages
+
+
+def early_exit_params(params, n_layers: int):
+    """Host-side subset of a ``LlamaLM``/``PagedLlamaLM`` param tree for an
+    EARLY-EXIT draft model: keeps ``embed``, ``lm_head``, the decoder's
+    final norm (``RMSNorm_0``) and only ``layer_i`` for ``i < n_layers``.
+    Applying the paged modules with ``dataclasses.replace(cfg,
+    n_layers=n_layers)`` over this subset is the self-draft forward — no
+    second checkpoint, no re-init."""
+    dec = params["decoder"]
+    sub = {}
+    for k, v in dec.items():
+        if k.startswith("layer_"):
+            if int(k.split("_", 1)[1]) < n_layers:
+                sub[k] = v
+        else:
+            sub[k] = v
+    out = {k: v for k, v in params.items() if k != "decoder"}
+    out["decoder"] = sub
+    return out

@@ -81,6 +81,20 @@ _ENGINE_METRICS = obs.HandleCache(lambda reg: {
     "finished": reg.counter(
         "synapseml_llm_sequences_finished_total",
         "sequences completed, by finish reason", ("reason",)),
+    "spec_proposed": reg.counter(
+        "synapseml_llm_spec_tokens_proposed_total",
+        "draft tokens proposed to the speculative verify step"),
+    "spec_accepted": reg.counter(
+        "synapseml_llm_spec_tokens_accepted_total",
+        "draft tokens the full model confirmed (greedy match)"),
+    "spec_steps": reg.counter(
+        "synapseml_llm_spec_steps_total",
+        "engine steps by decode mode: 'spec' = fused draft+verify, "
+        "'fallback' = plain single-token (pool too tight for the window)",
+        ("mode",)),
+    "spec_accept_rate": reg.gauge(
+        "synapseml_llm_spec_acceptance_rate",
+        "cumulative accepted / proposed draft tokens"),
 })
 
 
@@ -98,7 +112,15 @@ class BlockAllocator:
     """Free-list allocator over the physical page pool. Block 0 is the
     reserved trash page and is never handed out; double-free and
     allocate-while-live are hard errors (the no-aliasing invariant the
-    property test leans on)."""
+    property test leans on).
+
+    Blocks are REFERENCE-COUNTED for prefix-KV sharing: ``alloc`` hands out
+    blocks at refcount 1, :meth:`ref` lets another holder (the prefix
+    cache, a prefix-hit sequence) pin an already-live block, and ``free``
+    drops ONE reference per call — the block returns to the free list only
+    when the last holder lets go. Freeing a non-live block (refcount
+    already zero) is still the same hard error, so a double free cannot
+    hide behind sharing."""
 
     def __init__(self, n_blocks: int):
         if n_blocks < 2:
@@ -107,6 +129,7 @@ class BlockAllocator:
         self.n_blocks = int(n_blocks)
         self._free: list[int] = list(range(self.n_blocks - 1, 0, -1))
         self._live: set[int] = set()
+        self._refs: dict[int, int] = {}
 
     @property
     def free_count(self) -> int:
@@ -129,7 +152,22 @@ class BlockAllocator:
             return None
         out = [self._free.pop() for _ in range(n)]
         self._live.update(out)
+        for b in out:
+            self._refs[b] = 1
         return out
+
+    def ref(self, block: int) -> None:
+        """Add one reference to an already-live block (prefix sharing).
+        Referencing a non-live block is a hard error — it would resurrect
+        freed pages and alias whoever allocates them next."""
+        if block not in self._live:
+            raise RuntimeError(
+                f"ref on block {block} that is not live (use-after-free "
+                f"or trash-page share — an aliasing bug)")
+        self._refs[block] += 1
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(block, 0)
 
     def free(self, blocks: Iterable[int]) -> None:
         for b in blocks:
@@ -137,8 +175,11 @@ class BlockAllocator:
                 raise RuntimeError(
                     f"freeing block {b} that is not live (double free or "
                     f"trash-page free — an aliasing bug)")
-            self._live.remove(b)
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._live.remove(b)
+                self._free.append(b)
 
 
 @dataclass
@@ -164,6 +205,9 @@ class SequenceState:
     #                                 rides exports so a drained worker's
     #                                 handoff can find the front's journal
     #                                 entry (worker request_ids are local)
+    registered_blocks: int = 0     # full blocks already chain-hashed into
+    prefix_digest: bytes = b""     # the prefix cache, + the chain digest
+    #                                at that boundary (incremental hashing)
 
     @property
     def context_ids(self) -> list:
@@ -198,7 +242,9 @@ class PagedDecodeEngine:
                  top_p: float | None = None, seed: int = 0,
                  eos_id: int | None = None, bucketer=None,
                  instance: Any = None, fn_prefix: str = "llama_paged",
-                 donate_pages: bool = True):
+                 donate_pages: bool = True, prefix_cache: bool = False,
+                 draft_tokens: int = 0, draft_layers: int | None = None,
+                 drafter: tuple | None = None):
         import jax.numpy as jnp
 
         self.cfg = cfg
@@ -243,6 +289,54 @@ class PagedDecodeEngine:
         # CPU backend this is the difference between winning and losing the
         # continuous-vs-RTC A/B
         self._donate = bool(donate_pages)
+        # --- prefix KV cache (OFF by default: zero behavior change) ------
+        self._prefix_cache = None
+        if prefix_cache:
+            from .prefix_cache import PrefixCache
+            self._prefix_cache = PrefixCache(self.allocator, self.block_len)
+        # --- greedy speculative decoding (OFF by default) ----------------
+        self.draft_tokens = int(draft_tokens)
+        if self.draft_tokens < 0:
+            raise ValueError(f"draft_tokens={draft_tokens}")
+        if self.draft_tokens > 0 and temperature is not None \
+                and temperature > 0.0:
+            raise ValueError(
+                "speculative decoding is greedy-only (the acceptance rule "
+                "compares argmaxes); temperature > 0 would break the "
+                "token-identity guarantee — set draft_tokens=0 to sample")
+        self.draft_layers = None
+        self._drafter = None
+        self._draft_params = None
+        if self.draft_tokens > 0:
+            if drafter is not None:
+                # a registry-resolved small model drafts over a dense
+                # LEFT-ALIGNED context window (no second page pool; window
+                # truncation only affects draft quality, never correctness
+                # — the full model's verify is the ground truth)
+                d_cfg = drafter[0]
+                if d_cfg.max_len < self.max_len:
+                    raise ValueError(
+                        f"drafter max_len={d_cfg.max_len} cannot position-"
+                        f"encode the engine horizon max_len={self.max_len}")
+                self._drafter = (d_cfg, drafter[1])
+                self._draft_params = drafter[1]
+                self._draft_window = self.bucketer.seq_bucket_for(
+                    min(64, self.max_len), cap=self.max_len)
+            else:
+                # self-draft: early-exit at draft_layers over the SAME
+                # params and pool leaves (layers < E)
+                from .flax_nets.llama import early_exit_params
+                E = draft_layers if draft_layers is not None \
+                    else max(1, cfg.n_layers // 2)
+                if not 1 <= E <= cfg.n_layers:
+                    raise ValueError(
+                        f"draft_layers={E} outside [1, {cfg.n_layers}]")
+                self.draft_layers = int(E)
+                self._draft_params = early_exit_params(params, self.draft_layers)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_steps = 0
+        self._spec_fallbacks = 0
         self._lock = threading.RLock()
         self._waiting: deque[SequenceState] = deque()
         self._active: list[SequenceState] = []
@@ -324,6 +418,137 @@ class PagedDecodeEngine:
             (S, self.max_blocks) + self._cfg_key(), _build,
             instance=self._instance, dtype="int32")
 
+    def _extend_fn(self, B: int, Q: int) -> Callable:
+        """Suffix prefill over a cached prefix: COW-copies each row's
+        divergence block (``cow_dst`` < 0 = no copy; the trash page absorbs
+        the no-op write), then prefills only the UNCACHED suffix with
+        decode-mode attention over the pooled prefix KV."""
+        def _build():
+            import jax
+            import jax.numpy as jnp
+
+            from .flax_nets.llama import paged_extend
+
+            cfg, bl = self.cfg, self.block_len
+            select = self._selector()
+
+            def fn(params, ids, mask, start_pos, tables, cow_src, cow_dst,
+                   kp, vp, uids, steps):
+                src = jnp.maximum(cow_src, 0)
+                dst = jnp.maximum(cow_dst, 0)
+                do = (cow_dst >= 0)[:, None, None, None]
+
+                def cow(pages):
+                    return pages.at[dst].set(
+                        jnp.where(do, pages[src], pages[dst]))
+
+                kp = tuple(cow(p) for p in kp)
+                vp = tuple(cow(p) for p in vp)
+                logits, kp, vp = paged_extend(cfg, bl, params, ids, mask,
+                                              start_pos, tables, kp, vp)
+                return select(logits, uids, steps), kp, vp
+
+            donate = (7, 8) if self._donate else ()
+            return jax.jit(fn, donate_argnums=donate)
+
+        return cb.get_compiled_cache().get(
+            f"{self._fn_prefix}_extend",
+            (B, Q, self.max_blocks) + self._cfg_key(), _build,
+            instance=self._instance, dtype="int32")
+
+    def _spec_fn(self, S: int) -> Callable:
+        """Fused greedy draft + verify: K single-token draft steps (early
+        exit over the shared pool leaves, or a dense windowed drafter) then
+        ONE K+1-token verify forward of the full model. Returns
+        (pred [S,K+1], n_accepted [S], pools); the emitted tokens are
+        ``pred[:, :n_accepted+1]`` — token-identical to plain greedy decode
+        because a draft survives only where the full model's argmax agrees
+        and the first disagreement emits the full model's own token."""
+        K = self.draft_tokens
+
+        def _build():
+            import dataclasses
+
+            import jax
+            import jax.numpy as jnp
+
+            from .flax_nets.llama import (LlamaLM, paged_decode_step,
+                                          paged_verify)
+
+            cfg, bl = self.cfg, self.block_len
+
+            def _accept(window, logits):
+                pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                match = (pred[:, :K] == window[:, 1:]).astype(jnp.int32)
+                n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                return pred, n_acc
+
+            if self._drafter is not None:
+                d_cfg, _ = self._drafter
+                W = self._draft_window
+                d_model = LlamaLM(d_cfg)
+
+                def fn(params, d_params, last_tok, seq_lens, active, tables,
+                       win, pos, L0, kp, vp):
+                    S_ = last_tok.shape[0]
+                    wm = (jnp.arange(W)[None, :]
+                          < L0[:, None]).astype(jnp.int32)
+                    drafts = []
+                    for j in range(K):
+                        logits = d_model.apply({"params": d_params}, win,
+                                               positions=pos,
+                                               attention_mask=wm)
+                        idx = jnp.maximum(L0 + j - 1, 0)
+                        last = jnp.take_along_axis(
+                            logits, idx[:, None, None], axis=1)[:, 0]
+                        d = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                        drafts.append(d)
+                        rows = jnp.arange(S_)
+                        win = win.at[rows, L0 + j].set(d)
+                        wm = wm.at[rows, L0 + j].set(1)
+                    window = jnp.stack([last_tok] + drafts, axis=1)
+                    logits, kp, vp = paged_verify(cfg, bl, params, window,
+                                                  seq_lens, active, tables,
+                                                  kp, vp)
+                    pred, n_acc = _accept(window, logits)
+                    return pred, n_acc, kp, vp
+
+                donate = (9, 10) if self._donate else ()
+                return jax.jit(fn, donate_argnums=donate)
+
+            E = self.draft_layers
+            d_cfg = dataclasses.replace(cfg, n_layers=E)
+
+            def fn(params, d_params, last_tok, seq_lens, active, tables,
+                   kp, vp):
+                kpE, vpE = kp[:E], vp[:E]
+                drafts = []
+                d = last_tok
+                for j in range(K):
+                    logits, kpE, vpE = paged_decode_step(
+                        d_cfg, bl, d_params, d, seq_lens + j, active,
+                        tables, kpE, vpE)
+                    d = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    drafts.append(d)
+                kp = kpE + kp[E:]
+                vp = vpE + vp[E:]
+                window = jnp.stack([last_tok] + drafts, axis=1)
+                logits, kp, vp = paged_verify(cfg, bl, params, window,
+                                              seq_lens, active, tables,
+                                              kp, vp)
+                pred, n_acc = _accept(window, logits)
+                return pred, n_acc, kp, vp
+
+            donate = (6, 7) if self._donate else ()
+            return jax.jit(fn, donate_argnums=donate)
+
+        mode = ("ext", self._draft_window) if self._drafter is not None \
+            else ("self", self.draft_layers)
+        return cb.get_compiled_cache().get(
+            f"{self._fn_prefix}_spec",
+            (S, self.max_blocks, K) + mode + self._cfg_key(), _build,
+            instance=self._instance, dtype="int32")
+
     # ------------------------------------------------------------------
     # scheduling surface
     # ------------------------------------------------------------------
@@ -360,6 +585,12 @@ class PagedDecodeEngine:
         return seq
 
     @property
+    def prefix_cache(self):
+        """The engine's :class:`~.prefix_cache.PrefixCache`, or None when
+        prefix caching is off."""
+        return self._prefix_cache
+
+    @property
     def active_count(self) -> int:
         return len(self._active)
 
@@ -372,6 +603,34 @@ class PagedDecodeEngine:
 
     def _blocks_for(self, n_tokens: int) -> int:
         return -(-max(n_tokens, 1) // self.block_len)
+
+    def _reclaim(self, n: int) -> None:
+        """Make room for ``n`` blocks by evicting cold prefix-cache entries
+        — cached pages are strictly cheaper to give up than preempting (and
+        recomputing) a live sequence, so every alloc path tries this
+        first."""
+        if self._prefix_cache is not None and self.allocator.free_count < n:
+            self._prefix_cache.evict(n - self.allocator.free_count)
+
+    def _register_blocks(self, seq: SequenceState) -> None:
+        """Chain-hash every newly FILLED block of committed tokens into the
+        prefix cache (incremental: picks up from the sequence's recorded
+        digest). Full blocks are immutable from here on — writes only ever
+        target positions >= ``tokens_in_pages`` — so cached pages stay
+        byte-stable while shared."""
+        pc = self._prefix_cache
+        if pc is None:
+            return
+        bl = self.block_len
+        n_full = min(seq.tokens_in_pages // bl, len(seq.blocks))
+        if n_full <= seq.registered_blocks:
+            return
+        ctx = seq.context_ids
+        h = seq.prefix_digest
+        for i in range(seq.registered_blocks, n_full):
+            h = pc.insert(h, ctx[i * bl:(i + 1) * bl], seq.blocks[i])
+        seq.registered_blocks = n_full
+        seq.prefix_digest = h
 
     def _update_pool_gauges(self) -> None:
         m = _ENGINE_METRICS.get()
@@ -410,23 +669,39 @@ class PagedDecodeEngine:
             done, reason = True, "length"
         if done:
             self._finish(seq, reason)
+        # "index" is the token's 0-based position in the generation —
+        # every _emit call sits right after its generated.append, so this
+        # is exact even when one speculative step emits several tokens
+        # (consumers reading len(generated) AFTER the step would see only
+        # the window's final length)
         return {"seq": seq, "token": int(token), "done": done,
+                "index": len(seq.generated) - 1,
                 "finish_reason": seq.finish_reason}
 
     def admit(self) -> list[dict]:
         """Prefill waiting sequences into free capacity. Batches up to
         ``prefill_batch`` sequences per program call, prompts padded to one
-        seq-ladder bucket — compile count stays <= len(seq ladder)."""
+        seq-ladder bucket — compile count stays <= len(seq ladder).
+
+        With the prefix cache on, each candidate first looks up its longest
+        cached full-block chain: shared blocks are referenced (never
+        written), only fresh blocks are allocated, and the sequence rides
+        the EXTEND program — prefill over just the uncached suffix,
+        attending to the resident prefix KV through the block table. A
+        fully-cached prompt COWs its divergence block so the mandatory
+        last-token recompute writes a private copy."""
         import jax.numpy as jnp
 
         events: list[dict] = self.expire_deadlines()
         with self._lock:
             while self._waiting and len(self._active) < self.max_slots:
-                group: list[SequenceState] = []
+                # (seq, reuse_tokens, cow_src) triples
+                group: list[tuple[SequenceState, int, int]] = []
                 while (self._waiting and len(group) < self.prefill_batch
                        and len(self._active) + len(group) < self.max_slots):
                     seq = self._waiting[0]
-                    need = self._blocks_for(len(seq.context_ids))
+                    ctx = seq.context_ids
+                    need = self._blocks_for(len(ctx))
                     if need > self.allocator.capacity:
                         # no amount of freeing can ever satisfy this
                         # sequence — terminate it instead of wedging the
@@ -437,48 +712,142 @@ class PagedDecodeEngine:
                                        "done": True,
                                        "finish_reason": "kv_capacity"})
                         continue
-                    got = self.allocator.alloc(need)
+                    shared: list[int] = []
+                    digests: list[bytes] = []
+                    reuse, cow_src = 0, -1
+                    if self._prefix_cache is not None:
+                        cblocks, digests = self._prefix_cache.lookup(ctx)
+                        # whole blocks only, and ALWAYS leave >= 1 token of
+                        # suffix to prefill (the last-position logits seed
+                        # the first generated token)
+                        reuse = min(len(cblocks) * self.block_len,
+                                    len(ctx) - 1)
+                        n_shared = reuse // self.block_len
+                        if reuse % self.block_len:
+                            # fully-cached prompt: the divergence block is
+                            # shared, so the suffix write gets a COW copy
+                            cow_src = cblocks[n_shared]
+                        shared = cblocks[:n_shared]
+                        # pin BEFORE any eviction can run: _reclaim (ours,
+                        # or a later group member's) frees refcount-1
+                        # cache entries, so without the extra ref it could
+                        # evict these very blocks and alloc() would hand
+                        # them back as fresh suffix pages — the "shared
+                        # prefix" silently aliasing its own suffix writes.
+                        # cow_src is pinned too: the extend program reads
+                        # it for the divergence-block copy AFTER every
+                        # group member has run its own reclaim (unpinned
+                        # once the program has executed).
+                        for b in shared:
+                            self.allocator.ref(b)
+                        if cow_src >= 0:
+                            self.allocator.ref(cow_src)
+                    need_new = need - len(shared)
+                    self._reclaim(need_new)
+                    got = self.allocator.alloc(need_new)
                     if got is None:
+                        for b in shared:  # unpin: the seq stays waiting
+                            self.allocator.free([b])
+                        if cow_src >= 0:
+                            self.allocator.free([cow_src])
                         break  # pool dry: decode must free pages first
                     self._waiting.popleft()
-                    seq.blocks = got
-                    group.append(seq)
+                    seq.blocks = shared + got
+                    seq.registered_blocks = len(shared)
+                    seq.prefix_digest = digests[len(shared) - 1] \
+                        if shared else b""
+                    if reuse and self._prefix_cache is not None:
+                        self._prefix_cache.note_reused(reuse)
+                    group.append((seq, reuse, cow_src))
                 if not group:
                     break
-                t0 = time.perf_counter()
+                plain = [g for g in group if g[1] == 0]
+                hits = [g for g in group if g[1] > 0]
                 B = self.prefill_batch
-                P = self.bucketer.seq_bucket_for(
-                    max(len(s.context_ids) for s in group), cap=self.max_len)
-                ids = np.zeros((B, P), np.int32)
-                mask = np.zeros((B, P), np.int32)
-                tables = np.zeros((B, self.max_blocks), np.int32)
-                uids = np.zeros((B,), np.int32)
-                steps = np.zeros((B,), np.int32)
-                for i, seq in enumerate(group):
-                    ctx = seq.context_ids
-                    ids[i, :len(ctx)] = ctx
-                    mask[i, :len(ctx)] = 1
-                    tables[i, :len(seq.blocks)] = seq.blocks
-                    uids[i] = seq.uid
-                    steps[i] = len(seq.generated)
-                fn = self._prefill_fn(B, P)
-                next_tok, self._k_pages, self._v_pages = fn(
-                    self.params, jnp.asarray(ids), jnp.asarray(mask),
-                    jnp.asarray(tables), self._k_pages, self._v_pages,
-                    jnp.asarray(uids), jnp.asarray(steps))
-                next_tok = np.asarray(next_tok)
                 m = _ENGINE_METRICS.get()
-                m["step_ms"].observe((time.perf_counter() - t0) * 1e3,
-                                     phase="prefill")
-                m["tokens"].inc(len(group), phase="prefill")
-                for i, seq in enumerate(group):
+                admitted: list[SequenceState] = []
+                if plain:
+                    t0 = time.perf_counter()
+                    P = self.bucketer.seq_bucket_for(
+                        max(len(s.context_ids) for s, _, _ in plain),
+                        cap=self.max_len)
+                    ids = np.zeros((B, P), np.int32)
+                    mask = np.zeros((B, P), np.int32)
+                    tables = np.zeros((B, self.max_blocks), np.int32)
+                    uids = np.zeros((B,), np.int32)
+                    steps = np.zeros((B,), np.int32)
+                    for i, (seq, _, _) in enumerate(plain):
+                        ctx = seq.context_ids
+                        ids[i, :len(ctx)] = ctx
+                        mask[i, :len(ctx)] = 1
+                        tables[i, :len(seq.blocks)] = seq.blocks
+                        uids[i] = seq.uid
+                        steps[i] = len(seq.generated)
+                    fn = self._prefill_fn(B, P)
+                    next_tok, self._k_pages, self._v_pages = fn(
+                        self.params, jnp.asarray(ids), jnp.asarray(mask),
+                        jnp.asarray(tables), self._k_pages, self._v_pages,
+                        jnp.asarray(uids), jnp.asarray(steps))
+                    next_tok = np.asarray(next_tok)
+                    m["step_ms"].observe((time.perf_counter() - t0) * 1e3,
+                                         phase="prefill")
+                    for i, (seq, _, _) in enumerate(plain):
+                        seq._admit_token = int(next_tok[i])
+                        admitted.append(seq)
+                if hits:
+                    t0 = time.perf_counter()
+                    Q = self.bucketer.seq_bucket_for(
+                        max(len(s.context_ids) - r for s, r, _ in hits),
+                        cap=self.max_len)
+                    ids = np.zeros((B, Q), np.int32)
+                    mask = np.zeros((B, Q), np.int32)
+                    start = np.zeros((B,), np.int32)
+                    tables = np.zeros((B, self.max_blocks), np.int32)
+                    cow_src = np.full((B,), -1, np.int32)
+                    cow_dst = np.full((B,), -1, np.int32)
+                    uids = np.zeros((B,), np.int32)
+                    steps = np.zeros((B,), np.int32)
+                    for i, (seq, r, cs) in enumerate(hits):
+                        suffix = seq.context_ids[r:]
+                        ids[i, :len(suffix)] = suffix
+                        mask[i, :len(suffix)] = 1
+                        start[i] = r
+                        tables[i, :len(seq.blocks)] = seq.blocks
+                        if cs >= 0:
+                            cow_src[i] = cs
+                            cow_dst[i] = seq.blocks[r // self.block_len]
+                        uids[i] = seq.uid
+                        steps[i] = len(seq.generated)
+                    fn = self._extend_fn(B, Q)
+                    next_tok, self._k_pages, self._v_pages = fn(
+                        self.params, jnp.asarray(ids), jnp.asarray(mask),
+                        jnp.asarray(start), jnp.asarray(tables),
+                        jnp.asarray(cow_src), jnp.asarray(cow_dst),
+                        self._k_pages, self._v_pages,
+                        jnp.asarray(uids), jnp.asarray(steps))
+                    next_tok = np.asarray(next_tok)
+                    m["step_ms"].observe((time.perf_counter() - t0) * 1e3,
+                                         phase="prefill")
+                    for i, (seq, _, cs) in enumerate(hits):
+                        if cs >= 0:
+                            # the divergence-block copy has executed;
+                            # release the lookup-time pin on its source
+                            self.allocator.free([cs])
+                        seq._admit_token = int(next_tok[i])
+                        admitted.append(seq)
+                m["tokens"].inc(len(admitted), phase="prefill")
+                for seq in admitted:
+                    tok = seq._admit_token
+                    del seq._admit_token
                     seq.tokens_in_pages = len(seq.context_ids)
-                    seq.generated.append(int(next_tok[i]))
+                    seq.generated.append(tok)
                     self._active.append(seq)
                     if self._freed_since_admit > 0:
                         self._freed_since_admit -= 1
                         m["refilled"].inc()
-                    events.append(self._emit(seq, int(next_tok[i])))
+                    events.append(self._emit(seq, tok))
+                    if not seq.done:
+                        self._register_blocks(seq)
                 self._update_pool_gauges()
         return events
 
@@ -493,6 +862,8 @@ class PagedDecodeEngine:
             self.allocator.free(victim.blocks)
             victim.blocks = []
             victim.tokens_in_pages = 0
+            victim.registered_blocks = 0
+            victim.prefix_digest = b""
             victim.preemptions += 1
             self._waiting.appendleft(victim)
             self._freed_since_admit += 1
@@ -500,15 +871,117 @@ class PagedDecodeEngine:
             return True
         return False
 
+    def _try_spec_step(self, events: list[dict]) -> bool:
+        """Attempt one fused draft+verify step for every active sequence
+        (caller holds the lock). Returns False — telling :meth:`step` to run
+        the plain single-token program — when any sequence's K+1-token
+        window would cross ``max_len`` or the pool cannot cover the window
+        even after prefix-cache eviction; preempting a neighbor just to
+        speculate is never worth it."""
+        import jax.numpy as jnp
+
+        K = self.draft_tokens
+        batch = [s for s in self._active if not s.done]
+        if not batch:
+            return True
+        # every window write position n..n+K must fit the engine horizon
+        if any(s.tokens_in_pages + K >= self.max_len for s in batch):
+            return False
+        # grow tables to cover the whole window (cache eviction only — no
+        # preemption on the speculative path)
+        for seq in batch:
+            need = (seq.tokens_in_pages + K) // self.block_len + 1
+            grow = need - len(seq.blocks)
+            if grow <= 0:
+                continue
+            self._reclaim(grow)
+            got = self.allocator.alloc(grow)
+            if got is None:
+                return False
+            seq.blocks.extend(got)
+        t0 = time.perf_counter()
+        S_active = len(batch)
+        S = next(r for r in self.slot_rungs if r >= S_active)
+        last_tok = np.zeros((S,), np.int32)
+        seq_lens = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        tables = np.zeros((S, self.max_blocks), np.int32)
+        for i, seq in enumerate(batch):
+            last_tok[i] = seq.generated[-1]
+            seq_lens[i] = seq.tokens_in_pages
+            active[i] = True
+            tables[i, :len(seq.blocks)] = seq.blocks
+        fn = self._spec_fn(S)
+        if self._drafter is not None:
+            W = self._draft_window
+            win = np.zeros((S, W), np.int32)
+            pos = np.zeros((S, W), np.int32)
+            L0 = np.zeros((S,), np.int32)
+            for i, seq in enumerate(batch):
+                ctx = seq.context_ids
+                L = min(len(ctx), W - K)
+                win[i, :L] = ctx[-L:]
+                pos[i, :] = (len(ctx) - L) + np.arange(W)
+                L0[i] = L
+            pred, n_acc, self._k_pages, self._v_pages = fn(
+                self.params, self._draft_params, jnp.asarray(last_tok),
+                jnp.asarray(seq_lens), jnp.asarray(active),
+                jnp.asarray(tables), jnp.asarray(win), jnp.asarray(pos),
+                jnp.asarray(L0), self._k_pages, self._v_pages)
+        else:
+            pred, n_acc, self._k_pages, self._v_pages = fn(
+                self.params, self._draft_params, jnp.asarray(last_tok),
+                jnp.asarray(seq_lens), jnp.asarray(active),
+                jnp.asarray(tables), self._k_pages, self._v_pages)
+        pred = np.asarray(pred)
+        n_acc = np.asarray(n_acc)
+        m = _ENGINE_METRICS.get()
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        m["step_ms"].observe(dt_ms, phase="decode")
+        emitted = 0
+        for i, seq in enumerate(batch):
+            a = int(n_acc[i])
+            self._spec_proposed += K
+            self._spec_accepted += a
+            for t in range(a + 1):
+                tok = int(pred[i, t])
+                seq.tokens_in_pages += 1
+                seq.generated.append(tok)
+                emitted += 1
+                ev = self._emit(seq, tok)
+                events.append(ev)
+                if ev["done"]:
+                    break  # EOS/length inside the window: the tail tokens
+                    #        would not exist under plain decode either
+            if not seq.done:
+                self._register_blocks(seq)
+        self._spec_steps += 1
+        m["spec_steps"].inc(mode="spec")
+        m["spec_proposed"].inc(K * S_active)
+        m["spec_accepted"].inc(int(n_acc[:S_active].sum()))
+        if self._spec_proposed:
+            m["spec_accept_rate"].labels().set(
+                self._spec_accepted / self._spec_proposed)
+        m["token_ms"].labels().observe(dt_ms / max(emitted, 1))
+        m["tokens"].inc(emitted, phase="decode")
+        self._update_pool_gauges()
+        return True
+
     def step(self) -> list[dict]:
         """One decode step for every active sequence (bucketed slot count);
         returns per-sequence token events. Finished sequences free their
-        pages immediately — the next :meth:`admit` refills the capacity."""
+        pages immediately — the next :meth:`admit` refills the capacity.
+        With ``draft_tokens`` > 0 the step runs the fused draft+verify
+        program instead (up to ``draft_tokens``+1 tokens per sequence per
+        step), falling back to the plain single-token program whenever the
+        pool or the ``max_len`` horizon cannot take a full window."""
         import jax.numpy as jnp
 
         events: list[dict] = self.expire_deadlines()
         with self._lock:
             if not self._active:
+                return events
+            if self.draft_tokens > 0 and self._try_spec_step(events):
                 return events
             # grow block tables where the next token crosses a page boundary
             for seq in list(self._active):
@@ -516,6 +989,7 @@ class PagedDecodeEngine:
                     continue  # preempted/finished by an earlier iteration
                 pos = seq.tokens_in_pages
                 if pos // self.block_len >= len(seq.blocks):
+                    self._reclaim(1)
                     grown = self.allocator.alloc(1)
                     while grown is None:
                         if not self._preempt_youngest(keep=seq):
@@ -558,10 +1032,15 @@ class PagedDecodeEngine:
             m["step_ms"].observe(dt_ms, phase="decode")
             m["token_ms"].labels().observe(dt_ms / max(S_active, 1))
             m["tokens"].inc(S_active, phase="decode")
+            if self.draft_tokens > 0:
+                self._spec_fallbacks += 1
+                m["spec_steps"].inc(mode="fallback")
             for i, seq in enumerate(batch):
                 seq.tokens_in_pages += 1
                 seq.generated.append(int(next_tok[i]))
                 events.append(self._emit(seq, int(next_tok[i])))
+                if not seq.done:
+                    self._register_blocks(seq)
             self._update_pool_gauges()
         return events
 
@@ -636,6 +1115,19 @@ class PagedDecodeEngine:
                     self.params, ids, mask, tables, self._k_pages,
                     self._v_pages, zi, zi)
                 n += 1
+            if self._prefix_cache is not None:
+                for Q in sorted({self.bucketer.seq_bucket_for(
+                        int(p), cap=self.max_len) for p in prompt_lens}):
+                    fn = self._extend_fn(B, Q)
+                    ids = jnp.zeros((B, Q), jnp.int32)
+                    mask = jnp.zeros((B, Q), jnp.int32).at[:, 0].set(1)
+                    tables = jnp.zeros((B, self.max_blocks), jnp.int32)
+                    none = jnp.full((B,), -1, jnp.int32)
+                    zi = jnp.zeros((B,), jnp.int32)
+                    _, self._k_pages, self._v_pages = fn(
+                        self.params, ids, mask, zi, tables, none, none,
+                        self._k_pages, self._v_pages, zi, zi)
+                    n += 1
             for S in sorted({int(s) for s in slot_counts}):
                 fn = self._decode_fn(S)
                 zs = jnp.zeros((S,), jnp.int32)
@@ -644,6 +1136,24 @@ class PagedDecodeEngine:
                     self.params, zs, zs, jnp.zeros((S,), bool), tables,
                     self._k_pages, self._v_pages, zs, zs)
                 n += 1
+            if self.draft_tokens > 0:
+                for S in sorted({int(s) for s in slot_counts}):
+                    fn = self._spec_fn(S)
+                    zs = jnp.zeros((S,), jnp.int32)
+                    off = jnp.zeros((S,), bool)
+                    tables = jnp.zeros((S, self.max_blocks), jnp.int32)
+                    if self._drafter is not None:
+                        W = self._draft_window
+                        zw = jnp.zeros((S, W), jnp.int32)
+                        _, _, self._k_pages, self._v_pages = fn(
+                            self.params, self._draft_params, zs, zs, off,
+                            tables, zw, zw, zs, self._k_pages,
+                            self._v_pages)
+                    else:
+                        _, _, self._k_pages, self._v_pages = fn(
+                            self.params, self._draft_params, zs, zs, off,
+                            tables, self._k_pages, self._v_pages)
+                    n += 1
         return n
 
     # ------------------------------------------------------------------
@@ -810,6 +1320,7 @@ class PagedDecodeEngine:
                          and T < self.max_len)
             if not resumable:
                 return _fallback()
+            self._reclaim(self._blocks_for(T))
             blocks = self.allocator.alloc(self._blocks_for(T))
             if blocks is None:
                 return _fallback()  # import-side page exhaustion
@@ -905,12 +1416,27 @@ class PagedDecodeEngine:
     def stats(self) -> dict:
         with self._lock:
             cap = self.allocator.capacity
-            return {"active": len(self._active),
-                    "waiting": len(self._waiting),
-                    "blocks_used": self.allocator.used_count,
-                    "blocks_free": self.allocator.free_count,
-                    "occupancy": self.allocator.used_count / cap if cap
-                    else 0.0}
+            out = {"active": len(self._active),
+                   "waiting": len(self._waiting),
+                   "blocks_used": self.allocator.used_count,
+                   "blocks_free": self.allocator.free_count,
+                   "occupancy": self.allocator.used_count / cap if cap
+                   else 0.0}
+            if self._prefix_cache is not None:
+                pc = self._prefix_cache.stats()
+                pc["occupancy"] = pc["blocks"] / cap if cap else 0.0
+                out["prefix_cache"] = pc
+            if self.draft_tokens > 0:
+                out["speculation"] = {
+                    "draft_tokens": self.draft_tokens,
+                    "proposed": self._spec_proposed,
+                    "accepted": self._spec_accepted,
+                    "acceptance_rate": (
+                        self._spec_accepted / self._spec_proposed
+                        if self._spec_proposed else 0.0),
+                    "steps": self._spec_steps,
+                    "fallbacks": self._spec_fallbacks}
+            return out
 
     def release(self) -> None:
         """Evict this engine's compiled programs from the shared cache and

@@ -226,6 +226,11 @@ def test_no_inline_jit_in_stage_transform():
     modules = ["onnx/model.py", "hf/embedder.py", "hf/causal_lm.py",
                "models/text.py", "models/vision.py", "nn/knn.py",
                "models/paged_engine.py", "models/flax_nets/llama.py",
+               # the prefix cache indexes pages (pure host bookkeeping)
+               # and the distributed front routes on prefix hashes — a
+               # private jit in either would put tracing on the admit or
+               # routing hot path, invisible to the warmup precompile
+               "models/prefix_cache.py", "io/distributed_serving.py",
                "io/serving.py",
                "automl/tune.py", "automl/hyperparams.py",
                "models/fused_trainer.py", "gbdt/fused.py",

@@ -302,6 +302,72 @@ def test_autoscaler_spawn_failure_does_not_kill_the_loop():
     assert [e["event"] for e in asc.reconcile_once()] == ["spawn_failed"]
 
 
+def test_autoscaler_default_signals_read_prefix_hit_rate():
+    """The stats poller surfaces the fleet-mean prefix-cache hit rate from
+    /admin/stats (the admin mirror of ``synapseml_llm_prefix_hit_rate``):
+    LLM workers contribute, workers without an ``llm`` block are skipped,
+    and a reconcile pass over signals carrying the new field behaves
+    exactly as before — the hit rate is advisory telemetry, not a scaling
+    trigger."""
+    import http.server
+
+    payloads = [
+        {"queue_depth": 2, "llm": {"prefix_cache": {"hit_rate": 0.8}}},
+        {"queue_depth": 4, "llm": {"prefix_cache": {"hit_rate": 0.4}}},
+        {"queue_depth": 0},  # a non-LLM worker: no llm block at all
+    ]
+    servers, handles = [], []
+    for i, payload in enumerate(payloads):
+        raw = json.dumps(payload).encode()
+
+        class H(http.server.BaseHTTPRequestHandler):
+            _body = raw
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(self._body)))
+                self.end_headers()
+                self.wfile.write(self._body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        handles.append(WorkerHandle(model="m", token=i + 1, pid=-(i + 1),
+                                    host="127.0.0.1",
+                                    port=srv.server_address[1],
+                                    spawned_at=0.0, state="ready"))
+    slo = ModelSLO(model="m")
+    asc = FleetAutoscaler(FleetSpec(models=[slo]), FakeLauncher())
+    try:
+        sig = asc._default_signals(slo, handles)
+        assert sig.workers_polled == 3
+        assert sig.queue_per_worker == pytest.approx(2.0)
+        assert sig.prefix_hit_rate == pytest.approx(0.6)  # mean of LLM two
+    finally:
+        for srv in servers:
+            srv.shutdown()
+
+    # reconcile non-regression: the same doubling policy fires on queue
+    # depth whether or not prefix_hit_rate rides along
+    t = [0.0]
+    asc2 = FleetAutoscaler(
+        FleetSpec(models=[ModelSLO(model="m", min_workers=1, max_workers=4,
+                                   target_queue_depth=4.0,
+                                   up_cooldown_s=0.0)]),
+        FakeLauncher(), clock=lambda: t[0],
+        signals_fn=lambda s, live: FleetSignals(queue_per_worker=10.0,
+                                                prefix_hit_rate=0.9))
+    events = asc2.reconcile_once()  # spawn to min + immediate doubling
+    assert {e["event"] for e in events} == {"up", "spawn"}
+    assert asc2.desired("m") == asc2.actual("m") == 2
+    t[0] = 1.0
+    asc2.reconcile_once()
+    assert asc2.desired("m") == 4
+
+
 # ---------------------------------------------------------------------------
 # integration: thread-launcher workers on real ports
 # ---------------------------------------------------------------------------

@@ -921,12 +921,12 @@ def test_hot_swap_terminates_live_streams():
 # journaled streams through the RoutingFront (survivable serving plane)
 # ---------------------------------------------------------------------------
 
-def _start_llm_worker(max_new=64, warmup=False):
+def _start_llm_worker(max_new=64, warmup=False, **lm_kw):
     from synapseml_tpu.hf import HuggingFaceCausalLM
     from synapseml_tpu.io.serving import serve_llm
 
     lm = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=max_new,
-                             engine="paged")
+                             engine="paged", **lm_kw)
     return serve_llm(lm, warmup=warmup)
 
 
@@ -1361,3 +1361,524 @@ def test_sigkill_one_of_two_workers_mid_decode_16_streams():
             if p.poll() is None:
                 p.kill()
             p.wait(30)
+
+
+# ---------------------------------------------------------------------------
+# prefix-cached KV reuse + greedy speculative decoding
+# ---------------------------------------------------------------------------
+
+def test_prefix_and_spec_parity_across_rungs(tiny_lm):
+    """BOTH features on (prefix cache + speculation) stay token-identical
+    to the plain paged engine across >= 3 seq-ladder rungs, on a stream
+    where several prompts share a long head (cache hits, a fully-cached
+    COW prompt, and multi-token speculative steps all fire) — run twice so
+    round 2 decodes entirely over cached prefix pages."""
+    cfg, params = tiny_lm
+    rng = np.random.default_rng(41)
+    head = rng.integers(2, cfg.vocab_size, (24,)).tolist()  # 3 blocks of 8
+    prompts = [
+        head[:5],                                                 # rung 8
+        head[:14],                                                # rung 16
+        head + rng.integers(2, cfg.vocab_size, (4,)).tolist(),    # rung 32
+        head + rng.integers(2, cfg.vocab_size, (30,)).tolist(),   # rung 64
+        list(head),                     # block-multiple prompt: COW path
+    ]
+    max_new = 10
+    kw = dict(block_len=8, max_slots=4,
+              bucketer=ShapeBucketer(ladder=[1, 2, 4, 8],
+                                     seq_ladder=[8, 16, 32, 64]))
+    plain = PagedDecodeEngine(cfg, params, **kw)
+    want = plain.generate(prompts, max_new)
+    plain.release()
+
+    boosted = PagedDecodeEngine(cfg, params, prefix_cache=True,
+                                draft_tokens=3, **kw)
+    for round_ in range(2):
+        got = boosted.generate(prompts, max_new)
+        assert got == want, f"boosted engine diverged on round {round_}"
+    pc = boosted.stats()["prefix_cache"]
+    assert pc["hits"] > 0 and pc["tokens_reused"] > 0, \
+        "stream never exercised the prefix cache"
+    sp = boosted.stats()["speculation"]
+    assert sp["steps"] > 0, "stream never exercised speculation"
+    boosted.release()
+
+
+def test_prefix_and_spec_parity_with_early_eos(tiny_lm):
+    """Early-EOS parity with both features on: a draft window that crosses
+    the EOS must discard the speculated tail, and the freed shared pages
+    must not corrupt any still-running row."""
+    cfg, params = tiny_lm
+    rng = np.random.default_rng(43)
+    head = rng.integers(2, cfg.vocab_size, (16,)).tolist()
+    prompts = [head + rng.integers(2, cfg.vocab_size, (int(n),)).tolist()
+               for n in (2, 9, 21, 40)]
+    max_new = 16
+    kw = dict(block_len=8, max_slots=4,
+              bucketer=ShapeBucketer(ladder=[1, 2, 4, 8],
+                                     seq_ladder=[8, 16, 32, 64]))
+    free_eng = PagedDecodeEngine(cfg, params, **kw)
+    free_run = free_eng.generate(prompts, max_new)
+    free_eng.release()
+    eos_id = None  # an eos that hits mid-stream for some rows, not all
+    for row in free_run:
+        for tok in row[1:max_new // 2]:
+            if sum(tok in r for r in free_run) < len(free_run):
+                eos_id = int(tok)
+                break
+        if eos_id is not None:
+            break
+    assert eos_id is not None
+
+    plain = PagedDecodeEngine(cfg, params, eos_id=eos_id, **kw)
+    want = [_trim_eos(r, eos_id) for r in plain.generate(prompts, max_new)]
+    plain.release()
+    assert any(len(r) < max_new for r in want), "eos never fired"
+
+    boosted = PagedDecodeEngine(cfg, params, eos_id=eos_id,
+                                prefix_cache=True, draft_tokens=3, **kw)
+    got = [_trim_eos(r, eos_id) for r in boosted.generate(prompts, max_new)]
+    assert got == want
+    # every non-cache page freed once every sequence finished
+    assert boosted.allocator.used_count == \
+        len(boosted.prefix_cache.block_ids())
+    boosted.release()
+
+
+def test_prefix_and_spec_parity_under_preemption(tiny_lm):
+    """A pool too small for the working set still produces token-identical
+    output with both features on: preemption releases shared pages to the
+    cache (refcounts, not frees), eviction makes room, and the preempted
+    sequence's re-prefill may legitimately ride its OWN cached blocks."""
+    cfg, params = tiny_lm
+    rng = np.random.default_rng(45)
+    prompts = [rng.integers(2, cfg.vocab_size, (20,)).tolist()
+               for _ in range(4)]
+    max_new = 20
+    kw = dict(block_len=8, max_slots=4,
+              bucketer=ShapeBucketer(ladder=[1, 2, 4, 8],
+                                     seq_ladder=[8, 16, 32, 64]))
+    roomy = PagedDecodeEngine(cfg, params, **kw)
+    want = roomy.generate(prompts, max_new)
+    roomy.release()
+
+    tight = PagedDecodeEngine(cfg, params, n_blocks=14, prefix_cache=True,
+                              draft_tokens=3, **kw)
+    seqs = [tight.submit(p, max_new) for p in prompts]
+    deadline = time.perf_counter() + 120
+    while any(not s.done for s in seqs) and time.perf_counter() < deadline:
+        tight.admit()
+        tight.step()
+    assert all(s.done for s in seqs), "tight pool wedged"
+    assert [list(s.generated) for s in seqs] == want
+    assert sum(s.preemptions for s in seqs) >= 1, \
+        "pool was never actually tight"
+    tight.release()
+
+
+def test_speculation_is_greedy_only(tiny_lm):
+    """draft_tokens > 0 with a sampling temperature must be rejected up
+    front — the acceptance rule compares argmaxes, so sampling would
+    silently break the token-identity guarantee."""
+    cfg, params = tiny_lm
+    with pytest.raises(ValueError, match="greedy"):
+        PagedDecodeEngine(cfg, params, block_len=8, max_slots=2,
+                          draft_tokens=3, temperature=0.9)
+
+
+def test_compile_counts_bounded_with_prefix_and_spec(tiny_lm):
+    """The acceptance bar on executables: two rounds of a shared-prefix
+    stream (heavy extend + spec traffic) compile at most one program per
+    ladder rung for EACH of the four paged fn ids — no per-shape or
+    per-request recompiles."""
+    cfg, params = tiny_lm
+    cache = cb.get_compiled_cache()
+    ids = ("llama_paged_prefill", "llama_paged_extend",
+           "llama_paged_decode", "llama_paged_spec")
+    before = {i: cache.miss_count(i) for i in ids}
+    eng = PagedDecodeEngine(
+        cfg, params, block_len=16, max_slots=8, prefill_batch=2,
+        prefix_cache=True, draft_tokens=3,
+        bucketer=ShapeBucketer(ladder=[2, 4, 8], seq_ladder=[16, 32, 64]))
+    rng = np.random.default_rng(47)
+    heads = [rng.integers(2, cfg.vocab_size, (20,)).tolist()
+             for _ in range(3)]
+    prompts = [heads[k % 3] + rng.integers(
+        2, cfg.vocab_size, (int(rng.integers(1, 30)),)).tolist()
+        for k in range(24)]
+    for _ in range(2):  # round 2: every family head is cache-resident
+        assert eng.generate(prompts, 8) is not None
+    pc = eng.stats()["prefix_cache"]
+    assert pc["hits"] > 0, "no extend traffic — the bound proved nothing"
+    assert eng.stats()["speculation"]["steps"] > 0
+    n_seq = len(eng.bucketer.seq_buckets_upto(eng.max_len))
+    deltas = {i: cache.miss_count(i) - before[i] for i in ids}
+    assert 0 < deltas["llama_paged_prefill"] <= n_seq, deltas
+    assert 0 < deltas["llama_paged_extend"] <= n_seq, deltas
+    # plain decode only compiles on spec FALLBACK — with an ample pool
+    # every step rides the spec program, so 0 is legitimate here
+    assert deltas["llama_paged_decode"] <= len(eng.slot_rungs), deltas
+    assert 0 < deltas["llama_paged_spec"] <= len(eng.slot_rungs), deltas
+    eng.release()
+
+
+def test_warmup_covers_extend_and_spec_rungs(tiny_lm):
+    """warmup() on a both-features engine precompiles the suffix-extend
+    and draft/verify rungs too: a mixed shared-prefix stream afterwards
+    causes ZERO new compiles of any paged program (the /admin/load
+    zero-compile-stall contract extends to the new executables)."""
+    cfg, params = tiny_lm
+    cache = cb.get_compiled_cache()
+    eng = PagedDecodeEngine(
+        cfg, params, block_len=16, max_slots=4, prefill_batch=2,
+        prefix_cache=True, draft_tokens=3,
+        bucketer=ShapeBucketer(ladder=[2, 4], seq_ladder=[16, 32, 64]))
+    eng.warmup()
+    ids = ("llama_paged_prefill", "llama_paged_extend",
+           "llama_paged_decode", "llama_paged_spec")
+    before = {i: cache.miss_count(i) for i in ids}
+    rng = np.random.default_rng(49)
+    head = rng.integers(2, cfg.vocab_size, (32,)).tolist()
+    prompts = [head + rng.integers(2, cfg.vocab_size, (int(n),)).tolist()
+               for n in rng.integers(1, 30, (8,))]
+    for _ in range(2):
+        eng.generate(prompts, 6)
+    assert eng.stats()["prefix_cache"]["hits"] > 0
+    for i in ids:
+        assert cache.miss_count(i) == before[i], \
+            f"{i} compiled after warmup"
+    eng.release()
+
+
+def test_block_allocator_refcount_invariants_property():
+    """Satellite: randomized ref/free/alloc interleaving — a shared block
+    is never handed out again while ANY holder remains, refcounts are
+    conserved exactly, and ref/free on a non-live block is a hard error
+    (no silent double-free, no resurrect-after-free)."""
+    rng = np.random.default_rng(1)
+    alloc = BlockAllocator(25)
+    holders: dict[int, int] = {}  # block -> expected refcount
+    for _ in range(800):
+        r = rng.random()
+        if holders and r < 0.35:
+            b = int(rng.choice(list(holders)))
+            alloc.free([b])
+            holders[b] -= 1
+            if holders[b] == 0:
+                del holders[b]
+        elif holders and r < 0.55:
+            b = int(rng.choice(list(holders)))
+            alloc.ref(b)
+            holders[b] += 1
+        else:
+            got = alloc.alloc(int(rng.integers(1, 4)))
+            if got is None:
+                continue
+            assert 0 not in got, "trash page handed out"
+            assert not (set(got) & set(holders)), \
+                "block re-allocated while still referenced"
+            for b in got:
+                holders[b] = 1
+        for b, n in holders.items():
+            assert alloc.refcount(b) == n, (b, n)
+        assert alloc.used_count == len(holders)
+        assert alloc.free_count == alloc.capacity - len(holders)
+    for b, n in list(holders.items()):  # drain every remaining ref
+        for _ in range(n):
+            alloc.free([b])
+    assert alloc.used_count == 0
+    with pytest.raises(RuntimeError):
+        alloc.free([1])  # fully-released block: freeing again is fatal
+    with pytest.raises(RuntimeError):
+        alloc.ref(1)  # ...and so is resurrecting it with a new ref
+    with pytest.raises(RuntimeError):
+        alloc.ref(0)  # the trash page is never shareable
+
+
+def _assert_refcount_conservation(eng):
+    """Every live block's refcount equals its holder count (active
+    sequences + the prefix cache), the pool accounts exactly, and the
+    block each sequence will write next is PRIVATE — shared pages are
+    immutable while shared."""
+    holders: dict[int, int] = {}
+    cache_blocks = eng.prefix_cache.block_ids()
+    for s in eng._active:
+        assert 0 not in s.blocks, "trash page in a live block table"
+        for b in s.blocks:
+            holders[b] = holders.get(b, 0) + 1
+        wi = s.tokens_in_pages // eng.block_len
+        if wi < len(s.blocks):
+            wb = s.blocks[wi]
+            assert eng.allocator.refcount(wb) == 1, \
+                f"seq {s.uid} would write shared block {wb}"
+            assert wb not in cache_blocks
+    for b in cache_blocks:
+        holders[b] = holders.get(b, 0) + 1
+    for b, n in holders.items():
+        assert eng.allocator.refcount(b) == n, (b, n)
+    assert eng.allocator.used_count == len(holders)
+
+
+def test_prefix_cache_fuzz_refcounts_cow_and_parity(tiny_lm):
+    """Satellite fuzz: a randomized stream of prompts forking off two
+    shared heads (exact-head COW forks, divergent suffixes, unrelated
+    prompts) churns through a SMALL pool with speculation on. After every
+    scheduler tick: refcount conservation, write-block privacy, exact pool
+    accounting. Every completion must match a plain single-sequence run —
+    a child's writes never leak into a parent's shared pages."""
+    cfg, params = tiny_lm
+    rng = np.random.default_rng(51)
+    heads = [rng.integers(2, cfg.vocab_size, (16,)).tolist()
+             for _ in range(2)]
+    bucketer = ShapeBucketer(ladder=[1, 2, 4], seq_ladder=[8, 16, 32, 64])
+    eng = PagedDecodeEngine(cfg, params, block_len=8, max_slots=4,
+                            n_blocks=28, prefix_cache=True, draft_tokens=2,
+                            bucketer=bucketer)
+    plain = PagedDecodeEngine(cfg, params, block_len=8, max_slots=4,
+                              bucketer=bucketer)
+    live, done = [], []
+    for _ in range(30):
+        if rng.random() < 0.7:
+            r = rng.random()
+            h = heads[int(rng.integers(0, 2))]
+            if r < 0.3:
+                p = list(h)  # block-multiple prompt: the COW path
+            elif r < 0.8:
+                p = h + rng.integers(2, cfg.vocab_size,
+                                     (int(rng.integers(1, 12)),)).tolist()
+            else:
+                p = rng.integers(2, cfg.vocab_size,
+                                 (int(rng.integers(3, 20)),)).tolist()
+            live.append(eng.submit(p, int(rng.integers(2, 8))))
+        eng.admit()
+        eng.step()
+        _assert_refcount_conservation(eng)
+        done += [s for s in live if s.done]
+        live = [s for s in live if not s.done]
+    deadline = time.perf_counter() + 120
+    while any(not s.done for s in live) and time.perf_counter() < deadline:
+        eng.admit()
+        eng.step()
+        _assert_refcount_conservation(eng)
+    done += live
+    assert eng.stats()["prefix_cache"]["hits"] > 0
+    for s in done:
+        assert s.done
+        want = plain.generate([list(s.prompt_ids)], s.max_new_tokens)[0]
+        assert list(s.generated) == want, \
+            f"seq {s.uid} diverged (shared-page corruption?)"
+    plain.release()
+    eng.release()
+
+
+def test_export_import_with_shared_prefix_pages(tiny_lm):
+    """PR-14 compat: a sequence holding SHARED (refcounted) prefix pages
+    exports and imports with zero duplicated and zero lost tokens; the
+    source's cached pages survive the export intact (a same-prefix rerun
+    on the source still matches), and both allocators account exactly to
+    their caches' holdings."""
+    cfg, params = tiny_lm
+    rng = np.random.default_rng(53)
+    head = rng.integers(2, cfg.vocab_size, (16,)).tolist()
+    prompt = head + rng.integers(2, cfg.vocab_size, (5,)).tolist()
+    max_new = 12
+    kw = dict(block_len=8, max_slots=2)
+    plain = PagedDecodeEngine(cfg, params, **kw)
+    reference = plain.generate([prompt], max_new)[0]
+    plain.release()
+
+    src = PagedDecodeEngine(cfg, params, prefix_cache=True, **kw)
+    dst = PagedDecodeEngine(cfg, params, prefix_cache=True, **kw)
+    try:
+        # seed the source cache so the migrating sequence SHARES its head
+        src.generate([head + [3, 5]], 4)
+        seq = src.submit(prompt, max_new, request_id="shared-mig",
+                         stream=True)
+        while len(seq.generated) < 4:
+            src.admit()
+            src.step()
+        assert any(src.allocator.refcount(b) > 1 for b in seq.blocks), \
+            "setup failed: the migrating sequence shares no pages"
+        snap = src.export_sequence(seq.uid)
+        assert snap is not None
+        # export released the sequence's refs; the cache's refs survive
+        assert src.allocator.used_count == \
+            len(src.prefix_cache.block_ids()), "export leaked source pages"
+        moved = dst.import_sequence(snap)
+        assert list(moved.generated) == list(seq.generated)
+        assert _run_to_done(dst, moved) == reference, \
+            "migrated decode diverged"
+        assert dst.allocator.used_count == \
+            len(dst.prefix_cache.block_ids()), "import leaked dest pages"
+        # source cache pages are still byte-valid after the export
+        assert src.generate([prompt], max_new)[0] == reference
+    finally:
+        src.release()
+        dst.release()
+
+
+def test_spec_decode_replays_token_identically_through_kill(tiny_lm):
+    """PR-14 compat: kill the engine mid-draft-window (release, no
+    export), resume every unfinished sequence on a survivor ALSO running
+    prefix cache + speculation through the crash-path manifest the
+    RoutingFront journal uses. Combined emissions must carry zero
+    duplicate and zero lost token indices and equal the uninterrupted
+    stream — ``index`` is stamped at emission time, so multi-token
+    speculative steps number their chunks exactly."""
+    cfg, params = tiny_lm
+    rng = np.random.default_rng(55)
+    prompts = [rng.integers(2, cfg.vocab_size, (int(n),)).tolist()
+               for n in (7, 18, 33)]
+    max_new = 14
+    kw = dict(block_len=8, max_slots=4,
+              bucketer=ShapeBucketer(ladder=[1, 2, 4, 8],
+                                     seq_ladder=[8, 16, 32, 64]))
+    plain = PagedDecodeEngine(cfg, params, **kw)
+    want = plain.generate(prompts, max_new)
+    plain.release()
+
+    boost = dict(kw, prefix_cache=True, draft_tokens=3)
+    victim = PagedDecodeEngine(cfg, params, **boost)
+    seqs = [victim.submit(p, max_new, request_id=str(i), stream=True)
+            for i, p in enumerate(prompts)]
+    by_uid = {s.uid: i for i, s in enumerate(seqs)}
+    emissions: list[list] = [[] for _ in prompts]
+
+    def drain(events):
+        for ev in events:
+            if ev.get("token") is not None:
+                emissions[by_uid[ev["seq"].uid]].append(
+                    (int(ev["index"]), int(ev["token"])))
+
+    while sum(len(e) for e in emissions) < len(prompts) * max_new // 2:
+        drain(victim.admit())
+        drain(victim.step())
+    unfinished = [s for s in seqs if not s.done]
+    assert unfinished, "kill point too late to prove anything"
+    victim.release()  # SIGKILL analog: pages gone, nothing exported
+
+    survivor = PagedDecodeEngine(cfg, params, **boost)
+    moved = [survivor.import_sequence({"manifest": {
+        "uid": s.uid, "prompt_ids": list(s.prompt_ids),
+        "generated": list(s.generated),
+        "max_new_tokens": s.max_new_tokens, "request_id": s.request_id,
+        "stream": True, "tokens_in_pages": 0,
+        "model_digest": "crashed-worker"}}) for s in unfinished]
+    deadline = time.perf_counter() + 120
+    while any(not s.done for s in moved) and time.perf_counter() < deadline:
+        drain(survivor.admit())
+        drain(survivor.step())
+    assert all(s.done for s in moved)
+    assert survivor.stats()["speculation"]["steps"] > 0, \
+        "the resumed run never speculated"
+    survivor.release()
+    for i, ems in enumerate(emissions):
+        idxs = [ix for ix, _ in ems]
+        assert len(idxs) == len(set(idxs)), f"duplicate tokens, stream {i}"
+        got = [t for _, t in sorted(ems)]
+        assert got == want[i], f"stream {i} diverged through the kill"
+
+
+def test_causal_lm_resolves_speculation_params(tiny_lm):
+    """The Params surface wires through: prefix_cache/draft_tokens reach
+    the engine, 'self:<n>' pins the early-exit layer, and a registry
+    drafter_ref resolves a real (cfg, params) drafter."""
+    from synapseml_tpu.hf import HuggingFaceCausalLM
+
+    lm = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=4,
+                             engine="paged", prefix_cache=True,
+                             draft_tokens=2)
+    eng = lm._paged_engine(lm._effective_gen_cfg())
+    assert eng.prefix_cache is not None
+    assert eng.draft_tokens == 2
+    assert eng._drafter is None  # self-draft default
+
+    lm2 = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=4,
+                              engine="paged", draft_tokens=2,
+                              drafter_ref="self:1")
+    eng2 = lm2._paged_engine(lm2._effective_gen_cfg())
+    assert eng2.draft_layers == 1
+
+    lm3 = HuggingFaceCausalLM(model_name="llama-tiny", max_new_tokens=4,
+                              engine="paged", draft_tokens=2,
+                              drafter_ref="llama-tiny")
+    eng3 = lm3._paged_engine(lm3._effective_gen_cfg())
+    assert eng3._drafter is not None
+
+
+def test_admin_stats_exposes_prefix_and_speculation():
+    """Satellite: GET /admin/stats on a serving worker carries the
+    engine's prefix-cache occupancy/hit-rate and speculation acceptance
+    under an ``llm`` key — the same numbers the fleet autoscaler and the
+    prefix-affinity router consume."""
+    import urllib.request
+
+    srv = _start_llm_worker(max_new=6, prefix_cache=True, draft_tokens=2)
+    try:
+        ids = list(range(2, 22))
+        for _ in range(2):  # second pass hits the cache
+            st, body, _ = _request(srv.address,
+                                   {"input_ids": ids, "max_new_tokens": 4})
+            assert st == 200, body
+        with urllib.request.urlopen(srv.address + "/admin/stats",
+                                    timeout=30) as r:
+            stats = json.loads(r.read())
+        llm = stats["llm"]
+        assert llm["prefix_cache"]["hits"] >= 1
+        assert 0.0 < llm["prefix_cache"]["hit_rate"] <= 1.0
+        assert "occupancy" in llm["prefix_cache"]
+        assert llm["speculation"]["draft_tokens"] == 2
+        assert "acceptance_rate" in llm["speculation"]
+        # the gauge mirror on /metrics agrees
+        assert _prom_value("synapseml_llm_prefix_hit_rate") > 0.0
+    finally:
+        srv.stop()
+
+
+def test_front_prefix_routing_beats_unrouted_hit_rate():
+    """Fleet E2E acceptance: 2 prefix-cached workers behind a
+    RoutingFront, one request stream drawn from 3 shared-prefix families.
+    With ``route_by_prefix`` each family packs onto one worker (one cold
+    miss per family fleet-wide); plain rotation cold-misses every family
+    on BOTH workers — the routed fleet's aggregate hit rate must beat the
+    unrouted fleet's on the SAME stream, same round."""
+    import urllib.request
+
+    from synapseml_tpu.io.distributed_serving import RoutingFront
+
+    rng = np.random.default_rng(57)
+    families = [rng.integers(2, 200, (24,)).tolist() for _ in range(3)]
+    stream = [families[k % 3]
+              + rng.integers(2, 200, (int(rng.integers(1, 6)),)).tolist()
+              for k in range(18)]
+
+    def run_round(route_by_prefix):
+        workers = [_start_llm_worker(max_new=4, prefix_cache=True)
+                   for _ in range(2)]
+        front = RoutingFront(
+            [{"host": s.host, "port": s.port, "pid": i + 1}
+             for i, s in enumerate(workers)],
+            timeout_s=60, route_by_prefix=route_by_prefix)
+        try:
+            for ids in stream:
+                st, body, _ = _request(front.address,
+                                       {"input_ids": ids,
+                                        "max_new_tokens": 2})
+                assert st == 200, body
+            hits = misses = 0
+            for s in workers:
+                with urllib.request.urlopen(s.address + "/admin/stats",
+                                            timeout=30) as r:
+                    pc = (json.loads(r.read()).get("llm") or {}) \
+                        .get("prefix_cache") or {}
+                hits += pc.get("hits", 0)
+                misses += pc.get("misses", 0)
+            return hits / max(hits + misses, 1)
+        finally:
+            front.close()
+            for s in workers:
+                s.stop()
+
+    routed = run_round(True)
+    unrouted = run_round(False)
+    assert routed > unrouted, (routed, unrouted)
