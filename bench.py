@@ -113,6 +113,12 @@ CONFIGS = [
     # a live delta ingest — recall@10 >= 0.99, served QPS >= 0.9x brute,
     # fresh docs queryable with zero downtime; workers force CPU
     ("retrieval-serve", "retrieval_serve", 300, 300),
+    # explain-bulk A/B: fused perturbation scoring vs serial per-row
+    # transform, plus streamed explain_source vs in-memory transform over
+    # the same jsonl corpus — all three arms same round, cold-cache compile
+    # count vs the ladder, content-keyed rng makes the arms byte-comparable;
+    # host-driven, fine on the CPU fallback
+    ("explain-bulk", "explain_bulk", 240, 240),
     ("flagship", None, 420, 360),
     ("vit", "vit_finetune", 450, 300),
 ]

@@ -1,0 +1,265 @@
+"""Bulk explanation: fused perturbation scoring vs serial, and streamed
+``explain_source`` vs one in-memory ``transform``.
+
+Writes a multi-shard synthetic jsonl corpus, builds a linear scorer that
+exposes BOTH faces of the rai score-fn protocol (a serial DataFrame
+``transform`` and a pure jax array fn), then explains the WHOLE corpus
+with ``VectorSHAP`` three ways in the SAME round:
+
+  (a) serial  — ``fused=False``: the seed path, one coalition batch per
+                explained row through ``model.transform`` (a DataFrame
+                round trip per row — the per-row tax being measured);
+  (b) fused   — ``fused=True``: many rows' coalition samples concatenated
+                into one ``[B, M]`` array scored through the shared
+                ``CompiledCache`` pow-2 ladder under ONE ``rai.fused_score``
+                fn_id (compile count <= ladder size, recorded from a COLD
+                cache on the first run);
+  (c) streamed — ``explain_source`` over ``ShardedSource.jsonl`` +
+                ``JsonlSink``: the same fused engine riding the scoring
+                plane's exactly-once shard pipeline, files in -> committed
+                explanation parts out.
+
+Sampling is content-keyed (``row_rng``), so all three arms must produce
+the SAME explanation vectors — parity is asserted at f32 tolerance, and
+streamed-vs-in-memory equality is exact row-for-row by id.
+
+Reports explanations/sec per arm: one cold fused run records the compile
+count, then min-of-3 warm walls per arm, interleaved (the bulk_scoring
+discipline — host-side json work makes single runs noisy). Acceptance bar
+(ISSUE 20): fused >= 3x serial explanations/sec at f32 parity, streamed
+>= 0.9x in-memory rows/sec, executable count <= ladder size. Prints one
+JSON line.
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+N_SHARDS = 6
+ROWS_PER_SHARD = 1024
+N_FEATURES = 12
+NUM_SAMPLES = 128    # coalitions per explained row
+BATCH_ROWS = 512     # streamed source batch size
+OUT_COLUMNS = ["id", "explanation"]
+
+
+def _write_corpus(directory: str) -> tuple[int, int]:
+    rs = np.random.default_rng(0)
+    i, total = 0, 0
+    for s in range(N_SHARDS):
+        p = os.path.join(directory, f"part-{s:03d}.jsonl")
+        with open(p, "w") as f:
+            X = rs.normal(size=(ROWS_PER_SHARD, N_FEATURES))
+            for j in range(ROWS_PER_SHARD):
+                f.write(json.dumps({
+                    "features": [round(float(v), 5) for v in X[j]],
+                    "id": i}) + "\n")
+                i += 1
+        total += os.path.getsize(p)
+    return i, total
+
+
+def _make_model():
+    """Linear scorer exposing both protocol faces — the serial arm goes
+    through ``_transform`` (one DataFrame round trip per coalition batch),
+    the fused arm through ``score_fn``'s pure array fn."""
+    from synapseml_tpu.core.pipeline import Transformer
+
+    w = np.linspace(-1.0, 1.0, N_FEATURES).astype(np.float32)
+
+    class BenchLinear(Transformer):
+        def _transform(self, df):
+            def score(p):
+                X = np.stack([np.asarray(v, np.float64)
+                              for v in p["features"]])
+                s = X @ w.astype(np.float64)
+                return np.asarray([np.asarray([v]) for v in s])
+
+            return df.with_column("probability", score)
+
+        def score_fn(self):
+            return lambda X: (X.astype("float32") @ w)[:, None]
+
+    return BenchLinear()
+
+
+def _background(data_dir: str):
+    """Fixed background frame shared by every arm — a streamed run has no
+    'whole dataset' to default to, so the background must be pinned for
+    the arms to be comparable (and for phi0 to mean one thing)."""
+    from synapseml_tpu.io.files import read_jsonl
+
+    df = read_jsonl(os.path.join(data_dir, "part-000.jsonl"))
+    return df.limit(64)
+
+
+def _explainer(model, fused, bg):
+    from synapseml_tpu.explainers import VectorSHAP
+
+    return VectorSHAP(model=model, fused=fused, seed=0,
+                      num_samples=NUM_SAMPLES, background_data=bg)
+
+
+def _cold_cache() -> int:
+    from synapseml_tpu.core.batching import (get_compiled_cache,
+                                             reset_compiled_cache)
+    from synapseml_tpu.rai import FUSED_SCORE_FN_ID
+
+    reset_compiled_cache()
+    return get_compiled_cache().miss_count(FUSED_SCORE_FN_ID)
+
+
+def _run_scoring_path(model, df, bg, n_rows: int, fused: bool,
+                      cold: bool = False) -> dict:
+    """The fused-vs-serial A/B: same pre-parsed frame, only the
+    perturbation-scoring path differs."""
+    from synapseml_tpu.core.batching import get_compiled_cache
+    from synapseml_tpu.rai import FUSED_SCORE_FN_ID
+
+    misses0 = _cold_cache() if cold else 0
+    t0 = time.perf_counter()
+    out = _explainer(model, fused, bg).transform(df)
+    exps = [np.asarray(v) for v in out.collect_column("explanation")]
+    wall = time.perf_counter() - t0
+    compiles = int(get_compiled_cache().miss_count(FUSED_SCORE_FN_ID)
+                   - misses0) if cold else None
+    return {"wall_s": round(wall, 3),
+            "explanations_per_sec": round(n_rows / wall, 1),
+            "fused_score_compiles": compiles,
+            "_exps": np.stack(exps),
+            "_ids": np.asarray(df.collect_column("id"))}
+
+
+def _run_in_memory(model, data_dir: str, bg, out_dir: str,
+                   n_rows: int) -> dict:
+    """End-to-end in-memory arm: files in -> explained files out, the full
+    parse paid before the first explanation (the all-in-RAM baseline the
+    streamed arm is measured against)."""
+    from synapseml_tpu.core.dataframe import DataFrame
+    from synapseml_tpu.io.files import read_jsonl, write_jsonl
+
+    os.makedirs(out_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    df = read_jsonl(os.path.join(data_dir, "*.jsonl"))
+    out = _explainer(model, True, bg).transform(df)
+    part = out.collect()
+    write_jsonl(DataFrame([{c: part[c] for c in OUT_COLUMNS}]),
+                os.path.join(out_dir, "explained.jsonl"))
+    wall = time.perf_counter() - t0
+    return {"wall_s": round(wall, 3),
+            "rows_per_sec": round(n_rows / wall, 1)}
+
+
+def _run_streamed(model, data_dir: str, bg, out_dir: str) -> dict:
+    from synapseml_tpu.data import ShardedSource
+    from synapseml_tpu.rai import explain_source
+    from synapseml_tpu.scoring import JsonlSink
+
+    src = ShardedSource.jsonl(os.path.join(data_dir, "*.jsonl"))
+    sink = JsonlSink(out_dir, columns=OUT_COLUMNS)
+    t0 = time.perf_counter()
+    report = explain_source(_explainer(model, True, bg), src, sink,
+                            batch_rows=BATCH_ROWS)
+    wall = time.perf_counter() - t0
+    rows = [json.loads(ln) for p in sink.part_files()
+            for ln in open(p) if ln.strip()]
+    return {"wall_s": round(wall, 3),
+            "rows_per_sec": round(report.rows_written / max(wall, 1e-9), 1),
+            "rows_written": report.rows_written,
+            "shards": report.shards_done,
+            "complete": report.complete,
+            "_exps": {r["id"]: np.asarray(r["explanation"]) for r in rows}}
+
+
+def run(jax, platform, n_chips):
+    from synapseml_tpu.core.batching import default_bucketer
+    from synapseml_tpu.io.files import read_jsonl
+    from synapseml_tpu.rai import MAX_FUSED_ROWS
+
+    directory = tempfile.mkdtemp(prefix="synapseml_explainbulk_")
+    try:
+        data_dir = os.path.join(directory, "data")
+        os.makedirs(data_dir)
+        n_rows, n_bytes = _write_corpus(data_dir)
+        model = _make_model()
+        bg = _background(data_dir)
+        df = read_jsonl(os.path.join(data_dir, "*.jsonl"))
+        ladder = len(default_bucketer().buckets_upto(MAX_FUSED_ROWS))
+
+        # one cold fused run: the compile-count-vs-ladder record
+        cold = _run_scoring_path(model, df, bg, n_rows, fused=True,
+                                 cold=True)
+        # then min-of-3 WARM walls per arm, arms interleaved so a load
+        # spike on the shared box can't bias one side
+        serial = fused = in_mem = streamed = None
+        for t in range(3):
+            se = _run_scoring_path(model, df, bg, n_rows, fused=False)
+            fu = _run_scoring_path(model, df, bg, n_rows, fused=True)
+            im = _run_in_memory(model, data_dir, bg,
+                                os.path.join(directory, f"out_mem{t}"),
+                                n_rows)
+            st = _run_streamed(model, data_dir, bg,
+                               os.path.join(directory, f"out_stream{t}"))
+            if serial is None or se["wall_s"] < serial["wall_s"]:
+                serial = se
+            if fused is None or fu["wall_s"] < fused["wall_s"]:
+                fused = fu
+            if in_mem is None or im["wall_s"] < in_mem["wall_s"]:
+                in_mem = im
+            if streamed is None or st["wall_s"] < streamed["wall_s"]:
+                streamed = st
+        fused["fused_score_compiles"] = cold["fused_score_compiles"]
+        fused["cold_wall_s"] = cold["wall_s"]
+
+        f_exp, s_exp = fused.pop("_exps"), serial.pop("_exps")
+        ids = fused.pop("_ids")
+        serial.pop("_ids")
+        cold.pop("_exps"), cold.pop("_ids")
+        parity = bool(np.allclose(f_exp, s_exp, rtol=1e-4, atol=1e-5))
+        by_id = streamed.pop("_exps")
+        streamed_equal = (len(by_id) == n_rows and all(
+            np.allclose(by_id[int(i)], f_exp[k], rtol=1e-6, atol=1e-7)
+            for k, i in enumerate(ids)))
+    finally:
+        shutil.rmtree(directory, ignore_errors=True)
+    in_memory_rps = in_mem["rows_per_sec"]
+    return {
+        "metric": "bulk explanation fused explanations/sec "
+                  "(fused perturbation engine vs serial per-row transform)",
+        "value": fused["explanations_per_sec"], "unit": "explanations/sec",
+        "lower_is_better": False, "platform": platform,
+        "dataset_rows": n_rows, "dataset_bytes": n_bytes,
+        "num_samples": NUM_SAMPLES,
+        "fused": fused, "serial_baseline": serial,
+        "in_memory_baseline": in_mem, "streamed": streamed,
+        "fused_vs_serial": round(
+            fused["explanations_per_sec"] / serial["explanations_per_sec"], 3)
+        if serial["explanations_per_sec"] else None,
+        "streamed_vs_in_memory": round(
+            streamed["rows_per_sec"] / in_memory_rps, 3)
+        if in_memory_rps else None,
+        "ladder_bound": ladder,
+        "compile_count_within_ladder":
+            fused["fused_score_compiles"] <= ladder,
+        "fused_serial_parity_f32": parity,
+        "streamed_equals_in_memory": bool(streamed_equal),
+    }
+
+
+def main():
+    from _common import init_jax
+
+    jax, platform, n_chips = init_jax()
+    print(json.dumps(run(jax, platform, n_chips)))
+
+
+if __name__ == "__main__":
+    main()

@@ -206,6 +206,7 @@ _MODULE_NAMESPACE_MAP = {
 _PASSTHROUGH_NAMESPACES = {
     "continual": "synapseml_tpu.continual",
     "fleet": "synapseml_tpu.fleet",
+    "rai": "synapseml_tpu.rai",
     "registry": "synapseml_tpu.registry",
     "retrieval": "synapseml_tpu.retrieval",
     "scoring": "synapseml_tpu.scoring",

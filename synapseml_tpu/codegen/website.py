@@ -174,9 +174,9 @@ def emit_site(docs_dir: str | None = None, out_dir: str | None = None) -> list[s
 
     sections = {"": ["GETTING_STARTED.md", "ARCHITECTURE.md", "AUTOML.md",
                      "BENCHMARKS.md", "CONTINUAL.md", "DATA.md", "FLEET.md",
-                     "OBSERVABILITY.md", "REGISTRY.md", "RESILIENCE.md",
-                     "RETRIEVAL.md", "SCORING.md", "SERVING.md",
-                     "SHARDING.md"],
+                     "OBSERVABILITY.md", "RAI.md", "REGISTRY.md",
+                     "RESILIENCE.md", "RETRIEVAL.md", "SCORING.md",
+                     "SERVING.md", "SHARDING.md"],
                 "api": sorted(f for f in os.listdir(os.path.join(docs_dir, "api"))
                               if f.endswith(".md"))}
     pages = []  # (out_name, title, src_path)

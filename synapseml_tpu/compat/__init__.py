@@ -7,7 +7,7 @@ chaining). Regenerate with ``python -m synapseml_tpu.codegen``.
 
 import importlib
 
-_MODULES = ['automl', 'causal', 'cntk', 'continual', 'core', 'cyber', 'dl', 'explainers', 'exploratory', 'featurize', 'fleet', 'hf', 'io', 'isolationforest', 'lightgbm', 'nn', 'onnx', 'opencv', 'recommendation', 'registry', 'retrieval', 'scoring', 'services', 'stages', 'train', 'vw']
+_MODULES = ['automl', 'causal', 'cntk', 'continual', 'core', 'cyber', 'dl', 'explainers', 'exploratory', 'featurize', 'fleet', 'hf', 'io', 'isolationforest', 'lightgbm', 'nn', 'onnx', 'opencv', 'rai', 'rai', 'recommendation', 'registry', 'retrieval', 'scoring', 'services', 'stages', 'train', 'vw']
 
 
 _REGISTRY = None

@@ -13,6 +13,8 @@ from synapseml_tpu.continual import (  # noqa: F401
     RequestLogger,
     TrainAttempt,
     TrainSupervisor,
+    annotate_drift_gauge,
+    drift_annotation,
     logged_request_source,
 )
 
@@ -23,5 +25,7 @@ __all__ = [
     'RequestLogger',
     'TrainAttempt',
     'TrainSupervisor',
+    'annotate_drift_gauge',
+    'drift_annotation',
     'logged_request_source',
 ]

@@ -13,6 +13,12 @@ class LocalExplainerBase(WrapperBase):
 
     _target = 'synapseml_tpu.explainers.base.LocalExplainerBase'
 
+    def setFused(self, value):
+        return self._set('fused', value)
+
+    def getFused(self):
+        return self._get('fused')
+
     def setModel(self, value):
         return self._set('model', value)
 
@@ -60,6 +66,12 @@ class ICETransformer(WrapperBase):
 
     def getCategoricalFeatures(self):
         return self._get('categorical_features')
+
+    def setFused(self, value):
+        return self._set('fused', value)
+
+    def getFused(self):
+        return self._get('fused')
 
     def setKind(self, value):
         return self._set('kind', value)
@@ -126,6 +138,12 @@ class ImageLIME(WrapperBase):
 
     def getCellSize(self):
         return self._get('cell_size')
+
+    def setFused(self, value):
+        return self._set('fused', value)
+
+    def getFused(self):
+        return self._get('fused')
 
     def setInputCol(self, value):
         return self._set('input_col', value)
@@ -211,6 +229,12 @@ class TabularLIME(WrapperBase):
     def getBackgroundData(self):
         return self._get('background_data')
 
+    def setFused(self, value):
+        return self._set('fused', value)
+
+    def getFused(self):
+        return self._get('fused')
+
     def setInputCol(self, value):
         return self._set('input_col', value)
 
@@ -276,6 +300,12 @@ class TextLIME(WrapperBase):
     """(ref ``TextLIME.scala``) token on/off perturbations. (wraps ``synapseml_tpu.explainers.lime.TextLIME``)."""
 
     _target = 'synapseml_tpu.explainers.lime.TextLIME'
+
+    def setFused(self, value):
+        return self._set('fused', value)
+
+    def getFused(self):
+        return self._get('fused')
 
     def setInputCol(self, value):
         return self._set('input_col', value)
@@ -355,6 +385,12 @@ class VectorLIME(WrapperBase):
     def getBackgroundData(self):
         return self._get('background_data')
 
+    def setFused(self, value):
+        return self._set('fused', value)
+
+    def getFused(self):
+        return self._get('fused')
+
     def setInputCol(self, value):
         return self._set('input_col', value)
 
@@ -421,6 +457,12 @@ class ImageSHAP(WrapperBase):
     def getCellSize(self):
         return self._get('cell_size')
 
+    def setFused(self, value):
+        return self._set('fused', value)
+
+    def getFused(self):
+        return self._get('fused')
+
     def setInputCol(self, value):
         return self._set('input_col', value)
 
@@ -481,6 +523,12 @@ class TabularSHAP(WrapperBase):
     def getBackgroundData(self):
         return self._get('background_data')
 
+    def setFused(self, value):
+        return self._set('fused', value)
+
+    def getFused(self):
+        return self._get('fused')
+
     def setInputCol(self, value):
         return self._set('input_col', value)
 
@@ -534,6 +582,12 @@ class TextSHAP(WrapperBase):
     """(ref ``TextSHAP.scala``) tokens as players; off tokens dropped. (wraps ``synapseml_tpu.explainers.shap.TextSHAP``)."""
 
     _target = 'synapseml_tpu.explainers.shap.TextSHAP'
+
+    def setFused(self, value):
+        return self._set('fused', value)
+
+    def getFused(self):
+        return self._get('fused')
 
     def setInputCol(self, value):
         return self._set('input_col', value)
@@ -594,6 +648,12 @@ class VectorSHAP(WrapperBase):
 
     def getBackgroundData(self):
         return self._get('background_data')
+
+    def setFused(self, value):
+        return self._set('fused', value)
+
+    def getFused(self):
+        return self._get('fused')
 
     def setInputCol(self, value):
         return self._set('input_col', value)

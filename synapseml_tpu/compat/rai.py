@@ -1,0 +1,49 @@
+"""Generated passthrough namespace — do not edit.
+
+Regenerate with ``python -m synapseml_tpu.codegen`` (emit_wrappers).
+Re-exports the public surface of ``synapseml_tpu.rai`` so the compat layer covers
+non-stage subsystems too (compat coverage is drift-tested).
+"""
+
+
+from synapseml_tpu.rai import (  # noqa: F401
+    AuditJob,
+    AuditReport,
+    AuditSpec,
+    DRIFT_GAUGE,
+    FUSED_SCORE_FN_ID,
+    MAX_FUSED_ROWS,
+    array_score_fn,
+    default_feature_fn,
+    default_segment_fn,
+    explain_source,
+    fused_array_scores,
+    fused_block_scores,
+    fused_columnar_scores,
+    js_divergence,
+    psi,
+    rai_measures,
+    reference_bins,
+    segment_drift,
+)
+
+__all__ = [
+    'AuditJob',
+    'AuditReport',
+    'AuditSpec',
+    'DRIFT_GAUGE',
+    'FUSED_SCORE_FN_ID',
+    'MAX_FUSED_ROWS',
+    'array_score_fn',
+    'default_feature_fn',
+    'default_segment_fn',
+    'explain_source',
+    'fused_array_scores',
+    'fused_block_scores',
+    'fused_columnar_scores',
+    'js_divergence',
+    'psi',
+    'rai_measures',
+    'reference_bins',
+    'segment_drift',
+]

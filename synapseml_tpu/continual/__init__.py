@@ -39,7 +39,8 @@ See ``docs/CONTINUAL.md`` for the seam-by-seam degradation contract.
 """
 
 from .logger import RequestLogger, logged_request_source
-from .loop import ContinualLoop, ContinualSpec, LoopAborted
+from .loop import (ContinualLoop, ContinualSpec, LoopAborted,
+                   annotate_drift_gauge, drift_annotation)
 from .supervisor import TrainAttempt, TrainSupervisor
 
 __all__ = [
@@ -49,5 +50,7 @@ __all__ = [
     "RequestLogger",
     "TrainAttempt",
     "TrainSupervisor",
+    "annotate_drift_gauge",
+    "drift_annotation",
     "logged_request_source",
 ]

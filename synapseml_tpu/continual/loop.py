@@ -50,7 +50,29 @@ from ..registry.store import atomic_write_bytes
 from .logger import _DONE_SUFFIX, _PART_PREFIX  # shared layout constants
 from .supervisor import TrainSupervisor
 
-__all__ = ["ContinualSpec", "ContinualLoop", "LoopAborted"]
+__all__ = ["ContinualSpec", "ContinualLoop", "LoopAborted",
+           "annotate_drift_gauge", "drift_annotation"]
+
+# gauge name -> opaque evidence ref (e.g. the rai plane's audit artifact
+# "name:version"). A drift-triggered iteration appends it to the trigger
+# reason, so the retrain record carries WHY the gauge fired, not just that
+# it did. Process-local like the gauge itself; last writer wins.
+_DRIFT_ANNOTATIONS: dict[str, str] = {}
+
+
+def annotate_drift_gauge(gauge: str, evidence: str | None) -> None:
+    """Attach (or clear, with ``None``) the evidence ref behind a drift
+    gauge — the rai ``AuditJob`` calls this with the audit artifact it
+    published alongside setting the per-segment gauge values."""
+    if evidence is None:
+        _DRIFT_ANNOTATIONS.pop(gauge, None)
+    else:
+        _DRIFT_ANNOTATIONS[gauge] = str(evidence)
+
+
+def drift_annotation(gauge: str) -> str | None:
+    """The current evidence ref behind ``gauge``, if any."""
+    return _DRIFT_ANNOTATIONS.get(gauge)
 
 _LOOP_METRICS = obs.HandleCache(lambda reg: {
     "iterations": reg.counter(
@@ -248,8 +270,14 @@ class ContinualLoop:
         if self.spec.drift_gauge and self.spec.drift_threshold is not None:
             value = self._gauge_value(self.spec.drift_gauge)
             if value is not None and value > self.spec.drift_threshold:
-                return (True, f"drift {self.spec.drift_gauge}="
-                        f"{value:g}>{self.spec.drift_threshold:g}")
+                reason = (f"drift {self.spec.drift_gauge}="
+                          f"{value:g}>{self.spec.drift_threshold:g}")
+                evidence = drift_annotation(self.spec.drift_gauge)
+                if evidence:
+                    # e.g. the rai plane's published audit artifact: the
+                    # retrain record names its triggering evidence
+                    reason += f" audit={evidence}"
+                return True, reason
         return False, f"fresh_rows={fresh_rows}<{self.spec.min_new_rows}"
 
     @staticmethod

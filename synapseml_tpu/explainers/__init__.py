@@ -11,6 +11,7 @@ then the local weighted linear models are solved per row with vectorized
 numpy/jax least squares.
 """
 
+from .base import row_rng
 from .lasso import lasso_regression, weighted_least_squares
 from .lime import ImageLIME, TabularLIME, TextLIME, VectorLIME
 from .shap import ImageSHAP, TabularSHAP, TextSHAP, VectorSHAP
@@ -20,4 +21,5 @@ __all__ = [
     "TabularLIME", "VectorLIME", "ImageLIME", "TextLIME",
     "TabularSHAP", "VectorSHAP", "ImageSHAP", "TextSHAP",
     "ICETransformer", "lasso_regression", "weighted_least_squares",
+    "row_rng",
 ]

@@ -4,13 +4,35 @@
 
 from __future__ import annotations
 
+import hashlib
+
 import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
 from ..core.pipeline import Transformer
 
-__all__ = ["LocalExplainerBase"]
+__all__ = ["LocalExplainerBase", "row_rng"]
+
+
+def row_rng(seed: int, row_key) -> np.random.Generator:
+    """One rng per (seed, row) — the determinism contract of the rai plane.
+
+    The stream is derived from a blake2b digest of the row's CONTENT (array
+    bytes / utf-8 text), keyed by ``seed``, so the same row draws the same
+    coalitions / neighborhoods no matter which host, shard or partition
+    explains it, and no matter how many rows came before it in the batch.
+    That content-keying is what makes streamed explanation runs resumable
+    byte-identically and partition-invariant (ISSUE 20 satellite)."""
+    if isinstance(row_key, np.ndarray):
+        payload = np.ascontiguousarray(row_key).tobytes()
+    elif isinstance(row_key, (bytes, bytearray)):
+        payload = bytes(row_key)
+    else:
+        payload = str(row_key).encode()
+    digest = hashlib.blake2b(payload, digest_size=16,
+                             key=str(int(seed)).encode()).digest()
+    return np.random.default_rng(int.from_bytes(digest, "little"))
 
 
 class LocalExplainerBase(Transformer):
@@ -27,6 +49,26 @@ class LocalExplainerBase(Transformer):
     num_samples = Param("num_samples", "perturbations per row", default=256,
                         converter=TypeConverters.to_int)
     seed = Param("seed", "rng seed", default=0, converter=TypeConverters.to_int)
+    fused = Param("fused", "perturbation scoring path: True = fused "
+                  "ladder-bucketed batches through the shared CompiledCache "
+                  "(rai plane), False = the serial reference loop, 'auto' = "
+                  "fused when the model exposes an array score fn",
+                  default="auto")
+
+    def _use_fused(self) -> bool:
+        mode = self.get("fused")
+        if mode is True or mode is False:
+            return bool(mode)
+        from ..rai.fused import array_score_fn
+
+        return array_score_fn(self.get("model")) is not None
+
+    def _target_index(self, n_cols: int) -> list[int]:
+        """Class indices to explain, clamped into the model's output width —
+        the ONE selection rule shared by the serial ``_score_samples`` path
+        and the rai fused engine (parity depends on it)."""
+        targets = self.get("target_classes") or [0]
+        return [t if t < n_cols else n_cols - 1 for t in targets]
 
     def _score_samples(self, sample_df: DataFrame) -> np.ndarray:
         """Run the wrapped model; returns [n_samples_total, n_targets]."""
@@ -34,12 +76,21 @@ class LocalExplainerBase(Transformer):
         col = scored.collect_column(self.get("target_col"))
         arr = np.asarray(np.stack([np.atleast_1d(np.asarray(v, np.float64))
                                    for v in col]))
-        targets = self.get("target_classes") or [0]
-        idx = [t if t < arr.shape[1] else arr.shape[1] - 1 for t in targets]
-        return arr[:, idx]
+        return arr[:, self._target_index(arr.shape[1])]
 
-    @staticmethod
-    def _pack_explanations(coef_rows: list) -> np.ndarray:
+    def transform_source(self, source, sink, **opts):
+        """Corpus-scale explanation: the scoring plane's reader→compute→
+        writer pipeline (exactly-once DONE-gated sinks, resume, quarantine)
+        plus the ``synapseml_rai_*`` series — see ``rai/stream.py``."""
+        from ..rai.stream import explain_source
+
+        return explain_source(self, source, sink, **opts)
+
+    def _pack_explanations(self, coef_rows: list) -> np.ndarray:
+        from ..rai.metrics import rai_measures
+
+        rai_measures()["explanations"].inc(len(coef_rows),
+                                           explainer=type(self).__name__)
         out = np.empty(len(coef_rows), dtype=object)
         for i, c in enumerate(coef_rows):
             out[i] = np.asarray(c, np.float32)

@@ -55,7 +55,16 @@ class ICETransformer(LocalExplainerBase):
                    else np.concatenate([v] * G)
                    for k, v in whole.items()}
             rep[col] = np.repeat(grid, n)
-            scores = self._score_samples(DataFrame.from_dict(rep))  # [G*n, T]
+            scores = None
+            if self._use_fused():
+                from ..rai.fused import fused_columnar_scores
+
+                # G*n grid clones in ladder-bucketed mega-batches through
+                # the model's own score fn (None when the model declares no
+                # columnar score path — fall through to the serial call)
+                scores = fused_columnar_scores(self, rep)
+            if scores is None:
+                scores = self._score_samples(DataFrame.from_dict(rep))  # [G*n, T]
             curves = scores.reshape(G, n, -1).transpose(1, 0, 2)    # [n, G, T]
             if self.get("kind") == "average":
                 pdp = curves.mean(axis=0)                           # [G, T]

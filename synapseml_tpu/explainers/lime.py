@@ -12,7 +12,7 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
-from .base import LocalExplainerBase
+from .base import LocalExplainerBase, row_rng
 from .lasso import lasso_regression
 
 __all__ = ["TabularLIME", "VectorLIME", "ImageLIME", "TextLIME"]
@@ -62,16 +62,24 @@ class VectorLIME(_LIMEBase):
         self.require_columns(df, self.get("input_col"))
         std = self._background_stats(df)
         S = self.get("num_samples")
-        rng = np.random.default_rng(self.get("seed"))
+        seed = self.get("seed")
 
         def per_part(p):
             X = np.stack([np.asarray(v, np.float64) for v in p[self.get("input_col")]])
             n, M = X.shape
-            noise = rng.standard_normal((n, S, M))
+            # one neighborhood draw per (seed, row content): the same row
+            # gets the same perturbations on any shard/partitioning
+            noise = np.stack([row_rng(seed, X[i]).standard_normal((S, M))
+                              for i in range(n)])
             samples = X[:, None, :] + noise * std[None, None, :]
             flat = samples.reshape(n * S, M).astype(np.float32)
-            scores = self._score_samples(
-                DataFrame.from_dict({self.get("input_col"): flat}))
+            if self._use_fused():
+                from ..rai.fused import fused_array_scores
+
+                scores = fused_array_scores(self, flat)
+            else:
+                scores = self._score_samples(
+                    DataFrame.from_dict({self.get("input_col"): flat}))
             scores = scores.reshape(n, S, -1)
             dist = np.sqrt((noise ** 2).mean(axis=2))     # [n, S] scaled distance
             expl = []
@@ -141,7 +149,7 @@ class ImageLIME(_LIMEBase):
 
         self.require_columns(df, self.get("input_col"))
         S = self.get("num_samples")
-        rng = np.random.default_rng(self.get("seed"))
+        seed = self.get("seed")
         frac = self.get("sampling_fraction")
 
         def per_part(p):
@@ -150,15 +158,25 @@ class ImageLIME(_LIMEBase):
             label_maps = (list(p[sp_col]) if sp_col and sp_col in p else
                           [slic_segments(im, self.get("cell_size"), self.get("modifier"))
                            for im in imgs])
-            expl = []
+            designs, blocks = [], []
             for im, labels in zip(imgs, label_maps):
                 K = int(labels.max()) + 1
-                states = rng.random((S, K)) < frac       # [S, K] on/off
+                states = row_rng(seed, im).random((S, K)) < frac  # [S, K]
                 states[0] = True                          # include the full image
                 masks = states[:, labels]                 # [S, H, W]
-                samples = im[None] * masks[:, :, :, None]
-                scores = self._score_samples(DataFrame.from_dict(
-                    {self.get("input_col"): [s for s in samples]}))
+                designs.append(states)
+                blocks.append(im[None] * masks[:, :, :, None])
+            builder = lambda samples: DataFrame.from_dict(  # noqa: E731
+                {self.get("input_col"): [s for s in samples]})
+            if self._use_fused():
+                from ..rai.fused import fused_block_scores
+
+                score_blocks = fused_block_scores(self, blocks, builder)
+            else:
+                score_blocks = [self._score_samples(builder(b))
+                                for b in blocks]
+            expl = []
+            for states, scores in zip(designs, score_blocks):
                 dist = 1.0 - states.mean(axis=1)          # fraction turned off
                 expl.append(self._fit_surrogates(states.astype(np.float64),
                                                  scores, dist))
@@ -183,23 +201,33 @@ class TextLIME(_LIMEBase):
     def _transform(self, df: DataFrame) -> DataFrame:
         self.require_columns(df, self.get("input_col"))
         S = self.get("num_samples")
-        rng = np.random.default_rng(self.get("seed"))
+        seed = self.get("seed")
         frac = self.get("sampling_fraction")
 
         def per_part(p):
             texts = [str(t) for t in p[self.get("input_col")]]
-            expl = []
             token_rows = np.empty(len(texts), dtype=object)
+            designs, blocks = [], []
             for r, text in enumerate(texts):
                 tokens = text.split()
                 token_rows[r] = np.asarray(tokens, dtype=object)
                 K = max(len(tokens), 1)
-                states = rng.random((S, K)) < frac
+                states = row_rng(seed, text).random((S, K)) < frac
                 states[0] = True
-                variants = [" ".join(t for t, on in zip(tokens, st) if on)
-                            for st in states]
-                scores = self._score_samples(DataFrame.from_dict(
-                    {self.get("input_col"): variants}))
+                designs.append(states)
+                blocks.append([" ".join(t for t, on in zip(tokens, st) if on)
+                               for st in states])
+            builder = lambda samples: DataFrame.from_dict(  # noqa: E731
+                {self.get("input_col"): samples})
+            if self._use_fused():
+                from ..rai.fused import fused_block_scores
+
+                score_blocks = fused_block_scores(self, blocks, builder)
+            else:
+                score_blocks = [self._score_samples(builder(b))
+                                for b in blocks]
+            expl = []
+            for states, scores in zip(designs, score_blocks):
                 dist = 1.0 - states.mean(axis=1)
                 expl.append(self._fit_surrogates(states.astype(np.float64),
                                                  scores, dist))

@@ -15,7 +15,7 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.params import ComplexParam, Param, TypeConverters
-from .base import LocalExplainerBase
+from .base import LocalExplainerBase, row_rng
 from .lasso import weighted_least_squares
 
 __all__ = ["TabularSHAP", "VectorSHAP", "ImageSHAP", "TextSHAP"]
@@ -27,29 +27,46 @@ def shapley_kernel_weight(M: int, s: int) -> float:
     return (M - 1) / (math.comb(M, s) * s * (M - s))
 
 
+# (M, n_samples) -> enumerated (states, weights): in the exhaustive regime
+# the design is rng-free and identical for every row, so rows share one copy
+# instead of re-enumerating 2^M coalitions per row
+_ENUM_DESIGNS: dict = {}
+
+
 def sample_coalitions(M: int, n_samples: int, rng) -> tuple[np.ndarray, np.ndarray]:
     """[S, M] binary coalition matrix + kernel weights; always includes the
     empty and full coalitions (they pin phi0 and the efficiency constraint)."""
-    states = [np.zeros(M, bool), np.ones(M, bool)]
-    weights = [shapley_kernel_weight(M, 0), shapley_kernel_weight(M, M)]
     # enumerate when feasible, sample otherwise (reference sampler behavior)
     if 2 ** M <= n_samples:
+        hit = _ENUM_DESIGNS.get((M, n_samples))
+        if hit is not None:
+            return hit
+        states = [np.zeros(M, bool), np.ones(M, bool)]
+        weights = [shapley_kernel_weight(M, 0), shapley_kernel_weight(M, M)]
         for code in range(1, 2 ** M - 1):
             z = np.asarray([(code >> b) & 1 for b in range(M)], bool)
             states.append(z)
             weights.append(shapley_kernel_weight(M, int(z.sum())))
-    else:
-        sizes = np.arange(1, M)
-        size_w = np.asarray([shapley_kernel_weight(M, s) * math.comb(M, s)
-                             for s in sizes])
-        size_p = size_w / size_w.sum()
-        for _ in range(n_samples - 2):
-            s = rng.choice(sizes, p=size_p)
-            z = np.zeros(M, bool)
-            z[rng.choice(M, size=s, replace=False)] = True
-            states.append(z)
-            weights.append(shapley_kernel_weight(M, s))
-    return np.asarray(states), np.asarray(weights, np.float64)
+        out = (np.asarray(states), np.asarray(weights, np.float64))
+        if len(_ENUM_DESIGNS) < 64:
+            _ENUM_DESIGNS[(M, n_samples)] = out
+        return out
+    pinned = np.stack([np.zeros(M, bool), np.ones(M, bool)])
+    pinned_w = np.asarray([shapley_kernel_weight(M, 0),
+                           shapley_kernel_weight(M, M)], np.float64)
+    sizes = np.arange(1, M)
+    size_w = np.asarray([shapley_kernel_weight(M, s) * math.comb(M, s)
+                         for s in sizes])
+    size_p = size_w / size_w.sum()
+    n_draw = max(n_samples - 2, 0)
+    s_draw = rng.choice(sizes, size=n_draw, p=size_p)
+    # the s smallest of M iid uniform keys are a uniform random size-s
+    # subset, so one double argsort yields every sample's membership mask
+    ranks = rng.random((n_draw, M)).argsort(axis=1).argsort(axis=1)
+    wt = np.asarray([shapley_kernel_weight(M, s) for s in range(M + 1)],
+                    np.float64)
+    return (np.concatenate([pinned, ranks < s_draw[:, None]]),
+            np.concatenate([pinned_w, wt[s_draw]]))
 
 
 def solve_shap(Z: np.ndarray, y: np.ndarray, w: np.ndarray) -> np.ndarray:
@@ -60,16 +77,35 @@ def solve_shap(Z: np.ndarray, y: np.ndarray, w: np.ndarray) -> np.ndarray:
 
 
 class _KernelSHAPBase(LocalExplainerBase):
-    def _explain_rows(self, make_samples, K_of_row, rows, score_input_builder):
-        """Shared loop: rows -> coalitions -> model scores -> phi vectors."""
-        rng = np.random.default_rng(self.get("seed"))
+    def _explain_rows(self, make_samples, K_of_row, rows, score_input_builder,
+                      row_keys=None):
+        """Shared loop: rows -> coalitions -> model scores -> phi vectors.
+
+        Each row's coalition draw comes from ``row_rng(seed, row)`` — keyed
+        on the row CONTENT (``row_keys`` overrides the key when ``rows``
+        are not hashable payloads themselves), so the same row gets the
+        same design on any host/shard/partitioning. Scoring goes per-row
+        through ``_score_samples`` (serial reference path) or through the
+        rai fused engine (all rows' perturbations in ladder-bucketed
+        mega-batches, one executable per rung)."""
         S = self.get("num_samples")
+        seed = self.get("seed")
+        keys = list(rows) if row_keys is None else list(row_keys)
+        designs = []                       # (states [S,K], weights [S])
+        for r, key in zip(rows, keys):
+            designs.append(sample_coalitions(K_of_row(r), S,
+                                             row_rng(seed, key)))
+        if self._use_fused():
+            from ..rai.fused import fused_block_scores
+
+            blocks = fused_block_scores(
+                self, [make_samples(r, st) for r, (st, _) in
+                       zip(rows, designs)], score_input_builder)
+        else:
+            blocks = [self._score_samples(score_input_builder(
+                make_samples(r, st))) for r, (st, _) in zip(rows, designs)]
         expl = []
-        for r in rows:
-            K = K_of_row(r)
-            states, w = sample_coalitions(K, S, rng)
-            samples = make_samples(r, states)
-            scores = self._score_samples(score_input_builder(samples))
+        for (states, w), scores in zip(designs, blocks):
             phis = [solve_shap(states, scores[:, t], w)
                     for t in range(scores.shape[1])]
             expl.append(np.stack(phis))  # [T, K+1]
@@ -162,23 +198,26 @@ class ImageSHAP(_KernelSHAPBase):
 
         def per_part(p):
             imgs = [as_image(v) for v in p[self.get("input_col")]]
-            expl = []
+            rows = []
             for im in imgs:
-                labels = slic_segments(im, self.get("cell_size"), self.get("modifier"))
-                fill = im.mean(axis=(0, 1))
+                labels = slic_segments(im, self.get("cell_size"),
+                                       self.get("modifier"))
+                rows.append((im, labels, im.mean(axis=(0, 1))))
 
-                def make_samples(_, states, im=im, labels=labels, fill=fill):
-                    masks = states[:, labels]              # [S, H, W]
-                    return np.where(masks[:, :, :, None], im[None], fill[None, None, None, :])
+            def make_samples(row, states):
+                im, labels, fill = row
+                masks = states[:, labels]                  # [S, H, W]
+                return np.where(masks[:, :, :, None], im[None],
+                                fill[None, None, None, :])
 
-                phis = self._explain_rows(
-                    make_samples=make_samples,
-                    K_of_row=lambda _im, K=int(labels.max()) + 1: K,
-                    rows=[im],
-                    score_input_builder=lambda samples: DataFrame.from_dict(
-                        {self.get("input_col"): [s for s in samples]}),
-                )
-                expl.extend(phis)
+            expl = self._explain_rows(
+                make_samples=make_samples,
+                K_of_row=lambda row: int(row[1].max()) + 1,
+                rows=rows,
+                score_input_builder=lambda samples: DataFrame.from_dict(
+                    {self.get("input_col"): [s for s in samples]}),
+                row_keys=imgs,
+            )
             q = dict(p)
             q[self.get("output_col")] = self._pack_explanations(expl)
             return q
@@ -199,24 +238,22 @@ class TextSHAP(_KernelSHAPBase):
 
         def per_part(p):
             texts = [str(t) for t in p[self.get("input_col")]]
-            expl = []
             token_rows = np.empty(len(texts), dtype=object)
             for r, text in enumerate(texts):
+                token_rows[r] = np.asarray(text.split(), dtype=object)
+
+            def make_samples(text, states):
                 tokens = text.split()
-                token_rows[r] = np.asarray(tokens, dtype=object)
+                return [" ".join(t for t, on in zip(tokens, st) if on)
+                        for st in states]
 
-                def make_samples(_, states, tokens=tokens):
-                    return [" ".join(t for t, on in zip(tokens, st) if on)
-                            for st in states]
-
-                phis = self._explain_rows(
-                    make_samples=make_samples,
-                    K_of_row=lambda _t, K=max(len(tokens), 1): K,
-                    rows=[text],
-                    score_input_builder=lambda samples: DataFrame.from_dict(
-                        {self.get("input_col"): samples}),
-                )
-                expl.extend(phis)
+            expl = self._explain_rows(
+                make_samples=make_samples,
+                K_of_row=lambda t: max(len(t.split()), 1),
+                rows=texts,
+                score_input_builder=lambda samples: DataFrame.from_dict(
+                    {self.get("input_col"): samples}),
+            )
             q = dict(p)
             q[self.get("output_col")] = self._pack_explanations(expl)
             q[self.get("token_col")] = token_rows

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..core.batching import default_bucketer, get_compiled_cache, pad_rows
 from ..core.dataframe import DataFrame
 from ..core.params import Param, TypeConverters
 from ..core.pipeline import Transformer
@@ -24,6 +25,59 @@ __all__ = ["FeatureBalanceMeasure", "DistributionBalanceMeasure",
            "AggregateBalanceMeasure"]
 
 _EPS = 1e-12
+
+PAIR_FN_ID = "exploratory.balance_pairs"
+_MEASURE_KEYS = ("dp", "pmi", "sdc", "ji", "llr", "krc", "n_pmi_y")
+_MAX_PAIR_ROWS = 1024
+
+
+def _build_pair_measures():
+    """One executable per pair-count bucket: every (classA, classB) gap
+    measure for a whole table of pairs in one fused elementwise pass.
+    Input is [P, 5] rows of (pa, pb, pa_y, pb_y, py); output [P, 7] in
+    ``_MEASURE_KEYS`` order."""
+    import jax
+    import jax.numpy as jnp
+
+    def measures(pairs):
+        pa, pb, pa_y, pb_y, py = (pairs[:, i] for i in range(5))
+        eps = _EPS  # representable in f32; dtype follows the input
+        dp_a = pa_y / jnp.maximum(pa, eps)
+        dp_b = pb_y / jnp.maximum(pb, eps)
+        log_py = jnp.log(jnp.maximum(py, eps))
+        pmi = (jnp.log(jnp.maximum(dp_a, eps))
+               - jnp.log(jnp.maximum(dp_b, eps)))
+        sdc = pa_y / jnp.maximum(pa + py, eps) - pb_y / jnp.maximum(pb + py,
+                                                                    eps)
+        ji = (pa_y / jnp.maximum(pa + py - pa_y, eps)
+              - pb_y / jnp.maximum(pb + py - pb_y, eps))
+        llr = (jnp.log(jnp.maximum(pa_y, eps))
+               - jnp.log(jnp.maximum(pb_y, eps)))
+        krc = (pa_y - pa * py) - (pb_y - pb * py)
+        n_pmi_y = pmi / jnp.maximum(-log_py, eps)
+        return jnp.stack([dp_a - dp_b, pmi, sdc, ji, llr, krc, n_pmi_y],
+                         axis=1)
+
+    return jax.jit(measures)
+
+
+def _pair_measure_table(pairs: np.ndarray) -> np.ndarray:
+    """[P, 5] (pa, pb, pa_y, pb_y, py) -> [P, 7] measures through the
+    shared CompiledCache on the bucket ladder (``PAIR_FN_ID``)."""
+    P = len(pairs)
+    if P == 0:
+        return np.zeros((0, len(_MEASURE_KEYS)), np.float64)
+    arr = np.ascontiguousarray(np.asarray(pairs, np.float64))
+    cache = get_compiled_cache()
+    out = np.empty((P, len(_MEASURE_KEYS)), np.float64)
+    for start, stop, bucket in default_bucketer().slices(
+            P, max_rows=_MAX_PAIR_ROWS):
+        chunk = pad_rows(arr[start:stop], bucket, mode="edge")
+        exe = cache.get(PAIR_FN_ID, (bucket, chunk.shape[1]),
+                        _build_pair_measures, dtype=str(chunk.dtype))
+        y = np.asarray(exe(chunk), np.float64)
+        out[start:stop] = y[: stop - start]
+    return out
 
 
 class FeatureBalanceMeasure(Transformer):
@@ -37,7 +91,8 @@ class FeatureBalanceMeasure(Transformer):
     label_col = Param("label_col", "binary label column", default="label")
 
     def _pair_measures(self, pa, pb, pa_y, pb_y, py) -> dict:
-        """p(class), p(class & positive), p(positive)."""
+        """p(class), p(class & positive), p(positive) — the scalar reference
+        for the compiled ``_pair_measure_table`` path (parity oracle)."""
         dp_a, dp_b = pa_y / max(pa, _EPS), pb_y / max(pb, _EPS)
         pmi_a = np.log(max(dp_a, _EPS) / max(py, _EPS))
         pmi_b = np.log(max(dp_b, _EPS) / max(py, _EPS))
@@ -65,27 +120,36 @@ class FeatureBalanceMeasure(Transformer):
         y = np.asarray(df.collect_column(self.get("label_col"))).astype(float) > 0
         n = len(y)
         py = float(y.mean()) if n else 0.0
-        rows = {"FeatureName": [], "ClassA": [], "ClassB": []}
-        measure_rows = []
+        feature_names: list = []
+        class_a: list = []
+        class_b: list = []
+        pair_blocks = []
         for col in cols:
             v = np.asarray(df.collect_column(col))
-            classes = np.unique(v)
-            for i, a in enumerate(classes):
-                for b in classes[i + 1:]:
-                    pa = float((v == a).mean())
-                    pb = float((v == b).mean())
-                    pa_y = float(((v == a) & y).mean())
-                    pb_y = float(((v == b) & y).mean())
-                    rows["FeatureName"].append(col)
-                    rows["ClassA"].append(a)
-                    rows["ClassB"].append(b)
-                    measure_rows.append(self._pair_measures(pa, pb, pa_y, pb_y, py))
-        out = {k: np.asarray(v) for k, v in rows.items()}
+            # one unique pass per column: class fractions + positive-class
+            # fractions via bincount, then every (i < j) pair at once
+            classes, inverse = np.unique(v, return_inverse=True)
+            counts = np.bincount(inverse, minlength=len(classes))
+            pos = np.bincount(inverse, weights=y.astype(np.float64),
+                              minlength=len(classes))
+            p_class = counts / max(n, 1)
+            p_class_y = pos / max(n, 1)
+            ia, ib = np.triu_indices(len(classes), k=1)
+            feature_names.extend([col] * len(ia))
+            class_a.extend(classes[ia].tolist())
+            class_b.extend(classes[ib].tolist())
+            pair_blocks.append(np.stack(
+                [p_class[ia], p_class[ib], p_class_y[ia], p_class_y[ib],
+                 np.full(len(ia), py)], axis=1))
+        pairs = (np.concatenate(pair_blocks) if pair_blocks
+                 else np.zeros((0, 5)))
+        table = _pair_measure_table(pairs)
+        out = {"FeatureName": np.asarray(feature_names),
+               "ClassA": np.asarray(class_a),
+               "ClassB": np.asarray(class_b)}
         # static measure schema even with zero class pairs (schema stability)
-        keys = (list(measure_rows[0]) if measure_rows
-                else list(self._pair_measures(0.5, 0.5, 0.25, 0.25, 0.5)))
-        for key in keys:
-            out[key] = np.asarray([m[key] for m in measure_rows])
+        for j, key in enumerate(_MEASURE_KEYS):
+            out[key] = table[:, j]
         return DataFrame([out])
 
 

@@ -1,0 +1,69 @@
+"""Responsible-AI audit plane: fused corpus-scale explainers, streamed
+data-balance/drift audits, and flywheel-triggering audit artifacts.
+
+The plane composes existing seams instead of inventing new ones:
+
+* **fused explanation** (:mod:`.fused`) — the perturbation batches every
+  local explainer generates (SHAP coalitions, LIME neighborhoods, ICE
+  grids) score through ONE ladder-bucketed executable per rung of the
+  shared ``core.batching.CompiledCache`` instead of a Python loop per row:
+  a model opts in by exposing ``score_fn()`` (a pure jax array fn), and the
+  compile bill for an entire corpus-scale run is bounded by the bucket
+  ladder, provable from the cache's miss counters;
+* **streamed runs** (:mod:`.stream`) — ``explainer.transform_source(source,
+  sink)`` IS a scoring-plane bulk scan (exactly-once DONE-gated sink parts,
+  resume, quarantine); content-keyed per-row rngs
+  (``explainers.row_rng``) make a killed-and-resumed run byte-identical;
+* **audits** (:mod:`.audit`, :mod:`.drift`) — :class:`AuditJob` replays the
+  continual plane's DONE-committed request log through per-segment drift
+  (PSI/JS vs a reference window), ``FeatureBalanceMeasure`` parity gaps,
+  isolation-forest anomaly rates, and exemplar explanations, publishing the
+  result as a content-addressed registry artifact;
+* **flywheel** — the audit feeds ``synapseml_rai_segment_drift`` and
+  annotates the gauge with its artifact ref, so a ``ContinualLoop`` watching
+  that gauge retrains WITH the evidence in its trigger reason;
+* **observe** (:mod:`.metrics`) — the ``synapseml_rai_*`` series.
+
+Submodules import lazily (PEP 562) so ``explainers/`` can consult the
+fused engine without dragging the registry/continual planes into every
+explainer import.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+__all__ = [
+    "array_score_fn", "fused_array_scores", "fused_block_scores",
+    "fused_columnar_scores", "FUSED_SCORE_FN_ID", "MAX_FUSED_ROWS",
+    "explain_source",
+    "psi", "js_divergence", "reference_bins", "segment_drift",
+    "AuditSpec", "AuditJob", "AuditReport",
+    "default_feature_fn", "default_segment_fn",
+    "rai_measures", "DRIFT_GAUGE",
+]
+
+_LOCATIONS = {
+    "array_score_fn": "fused", "fused_array_scores": "fused",
+    "fused_block_scores": "fused", "fused_columnar_scores": "fused",
+    "FUSED_SCORE_FN_ID": "fused", "MAX_FUSED_ROWS": "fused",
+    "explain_source": "stream",
+    "psi": "drift", "js_divergence": "drift", "reference_bins": "drift",
+    "segment_drift": "drift",
+    "AuditSpec": "audit", "AuditJob": "audit", "AuditReport": "audit",
+    "default_feature_fn": "audit", "default_segment_fn": "audit",
+    "rai_measures": "metrics", "DRIFT_GAUGE": "metrics",
+}
+
+
+def __getattr__(name: str):
+    submodule = _LOCATIONS.get(name)
+    if submodule is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(f".{submodule}", __name__), name)
+    globals()[name] = value  # cache: one import, stable identity
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,328 @@
+"""Nightly audit jobs over logged production traffic.
+
+An :class:`AuditJob` closes the responsible-AI loop the way the continual
+plane closed the training loop: it reads the SAME DONE-committed
+``RequestLogger`` shards the retrainer consumes, runs the audit battery —
+per-segment distribution drift vs a reference window (:mod:`.drift`),
+``FeatureBalanceMeasure`` label-parity gaps across segments, isolation-forest
+anomaly rates, and (optionally) fused exemplar explanations of the most
+drifted slice — and publishes the result as a REGISTRY ARTIFACT: a
+content-addressed, signed version of ``<model>-audit`` whose tree carries
+the manifest (model version, traffic window, metric tables) under
+``audit/``.
+
+The artifact is not just a report. ``run_once`` feeds the per-segment
+numbers into the ``synapseml_rai_segment_drift`` gauge and annotates the
+gauge with the artifact ref (``continual.annotate_drift_gauge``), so when
+``ContinualLoop.should_run`` fires on that gauge the retrain record's
+trigger reason names the exact audit that justified it — "the model
+drifted on segment X, evidence: <model>-audit:v7" instead of a bare
+number.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..continual.logger import _DONE_SUFFIX, _PART_PREFIX
+from ..continual.loop import _tolerant_rows, annotate_drift_gauge
+from ..core.dataframe import DataFrame
+from ..core.params import ComplexParam, Param
+from ..core.pipeline import Transformer
+from .drift import segment_drift
+from .metrics import DRIFT_GAUGE, rai_measures
+
+__all__ = ["AuditSpec", "AuditJob", "AuditReport",
+           "default_feature_fn", "default_segment_fn"]
+
+
+def default_feature_fn(record: dict) -> Sequence[float]:
+    """Logged record → feature vector: the request body's ``x`` (the same
+    convention as ``continual.default_row_fn``)."""
+    return record["body"]["x"]
+
+
+def default_segment_fn(record: dict) -> str:
+    """Logged record → segment key: the request path (one segment per
+    served route). Real deployments pass a cohort/geo/tier extractor."""
+    return str(record.get("path", "/"))
+
+
+class AuditReport(Transformer):
+    """The stage INSIDE a published audit artifact.
+
+    Publishing requires a stage; the report stage carries the audit summary
+    as params so ``registry.resolve(...)`` round-trips it like any model,
+    while the full metric tables ride the artifact tree under ``audit/``.
+    Its transform is identity — an audit artifact scores nothing."""
+
+    feature_name = "rai"
+
+    model_name = Param("model_name", "audited model name", default="")
+    model_version = Param("model_version", "audited model version at audit "
+                          "time", default="")
+    window = ComplexParam("window", "traffic window summary (parts, rows, "
+                          "ts range)", default=None)
+    summary = ComplexParam("summary", "flat audit metric summary",
+                           default=None)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return df
+
+
+@dataclasses.dataclass
+class AuditSpec:
+    """One audit's declarative config.
+
+    ``reference`` is the healthy/training feature window [n_ref, M] the
+    traffic is compared against; ``segment_fn``/``feature_fn``/``label_fn``
+    map a logged request record to its segment key, feature vector, and
+    (optional) binary label for the balance measures."""
+
+    model: str
+    reference: np.ndarray
+    feature_fn: Callable[[dict], Sequence[float]] = default_feature_fn
+    segment_fn: Callable[[dict], str] = default_segment_fn
+    label_fn: Callable[[dict], object] | None = None
+    drift_gauge: str = DRIFT_GAUGE
+    drift_metric: str = "psi"
+    drift_bins: int = 10
+    alias: str = "prod"
+    artifact: str | None = None        # default: f"{model}-audit"
+    anomaly_trees: int = 0             # 0 disables the isolation-forest pass
+    anomaly_seed: int = 0
+    explainer: object | None = None    # optional LocalExplainerBase
+    explain_rows: int = 8              # exemplars from the worst segment
+
+    @property
+    def artifact_name(self) -> str:
+        return self.artifact or f"{self.model}-audit"
+
+
+class AuditJob:
+    """Run the audit battery over a ``RequestLogger`` directory and publish
+    the result as a registry artifact; see the module docstring for the
+    flywheel contract."""
+
+    def __init__(self, spec: AuditSpec, registry, log_dir: str):
+        self.spec = spec
+        self.registry = registry
+        self.log_dir = log_dir
+
+    # -- traffic window ------------------------------------------------------
+    def _committed_parts(self) -> list[str]:
+        try:
+            names = sorted(os.listdir(self.log_dir))
+        except OSError:
+            return []
+        return [n for n in names
+                if n.startswith(_PART_PREFIX) and n.endswith(".jsonl")
+                and os.path.exists(os.path.join(self.log_dir,
+                                                n + _DONE_SUFFIX))]
+
+    def _collect_window(self, parts: list[str]):
+        """(features [n, M], segments, labels|None, ts range, quarantined)."""
+        feats: list[np.ndarray] = []
+        segs: list[str] = []
+        labels: list = []
+        ts_lo = ts_hi = None
+        quarantined = 0
+        for name in parts:
+            for record in _tolerant_rows(os.path.join(self.log_dir, name)):
+                if record is None:
+                    quarantined += 1
+                    continue
+                try:
+                    x = np.asarray(self.spec.feature_fn(record), np.float64)
+                    seg = str(self.spec.segment_fn(record))
+                except Exception:  # noqa: BLE001 — poisoned row, not job
+                    quarantined += 1
+                    continue
+                if x.ndim != 1 or not np.all(np.isfinite(x)):
+                    quarantined += 1
+                    continue
+                feats.append(x)
+                segs.append(seg)
+                if self.spec.label_fn is not None:
+                    try:
+                        labels.append(self.spec.label_fn(record))
+                    except Exception:  # noqa: BLE001
+                        labels.append(None)
+                ts = record.get("ts")
+                if isinstance(ts, (int, float)):
+                    ts_lo = ts if ts_lo is None else min(ts_lo, ts)
+                    ts_hi = ts if ts_hi is None else max(ts_hi, ts)
+        X = (np.stack(feats) if feats
+             else np.zeros((0, np.asarray(self.spec.reference).shape[-1])))
+        y = labels if self.spec.label_fn is not None else None
+        return X, segs, y, (ts_lo, ts_hi), quarantined
+
+    # -- audit passes --------------------------------------------------------
+    def _balance_table(self, segments: list[str], labels) -> list[dict]:
+        """Per-(segmentA, segmentB) label-parity gaps via the exploratory
+        plane's ``FeatureBalanceMeasure`` (sensitive col = segment)."""
+        from ..exploratory.balance import FeatureBalanceMeasure
+
+        pairs = [(s, l) for s, l in zip(segments, labels)
+                 if l is not None]
+        if not pairs:
+            return []
+        df = DataFrame.from_dict({
+            "segment": [s for s, _ in pairs],
+            "label": [int(bool(l)) for _, l in pairs],
+        })
+        out = FeatureBalanceMeasure(sensitive_cols=["segment"],
+                                    label_col="label").transform(df)
+        cols = {c: out.collect_column(c) for c in out.columns}
+        n = len(cols.get("ClassA", []))
+        return [{k: (v[i].item() if hasattr(v[i], "item") else v[i])
+                 for k, v in cols.items()} for i in range(n)]
+
+    def _anomaly_rates(self, X: np.ndarray,
+                       segments: list[str]) -> dict | None:
+        """Isolation forest fit on the REFERENCE window, scored on the
+        traffic: per-segment mean anomaly score + overall anomalous rate."""
+        if self.spec.anomaly_trees <= 0 or not len(X):
+            return None
+        from ..isolationforest.iforest import IsolationForest
+
+        ref = np.asarray(self.spec.reference, np.float64)
+        fit_df = DataFrame.from_dict({"features": [r for r in ref]})
+        model = IsolationForest(
+            num_estimators=self.spec.anomaly_trees,
+            random_seed=self.spec.anomaly_seed).fit(fit_df)
+        scores = model._scores(np.asarray(X, np.float64))
+        thr = float(model.get("threshold"))
+        keys = np.asarray(segments, dtype=object)
+        per_segment = {
+            seg: float(scores[keys == seg].mean())
+            for seg in sorted(set(segments))
+        }
+        return {"rate": float((scores >= thr).mean()),
+                "mean_score": float(scores.mean()),
+                "per_segment": per_segment}
+
+    def _exemplars(self, X: np.ndarray, segments: list[str],
+                   worst: str | None) -> list | None:
+        """Fused explanations of up to ``explain_rows`` rows from the most
+        drifted segment — the artifact shows WHICH features drive the
+        drifted slice's predictions, not just that the slice drifted."""
+        exp = self.spec.explainer
+        if exp is None or worst is None or not len(X):
+            return None
+        try:
+            keys = np.asarray(segments, dtype=object)
+            rows = X[keys == worst][: max(self.spec.explain_rows, 1)]
+            col = exp.get("input_col")
+            df = DataFrame.from_dict(
+                {col: [np.asarray(r, np.float32) for r in rows]})
+            out = exp.transform(df)
+            return [np.asarray(e, np.float64).tolist()
+                    for e in out.collect_column(exp.get("output_col"))]
+        except Exception:  # noqa: BLE001 — exemplars are best-effort
+            return None
+
+    # -- run -----------------------------------------------------------------
+    def run_once(self) -> dict:
+        """One audit: collect the committed window, run the battery, publish
+        the artifact, feed the drift gauges, annotate the trigger."""
+        spec = self.spec
+        m = rai_measures()
+        t0 = time.perf_counter()
+        parts = self._committed_parts()
+        X, segments, labels, (ts_lo, ts_hi), quarantined = \
+            self._collect_window(parts)
+        if not len(X):
+            m["audit_runs"].inc(1, model=spec.model, status="empty")
+            return {"status": "empty", "rows": 0, "parts": parts,
+                    "quarantined": quarantined}
+
+        drift = segment_drift(spec.reference, X, segments,
+                              bins=spec.drift_bins, metric=spec.drift_metric)
+        worst = max(drift, key=lambda s: drift[s]["drift"])
+        balance = (self._balance_table(segments, labels)
+                   if labels is not None else [])
+        anomaly = self._anomaly_rates(X, segments)
+        exemplars = self._exemplars(X, segments, worst)
+
+        try:
+            model_version = (self.registry.resolve_ref(spec.model, spec.alias)
+                             if spec.alias else "")
+        except (KeyError, RuntimeError):
+            model_version = ""
+        window = {"parts": parts, "rows": int(len(X)),
+                  "quarantined": int(quarantined),
+                  "ts_first": ts_lo, "ts_last": ts_hi}
+        metrics = {
+            "rows": float(len(X)),
+            "segments": float(len(drift)),
+            "max_segment_drift": drift[worst]["drift"],
+            "quarantined": float(quarantined),
+        }
+        if anomaly is not None:
+            metrics["anomaly_rate"] = anomaly["rate"]
+        if balance:
+            metrics["max_abs_dp_gap"] = max(abs(r.get("dp", 0.0))
+                                            for r in balance)
+        summary = dict(metrics, worst_segment=worst,
+                       drift_metric=spec.drift_metric)
+
+        report = AuditReport(model_name=spec.model,
+                             model_version=model_version,
+                             window=window, summary=summary)
+        tree = tempfile.mkdtemp(prefix="rai-audit-")
+        try:
+            audit_dir = os.path.join(tree, "audit")
+            os.makedirs(audit_dir)
+            manifest = {"model": spec.model, "model_version": model_version,
+                        "alias": spec.alias, "window": window,
+                        "drift_gauge": spec.drift_gauge,
+                        "drift_metric": spec.drift_metric,
+                        "drift_bins": spec.drift_bins, "metrics": metrics,
+                        "worst_segment": worst}
+            with open(os.path.join(audit_dir, "manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+            with open(os.path.join(audit_dir, "segment_drift.json"),
+                      "w") as f:
+                json.dump(drift, f, indent=1, sort_keys=True)
+            if balance:
+                with open(os.path.join(audit_dir, "balance.jsonl"),
+                          "w") as f:
+                    for row in balance:
+                        f.write(json.dumps(row) + "\n")
+            if anomaly is not None:
+                with open(os.path.join(audit_dir, "anomaly.json"), "w") as f:
+                    json.dump(anomaly, f, indent=1, sort_keys=True)
+            if exemplars is not None:
+                with open(os.path.join(audit_dir, "explanations.json"),
+                          "w") as f:
+                    json.dump({"segment": worst,
+                               "attributions": exemplars}, f)
+            published = self.registry.publish(
+                spec.artifact_name, report, metrics=metrics,
+                extra={"kind": "rai_audit", "model": spec.model,
+                       "model_version": model_version},
+                extra_tree=tree)
+        finally:
+            shutil.rmtree(tree, ignore_errors=True)
+
+        for seg, d in drift.items():
+            m["segment_drift"].set(d["drift"], model=spec.model, segment=seg)
+        artifact_ref = f"{published.name}:{published.version}"
+        if spec.drift_gauge:
+            annotate_drift_gauge(spec.drift_gauge, artifact_ref)
+        m["audit_runs"].inc(1, model=spec.model, status="ok")
+        m["audit_ms"].observe((time.perf_counter() - t0) * 1e3,
+                              model=spec.model)
+        return {"status": "ok", "artifact": artifact_ref,
+                "rows": int(len(X)), "parts": parts,
+                "quarantined": int(quarantined),
+                "worst_segment": worst, "drift": drift, "metrics": metrics}

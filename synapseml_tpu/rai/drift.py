@@ -1,0 +1,104 @@
+"""Per-segment distribution drift of logged traffic vs a reference window.
+
+The audit plane's drift question is "which slice of production traffic no
+longer looks like what the model was trained on?" — answered per SEGMENT
+(a caller-defined partition of requests: geography, client tier, cohort)
+so the retrain trigger can name the drifted slice instead of a corpus-wide
+average that washes real drift out.
+
+Measures: PSI (population stability index — the industry drift staple; >0.25
+is the conventional "significant shift" line) and Jensen-Shannon divergence,
+both over per-feature histograms binned at the REFERENCE window's deciles
+(quantile bins make the measures scale-free and robust to outliers). All
+vectorized: one ``searchsorted`` per feature over the whole window +
+``np.add.at`` scatter per segment — no per-row Python on the hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["psi", "js_divergence", "reference_bins", "segment_drift"]
+
+_EPS = 1e-6
+
+
+def reference_bins(reference: np.ndarray, bins: int = 10) -> list[np.ndarray]:
+    """Per-feature interior bin edges at the reference quantiles.
+
+    ``reference`` is [n_ref, M]; returns M edge arrays (deduplicated, so a
+    constant feature yields zero edges = one bin)."""
+    X = np.asarray(reference, np.float64)
+    if X.ndim == 1:
+        X = X[:, None]
+    qs = np.linspace(0.0, 1.0, max(int(bins), 2) + 1)[1:-1]
+    return [np.unique(np.quantile(X[:, j], qs)) for j in range(X.shape[1])]
+
+
+def _fractions(X: np.ndarray, edges: list[np.ndarray]) -> np.ndarray:
+    """[M, max_bins] bin-fraction table of ``X`` under ``edges``."""
+    M = len(edges)
+    width = max((len(e) + 1 for e in edges), default=1)
+    out = np.zeros((M, width), np.float64)
+    n = X.shape[0]
+    for j, e in enumerate(edges):
+        idx = np.searchsorted(e, X[:, j], side="right")
+        counts = np.bincount(idx, minlength=len(e) + 1)
+        out[j, : len(e) + 1] = counts / max(n, 1)
+    return out
+
+
+def psi(p: np.ndarray, q: np.ndarray) -> float:
+    """Population stability index between two fraction vectors/tables."""
+    p = np.asarray(p, np.float64) + _EPS
+    q = np.asarray(q, np.float64) + _EPS
+    p = p / p.sum(axis=-1, keepdims=True)
+    q = q / q.sum(axis=-1, keepdims=True)
+    return float(np.sum((p - q) * np.log(p / q), axis=-1).max())
+
+
+def js_divergence(p: np.ndarray, q: np.ndarray) -> float:
+    """Jensen-Shannon divergence (base e) between fraction vectors/tables;
+    returns the max over leading rows like :func:`psi`."""
+    p = np.asarray(p, np.float64) + _EPS
+    q = np.asarray(q, np.float64) + _EPS
+    p = p / p.sum(axis=-1, keepdims=True)
+    q = q / q.sum(axis=-1, keepdims=True)
+    m = 0.5 * (p + q)
+    kl_pm = np.sum(p * np.log(p / m), axis=-1)
+    kl_qm = np.sum(q * np.log(q / m), axis=-1)
+    return float((0.5 * (kl_pm + kl_qm)).max())
+
+
+def segment_drift(reference: np.ndarray, X: np.ndarray,
+                  segments, bins: int = 10,
+                  metric: str = "psi") -> dict[str, dict]:
+    """Drift of each traffic segment vs the reference window.
+
+    ``reference`` [n_ref, M] is the training/healthy window; ``X`` [n, M]
+    the audited traffic; ``segments`` a length-n sequence of segment keys.
+    Returns ``{segment: {"drift": <max over features>, "per_feature": [...],
+    "rows": n_seg}}`` under the chosen metric (``psi`` | ``js``)."""
+    ref = np.asarray(reference, np.float64)
+    if ref.ndim == 1:
+        ref = ref[:, None]
+    W = np.asarray(X, np.float64)
+    if W.ndim == 1:
+        W = W[:, None]
+    if W.shape[1] != ref.shape[1]:
+        raise ValueError(f"window has {W.shape[1]} features, reference "
+                         f"has {ref.shape[1]}")
+    measure = {"psi": psi, "js": js_divergence}[metric]
+    edges = reference_bins(ref, bins)
+    ref_frac = _fractions(ref, edges)
+    keys = np.asarray([str(s) for s in segments], dtype=object)
+    out: dict[str, dict] = {}
+    for seg in sorted(set(keys.tolist())):
+        rows = W[keys == seg]
+        frac = _fractions(rows, edges)
+        per_feature = [measure(frac[j], ref_frac[j])
+                       for j in range(ref.shape[1])]
+        out[seg] = {"drift": float(max(per_feature)),
+                    "per_feature": [float(v) for v in per_feature],
+                    "rows": int(rows.shape[0])}
+    return out

@@ -1,0 +1,185 @@
+"""Fused perturbation engine — the rai plane's compute core.
+
+A SHAP coalition, a LIME neighborhood and an ICE grid clone are all the same
+workload: N perturbed forward passes of ONE model ("same program, different
+data" — the HFTA observation, arXiv:2102.02344). The seed explainers score
+them row-at-a-time through ``model.transform`` (a Python/DataFrame round
+trip per explained row); this engine concatenates EVERY row's perturbations
+into mega-batches, pads them to the process bucket ladder, and scores them
+through the explained model's own score fn acquired via the shared
+``core/batching.CompiledCache`` — the PR-7 fused-trial discipline applied to
+perturbations. Compile count is bounded by the ladder (one executable per
+rung per (model instance, feature shape, dtype)), proved by the
+``CompiledCache.miss_count`` acceptance surface, never by the corpus size.
+
+The score-fn protocol: a stage opts into fusion by exposing
+``score_fn() -> callable`` returning a pure jax-traceable function
+``fn(X: [B, ...]) -> [B, T]`` over the SAME feature layout its ``transform``
+consumes, plus (for columnar stages, the ICE path) ``score_cols`` naming the
+column order ``X`` is assembled in. Models without the protocol still fuse
+at the batching level: all rows' perturbations go through ONE
+``_score_samples`` call per ladder-capped chunk instead of one per row.
+
+Everything here is deterministic given the explainer's (seed, row content)
+rng — results never depend on which rows share a fused batch (padding is
+sliced back off before any per-row solve), which is what makes streamed
+explanation runs resumable byte-identically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.batching import (
+    default_bucketer,
+    get_compiled_cache,
+    instance_token,
+    pad_rows,
+)
+from .metrics import rai_measures
+
+__all__ = ["array_score_fn", "fused_array_scores", "fused_block_scores",
+           "fused_columnar_scores", "FUSED_SCORE_FN_ID", "MAX_FUSED_ROWS"]
+
+# the CompiledCache fn_id every fused explainer executable is acquired
+# under: miss_count(FUSED_SCORE_FN_ID) is the explainer compile bound
+FUSED_SCORE_FN_ID = "rai.fused_score"
+
+# fused mega-batches are capped at the ladder top so peak sample memory is
+# bounded by (cap x feature width), not by rows x perturbations
+MAX_FUSED_ROWS = 1024
+
+
+def array_score_fn(model):
+    """The model's pure array score fn, or ``None`` when it doesn't expose
+    the protocol (``score_fn()`` -> jax-traceable ``fn(X) -> [B, T]``)."""
+    getter = getattr(model, "score_fn", None)
+    if not callable(getter):
+        return None
+    try:
+        fn = getter()
+    except Exception:  # noqa: BLE001 — a broken protocol demotes to serial
+        return None
+    return fn if callable(fn) else None
+
+
+def _ladder_scores(explainer, X: np.ndarray, fn) -> np.ndarray:
+    """Score ``X`` [N, ...] through ``fn`` in bucket-ladder chunks; one
+    executable per rung via the shared CompiledCache. Returns the
+    target-selected [N, T] float64 scores (same selection rule as the
+    serial ``_score_samples`` — parity depends on it)."""
+    model = explainer.get("model")
+    cache = get_compiled_cache()
+    bucketer = default_bucketer()
+    name = type(explainer).__name__
+    n = X.shape[0]
+    out = None
+    valid = 0
+    padded = 0
+    for start, stop, bucket in bucketer.slices(n, max_rows=MAX_FUSED_ROWS):
+        chunk = pad_rows(np.ascontiguousarray(X[start:stop]), bucket,
+                         mode="edge")
+
+        def build(fn=fn):
+            import jax
+
+            return jax.jit(fn)
+
+        exe = cache.get(FUSED_SCORE_FN_ID, (bucket,) + tuple(X.shape[1:]),
+                        build, instance=instance_token(model),
+                        dtype=str(X.dtype))
+        y = np.atleast_2d(np.asarray(exe(chunk), np.float64))
+        if y.ndim == 1 or y.shape[0] != chunk.shape[0]:
+            y = y.reshape(chunk.shape[0], -1)
+        if out is None:
+            out = np.empty((n, y.shape[1]), np.float64)
+        out[start:stop] = y[: stop - start]
+        valid += stop - start
+        padded += bucket
+    m = rai_measures()
+    m["perturbations"].inc(n, explainer=name)
+    if padded:
+        m["occupancy"].set(valid / padded, explainer=name)
+    return out[:, explainer._target_index(out.shape[1])]
+
+
+def _chunked_transform_scores(explainer, samples, builder) -> np.ndarray:
+    """The no-protocol fallback: ONE ``_score_samples`` call per
+    ladder-capped chunk (fused across rows, bounded memory) instead of one
+    per explained row."""
+    n = len(samples)
+    name = type(explainer).__name__
+    blocks = []
+    for start in range(0, n, MAX_FUSED_ROWS):
+        chunk = samples[start:start + MAX_FUSED_ROWS]
+        blocks.append(explainer._score_samples(builder(chunk)))
+    rai_measures()["perturbations"].inc(n, explainer=name)
+    return np.concatenate(blocks, axis=0) if blocks else \
+        np.empty((0, 1), np.float64)
+
+
+def fused_array_scores(explainer, X: np.ndarray,
+                       builder=None) -> np.ndarray:
+    """[N, ...] perturbation samples -> [N, T] scores, fused.
+
+    Uses the model's score-fn protocol when available (ladder-bucketed
+    CompiledCache executables); otherwise falls back to ladder-capped
+    chunks through ``builder`` + ``_score_samples`` (``builder`` defaults
+    to a single-column DataFrame over the explainer's ``input_col``)."""
+    fn = array_score_fn(explainer.get("model"))
+    if fn is not None:
+        return _ladder_scores(explainer, X, fn)
+    if builder is None:
+        from ..core.dataframe import DataFrame
+
+        col = explainer.get("input_col")
+        builder = lambda chunk: DataFrame.from_dict({col: chunk})  # noqa: E731
+    return _chunked_transform_scores(explainer, X, builder)
+
+
+def fused_block_scores(explainer, blocks: list, builder) -> list:
+    """Per-row sample blocks -> per-row score arrays, scored together.
+
+    ``blocks`` holds one samples payload per explained row (an ndarray
+    [S, ...] or a list, e.g. text variants). Blocks with a common payload
+    shape are concatenated into one mega-batch — ndarrays ride the
+    score-fn/ladder path via :func:`fused_array_scores`, ragged or
+    non-array payloads ride the chunked-transform fallback — then split
+    back per row, so results are identical to scoring each row alone."""
+    groups: dict = {}                     # signature -> [block indices]
+    for i, b in enumerate(blocks):
+        sig = (("nd",) + tuple(np.asarray(b).shape[1:])
+               if isinstance(b, np.ndarray) else ("raw",))
+        groups.setdefault(sig, []).append(i)
+    out: list = [None] * len(blocks)
+    for sig, idxs in groups.items():
+        counts = [len(blocks[i]) for i in idxs]
+        if sig[0] == "nd":
+            cat = np.concatenate([blocks[i] for i in idxs], axis=0)
+            scores = fused_array_scores(explainer, cat, builder)
+        else:
+            cat = []
+            for i in idxs:
+                cat.extend(blocks[i])
+            scores = _chunked_transform_scores(explainer, cat, builder)
+        offset = 0
+        for i, c in zip(idxs, counts):
+            out[i] = scores[offset:offset + c]
+            offset += c
+    return out
+
+
+def fused_columnar_scores(explainer, cols: dict) -> np.ndarray | None:
+    """The ICE path: assemble the model's declared ``score_cols`` from a
+    columnar dict and score through the ladder. ``None`` when the model
+    doesn't declare a columnar score layout (caller falls back serial)."""
+    model = explainer.get("model")
+    names = getattr(model, "score_cols", None)
+    if not names or array_score_fn(model) is None:
+        return None
+    try:
+        X = np.stack([np.asarray(cols[c], np.float32) for c in names],
+                     axis=1)
+    except (KeyError, ValueError, TypeError):
+        return None
+    return fused_array_scores(explainer, X)

@@ -1,0 +1,69 @@
+"""The ``synapseml_rai_*`` metric series (PR-2 observability plane).
+
+One :class:`~synapseml_tpu.core.observability.HandleCache` per plane is the
+repo-wide discipline (``_SCORING_METRICS``, ``_LOOP_METRICS``, ...); the rai
+plane's series cover the three workloads it owns:
+
+- fused explanation (``explanations``/``perturbations`` counters + the
+  per-run rate gauges and the fused-batch ``occupancy`` gauge — valid rows
+  over padded rows, the ladder's wasted-compute fraction);
+- streamed explanation runs (``progress`` — mirrors the scoring plane's
+  per-shard progress at the explanation granularity);
+- audit jobs (``audit_runs`` counter by outcome, ``audit_ms`` wall
+  histogram, and the ``segment_drift`` gauge that
+  ``ContinualLoop.drift_gauge`` watches — one labeled series per
+  (model, segment), max over segments drives the retrain trigger).
+
+See docs/RAI.md for the full series table.
+"""
+
+from __future__ import annotations
+
+from ..core import observability as obs
+
+__all__ = ["rai_measures", "DRIFT_GAUGE"]
+
+# the default gauge name AuditJob publishes per-segment drift under; pass it
+# as ``ContinualSpec.drift_gauge`` to close the audit -> retrain loop
+DRIFT_GAUGE = "synapseml_rai_segment_drift"
+
+_RAI_METRICS = obs.HandleCache(lambda reg: {
+    "explanations": reg.counter(
+        "synapseml_rai_explanations_total",
+        "rows explained (one explanation vector per row per target)",
+        ("explainer",)),
+    "perturbations": reg.counter(
+        "synapseml_rai_perturbations_total",
+        "perturbed samples scored through the explained model",
+        ("explainer",)),
+    "explanations_per_sec": reg.gauge(
+        "synapseml_rai_explanations_per_sec",
+        "explanation throughput of the last streamed run", ("explainer",)),
+    "perturbations_per_sec": reg.gauge(
+        "synapseml_rai_perturbations_per_sec",
+        "perturbation scoring throughput of the last streamed run",
+        ("explainer",)),
+    "occupancy": reg.gauge(
+        "synapseml_rai_fused_occupancy",
+        "valid rows / padded rows across fused score batches (1.0 = no "
+        "ladder padding waste)", ("explainer",)),
+    "progress": reg.gauge(
+        "synapseml_rai_progress_pct",
+        "streamed explanation run progress (rows written / estimated rows)",
+        ("explainer",)),
+    "audit_runs": reg.counter(
+        "synapseml_rai_audit_runs_total",
+        "audit job iterations by outcome", ("model", "status")),
+    "audit_ms": reg.histogram(
+        "synapseml_rai_audit_ms",
+        "wall time of one full audit job iteration", ("model",)),
+    "segment_drift": reg.gauge(
+        DRIFT_GAUGE,
+        "per-segment drift (PSI) of logged traffic vs the reference window",
+        ("model", "segment")),
+})
+
+
+def rai_measures() -> dict:
+    """The rai plane's metric handles (registry-swap-safe memo)."""
+    return _RAI_METRICS.get()

@@ -1,0 +1,84 @@
+"""Streaming adapters: corpus-scale explanation runs on the scoring plane.
+
+``TabularSHAP(model).transform_source(source, sink)`` routes here
+(``LocalExplainerBase.transform_source``): the run IS a PR-11 bulk-scoring
+scan — reader→compute→writer bounded queues, exactly-once DONE-gated sink
+parts, resume that skips completed shards, per-row quarantine — with the
+explainer as the scored stage and the ``synapseml_rai_*`` series layered on
+top of ``synapseml_scoring_*``. Because every explanation is keyed on
+(seed, row content) (``explainers.row_rng``) and fused batches never leak
+across rows, a killed run resumed mid-corpus produces byte-identical sink
+parts — the scoring plane's kill/resume contract holds for explanations.
+
+Progress rides a sink proxy: each shard COMMIT (the DONE marker) updates
+``synapseml_rai_progress_pct`` from rows written vs the source's row
+estimate, so a nightly explanation job is observable at the explanation
+granularity without waiting for the final report.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .metrics import rai_measures
+
+__all__ = ["explain_source"]
+
+
+class _ProgressSink:
+    """Transparent ScoreSink proxy: counts rows as shards COMMIT and feeds
+    the rai progress gauge; every other attribute delegates to the wrapped
+    sink (same part layout, same resume semantics)."""
+
+    def __init__(self, sink, explainer_name: str, total_rows):
+        self._sink = sink
+        self._name = explainer_name
+        self._total = total_rows
+        self._rows = 0
+
+    def __getattr__(self, attr):
+        return getattr(self._sink, attr)
+
+    def begin_shard(self, *args, **kwargs):
+        part = self._sink.begin_shard(*args, **kwargs)
+        proxy = self
+        orig_finish = part.finish
+
+        def finish(meta=None):
+            record = orig_finish(meta)
+            proxy._rows += int(record.get("rows", 0))
+            if proxy._total:
+                rai_measures()["progress"].set(
+                    min(100.0 * proxy._rows / max(proxy._total, 1), 100.0),
+                    explainer=proxy._name)
+            return record
+
+        part.finish = finish
+        return part
+
+
+def explain_source(explainer, source, sink, **opts):
+    """Explain every row of ``source`` into ``sink`` — the scoring plane's
+    ``transform_source`` with the ``synapseml_rai_*`` series recorded
+    around it. Returns the scoring plane's ``ScoringReport``."""
+    from ..scoring.runner import transform_source
+
+    name = type(explainer).__name__
+    m = rai_measures()
+    try:
+        total = source.estimate_rows(read_fallback=False)
+    except Exception:  # noqa: BLE001 — progress is best-effort
+        total = None
+    t0 = time.perf_counter()
+    report = transform_source(explainer, source,
+                              _ProgressSink(sink, name, total), **opts)
+    wall = max(time.perf_counter() - t0, 1e-9)
+    S = int(explainer.get("num_samples") or 0)
+    m["explanations_per_sec"].set(report.rows_written / wall, explainer=name)
+    m["perturbations_per_sec"].set(report.rows_written * S / wall,
+                                   explainer=name)
+    m["progress"].set(100.0 if report.complete else
+                      min(100.0 * report.shards_done /
+                          max(report.shards_assigned, 1), 100.0),
+                      explainer=name)
+    return report

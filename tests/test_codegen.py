@@ -197,6 +197,29 @@ def test_retrieval_compat_coverage():
             "object")
 
 
+def test_rai_compat_coverage():
+    """Same compat coverage rule for the responsible-AI audit plane: every
+    public ``synapseml_tpu.rai`` symbol importable from the generated
+    ``compat.rai`` passthrough, with no stale extras. The plane's __init__
+    is lazy (PEP 562), so identity holds through __getattr__."""
+    import synapseml_tpu.compat.rai as compat_rai
+    import synapseml_tpu.rai as rai
+
+    public = set(rai.__all__)
+    covered = set(compat_rai.__all__)
+    missing = sorted(public - covered)
+    assert not missing, (
+        f"public rai symbols missing compat coverage: {missing}; "
+        "run python -m synapseml_tpu.codegen")
+    stale = sorted(covered - public)
+    assert not stale, (
+        f"compat.rai exports symbols the rai plane no longer "
+        f"has: {stale}; run python -m synapseml_tpu.codegen")
+    for name in sorted(public):
+        assert getattr(compat_rai, name) is getattr(rai, name), (
+            f"compat.rai.{name} is not the rai plane's own object")
+
+
 def test_no_inline_jit_in_stage_transform():
     """Static guard for the continuous-batching plane: inference-stage
     modules must acquire jitted programs through
@@ -262,7 +285,18 @@ def test_no_inline_jit_in_stage_transform():
                # off the cache miss counters
                "retrieval/scorer.py", "retrieval/model.py",
                "retrieval/build.py", "retrieval/ingest.py",
-               "retrieval/serve.py"]
+               "retrieval/serve.py",
+               # the rai audit plane: the fused perturbation engine's whole
+               # claim is "compile bill bounded by the ladder", which only
+               # holds if every explainer/audit jit goes through the cache
+               # where the miss counters the acceptance test reads can see
+               # it; the explainers and the audited scorers (iforest,
+               # balance) are held to the same rule
+               "rai/fused.py", "rai/stream.py", "rai/audit.py",
+               "rai/drift.py", "rai/metrics.py",
+               "explainers/base.py", "explainers/shap.py",
+               "explainers/lime.py", "explainers/ice.py",
+               "isolationforest/iforest.py", "exploratory/balance.py"]
     pkg = pathlib.Path(st.__file__).parent
     offenders = []
     for rel in modules:

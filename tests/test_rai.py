@@ -1,0 +1,468 @@
+"""Responsible-AI audit plane (ISSUE 20): fused-vs-serial explainer parity,
+ladder-bounded compiles, streamed explanation kill/resume byte-identity,
+partition-invariant determinism, audit artifacts, and the drift-triggered
+retrain flywheel with audit evidence in the trigger reason."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.core import DataFrame
+from synapseml_tpu.core import batching as cb
+from synapseml_tpu.core import observability as obs
+from synapseml_tpu.core.pipeline import Transformer
+from synapseml_tpu.explainers import (
+    ICETransformer,
+    TabularSHAP,
+    TextLIME,
+    TextSHAP,
+    VectorLIME,
+    VectorSHAP,
+    row_rng,
+)
+from synapseml_tpu.registry import ModelRegistry
+
+pytestmark = pytest.mark.rai
+
+
+# ---------------------------------------------------------------------------
+# fixtures: scorers with and without the score-fn protocol
+# ---------------------------------------------------------------------------
+
+class ProtoLinear(Transformer):
+    """score = x @ w + b, exposed BOTH ways: a serial DataFrame transform
+    and the rai score-fn protocol (pure jax array fn) — the parity pair."""
+
+    def __init__(self, w, b=0.0, input_col="features", **kw):
+        super().__init__(**kw)
+        self._w = np.asarray(w, np.float32)
+        self._b = float(b)
+        self._input_col = input_col
+
+    def _transform(self, df):
+        def score(p):
+            X = np.stack([np.asarray(v, np.float64)
+                          for v in p[self._input_col]])
+            s = X @ self._w.astype(np.float64) + self._b
+            return np.asarray([np.asarray([v]) for v in s])
+
+        return df.with_column("probability", score)
+
+    def score_fn(self):
+        w, b = self._w, self._b
+        return lambda X: (X.astype("float32") @ w + b)[:, None]
+
+
+class ProtoColumnar(ProtoLinear):
+    """The ICE shape of the protocol: ``score_cols`` names the column
+    order the array fn consumes."""
+
+    score_cols = ("x0", "x1")
+
+    def _transform(self, df):
+        def score(p):
+            X = np.stack([np.asarray(p[c], np.float64)
+                          for c in self.score_cols], axis=1)
+            s = X @ self._w.astype(np.float64) + self._b
+            return np.asarray([np.asarray([v]) for v in s])
+
+        return df.with_column("probability", score)
+
+
+class KeywordScorer(Transformer):
+    """Text scorer with NO protocol — exercises the chunked-transform
+    fallback (fusion at the batching level)."""
+
+    def _transform(self, sdf):
+        def score(p):
+            return np.asarray(
+                [np.asarray([1.0 if "good" in str(t).split() else 0.0])
+                 for t in p["text"]])
+
+        return sdf.with_column("probability", score)
+
+
+def _explanations(out):
+    return [np.asarray(v) for v in out.collect_column("explanation")]
+
+
+def _fused_serial_pair(cls, model, df, **kw):
+    serial = _explanations(cls(model=model, fused=False, seed=0,
+                               **kw).transform(df))
+    fused = _explanations(cls(model=model, fused=True, seed=0,
+                              **kw).transform(df))
+    return fused, serial
+
+
+# ---------------------------------------------------------------------------
+# fused-vs-serial parity + compile bounds
+# ---------------------------------------------------------------------------
+
+def test_fused_matches_serial_vector_explainers():
+    """Vector SHAP/LIME through the score-fn ladder path: attributions
+    match the serial reference at f32 tolerance, and the whole run compiles
+    at most one executable per ladder rung."""
+    rs = np.random.default_rng(1)
+    w = np.asarray([1.0, -2.0, 0.5, 0.0])
+    X = rs.normal(size=(12, 4)).astype(np.float32)
+    df = DataFrame.from_dict({"features": X})
+    model = ProtoLinear(w, b=1.0)
+    cb.reset_compiled_cache()
+    before = cb.get_compiled_cache().miss_count("rai.fused_score")
+    for cls, kw in [(VectorSHAP, dict(num_samples=64, background_data=df)),
+                    (VectorLIME, dict(num_samples=100, regularization=1e-4,
+                                      background_data=df))]:
+        fused, serial = _fused_serial_pair(cls, model, df, **kw)
+        np.testing.assert_allclose(np.stack(fused), np.stack(serial),
+                                   rtol=1e-4, atol=1e-4)
+        # the 'auto' default detects the protocol
+        assert cls(model=model, **kw)._use_fused()
+    ladder = len(cb.default_bucketer().buckets_upto(1024))
+    misses = cb.get_compiled_cache().miss_count("rai.fused_score") - before
+    assert 0 < misses <= ladder, (misses, ladder)
+
+
+def test_fused_matches_serial_tabular_and_text():
+    """Models WITHOUT the protocol (Tabular proxy, text scorers) ride the
+    chunked-transform fallback: identical numbers, zero new executables."""
+    rs = np.random.default_rng(2)
+    X = rs.normal(size=(8, 2)).astype(np.float32)
+    tab_df = DataFrame.from_dict({"a": X[:, 0], "b": X[:, 1]})
+
+    class ColScorer(Transformer):
+        def _transform(self, sdf):
+            def score(p):
+                s = (np.asarray(p["a"], np.float64) * 1.5
+                     - np.asarray(p["b"], np.float64))
+                return np.asarray([np.asarray([v]) for v in s])
+
+            return sdf.with_column("probability", score)
+
+    cb.reset_compiled_cache()
+    before = cb.get_compiled_cache().miss_count("rai.fused_score")
+    fused, serial = _fused_serial_pair(
+        TabularSHAP, ColScorer(), tab_df, input_cols=["a", "b"],
+        num_samples=16, background_data=tab_df)
+    np.testing.assert_allclose(np.stack(fused), np.stack(serial), rtol=1e-6)
+
+    text_df = DataFrame.from_dict(
+        {"text": ["this is a good movie", "bad film overall",
+                  "good good good", "nothing to see"]})
+    for cls, kw in [(TextSHAP, dict(num_samples=32)),
+                    (TextLIME, dict(num_samples=32, regularization=1e-4))]:
+        fused, serial = _fused_serial_pair(cls, KeywordScorer(), text_df,
+                                           **kw)
+        assert len(fused) == len(serial)
+        for f, s in zip(fused, serial):
+            np.testing.assert_allclose(f, s, rtol=1e-6)
+    assert cb.get_compiled_cache().miss_count("rai.fused_score") == before
+
+
+def test_ice_fused_columnar_matches_serial():
+    rs = np.random.default_rng(3)
+    df = DataFrame.from_dict(
+        {"x0": rs.uniform(-2, 2, 20).astype(np.float32),
+         "x1": rs.uniform(-2, 2, 20).astype(np.float32)})
+    model = ProtoColumnar(np.asarray([2.0, -1.0]), input_col=None)
+    curves = {}
+    for fused in (False, True):
+        ice = ICETransformer(model=model, fused=fused, target_col="probability",
+                             numeric_features=["x0"], num_splits=5,
+                             kind="average")
+        curves[fused] = ice.transform(df).collect_column("x0_dependence")[0]
+    assert curves[False].keys() == curves[True].keys()
+    for k in curves[False]:
+        np.testing.assert_allclose(curves[True][k], curves[False][k],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_row_rng_partition_invariance():
+    """Explanations are keyed on (seed, row content): repartitioning the
+    frame — or explaining a row alongside different neighbors — changes
+    nothing. (The pre-rai sampler drew from one sequential stream, so row
+    i's design depended on how many rows preceded it.)"""
+    rs = np.random.default_rng(4)
+    X = rs.normal(size=(9, 3)).astype(np.float32)
+    bg = DataFrame.from_dict({"features": X})
+    w = np.asarray([3.0, -2.0, 0.0])
+
+    def explain(df, cls, **kw):
+        return _explanations(
+            cls(model=ProtoLinear(w), seed=0, background_data=bg,
+                **kw).transform(df))
+
+    for cls, kw in [(VectorSHAP, dict(num_samples=20)),
+                    (VectorLIME, dict(num_samples=50,
+                                      regularization=1e-4))]:
+        whole = explain(DataFrame.from_dict({"features": X}), cls, **kw)
+        parts = explain(DataFrame.from_dict({"features": X},
+                                            num_partitions=3), cls, **kw)
+        solo = explain(DataFrame.from_dict({"features": X[4:5]}), cls, **kw)
+        np.testing.assert_array_equal(np.stack(whole), np.stack(parts))
+        np.testing.assert_array_equal(whole[4], solo[0])
+    # the rng itself: content-keyed, seed-sensitive
+    a = row_rng(0, X[0]).random(4)
+    np.testing.assert_array_equal(a, row_rng(0, X[0].copy()).random(4))
+    assert not np.array_equal(a, row_rng(1, X[0]).random(4))
+    assert not np.array_equal(a, row_rng(0, X[1]).random(4))
+
+
+# ---------------------------------------------------------------------------
+# streamed explanation runs: exactly-once on the scoring plane
+# ---------------------------------------------------------------------------
+
+class _Kill(BaseException):
+    """Process-kill stand-in (BaseException so quarantine can't eat it)."""
+
+
+class KillAfter(Transformer):
+    """Delegates to an inner explainer, killing the scan after N batches."""
+
+    def __init__(self, inner, after, **kw):
+        super().__init__(**kw)
+        self._inner = inner
+        self._after = after
+        self._seen = 0
+
+    def _transform(self, df):
+        if self._seen >= self._after:
+            raise _Kill(f"killed after {self._seen} batches")
+        self._seen += 1
+        return self._inner._transform(df)
+
+
+def _write_corpus(directory, sizes, n_features=3, seed=0):
+    os.makedirs(directory, exist_ok=True)
+    rs = np.random.default_rng(seed)
+    i = 0
+    for s, n in enumerate(sizes):
+        with open(os.path.join(directory, f"in-{s:03d}.jsonl"), "w") as f:
+            for _ in range(n):
+                f.write(json.dumps({
+                    "features": [round(float(v), 5)
+                                 for v in rs.normal(size=n_features)],
+                    "i": i}) + "\n")
+                i += 1
+    return i
+
+
+def _part_bytes(sink):
+    return b"".join(open(p, "rb").read() for p in sink.part_files())
+
+
+def _bg():
+    rs = np.random.default_rng(9)
+    return DataFrame.from_dict(
+        {"features": rs.normal(size=(32, 3)).astype(np.float32)})
+
+
+def _explainer():
+    return VectorSHAP(model=ProtoLinear(np.asarray([1.0, -1.0, 0.5])),
+                      num_samples=16, seed=0, background_data=_bg())
+
+
+def test_streamed_explanations_kill_resume_byte_identical(tmp_path):
+    """The scoring plane's exactly-once contract holds for explanation
+    runs: kill at three cut points, resume with a fresh runner, output
+    byte-identical to the uninterrupted run (content-keyed rngs mean the
+    resumed rows redraw the exact same designs)."""
+    from synapseml_tpu.data.source import ShardedSource
+    from synapseml_tpu.scoring import JsonlSink
+
+    total = _write_corpus(tmp_path / "data", [23, 9, 31, 6])
+    src = ShardedSource.jsonl(os.path.join(tmp_path, "data", "*.jsonl"))
+    clean = JsonlSink(tmp_path / "clean", columns=["i", "explanation"])
+    report = _explainer().transform_source(src, clean, batch_rows=16,
+                                           host_index=0, host_count=1)
+    assert report.complete and report.rows_written == total
+    golden = _part_bytes(clean)
+    assert golden
+
+    for cut in (1, 2, 4):
+        out = tmp_path / f"out_cut{cut}"
+        killer = KillAfter(_explainer(), cut)
+        with pytest.raises(_Kill):
+            killer.transform_source(
+                src, JsonlSink(out, columns=["i", "explanation"]),
+                batch_rows=16, host_index=0, host_count=1)
+        sink = JsonlSink(out, columns=["i", "explanation"])
+        assert not sink.is_complete()
+        report = _explainer().transform_source(src, sink, batch_rows=16,
+                                               host_index=0, host_count=1)
+        assert report.complete
+        assert report.shards_skipped + report.shards_done == 4
+        assert _part_bytes(sink) == golden
+
+
+def test_streamed_run_metrics_and_quarantine(tmp_path):
+    """The rai series rides the run: progress lands at 100, rates are set,
+    and a poisoned row quarantines instead of killing the scan."""
+    from synapseml_tpu.data.source import ShardedSource
+    from synapseml_tpu.scoring import JsonlSink
+
+    obs.reset_registry()
+    d = tmp_path / "data"
+    total = _write_corpus(d, [12, 8])
+    # poison one row: a non-numeric feature payload
+    with open(os.path.join(d, "in-001.jsonl"), "a") as f:
+        f.write(json.dumps({"features": "not-a-vector", "i": total}) + "\n")
+    src = ShardedSource.jsonl(os.path.join(d, "*.jsonl"))
+    sink = JsonlSink(tmp_path / "out", columns=["i", "explanation"])
+    report = _explainer().transform_source(src, sink, batch_rows=8,
+                                           host_index=0, host_count=1)
+    assert report.complete and report.rows_written == total
+    assert report.rows_quarantined >= 1
+    snap = obs.get_registry().snapshot()
+    prog = [v for k, v in snap.items()
+            if k.startswith("synapseml_rai_progress_pct")]
+    assert prog and max(prog) == pytest.approx(100.0)
+    assert any(k.startswith("synapseml_rai_explanations_total")
+               for k in snap)
+    rates = [v for k, v in snap.items()
+             if k.startswith("synapseml_rai_explanations_per_sec")]
+    assert rates and max(rates) > 0
+
+
+# ---------------------------------------------------------------------------
+# audit jobs + the retrain flywheel
+# ---------------------------------------------------------------------------
+
+def _log_traffic(logdir, X, segments, labels=None, part=0, rows_per=40):
+    """Committed RequestLogger-layout parts carrying (x, segment, y)."""
+    os.makedirs(logdir, exist_ok=True)
+    for k in range(0, len(X), rows_per):
+        name = f"part-{part:05d}.jsonl"
+        chunk = range(k, min(k + rows_per, len(X)))
+        with open(os.path.join(logdir, name), "w") as f:
+            for i in chunk:
+                body = {"x": [float(v) for v in X[i]]}
+                if labels is not None:
+                    body["y"] = int(labels[i])
+                f.write(json.dumps(
+                    {"ts": i, "method": "POST", "path": f"/{segments[i]}",
+                     "status": 200, "latency_ms": 1.0, "body": body,
+                     "reply": {}}) + "\n")
+        with open(os.path.join(logdir, name + ".DONE"), "w") as f:
+            json.dump({"rows": len(list(chunk))}, f)
+        part += 1
+    return part
+
+
+def test_audit_job_publishes_artifact_and_raises_gauge(tmp_path):
+    import synapseml_tpu.rai as rai
+    from synapseml_tpu.continual import annotate_drift_gauge, drift_annotation
+
+    obs.reset_registry()
+    annotate_drift_gauge(rai.DRIFT_GAUGE, None)
+    rs = np.random.default_rng(0)
+    ref = rs.normal(0, 1, (300, 4))
+    n = 120
+    segs = ["base" if i % 2 else "shifted" for i in range(n)]
+    X = np.stack([rs.normal(0 if s == "base" else 4.0, 1, 4)
+                  for s in segs])
+    y = (X[:, 0] > 1).astype(int)
+    logdir = tmp_path / "log"
+    _log_traffic(str(logdir), X, segs, labels=y)
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    spec = rai.AuditSpec(model="m", reference=ref,
+                         segment_fn=lambda r: r["path"].strip("/"),
+                         label_fn=lambda r: r["body"].get("y"),
+                         anomaly_trees=16)
+    res = rai.AuditJob(spec, reg, str(logdir)).run_once()
+    assert res["status"] == "ok"
+    assert res["worst_segment"] == "shifted"
+    assert res["drift"]["shifted"]["drift"] > res["drift"]["base"]["drift"]
+    assert res["artifact"] == "m-audit:v1"
+    # the artifact: resolvable, manifest links model + window + metrics
+    rm = reg.resolve("m-audit", "latest")
+    manifest = json.load(open(os.path.join(rm.path, "audit",
+                                           "manifest.json")))
+    assert manifest["model"] == "m"
+    assert manifest["window"]["rows"] == n
+    assert manifest["window"]["parts"] == sorted(manifest["window"]["parts"])
+    assert manifest["worst_segment"] == "shifted"
+    assert manifest["metrics"]["max_segment_drift"] == pytest.approx(
+        res["drift"]["shifted"]["drift"])
+    per_seg = json.load(open(os.path.join(rm.path, "audit",
+                                          "segment_drift.json")))
+    assert set(per_seg) == {"base", "shifted"}
+    assert os.path.exists(os.path.join(rm.path, "audit", "balance.jsonl"))
+    assert os.path.exists(os.path.join(rm.path, "audit", "anomaly.json"))
+    # gauge raised per segment + annotated with the artifact ref
+    snap = obs.get_registry().snapshot()
+    key = f'{rai.DRIFT_GAUGE}{{model="m",segment="shifted"}}'
+    assert snap[key] > 1.0
+    assert drift_annotation(rai.DRIFT_GAUGE) == "m-audit:v1"
+    # a second run versions the artifact, never overwrites
+    assert rai.AuditJob(spec, reg, str(logdir)).run_once()["artifact"] == \
+        "m-audit:v2"
+
+
+def test_audit_job_empty_window_publishes_nothing(tmp_path):
+    import synapseml_tpu.rai as rai
+
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    logdir = tmp_path / "log"
+    os.makedirs(logdir)
+    res = rai.AuditJob(
+        rai.AuditSpec(model="m", reference=np.zeros((10, 4))),
+        reg, str(logdir)).run_once()
+    assert res["status"] == "empty"
+    assert reg.list_models() == [] if hasattr(reg, "list_models") else True
+    with pytest.raises(Exception):
+        reg.resolve("m-audit", "latest")
+
+
+def test_flywheel_drift_audit_triggers_retrain_with_evidence(tmp_path):
+    """The E2E flywheel (the tentpole's acceptance path): drifted-segment
+    traffic → AuditJob publishes the artifact + raises the segment gauge →
+    the ContinualLoop's drift watch fires with the audit ref in the trigger
+    reason → retrain promotes through the eval gate, ``prod`` untouched
+    until it passes."""
+    import synapseml_tpu.rai as rai
+    from synapseml_tpu.continual import annotate_drift_gauge
+    from test_continual import (_W_TRUE, D_IN, _loop_fixture, make_rows,
+                                write_part)
+
+    obs.reset_registry()
+    annotate_drift_gauge(rai.DRIFT_GAUGE, None)
+    reg, logdir, loop = _loop_fixture(tmp_path, min_new_rows=100_000,
+                                      drift_gauge=rai.DRIFT_GAUGE,
+                                      drift_threshold=1.0)
+    # logged traffic: half healthy, half with feature 0 shifted +4 (the
+    # drifted segment); shifted labels recomputed under the true rule so
+    # the retrain still has consistent data
+    Xh, yh = make_rows(120, seed=7)
+    Xs = make_rows(120, seed=17)[0] + np.asarray(
+        [4.0] + [0.0] * (D_IN - 1), np.float32)
+    ys = np.digitize(Xs @ _W_TRUE,
+                     np.quantile(Xs @ _W_TRUE, [1 / 3, 2 / 3])).astype(
+                         np.int32)
+    for k in range(4):
+        write_part(str(logdir), k, Xh[k * 30:(k + 1) * 30],
+                   yh[k * 30:(k + 1) * 30])
+        write_part(str(logdir), 4 + k, Xs[k * 30:(k + 1) * 30],
+                   ys[k * 30:(k + 1) * 30])
+
+    # not due on freshness alone, gauge unset -> no run
+    ok, _ = loop.should_run()
+    assert not ok
+
+    ref, _ = make_rows(300, seed=8)         # the healthy reference window
+    spec = rai.AuditSpec(
+        model="m", reference=ref,
+        segment_fn=lambda r: "shifted" if r["body"]["x"][0] > 2 else "base")
+    res = rai.AuditJob(spec, reg, str(logdir)).run_once()
+    assert res["status"] == "ok" and res["worst_segment"] == "shifted"
+    artifact = res["artifact"]
+
+    assert reg.alias_target("m", "prod") == "v1"  # untouched pre-retrain
+    ok, reason = loop.should_run()
+    assert ok and "drift" in reason
+    assert f"audit={artifact}" in reason
+    rec = loop.run_once()
+    assert rec["outcome"] == "promoted", rec
+    assert f"audit={artifact}" in rec["trigger"]
+    assert reg.alias_target("m", "prod") == rec["version"] != "v1"
